@@ -1,0 +1,86 @@
+"""Headline benchmark: sustained ingest -> rule-eval -> device-state throughput.
+
+Measures the fused hot-path step (validation gather + threshold table +
+geofence containment + keyed device-state fold) at production shapes on the
+available accelerator, including per-step host->device batch transfer —
+i.e., configs 2+3 of BASELINE.md combined, the path the reference runs across
+service-inbound-processing -> service-rule-processing -> service-device-state.
+
+Prints ONE JSON line: events/sec vs the 1M ev/s north star (BASELINE.json),
+plus p50/p99 step latency as auxiliary fields.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+
+    from sitewhere_tpu.model import AlertLevel
+    from sitewhere_tpu.pipeline.engine import (
+        GeofenceRule, PipelineEngine, ThresholdRule)
+    from __graft_entry__ import _example_world, _synthetic_batch
+
+    # BENCH_SCALE=small gives a CPU-feasible smoke configuration.
+    small = os.environ.get("BENCH_SCALE") == "small"
+    BATCH = 2048 if small else 32768
+    MAX_DEVICES = 8192 if small else 131072
+    N_REGISTERED = 2000 if small else 100_000  # BASELINE config 3: 100k devices
+    STEPS = 10 if small else 50
+    WARMUP = 2 if small else 5
+
+    _, tensors = _example_world(max_devices=MAX_DEVICES,
+                                n_registered=N_REGISTERED,
+                                max_zones=64, max_verts=16)
+    engine = PipelineEngine(tensors, batch_size=BATCH,
+                            measurement_slots=8 if small else 32,
+                            max_tenants=16, max_threshold_rules=64,
+                            max_geofence_rules=64)
+    engine.packer.measurements.intern("m1")
+    for i in range(16):
+        engine.add_threshold_rule(ThresholdRule(
+            token=f"thr-{i}", measurement_name="m1", operator=">",
+            threshold=95.0 + i, alert_level=AlertLevel.WARNING))
+    engine.add_geofence_rule(GeofenceRule(
+        token="fence", zone_token="zone-1", condition="outside"))
+    engine.start()
+
+    pool = [_synthetic_batch(engine.packer, N_REGISTERED, BATCH, seed=s)
+            for s in range(8)]
+
+    for i in range(WARMUP):
+        out = engine.submit(pool[i % len(pool)])
+    jax.block_until_ready(out.processed)
+
+    latencies = []
+    t0 = time.perf_counter()
+    for i in range(STEPS):
+        s0 = time.perf_counter()
+        out = engine.submit(pool[i % len(pool)])
+        out.processed.block_until_ready()
+        latencies.append(time.perf_counter() - s0)
+    total = time.perf_counter() - t0
+
+    events_per_sec = STEPS * BATCH / total
+    lat = np.array(sorted(latencies))
+    result = {
+        "metric": "events/sec ingest->rule->device-state (fused step, "
+                  f"{N_REGISTERED} devices, batch {BATCH})",
+        "value": round(events_per_sec, 1),
+        "unit": "events/sec",
+        "vs_baseline": round(events_per_sec / 1_000_000, 4),
+        "p50_step_ms": round(float(lat[len(lat) // 2]) * 1000, 3),
+        "p99_step_ms": round(float(lat[int(len(lat) * 0.99)]) * 1000, 3),
+        "device": str(jax.devices()[0]),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,23 @@
+"""sitewhere_tpu: a TPU-native IoT application-enablement framework.
+
+A ground-up rebuild of the capabilities of SiteWhere 2.0 (the reference Java
+microservice platform) designed TPU-first: the hot event path
+(ingest -> validate -> rule-eval -> device-state) executes as a single fused
+JAX/XLA step over HBM-resident event tensors, sharded over a TPU mesh with
+ICI collectives, while the control plane (registry, tenants, users, REST API,
+command delivery) runs as conventional host-side Python.
+
+Package map (reference layer -> here):
+  L0 API/model contract  (sitewhere-core-api)        -> sitewhere_tpu.model
+  L1 core runtime        (sitewhere-microservice,
+                          sitewhere-core-lifecycle)  -> sitewhere_tpu.runtime
+  L2 communication       (Kafka + gRPC + MQTT)       -> sitewhere_tpu.runtime.bus (data plane),
+                                                        sitewhere_tpu.transport (device wire)
+  L3 persistence         (mongo/hbase/...)           -> sitewhere_tpu.persist, sitewhere_tpu.registry
+  L4 domain services     (service-*)                 -> sitewhere_tpu.pipeline (hot path on TPU),
+                                                        sitewhere_tpu.services (control plane)
+  L5 edge APIs           (service-web-rest, client)  -> sitewhere_tpu.api
+  TPU compute            (n/a in reference)          -> sitewhere_tpu.ops, sitewhere_tpu.parallel
+"""
+
+__version__ = "0.1.0"

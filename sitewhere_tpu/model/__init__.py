@@ -1,0 +1,76 @@
+"""Domain model: the L0 API contract.
+
+Python dataclass equivalents of the reference's com.sitewhere.spi.* surface
+(reference: sitewhere-core-api, 519 files). Every persisted entity carries a
+uuid `id`, a human `token`, timestamps and a metadata map, mirroring
+IPersistentEntity / IMetadataProvider.
+"""
+
+from sitewhere_tpu.model.common import (
+    PersistentEntity,
+    BrandedEntity,
+    Pager,
+    SearchCriteria,
+    SearchResults,
+    DateRangeCriteria,
+    Location,
+)
+from sitewhere_tpu.model.device import (
+    Device,
+    DeviceType,
+    DeviceAssignment,
+    DeviceAssignmentStatus,
+    DeviceCommand,
+    CommandParameter,
+    ParameterType,
+    DeviceStatus,
+    DeviceGroup,
+    DeviceGroupElement,
+    DeviceAlarm,
+    DeviceAlarmState,
+    DeviceElementMapping,
+)
+from sitewhere_tpu.model.area import (
+    AreaType,
+    Area,
+    Zone,
+    CustomerType,
+    Customer,
+)
+from sitewhere_tpu.model.event import (
+    DeviceEvent,
+    DeviceEventType,
+    DeviceMeasurement,
+    DeviceLocation,
+    DeviceAlert,
+    AlertLevel,
+    AlertSource,
+    DeviceCommandInvocation,
+    CommandInitiator,
+    CommandTarget,
+    DeviceCommandResponse,
+    DeviceStateChange,
+    DeviceStreamData,
+    DeviceEventBatch,
+    DeviceEventContext,
+    DeviceRegistrationRequest,
+)
+from sitewhere_tpu.model.state import DeviceState, PresenceState
+from sitewhere_tpu.model.tenant import Tenant
+from sitewhere_tpu.model.user import User, GrantedAuthority, ACCOUNT_STATUS
+from sitewhere_tpu.model.asset import Asset, AssetType, AssetCategory
+from sitewhere_tpu.model.batch import (
+    BatchOperation,
+    BatchOperationStatus,
+    BatchElement,
+    ElementProcessingStatus,
+)
+from sitewhere_tpu.model.schedule import (
+    Schedule,
+    ScheduledJob,
+    TriggerType,
+    ScheduledJobType,
+    ScheduledJobState,
+)
+
+__all__ = [name for name in dir() if not name.startswith("_")]

@@ -1,0 +1,179 @@
+"""Device registry model.
+
+Reference surface: sitewhere-core-api spi/device/ — IDevice, IDeviceType,
+IDeviceAssignment, IDeviceCommand, IDeviceStatus, IDeviceGroup, IDeviceAlarm,
+IDeviceElementMapping, DeviceAssignmentStatus.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from sitewhere_tpu.model.common import BrandedEntity, PersistentEntity
+
+
+class DeviceContainerPolicy(enum.Enum):
+    STANDALONE = "Standalone"
+    COMPOSITE = "Composite"
+
+
+@dataclass
+class DeviceType(BrandedEntity):
+    """Hardware/firmware class of devices (IDeviceType)."""
+
+    container_policy: DeviceContainerPolicy = DeviceContainerPolicy.STANDALONE
+    # For COMPOSITE types: named slots/units a child device can map into.
+    device_element_schema: Dict[str, str] = field(default_factory=dict)
+
+
+class ParameterType(enum.Enum):
+    """Command parameter wire types (spi/device/command/ParameterType.java,
+    mirroring protobuf scalar types)."""
+
+    DOUBLE = "Double"
+    FLOAT = "Float"
+    INT32 = "Int32"
+    INT64 = "Int64"
+    UINT32 = "UInt32"
+    UINT64 = "UInt64"
+    SINT32 = "SInt32"
+    SINT64 = "SInt64"
+    FIXED32 = "Fixed32"
+    FIXED64 = "Fixed64"
+    SFIXED32 = "SFixed32"
+    SFIXED64 = "SFixed64"
+    BOOL = "Bool"
+    STRING = "String"
+    BYTES = "Bytes"
+
+
+@dataclass
+class CommandParameter:
+    """One parameter of a device command (ICommandParameter)."""
+
+    name: str = ""
+    type: ParameterType = ParameterType.STRING
+    required: bool = False
+
+
+@dataclass
+class DeviceCommand(PersistentEntity):
+    """Command callable on devices of a type (IDeviceCommand)."""
+
+    device_type_id: str = ""
+    namespace: str = ""
+    name: str = ""
+    description: str = ""
+    parameters: List[CommandParameter] = field(default_factory=list)
+
+
+@dataclass
+class DeviceStatus(PersistentEntity):
+    """Named device status within a type's state machine (IDeviceStatus)."""
+
+    device_type_id: str = ""
+    code: str = ""
+    name: str = ""
+    background_color: str = ""
+    foreground_color: str = ""
+    border_color: str = ""
+    icon: str = ""
+
+
+@dataclass
+class DeviceElementMapping:
+    """Composite-device slot -> child device mapping (IDeviceElementMapping)."""
+
+    device_element_schema_path: str = ""
+    device_token: str = ""
+
+
+@dataclass
+class Device(PersistentEntity):
+    """Registered device (IDevice)."""
+
+    device_type_id: str = ""
+    parent_device_id: str = ""  # set when mapped into a composite parent
+    status: str = ""  # code of a DeviceStatus
+    comments: str = ""
+    device_element_mappings: List[DeviceElementMapping] = field(default_factory=list)
+
+
+class DeviceAssignmentStatus(enum.IntEnum):
+    """Assignment state machine (spi/device/DeviceAssignmentStatus.java).
+
+    Integer-valued: mirrored into the registry lookup tensor
+    (registry/tensors.py) so validation runs on device.
+    """
+
+    ACTIVE = 1
+    MISSING = 2
+    RELEASED = 3
+
+
+@dataclass
+class DeviceAssignment(PersistentEntity):
+    """Binding of a device to customer/area/asset for a period (IDeviceAssignment).
+
+    Events are always recorded against an assignment, not a raw device.
+    """
+
+    device_id: str = ""
+    device_type_id: str = ""
+    customer_id: str = ""
+    area_id: str = ""
+    asset_id: str = ""
+    status: DeviceAssignmentStatus = DeviceAssignmentStatus.ACTIVE
+    active_date: Optional[int] = None
+    released_date: Optional[int] = None
+
+
+class DeviceGroupRole:
+    """Well-known group element roles (reference uses free-form role strings)."""
+
+    GROUP = "group"
+    DEVICE = "device"
+
+
+@dataclass
+class DeviceGroup(BrandedEntity):
+    """Named set of devices/groups with roles (IDeviceGroup)."""
+
+    roles: List[str] = field(default_factory=list)
+
+
+@dataclass
+class DeviceGroupElement(PersistentEntity):
+    """Member of a device group (IDeviceGroupElement): device OR nested group."""
+
+    group_id: str = ""
+    device_id: str = ""
+    nested_group_id: str = ""
+    roles: List[str] = field(default_factory=list)
+
+
+class DeviceAlarmState(enum.Enum):
+    """Alarm lifecycle (spi/device/DeviceAlarmState.java)."""
+
+    TRIGGERED = "Triggered"
+    ACKNOWLEDGED = "Acknowledged"
+    RESOLVED = "Resolved"
+
+
+@dataclass
+class DeviceAlarm(PersistentEntity):
+    """Persistent alarm on a device (IDeviceAlarm), raised by rule processors."""
+
+    device_id: str = ""
+    device_assignment_id: str = ""
+    customer_id: str = ""
+    area_id: str = ""
+    asset_id: str = ""
+    alarm_message: str = ""
+    triggering_event_id: str = ""
+    state: DeviceAlarmState = DeviceAlarmState.TRIGGERED
+    triggered_date: Optional[int] = None
+    acknowledged_date: Optional[int] = None
+    resolved_date: Optional[int] = None

@@ -1,0 +1,46 @@
+"""Device state model: last-known values + presence.
+
+Reference surface: sitewhere-grpc-device-state / service-device-state —
+IDeviceState with last-interaction date, presence-missing date, and maps of
+last measurement/location/alert per assignment
+(DeviceStateProcessingLogic.java:116+).
+
+TPU note: this dataclass is the API view; the authoritative state lives in the
+HBM-resident DeviceStateTensors (pipeline/state_tensors.py) and is materialized
+into DeviceState records on API reads.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+from sitewhere_tpu.model.common import new_id
+
+
+class PresenceState(enum.IntEnum):
+    PRESENT = 1
+    NOT_PRESENT = 0
+
+
+@dataclass
+class DeviceState:
+    """Last-known state snapshot for one device assignment (IDeviceState)."""
+
+    id: str = field(default_factory=new_id)
+    device_id: str = ""
+    device_assignment_id: str = ""
+    device_type_id: str = ""
+    customer_id: str = ""
+    area_id: str = ""
+    asset_id: str = ""
+    last_interaction_date: Optional[int] = None
+    presence_missing_date: Optional[int] = None
+    presence: PresenceState = PresenceState.PRESENT
+    # measurement name -> (event_date, value)
+    last_measurements: Dict[str, tuple] = field(default_factory=dict)
+    # (event_date, lat, lon, elevation)
+    last_location: Optional[tuple] = None
+    # alert type -> (event_date, level, message)
+    last_alerts: Dict[str, tuple] = field(default_factory=dict)

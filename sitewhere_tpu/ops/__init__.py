@@ -1,0 +1,13 @@
+"""TPU compute ops: the kernels of the hot event path.
+
+Everything here is shape-static, jit-safe, and free of per-event Python — the
+replacement for the reference's per-event JVM work (decode, validate, JTS
+containment, Mongo upserts) described in SURVEY.md §3.2-3.3.
+"""
+
+from sitewhere_tpu.ops.pack import EventBatch, EventPacker
+from sitewhere_tpu.ops.threshold import ThresholdRuleTable, eval_threshold_rules
+from sitewhere_tpu.ops.geofence import ZoneTable, points_in_zones, eval_geofence_rules, GeofenceRuleTable
+from sitewhere_tpu.ops.segments import last_by_key, scatter_max_by_key, count_by_key
+
+__all__ = [name for name in dir() if not name.startswith("_")]

@@ -1,0 +1,184 @@
+"""Event packing: API events -> fixed-width SoA tensors.
+
+The wire/API view of events is the dataclass family in model/event.py; the
+device view is `EventBatch`: one fixed-width column per field, shape [B], with
+a validity mask for padding. Variable-rate ingest never changes shapes — the
+host packs whatever arrived into the next fixed-size batch and pads
+(SURVEY.md §7 hard part (a): bucketed shapes + padding masks, no recompiles).
+
+Timestamps are int32 milliseconds relative to a host-held `epoch_base_ms` so
+they fit TPU-friendly 32-bit lanes; the host rebases periodically (int32 ms
+covers ±24 days per base).
+
+Columns are a strict superset of what each event type needs; unused columns
+for a given event type are zero. This wastes HBM bytes but keeps a single
+batch schema for the whole pipeline — the same trade the reference's
+GDeviceEventPayload protobuf union makes, resolved SoA instead of AoS.
+
+Reference: model fields from IDeviceMeasurement/IDeviceLocation/IDeviceAlert
+(sitewhere-core-api spi/device/event/); packing replaces the per-event protobuf
+decode at InboundPayloadProcessingLogic.java:141.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+from flax import struct
+
+from sitewhere_tpu.model.event import (
+    DeviceAlert, DeviceEvent, DeviceEventType, DeviceLocation, DeviceMeasurement,
+)
+from sitewhere_tpu.registry.interning import TokenInterner
+
+
+@struct.dataclass
+class EventBatch:
+    """SoA columns, all shape [B]. A jax pytree (works under jit/shard_map)."""
+
+    device_idx: np.ndarray   # int32, interned device token (0 = unknown)
+    tenant_idx: np.ndarray   # int32, interned tenant (filled by validation)
+    event_type: np.ndarray   # int32, DeviceEventType value
+    ts: np.ndarray           # int32, ms since epoch_base
+    mm_idx: np.ndarray       # int32, interned measurement name
+    value: np.ndarray        # float32, measurement value
+    lat: np.ndarray          # float32
+    lon: np.ndarray          # float32
+    elevation: np.ndarray    # float32
+    alert_type_idx: np.ndarray  # int32, interned alert type code
+    alert_level: np.ndarray  # int32, AlertLevel value
+    valid: np.ndarray        # bool, False for padding rows
+
+    @property
+    def batch_size(self) -> int:
+        return self.device_idx.shape[0]
+
+
+def empty_batch(batch_size: int) -> EventBatch:
+    zi = np.zeros(batch_size, np.int32)
+    zf = np.zeros(batch_size, np.float32)
+    return EventBatch(
+        device_idx=zi, tenant_idx=zi.copy(), event_type=zi.copy(), ts=zi.copy(),
+        mm_idx=zi.copy(), value=zf, lat=zf.copy(), lon=zf.copy(),
+        elevation=zf.copy(), alert_type_idx=zi.copy(), alert_level=zi.copy(),
+        valid=np.zeros(batch_size, bool))
+
+
+class EventPacker:
+    """Host-side packer: Python event objects / raw column arrays -> EventBatch.
+
+    Owns the measurement-name and alert-type interners; device tokens are
+    interned against the shared registry interner so packed indices line up
+    with the registry lookup tensors.
+    """
+
+    def __init__(self, batch_size: int, device_interner: TokenInterner,
+                 max_measurement_names: int = 1024, max_alert_types: int = 1024,
+                 epoch_base_ms: Optional[int] = None):
+        self.batch_size = batch_size
+        self.devices = device_interner
+        self.measurements = TokenInterner(max_measurement_names, "measurements")
+        self.alert_types = TokenInterner(max_alert_types, "alert_types")
+        self.epoch_base_ms = (epoch_base_ms if epoch_base_ms is not None
+                              else int(time.time() * 1000))
+
+    # int32 range minus a margin for the -2^31 "never" sentinel in state tensors
+    _REL_MIN = -(2 ** 31) + 2
+    _REL_MAX = 2 ** 31 - 1
+
+    def rel_ts(self, ts_ms: int) -> int:
+        # Events dated before epoch_base are legitimate (delayed delivery,
+        # replay): rebased ts may be negative. Clamp to int32 range.
+        rel = int(ts_ms - self.epoch_base_ms)
+        return max(self._REL_MIN, min(self._REL_MAX, rel))
+
+    def abs_ts(self, rel: int) -> int:
+        return self.epoch_base_ms + int(rel)
+
+    def pack_events(self, events: Sequence[DeviceEvent],
+                    device_tokens: Sequence[str]) -> List[EventBatch]:
+        """Pack API-level events (paired with their device tokens) into one or
+        more fixed-size batches."""
+        batches: List[EventBatch] = []
+        for start in range(0, max(len(events), 1), self.batch_size):
+            chunk = events[start:start + self.batch_size]
+            tokens = device_tokens[start:start + self.batch_size]
+            if not chunk:
+                break
+            batches.append(self._pack_chunk(chunk, tokens))
+        return batches
+
+    def _pack_chunk(self, events: Sequence[DeviceEvent],
+                    tokens: Sequence[str]) -> EventBatch:
+        B = self.batch_size
+        batch = empty_batch(B)
+        n = len(events)
+        device_idx = np.zeros(B, np.int32)
+        event_type = np.zeros(B, np.int32)
+        ts = np.zeros(B, np.int32)
+        mm_idx = np.zeros(B, np.int32)
+        value = np.zeros(B, np.float32)
+        lat = np.zeros(B, np.float32)
+        lon = np.zeros(B, np.float32)
+        elevation = np.zeros(B, np.float32)
+        alert_type_idx = np.zeros(B, np.int32)
+        alert_level = np.zeros(B, np.int32)
+        valid = np.zeros(B, bool)
+        for i, (event, token) in enumerate(zip(events, tokens)):
+            device_idx[i] = self.devices.lookup(token)
+            event_type[i] = int(event.event_type)
+            ts[i] = self.rel_ts(event.event_date)
+            valid[i] = True
+            if isinstance(event, DeviceMeasurement):
+                mm_idx[i] = self.measurements.intern(event.name)
+                value[i] = event.value
+            elif isinstance(event, DeviceLocation):
+                lat[i] = event.latitude
+                lon[i] = event.longitude
+                elevation[i] = event.elevation
+            elif isinstance(event, DeviceAlert):
+                alert_type_idx[i] = self.alert_types.intern(event.type)
+                alert_level[i] = int(event.level)
+        return EventBatch(
+            device_idx=device_idx, tenant_idx=batch.tenant_idx,
+            event_type=event_type, ts=ts, mm_idx=mm_idx, value=value,
+            lat=lat, lon=lon, elevation=elevation,
+            alert_type_idx=alert_type_idx, alert_level=alert_level, valid=valid)
+
+    def pack_columns(self, device_idx: np.ndarray, event_type: np.ndarray,
+                     ts_ms_abs: np.ndarray, *, mm_idx: Optional[np.ndarray] = None,
+                     value: Optional[np.ndarray] = None,
+                     lat: Optional[np.ndarray] = None,
+                     lon: Optional[np.ndarray] = None,
+                     elevation: Optional[np.ndarray] = None,
+                     alert_type_idx: Optional[np.ndarray] = None,
+                     alert_level: Optional[np.ndarray] = None) -> EventBatch:
+        """Zero-copy-ish fast path for bulk synthetic/replayed columns; pads or
+        rejects to exactly one batch."""
+        n = len(device_idx)
+        if n > self.batch_size:
+            raise ValueError(f"{n} events > batch size {self.batch_size}")
+        B = self.batch_size
+
+        def col(arr: Optional[np.ndarray], dtype) -> np.ndarray:
+            out = np.zeros(B, dtype)
+            if arr is not None:
+                out[:n] = arr
+            return out
+
+        ts_rel = np.clip(np.asarray(ts_ms_abs, np.int64) - self.epoch_base_ms,
+                         self._REL_MIN, self._REL_MAX).astype(np.int32)
+        valid = np.zeros(B, bool)
+        valid[:n] = True
+        return EventBatch(
+            device_idx=col(device_idx, np.int32),
+            tenant_idx=np.zeros(B, np.int32),
+            event_type=col(event_type, np.int32),
+            ts=col(ts_rel, np.int32),
+            mm_idx=col(mm_idx, np.int32), value=col(value, np.float32),
+            lat=col(lat, np.float32), lon=col(lon, np.float32),
+            elevation=col(elevation, np.float32),
+            alert_type_idx=col(alert_type_idx, np.int32),
+            alert_level=col(alert_level, np.int32), valid=valid)

@@ -1,0 +1,107 @@
+"""Keyed per-device reductions: last-write-wins, scatter-max, counts.
+
+The TPU replacement for the reference's per-event Mongo upserts in
+service-device-state (DeviceStateProcessingLogic.java:116+ merges each event
+into a DeviceState row): a whole batch of events folds into device-indexed
+state tensors with sort + boundary-detection + unique-index scatter, which is
+deterministic under XLA (unlike duplicate-index scatter-set).
+
+SURVEY.md §7 hard part (d): keyed last-write-wins at scale.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _last_row_selector(keys: jnp.ndarray, ts: jnp.ndarray, valid: jnp.ndarray,
+                       num_segments: int
+                       ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Sort rows by (key, ts) with invalid rows keyed to `num_segments`, and
+    compute for each sorted row whether it is the LAST row of its key segment.
+
+    Returns (order, scatter_target, is_last_sorted):
+      order[B]      permutation sorting the batch
+      target[B]     key for last-of-segment rows, num_segments otherwise
+                    (scatter into a [num_segments+1] padded array, drop tail)
+      is_last[B]    last-of-segment mask in sorted order
+    """
+    B = keys.shape[0]
+    sort_key = jnp.where(valid, keys, num_segments)
+    # Stable two-level sort: primary key, secondary ts. jnp.lexsort sorts by
+    # last key first.
+    order = jnp.lexsort((ts, sort_key))
+    sorted_keys = sort_key[order]
+    next_keys = jnp.concatenate(
+        [sorted_keys[1:], jnp.full((1,), -1, sorted_keys.dtype)])
+    is_last = sorted_keys != next_keys
+    target = jnp.where(is_last & (sorted_keys < num_segments),
+                       sorted_keys, num_segments)
+    return order, target, is_last
+
+
+def last_by_key(keys: jnp.ndarray, ts: jnp.ndarray, valid: jnp.ndarray,
+                num_segments: int, state_ts: jnp.ndarray,
+                states: Sequence[jnp.ndarray], values: Sequence[jnp.ndarray],
+                ) -> Tuple[jnp.ndarray, Tuple[jnp.ndarray, ...]]:
+    """Fold a batch into last-value-wins state tensors.
+
+    For each key k appearing in the batch (valid rows only), pick the row with
+    the greatest ts; if that ts >= state_ts[k], write each values[i] into
+    states[i][k] and update state_ts[k]. Rows with equal ts resolve by batch
+    position (later position wins) via stable sort.
+
+    Args:
+      keys:   int32 [B] segment ids in [0, num_segments)
+      ts:     int32 [B] event timestamps (rebased ms)
+      valid:  bool  [B]
+      num_segments: static int
+      state_ts: int32 [num_segments] current last-update ts per key
+      states: tensors [num_segments, ...] to update
+      values: matching per-row update values [B, ...]
+
+    Returns (new_state_ts, tuple(new_states)).
+    """
+    order, target, _ = _last_row_selector(keys, ts, valid, num_segments)
+    sorted_ts = ts[order]
+    # Only apply if batch ts is newer than (or equal to) what state holds.
+    candidate_ts = jnp.zeros(num_segments + 1, ts.dtype).at[target].set(sorted_ts)
+    touched = jnp.zeros(num_segments + 1, bool).at[target].set(True)[:num_segments]
+    newer = touched & (candidate_ts[:num_segments] >= state_ts)
+    new_state_ts = jnp.where(newer, candidate_ts[:num_segments], state_ts)
+
+    new_states = []
+    for state, value in zip(states, values):
+        sorted_val = value[order]
+        candidate = (jnp.zeros((num_segments + 1,) + state.shape[1:], state.dtype)
+                     .at[target].set(sorted_val))[:num_segments]
+        mask = newer.reshape((num_segments,) + (1,) * (state.ndim - 1))
+        new_states.append(jnp.where(mask, candidate, state))
+    return new_state_ts, tuple(new_states)
+
+
+def scatter_max_by_key(keys: jnp.ndarray, values: jnp.ndarray,
+                       valid: jnp.ndarray, num_segments: int,
+                       state: jnp.ndarray) -> jnp.ndarray:
+    """state[k] = max(state[k], max over batch rows with key k).
+
+    Used for last-interaction timestamps (presence tracking): duplicate-index
+    scatter-max is deterministic. Invalid rows route to the dropped pad row.
+    """
+    target = jnp.where(valid, keys, num_segments)
+    padded = jnp.concatenate([state, jnp.full((1,), -(2 ** 31), state.dtype)])
+    return padded.at[target].max(values)[:num_segments]
+
+
+def count_by_key(keys: jnp.ndarray, valid: jnp.ndarray, num_segments: int,
+                 weights: jnp.ndarray = None) -> jnp.ndarray:
+    """Per-key event counts (int32 [num_segments]) — feeds per-tenant /
+    per-device throughput stats (the reference's Dropwizard meters)."""
+    target = jnp.where(valid, keys, num_segments)
+    ones = (weights if weights is not None
+            else jnp.ones(keys.shape[0], jnp.int32))
+    ones = jnp.where(valid, ones, 0)
+    return jnp.zeros(num_segments + 1, jnp.int32).at[target].add(ones)[:num_segments]

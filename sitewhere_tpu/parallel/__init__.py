@@ -1,0 +1,17 @@
+"""Multi-chip scaling: device mesh, shard routing, collective step.
+
+The reference scales by running N replicas of each microservice and letting
+Kafka consumer groups split topic partitions among them (SURVEY.md §2.5).
+Here the same data parallelism is SPMD over a `jax.sharding.Mesh`: the device
+dimension of every state/registry tensor is sharded over the `shard` mesh
+axis, events are routed to shards by interned device index (exactly the
+device-token record-key partitioning the reference uses), and the only
+cross-shard traffic is psum'd stats riding ICI — replacing the reference's
+gRPC fan-out + broker round-trips between stages.
+"""
+
+from sitewhere_tpu.parallel.mesh import make_mesh, shard_axis_size
+from sitewhere_tpu.parallel.router import ShardRouter
+from sitewhere_tpu.parallel.engine import ShardedPipelineEngine
+
+__all__ = ["make_mesh", "shard_axis_size", "ShardRouter", "ShardedPipelineEngine"]

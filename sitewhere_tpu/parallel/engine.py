@@ -1,0 +1,277 @@
+"""ShardedPipelineEngine: the fused step over a device mesh.
+
+Scaling story (SURVEY.md §2.5): the reference adds replicas per microservice
+and lets Kafka split partitions; here ONE SPMD program runs on every chip.
+Each shard owns devices `d % S == s` (their state rows, their slice of the
+registry mirror); the host router sends each event to its owner shard; rule
+tables and zone geometry are replicated (small, read-only). The only
+cross-shard communication is the psum of per-batch stats — a few hundred
+bytes over ICI per step, vs. the reference's per-event gRPC fan-out.
+
+Multi-host note: the same program runs under `jax.distributed` across hosts —
+the mesh spans all processes' devices and each host routes/feeds the
+sub-batches of its local shards (the standard multi-host jax data-loading
+contract). ICI carries the psum; DCN only carries control-plane traffic.
+"""
+
+from __future__ import annotations
+
+import time
+from functools import partial
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+try:  # jax >= 0.6 exposes shard_map at top level
+    from jax import shard_map as _shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+from sitewhere_tpu.model import DeviceAlert
+from sitewhere_tpu.ops.pack import EventBatch
+from sitewhere_tpu.parallel.mesh import SHARD_AXIS, make_mesh, shard_axis_size
+from sitewhere_tpu.parallel.router import RoutedBatches, ShardRouter
+from sitewhere_tpu.pipeline.engine import PipelineEngine
+from sitewhere_tpu.pipeline.state_tensors import DeviceStateTensors, init_device_state
+from sitewhere_tpu.pipeline.step import PipelineParams, ProcessOutputs, process_batch
+from sitewhere_tpu.registry.tensors import RegistryTensors
+
+
+def _tree_specs(tree, spec):
+    return jax.tree_util.tree_map(lambda _: spec, tree)
+
+
+class ShardedPipelineEngine(PipelineEngine):
+    """Drop-in engine whose state/params/batches carry a leading shard axis.
+
+    `per_shard_batch` is the per-chip batch; global throughput scales with the
+    mesh. Device capacity must divide evenly by the mesh size.
+    """
+
+    def __init__(self, registry_tensors: RegistryTensors,
+                 mesh: Optional[Mesh] = None, per_shard_batch: int = 4096,
+                 **kwargs):
+        self.mesh = mesh or make_mesh()
+        self.n_shards = shard_axis_size(self.mesh)
+        if registry_tensors.devices.capacity % self.n_shards:
+            raise ValueError(
+                f"max_devices {registry_tensors.devices.capacity} must be "
+                f"divisible by {self.n_shards} shards")
+        super().__init__(registry_tensors, batch_size=per_shard_batch, **kwargs)
+        self.router = ShardRouter(self.n_shards, per_shard_batch)
+        # host packer accepts a full mesh's worth of events per flat batch
+        from sitewhere_tpu.ops.pack import EventPacker
+        self.packer = EventPacker(per_shard_batch * self.n_shards,
+                                  registry_tensors.devices)
+        self._step = None  # built lazily once specs are known
+        self._sharded_step = None
+        # shard-overflow events requeued ahead of the next submit; bounded so
+        # a pathological hot shard cannot grow the host queue without limit
+        self._overflow: Optional[EventBatch] = None
+        self.max_overflow_events = per_shard_batch * self.n_shards * 4
+        self.total_dropped = 0  # overflow beyond the bound (permanent loss)
+
+    # -- initialization -------------------------------------------------------
+
+    def on_initialize(self, monitor) -> None:
+        S = self.n_shards
+        local = init_device_state(
+            self.registry.devices.capacity // S, self.measurement_slots,
+            self.max_tenants)
+        stacked = jax.tree_util.tree_map(
+            lambda a: jnp.asarray(np.ascontiguousarray(
+                np.broadcast_to(np.asarray(a), (S,) + a.shape))), local)
+        shard0 = NamedSharding(self.mesh, P(SHARD_AXIS))
+        self._state = jax.device_put(
+            stacked, _tree_specs(stacked, shard0))
+        self._refresh_params()
+        self._build_step()
+
+    def _build_step(self) -> None:
+        params_template = self._params
+        dev = P(SHARD_AXIS)
+        rep = P()
+        params_specs = PipelineParams(
+            assignment_status=dev, tenant_idx=dev, area_idx=dev,
+            device_type_idx=dev,
+            threshold=_tree_specs(params_template.threshold, rep),
+            zones=_tree_specs(params_template.zones, rep),
+            geofence=_tree_specs(params_template.geofence, rep))
+        state_specs = _tree_specs(self._state, dev)
+        batch_specs = _tree_specs(EventBatch(*([0] * 12)), dev)
+        out_specs = ProcessOutputs(
+            valid=dev, unregistered=dev, threshold_fired=dev,
+            threshold_first_rule=dev, threshold_alert_level=dev,
+            geofence_fired=dev, geofence_first_rule=dev,
+            geofence_alert_level=dev, tenant_counts=rep, processed=rep,
+            alerts=rep)
+
+        def sq(a):
+            # shard_map hands blocks with the mapped axis kept (size 1); the
+            # per-shard program works on squeezed local shapes.
+            return a.reshape(a.shape[1:])
+
+        def unsq(a):
+            return a[None]
+
+        def sharded(params, state, batch):
+            params = params.replace(
+                assignment_status=sq(params.assignment_status),
+                tenant_idx=sq(params.tenant_idx),
+                area_idx=sq(params.area_idx),
+                device_type_idx=sq(params.device_type_idx))
+            state = jax.tree_util.tree_map(sq, state)
+            batch = jax.tree_util.tree_map(sq, batch)
+            new_state, out = process_batch(params, state, batch)
+            new_state = jax.tree_util.tree_map(unsq, new_state)
+            out = out.replace(
+                valid=unsq(out.valid), unregistered=unsq(out.unregistered),
+                threshold_fired=unsq(out.threshold_fired),
+                threshold_first_rule=unsq(out.threshold_first_rule),
+                threshold_alert_level=unsq(out.threshold_alert_level),
+                geofence_fired=unsq(out.geofence_fired),
+                geofence_first_rule=unsq(out.geofence_first_rule),
+                geofence_alert_level=unsq(out.geofence_alert_level),
+                tenant_counts=jax.lax.psum(out.tenant_counts, SHARD_AXIS),
+                processed=jax.lax.psum(out.processed, SHARD_AXIS),
+                alerts=jax.lax.psum(out.alerts, SHARD_AXIS))
+            return new_state, out
+
+        mapped = _shard_map(sharded, mesh=self.mesh,
+                            in_specs=(params_specs, state_specs, batch_specs),
+                            out_specs=(state_specs, out_specs))
+        self._sharded_step = jax.jit(mapped, donate_argnums=(1,))
+
+    # -- params ---------------------------------------------------------------
+
+    def _refresh_params(self) -> None:
+        snap = self.registry.snapshot()
+        threshold = self._compile_threshold_table()
+        geofence = self._compile_geofence_table()
+        from sitewhere_tpu.ops.geofence import ZoneTable
+        zones = ZoneTable(vertices=snap.zone_vertices, nvert=snap.zone_nvert,
+                          tenant_idx=snap.zone_tenant, active=snap.zone_active)
+        router = getattr(self, "router", None) or ShardRouter(
+            self.n_shards, self.batch_size)
+        shard0 = NamedSharding(self.mesh, P(SHARD_AXIS))
+        rep = NamedSharding(self.mesh, P())
+        params = PipelineParams(
+            assignment_status=router.shard_param(snap.assignment_status),
+            tenant_idx=router.shard_param(snap.tenant_idx),
+            area_idx=router.shard_param(snap.area_idx),
+            device_type_idx=router.shard_param(snap.device_type_idx),
+            threshold=threshold, zones=zones, geofence=geofence)
+        shardings = PipelineParams(
+            assignment_status=shard0, tenant_idx=shard0, area_idx=shard0,
+            device_type_idx=shard0,
+            threshold=_tree_specs(threshold, rep),
+            zones=_tree_specs(zones, rep),
+            geofence=_tree_specs(geofence, rep))
+        self._params = jax.device_put(params, shardings)
+        self._params_built_for = (snap.version, self._rules_version)
+
+    # -- processing -----------------------------------------------------------
+
+    def submit(self, batch: EventBatch) -> Tuple[EventBatch, ProcessOutputs]:
+        """Route a flat host batch (global indices, any length) to shards and
+        run one collective step. Returns (routed batch with a [S, B] layout,
+        outputs). Events overflowing a shard's capacity are requeued ahead of
+        the next submit (at-least-once; order per device preserved because
+        overflow rows predate the next batch's rows)."""
+        from sitewhere_tpu.parallel.router import concat_flat_batches
+
+        params = self._ensure_params()
+        if self._overflow is not None:
+            batch = concat_flat_batches([self._overflow, batch])
+            self._overflow = None
+        routed = self.router.route_columns(batch)
+        if routed.overflow is not None:
+            n_over = routed.overflow_count
+            if n_over > self.max_overflow_events:
+                self.total_dropped += n_over - self.max_overflow_events
+                keep = jax.tree_util.tree_map(
+                    lambda a: a[:self.max_overflow_events], routed.overflow)
+                self._overflow = keep
+            else:
+                self._overflow = routed.overflow
+        shard0 = NamedSharding(self.mesh, P(SHARD_AXIS))
+        device_batch = jax.device_put(routed.batch,
+                                      _tree_specs(routed.batch, shard0))
+        with self._metrics.timer("step").time():
+            self._state, outputs = self._sharded_step(params, self._state,
+                                                      device_batch)
+        self.batches_processed += 1
+        self._metrics.meter("events").mark(int(np.asarray(batch.valid).sum()))
+        return routed.batch, outputs
+
+    def materialize_alerts(self, routed_batch: EventBatch,
+                           outputs: ProcessOutputs,
+                           max_alerts: int = 1024) -> List[DeviceAlert]:
+        """Flatten [S, B] rows back to a flat batch with GLOBAL device indices
+        and reuse the base materializer."""
+        S, B = routed_batch.valid.shape
+        shard_of_row = np.repeat(np.arange(S, dtype=np.int32), B)
+
+        def flat(a):
+            return np.asarray(a).reshape((S * B,) + np.asarray(a).shape[2:])
+
+        flat_batch = jax.tree_util.tree_map(flat, routed_batch)
+        flat_batch = flat_batch.replace(
+            device_idx=flat_batch.device_idx * S + shard_of_row)
+        flat_out = outputs.replace(
+            valid=flat(outputs.valid), unregistered=flat(outputs.unregistered),
+            threshold_fired=flat(outputs.threshold_fired),
+            threshold_first_rule=flat(outputs.threshold_first_rule),
+            threshold_alert_level=flat(outputs.threshold_alert_level),
+            geofence_fired=flat(outputs.geofence_fired),
+            geofence_first_rule=flat(outputs.geofence_first_rule),
+            geofence_alert_level=flat(outputs.geofence_alert_level))
+        return super().materialize_alerts(flat_batch, flat_out, max_alerts)
+
+    # -- reads ----------------------------------------------------------------
+
+    def _state_row(self, idx: int):
+        s, l = idx % self.n_shards, idx // self.n_shards
+
+        class Row:
+            pass
+
+        row = Row()
+        for field_name in ("last_interaction", "present", "presence_missing_since",
+                           "event_count", "last_location", "last_location_ts",
+                           "last_measurement", "last_measurement_ts",
+                           "last_alert_type", "last_alert_level", "last_alert_ts"):
+            setattr(row, field_name, np.asarray(getattr(self._state, field_name)[s, l]))
+        return row
+
+    def presence_sweep(self) -> List[str]:
+        params = self._ensure_params()
+        now_rel = np.int32(self.packer.rel_ts(int(time.time() * 1000)))
+        registered = params.assignment_status == 1
+        self._state, newly_missing = self._presence(
+            self._state, registered, now_rel,
+            np.int32(min(self.presence_missing_interval_ms, 2 ** 31 - 1)))
+        shards, locals_ = np.nonzero(np.asarray(newly_missing))
+        tokens = []
+        for s, l in zip(shards, locals_):
+            token = self.registry.devices.token_of(int(l) * self.n_shards + int(s))
+            if token is not None:
+                tokens.append(token)
+        return tokens
+
+    @property
+    def pending_overflow(self) -> int:
+        return 0 if self._overflow is None else int(self._overflow.valid.sum())
+
+    def stats(self):
+        s = self._state
+        return {
+            "batches": self.batches_processed,
+            "dropped": self.total_dropped,
+            "pending_overflow": self.pending_overflow,
+            "tenant_event_count": np.asarray(s.tenant_event_count).sum(0).tolist(),
+            "tenant_alert_count": np.asarray(s.tenant_alert_count).sum(0).tolist(),
+        }

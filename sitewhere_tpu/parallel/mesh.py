@@ -1,0 +1,30 @@
+"""Mesh construction: one `shard` axis over all available devices.
+
+The hot path is embarrassingly parallel over devices (each shard owns a
+disjoint slice of the device population), so a 1-D mesh suffices; tenants ride
+the same axis (a tenant's devices spread over all shards, stats psum'd).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+SHARD_AXIS = "shard"
+
+
+def make_mesh(n_shards: Optional[int] = None,
+              devices: Optional[Sequence] = None) -> Mesh:
+    devs = list(devices) if devices is not None else jax.devices()
+    if n_shards is not None:
+        if n_shards > len(devs):
+            raise ValueError(f"requested {n_shards} shards, have {len(devs)} devices")
+        devs = devs[:n_shards]
+    return Mesh(np.asarray(devs), (SHARD_AXIS,))
+
+
+def shard_axis_size(mesh: Mesh) -> int:
+    return mesh.shape[SHARD_AXIS]

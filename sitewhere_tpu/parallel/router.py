@@ -1,0 +1,125 @@
+"""Host-side shard routing: global device index -> (shard, local index).
+
+The reference routes events to microservice replicas by Kafka record key
+(device token) -> partition -> consumer (SURVEY.md §2.5 row 1). Here the
+same per-device affinity maps global interned index d to shard `d % S` with
+local row `d // S`; each shard's state tensors are indexed by local rows, so
+a shard only ever touches its own devices and the fused step needs NO
+cross-shard communication for state updates — only stat reductions.
+
+`route_columns` turns flat event columns into [S, B_local] stacked columns
+(the layout shard_map splits along the mesh axis).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from sitewhere_tpu.ops.pack import EventBatch
+
+
+_I32_COLS = ("device_idx", "tenant_idx", "event_type", "ts", "mm_idx",
+             "alert_type_idx", "alert_level")
+_F32_COLS = ("value", "lat", "lon", "elevation")
+
+
+@dataclass
+class RoutedBatches:
+    batch: EventBatch                    # columns shaped [S, B_local]
+    overflow: Optional[EventBatch]       # flat batch of events beyond per-shard
+    #                                      capacity (global indices, no padding)
+    #                                      — callers requeue these next round
+
+    @property
+    def overflow_count(self) -> int:
+        return 0 if self.overflow is None else int(self.overflow.valid.sum())
+
+
+def concat_flat_batches(batches: List[EventBatch]) -> EventBatch:
+    """Concatenate flat (1-D column) batches, keeping only valid rows.
+    Host-side only: the result length is variable; route_columns repacks to
+    fixed shapes."""
+    keeps = [np.asarray(b.valid) for b in batches]
+    cols = {}
+    for name in _I32_COLS + _F32_COLS:
+        cols[name] = np.concatenate(
+            [np.asarray(getattr(b, name))[k] for b, k in zip(batches, keeps)])
+    n = len(cols["device_idx"])
+    return EventBatch(valid=np.ones(n, bool), **cols)
+
+
+class ShardRouter:
+    def __init__(self, n_shards: int, per_shard_batch: int):
+        self.n_shards = n_shards
+        self.per_shard_batch = per_shard_batch
+
+    def global_to_local(self, device_idx: np.ndarray
+                        ) -> Tuple[np.ndarray, np.ndarray]:
+        return device_idx % self.n_shards, device_idx // self.n_shards
+
+    def local_to_global(self, shard: int, local_idx: np.ndarray) -> np.ndarray:
+        return local_idx * self.n_shards + shard
+
+    def shard_param(self, arr: np.ndarray) -> np.ndarray:
+        """Re-lay a device-indexed [D, ...] array into [S, D//S, ...] so that
+        row (s, l) holds global row l*S + s. D must be divisible by S."""
+        D = arr.shape[0]
+        S = self.n_shards
+        if D % S:
+            raise ValueError(f"device capacity {D} not divisible by {S} shards")
+        return np.ascontiguousarray(
+            arr.reshape((D // S, S) + arr.shape[1:]).swapaxes(0, 1))
+
+    def unshard_param(self, arr: np.ndarray) -> np.ndarray:
+        """Inverse of shard_param: [S, D//S, ...] -> [D, ...]."""
+        S, L = arr.shape[0], arr.shape[1]
+        return np.ascontiguousarray(
+            np.asarray(arr).swapaxes(0, 1).reshape((S * L,) + arr.shape[2:]))
+
+    def route_columns(self, batch: EventBatch) -> RoutedBatches:
+        """Scatter a flat host batch into per-shard sub-batches with local
+        device indices — fully vectorized (no per-event Python on the ingest
+        path). A stable argsort by shard preserves arrival order per device.
+        Rows beyond a shard's fixed capacity come back as `overflow` (flat,
+        global indices) for the caller to requeue; fixed shapes are
+        non-negotiable under jit."""
+        S, B = self.n_shards, self.per_shard_batch
+        valid = np.asarray(batch.valid)
+        rows = np.nonzero(valid)[0]
+        dev = np.asarray(batch.device_idx)[rows]
+        shard = dev % S
+        local = dev // S
+
+        order = np.argsort(shard, kind="stable")
+        srows = rows[order]
+        sshard = shard[order]
+        counts = np.bincount(sshard, minlength=S)
+        starts = np.concatenate([[0], np.cumsum(counts)[:-1]])
+        pos = np.arange(len(srows), dtype=np.int64) - starts[sshard]
+        keep = pos < B
+        ks = sshard[keep]
+        kp = pos[keep]
+        krows = srows[keep]
+
+        out_cols: Dict[str, np.ndarray] = {}
+        for name in _I32_COLS:
+            out_cols[name] = np.zeros((S, B), np.int32)
+        for name in _F32_COLS:
+            out_cols[name] = np.zeros((S, B), np.float32)
+        out_valid = np.zeros((S, B), bool)
+        out_valid[ks, kp] = True
+        out_cols["device_idx"][ks, kp] = local[order][keep]
+        for name in _I32_COLS[1:] + _F32_COLS:
+            out_cols[name][ks, kp] = np.asarray(getattr(batch, name))[krows]
+        routed = EventBatch(valid=out_valid, **out_cols)
+
+        overflow = None
+        if not keep.all():
+            orows = srows[~keep]
+            ocols = {name: np.asarray(getattr(batch, name))[orows]
+                     for name in _I32_COLS + _F32_COLS}
+            overflow = EventBatch(valid=np.ones(len(orows), bool), **ocols)
+        return RoutedBatches(batch=routed, overflow=overflow)

@@ -1,0 +1,17 @@
+"""The hot event path + domain services.
+
+The reference runs the hot path as five microservices exchanging Kafka batches
+(SURVEY.md §3.2-3.3: event-sources -> inbound-processing -> event-management ->
+enrichment -> {rule-processing, device-state, outbound-connectors}); here those
+stages fuse into ONE jit-compiled step over an EventBatch
+(pipeline/step.py::process_batch), and the surrounding services (sources,
+registration, command delivery, connectors, batch ops, schedules) run host-side
+around it.
+"""
+
+from sitewhere_tpu.pipeline.state_tensors import DeviceStateTensors, init_device_state
+from sitewhere_tpu.pipeline.step import PipelineParams, ProcessOutputs, process_batch
+from sitewhere_tpu.pipeline.engine import PipelineEngine
+
+__all__ = ["DeviceStateTensors", "init_device_state", "PipelineParams",
+           "ProcessOutputs", "process_batch", "PipelineEngine"]

@@ -1,0 +1,335 @@
+"""PipelineEngine: host orchestrator for the fused TPU step.
+
+Owns the jitted `process_batch`, the HBM device-state, the registry tensor
+mirror, and the compiled rule tables; refreshes device-side params when the
+registry or rules change (version counter — the reference reacts to ZK config
+watches and Kafka model-update topics the same way); materializes rule-fired
+alerts back into API-level DeviceAlert events; runs the presence sweep.
+
+This is the rebuild of the *composition* of service-inbound-processing +
+service-rule-processing + service-device-state (their per-service manager
+classes collapse into one engine because the stages fused into one step).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sitewhere_tpu.model import DeviceAlert, AlertLevel, AlertSource, DeviceState, PresenceState
+from sitewhere_tpu.model.event import DeviceEventType
+from sitewhere_tpu.ops.geofence import GeofenceCondition, GeofenceRuleTable, ZoneTable, empty_geofence_table
+from sitewhere_tpu.ops.pack import EventBatch, EventPacker
+from sitewhere_tpu.ops.threshold import ThresholdOp, ThresholdRuleTable, empty_threshold_table
+from sitewhere_tpu.pipeline.state_tensors import DeviceStateTensors, init_device_state
+from sitewhere_tpu.pipeline.step import PipelineParams, ProcessOutputs, check_presence, process_batch
+from sitewhere_tpu.registry.tensors import RegistryTensors
+from sitewhere_tpu.runtime.lifecycle import LifecycleComponent
+from sitewhere_tpu.runtime.metrics import GLOBAL_METRICS
+
+_NEG = -(2 ** 31)
+
+
+@dataclass
+class ThresholdRule:
+    """Host-side rule definition; compiled into ThresholdRuleTable rows."""
+
+    token: str
+    measurement_name: str = ""       # "" = any
+    operator: str = ">"
+    threshold: float = 0.0
+    alert_type: str = "threshold.violation"
+    alert_level: AlertLevel = AlertLevel.WARNING
+    alert_message: str = ""
+    tenant_token: str = ""           # "" = any
+    device_type_token: str = ""      # "" = any
+    active: bool = True
+
+
+@dataclass
+class GeofenceRule:
+    """Host-side geofence rule (the reference's ZoneTestRuleProcessor config:
+    zone token + containment condition + alert to fire)."""
+
+    token: str
+    zone_token: str = ""
+    condition: str = "outside"       # fire when point is inside|outside
+    alert_type: str = "zone.violation"
+    alert_level: AlertLevel = AlertLevel.ERROR
+    alert_message: str = ""
+    active: bool = True
+
+
+class PipelineEngine(LifecycleComponent):
+    """One engine per process; multi-tenant by construction (tenant axis is a
+    tensor column, not a separate engine — SURVEY.md §2.5 tenant parallelism).
+    """
+
+    def __init__(self, registry_tensors: RegistryTensors, batch_size: int = 8192,
+                 measurement_slots: int = 32, max_tenants: int = 16,
+                 max_threshold_rules: int = 256, max_geofence_rules: int = 256,
+                 presence_missing_interval_ms: int = 8 * 60 * 60 * 1000,
+                 name: str = "pipeline-engine"):
+        super().__init__(name)
+        self.registry = registry_tensors
+        self.batch_size = batch_size
+        self.max_tenants = max_tenants
+        self.measurement_slots = measurement_slots
+        self.max_threshold_rules = max_threshold_rules
+        self.max_geofence_rules = max_geofence_rules
+        self.presence_missing_interval_ms = presence_missing_interval_ms
+        self.packer = EventPacker(batch_size, registry_tensors.devices)
+
+        self._threshold_rules: List[ThresholdRule] = []
+        self._geofence_rules: List[GeofenceRule] = []
+        self._rules_version = 0
+        self._params_built_for: Tuple[int, int] = (-1, -1)
+        self._params: Optional[PipelineParams] = None
+        self._state: Optional[DeviceStateTensors] = None
+        self._lock = threading.RLock()
+        self._metrics = GLOBAL_METRICS.scoped(f"pipeline.{name}")
+        self._step = jax.jit(process_batch, donate_argnums=(1,))
+        self._presence = jax.jit(check_presence, donate_argnums=(0,))
+        self.batches_processed = 0
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def on_initialize(self, monitor) -> None:
+        self._state = init_device_state(self.registry.devices.capacity,
+                                        self.measurement_slots, self.max_tenants)
+        self._refresh_params()
+
+    def on_start(self, monitor) -> None:
+        if self._state is None:
+            self.on_initialize(monitor)
+
+    # -- rules ----------------------------------------------------------------
+
+    def add_threshold_rule(self, rule: ThresholdRule) -> None:
+        with self._lock:
+            if len(self._threshold_rules) >= self.max_threshold_rules:
+                from sitewhere_tpu.errors import ErrorCode, SiteWhereError
+                raise SiteWhereError("threshold rule capacity exceeded",
+                                     ErrorCode.CAPACITY_EXCEEDED)
+            self._threshold_rules.append(rule)
+            self._rules_version += 1
+
+    def add_geofence_rule(self, rule: GeofenceRule) -> None:
+        with self._lock:
+            if len(self._geofence_rules) >= self.max_geofence_rules:
+                from sitewhere_tpu.errors import ErrorCode, SiteWhereError
+                raise SiteWhereError("geofence rule capacity exceeded",
+                                     ErrorCode.CAPACITY_EXCEEDED)
+            self._geofence_rules.append(rule)
+            self._rules_version += 1
+
+    def remove_rule(self, token: str) -> bool:
+        with self._lock:
+            n = len(self._threshold_rules) + len(self._geofence_rules)
+            self._threshold_rules = [r for r in self._threshold_rules
+                                     if r.token != token]
+            self._geofence_rules = [r for r in self._geofence_rules
+                                    if r.token != token]
+            changed = n != len(self._threshold_rules) + len(self._geofence_rules)
+            if changed:
+                self._rules_version += 1
+            return changed
+
+    def list_rules(self) -> Dict[str, list]:
+        with self._lock:
+            return {"threshold": list(self._threshold_rules),
+                    "geofence": list(self._geofence_rules)}
+
+    def _compile_threshold_table(self) -> ThresholdRuleTable:
+        table = empty_threshold_table(self.max_threshold_rules)
+        for i, rule in enumerate(self._threshold_rules):
+            active = rule.active
+            tenant_idx = mm_idx = dtype_idx = 0
+            # A scoping token that doesn't resolve must deactivate the rule,
+            # not silently widen to "any" (index 0 means wildcard on device).
+            if rule.tenant_token:
+                tenant_idx = self.registry.tenants.lookup(rule.tenant_token)
+                active = active and tenant_idx > 0
+            if rule.device_type_token:
+                dtype_idx = self.registry.device_types.lookup(rule.device_type_token)
+                active = active and dtype_idx > 0
+            if rule.measurement_name:
+                mm_idx = self.packer.measurements.intern(rule.measurement_name)
+            table.active[i] = active
+            table.tenant_idx[i] = tenant_idx
+            table.mm_idx[i] = mm_idx
+            table.device_type_idx[i] = dtype_idx
+            table.op[i] = ThresholdOp.BY_NAME[rule.operator]
+            table.threshold[i] = rule.threshold
+            table.alert_level[i] = int(rule.alert_level)
+            table.alert_type_idx[i] = self.packer.alert_types.intern(rule.alert_type)
+        return table
+
+    def _compile_geofence_table(self) -> GeofenceRuleTable:
+        table = empty_geofence_table(self.max_geofence_rules)
+        for i, rule in enumerate(self._geofence_rules):
+            zidx = self.registry.zones_interner.lookup(rule.zone_token)
+            table.active[i] = rule.active and zidx > 0
+            table.zone_row[i] = max(0, zidx - 1)
+            table.condition[i] = (GeofenceCondition.INSIDE
+                                  if rule.condition == "inside"
+                                  else GeofenceCondition.OUTSIDE)
+            table.alert_level[i] = int(rule.alert_level)
+            table.alert_type_idx[i] = self.packer.alert_types.intern(rule.alert_type)
+        return table
+
+    # -- params refresh -------------------------------------------------------
+
+    def _refresh_params(self) -> None:
+        with self._lock:
+            snap = self.registry.snapshot()
+            threshold = self._compile_threshold_table()
+            geofence = self._compile_geofence_table()
+            zones = ZoneTable(vertices=snap.zone_vertices, nvert=snap.zone_nvert,
+                              tenant_idx=snap.zone_tenant, active=snap.zone_active)
+            self._params = jax.device_put(PipelineParams(
+                assignment_status=snap.assignment_status,
+                tenant_idx=snap.tenant_idx,
+                area_idx=snap.area_idx,
+                device_type_idx=snap.device_type_idx,
+                threshold=threshold, zones=zones, geofence=geofence))
+            self._params_built_for = (snap.version, self._rules_version)
+
+    def _ensure_params(self) -> PipelineParams:
+        if self._params_built_for != (self.registry.version, self._rules_version):
+            self._refresh_params()
+        assert self._params is not None
+        return self._params
+
+    # -- processing -----------------------------------------------------------
+
+    def submit(self, batch: EventBatch) -> ProcessOutputs:
+        """Run one fused step; state advances in place (donated)."""
+        params = self._ensure_params()
+        with self._metrics.timer("step").time():
+            self._state, outputs = self._step(params, self._state, batch)
+        self.batches_processed += 1
+        self._metrics.meter("events").mark(int(np.asarray(batch.valid).sum()))
+        return outputs
+
+    def materialize_alerts(self, batch: EventBatch, outputs: ProcessOutputs,
+                           max_alerts: int = 1024) -> List[DeviceAlert]:
+        """Turn fired-rule masks back into API-level DeviceAlert events
+        (host-side; only fired rows cross the host boundary)."""
+        thr_fired = np.asarray(outputs.threshold_fired)
+        geo_fired = np.asarray(outputs.geofence_fired)
+        fired_rows = np.nonzero(thr_fired | geo_fired)[0][:max_alerts]
+        if fired_rows.size == 0:
+            return []
+        device_idx = np.asarray(batch.device_idx)
+        thr_level = np.asarray(outputs.threshold_alert_level)
+        geo_level = np.asarray(outputs.geofence_alert_level)
+        thr_rule = np.asarray(outputs.threshold_first_rule)
+        geo_rule = np.asarray(outputs.geofence_first_rule)
+        ts = np.asarray(batch.ts)
+        alerts: List[DeviceAlert] = []
+        with self._lock:
+            thr_rules = list(self._threshold_rules)
+            geo_rules = list(self._geofence_rules)
+        for row in fired_rows:
+            token = self.registry.devices.token_of(int(device_idx[row])) or ""
+            if thr_fired[row] and 0 <= thr_rule[row] < len(thr_rules):
+                rule = thr_rules[int(thr_rule[row])]
+                alerts.append(DeviceAlert(
+                    device_id=token, source=AlertSource.SYSTEM,
+                    level=AlertLevel(int(thr_level[row])), type=rule.alert_type,
+                    message=rule.alert_message or f"threshold rule {rule.token} fired",
+                    event_date=self.packer.abs_ts(int(ts[row]))))
+            if geo_fired[row] and 0 <= geo_rule[row] < len(geo_rules):
+                rule = geo_rules[int(geo_rule[row])]
+                alerts.append(DeviceAlert(
+                    device_id=token, source=AlertSource.SYSTEM,
+                    level=AlertLevel(int(geo_level[row])), type=rule.alert_type,
+                    message=rule.alert_message or f"geofence rule {rule.token} fired",
+                    event_date=self.packer.abs_ts(int(ts[row]))))
+        return alerts
+
+    # -- presence -------------------------------------------------------------
+
+    def presence_sweep(self) -> List[str]:
+        """Run the presence check; returns tokens of newly-missing devices."""
+        params = self._ensure_params()
+        now_rel = np.int32(self.packer.rel_ts(int(time.time() * 1000)))
+        registered = params.assignment_status == 1
+        self._state, newly_missing = self._presence(
+            self._state, registered, now_rel,
+            np.int32(min(self.presence_missing_interval_ms, 2 ** 31 - 1)))
+        rows = np.nonzero(np.asarray(newly_missing))[0]
+        return [t for t in (self.registry.devices.token_of(int(r)) for r in rows)
+                if t is not None]
+
+    # -- state reads ----------------------------------------------------------
+
+    @property
+    def state(self) -> DeviceStateTensors:
+        assert self._state is not None, "engine not initialized"
+        return self._state
+
+    def set_state(self, state: DeviceStateTensors) -> None:
+        """Checkpoint restore."""
+        self._state = jax.device_put(state)
+
+    def _state_row(self, idx: int):
+        """Fetch one device's row from every state tensor (overridden by the
+        sharded engine, which remaps global -> (shard, local))."""
+        s = self._state
+
+        class Row:
+            pass
+
+        row = Row()
+        for field_name in ("last_interaction", "present", "presence_missing_since",
+                           "event_count", "last_location", "last_location_ts",
+                           "last_measurement", "last_measurement_ts",
+                           "last_alert_type", "last_alert_level", "last_alert_ts"):
+            setattr(row, field_name, np.asarray(getattr(s, field_name)[idx]))
+        return row
+
+    def get_device_state(self, device_token: str) -> Optional[DeviceState]:
+        """Materialize one device's state row as the API-level DeviceState."""
+        idx = self.registry.devices.lookup(device_token)
+        if idx == 0 or self._state is None:
+            return None
+        row = self._state_row(idx)
+        state = DeviceState(device_id=device_token)
+        if int(row.last_interaction) > _NEG:
+            state.last_interaction_date = self.packer.abs_ts(int(row.last_interaction))
+        state.presence = (PresenceState.PRESENT if bool(row.present)
+                          else PresenceState.NOT_PRESENT)
+        if int(row.presence_missing_since) > _NEG:
+            state.presence_missing_date = self.packer.abs_ts(
+                int(row.presence_missing_since))
+        if int(row.last_location_ts) > _NEG:
+            lat, lon, elev = (float(x) for x in row.last_location)
+            state.last_location = (self.packer.abs_ts(int(row.last_location_ts)),
+                                   lat, lon, elev)
+        for slot in range(self.measurement_slots):
+            ts_slot = int(row.last_measurement_ts[slot])
+            if ts_slot > _NEG:
+                name = self.packer.measurements.token_of(slot) or f"slot{slot}"
+                state.last_measurements[name] = (self.packer.abs_ts(ts_slot),
+                                                 float(row.last_measurement[slot]))
+        if int(row.last_alert_ts) > _NEG:
+            atype = self.packer.alert_types.token_of(int(row.last_alert_type)) or ""
+            state.last_alerts[atype] = (self.packer.abs_ts(int(row.last_alert_ts)),
+                                        int(row.last_alert_level), "")
+        return state
+
+    def stats(self) -> Dict[str, int]:
+        s = self._state
+        return {
+            "batches": self.batches_processed,
+            "tenant_event_count": np.asarray(s.tenant_event_count).tolist(),
+            "tenant_alert_count": np.asarray(s.tenant_alert_count).tolist(),
+        }

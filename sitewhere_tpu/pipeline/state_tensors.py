@@ -1,0 +1,77 @@
+"""HBM-resident device state: the tensorized service-device-state.
+
+Reference: service-device-state keeps one Mongo document per assignment with
+last-interaction date, last location, last measurement per name, last alert
+per type, and presence (DeviceStateProcessingLogic.java:116+,
+DevicePresenceManager.java:47). Here the same state is fixed-capacity tensors
+indexed by interned device index, updated wholesale per batch by
+deterministic keyed reductions (ops/segments.py) and periodically
+checkpointed to host storage (persist/checkpoint.py) — the HBM copy is a
+cache rebuildable by bus replay (SURVEY.md §5 checkpoint/resume).
+
+Capacity knobs: D devices, M tracked measurement slots (measurement names with
+interned index < M get per-name last values; all names still update
+last-interaction), T tenants for the stat rows.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+_NEG = -(2 ** 31)
+
+
+@struct.dataclass
+class DeviceStateTensors:
+    """All tensors device-indexed unless noted. ts columns are rebased int32 ms
+    (EventPacker.epoch_base_ms); -2^31 = never."""
+
+    last_interaction: jnp.ndarray    # int32 [D]
+    present: jnp.ndarray             # bool [D]
+    presence_missing_since: jnp.ndarray  # int32 [D]
+    event_count: jnp.ndarray         # int32 [D]
+
+    last_location: jnp.ndarray       # f32 [D,3] lat/lon/elev
+    last_location_ts: jnp.ndarray    # int32 [D]
+
+    last_measurement: jnp.ndarray    # f32 [D,M]
+    last_measurement_ts: jnp.ndarray  # int32 [D,M]
+
+    last_alert_type: jnp.ndarray     # int32 [D]
+    last_alert_level: jnp.ndarray    # int32 [D]
+    last_alert_ts: jnp.ndarray       # int32 [D]
+
+    tenant_event_count: jnp.ndarray  # int32 [T]
+    tenant_alert_count: jnp.ndarray  # int32 [T]
+
+    @property
+    def num_devices(self) -> int:
+        return self.last_interaction.shape[0]
+
+    @property
+    def num_measurement_slots(self) -> int:
+        return self.last_measurement.shape[1]
+
+
+def init_device_state(max_devices: int, measurement_slots: int = 32,
+                      max_tenants: int = 16) -> DeviceStateTensors:
+    D, M, T = max_devices, measurement_slots, max_tenants
+    return DeviceStateTensors(
+        last_interaction=jnp.full((D,), _NEG, jnp.int32),
+        present=jnp.zeros((D,), bool),
+        presence_missing_since=jnp.full((D,), _NEG, jnp.int32),
+        event_count=jnp.zeros((D,), jnp.int32),
+        last_location=jnp.zeros((D, 3), jnp.float32),
+        last_location_ts=jnp.full((D,), _NEG, jnp.int32),
+        last_measurement=jnp.zeros((D, M), jnp.float32),
+        last_measurement_ts=jnp.full((D, M), _NEG, jnp.int32),
+        last_alert_type=jnp.zeros((D,), jnp.int32),
+        last_alert_level=jnp.full((D,), -1, jnp.int32),
+        last_alert_ts=jnp.full((D,), _NEG, jnp.int32),
+        tenant_event_count=jnp.zeros((T,), jnp.int32),
+        tenant_alert_count=jnp.zeros((T,), jnp.int32),
+    )

@@ -1,0 +1,195 @@
+"""The fused pipeline step: validate + rules + device-state in ONE jit.
+
+This function is the TPU rebuild of the reference's entire hot path
+(SURVEY.md §3.2-3.3). What the reference does with five microservices, three
+Kafka round-trips and two gRPC hops per event —
+  InboundPayloadProcessingLogic (validate, gRPC device lookup)
+  -> UnaryEventStorageStrategy (gRPC persist per event)
+  -> OutboundPayloadEnrichmentLogic (re-fetch + enrich)
+  -> ZoneTestRuleProcessor (JTS containment per event)
+  -> DeviceStateProcessingLogic (Mongo upsert per event)
+— happens here as one XLA program over an 8k-event batch: gathers against the
+registry mirror replace the gRPC lookups, broadcast compares replace the rule
+hosts, keyed reductions replace the Mongo upserts. Stage boundaries are
+registers/HBM, not broker round-trips.
+
+Persistence (the reference's event-management store) is intentionally NOT in
+the jit: the host appends the raw batch to the columnar event log
+(persist/eventlog.py) in parallel with device compute.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from sitewhere_tpu.model.event import DeviceEventType
+from sitewhere_tpu.ops.geofence import (
+    GeofenceRuleTable, ZoneTable, eval_geofence_rules,
+)
+from sitewhere_tpu.ops.pack import EventBatch
+from sitewhere_tpu.ops.segments import (
+    count_by_key, last_by_key, scatter_max_by_key,
+)
+from sitewhere_tpu.ops.threshold import ThresholdRuleTable, eval_threshold_rules
+from sitewhere_tpu.pipeline.state_tensors import DeviceStateTensors
+
+_NEG = -(2 ** 31)
+
+
+@struct.dataclass
+class PipelineParams:
+    """Everything the step reads but does not write: registry mirror + rule
+    tables. A pytree of device arrays; contents change without recompiling."""
+
+    # registry mirror (registry/tensors.py), [D]
+    assignment_status: jnp.ndarray
+    tenant_idx: jnp.ndarray
+    area_idx: jnp.ndarray
+    device_type_idx: jnp.ndarray
+    # rule tables
+    threshold: ThresholdRuleTable
+    zones: ZoneTable
+    geofence: GeofenceRuleTable
+
+
+@struct.dataclass
+class ProcessOutputs:
+    """Per-batch outputs consumed host-side (alert materialization, failed
+    events -> registration topic, stats)."""
+
+    valid: jnp.ndarray              # bool [B] passed validation
+    unregistered: jnp.ndarray       # bool [B] had no active assignment
+    threshold_fired: jnp.ndarray    # bool [B]
+    threshold_first_rule: jnp.ndarray  # int32 [B]
+    threshold_alert_level: jnp.ndarray  # int32 [B]
+    geofence_fired: jnp.ndarray     # bool [B]
+    geofence_first_rule: jnp.ndarray   # int32 [B]
+    geofence_alert_level: jnp.ndarray  # int32 [B]
+    tenant_counts: jnp.ndarray      # int32 [T] events this batch per tenant
+    processed: jnp.ndarray          # int32 scalar, valid events
+    alerts: jnp.ndarray             # int32 scalar, alerts fired
+
+
+def process_batch(params: PipelineParams, state: DeviceStateTensors,
+                  batch: EventBatch
+                  ) -> Tuple[DeviceStateTensors, ProcessOutputs]:
+    """One fused step. Shapes static; jit/shard_map safe; donate `state`."""
+    D = state.num_devices
+    M = state.num_measurement_slots
+    T = state.tenant_event_count.shape[0]
+
+    # ---- stage 1: validation (replaces gRPC hop #1 + assignment check) -----
+    # Unknown tokens intern to index 0 whose registry row always holds
+    # status 0, so a single status gather covers both "unknown device" and
+    # "no active assignment" (local index 0 is a real device on shards > 0).
+    status = params.assignment_status[batch.device_idx]          # gather [B]
+    registered = status == 1  # DeviceAssignmentStatus.ACTIVE
+    unregistered = batch.valid & ~registered
+    valid = batch.valid & registered
+    tenant = params.tenant_idx[batch.device_idx]
+    device_type = params.device_type_idx[batch.device_idx]
+    batch = batch.replace(tenant_idx=tenant, valid=valid)
+
+    # ---- stage 2: rule evaluation (replaces rule-processing service) -------
+    thr = eval_threshold_rules(batch, params.threshold, device_type)
+    geo = eval_geofence_rules(batch, params.zones, params.geofence)
+
+    # ---- stage 3: device-state fold (replaces device-state service) --------
+    dev = batch.device_idx
+    ts = batch.ts
+    last_interaction = scatter_max_by_key(dev, ts, valid, D,
+                                          state.last_interaction)
+    event_count = state.event_count + count_by_key(dev, valid, D)
+
+    # presence restore: any device with a valid event is present again
+    touched = count_by_key(dev, valid, D) > 0
+    present = state.present | touched
+    presence_missing_since = jnp.where(touched, _NEG,
+                                       state.presence_missing_since)
+
+    # last location (location events only)
+    is_loc = valid & (batch.event_type == DeviceEventType.LOCATION)
+    loc_vals = jnp.stack([batch.lat, batch.lon, batch.elevation], axis=1)
+    loc_ts, (last_location,) = last_by_key(
+        dev, ts, is_loc, D, state.last_location_ts, (state.last_location,),
+        (loc_vals,))
+
+    # last measurement per (device, slot<M)
+    is_mm = (valid & (batch.event_type == DeviceEventType.MEASUREMENT)
+             & (batch.mm_idx < M))
+    mm_key = dev * M + batch.mm_idx
+    mm_ts_flat, (mm_val_flat,) = last_by_key(
+        mm_key, ts, is_mm, D * M, state.last_measurement_ts.reshape(-1),
+        (state.last_measurement.reshape(-1),), (batch.value,))
+    last_measurement_ts = mm_ts_flat.reshape(D, M)
+    last_measurement = mm_val_flat.reshape(D, M)
+
+    # last alert per device (device-sent alerts; rule-fired alerts merge on
+    # the next batch once materialized as events)
+    is_alert = valid & (batch.event_type == DeviceEventType.ALERT)
+    alert_ts, (last_alert_type, last_alert_level) = last_by_key(
+        dev, ts, is_alert, D, state.last_alert_ts,
+        (state.last_alert_type, state.last_alert_level),
+        (batch.alert_type_idx, batch.alert_level))
+
+    # ---- stage 4: stats (replaces Dropwizard meters / Kafka state topics) --
+    tenant_counts = count_by_key(tenant, valid, T)
+    alerts = (jnp.sum(thr["fired"], dtype=jnp.int32)
+              + jnp.sum(geo["fired"], dtype=jnp.int32))
+
+    new_state = DeviceStateTensors(
+        last_interaction=last_interaction,
+        present=present,
+        presence_missing_since=presence_missing_since,
+        event_count=event_count,
+        last_location=last_location,
+        last_location_ts=loc_ts,
+        last_measurement=last_measurement,
+        last_measurement_ts=last_measurement_ts,
+        last_alert_type=last_alert_type,
+        last_alert_level=last_alert_level,
+        last_alert_ts=alert_ts,
+        tenant_event_count=state.tenant_event_count + tenant_counts,
+        tenant_alert_count=state.tenant_alert_count + count_by_key(
+            tenant, valid & (thr["fired"] | geo["fired"]), T),
+    )
+    outputs = ProcessOutputs(
+        valid=valid,
+        unregistered=unregistered,
+        threshold_fired=thr["fired"],
+        threshold_first_rule=thr["first_rule"],
+        threshold_alert_level=thr["alert_level"],
+        geofence_fired=geo["fired"],
+        geofence_first_rule=geo["first_rule"],
+        geofence_alert_level=geo["alert_level"],
+        tenant_counts=tenant_counts,
+        processed=jnp.sum(valid, dtype=jnp.int32),
+        alerts=alerts,
+    )
+    return new_state, outputs
+
+
+def check_presence(state: DeviceStateTensors, registered: jnp.ndarray,
+                   now_rel: jnp.ndarray, missing_interval_ms: jnp.ndarray
+                   ) -> Tuple[DeviceStateTensors, jnp.ndarray]:
+    """Periodic presence sweep (replaces DevicePresenceManager's
+    PresenceChecker thread, DevicePresenceManager.java:110-135).
+
+    A registered device that has interacted before and whose last interaction
+    is older than `missing_interval_ms` transitions to NOT_PRESENT exactly
+    once (send-once notification strategy): returns the newly-missing mask so
+    the host can emit PresenceState change events.
+    """
+    has_interacted = state.last_interaction > _NEG
+    overdue = (now_rel - state.last_interaction) > missing_interval_ms
+    newly_missing = registered & has_interacted & state.present & overdue
+    new_state = state.replace(
+        present=state.present & ~newly_missing,
+        presence_missing_since=jnp.where(newly_missing, now_rel,
+                                         state.presence_missing_since),
+    )
+    return new_state, newly_missing

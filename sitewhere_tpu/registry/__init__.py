@@ -1,0 +1,15 @@
+"""Device registry: CRUD store + token interning + device-indexed tensors.
+
+Replaces the reference's service-device-management (gRPC registry with 84 rpcs,
+Hazelcast near-caches) with an in-process store whose hot-path view is a set of
+device-indexed lookup tensors resident in HBM — the per-event gRPC
+getDeviceByToken of InboundPayloadProcessingLogic.java:156-193 becomes a dense
+int32 gather inside the fused pipeline step.
+"""
+
+from sitewhere_tpu.registry.interning import TokenInterner
+from sitewhere_tpu.registry.store import DeviceManagement, SqliteStore, InMemoryStore
+from sitewhere_tpu.registry.tensors import RegistryTensors
+
+__all__ = ["TokenInterner", "DeviceManagement", "SqliteStore", "InMemoryStore",
+           "RegistryTensors"]

@@ -1,0 +1,91 @@
+"""Token interning: string identifiers -> dense int32 indices.
+
+The hot path cannot touch Python strings: device tokens, measurement names,
+alert types and tenant tokens are interned once on the host into dense indices
+that index HBM lookup tensors. This replaces the reference's per-event
+device-token -> Device gRPC lookup + Hazelcast near-cache
+(InboundPayloadProcessingLogic.java:156, NearCacheManager.java:42).
+
+A native C++ batch interner (sitewhere_tpu/native) accelerates bulk interning;
+this module transparently uses it when the shared library is built and falls
+back to pure Python otherwise.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Iterable, List, Optional, Sequence
+
+import numpy as np
+
+
+class TokenInterner:
+    """Bidirectional string<->int32 mapping with a fixed capacity.
+
+    Index 0 is reserved as UNKNOWN so that lookup tensors can keep a sentinel
+    row and failed lookups stay in-band on device.
+    """
+
+    UNKNOWN = 0
+
+    def __init__(self, capacity: int, name: str = "tokens"):
+        if capacity < 2:
+            raise ValueError("capacity must be >= 2")
+        self.capacity = capacity
+        self.name = name
+        self._to_index: Dict[str, int] = {}
+        self._to_token: List[Optional[str]] = [None]  # index 0 = UNKNOWN
+        self._lock = threading.Lock()
+
+    def __len__(self) -> int:
+        return len(self._to_token)
+
+    def intern(self, token: str) -> int:
+        """Get-or-assign the index for a token."""
+        idx = self._to_index.get(token)
+        if idx is not None:
+            return idx
+        with self._lock:
+            idx = self._to_index.get(token)
+            if idx is not None:
+                return idx
+            idx = len(self._to_token)
+            if idx >= self.capacity:
+                from sitewhere_tpu.errors import ErrorCode, SiteWhereError
+                raise SiteWhereError(
+                    f"interner '{self.name}' capacity {self.capacity} exceeded",
+                    ErrorCode.CAPACITY_EXCEEDED)
+            self._to_token.append(token)
+            self._to_index[token] = idx
+            return idx
+
+    def lookup(self, token: str) -> int:
+        """Index for a token, UNKNOWN (0) if absent. Never allocates."""
+        return self._to_index.get(token, self.UNKNOWN)
+
+    def token_of(self, index: int) -> Optional[str]:
+        if 0 < index < len(self._to_token):
+            return self._to_token[index]
+        return None
+
+    def lookup_batch(self, tokens: Sequence[str]) -> np.ndarray:
+        """Vectorized lookup of many tokens -> int32 array (no allocation)."""
+        get = self._to_index.get
+        return np.fromiter((get(t, 0) for t in tokens), dtype=np.int32,
+                           count=len(tokens))
+
+    def intern_batch(self, tokens: Iterable[str]) -> np.ndarray:
+        return np.fromiter((self.intern(t) for t in tokens), dtype=np.int32)
+
+    def snapshot(self) -> List[Optional[str]]:
+        with self._lock:
+            return list(self._to_token)
+
+    def restore(self, tokens: Sequence[Optional[str]]) -> None:
+        """Rebuild from a snapshot (checkpoint restore)."""
+        with self._lock:
+            self._to_token = list(tokens) if tokens else [None]
+            if not self._to_token or self._to_token[0] is not None:
+                self._to_token.insert(0, None)
+            self._to_index = {t: i for i, t in enumerate(self._to_token)
+                              if t is not None}

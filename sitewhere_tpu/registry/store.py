@@ -1,0 +1,607 @@
+"""Registry CRUD store: the IDeviceManagement surface.
+
+Reference: sitewhere-core-api spi/device/IDeviceManagement.java (device types,
+commands, statuses, devices, assignments, areas/area types, zones, customers/
+customer types, device groups, alarms — the 84-rpc device-management surface)
+with pluggable persistence like the reference's mongodb/hbase choice
+(service-device-management/persistence/*). Backends here: InMemoryStore
+(dict-of-dicts) and SqliteStore (stdlib sqlite3, one row per entity, JSON
+payload, token/id indexed) — write-through from the in-memory maps.
+
+All reads the hot path needs are mirrored into RegistryTensors
+(registry/tensors.py); this store is control-plane only.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import sqlite3
+import threading
+from typing import Any, Callable, Dict, Generic, Iterable, List, Optional, Type, TypeVar
+
+from sitewhere_tpu.errors import DuplicateTokenError, ErrorCode, NotFoundError, SiteWhereError
+from sitewhere_tpu.model import (
+    Area, AreaType, Customer, CustomerType, Device, DeviceAlarm, DeviceAssignment,
+    DeviceAssignmentStatus, DeviceCommand, DeviceGroup, DeviceGroupElement,
+    DeviceStatus, DeviceType, Zone,
+)
+from sitewhere_tpu.model.common import SearchCriteria, SearchResults, now_ms, page
+from sitewhere_tpu.model.device import CommandParameter, DeviceElementMapping, ParameterType
+
+T = TypeVar("T")
+
+
+# ---------------------------------------------------------------------------
+# (de)serialization helpers
+# ---------------------------------------------------------------------------
+
+def _entity_to_json(entity: Any) -> str:
+    def default(obj: Any) -> Any:
+        if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+            return dataclasses.asdict(obj)
+        if hasattr(obj, "value"):
+            return obj.value
+        raise TypeError(type(obj))
+    return json.dumps(dataclasses.asdict(entity), default=default)
+
+
+_NESTED_FIELDS: Dict[Type, Dict[str, Callable[[dict], Any]]] = {
+    Device: {"device_element_mappings": lambda d: DeviceElementMapping(**d)},
+    DeviceCommand: {"parameters": lambda d: CommandParameter(
+        name=d["name"], type=ParameterType(d["type"]), required=d["required"])},
+}
+
+
+def _entity_from_json(cls: Type[T], payload: str) -> T:
+    data = json.loads(payload)
+    fields = {f.name: f for f in dataclasses.fields(cls)}
+    kwargs: Dict[str, Any] = {}
+    nested = _NESTED_FIELDS.get(cls, {})
+    for key, val in data.items():
+        if key not in fields:
+            continue
+        ftype = fields[key].type
+        if key in nested and isinstance(val, list):
+            val = [nested[key](v) for v in val]
+        elif isinstance(ftype, str):
+            # enum-typed fields are stored by value
+            resolved = _ENUM_TYPES.get(ftype)
+            if resolved is not None and val is not None:
+                val = resolved(val)
+        kwargs[key] = val
+    # Location lists come back as dicts
+    if cls in (Area, Zone) and "bounds" in kwargs:
+        from sitewhere_tpu.model.common import Location
+        kwargs["bounds"] = [Location(**b) if isinstance(b, dict) else b
+                            for b in kwargs["bounds"]]
+    return cls(**kwargs)
+
+
+from sitewhere_tpu.model.device import DeviceContainerPolicy
+from sitewhere_tpu.model.device import DeviceAlarmState
+
+_ENUM_TYPES = {
+    "DeviceAssignmentStatus": DeviceAssignmentStatus,
+    "DeviceContainerPolicy": DeviceContainerPolicy,
+    "DeviceAlarmState": DeviceAlarmState,
+}
+
+
+# ---------------------------------------------------------------------------
+# storage backends
+# ---------------------------------------------------------------------------
+
+class InMemoryStore:
+    """No-op durable backend: everything lives in DeviceManagement's maps."""
+
+    def save(self, kind: str, entity_id: str, token: str, payload: str) -> None:
+        pass
+
+    def delete(self, kind: str, entity_id: str) -> None:
+        pass
+
+    def load_all(self, kind: str) -> Iterable[tuple]:
+        return []
+
+    def close(self) -> None:
+        pass
+
+
+class SqliteStore:
+    """Durable backend on stdlib sqlite3 (reference analogue: the MongoDB
+    persistence tier, MongoDeviceManagement)."""
+
+    def __init__(self, path: str):
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        self._lock = threading.Lock()
+        self._conn.execute(
+            "CREATE TABLE IF NOT EXISTS entities ("
+            " kind TEXT NOT NULL, id TEXT NOT NULL, token TEXT NOT NULL,"
+            " payload TEXT NOT NULL, PRIMARY KEY (kind, id))")
+        self._conn.execute(
+            "CREATE INDEX IF NOT EXISTS idx_entities_token ON entities (kind, token)")
+        self._conn.commit()
+
+    def save(self, kind: str, entity_id: str, token: str, payload: str) -> None:
+        with self._lock:
+            self._conn.execute(
+                "INSERT OR REPLACE INTO entities (kind, id, token, payload)"
+                " VALUES (?, ?, ?, ?)", (kind, entity_id, token, payload))
+            self._conn.commit()
+
+    def delete(self, kind: str, entity_id: str) -> None:
+        with self._lock:
+            self._conn.execute("DELETE FROM entities WHERE kind=? AND id=?",
+                               (kind, entity_id))
+            self._conn.commit()
+
+    def load_all(self, kind: str) -> Iterable[tuple]:
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT id, token, payload FROM entities WHERE kind=?", (kind,)
+            ).fetchall()
+        return rows
+
+    def close(self) -> None:
+        with self._lock:
+            self._conn.close()
+
+
+# ---------------------------------------------------------------------------
+# generic collection
+# ---------------------------------------------------------------------------
+
+class _Collection(Generic[T]):
+    """Token+id indexed entity map with write-through persistence."""
+
+    def __init__(self, kind: str, cls: Type[T], store: Any,
+                 not_found: ErrorCode):
+        self.kind = kind
+        self.cls = cls
+        self.store = store
+        self.not_found = not_found
+        self.by_id: Dict[str, T] = {}
+        self.by_token: Dict[str, T] = {}
+        self._lock = threading.RLock()
+        for _id, _token, payload in store.load_all(kind):
+            entity = _entity_from_json(cls, payload)
+            self.by_id[_id] = entity
+            if _token:
+                self.by_token[_token] = entity
+
+    def create(self, entity: T) -> T:
+        with self._lock:
+            token = getattr(entity, "token", "")
+            if token and token in self.by_token:
+                raise DuplicateTokenError(
+                    f"{self.kind} token '{token}' already exists")
+            self.by_id[entity.id] = entity
+            if token:
+                self.by_token[token] = entity
+            self.store.save(self.kind, entity.id, token, _entity_to_json(entity))
+            return entity
+
+    def get(self, entity_id: str) -> Optional[T]:
+        return self.by_id.get(entity_id)
+
+    def get_by_token(self, token: str) -> Optional[T]:
+        return self.by_token.get(token)
+
+    def require(self, entity_id: str) -> T:
+        entity = self.by_id.get(entity_id)
+        if entity is None:
+            raise NotFoundError(f"{self.kind} id '{entity_id}' not found",
+                                self.not_found)
+        return entity
+
+    def require_by_token(self, token: str) -> T:
+        entity = self.by_token.get(token)
+        if entity is None:
+            raise NotFoundError(f"{self.kind} token '{token}' not found",
+                                self.not_found)
+        return entity
+
+    def update(self, entity_id: str, updates: Dict[str, Any],
+               username: str = "") -> T:
+        with self._lock:
+            entity = self.require(entity_id)
+            old_token = getattr(entity, "token", "")
+            # validate every key before mutating, so a bad update leaves the
+            # entity untouched (and in-memory state consistent with storage)
+            for key in updates:
+                if not hasattr(entity, key):
+                    raise SiteWhereError(f"unknown field '{key}' on {self.kind}")
+            for key, val in updates.items():
+                setattr(entity, key, val)
+            entity.touch(username)
+            new_token = getattr(entity, "token", "")
+            if new_token != old_token:
+                if new_token in self.by_token:
+                    raise DuplicateTokenError(
+                        f"{self.kind} token '{new_token}' already exists")
+                self.by_token.pop(old_token, None)
+                if new_token:
+                    self.by_token[new_token] = entity
+            self.store.save(self.kind, entity.id, new_token, _entity_to_json(entity))
+            return entity
+
+    def delete(self, entity_id: str) -> T:
+        with self._lock:
+            entity = self.require(entity_id)
+            del self.by_id[entity_id]
+            token = getattr(entity, "token", "")
+            if token:
+                self.by_token.pop(token, None)
+            self.store.delete(self.kind, entity_id)
+            return entity
+
+    def save(self, entity: T) -> None:
+        """Persist in-place mutations."""
+        self.store.save(self.kind, entity.id, getattr(entity, "token", ""),
+                        _entity_to_json(entity))
+
+    def list(self, criteria: Optional[SearchCriteria] = None,
+             where: Optional[Callable[[T], bool]] = None) -> SearchResults[T]:
+        with self._lock:
+            items = [e for e in self.by_id.values() if where is None or where(e)]
+        items.sort(key=lambda e: getattr(e, "created_date", 0))
+        return page(items, criteria or SearchCriteria(page_size=10 ** 9))
+
+    def all(self) -> List[T]:
+        with self._lock:
+            return list(self.by_id.values())
+
+    def __len__(self) -> int:
+        return len(self.by_id)
+
+
+# ---------------------------------------------------------------------------
+# the IDeviceManagement surface
+# ---------------------------------------------------------------------------
+
+class DeviceManagement:
+    """Full registry API (IDeviceManagement.java). One instance per tenant
+    engine, like the reference's per-tenant store delegates.
+
+    Mutations invalidate listeners (pipeline mirrors subscribe via
+    `add_listener` — the reference's DeviceManagementTriggers Kafka
+    notifications, collapsed to an in-proc callback)."""
+
+    def __init__(self, store: Any = None, tenant_id: str = "default"):
+        store = store or InMemoryStore()
+        self.tenant_id = tenant_id
+        self.store = store
+        E = ErrorCode
+        self.device_types: _Collection[DeviceType] = _Collection(
+            "device_type", DeviceType, store, E.INVALID_DEVICE_TYPE_TOKEN)
+        self.device_commands: _Collection[DeviceCommand] = _Collection(
+            "device_command", DeviceCommand, store, E.INVALID_COMMAND_TOKEN)
+        self.device_statuses: _Collection[DeviceStatus] = _Collection(
+            "device_status", DeviceStatus, store, E.INVALID_DEVICE_TOKEN)
+        self.devices: _Collection[Device] = _Collection(
+            "device", Device, store, E.INVALID_DEVICE_TOKEN)
+        self.assignments: _Collection[DeviceAssignment] = _Collection(
+            "assignment", DeviceAssignment, store, E.INVALID_ASSIGNMENT_TOKEN)
+        self.area_types: _Collection[AreaType] = _Collection(
+            "area_type", AreaType, store, E.INVALID_AREA_TOKEN)
+        self.areas: _Collection[Area] = _Collection(
+            "area", Area, store, E.INVALID_AREA_TOKEN)
+        self.zones: _Collection[Zone] = _Collection(
+            "zone", Zone, store, E.INVALID_ZONE_TOKEN)
+        self.customer_types: _Collection[CustomerType] = _Collection(
+            "customer_type", CustomerType, store, E.INVALID_CUSTOMER_TOKEN)
+        self.customers: _Collection[Customer] = _Collection(
+            "customer", Customer, store, E.INVALID_CUSTOMER_TOKEN)
+        self.device_groups: _Collection[DeviceGroup] = _Collection(
+            "device_group", DeviceGroup, store, E.INVALID_GROUP_TOKEN)
+        self.group_elements: _Collection[DeviceGroupElement] = _Collection(
+            "group_element", DeviceGroupElement, store, E.INVALID_GROUP_TOKEN)
+        self.alarms: _Collection[DeviceAlarm] = _Collection(
+            "alarm", DeviceAlarm, store, E.INVALID_DEVICE_TOKEN)
+        self._listeners: List[Callable[[str, Any], None]] = []
+        # device_id -> active assignment (the hot lookup of
+        # InboundPayloadProcessingLogic.validateAssignment:179)
+        self._active_assignment: Dict[str, DeviceAssignment] = {}
+        for assignment in self.assignments.all():
+            if assignment.status == DeviceAssignmentStatus.ACTIVE:
+                self._active_assignment[assignment.device_id] = assignment
+
+    # -- change notification --------------------------------------------------
+
+    def add_listener(self, callback: Callable[[str, Any], None]) -> None:
+        self._listeners.append(callback)
+
+    def _notify(self, kind: str, entity: Any) -> None:
+        for callback in list(self._listeners):
+            callback(kind, entity)
+
+    # -- device types / commands / statuses -----------------------------------
+
+    def create_device_type(self, device_type: DeviceType) -> DeviceType:
+        result = self.device_types.create(device_type)
+        self._notify("device_type", result)
+        return result
+
+    def get_device_type_by_token(self, token: str) -> DeviceType:
+        return self.device_types.require_by_token(token)
+
+    def update_device_type(self, token: str, updates: Dict) -> DeviceType:
+        entity = self.device_types.require_by_token(token)
+        result = self.device_types.update(entity.id, updates)
+        self._notify("device_type", result)
+        return result
+
+    def delete_device_type(self, token: str) -> DeviceType:
+        entity = self.device_types.require_by_token(token)
+        in_use = any(d.device_type_id == entity.id for d in self.devices.all())
+        if in_use:
+            raise SiteWhereError("device type in use",
+                                 ErrorCode.DEVICE_TYPE_IN_USE)
+        result = self.device_types.delete(entity.id)
+        self._notify("device_type", result)
+        return result
+
+    def list_device_types(self, criteria: Optional[SearchCriteria] = None
+                          ) -> SearchResults[DeviceType]:
+        return self.device_types.list(criteria)
+
+    def create_device_command(self, command: DeviceCommand) -> DeviceCommand:
+        return self.device_commands.create(command)
+
+    def get_device_command_by_token(self, token: str) -> DeviceCommand:
+        return self.device_commands.require_by_token(token)
+
+    def list_device_commands(self, device_type_token: Optional[str] = None
+                             ) -> SearchResults[DeviceCommand]:
+        type_id = (self.device_types.require_by_token(device_type_token).id
+                   if device_type_token else None)
+        return self.device_commands.list(
+            where=(lambda c: c.device_type_id == type_id) if type_id else None)
+
+    def create_device_status(self, status: DeviceStatus) -> DeviceStatus:
+        return self.device_statuses.create(status)
+
+    def list_device_statuses(self, device_type_token: Optional[str] = None
+                             ) -> SearchResults[DeviceStatus]:
+        type_id = (self.device_types.require_by_token(device_type_token).id
+                   if device_type_token else None)
+        return self.device_statuses.list(
+            where=(lambda s: s.device_type_id == type_id) if type_id else None)
+
+    # -- devices ---------------------------------------------------------------
+
+    def create_device(self, device: Device) -> Device:
+        if device.device_type_id:
+            self.device_types.require(device.device_type_id)
+        result = self.devices.create(device)
+        self._notify("device", result)
+        return result
+
+    def get_device(self, device_id: str) -> Device:
+        return self.devices.require(device_id)
+
+    def get_device_by_token(self, token: str) -> Optional[Device]:
+        return self.devices.get_by_token(token)
+
+    def update_device(self, token: str, updates: Dict) -> Device:
+        entity = self.devices.require_by_token(token)
+        result = self.devices.update(entity.id, updates)
+        self._notify("device", result)
+        return result
+
+    def delete_device(self, token: str) -> Device:
+        entity = self.devices.require_by_token(token)
+        active = self._active_assignment.get(entity.id)
+        if active is not None:
+            raise SiteWhereError("device has an active assignment",
+                                 ErrorCode.DEVICE_ALREADY_ASSIGNED)
+        result = self.devices.delete(entity.id)
+        self._notify("device", result)
+        return result
+
+    def list_devices(self, criteria: Optional[SearchCriteria] = None,
+                     device_type_token: Optional[str] = None,
+                     assigned: Optional[bool] = None) -> SearchResults[Device]:
+        type_id = (self.device_types.require_by_token(device_type_token).id
+                   if device_type_token else None)
+
+        def where(d: Device) -> bool:
+            if type_id and d.device_type_id != type_id:
+                return False
+            if assigned is not None:
+                if assigned != (d.id in self._active_assignment):
+                    return False
+            return True
+
+        return self.devices.list(criteria, where)
+
+    # -- assignments -----------------------------------------------------------
+
+    def create_device_assignment(self, assignment: DeviceAssignment
+                                 ) -> DeviceAssignment:
+        device = self.devices.require(assignment.device_id)
+        if device.id in self._active_assignment:
+            raise SiteWhereError(
+                f"device '{device.token}' already has an active assignment",
+                ErrorCode.DEVICE_ALREADY_ASSIGNED)
+        if not assignment.device_type_id:
+            assignment.device_type_id = device.device_type_id
+        assignment.status = DeviceAssignmentStatus.ACTIVE
+        assignment.active_date = now_ms()
+        result = self.assignments.create(assignment)
+        self._active_assignment[device.id] = result
+        self._notify("assignment", result)
+        return result
+
+    def get_device_assignment(self, assignment_id: str) -> DeviceAssignment:
+        return self.assignments.require(assignment_id)
+
+    def get_device_assignment_by_token(self, token: str) -> Optional[DeviceAssignment]:
+        return self.assignments.get_by_token(token)
+
+    def get_active_assignment(self, device_id: str) -> Optional[DeviceAssignment]:
+        """The per-event validation lookup (hot in the reference, tensorized
+        here via RegistryTensors)."""
+        return self._active_assignment.get(device_id)
+
+    def release_device_assignment(self, token: str) -> DeviceAssignment:
+        assignment = self.assignments.require_by_token(token)
+        assignment.status = DeviceAssignmentStatus.RELEASED
+        assignment.released_date = now_ms()
+        assignment.touch()
+        self.assignments.save(assignment)
+        if self._active_assignment.get(assignment.device_id) is assignment:
+            del self._active_assignment[assignment.device_id]
+        self._notify("assignment", assignment)
+        return assignment
+
+    def mark_assignment_missing(self, assignment_id: str) -> DeviceAssignment:
+        assignment = self.assignments.require(assignment_id)
+        assignment.status = DeviceAssignmentStatus.MISSING
+        assignment.touch()
+        self.assignments.save(assignment)
+        self._notify("assignment", assignment)
+        return assignment
+
+    def list_assignments(self, criteria: Optional[SearchCriteria] = None,
+                         device_token: Optional[str] = None,
+                         customer_token: Optional[str] = None,
+                         area_token: Optional[str] = None
+                         ) -> SearchResults[DeviceAssignment]:
+        device_id = (self.devices.require_by_token(device_token).id
+                     if device_token else None)
+        customer_id = (self.customers.require_by_token(customer_token).id
+                       if customer_token else None)
+        area_id = (self.areas.require_by_token(area_token).id
+                   if area_token else None)
+
+        def where(a: DeviceAssignment) -> bool:
+            if device_id and a.device_id != device_id:
+                return False
+            if customer_id and a.customer_id != customer_id:
+                return False
+            if area_id and a.area_id != area_id:
+                return False
+            return True
+
+        return self.assignments.list(criteria, where)
+
+    # -- areas / zones / customers --------------------------------------------
+
+    def create_area_type(self, area_type: AreaType) -> AreaType:
+        return self.area_types.create(area_type)
+
+    def create_area(self, area: Area) -> Area:
+        result = self.areas.create(area)
+        self._notify("area", result)
+        return result
+
+    def get_area_by_token(self, token: str) -> Area:
+        return self.areas.require_by_token(token)
+
+    def list_areas(self, criteria: Optional[SearchCriteria] = None
+                   ) -> SearchResults[Area]:
+        return self.areas.list(criteria)
+
+    def create_zone(self, zone: Zone) -> Zone:
+        result = self.zones.create(zone)
+        self._notify("zone", result)
+        return result
+
+    def get_zone_by_token(self, token: str) -> Zone:
+        return self.zones.require_by_token(token)
+
+    def update_zone(self, token: str, updates: Dict) -> Zone:
+        entity = self.zones.require_by_token(token)
+        result = self.zones.update(entity.id, updates)
+        self._notify("zone", result)
+        return result
+
+    def delete_zone(self, token: str) -> Zone:
+        entity = self.zones.require_by_token(token)
+        result = self.zones.delete(entity.id)
+        self._notify("zone", result)
+        return result
+
+    def list_zones(self, area_token: Optional[str] = None,
+                   criteria: Optional[SearchCriteria] = None
+                   ) -> SearchResults[Zone]:
+        area_id = self.areas.require_by_token(area_token).id if area_token else None
+        return self.zones.list(
+            criteria, (lambda z: z.area_id == area_id) if area_id else None)
+
+    def create_customer_type(self, customer_type: CustomerType) -> CustomerType:
+        return self.customer_types.create(customer_type)
+
+    def create_customer(self, customer: Customer) -> Customer:
+        return self.customers.create(customer)
+
+    def get_customer_by_token(self, token: str) -> Customer:
+        return self.customers.require_by_token(token)
+
+    def list_customers(self, criteria: Optional[SearchCriteria] = None
+                       ) -> SearchResults[Customer]:
+        return self.customers.list(criteria)
+
+    # -- device groups ---------------------------------------------------------
+
+    def create_device_group(self, group: DeviceGroup) -> DeviceGroup:
+        return self.device_groups.create(group)
+
+    def get_device_group_by_token(self, token: str) -> DeviceGroup:
+        return self.device_groups.require_by_token(token)
+
+    def add_device_group_elements(self, group_token: str,
+                                  elements: List[DeviceGroupElement]
+                                  ) -> List[DeviceGroupElement]:
+        group = self.device_groups.require_by_token(group_token)
+        out = []
+        for element in elements:
+            element.group_id = group.id
+            out.append(self.group_elements.create(element))
+        return out
+
+    def list_device_group_elements(self, group_token: str
+                                   ) -> SearchResults[DeviceGroupElement]:
+        group = self.device_groups.require_by_token(group_token)
+        return self.group_elements.list(where=lambda e: e.group_id == group.id)
+
+    def expand_group_devices(self, group_token: str) -> List[Device]:
+        """Recursively resolve a group to its device list (used by batch ops)."""
+        seen_groups: set = set()
+        devices: Dict[str, Device] = {}
+
+        def walk(token: str) -> None:
+            group = self.device_groups.require_by_token(token)
+            if group.id in seen_groups:
+                return
+            seen_groups.add(group.id)
+            for element in self.group_elements.all():
+                if element.group_id != group.id:
+                    continue
+                if element.device_id:
+                    device = self.devices.get(element.device_id)
+                    if device:
+                        devices[device.id] = device
+                elif element.nested_group_id:
+                    nested = self.device_groups.get(element.nested_group_id)
+                    if nested:
+                        walk(nested.token)
+
+        walk(group_token)
+        return list(devices.values())
+
+    # -- alarms ----------------------------------------------------------------
+
+    def create_device_alarm(self, alarm: DeviceAlarm) -> DeviceAlarm:
+        alarm.triggered_date = alarm.triggered_date or now_ms()
+        return self.alarms.create(alarm)
+
+    def list_device_alarms(self, device_token: Optional[str] = None,
+                           criteria: Optional[SearchCriteria] = None
+                           ) -> SearchResults[DeviceAlarm]:
+        device_id = (self.devices.require_by_token(device_token).id
+                     if device_token else None)
+        return self.alarms.list(
+            criteria, (lambda a: a.device_id == device_id) if device_id else None)

@@ -1,0 +1,207 @@
+"""Device-indexed registry lookup tensors: the HBM mirror of the registry.
+
+This is the TPU replacement for hot-path gRPC lookup #1 (SURVEY.md §3.2):
+instead of `getDeviceByToken` + assignment validation per event over gRPC +
+Hazelcast near-cache, the registry is mirrored into fixed-capacity int32/f32
+arrays indexed by interned device index. Validation inside the fused pipeline
+step is then a gather + compare.
+
+Columns (capacity D = max_devices, index = TokenInterner index, row 0 =
+UNKNOWN sentinel):
+  assignment_status  int32[D]  0 = unregistered/no active assignment,
+                               else DeviceAssignmentStatus value
+  tenant_idx         int32[D]  interned tenant of the device's assignment
+  area_idx           int32[D]  interned area token of the active assignment
+  device_type_idx    int32[D]  interned device type token
+  assignment_idx     int32[D]  interned assignment token (for mapping back)
+
+Zone geometry for the geofence kernel lives here too (compiled from
+Zone.bounds, reference analogue: ZoneTestRuleProcessor's cached JTS polygons,
+ZoneTestRuleProcessor.java:72-83):
+  zone_vertices f32[Z, V, 2]  (lat, lon), padded by repeating the last vertex
+  zone_nvert    int32[Z]      actual vertex count
+  zone_tenant   int32[Z], zone_area int32[Z], zone_active bool[Z]
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+import numpy as np
+
+from sitewhere_tpu.model import DeviceAssignmentStatus, Zone
+from sitewhere_tpu.registry.interning import TokenInterner
+from sitewhere_tpu.registry.store import DeviceManagement
+
+
+@dataclass
+class RegistrySnapshot:
+    """Immutable numpy view handed to the pipeline step. All int32/f32."""
+
+    assignment_status: np.ndarray
+    tenant_idx: np.ndarray
+    area_idx: np.ndarray
+    device_type_idx: np.ndarray
+    assignment_idx: np.ndarray
+    zone_vertices: np.ndarray
+    zone_nvert: np.ndarray
+    zone_tenant: np.ndarray
+    zone_area: np.ndarray
+    zone_active: np.ndarray
+    version: int
+
+
+class RegistryTensors:
+    """Maintains the tensor mirror of one-or-more tenants' DeviceManagement.
+
+    Subscribes to registry mutations and rebuilds incrementally (device-level
+    changes touch single rows; zone changes recompile the zone table).
+    Thread-safe: `snapshot()` returns a consistent frozen view with a version
+    counter so the pipeline can detect staleness cheaply.
+    """
+
+    def __init__(self, max_devices: int, max_zones: int, max_zone_vertices: int,
+                 device_interner: Optional[TokenInterner] = None,
+                 tenant_interner: Optional[TokenInterner] = None):
+        self.devices = device_interner or TokenInterner(max_devices, "devices")
+        self.tenants = tenant_interner or TokenInterner(64, "tenants")
+        self.areas = TokenInterner(4096, "areas")
+        self.device_types = TokenInterner(4096, "device_types")
+        self.assignments = TokenInterner(max_devices, "assignments")
+        self.zones_interner = TokenInterner(max_zones + 1, "zones")
+        self.max_zones = max_zones
+        self.max_zone_vertices = max_zone_vertices
+
+        D = max_devices
+        self._assignment_status = np.zeros(D, np.int32)
+        self._tenant_idx = np.zeros(D, np.int32)
+        self._area_idx = np.zeros(D, np.int32)
+        self._device_type_idx = np.zeros(D, np.int32)
+        self._assignment_idx = np.zeros(D, np.int32)
+
+        Z, V = max_zones, max_zone_vertices
+        self._zone_vertices = np.zeros((Z, V, 2), np.float32)
+        self._zone_nvert = np.zeros(Z, np.int32)
+        self._zone_tenant = np.zeros(Z, np.int32)
+        self._zone_area = np.zeros(Z, np.int32)
+        self._zone_active = np.zeros(Z, bool)
+
+        self._version = 0
+        self._lock = threading.Lock()
+        self._managements: Dict[str, DeviceManagement] = {}
+        # device entity id -> interned token index, to retire stale rows when
+        # a device's token is renamed (the old token's row must stop
+        # validating events)
+        self._idx_by_device_id: Dict[str, int] = {}
+
+    # -- wiring ---------------------------------------------------------------
+
+    def attach(self, management: DeviceManagement, tenant_token: str) -> None:
+        """Mirror a tenant's registry; subscribes to its mutations."""
+        tenant_idx = self.tenants.intern(tenant_token)
+        self._managements[tenant_token] = management
+        management.add_listener(
+            lambda kind, entity: self._on_change(management, tenant_idx, kind, entity))
+        self._full_rebuild(management, tenant_idx)
+
+    def _on_change(self, management: DeviceManagement, tenant_idx: int,
+                   kind: str, entity) -> None:
+        if kind in ("device", "assignment"):
+            with self._lock:
+                if kind == "assignment":
+                    device = management.devices.get(entity.device_id)
+                else:
+                    device = entity if entity.id in management.devices.by_id else None
+                    if device is None:  # deleted device
+                        idx = self.devices.lookup(entity.token)
+                        if idx:
+                            self._assignment_status[idx] = 0
+                        self._idx_by_device_id.pop(entity.id, None)
+                        self._version += 1
+                        return
+                if device is not None:
+                    self._mirror_device(management, tenant_idx, device)
+                self._version += 1
+        elif kind == "zone":
+            with self._lock:
+                self._mirror_zone(tenant_idx, entity,
+                                  active=entity.id in management.zones.by_id)
+                self._version += 1
+
+    # -- mirroring ------------------------------------------------------------
+
+    def _mirror_device(self, management: DeviceManagement, tenant_idx: int,
+                       device) -> None:
+        idx = self.devices.intern(device.token)
+        prior = self._idx_by_device_id.get(device.id)
+        if prior is not None and prior != idx:
+            # token renamed: the retired token's row must stop validating
+            self._assignment_status[prior] = 0
+            self._assignment_idx[prior] = 0
+        self._idx_by_device_id[device.id] = idx
+        assignment = management.get_active_assignment(device.id)
+        if assignment is None:
+            self._assignment_status[idx] = 0
+            self._tenant_idx[idx] = tenant_idx
+            self._assignment_idx[idx] = 0
+            return
+        self._assignment_status[idx] = int(assignment.status)
+        self._tenant_idx[idx] = tenant_idx
+        area = management.areas.get(assignment.area_id)
+        self._area_idx[idx] = self.areas.intern(area.token) if area else 0
+        dtype = management.device_types.get(device.device_type_id)
+        self._device_type_idx[idx] = (
+            self.device_types.intern(dtype.token) if dtype else 0)
+        self._assignment_idx[idx] = self.assignments.intern(assignment.token)
+
+    def _mirror_zone(self, tenant_idx: int, zone: Zone, active: bool = True) -> None:
+        zidx = self.zones_interner.intern(zone.token) - 1  # row 0 of table = zone idx 1
+        if not (0 <= zidx < self.max_zones):
+            return
+        verts = [(b.latitude, b.longitude) for b in zone.bounds]
+        n = min(len(verts), self.max_zone_vertices)
+        self._zone_active[zidx] = active and n >= 3
+        self._zone_nvert[zidx] = n
+        self._zone_tenant[zidx] = tenant_idx
+        if verts:
+            arr = np.asarray(verts[:n], np.float32)
+            self._zone_vertices[zidx, :n] = arr
+            # pad by repeating last vertex: degenerate edges never toggle the
+            # crossing-number parity in the geofence kernel
+            self._zone_vertices[zidx, n:] = arr[-1]
+        management = self._managements.get(self.tenants.token_of(tenant_idx) or "")
+        if management is not None:
+            area = management.areas.get(zone.area_id)
+            self._zone_area[zidx] = self.areas.intern(area.token) if area else 0
+
+    def _full_rebuild(self, management: DeviceManagement, tenant_idx: int) -> None:
+        with self._lock:
+            for device in management.devices.all():
+                self._mirror_device(management, tenant_idx, device)
+            for zone in management.zones.all():
+                self._mirror_zone(tenant_idx, zone)
+            self._version += 1
+
+    # -- reads ----------------------------------------------------------------
+
+    @property
+    def version(self) -> int:
+        return self._version
+
+    def snapshot(self) -> RegistrySnapshot:
+        with self._lock:
+            return RegistrySnapshot(
+                assignment_status=self._assignment_status.copy(),
+                tenant_idx=self._tenant_idx.copy(),
+                area_idx=self._area_idx.copy(),
+                device_type_idx=self._device_type_idx.copy(),
+                assignment_idx=self._assignment_idx.copy(),
+                zone_vertices=self._zone_vertices.copy(),
+                zone_nvert=self._zone_nvert.copy(),
+                zone_tenant=self._zone_tenant.copy(),
+                zone_area=self._zone_area.copy(),
+                zone_active=self._zone_active.copy(),
+                version=self._version,
+            )

@@ -1,0 +1,393 @@
+"""Partitioned event bus: the in-process data plane replacing Kafka.
+
+Reference: the Kafka topic pipeline (SURVEY.md §1) — topics named
+`{product}.{instance}.tenant.{tenant}.{suffix}` (KafkaTopicNaming.java:81-98),
+per-key partitioning for per-device ordering, consumer groups with committed
+offsets (MicroserviceKafkaConsumer.java:36, offset commit in
+DecodedEventsConsumer.java:194-199), at-least-once delivery, and replay.
+
+Here a Topic is N append-only partitions. Records are (offset, key, value)
+byte pairs; a record's partition is hash(key) % N, preserving per-device
+ordering exactly like the reference's device-token record keys. Consumer
+groups track committed offsets per partition and independently replay.
+Durability is an optional length-prefixed append log per partition, replayed
+on open — the Kafka-replay story the device-state cache depends on
+(SURVEY.md §5 checkpoint/resume) works the same way here.
+
+TPU note: the hot path deliberately does NOT hop through this bus between
+stages the way the reference hops through Kafka between microservices — the
+fused pjit step (pipeline/step.py) replaces those broker round-trips. The bus
+carries the pod-edge flows: ingest -> pipeline, pipeline -> outbound
+connectors / command delivery, plus control-plane topics.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import threading
+import time
+import zlib
+from dataclasses import dataclass
+from typing import Callable, Dict, Iterator, List, Optional, Tuple
+
+
+@dataclass(frozen=True)
+class Record:
+    topic: str
+    partition: int
+    offset: int
+    key: bytes
+    value: bytes
+    timestamp_ms: int
+
+
+class TopicNaming:
+    """Topic name taxonomy (KafkaTopicNaming.java:33-98)."""
+
+    def __init__(self, product: str = "swtpu", instance: str = "default"):
+        self.product = product
+        self.instance = instance
+
+    def _global(self, suffix: str) -> str:
+        return f"{self.product}.{self.instance}.{suffix}"
+
+    def _tenant(self, tenant: str, suffix: str) -> str:
+        return f"{self.product}.{self.instance}.tenant.{tenant}.{suffix}"
+
+    # global topics (KafkaTopicNaming.java:33-43)
+    def microservice_state_updates(self) -> str:
+        return self._global("microservice-state-updates")
+
+    def instance_topology_updates(self) -> str:
+        return self._global("instance-topology-updates")
+
+    def tenant_model_updates(self) -> str:
+        return self._global("tenant-model-updates")
+
+    def instance_logging(self) -> str:
+        return self._global("instance-logging")
+
+    # per-tenant topics (KafkaTopicNaming.java:45-69)
+    def event_source_decoded_events(self, tenant: str) -> str:
+        return self._tenant(tenant, "event-source-decoded-events")
+
+    def event_source_failed_decode_events(self, tenant: str) -> str:
+        return self._tenant(tenant, "event-source-failed-decode-events")
+
+    def inbound_persisted_events(self, tenant: str) -> str:
+        return self._tenant(tenant, "inbound-persisted-events")
+
+    def inbound_enriched_events(self, tenant: str) -> str:
+        return self._tenant(tenant, "inbound-enriched-events")
+
+    def inbound_enriched_command_invocations(self, tenant: str) -> str:
+        return self._tenant(tenant, "inbound-enriched-command-invocations")
+
+    def inbound_device_registration_events(self, tenant: str) -> str:
+        return self._tenant(tenant, "inbound-device-registration-events")
+
+    def inbound_unregistered_device_events(self, tenant: str) -> str:
+        return self._tenant(tenant, "inbound-unregistered-device-events")
+
+    def inbound_reprocess_events(self, tenant: str) -> str:
+        return self._tenant(tenant, "inbound-reprocess-events")
+
+    def undelivered_command_invocations(self, tenant: str) -> str:
+        return self._tenant(tenant, "undelivered-command-invocations")
+
+
+_FRAME = struct.Struct("<IIq")  # key_len, value_len, timestamp_ms
+
+
+class _Partition:
+    """One append-only ordered log. Thread-safe; optionally file-backed."""
+
+    def __init__(self, path: Optional[str] = None):
+        self._records: List[Tuple[int, bytes, bytes, int]] = []  # offset, k, v, ts
+        self._base_offset = 0  # offset of _records[0] after truncation
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._fh = None
+        if path:
+            self._load(path)
+            self._fh = open(path, "ab")
+
+    def _load(self, path: str) -> None:
+        if not os.path.exists(path):
+            return
+        with open(path, "rb") as fh:
+            data = fh.read()
+        pos, offset = 0, 0
+        while pos + _FRAME.size <= len(data):
+            klen, vlen, ts = _FRAME.unpack_from(data, pos)
+            pos += _FRAME.size
+            if pos + klen + vlen > len(data):
+                break  # torn tail write; drop
+            key = data[pos:pos + klen]
+            value = data[pos + klen:pos + klen + vlen]
+            pos += klen + vlen
+            self._records.append((offset, key, value, ts))
+            offset += 1
+
+    def append(self, key: bytes, value: bytes) -> int:
+        ts = int(time.time() * 1000)
+        with self._cv:
+            offset = self._base_offset + len(self._records)
+            self._records.append((offset, key, value, ts))
+            if self._fh is not None:
+                self._fh.write(_FRAME.pack(len(key), len(value), ts))
+                self._fh.write(key)
+                self._fh.write(value)
+            self._cv.notify_all()
+            return offset
+
+    def read(self, from_offset: int, max_records: int) -> List[Tuple[int, bytes, bytes, int]]:
+        with self._lock:
+            start = max(0, from_offset - self._base_offset)
+            return self._records[start:start + max_records]
+
+    def end_offset(self) -> int:
+        with self._lock:
+            return self._base_offset + len(self._records)
+
+    def start_offset(self) -> int:
+        with self._lock:
+            return self._base_offset
+
+    def truncate_before(self, offset: int) -> None:
+        """Drop in-memory records below `offset` (retention)."""
+        with self._lock:
+            drop = offset - self._base_offset
+            if drop > 0:
+                del self._records[:drop]
+                self._base_offset = offset
+
+    def wait_for_data(self, from_offset: int, timeout_s: float) -> bool:
+        with self._cv:
+            if self._base_offset + len(self._records) > from_offset:
+                return True
+            self._cv.wait(timeout_s)
+            return self._base_offset + len(self._records) > from_offset
+
+    def flush(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                self._fh.flush()
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
+
+
+class Topic:
+    def __init__(self, name: str, partitions: int, data_dir: Optional[str] = None):
+        self.name = name
+        paths = [None] * partitions
+        if data_dir:
+            safe = name.replace("/", "_")
+            topic_dir = os.path.join(data_dir, safe)
+            os.makedirs(topic_dir, exist_ok=True)
+            paths = [os.path.join(topic_dir, f"p{i:04d}.log") for i in range(partitions)]
+        self.partitions = [_Partition(p) for p in paths]
+
+    def partition_for(self, key: bytes) -> int:
+        # Stable across processes/restarts (unlike Python hash()).
+        return zlib.crc32(key) % len(self.partitions)
+
+    def publish(self, key: bytes, value: bytes) -> Tuple[int, int]:
+        part = self.partition_for(key)
+        return part, self.partitions[part].append(key, value)
+
+    def end_offsets(self) -> List[int]:
+        return [p.end_offset() for p in self.partitions]
+
+    def flush(self) -> None:
+        for p in self.partitions:
+            p.flush()
+
+    def close(self) -> None:
+        for p in self.partitions:
+            p.close()
+
+
+class ConsumerGroup:
+    """Committed-offset cursor over all partitions of a topic.
+
+    poll() returns the next batch past the *position* (not yet committed);
+    commit() advances the committed offsets — crash/restart replays anything
+    uncommitted, giving at-least-once semantics like the reference's manual
+    offset commits.
+    """
+
+    def __init__(self, topic: Topic, group_id: str,
+                 committed: Optional[List[int]] = None):
+        self.topic = topic
+        self.group_id = group_id
+        n = len(topic.partitions)
+        self.committed = list(committed) if committed else [0] * n
+        if len(self.committed) != n:
+            self.committed = (self.committed + [0] * n)[:n]
+        self.position = list(self.committed)
+        self._lock = threading.Lock()
+
+    def poll(self, max_records: int = 4096, timeout_s: float = 0.0) -> List[Record]:
+        out: List[Record] = []
+        with self._lock:
+            budget = max_records
+            for idx, part in enumerate(self.topic.partitions):
+                if budget <= 0:
+                    break
+                rows = part.read(self.position[idx], budget)
+                for offset, key, value, ts in rows:
+                    out.append(Record(self.topic.name, idx, offset, key, value, ts))
+                if rows:
+                    self.position[idx] = rows[-1][0] + 1
+                    budget -= len(rows)
+        if not out and timeout_s > 0:
+            for idx, part in enumerate(self.topic.partitions):
+                if part.wait_for_data(self.position[idx], timeout_s):
+                    return self.poll(max_records, 0.0)
+            return []
+        return out
+
+    def commit(self) -> None:
+        with self._lock:
+            self.committed = list(self.position)
+
+    def seek_to_committed(self) -> None:
+        with self._lock:
+            self.position = list(self.committed)
+
+    def seek_to_beginning(self) -> None:
+        with self._lock:
+            self.position = [p.start_offset() for p in self.topic.partitions]
+            self.committed = list(self.position)
+
+    def lag(self) -> int:
+        with self._lock:
+            return sum(e - c for e, c in zip(self.topic.end_offsets(), self.committed))
+
+
+class EventBus:
+    """Broker facade: topic registry + consumer-group registry + offsets store.
+
+    Committed group offsets persist to `<data_dir>/_offsets/<topic>@<group>`
+    so restart resumes from the last commit (the reference relies on Kafka's
+    __consumer_offsets for the same thing).
+    """
+
+    def __init__(self, partitions: int = 8, data_dir: Optional[str] = None):
+        self._partitions = partitions
+        self._data_dir = data_dir
+        self._topics: Dict[str, Topic] = {}
+        self._groups: Dict[Tuple[str, str], ConsumerGroup] = {}
+        self._lock = threading.RLock()  # consumer() -> topic() re-enters
+        if data_dir:
+            os.makedirs(os.path.join(data_dir, "_offsets"), exist_ok=True)
+
+    def topic(self, name: str, partitions: Optional[int] = None) -> Topic:
+        with self._lock:
+            if name not in self._topics:
+                self._topics[name] = Topic(name, partitions or self._partitions,
+                                           self._data_dir)
+            return self._topics[name]
+
+    def publish(self, topic_name: str, key: bytes, value: bytes) -> Tuple[int, int]:
+        return self.topic(topic_name).publish(key, value)
+
+    def _offsets_path(self, topic_name: str, group_id: str) -> Optional[str]:
+        if not self._data_dir:
+            return None
+        safe = f"{topic_name}@{group_id}".replace("/", "_")
+        return os.path.join(self._data_dir, "_offsets", safe)
+
+    def consumer(self, topic_name: str, group_id: str) -> ConsumerGroup:
+        with self._lock:
+            key = (topic_name, group_id)
+            if key not in self._groups:
+                committed = None
+                path = self._offsets_path(topic_name, group_id)
+                if path and os.path.exists(path):
+                    with open(path, "r", encoding="utf-8") as fh:
+                        committed = [int(x) for x in fh.read().split()] or None
+                self._groups[key] = ConsumerGroup(self.topic(topic_name), group_id,
+                                                  committed)
+            return self._groups[key]
+
+    def commit(self, group: ConsumerGroup) -> None:
+        group.commit()
+        path = self._offsets_path(group.topic.name, group.group_id)
+        if path:
+            tmp = path + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as fh:
+                fh.write(" ".join(str(o) for o in group.committed))
+            os.replace(tmp, path)
+
+    def topics(self) -> List[str]:
+        with self._lock:
+            return sorted(self._topics)
+
+    def flush(self) -> None:
+        with self._lock:
+            topics = list(self._topics.values())
+        for t in topics:
+            t.flush()
+
+    def close(self) -> None:
+        with self._lock:
+            topics = list(self._topics.values())
+            self._topics.clear()
+        for t in topics:
+            t.close()
+
+
+class ConsumerHost:
+    """Background poll loop driving a handler with batches — the reference's
+    MicroserviceKafkaConsumer single-thread poll loop (:115-121) as a
+    lifecycle-managed thread. Handler exceptions leave offsets uncommitted so
+    the batch redelivers."""
+
+    def __init__(self, bus: EventBus, topic_name: str, group_id: str,
+                 handler: Callable[[List[Record]], None],
+                 max_records: int = 4096, poll_timeout_s: float = 0.2):
+        self._bus = bus
+        self._topic_name = topic_name
+        self._group_id = group_id
+        self._handler = handler
+        self._max_records = max_records
+        self._poll_timeout_s = poll_timeout_s
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.errors = 0
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name=f"consumer-{self._group_id}", daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        consumer = self._bus.consumer(self._topic_name, self._group_id)
+        consumer.seek_to_committed()
+        while not self._stop.is_set():
+            batch = consumer.poll(self._max_records, timeout_s=self._poll_timeout_s)
+            if not batch:
+                continue
+            try:
+                self._handler(batch)
+                self._bus.commit(consumer)
+            except Exception:
+                self.errors += 1
+                consumer.seek_to_committed()
+                time.sleep(0.05)
+
+    def stop(self, timeout_s: float = 5.0) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=timeout_s)
+            self._thread = None

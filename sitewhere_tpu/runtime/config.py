@@ -1,0 +1,182 @@
+"""Layered configuration system.
+
+Replaces the reference's ZooKeeper-resident XML + Spring namespace parsers
+(sitewhere-configuration ConfigurationContentParser.java, ConfigurationMonitor.java:37-90)
+with layered JSON/dict sources: defaults <- instance file <- service section <-
+tenant section <- environment variables, plus a watch thread that live-reloads
+changed files and fires callbacks (the reference restarts components on ZK
+TreeCache change events; here listeners decide what to restart).
+
+Keys are dotted paths, e.g. ``pipeline.batch_size`` or
+``tenants.<tenant>.rules.geofence.max_zones``.
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import os
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+
+def _deep_merge(base: Dict, overlay: Dict) -> Dict:
+    out = dict(base)
+    for key, val in overlay.items():
+        if isinstance(val, dict) and isinstance(out.get(key), dict):
+            out[key] = _deep_merge(out[key], val)
+        else:
+            out[key] = val
+    return out
+
+
+class Configuration:
+    """Layered dotted-key configuration with optional file watching."""
+
+    ENV_PREFIX = "SWTPU_"  # SWTPU_PIPELINE__BATCH_SIZE=4096 -> pipeline.batch_size
+
+    def __init__(self, defaults: Optional[Dict] = None,
+                 config_path: Optional[str] = None,
+                 use_env: bool = True):
+        self._defaults = copy.deepcopy(defaults or {})
+        self._config_path = config_path
+        self._use_env = use_env
+        self._overrides: Dict = {}
+        self._listeners: List[Callable[["Configuration"], None]] = []
+        self._lock = threading.RLock()
+        self._watcher: Optional[threading.Thread] = None
+        self._watch_stop = threading.Event()
+        self._file_mtime: Optional[float] = None
+        self._merged: Dict = {}
+        self._rebuild()
+
+    # -- layering ------------------------------------------------------------
+
+    def _load_file(self) -> Dict:
+        if not self._config_path or not os.path.exists(self._config_path):
+            return {}
+        with open(self._config_path, "r", encoding="utf-8") as fh:
+            return json.load(fh)
+
+    def _load_env(self) -> Dict:
+        out: Dict = {}
+        if not self._use_env:
+            return out
+        for key, val in os.environ.items():
+            if not key.startswith(self.ENV_PREFIX):
+                continue
+            path = key[len(self.ENV_PREFIX):].lower().split("__")
+            node = out
+            for part in path[:-1]:
+                node = node.setdefault(part, {})
+            try:
+                node[path[-1]] = json.loads(val)
+            except (ValueError, json.JSONDecodeError):
+                node[path[-1]] = val
+        return out
+
+    def _rebuild(self) -> None:
+        with self._lock:
+            merged = self._defaults
+            merged = _deep_merge(merged, self._load_file())
+            merged = _deep_merge(merged, self._load_env())
+            merged = _deep_merge(merged, self._overrides)
+            self._merged = merged
+
+    # -- access --------------------------------------------------------------
+
+    def get(self, dotted_key: str, default: Any = None) -> Any:
+        with self._lock:
+            node: Any = self._merged
+            for part in dotted_key.split("."):
+                if not isinstance(node, dict) or part not in node:
+                    return default
+                node = node[part]
+            return node
+
+    def section(self, dotted_key: str) -> Dict:
+        val = self.get(dotted_key, {})
+        return copy.deepcopy(val) if isinstance(val, dict) else {}
+
+    def tenant_section(self, tenant_token: str, dotted_key: str = "") -> Dict:
+        """Per-tenant overlay (reference: per-tenant ZK config subtree)."""
+        base = self.section(dotted_key) if dotted_key else {}
+        suffix = f".{dotted_key}" if dotted_key else ""
+        overlay = self.section(f"tenants.{tenant_token}{suffix}")
+        return _deep_merge(base, overlay)
+
+    def set(self, dotted_key: str, value: Any) -> None:
+        """Programmatic override (highest-priority layer); fires listeners."""
+        with self._lock:
+            node = self._overrides
+            parts = dotted_key.split(".")
+            for part in parts[:-1]:
+                node = node.setdefault(part, {})
+            node[parts[-1]] = value
+            self._rebuild()
+        self._fire()
+
+    def snapshot(self) -> Dict:
+        with self._lock:
+            return copy.deepcopy(self._merged)
+
+    # -- change notification -------------------------------------------------
+
+    def add_listener(self, callback: Callable[["Configuration"], None]) -> None:
+        self._listeners.append(callback)
+
+    def _fire(self) -> None:
+        for callback in list(self._listeners):
+            callback(self)
+
+    def start_watching(self, interval_s: float = 2.0) -> None:
+        """Poll the config file for mtime changes and live-reload (reference:
+        ConfigurationMonitor TreeCache watch)."""
+        if self._watcher or not self._config_path:
+            return
+        self._watch_stop.clear()
+
+        def _watch() -> None:
+            while not self._watch_stop.wait(interval_s):
+                try:
+                    mtime = os.path.getmtime(self._config_path)
+                except OSError:
+                    continue
+                if self._file_mtime is None:
+                    self._file_mtime = mtime
+                    continue
+                if mtime != self._file_mtime:
+                    self._file_mtime = mtime
+                    self._rebuild()
+                    self._fire()
+
+        if os.path.exists(self._config_path):
+            self._file_mtime = os.path.getmtime(self._config_path)
+        self._watcher = threading.Thread(target=_watch, name="config-watch", daemon=True)
+        self._watcher.start()
+
+    def stop_watching(self) -> None:
+        self._watch_stop.set()
+        if self._watcher:
+            self._watcher.join(timeout=5)
+            self._watcher = None
+
+
+DEFAULTS: Dict = {
+    "instance": {"id": "swtpu1", "product_id": "sitewhere-tpu"},
+    "pipeline": {
+        "batch_size": 8192,
+        "max_devices": 131072,
+        "max_zones": 256,
+        "max_zone_vertices": 32,
+        "max_threshold_rules": 256,
+        "max_measurement_names": 1024,
+        "max_tenants": 16,
+        "presence_missing_interval_ms": 8 * 60 * 60 * 1000,  # reference default 8h
+    },
+    "bus": {"partitions": 8, "retention_chunks": 64, "chunk_events": 65536},
+    "persist": {"data_dir": "./swtpu-data"},
+    "api": {"host": "127.0.0.1", "port": 8080, "jwt_secret": "change-me",
+            "jwt_expiration_min": 600},
+    "mesh": {"shards": 1},
+}

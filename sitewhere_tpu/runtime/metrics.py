@@ -1,0 +1,163 @@
+"""Metrics registry: counters, meters (rates), timers with percentiles.
+
+Reference: Dropwizard metrics registry per microservice (Microservice.java:146),
+per-component timers/meters created via
+TenantEngineLifecycleComponent.createTimerMetric (used on the hot path at
+InboundPayloadProcessingLogic.java:76-81). Here: a lock-cheap in-proc registry;
+timers keep a bounded reservoir for p50/p95/p99.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+
+class Counter:
+    __slots__ = ("value", "_lock")
+
+    def __init__(self) -> None:
+        self.value = 0
+        self._lock = threading.Lock()
+
+    def inc(self, n: int = 1) -> None:
+        with self._lock:
+            self.value += n
+
+
+class Meter:
+    """Event rate: total count + exponentially-weighted 1-minute rate."""
+
+    def __init__(self) -> None:
+        self.count = 0
+        self._rate = 0.0
+        self._last = time.monotonic()
+        self._lock = threading.Lock()
+
+    def mark(self, n: int = 1) -> None:
+        with self._lock:
+            now = time.monotonic()
+            dt = now - self._last
+            self.count += n
+            if dt > 0:
+                inst = n / dt
+                alpha = min(1.0, dt / 60.0)
+                self._rate += alpha * (inst - self._rate)
+                self._last = now
+
+    @property
+    def one_minute_rate(self) -> float:
+        return self._rate
+
+
+class Timer:
+    """Duration histogram with a sliding reservoir (last `capacity` samples)."""
+
+    def __init__(self, capacity: int = 2048) -> None:
+        self._samples: List[float] = []
+        self._capacity = capacity
+        self._idx = 0
+        self.count = 0
+        self.total = 0.0
+        self._lock = threading.Lock()
+
+    def update(self, seconds: float) -> None:
+        with self._lock:
+            self.count += 1
+            self.total += seconds
+            if len(self._samples) < self._capacity:
+                self._samples.append(seconds)
+            else:
+                self._samples[self._idx] = seconds
+                self._idx = (self._idx + 1) % self._capacity
+
+    class _Ctx:
+        def __init__(self, timer: "Timer"):
+            self._timer = timer
+
+        def __enter__(self) -> "Timer._Ctx":
+            self._start = time.perf_counter()
+            return self
+
+        def __exit__(self, *exc) -> None:
+            self._timer.update(time.perf_counter() - self._start)
+
+    def time(self) -> "Timer._Ctx":
+        return Timer._Ctx(self)
+
+    def percentile(self, q: float) -> float:
+        with self._lock:
+            if not self._samples:
+                return 0.0
+            ordered = sorted(self._samples)
+            k = min(len(ordered) - 1, max(0, int(round(q * (len(ordered) - 1)))))
+            return ordered[k]
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            count, total = self.count, self.total
+        return {
+            "count": count,
+            "mean_s": (total / count) if count else 0.0,
+            "p50_s": self.percentile(0.50),
+            "p95_s": self.percentile(0.95),
+            "p99_s": self.percentile(0.99),
+        }
+
+
+class MetricsRegistry:
+    """Named metric registry; names are prefixed by component/tenant scope the
+    way TenantEngineLifecycleComponent prefixes metric names."""
+
+    def __init__(self) -> None:
+        self._counters: Dict[str, Counter] = {}
+        self._meters: Dict[str, Meter] = {}
+        self._timers: Dict[str, Timer] = {}
+        self._lock = threading.Lock()
+
+    def counter(self, name: str) -> Counter:
+        with self._lock:
+            return self._counters.setdefault(name, Counter())
+
+    def meter(self, name: str) -> Meter:
+        with self._lock:
+            return self._meters.setdefault(name, Meter())
+
+    def timer(self, name: str) -> Timer:
+        with self._lock:
+            return self._timers.setdefault(name, Timer())
+
+    def scoped(self, prefix: str) -> "ScopedMetrics":
+        return ScopedMetrics(self, prefix)
+
+    def report(self) -> Dict[str, Dict]:
+        """Serializable snapshot (reference: Slf4j reporter every 20s)."""
+        with self._lock:
+            counters = dict(self._counters)
+            meters = dict(self._meters)
+            timers = dict(self._timers)
+        return {
+            "counters": {k: v.value for k, v in counters.items()},
+            "meters": {k: {"count": v.count, "m1_rate": v.one_minute_rate}
+                       for k, v in meters.items()},
+            "timers": {k: v.snapshot() for k, v in timers.items()},
+        }
+
+
+class ScopedMetrics:
+    def __init__(self, registry: MetricsRegistry, prefix: str):
+        self._registry = registry
+        self._prefix = prefix
+
+    def counter(self, name: str) -> Counter:
+        return self._registry.counter(f"{self._prefix}.{name}")
+
+    def meter(self, name: str) -> Meter:
+        return self._registry.meter(f"{self._prefix}.{name}")
+
+    def timer(self, name: str) -> Timer:
+        return self._registry.timer(f"{self._prefix}.{name}")
+
+
+GLOBAL_METRICS = MetricsRegistry()

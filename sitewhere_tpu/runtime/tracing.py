@@ -1,0 +1,93 @@
+"""Lightweight distributed-tracing spans.
+
+Reference: OpenTracing + Jaeger spans around lifecycle ops and gRPC calls
+(sitewhere-grpc-model tracing/ServerTracingInterceptor.java,
+TracerUtils.java:17-37). Here: in-proc span tree with a ring-buffer exporter
+that the REST API can dump; `jax.profiler` traces cover the on-device side
+(pipeline exposes start_device_trace/stop_device_trace).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+import uuid
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Deque, Dict, List, Optional
+
+
+@dataclass
+class Span:
+    trace_id: str
+    span_id: str
+    parent_id: Optional[str]
+    operation: str
+    start_ms: float
+    end_ms: Optional[float] = None
+    tags: Dict[str, str] = field(default_factory=dict)
+    logs: List[str] = field(default_factory=list)
+
+    @property
+    def duration_ms(self) -> float:
+        return ((self.end_ms or time.time() * 1000) - self.start_ms)
+
+    def to_dict(self) -> Dict:
+        return {
+            "traceId": self.trace_id, "spanId": self.span_id,
+            "parentId": self.parent_id, "operation": self.operation,
+            "startMs": self.start_ms, "durationMs": self.duration_ms,
+            "tags": dict(self.tags), "logs": list(self.logs),
+        }
+
+
+class Tracer:
+    """Thread-local active-span stack + bounded finished-span buffer."""
+
+    def __init__(self, capacity: int = 4096):
+        self._finished: Deque[Span] = deque(maxlen=capacity)
+        self._local = threading.local()
+        self._lock = threading.Lock()
+
+    def _stack(self) -> List[Span]:
+        if not hasattr(self._local, "stack"):
+            self._local.stack = []
+        return self._local.stack
+
+    @contextlib.contextmanager
+    def span(self, operation: str, **tags: str):
+        stack = self._stack()
+        parent = stack[-1] if stack else None
+        span = Span(
+            trace_id=parent.trace_id if parent else uuid.uuid4().hex[:16],
+            span_id=uuid.uuid4().hex[:16],
+            parent_id=parent.span_id if parent else None,
+            operation=operation,
+            start_ms=time.time() * 1000,
+            tags={k: str(v) for k, v in tags.items()},
+        )
+        stack.append(span)
+        try:
+            yield span
+        except BaseException as exc:
+            span.tags["error"] = "true"
+            span.logs.append(repr(exc))
+            raise
+        finally:
+            span.end_ms = time.time() * 1000
+            stack.pop()
+            with self._lock:
+                self._finished.append(span)
+
+    def active(self) -> Optional[Span]:
+        stack = self._stack()
+        return stack[-1] if stack else None
+
+    def finished(self, limit: int = 100) -> List[Dict]:
+        with self._lock:
+            spans = list(self._finished)[-limit:]
+        return [s.to_dict() for s in spans]
+
+
+GLOBAL_TRACER = Tracer()

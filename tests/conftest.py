@@ -1,0 +1,35 @@
+"""Test harness: run everything on a virtual 8-device CPU mesh.
+
+SURVEY.md §4 consequence: unlike the reference (no multi-node harness, live
+brokers required), every test here is deterministic and in-proc — sharding is
+exercised on `--xla_force_host_platform_device_count=8` CPU devices standing
+in for a v5e-8 slice. Must set env vars before jax is imported anywhere.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # override the session's axon/TPU platform
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+# The image pre-imports jax at interpreter startup (before conftest runs), so
+# the env var alone is too late; the backend is still uninitialized though, so
+# the config override takes effect.
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)
+
+
+@pytest.fixture
+def tmp_data_dir(tmp_path):
+    return str(tmp_path / "swtpu-data")

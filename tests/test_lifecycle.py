@@ -1,0 +1,94 @@
+"""Lifecycle state machine tests (reference semantics:
+LifecycleComponent.java transitions, nested components, error states)."""
+
+import pytest
+
+from sitewhere_tpu.errors import LifecycleError
+from sitewhere_tpu.runtime.lifecycle import (
+    CompositeLifecycleStep, LifecycleComponent, LifecycleStatus,
+)
+
+
+class Recorder(LifecycleComponent):
+    def __init__(self, name, log, fail_on=None):
+        super().__init__(name)
+        self._log = log
+        self._fail_on = fail_on or set()
+
+    def on_initialize(self, monitor):
+        if "initialize" in self._fail_on:
+            raise RuntimeError("boom-init")
+        self._log.append(f"{self.name}:init")
+
+    def on_start(self, monitor):
+        if "start" in self._fail_on:
+            raise RuntimeError("boom-start")
+        self._log.append(f"{self.name}:start")
+
+    def on_stop(self, monitor):
+        self._log.append(f"{self.name}:stop")
+
+
+def test_nested_start_order_and_reverse_stop():
+    log = []
+    parent = Recorder("parent", log)
+    child_a = parent.add_nested(Recorder("a", log))
+    parent.add_nested(Recorder("b", log))
+    parent.start()
+    assert parent.status == LifecycleStatus.STARTED
+    assert child_a.status == LifecycleStatus.STARTED
+    assert log == ["parent:init", "a:init", "b:init",
+                   "parent:start", "a:start", "b:start"]
+    log.clear()
+    parent.stop()
+    assert log == ["b:stop", "a:stop", "parent:stop"]
+    assert parent.status == LifecycleStatus.STOPPED
+
+
+def test_nested_failure_marks_started_with_errors():
+    log = []
+    parent = Recorder("parent", log)
+    parent.add_nested(Recorder("bad", log, fail_on={"start"}))
+    parent.start()
+    assert parent.status == LifecycleStatus.STARTED_WITH_ERRORS
+
+
+def test_init_failure_raises_and_sets_error_state():
+    bad = Recorder("bad", [], fail_on={"initialize"})
+    with pytest.raises(LifecycleError):
+        bad.initialize()
+    assert bad.status == LifecycleStatus.INITIALIZATION_ERROR
+
+
+def test_restart_cycles_state():
+    log = []
+    c = Recorder("c", log)
+    c.start()
+    c.restart()
+    assert c.status == LifecycleStatus.STARTED
+    assert log.count("c:stop") == 1
+    assert log.count("c:start") == 2
+
+
+def test_tenant_scope_propagates_to_nested():
+    parent = LifecycleComponent("p")
+    parent.tenant_id = "acme"
+    child = parent.add_nested(LifecycleComponent("c"))
+    assert child.tenant_id == "acme"
+
+
+def test_find_by_name_and_state_tree():
+    parent = LifecycleComponent("p")
+    child = parent.add_nested(LifecycleComponent("c"))
+    assert parent.find("c") is child
+    tree = parent.state_tree()
+    assert tree["nested"][0]["name"] == "c"
+
+
+def test_composite_step_runs_in_order():
+    log = []
+    step = CompositeLifecycleStep("boot")
+    step.add("one", lambda: log.append(1))
+    step.add("two", lambda: log.append(2))
+    step.execute()
+    assert log == [1, 2]

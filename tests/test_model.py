@@ -1,0 +1,57 @@
+"""Domain model unit tests: paging, entity basics, event types."""
+
+from sitewhere_tpu.model import (
+    Device, DeviceAlert, DeviceAssignment, DeviceEventType, DeviceLocation,
+    DeviceMeasurement, DeviceType, SearchCriteria, Zone,
+)
+from sitewhere_tpu.model.common import Location, Pager, page
+
+
+def test_pager_pages_and_counts():
+    criteria = SearchCriteria(page_number=2, page_size=10)
+    results = page(list(range(35)), criteria)
+    assert results.num_results == 35
+    assert results.results == list(range(10, 20))
+
+
+def test_pager_incremental_matches_page():
+    criteria = SearchCriteria(page_number=1, page_size=3)
+    pager = Pager(criteria)
+    for item in "abcdefg":
+        pager.process(item)
+    out = pager.results()
+    assert out.num_results == 7
+    assert out.results == ["a", "b", "c"]
+
+
+def test_entity_identity_and_touch():
+    device = Device(token="dev-1", device_type_id="t1")
+    assert device.id and device.created_date > 0
+    assert device.updated_date is None
+    device.touch("admin")
+    assert device.updated_date is not None
+    assert device.updated_by == "admin"
+
+
+def test_event_types_are_stable_ints():
+    # These codes are baked into packed tensors; they must never change.
+    assert DeviceEventType.MEASUREMENT == 0
+    assert DeviceEventType.LOCATION == 1
+    assert DeviceEventType.ALERT == 2
+    assert DeviceMeasurement(name="temp", value=1.5).event_type == 0
+    assert DeviceLocation(latitude=1.0).event_type == 1
+    assert DeviceAlert(type="x").event_type == 2
+
+
+def test_event_to_dict_round_trip():
+    m = DeviceMeasurement(name="temp", value=21.5, device_id="d1")
+    d = m.to_dict()
+    assert d["name"] == "temp"
+    assert d["value"] == 21.5
+    assert d["eventType"] == "MEASUREMENT"
+
+
+def test_zone_holds_polygon():
+    zone = Zone(token="z1", bounds=[Location(0, 0), Location(0, 1), Location(1, 1)])
+    assert len(zone.bounds) == 3
+    assert zone.bounds[1].longitude == 1

@@ -1,0 +1,348 @@
+"""Kernel unit tests vs NumPy/Python references (SURVEY.md §4: deterministic
+kernel tests replacing the reference's live-infrastructure-only testing)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sitewhere_tpu.model.event import DeviceEventType
+from sitewhere_tpu.ops.geofence import (
+    GeofenceCondition, GeofenceRuleTable, ZoneTable, empty_geofence_table,
+    eval_geofence_rules, points_in_zones,
+)
+from sitewhere_tpu.ops.pack import EventPacker, empty_batch
+from sitewhere_tpu.ops.segments import count_by_key, last_by_key, scatter_max_by_key
+from sitewhere_tpu.ops.threshold import (
+    ThresholdOp, empty_threshold_table, eval_threshold_rules,
+)
+from sitewhere_tpu.registry.interning import TokenInterner
+
+
+# ---------------------------------------------------------------------------
+# geofence
+# ---------------------------------------------------------------------------
+
+def ref_point_in_polygon(px, py, verts):
+    """Crossing-number reference implementation (pure Python)."""
+    inside = False
+    n = len(verts)
+    for i in range(n):
+        y1, x1 = verts[i]
+        y2, x2 = verts[(i + 1) % n]
+        if (y1 > py) != (y2 > py):
+            x_at = x1 + (x2 - x1) * (py - y1) / (y2 - y1)
+            if px < x_at:
+                inside = not inside
+    return inside
+
+
+def pad_zone(verts, V):
+    arr = np.asarray(verts, np.float32)
+    out = np.zeros((V, 2), np.float32)
+    out[:len(verts)] = arr
+    out[len(verts):] = arr[-1]
+    return out
+
+
+class TestPointsInZones:
+    def test_square_containment(self):
+        square = [(0, 0), (0, 2), (2, 2), (2, 0)]  # (lat, lon)
+        vertices = pad_zone(square, 8)[None]
+        lat = jnp.array([1.0, 3.0, -0.5, 1.999], jnp.float32)
+        lon = jnp.array([1.0, 1.0, 1.0, 1.999], jnp.float32)
+        inside = np.asarray(points_in_zones(lat, lon, jnp.asarray(vertices)))
+        assert inside[:, 0].tolist() == [True, False, False, True]
+
+    def test_concave_polygon_matches_reference(self, rng):
+        # L-shaped (concave) polygon
+        poly = [(0, 0), (0, 3), (1, 3), (1, 1), (3, 1), (3, 0)]
+        V = 8
+        vertices = jnp.asarray(pad_zone(poly, V)[None])
+        pts = rng.uniform(-0.5, 3.5, size=(200, 2)).astype(np.float32)
+        inside = np.asarray(points_in_zones(
+            jnp.asarray(pts[:, 0]), jnp.asarray(pts[:, 1]), vertices))[:, 0]
+        expected = np.array([ref_point_in_polygon(p[1], p[0], poly) for p in pts])
+        assert (inside == expected).all()
+
+    def test_many_random_polygons_match_reference(self, rng):
+        Z, V, B = 16, 12, 128
+        zones = []
+        for _ in range(Z):
+            n = rng.integers(3, V + 1)
+            # random star-shaped polygon around a random center
+            center = rng.uniform(0, 10, 2)
+            angles = np.sort(rng.uniform(0, 2 * np.pi, n))
+            radii = rng.uniform(0.5, 3.0, n)
+            verts = [(center[0] + r * np.sin(a), center[1] + r * np.cos(a))
+                     for a, r in zip(angles, radii)]
+            zones.append(verts)
+        vertices = jnp.asarray(np.stack([pad_zone(z, V) for z in zones]))
+        pts = rng.uniform(-2, 12, size=(B, 2)).astype(np.float32)
+        got = np.asarray(points_in_zones(
+            jnp.asarray(pts[:, 0]), jnp.asarray(pts[:, 1]), vertices))
+        for zi, verts in enumerate(zones):
+            expected = np.array(
+                [ref_point_in_polygon(p[1], p[0], verts) for p in pts])
+            assert (got[:, zi] == expected).all(), f"zone {zi}"
+
+    def test_padding_is_inert(self):
+        square = [(0, 0), (0, 2), (2, 2), (2, 0)]
+        v8 = jnp.asarray(pad_zone(square, 8)[None])
+        v32 = jnp.asarray(pad_zone(square, 32)[None])
+        lat = jnp.asarray(np.linspace(-1, 3, 50, dtype=np.float32))
+        lon = jnp.asarray(np.linspace(-1, 3, 50, dtype=np.float32))
+        a = np.asarray(points_in_zones(lat, lon, v8))
+        b = np.asarray(points_in_zones(lat, lon, v32))
+        assert (a == b).all()
+
+
+class TestGeofenceRules:
+    def _batch_with_locations(self, lats, lons, tenant=1):
+        B = len(lats)
+        batch = empty_batch(B)
+        batch = batch.replace(
+            device_idx=np.arange(1, B + 1, dtype=np.int32),
+            tenant_idx=np.full(B, tenant, np.int32),
+            event_type=np.full(B, DeviceEventType.LOCATION, np.int32),
+            lat=np.asarray(lats, np.float32), lon=np.asarray(lons, np.float32),
+            valid=np.ones(B, bool))
+        return batch
+
+    def _zone_table(self):
+        square = [(0, 0), (0, 2), (2, 2), (2, 0)]
+        return ZoneTable(
+            vertices=np.asarray(pad_zone(square, 8)[None]),
+            nvert=np.array([4], np.int32),
+            tenant_idx=np.array([1], np.int32),
+            active=np.array([True]))
+
+    def test_outside_condition_fires(self):
+        batch = self._batch_with_locations([1.0, 5.0], [1.0, 5.0])
+        rules = empty_geofence_table(4)
+        rules.active[0] = True
+        rules.zone_row[0] = 0
+        rules.condition[0] = GeofenceCondition.OUTSIDE
+        rules.alert_level[0] = 2
+        out = eval_geofence_rules(batch, self._zone_table(), rules)
+        assert np.asarray(out["fired"]).tolist() == [False, True]
+        assert np.asarray(out["alert_level"])[1] == 2
+
+    def test_inside_condition_fires(self):
+        batch = self._batch_with_locations([1.0, 5.0], [1.0, 5.0])
+        rules = empty_geofence_table(4)
+        rules.active[0] = True
+        rules.condition[0] = GeofenceCondition.INSIDE
+        out = eval_geofence_rules(batch, self._zone_table(), rules)
+        assert np.asarray(out["fired"]).tolist() == [True, False]
+
+    def test_tenant_scoping(self):
+        batch = self._batch_with_locations([5.0], [5.0], tenant=2)
+        rules = empty_geofence_table(4)
+        rules.active[0] = True
+        rules.condition[0] = GeofenceCondition.OUTSIDE
+        out = eval_geofence_rules(batch, self._zone_table(), rules)
+        # zone belongs to tenant 1; tenant 2's event can't violate it
+        assert not np.asarray(out["fired"])[0]
+
+    def test_non_location_events_ignored(self):
+        batch = self._batch_with_locations([5.0], [5.0])
+        batch = batch.replace(event_type=np.full(1, DeviceEventType.MEASUREMENT,
+                                                 np.int32))
+        rules = empty_geofence_table(4)
+        rules.active[0] = True
+        rules.condition[0] = GeofenceCondition.OUTSIDE
+        out = eval_geofence_rules(batch, self._zone_table(), rules)
+        assert not np.asarray(out["fired"])[0]
+
+
+# ---------------------------------------------------------------------------
+# threshold
+# ---------------------------------------------------------------------------
+
+class TestThreshold:
+    def _batch(self, values, mm_idx=1, tenant=1):
+        B = len(values)
+        batch = empty_batch(B)
+        return batch.replace(
+            device_idx=np.arange(1, B + 1, dtype=np.int32),
+            tenant_idx=np.full(B, tenant, np.int32),
+            event_type=np.full(B, DeviceEventType.MEASUREMENT, np.int32),
+            mm_idx=np.full(B, mm_idx, np.int32),
+            value=np.asarray(values, np.float32),
+            valid=np.ones(B, bool))
+
+    def test_all_operators_match_numpy(self, rng):
+        values = rng.uniform(-10, 10, 64).astype(np.float32)
+        batch = self._batch(values)
+        table = empty_threshold_table(8)
+        ops = [ThresholdOp.GT, ThresholdOp.GTE, ThresholdOp.LT,
+               ThresholdOp.LTE, ThresholdOp.EQ, ThresholdOp.NEQ]
+        for i, op in enumerate(ops):
+            table.active[i] = True
+            table.op[i] = op
+            table.threshold[i] = 0.0
+        out = eval_threshold_rules(batch, table,
+                                   jnp.zeros(64, jnp.int32))
+        count = np.asarray(out["fired_count"])
+        expected = ((values > 0).astype(int) + (values >= 0) + (values < 0)
+                    + (values <= 0) + (values == 0) + (values != 0))
+        assert (count == expected).all()
+
+    def test_measurement_name_scoping(self):
+        batch = self._batch([5.0], mm_idx=2)
+        table = empty_threshold_table(4)
+        table.active[0] = True
+        table.mm_idx[0] = 3  # different measurement
+        table.op[0] = ThresholdOp.GT
+        table.threshold[0] = 0.0
+        out = eval_threshold_rules(batch, table, jnp.zeros(1, jnp.int32))
+        assert not np.asarray(out["fired"])[0]
+        table.mm_idx[0] = 0  # any measurement
+        out = eval_threshold_rules(batch, table, jnp.zeros(1, jnp.int32))
+        assert np.asarray(out["fired"])[0]
+
+    def test_first_rule_and_level(self):
+        batch = self._batch([5.0])
+        table = empty_threshold_table(4)
+        for i, level in [(1, 3), (2, 1)]:
+            table.active[i] = True
+            table.op[i] = ThresholdOp.GT
+            table.threshold[i] = 0.0
+            table.alert_level[i] = level
+        out = eval_threshold_rules(batch, table, jnp.zeros(1, jnp.int32))
+        assert np.asarray(out["first_rule"])[0] == 1
+        assert np.asarray(out["alert_level"])[0] == 3
+
+    def test_invalid_rows_never_fire(self):
+        batch = self._batch([5.0, 5.0])
+        batch = batch.replace(valid=np.array([True, False]))
+        table = empty_threshold_table(2)
+        table.active[0] = True
+        table.op[0] = ThresholdOp.GT
+        out = eval_threshold_rules(batch, table, jnp.zeros(2, jnp.int32))
+        assert np.asarray(out["fired"]).tolist() == [True, False]
+
+
+# ---------------------------------------------------------------------------
+# keyed reductions
+# ---------------------------------------------------------------------------
+
+class TestSegments:
+    def test_last_by_key_matches_dict_reference(self, rng):
+        B, D = 256, 32
+        keys = rng.integers(0, D, B).astype(np.int32)
+        ts = rng.integers(0, 1000, B).astype(np.int32)
+        valid = rng.random(B) > 0.2
+        values = rng.uniform(-5, 5, B).astype(np.float32)
+        state_ts = np.full(D, -(2 ** 31), np.int32)
+        state_val = np.zeros(D, np.float32)
+
+        new_ts, (new_val,) = last_by_key(
+            jnp.asarray(keys), jnp.asarray(ts), jnp.asarray(valid), D,
+            jnp.asarray(state_ts), (jnp.asarray(state_val),),
+            (jnp.asarray(values),))
+
+        ref_ts = state_ts.copy()
+        ref_val = state_val.copy()
+        for i in range(B):  # batch order; later position wins ties
+            if valid[i] and ts[i] >= ref_ts[keys[i]]:
+                ref_ts[keys[i]] = ts[i]
+                ref_val[keys[i]] = values[i]
+        assert (np.asarray(new_ts) == ref_ts).all()
+        assert np.allclose(np.asarray(new_val), ref_val)
+
+    def test_last_by_key_ignores_stale_batch(self):
+        D = 4
+        state_ts = jnp.asarray(np.array([100, -(2 ** 31), 100, 100], np.int32))
+        state_val = jnp.asarray(np.array([1.0, 0, 1, 1], np.float32))
+        keys = jnp.asarray(np.array([0, 1], np.int32))
+        ts = jnp.asarray(np.array([50, 50], np.int32))  # older than state for key 0
+        valid = jnp.asarray(np.array([True, True]))
+        values = jnp.asarray(np.array([9.0, 9.0], np.float32))
+        new_ts, (new_val,) = last_by_key(keys, ts, valid, D, state_ts,
+                                         (state_val,), (values,))
+        assert np.asarray(new_val)[0] == 1.0  # stale update dropped
+        assert np.asarray(new_val)[1] == 9.0  # fresh key updated
+        assert np.asarray(new_ts)[1] == 50
+
+    def test_last_by_key_multicolumn_state(self, rng):
+        B, D = 64, 8
+        keys = rng.integers(0, D, B).astype(np.int32)
+        ts = np.arange(B, dtype=np.int32)  # strictly increasing
+        valid = np.ones(B, bool)
+        vecs = rng.uniform(size=(B, 3)).astype(np.float32)
+        state_ts = np.full(D, -(2 ** 31), np.int32)
+        state = np.zeros((D, 3), np.float32)
+        new_ts, (new_state,) = last_by_key(
+            jnp.asarray(keys), jnp.asarray(ts), jnp.asarray(valid), D,
+            jnp.asarray(state_ts), (jnp.asarray(state),), (jnp.asarray(vecs),))
+        for d in range(D):
+            rows = np.nonzero(keys == d)[0]
+            if rows.size:
+                assert np.allclose(np.asarray(new_state)[d], vecs[rows[-1]])
+
+    def test_scatter_max(self, rng):
+        B, D = 128, 16
+        keys = rng.integers(0, D, B).astype(np.int32)
+        values = rng.integers(0, 10 ** 6, B).astype(np.int32)
+        valid = rng.random(B) > 0.3
+        state = np.full(D, -(2 ** 31), np.int32)
+        out = scatter_max_by_key(jnp.asarray(keys), jnp.asarray(values),
+                                 jnp.asarray(valid), D, jnp.asarray(state))
+        ref = state.copy()
+        for i in range(B):
+            if valid[i]:
+                ref[keys[i]] = max(ref[keys[i]], values[i])
+        assert (np.asarray(out) == ref).all()
+
+    def test_count_by_key(self, rng):
+        B, D = 100, 10
+        keys = rng.integers(0, D, B).astype(np.int32)
+        valid = rng.random(B) > 0.5
+        out = count_by_key(jnp.asarray(keys), jnp.asarray(valid), D)
+        ref = np.bincount(keys[valid], minlength=D)
+        assert (np.asarray(out) == ref).all()
+
+
+# ---------------------------------------------------------------------------
+# packing
+# ---------------------------------------------------------------------------
+
+class TestPacker:
+    def test_pack_events_into_fixed_batches(self):
+        from sitewhere_tpu.model import DeviceLocation, DeviceMeasurement
+        devices = TokenInterner(64)
+        devices.intern("d1")
+        packer = EventPacker(batch_size=4, device_interner=devices)
+        events = [DeviceMeasurement(name="temp", value=float(i)) for i in range(6)]
+        events.append(DeviceLocation(latitude=1.0, longitude=2.0))
+        batches = packer.pack_events(events, ["d1"] * 7)
+        assert len(batches) == 2
+        assert batches[0].valid.sum() == 4
+        assert batches[1].valid.sum() == 3
+        assert batches[0].device_idx[0] == 1
+        assert batches[1].lat[2] == 1.0
+        # unknown device packs as index 0
+        batches2 = packer.pack_events(events[:1], ["unknown"])
+        assert batches2[0].device_idx[0] == 0
+
+    def test_timestamps_rebased(self):
+        devices = TokenInterner(8)
+        packer = EventPacker(batch_size=2, device_interner=devices,
+                             epoch_base_ms=1_000_000)
+        assert packer.rel_ts(1_000_500) == 500
+        assert packer.abs_ts(500) == 1_000_500
+
+    def test_pack_columns_pads(self):
+        devices = TokenInterner(8)
+        packer = EventPacker(batch_size=8, device_interner=devices,
+                             epoch_base_ms=0)
+        batch = packer.pack_columns(
+            np.array([1, 2], np.int32),
+            np.zeros(2, np.int32),
+            np.array([10, 20], np.int64),
+            value=np.array([1.5, 2.5], np.float32))
+        assert batch.valid.tolist() == [True, True] + [False] * 6
+        assert batch.value[1] == 2.5
+        assert batch.ts[1] == 20

@@ -1,0 +1,170 @@
+"""End-to-end slice tests: registry -> packed batch -> fused step -> alerts +
+device-state (the minimum end-to-end slice of SURVEY.md §7 step 3)."""
+
+import time
+
+import numpy as np
+import pytest
+
+from sitewhere_tpu.model import (
+    AlertLevel, Area, Device, DeviceAssignment, DeviceLocation,
+    DeviceMeasurement, DeviceType, PresenceState, Zone,
+)
+from sitewhere_tpu.model.common import Location
+from sitewhere_tpu.pipeline.engine import GeofenceRule, PipelineEngine, ThresholdRule
+from sitewhere_tpu.registry import DeviceManagement, RegistryTensors
+
+
+@pytest.fixture
+def world():
+    dm = DeviceManagement()
+    dtype = dm.create_device_type(DeviceType(token="tracker", name="Tracker"))
+    area = dm.create_area(Area(token="plant", name="Plant"))
+    dm.create_zone(Zone(token="safe", area_id=area.id, bounds=[
+        Location(0, 0), Location(0, 10), Location(10, 10), Location(10, 0)]))
+    tensors = RegistryTensors(max_devices=256, max_zones=16, max_zone_vertices=16)
+    tensors.attach(dm, "acme")
+    for i in range(10):
+        device = dm.create_device(Device(token=f"dev-{i}", device_type_id=dtype.id))
+        dm.create_device_assignment(DeviceAssignment(
+            token=f"as-{i}", device_id=device.id, area_id=area.id))
+    engine = PipelineEngine(tensors, batch_size=64, measurement_slots=8,
+                            max_tenants=4, max_threshold_rules=16,
+                            max_geofence_rules=16)
+    engine.start()
+    return dm, tensors, engine
+
+
+def _submit_events(engine, events, tokens):
+    batches = engine.packer.pack_events(events, tokens)
+    outs = [engine.submit(b) for b in batches]
+    return batches, outs
+
+
+class TestEndToEnd:
+    def test_measurement_flow_updates_state(self, world):
+        _, _, engine = world
+        now = int(time.time() * 1000)
+        events = [DeviceMeasurement(name="temp", value=20.0 + i, event_date=now + i)
+                  for i in range(5)]
+        _, outs = _submit_events(engine, events, ["dev-3"] * 5)
+        assert int(outs[0].processed) == 5
+        state = engine.get_device_state("dev-3")
+        assert state is not None
+        assert state.last_measurements["temp"][1] == 24.0
+        assert state.presence == PresenceState.PRESENT
+        assert state.last_interaction_date is not None
+
+    def test_threshold_rule_fires_and_materializes_alert(self, world):
+        _, _, engine = world
+        engine.add_threshold_rule(ThresholdRule(
+            token="overheat", measurement_name="temp", operator=">",
+            threshold=90.0, alert_level=AlertLevel.CRITICAL,
+            alert_message="too hot"))
+        events = [DeviceMeasurement(name="temp", value=v)
+                  for v in [50.0, 95.0, 91.0]]
+        batches, outs = _submit_events(engine, events, ["dev-0", "dev-1", "dev-2"])
+        assert int(outs[0].alerts) == 2
+        alerts = engine.materialize_alerts(batches[0], outs[0])
+        assert len(alerts) == 2
+        assert {a.device_id for a in alerts} == {"dev-1", "dev-2"}
+        assert alerts[0].level == AlertLevel.CRITICAL
+        assert alerts[0].message == "too hot"
+
+    def test_geofence_rule_fires_on_exit(self, world):
+        _, _, engine = world
+        engine.add_geofence_rule(GeofenceRule(
+            token="leave-safe", zone_token="safe", condition="outside",
+            alert_level=AlertLevel.ERROR))
+        events = [DeviceLocation(latitude=5.0, longitude=5.0),
+                  DeviceLocation(latitude=50.0, longitude=50.0)]
+        batches, outs = _submit_events(engine, events, ["dev-0", "dev-1"])
+        fired = np.asarray(outs[0].geofence_fired)
+        assert fired[:2].tolist() == [False, True]
+        alerts = engine.materialize_alerts(batches[0], outs[0])
+        assert len(alerts) == 1
+        assert alerts[0].device_id == "dev-1"
+        assert alerts[0].type == "zone.violation"
+        state = engine.get_device_state("dev-1")
+        assert state.last_location[1] == 50.0
+
+    def test_unregistered_device_rejected(self, world):
+        _, _, engine = world
+        events = [DeviceMeasurement(name="temp", value=1.0)]
+        batches, outs = _submit_events(engine, events, ["ghost"])
+        assert int(outs[0].processed) == 0
+        assert np.asarray(outs[0].unregistered)[0]
+
+    def test_released_assignment_invalidates_device(self, world):
+        dm, _, engine = world
+        dm.release_device_assignment("as-5")
+        events = [DeviceMeasurement(name="temp", value=1.0)]
+        _, outs = _submit_events(engine, events, ["dev-5"])
+        assert int(outs[0].processed) == 0
+
+    def test_registry_change_picked_up_without_recompile(self, world):
+        dm, _, engine = world
+        dtype = dm.get_device_type_by_token("tracker")
+        area = dm.get_area_by_token("plant")
+        device = dm.create_device(Device(token="dev-new", device_type_id=dtype.id))
+        dm.create_device_assignment(DeviceAssignment(
+            token="as-new", device_id=device.id, area_id=area.id))
+        events = [DeviceMeasurement(name="temp", value=1.0)]
+        _, outs = _submit_events(engine, events, ["dev-new"])
+        assert int(outs[0].processed) == 1
+
+    def test_presence_sweep_marks_missing(self, world):
+        _, _, engine = world
+        now = int(time.time() * 1000)
+        events = [DeviceMeasurement(name="temp", value=1.0,
+                                    event_date=now - 60_000)]
+        _submit_events(engine, events, ["dev-7"])
+        engine.presence_missing_interval_ms = 10_000  # 10s
+        missing = engine.presence_sweep()
+        assert "dev-7" in missing
+        state = engine.get_device_state("dev-7")
+        assert state.presence == PresenceState.NOT_PRESENT
+        # second sweep: send-once, not re-reported
+        assert "dev-7" not in engine.presence_sweep()
+        # new event restores presence
+        _submit_events(engine, [DeviceMeasurement(name="temp", value=2.0,
+                                                  event_date=now)], ["dev-7"])
+        assert engine.get_device_state("dev-7").presence == PresenceState.PRESENT
+
+    def test_multi_tenant_counters(self, world):
+        dm, tensors, engine = world
+        dm2 = DeviceManagement()
+        dtype2 = dm2.create_device_type(DeviceType(token="t2"))
+        device2 = dm2.create_device(Device(token="b-dev", device_type_id=dtype2.id))
+        dm2.create_device_assignment(DeviceAssignment(token="b-as",
+                                                      device_id=device2.id))
+        tensors.attach(dm2, "globex")
+        _, outs = _submit_events(
+            engine,
+            [DeviceMeasurement(name="m", value=1.0),
+             DeviceMeasurement(name="m", value=2.0)],
+            ["dev-0", "b-dev"])
+        counts = np.asarray(outs[0].tenant_counts)
+        acme = tensors.tenants.lookup("acme")
+        globex = tensors.tenants.lookup("globex")
+        assert counts[acme] == 1
+        assert counts[globex] == 1
+
+    def test_rule_with_unknown_tenant_token_is_inert(self, world):
+        """A scoping token that doesn't resolve must deactivate the rule, not
+        silently widen to every tenant."""
+        _, _, engine = world
+        engine.add_threshold_rule(ThresholdRule(
+            token="scoped", measurement_name="temp", operator=">",
+            threshold=0.0, tenant_token="no-such-tenant"))
+        _, outs = _submit_events(
+            engine, [DeviceMeasurement(name="temp", value=50.0)], ["dev-0"])
+        assert int(outs[0].alerts) == 0
+
+    def test_stats_accumulate(self, world):
+        _, _, engine = world
+        _submit_events(engine, [DeviceMeasurement(name="m", value=1.0)], ["dev-0"])
+        _submit_events(engine, [DeviceMeasurement(name="m", value=1.0)], ["dev-0"])
+        stats = engine.stats()
+        assert stats["batches"] == 2
+        assert sum(stats["tenant_event_count"]) == 2

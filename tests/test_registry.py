@@ -1,0 +1,199 @@
+"""Registry tests: CRUD surface, sqlite durability, interning, tensor mirror."""
+
+import numpy as np
+import pytest
+
+from sitewhere_tpu.errors import DuplicateTokenError, NotFoundError, SiteWhereError
+from sitewhere_tpu.model import (
+    Area, Device, DeviceAssignment, DeviceAssignmentStatus, DeviceGroup,
+    DeviceGroupElement, DeviceType, Zone,
+)
+from sitewhere_tpu.model.common import Location, SearchCriteria
+from sitewhere_tpu.registry import (
+    DeviceManagement, RegistryTensors, SqliteStore, TokenInterner,
+)
+
+
+def make_registry(store=None):
+    dm = DeviceManagement(store)
+    dtype = dm.create_device_type(DeviceType(token="sensor-v1", name="Sensor"))
+    area = dm.create_area(Area(token="plant-1", name="Plant 1"))
+    return dm, dtype, area
+
+
+def register(dm, dtype, area, token):
+    device = dm.create_device(Device(token=token, device_type_id=dtype.id))
+    assignment = dm.create_device_assignment(
+        DeviceAssignment(token=f"as-{token}", device_id=device.id, area_id=area.id))
+    return device, assignment
+
+
+class TestDeviceManagement:
+    def test_device_crud_and_duplicate_token(self):
+        dm, dtype, area = make_registry()
+        device, _ = register(dm, dtype, area, "d1")
+        assert dm.get_device_by_token("d1").id == device.id
+        with pytest.raises(DuplicateTokenError):
+            dm.create_device(Device(token="d1", device_type_id=dtype.id))
+
+    def test_single_active_assignment_enforced(self):
+        dm, dtype, area = make_registry()
+        device, assignment = register(dm, dtype, area, "d1")
+        with pytest.raises(SiteWhereError):
+            dm.create_device_assignment(
+                DeviceAssignment(token="as2", device_id=device.id))
+        dm.release_device_assignment(assignment.token)
+        assert dm.get_active_assignment(device.id) is None
+        dm.create_device_assignment(DeviceAssignment(token="as2",
+                                                     device_id=device.id))
+
+    def test_delete_guards(self):
+        dm, dtype, area = make_registry()
+        device, assignment = register(dm, dtype, area, "d1")
+        with pytest.raises(SiteWhereError):
+            dm.delete_device("d1")  # active assignment
+        with pytest.raises(SiteWhereError):
+            dm.delete_device_type("sensor-v1")  # in use
+        dm.release_device_assignment(assignment.token)
+        dm.delete_device("d1")
+        dm.delete_device_type("sensor-v1")
+
+    def test_listing_with_paging_and_filters(self):
+        dm, dtype, area = make_registry()
+        for i in range(25):
+            register(dm, dtype, area, f"d{i:02d}")
+        page2 = dm.list_devices(SearchCriteria(page_number=2, page_size=10))
+        assert page2.num_results == 25
+        assert len(page2.results) == 10
+        assigned = dm.list_devices(assigned=True)
+        assert assigned.num_results == 25
+
+    def test_group_expansion_recursive(self):
+        dm, dtype, area = make_registry()
+        d1, _ = register(dm, dtype, area, "d1")
+        d2, _ = register(dm, dtype, area, "d2")
+        outer = dm.create_device_group(DeviceGroup(token="outer"))
+        inner = dm.create_device_group(DeviceGroup(token="inner"))
+        dm.add_device_group_elements("inner", [DeviceGroupElement(device_id=d2.id)])
+        dm.add_device_group_elements("outer", [
+            DeviceGroupElement(device_id=d1.id),
+            DeviceGroupElement(nested_group_id=inner.id)])
+        tokens = {d.token for d in dm.expand_group_devices("outer")}
+        assert tokens == {"d1", "d2"}
+
+    def test_not_found_raises(self):
+        dm, _, _ = make_registry()
+        with pytest.raises(NotFoundError):
+            dm.get_device_type_by_token("nope")
+
+
+class TestSqliteDurability:
+    def test_reopen_preserves_entities_and_assignment_state(self, tmp_path):
+        path = str(tmp_path / "registry.db")
+        dm, dtype, area = make_registry(SqliteStore(path))
+        register(dm, dtype, area, "d1")
+        dm.store.close()
+
+        dm2 = DeviceManagement(SqliteStore(path))
+        device = dm2.get_device_by_token("d1")
+        assert device is not None
+        active = dm2.get_active_assignment(device.id)
+        assert active is not None
+        assert active.status == DeviceAssignmentStatus.ACTIVE
+        assert dm2.get_device_type_by_token("sensor-v1").name == "Sensor"
+
+
+class TestInterner:
+    def test_intern_stable_and_zero_reserved(self):
+        interner = TokenInterner(100)
+        a = interner.intern("a")
+        assert a == 1
+        assert interner.intern("a") == a
+        assert interner.lookup("missing") == 0
+        assert interner.token_of(a) == "a"
+        assert interner.token_of(0) is None
+
+    def test_batch_lookup(self):
+        interner = TokenInterner(100)
+        interner.intern("x")
+        interner.intern("y")
+        out = interner.lookup_batch(["y", "missing", "x"])
+        assert out.tolist() == [2, 0, 1]
+        assert out.dtype == np.int32
+
+    def test_capacity_enforced(self):
+        interner = TokenInterner(3)
+        interner.intern("a")
+        interner.intern("b")
+        with pytest.raises(SiteWhereError):
+            interner.intern("c")
+
+    def test_snapshot_restore(self):
+        interner = TokenInterner(10)
+        interner.intern("a")
+        interner.intern("b")
+        snap = interner.snapshot()
+        other = TokenInterner(10)
+        other.restore(snap)
+        assert other.lookup("b") == 2
+
+
+class TestRegistryTensors:
+    def test_mirror_reflects_assignment_lifecycle(self):
+        dm, dtype, area = make_registry()
+        tensors = RegistryTensors(max_devices=64, max_zones=8, max_zone_vertices=8)
+        tensors.attach(dm, "acme")
+        device, assignment = register(dm, dtype, area, "d1")
+        idx = tensors.devices.lookup("d1")
+        snap = tensors.snapshot()
+        assert idx > 0
+        assert snap.assignment_status[idx] == int(DeviceAssignmentStatus.ACTIVE)
+        assert snap.tenant_idx[idx] == tensors.tenants.lookup("acme")
+        assert snap.area_idx[idx] == tensors.areas.lookup("plant-1")
+
+        dm.release_device_assignment(assignment.token)
+        snap2 = tensors.snapshot()
+        assert snap2.assignment_status[idx] == 0
+        assert snap2.version > snap.version
+
+    def test_zone_compiled_with_padding(self):
+        dm, dtype, area = make_registry()
+        tensors = RegistryTensors(max_devices=16, max_zones=4, max_zone_vertices=8)
+        tensors.attach(dm, "acme")
+        dm.create_zone(Zone(token="z1", area_id=area.id, bounds=[
+            Location(0, 0), Location(0, 2), Location(2, 2), Location(2, 0)]))
+        snap = tensors.snapshot()
+        row = tensors.zones_interner.lookup("z1") - 1
+        assert snap.zone_active[row]
+        assert snap.zone_nvert[row] == 4
+        # padding repeats last vertex
+        assert (snap.zone_vertices[row, 4:] == snap.zone_vertices[row, 3]).all()
+
+    def test_token_rename_retires_old_row(self):
+        dm, dtype, area = make_registry()
+        tensors = RegistryTensors(max_devices=32, max_zones=4, max_zone_vertices=8)
+        tensors.attach(dm, "acme")
+        register(dm, dtype, area, "old-name")
+        old_idx = tensors.devices.lookup("old-name")
+        dm.update_device("old-name", {"token": "new-name"})
+        snap = tensors.snapshot()
+        assert snap.assignment_status[old_idx] == 0  # retired token rejected
+        new_idx = tensors.devices.lookup("new-name")
+        assert snap.assignment_status[new_idx] == int(DeviceAssignmentStatus.ACTIVE)
+
+    def test_update_rejects_unknown_field_atomically(self):
+        dm, dtype, area = make_registry()
+        device, _ = register(dm, dtype, area, "d1")
+        with pytest.raises(SiteWhereError):
+            dm.update_device("d1", {"comments": "changed", "bogus": 1})
+        assert dm.get_device_by_token("d1").comments == ""  # untouched
+
+    def test_degenerate_zone_inactive(self):
+        dm, dtype, area = make_registry()
+        tensors = RegistryTensors(max_devices=16, max_zones=4, max_zone_vertices=8)
+        tensors.attach(dm, "acme")
+        dm.create_zone(Zone(token="line", area_id=area.id,
+                            bounds=[Location(0, 0), Location(1, 1)]))
+        snap = tensors.snapshot()
+        row = tensors.zones_interner.lookup("line") - 1
+        assert not snap.zone_active[row]
