@@ -1,0 +1,20 @@
+"""Persistence tier: columnar event log, event-management API, checkpoints.
+
+Reference layer L3 (SURVEY.md §2.3): the reference persists events through
+pluggable stores (MongoDB bulk-insert buffer, HBase, Cassandra bucket tables,
+InfluxDB series) behind `IDeviceEventManagement`. Here the single TPU-native
+store is an append-only *columnar* event log (Arrow/Parquet segments): events
+arrive already packed as SoA tensors on the hot path, so persistence is a
+column append — no per-event serialization — and analytics read the same
+columns back as tensors (sitewhere_tpu.analytics).
+"""
+
+from sitewhere_tpu.persist.eventlog import ColumnarEventLog, EventFilter
+from sitewhere_tpu.persist.event_management import (
+    DeviceEventManagement, EventIndex, EventPersistenceTriggers)
+from sitewhere_tpu.persist.checkpoint import PipelineCheckpointer
+
+__all__ = [
+    "ColumnarEventLog", "EventFilter", "DeviceEventManagement", "EventIndex",
+    "EventPersistenceTriggers", "PipelineCheckpointer",
+]

@@ -1,0 +1,154 @@
+"""Checkpoint/restore of HBM device-state + replay recovery.
+
+Reference: SiteWhere has *no* snapshotting — durable truth lives in the
+datastores and Kafka offsets, and a restarted service replays from committed
+offsets (SURVEY.md §5; offset commit at DecodedEventsConsumer.java:194-199).
+Here the HBM DeviceStateTensors are exactly such a rebuildable cache: the
+checkpointer snapshots them (plus the interner tables and packer epoch that
+give the indices meaning, plus the bus committed offsets) so recovery is
+  restore latest checkpoint -> replay bus records past the saved offsets
+instead of a full-history replay.
+
+Format: a directory per checkpoint (`ckpt-<n>/`) holding one .npz of all
+state arrays + a JSON manifest; written to a temp dir and atomically renamed,
+so a crash mid-write never corrupts the latest checkpoint. (orbax serves the
+same role for model training; this state is a handful of dense arrays, so a
+direct npz keeps restore dependency-free and fast.)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import shutil
+from typing import Any, Dict, List, Optional
+
+import jax
+import numpy as np
+
+from sitewhere_tpu.pipeline.state_tensors import DeviceStateTensors
+
+
+class PipelineCheckpointer:
+    """Snapshot/restore a PipelineEngine's recoverable state."""
+
+    def __init__(self, directory: str, keep: int = 3):
+        self.directory = directory
+        self.keep = keep
+        os.makedirs(directory, exist_ok=True)
+
+    # -- save --------------------------------------------------------------
+    def save(self, engine, bus=None,
+             consumer_groups: Optional[List] = None) -> str:
+        """Write a new checkpoint; returns its path.
+
+        `consumer_groups` are bus ConsumerGroup objects whose committed
+        offsets should be captured (the replay cursor).
+
+        Offsets are captured BEFORE the state arrays: a commit racing the
+        snapshot then yields offsets <= state, i.e. at worst a duplicate
+        replay (at-least-once, like the reference's Kafka semantics);
+        offsets ahead of state would silently LOSE events."""
+        captured_offsets = {
+            f"{g.topic.name}@{g.group_id}": list(g.committed)
+            for g in consumer_groups or []
+        }
+        state = engine.state
+        arrays = {
+            f"state.{f.name}": np.asarray(getattr(state, f.name))
+            for f in dataclasses.fields(state)
+        }
+        packer = engine.packer
+        manifest: Dict[str, Any] = {
+            "epoch_base_ms": packer.epoch_base_ms,
+            "interners": {
+                "devices": packer.devices.snapshot(),
+                "measurements": packer.measurements.snapshot(),
+                "alert_types": packer.alert_types.snapshot(),
+            },
+            "offsets": captured_offsets,
+        }
+        seq = self._next_seq()
+        final = os.path.join(self.directory, f"ckpt-{seq:08d}")
+        tmp = final + ".tmp"
+        os.makedirs(tmp, exist_ok=True)
+        np.savez_compressed(os.path.join(tmp, "state.npz"), **arrays)
+        with open(os.path.join(tmp, "manifest.json"), "w",
+                  encoding="utf-8") as fh:
+            json.dump(manifest, fh)
+        os.replace(tmp, final)
+        self._gc()
+        return final
+
+    def _next_seq(self) -> int:
+        existing = [int(n.split("-")[1]) for n in os.listdir(self.directory)
+                    if n.startswith("ckpt-") and not n.endswith(".tmp")]
+        return (max(existing) + 1) if existing else 0
+
+    def _gc(self) -> None:
+        ckpts = sorted(n for n in os.listdir(self.directory)
+                       if n.startswith("ckpt-") and not n.endswith(".tmp"))
+        for stale in ckpts[:-self.keep]:
+            shutil.rmtree(os.path.join(self.directory, stale),
+                          ignore_errors=True)
+
+    # -- restore -----------------------------------------------------------
+    def latest(self) -> Optional[str]:
+        ckpts = sorted(n for n in os.listdir(self.directory)
+                       if n.startswith("ckpt-") and not n.endswith(".tmp"))
+        return os.path.join(self.directory, ckpts[-1]) if ckpts else None
+
+    def restore(self, engine, path: Optional[str] = None) -> Dict[str, List[int]]:
+        """Load a checkpoint into the engine; returns the saved bus offsets
+        keyed `topic@group` so the caller can seed replay consumers."""
+        path = path or self.latest()
+        if path is None:
+            return {}
+        with np.load(os.path.join(path, "state.npz")) as data:
+            kwargs = {
+                f.name: jax.numpy.asarray(data[f"state.{f.name}"])
+                for f in dataclasses.fields(DeviceStateTensors)
+            }
+        engine.set_state(DeviceStateTensors(**kwargs))
+        with open(os.path.join(path, "manifest.json"), encoding="utf-8") as fh:
+            manifest = json.load(fh)
+        packer = engine.packer
+        packer.epoch_base_ms = manifest["epoch_base_ms"]
+        packer.devices.restore(manifest["interners"]["devices"])
+        packer.measurements.restore(manifest["interners"]["measurements"])
+        packer.alert_types.restore(manifest["interners"]["alert_types"])
+        return manifest.get("offsets", {})
+
+    # -- recovery ----------------------------------------------------------
+    def recover(self, engine, bus, topic: str, group_id: str,
+                replay_handler, max_records: int = 4096) -> int:
+        """Restore the latest checkpoint, then replay every bus record past
+        the checkpointed offsets through `replay_handler(records)` until
+        caught up. Returns the number of replayed records.
+
+        This is the crash-recovery contract of SURVEY.md §5: HBM state is a
+        cache; checkpoint + at-least-once replay rebuilds it."""
+        offsets = self.restore(engine)
+        consumer = bus.consumer(topic, group_id)
+        saved = offsets.get(f"{topic}@{group_id}")
+        if saved is None:
+            # Checkpoint carries no cursor for this group: the only safe
+            # at-least-once choice is a full replay of the retained log —
+            # the bus's own committed offsets may be AHEAD of the
+            # checkpointed state (committed after save), which would lose
+            # those events.
+            consumer.seek_to_beginning()
+        else:
+            n = len(consumer.topic.partitions)
+            consumer.committed = (list(saved) + [0] * n)[:n]
+            consumer.seek_to_committed()
+        replayed = 0
+        while True:
+            batch = consumer.poll(max_records)
+            if not batch:
+                break
+            replay_handler(batch)
+            bus.commit(consumer)
+            replayed += len(batch)
+        return replayed

@@ -1,0 +1,672 @@
+"""Append-only columnar event log: the TPU-native event store.
+
+Reference: the MongoDB event store with its bulk-insert buffer
+(service-event-management/…/mongodb/MongoDeviceEventManagement.java:65,
+DeviceEventBuffer.java:34 — 10k queue, batched writer thread, 200/chunk,
+250 ms linger) and the time-bucketed Cassandra/HBase event tables.
+
+Design (TPU-first): events on the hot path already live as SoA columns
+(ops/pack.py EventBatch), so the store keeps them columnar end to end:
+
+  append (columns or API objects) -> in-memory column buffer
+    -> background flusher (chunk size + linger, like DeviceEventBuffer)
+    -> immutable Arrow record-batch segment, optionally spilled to Parquet
+
+Queries run as vectorized predicate scans over segments (numpy masks over
+column arrays — the same shape of work the TPU rule kernels do), newest
+first with offset/limit paging, and materialize model dataclasses only for
+the requested page. Analytics (sitewhere_tpu/analytics) reads the raw
+columns without materialization.
+
+One unified nullable schema covers every DeviceEventType — the same trade
+the reference's GDeviceEventPayload union makes, resolved as nullable
+columns instead of a protobuf oneof.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+from sitewhere_tpu.model.common import (
+    DateRangeCriteria, SearchCriteria, SearchResults, new_id)
+from sitewhere_tpu.model.event import (
+    AlertLevel, AlertSource, CommandInitiator, CommandTarget, DeviceAlert,
+    DeviceCommandInvocation, DeviceCommandResponse, DeviceEvent,
+    DeviceEventType, DeviceLocation, DeviceMeasurement, DeviceStateChange,
+    DeviceStreamData)
+
+# Unified event schema. String/object fields are nullable; numeric hot-path
+# columns are dense. `device_idx`/`mm_idx`/`alert_type_idx` mirror the interned
+# tensor indices so analytics can go straight back to tensors.
+_SCHEMA = pa.schema([
+    ("id", pa.string()),
+    ("alternate_id", pa.string()),
+    ("event_type", pa.int32()),
+    ("device_idx", pa.int32()),
+    ("device_token", pa.string()),
+    ("assignment_token", pa.string()),
+    ("customer_id", pa.string()),
+    ("area_id", pa.string()),
+    ("asset_id", pa.string()),
+    ("event_date", pa.int64()),      # absolute ms
+    ("received_date", pa.int64()),   # absolute ms
+    ("mm_idx", pa.int32()),
+    ("mm_name", pa.string()),
+    ("value", pa.float32()),
+    ("latitude", pa.float32()),
+    ("longitude", pa.float32()),
+    ("elevation", pa.float32()),
+    ("alert_source", pa.int32()),
+    ("alert_level", pa.int32()),
+    ("alert_type_idx", pa.int32()),
+    ("alert_type", pa.string()),
+    ("alert_message", pa.string()),
+    ("initiator", pa.int32()),
+    ("initiator_id", pa.string()),
+    ("target", pa.int32()),
+    ("target_id", pa.string()),
+    ("command_token", pa.string()),
+    ("parameters", pa.string()),     # json map
+    ("originating_event_id", pa.string()),
+    ("response_event_id", pa.string()),
+    ("response", pa.string()),
+    ("attribute", pa.string()),
+    ("state_type", pa.string()),
+    ("previous_state", pa.string()),
+    ("new_state", pa.string()),
+    ("stream_id", pa.string()),
+    ("sequence_number", pa.int64()),
+    ("stream_data", pa.binary()),
+    ("metadata", pa.string()),       # json map
+])
+
+_COLUMNS = [f.name for f in _SCHEMA]
+_ID_PREFIX = uuid.uuid4().hex[:10]  # process-unique; see append_batch ids
+_INT_COLS = {f.name for f in _SCHEMA if pa.types.is_integer(f.type)}
+_FLOAT_COLS = {f.name for f in _SCHEMA if pa.types.is_floating(f.type)}
+
+
+@dataclass
+class EventFilter:
+    """Predicate for event queries (the reference's per-index list rpcs +
+    ISearchCriteria date range, device-event-management.proto:37-93)."""
+
+    event_type: Optional[DeviceEventType] = None
+    device_idx: Optional[int] = None
+    device_token: Optional[str] = None
+    assignment_token: Optional[str] = None
+    area_id: Optional[str] = None
+    customer_id: Optional[str] = None
+    asset_id: Optional[str] = None
+    start_date: Optional[int] = None   # ms, inclusive
+    end_date: Optional[int] = None     # ms, inclusive
+    id: Optional[str] = None
+    alternate_id: Optional[str] = None
+    mm_name: Optional[str] = None
+    originating_event_id: Optional[str] = None
+    stream_id: Optional[str] = None
+
+    def _mask(self, cols: Dict[str, np.ndarray]) -> np.ndarray:
+        n = len(cols["event_date"])
+        mask = np.ones(n, bool)
+        if self.event_type is not None:
+            mask &= cols["event_type"] == int(self.event_type)
+        if self.device_idx is not None:
+            mask &= cols["device_idx"] == self.device_idx
+        if self.start_date is not None:
+            mask &= cols["event_date"] >= self.start_date
+        if self.end_date is not None:
+            mask &= cols["event_date"] <= self.end_date
+        for attr, col in (("device_token", "device_token"),
+                          ("assignment_token", "assignment_token"),
+                          ("area_id", "area_id"),
+                          ("customer_id", "customer_id"),
+                          ("asset_id", "asset_id"),
+                          ("id", "id"),
+                          ("alternate_id", "alternate_id"),
+                          ("mm_name", "mm_name"),
+                          ("originating_event_id", "originating_event_id"),
+                          ("stream_id", "stream_id")):
+            want = getattr(self, attr)
+            if want is not None:
+                mask &= cols[col] == want
+        return mask
+
+
+class _Segment:
+    """Immutable flushed chunk: numpy column dict + min/max event_date for
+    segment pruning (the reference's Cassandra time buckets serve the same
+    skip-scan purpose)."""
+
+    __slots__ = ("cols", "n", "min_date", "max_date")
+
+    def __init__(self, cols: Dict[str, np.ndarray]):
+        self.cols = cols
+        self.n = len(cols["event_date"])
+        dates = cols["event_date"]
+        self.min_date = int(dates.min()) if self.n else 0
+        self.max_date = int(dates.max()) if self.n else 0
+
+    def to_arrow(self) -> pa.Table:
+        arrays = []
+        for fld in _SCHEMA:
+            col = self.cols[fld.name]
+            if fld.name == "stream_data":
+                arrays.append(pa.array(list(col), type=pa.binary()))
+            else:
+                arrays.append(pa.array(col, type=fld.type))
+        return pa.Table.from_arrays(arrays, schema=_SCHEMA)
+
+    @classmethod
+    def from_arrow(cls, table: pa.Table) -> "_Segment":
+        cols: Dict[str, np.ndarray] = {}
+        for fld in _SCHEMA:
+            arr = table.column(fld.name)
+            if fld.name in _INT_COLS or fld.name in _FLOAT_COLS:
+                np_dtype = arr.type.to_pandas_dtype()
+                cols[fld.name] = np.asarray(
+                    arr.fill_null(0).to_numpy(zero_copy_only=False),
+                    dtype=np_dtype)
+            else:
+                cols[fld.name] = np.asarray(arr.to_pylist(), dtype=object)
+        return cls(cols)
+
+
+class _ColumnBuffer:
+    """Mutable append buffer; column-major lists of row-chunks."""
+
+    def __init__(self) -> None:
+        self.chunks: List[Dict[str, np.ndarray]] = []
+        self.n = 0
+
+    def append(self, cols: Dict[str, np.ndarray], n: int) -> None:
+        self.chunks.append(cols)
+        self.n += n
+
+    def _merge(self) -> Dict[str, np.ndarray]:
+        return {
+            name: np.concatenate([c[name] for c in self.chunks])
+            for name in _COLUMNS
+        }
+
+    def drain(self) -> Optional[_Segment]:
+        if not self.chunks:
+            return None
+        seg = _Segment(self._merge())
+        self.chunks = []
+        self.n = 0
+        return seg
+
+    def peek(self) -> Optional[_Segment]:
+        """Transient view of buffered rows for scans — does NOT seal a
+        segment, so trickle-rate tenants don't fragment the log."""
+        if not self.chunks:
+            return None
+        return _Segment(self._merge())
+
+
+def _obj_col(n: int, value: Any = None) -> np.ndarray:
+    out = np.empty(n, object)
+    out[:] = value
+    return out
+
+
+def _full_cols(n: int, **given: np.ndarray) -> Dict[str, np.ndarray]:
+    """Build a complete column dict; unspecified columns default to 0/None."""
+    cols: Dict[str, np.ndarray] = {}
+    for name in _COLUMNS:
+        if name in given:
+            cols[name] = given[name]
+        elif name in _INT_COLS:
+            cols[name] = np.zeros(n, np.int64 if name in
+                                  ("event_date", "received_date",
+                                   "sequence_number") else np.int32)
+        elif name in _FLOAT_COLS:
+            cols[name] = np.zeros(n, np.float32)
+        else:
+            cols[name] = _obj_col(n)
+    return cols
+
+
+class TenantEventLog:
+    """One tenant's log: buffer + segments (+ optional Parquet spill dir)."""
+
+    def __init__(self, tenant: str, data_dir: Optional[str],
+                 segment_rows: int, spill: bool):
+        self.tenant = tenant
+        self.segment_rows = segment_rows
+        self._buffer = _ColumnBuffer()
+        self._segments: List[_Segment] = []
+        self._seg_paths: List[Optional[str]] = []
+        self._lock = threading.Lock()
+        self._dir = None
+        self._spill = spill and data_dir is not None
+        self._next_seg = 0
+        if data_dir is not None:
+            self._dir = os.path.join(data_dir, tenant.replace("/", "_"))
+            os.makedirs(self._dir, exist_ok=True)
+            self._load()
+
+    def _load(self) -> None:
+        names = sorted(f for f in os.listdir(self._dir)
+                       if f.endswith(".parquet"))
+        for name in names:
+            path = os.path.join(self._dir, name)
+            self._segments.append(_Segment.from_arrow(pq.read_table(path)))
+            self._seg_paths.append(path)
+            seq = int(name.split("-")[1].split(".")[0])
+            self._next_seg = max(self._next_seg, seq + 1)
+
+    def append(self, cols: Dict[str, np.ndarray], n: int) -> None:
+        """Buffer only — never touches disk, so the ingest hot path pays a
+        list append. Sealing happens on the linger thread (flush_if_full) or
+        an explicit flush(); scans see buffered rows via peek()."""
+        with self._lock:
+            self._buffer.append(cols, n)
+
+    def flush_if_full(self) -> None:
+        """Seal only when a full segment's worth is buffered — the linger
+        loop calls this, so trickle-rate appends never fragment into tiny
+        parquet files. Durability for the un-sealed tail rides the event bus
+        log (at-least-once replay rebuilds it), the same trade the reference
+        makes with DeviceEventBuffer's in-memory 10k queue."""
+        self._seal(only_if_full=True)
+
+    def flush(self) -> None:
+        self._seal(only_if_full=False)
+
+    def _seal(self, only_if_full: bool) -> None:
+        """Drain buffer -> immutable segment under the lock; write Parquet
+        OUTSIDE the lock so concurrent appends/scans never stall on disk."""
+        with self._lock:
+            if only_if_full and self._buffer.n < self.segment_rows:
+                return
+            seg = self._buffer.drain()
+            if seg is None:
+                return
+            self._segments.append(seg)
+            path = None
+            if self._spill:
+                path = os.path.join(self._dir,
+                                    f"events-{self._next_seg:06d}.parquet")
+                self._next_seg += 1
+            self._seg_paths.append(path)
+        if path is not None:
+            tmp = path + ".tmp"
+            pq.write_table(seg.to_arrow(), tmp)
+            os.replace(tmp, path)
+
+    def scan(self, flt: EventFilter) -> Iterator[Tuple[Dict[str, np.ndarray], np.ndarray]]:
+        """Yield (cols, selected_row_indices) per segment, newest segment
+        first (global ordering is the caller's job — see query())."""
+        with self._lock:
+            segments = list(self._segments)
+            pending = self._buffer.peek()
+        if pending is not None:
+            segments.append(pending)
+        for seg in reversed(segments):
+            if flt.start_date is not None and seg.max_date < flt.start_date:
+                continue
+            if flt.end_date is not None and seg.min_date > flt.end_date:
+                continue
+            idx = np.nonzero(flt._mask(seg.cols))[0]
+            if len(idx):
+                yield seg.cols, idx
+
+    def count(self) -> int:
+        with self._lock:
+            return self._buffer.n + sum(s.n for s in self._segments)
+
+
+class ColumnarEventLog:
+    """Multi-tenant event store facade.
+
+    Appends accept either packed `EventBatch` columns (hot path — vectorized,
+    no per-event Python) or model dataclasses (control plane). Both land in
+    the same unified schema.
+    """
+
+    def __init__(self, data_dir: Optional[str] = None,
+                 segment_rows: int = 65536, linger_ms: int = 250,
+                 spill_parquet: bool = True):
+        self._data_dir = data_dir
+        self._segment_rows = segment_rows
+        self._linger_ms = linger_ms
+        self._spill = spill_parquet
+        self._tenants: Dict[str, TenantEventLog] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._flusher: Optional[threading.Thread] = None
+        if data_dir:
+            os.makedirs(data_dir, exist_ok=True)
+            for name in sorted(os.listdir(data_dir)):
+                if os.path.isdir(os.path.join(data_dir, name)):
+                    self._tenants[name] = TenantEventLog(
+                        name, data_dir, segment_rows, spill_parquet)
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        """Start the linger flusher (DeviceEventBuffer.java:99 writer thread)."""
+        if self._flusher is None:
+            self._stop.clear()
+            self._flusher = threading.Thread(
+                target=self._linger_loop, name="eventlog-flusher", daemon=True)
+            self._flusher.start()
+
+    def _linger_loop(self) -> None:
+        while not self._stop.wait(self._linger_ms / 1000.0):
+            for log in self._tenant_list():
+                log.flush_if_full()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._flusher is not None:
+            self._flusher.join(timeout=5.0)
+            self._flusher = None
+        self.flush()
+
+    def flush(self) -> None:
+        for log in self._tenant_list():
+            log.flush()
+
+    def _tenant_list(self) -> List[TenantEventLog]:
+        with self._lock:
+            return list(self._tenants.values())
+
+    def tenant(self, tenant: str) -> TenantEventLog:
+        """Write-path accessor: creates the tenant log (and its directory)."""
+        with self._lock:
+            if tenant not in self._tenants:
+                self._tenants[tenant] = TenantEventLog(
+                    tenant, self._data_dir, self._segment_rows, self._spill)
+            return self._tenants[tenant]
+
+    def tenant_if_exists(self, tenant: str) -> Optional[TenantEventLog]:
+        """Read-path accessor: never creates phantom tenants on disk."""
+        with self._lock:
+            return self._tenants.get(tenant)
+
+    def flush_tenant(self, tenant: str) -> None:
+        log = self.tenant_if_exists(tenant)
+        if log is not None:
+            log.flush()
+
+    # -- hot-path append ---------------------------------------------------
+    def append_batch(self, tenant: str, batch, packer,
+                     received_ms: Optional[int] = None, registry=None) -> int:
+        """Append the valid rows of a packed EventBatch. Vectorized: device
+        tokens (and, when `registry` is given, assignment/area/customer/asset
+        context — the GDeviceEventContext fields) are resolved once per
+        unique device index, not per row, so index-based list queries work
+        identically for hot-path and control-plane events."""
+        valid = np.asarray(batch.valid)
+        n = int(valid.sum())
+        if n == 0:
+            return 0
+        sel = np.nonzero(valid)[0]
+        device_idx = np.asarray(batch.device_idx)[sel].astype(np.int32)
+        event_type = np.asarray(batch.event_type)[sel].astype(np.int32)
+        ts = np.asarray(batch.ts)[sel].astype(np.int64) + packer.epoch_base_ms
+        mm_idx = np.asarray(batch.mm_idx)[sel].astype(np.int32)
+        alert_type_idx = np.asarray(batch.alert_type_idx)[sel].astype(np.int32)
+        now = received_ms if received_ms is not None else int(time.time() * 1000)
+
+        # bulk ids: <process-unique prefix>-<monotonic counter> per row;
+        # the random prefix keeps ids unique across restarts over the same
+        # parquet log (a uuid4 per row would dominate the append cost)
+        base = self._next_ids(n)
+        ids = _obj_col(n)
+        ids[:] = [f"ev-{_ID_PREFIX}-{base + i:012x}" for i in range(n)]
+
+        def resolve(interner, idx: np.ndarray) -> np.ndarray:
+            out = _obj_col(n)
+            for u in np.unique(idx):
+                tok = interner.token_of(int(u))
+                out[idx == u] = tok
+            return out
+
+        context_cols: Dict[str, np.ndarray] = {}
+        if registry is not None:
+            assignment_token = _obj_col(n)
+            customer_id = _obj_col(n)
+            area_id = _obj_col(n)
+            asset_id = _obj_col(n)
+            for u in np.unique(device_idx):
+                token = packer.devices.token_of(int(u))
+                device = registry.get_device_by_token(token) if token else None
+                assignment = (registry.get_active_assignment(device.id)
+                              if device is not None else None)
+                if assignment is None:
+                    continue
+                rows = device_idx == u
+                assignment_token[rows] = assignment.token
+                customer_id[rows] = assignment.customer_id or None
+                area_id[rows] = assignment.area_id or None
+                asset_id[rows] = assignment.asset_id or None
+            context_cols = dict(assignment_token=assignment_token,
+                                customer_id=customer_id, area_id=area_id,
+                                asset_id=asset_id)
+
+        cols = _full_cols(
+            n,
+            id=ids,
+            event_type=event_type,
+            device_idx=device_idx,
+            device_token=resolve(packer.devices, device_idx),
+            event_date=ts,
+            received_date=np.full(n, now, np.int64),
+            mm_idx=mm_idx,
+            mm_name=resolve(packer.measurements, mm_idx),
+            value=np.asarray(batch.value)[sel].astype(np.float32),
+            latitude=np.asarray(batch.lat)[sel].astype(np.float32),
+            longitude=np.asarray(batch.lon)[sel].astype(np.float32),
+            elevation=np.asarray(batch.elevation)[sel].astype(np.float32),
+            alert_level=np.asarray(batch.alert_level)[sel].astype(np.int32),
+            alert_type_idx=alert_type_idx,
+            alert_type=resolve(packer.alert_types, alert_type_idx),
+            **context_cols,
+        )
+        self.tenant(tenant).append(cols, n)
+        return n
+
+    _id_counter = 0
+    _id_lock = threading.Lock()
+
+    @classmethod
+    def _next_ids(cls, n: int) -> int:
+        with cls._id_lock:
+            base = cls._id_counter
+            cls._id_counter += n
+            return base
+
+    # -- control-plane append ---------------------------------------------
+    def append_events(self, tenant: str, events: Sequence[DeviceEvent],
+                      device_interner=None) -> None:
+        n = len(events)
+        if n == 0:
+            return
+        cols = _full_cols(n)
+        for i, ev in enumerate(events):
+            self._fill_row(cols, i, ev, device_interner)
+        self.tenant(tenant).append(cols, n)
+
+    @staticmethod
+    def _fill_row(cols: Dict[str, np.ndarray], i: int, ev: DeviceEvent,
+                  device_interner) -> None:
+        cols["id"][i] = ev.id or new_id()
+        cols["alternate_id"][i] = ev.alternate_id or None
+        cols["event_type"][i] = int(ev.event_type)
+        cols["device_token"][i] = ev.device_id or None
+        if device_interner is not None and ev.device_id:
+            cols["device_idx"][i] = device_interner.lookup(ev.device_id)
+        cols["assignment_token"][i] = ev.device_assignment_id or None
+        cols["customer_id"][i] = ev.customer_id or None
+        cols["area_id"][i] = ev.area_id or None
+        cols["asset_id"][i] = ev.asset_id or None
+        cols["event_date"][i] = ev.event_date
+        cols["received_date"][i] = ev.received_date
+        if ev.metadata:
+            cols["metadata"][i] = json.dumps(ev.metadata)
+        if isinstance(ev, DeviceMeasurement):
+            cols["mm_name"][i] = ev.name
+            cols["value"][i] = ev.value
+        elif isinstance(ev, DeviceLocation):
+            cols["latitude"][i] = ev.latitude
+            cols["longitude"][i] = ev.longitude
+            cols["elevation"][i] = ev.elevation
+        elif isinstance(ev, DeviceAlert):
+            cols["alert_source"][i] = int(ev.source)
+            cols["alert_level"][i] = int(ev.level)
+            cols["alert_type"][i] = ev.type or None
+            cols["alert_message"][i] = ev.message or None
+        elif isinstance(ev, DeviceCommandInvocation):
+            cols["initiator"][i] = int(ev.initiator)
+            cols["initiator_id"][i] = ev.initiator_id or None
+            cols["target"][i] = int(ev.target)
+            cols["target_id"][i] = ev.target_id or None
+            cols["command_token"][i] = ev.command_token or None
+            if ev.parameter_values:
+                cols["parameters"][i] = json.dumps(ev.parameter_values)
+        elif isinstance(ev, DeviceCommandResponse):
+            cols["originating_event_id"][i] = ev.originating_event_id or None
+            cols["response_event_id"][i] = ev.response_event_id or None
+            cols["response"][i] = ev.response or None
+        elif isinstance(ev, DeviceStateChange):
+            cols["attribute"][i] = ev.attribute or None
+            cols["state_type"][i] = ev.type or None
+            cols["previous_state"][i] = ev.previous_state or None
+            cols["new_state"][i] = ev.new_state or None
+        elif isinstance(ev, DeviceStreamData):
+            cols["stream_id"][i] = ev.stream_id or None
+            cols["sequence_number"][i] = ev.sequence_number
+            cols["stream_data"][i] = ev.data
+
+    # -- query -------------------------------------------------------------
+    def query(self, tenant: str, flt: EventFilter,
+              criteria: Optional[SearchCriteria] = None,
+              order_by: str = "event_date_desc"
+              ) -> SearchResults[DeviceEvent]:
+        """Globally ordered paged query (default newest-first by event_date
+        across ALL segments — late/replayed events interleave correctly),
+        materializing dataclasses only for the requested page.
+
+        `order_by`: "event_date_desc" | "sequence_asc" (stream reassembly).
+        The caller's filter is never mutated."""
+        criteria = criteria or SearchCriteria()
+        flt = dataclasses.replace(flt)
+        if isinstance(criteria, DateRangeCriteria):
+            if criteria.start_date is not None and flt.start_date is None:
+                flt.start_date = criteria.start_date
+            if criteria.end_date is not None and flt.end_date is None:
+                flt.end_date = criteria.end_date
+        tlog = self.tenant_if_exists(tenant)
+        matches: List[Tuple[Dict[str, np.ndarray], np.ndarray]] = \
+            list(tlog.scan(flt)) if tlog is not None else []
+        if not matches:
+            return SearchResults(results=[], num_results=0)
+        key_col = ("sequence_number" if order_by == "sequence_asc"
+                   else "event_date")
+        keys = np.concatenate([cols[key_col][idx] for cols, idx in matches])
+        if order_by != "sequence_asc":
+            keys = -keys  # descending
+        order = np.argsort(keys, kind="stable")
+        total = len(order)
+        skip = criteria.offset
+        page = order[skip:skip + criteria.page_size]
+        # map flat positions back to (segment, row)
+        bounds = np.cumsum([0] + [len(idx) for _, idx in matches])
+        events: List[DeviceEvent] = []
+        for pos in page:
+            seg_i = int(np.searchsorted(bounds, pos, side="right") - 1)
+            cols, idx = matches[seg_i]
+            events.append(self._materialize(cols, int(idx[pos - bounds[seg_i]])))
+        return SearchResults(results=events, num_results=total)
+
+    def query_columns(self, tenant: str, flt: EventFilter,
+                      names: Sequence[str]) -> Dict[str, np.ndarray]:
+        """Analytics path: concatenated raw columns for all matching rows —
+        no dataclass materialization (feeds windowed tensor reductions)."""
+        parts: Dict[str, List[np.ndarray]] = {n: [] for n in names}
+        tlog = self.tenant_if_exists(tenant)
+        for cols, idx in (tlog.scan(flt) if tlog is not None else ()):
+            for n in names:
+                parts[n].append(cols[n][idx])
+
+        def empty(name: str) -> np.ndarray:
+            fld = _SCHEMA.field(name)
+            if name in _INT_COLS or name in _FLOAT_COLS:
+                return np.array([], dtype=fld.type.to_pandas_dtype())
+            return np.array([], dtype=object)
+
+        return {
+            n: (np.concatenate(v) if v else empty(n))
+            for n, v in parts.items()
+        }
+
+    def count(self, tenant: str) -> int:
+        tlog = self.tenant_if_exists(tenant)
+        return tlog.count() if tlog is not None else 0
+
+    @staticmethod
+    def _materialize(cols: Dict[str, np.ndarray], i: int) -> DeviceEvent:
+        etype = DeviceEventType(int(cols["event_type"][i]))
+
+        def s(name: str) -> str:
+            v = cols[name][i]
+            return "" if v is None else str(v)
+
+        meta = json.loads(s("metadata")) if cols["metadata"][i] else {}
+        common = dict(
+            id=s("id"), alternate_id=s("alternate_id"), event_type=etype,
+            device_id=s("device_token"),
+            device_assignment_id=s("assignment_token"),
+            customer_id=s("customer_id"), area_id=s("area_id"),
+            asset_id=s("asset_id"), event_date=int(cols["event_date"][i]),
+            received_date=int(cols["received_date"][i]), metadata=meta)
+        if etype == DeviceEventType.MEASUREMENT:
+            return DeviceMeasurement(**common, name=s("mm_name"),
+                                     value=float(cols["value"][i]))
+        if etype == DeviceEventType.LOCATION:
+            return DeviceLocation(
+                **common, latitude=float(cols["latitude"][i]),
+                longitude=float(cols["longitude"][i]),
+                elevation=float(cols["elevation"][i]))
+        if etype == DeviceEventType.ALERT:
+            return DeviceAlert(
+                **common, source=AlertSource(int(cols["alert_source"][i])),
+                level=AlertLevel(int(cols["alert_level"][i])),
+                type=s("alert_type"), message=s("alert_message"))
+        if etype == DeviceEventType.COMMAND_INVOCATION:
+            params = json.loads(s("parameters")) if cols["parameters"][i] else {}
+            return DeviceCommandInvocation(
+                **common, initiator=CommandInitiator(int(cols["initiator"][i])),
+                initiator_id=s("initiator_id"),
+                target=CommandTarget(int(cols["target"][i])),
+                target_id=s("target_id"), command_token=s("command_token"),
+                parameter_values=params)
+        if etype == DeviceEventType.COMMAND_RESPONSE:
+            return DeviceCommandResponse(
+                **common, originating_event_id=s("originating_event_id"),
+                response_event_id=s("response_event_id"),
+                response=s("response"))
+        if etype == DeviceEventType.STATE_CHANGE:
+            return DeviceStateChange(
+                **common, attribute=s("attribute"), type=s("state_type"),
+                previous_state=s("previous_state"), new_state=s("new_state"))
+        data = cols["stream_data"][i]
+        return DeviceStreamData(
+            **common, stream_id=s("stream_id"),
+            sequence_number=int(cols["sequence_number"][i]),
+            data=data if isinstance(data, bytes) else b"")

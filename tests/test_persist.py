@@ -1,0 +1,355 @@
+"""Persistence tier tests: columnar event log, event-management API,
+checkpoint/replay recovery (SURVEY.md §4: deterministic, no live infra)."""
+
+import numpy as np
+import pytest
+
+from sitewhere_tpu.model import (
+    AlertLevel, Area, Device, DeviceAssignment, DeviceType, Zone)
+from sitewhere_tpu.model.common import (
+    DateRangeCriteria, Location, SearchCriteria)
+from sitewhere_tpu.model.event import (
+    DeviceAlert, DeviceCommandInvocation, DeviceCommandResponse,
+    DeviceEventBatch, DeviceEventType, DeviceLocation, DeviceMeasurement,
+    DeviceStateChange, DeviceStreamData)
+from sitewhere_tpu.persist import (
+    ColumnarEventLog, DeviceEventManagement, EventFilter, EventIndex,
+    EventPersistenceTriggers, PipelineCheckpointer)
+from sitewhere_tpu.registry import DeviceManagement
+
+
+@pytest.fixture
+def world():
+    dm = DeviceManagement()
+    dtype = dm.create_device_type(DeviceType(token="sensor"))
+    area = dm.create_area(Area(token="area-1"))
+    devices, assignments = [], []
+    for i in range(4):
+        device = dm.create_device(Device(token=f"dev-{i}",
+                                         device_type_id=dtype.id))
+        assignment = dm.create_device_assignment(DeviceAssignment(
+            token=f"as-{i}", device_id=device.id, area_id=area.id))
+        devices.append(device)
+        assignments.append(assignment)
+    return dm, devices, assignments
+
+
+def _mk_mgmt(world, tmp=None):
+    dm, devices, assignments = world
+    log = ColumnarEventLog(data_dir=tmp, segment_rows=8)
+    return DeviceEventManagement(log, registry=dm), log
+
+
+class TestEventLog:
+    def test_add_and_list_measurements(self, world):
+        mgmt, log = _mk_mgmt(world)
+        persisted = mgmt.add_measurements(
+            "as-0",
+            DeviceMeasurement(name="temp", value=21.5, event_date=1000),
+            DeviceMeasurement(name="temp", value=22.5, event_date=2000))
+        assert all(e.id for e in persisted)
+        assert persisted[0].device_id == "dev-0"
+        assert persisted[0].area_id  # filled from assignment context
+        res = mgmt.list_measurements(EventIndex.ASSIGNMENT, "as-0")
+        assert res.num_results == 2
+        # newest first
+        assert res.results[0].value == 22.5
+        assert res.results[0].name == "temp"
+
+    def test_list_by_area_and_device_index(self, world):
+        mgmt, _ = _mk_mgmt(world)
+        dm, devices, assignments = world
+        mgmt.add_measurements("as-0", DeviceMeasurement(name="a", value=1))
+        mgmt.add_measurements("as-1", DeviceMeasurement(name="a", value=2))
+        area_id = assignments[0].area_id
+        res = mgmt.list_measurements(EventIndex.AREA, area_id)
+        assert res.num_results == 2
+        res = mgmt.list_measurements(EventIndex.DEVICE, "dev-1")
+        assert res.num_results == 1
+        assert res.results[0].value == 2
+
+    def test_date_range_and_paging(self, world):
+        mgmt, _ = _mk_mgmt(world)
+        for i in range(10):
+            mgmt.add_measurements("as-0", DeviceMeasurement(
+                name="m", value=float(i), event_date=1000 + i))
+        res = mgmt.list_measurements(
+            EventIndex.ASSIGNMENT, "as-0",
+            DateRangeCriteria(start_date=1003, end_date=1006))
+        assert res.num_results == 4
+        res = mgmt.list_measurements(
+            EventIndex.ASSIGNMENT, "as-0",
+            DateRangeCriteria(page_number=2, page_size=3))
+        assert res.num_results == 10
+        assert len(res.results) == 3
+        # newest-first global ordering: page 2 holds values 6,5,4
+        assert [e.value for e in res.results] == [6.0, 5.0, 4.0]
+
+    def test_all_event_types_roundtrip(self, world):
+        mgmt, _ = _mk_mgmt(world)
+        mgmt.add_locations("as-0", DeviceLocation(latitude=1.0, longitude=2.0,
+                                                  elevation=3.0))
+        mgmt.add_alerts("as-0", DeviceAlert(type="zone.violation",
+                                            level=AlertLevel.CRITICAL,
+                                            message="out of bounds"))
+        inv = mgmt.add_command_invocations("as-0", DeviceCommandInvocation(
+            command_token="reboot", parameter_values={"delay": "5"}))[0]
+        mgmt.add_command_responses("as-0", DeviceCommandResponse(
+            originating_event_id=inv.id, response="ok"))
+        mgmt.add_state_changes("as-0", DeviceStateChange(
+            attribute="presence", type="presence", new_state="NOT_PRESENT"))
+        mgmt.add_stream_data("as-0", DeviceStreamData(
+            stream_id="s1", sequence_number=0, data=b"\x01\x02"))
+
+        loc = mgmt.list_locations(EventIndex.ASSIGNMENT, "as-0").results[0]
+        assert (loc.latitude, loc.longitude, loc.elevation) == (1.0, 2.0, 3.0)
+        alert = mgmt.list_alerts(EventIndex.ASSIGNMENT, "as-0").results[0]
+        assert alert.type == "zone.violation"
+        assert alert.level == AlertLevel.CRITICAL
+        got_inv = mgmt.list_command_invocations(
+            EventIndex.ASSIGNMENT, "as-0").results[0]
+        assert got_inv.parameter_values == {"delay": "5"}
+        resp = mgmt.list_command_responses_for_invocation(inv.id).results[0]
+        assert resp.response == "ok"
+        sc = mgmt.list_state_changes(EventIndex.ASSIGNMENT, "as-0").results[0]
+        assert sc.new_state == "NOT_PRESENT"
+        sd = mgmt.list_stream_data("as-0", "s1").results[0]
+        assert sd.data == b"\x01\x02"
+
+    def test_get_by_id_and_alternate_id(self, world):
+        mgmt, _ = _mk_mgmt(world)
+        ev = mgmt.add_measurements("as-0", DeviceMeasurement(
+            name="m", value=7.0, alternate_id="alt-1"))[0]
+        assert mgmt.get_event_by_id(ev.id).value == 7.0
+        assert mgmt.get_event_by_alternate_id("alt-1").id == ev.id
+        assert mgmt.get_event_by_id("nope") is None
+
+    def test_event_batch_via_active_assignment(self, world):
+        mgmt, _ = _mk_mgmt(world)
+        batch = DeviceEventBatch(
+            device_token="dev-2",
+            measurements=[DeviceMeasurement(name="m", value=1.0)],
+            locations=[DeviceLocation(latitude=4.0, longitude=5.0)])
+        persisted = mgmt.add_device_event_batch("dev-2", batch)
+        assert len(persisted) == 2
+        assert all(e.device_assignment_id == "as-2" for e in persisted)
+
+    def test_segment_flush_and_parquet_reload(self, world, tmp_data_dir):
+        mgmt, log = _mk_mgmt(world, tmp=tmp_data_dir)
+        for i in range(20):  # segment_rows=8 -> several parquet segments
+            mgmt.add_measurements("as-0", DeviceMeasurement(
+                name="m", value=float(i), event_date=1000 + i))
+        log.flush()
+        # reopen from disk
+        log2 = ColumnarEventLog(data_dir=tmp_data_dir, segment_rows=8)
+        res = log2.query("default",
+                         EventFilter(event_type=DeviceEventType.MEASUREMENT),
+                         SearchCriteria(page_size=50))
+        assert res.num_results == 20
+        assert res.results[0].value == 19.0
+
+    def test_global_newest_first_across_segments(self, world):
+        """Late-arriving events interleave correctly across segment seals."""
+        mgmt, log = _mk_mgmt(world)
+        for date in (1000, 5000, 2000, 6000, 1500, 7000):
+            mgmt.add_measurements("as-0", DeviceMeasurement(
+                name="m", value=float(date), event_date=date))
+            log.flush()  # one segment per event: worst-case interleaving
+        res = mgmt.list_measurements(EventIndex.ASSIGNMENT, "as-0",
+                                     DateRangeCriteria(page_size=10))
+        assert [e.event_date for e in res.results] == [
+            7000, 6000, 5000, 2000, 1500, 1000]
+
+    def test_query_does_not_mutate_filter(self, world):
+        mgmt, log = _mk_mgmt(world)
+        mgmt.add_measurements("as-0", DeviceMeasurement(
+            name="m", value=1.0, event_date=2000))
+        flt = EventFilter(assignment_token="as-0")
+        log.query("default", flt, DateRangeCriteria(start_date=5000))
+        assert flt.start_date is None
+        res = log.query("default", flt, DateRangeCriteria(start_date=1000))
+        assert res.num_results == 1
+
+    def test_trickle_does_not_fragment_segments(self, world):
+        """Buffered rows are scannable without sealing tiny segments."""
+        mgmt, log = _mk_mgmt(world)  # segment_rows=8
+        tlog = log.tenant("default")
+        for i in range(5):
+            mgmt.add_measurements("as-0", DeviceMeasurement(name="m",
+                                                            value=float(i)))
+            res = mgmt.list_measurements(EventIndex.ASSIGNMENT, "as-0")
+            assert res.num_results == i + 1
+        assert len(tlog._segments) == 0  # still buffered, not sealed
+
+    def test_append_packed_batch(self, world):
+        """Hot-path columnar append: packed EventBatch lands queryable."""
+        from sitewhere_tpu.ops.pack import EventPacker
+        from sitewhere_tpu.registry.interning import TokenInterner
+
+        interner = TokenInterner(64, "devices")
+        for i in range(4):
+            interner.intern(f"dev-{i}")
+        packer = EventPacker(batch_size=16, device_interner=interner)
+        packer.measurements.intern("temp")
+        log = ColumnarEventLog(segment_rows=64)
+        n = log.append_batch("default", _packed(packer), packer)
+        assert n == 8
+        res = log.query("default", EventFilter(device_token="dev-1"),
+                        SearchCriteria(page_size=50))
+        assert res.num_results > 0
+        assert res.results[0].device_id == "dev-1"
+        cols = log.query_columns("default", EventFilter(), ["value", "device_idx"])
+        assert len(cols["value"]) == 8
+        # dtype-correct empties for no-match queries
+        none = log.query_columns("default", EventFilter(device_token="nope"),
+                                 ["value"])
+        assert none["value"].dtype == np.float32
+
+    def test_append_packed_batch_with_registry_context(self, world):
+        """Hot-path rows carry assignment/area context when a registry is
+        provided, so index-based list rpcs see them like control-plane rows."""
+        from sitewhere_tpu.ops.pack import EventPacker
+        from sitewhere_tpu.registry.interning import TokenInterner
+
+        dm, devices, assignments = world
+        interner = TokenInterner(64, "devices")
+        for d in devices:
+            interner.intern(d.token)
+        packer = EventPacker(batch_size=16, device_interner=interner)
+        packer.measurements.intern("temp")
+        log = ColumnarEventLog(segment_rows=64)
+        log.append_batch("default", _packed(packer), packer, registry=dm)
+        mgmt = DeviceEventManagement(log, registry=dm)
+        found = sum(
+            mgmt.list_measurements(EventIndex.ASSIGNMENT, f"as-{i}").num_results
+            for i in range(4))
+        assert found == 8
+        by_area = mgmt.list_measurements(EventIndex.AREA,
+                                         assignments[0].area_id)
+        assert by_area.num_results == 8
+
+    def test_reads_do_not_create_tenants(self, world, tmp_data_dir):
+        import os
+        log = ColumnarEventLog(data_dir=tmp_data_dir, segment_rows=8)
+        assert log.count("defualt") == 0
+        assert log.query("defualt", EventFilter()).num_results == 0
+        assert not os.path.exists(os.path.join(tmp_data_dir, "defualt"))
+
+
+def _packed(packer):
+    rng = np.random.default_rng(0)
+    now = packer.epoch_base_ms
+    return packer.pack_columns(
+        device_idx=rng.integers(1, 5, 8).astype(np.int32),
+        event_type=np.zeros(8, np.int32),
+        ts_ms_abs=np.full(8, now + 5, np.int64),
+        mm_idx=np.full(8, 1, np.int32),
+        value=rng.uniform(0, 100, 8).astype(np.float32))
+
+
+class TestTriggers:
+    def test_persisted_events_forwarded_to_bus(self, world):
+        from sitewhere_tpu.runtime.bus import EventBus, TopicNaming
+
+        mgmt, _ = _mk_mgmt(world)
+        bus = EventBus(partitions=4)
+        naming = TopicNaming()
+        EventPersistenceTriggers(bus, naming, "default").attach(mgmt)
+        mgmt.add_measurements("as-0", DeviceMeasurement(name="m", value=1.0))
+        mgmt.add_measurements("as-1", DeviceMeasurement(name="m", value=2.0))
+        consumer = bus.consumer(naming.inbound_persisted_events("default"), "g")
+        records = consumer.poll()
+        assert len(records) == 2
+        import msgpack
+        payload = msgpack.unpackb(records[0].value, raw=False)
+        assert payload["eventType"] == "MEASUREMENT"
+
+
+class TestCheckpoint:
+    def _engine(self, n_registered=8):
+        from __graft_entry__ import _example_world, _synthetic_batch
+        from sitewhere_tpu.model import AlertLevel
+        from sitewhere_tpu.pipeline.engine import PipelineEngine, ThresholdRule
+
+        _, tensors = _example_world(max_devices=64, n_registered=n_registered,
+                                    max_zones=4, max_verts=8)
+        engine = PipelineEngine(tensors, batch_size=32, measurement_slots=4,
+                                max_tenants=4, max_threshold_rules=8,
+                                max_geofence_rules=8)
+        engine.packer.measurements.intern("m1")
+        engine.add_threshold_rule(ThresholdRule(
+            token="hot", measurement_name="m1", operator=">", threshold=90.0,
+            alert_level=AlertLevel.CRITICAL))
+        engine.start()
+        return engine
+
+    def test_save_restore_state(self, tmp_path):
+        from __graft_entry__ import _synthetic_batch
+
+        engine = self._engine()
+        for seed in range(3):
+            engine.submit(_synthetic_batch(engine.packer, 8, 32, seed=seed))
+        ckpt = PipelineCheckpointer(str(tmp_path / "ckpt"))
+        path = ckpt.save(engine)
+        assert path
+
+        engine2 = self._engine()
+        ckpt.restore(engine2)
+        a, b = engine.state, engine2.state
+        np.testing.assert_array_equal(np.asarray(a.last_interaction),
+                                      np.asarray(b.last_interaction))
+        np.testing.assert_array_equal(np.asarray(a.event_count),
+                                      np.asarray(b.event_count))
+
+    def test_recover_replays_uncommitted(self, tmp_path):
+        """Crash-recovery: checkpoint mid-stream, process more without
+        committing, recover → replay reproduces the exact final state."""
+        import msgpack
+
+        from __graft_entry__ import _synthetic_batch
+        from sitewhere_tpu.runtime.bus import EventBus
+
+        engine = self._engine()
+        bus = EventBus(partitions=2, data_dir=str(tmp_path / "bus"))
+        topic = "events"
+        batches = [_synthetic_batch(engine.packer, 8, 32, seed=s)
+                   for s in range(4)]
+        for i, b in enumerate(batches):
+            bus.publish(topic, b"k", msgpack.packb({"seed": i}))
+        bus.flush()  # publishes reach the log files before the "crash"
+
+        consumer = bus.consumer(topic, "pipeline")
+        # process + commit first two batches, checkpoint
+        recs = consumer.poll(2)
+        for r in recs:
+            engine.submit(batches[msgpack.unpackb(r.value)["seed"]])
+        bus.commit(consumer)
+        ckpt = PipelineCheckpointer(str(tmp_path / "ckpt"))
+        ckpt.save(engine, bus, consumer_groups=[consumer])
+        # process the rest WITHOUT commit (crash before commit)
+        recs = consumer.poll(10)
+        for r in recs:
+            engine.submit(batches[msgpack.unpackb(r.value)["seed"]])
+        expected = np.asarray(engine.state.event_count)
+
+        # crash: fresh engine + fresh bus handle over the same files
+        engine2 = self._engine()
+        bus2 = EventBus(partitions=2, data_dir=str(tmp_path / "bus"))
+
+        def replay(records):
+            for r in records:
+                engine2.submit(batches[msgpack.unpackb(r.value)["seed"]])
+
+        replayed = ckpt.recover(engine2, bus2, topic, "pipeline", replay)
+        assert replayed == 2  # only the uncommitted tail
+        np.testing.assert_array_equal(
+            np.asarray(engine2.state.event_count), expected)
+
+    def test_keep_limit_gc(self, tmp_path):
+        engine = self._engine()
+        ckpt = PipelineCheckpointer(str(tmp_path / "ckpt"), keep=2)
+        paths = [ckpt.save(engine) for _ in range(4)]
+        import os
+        remaining = sorted(os.listdir(str(tmp_path / "ckpt")))
+        assert len(remaining) == 2
+        assert ckpt.latest().endswith(remaining[-1])
