@@ -257,6 +257,12 @@ class TenantEventLog:
         if data_dir is not None:
             self._dir = os.path.join(data_dir, tenant.replace("/", "_"))
             os.makedirs(self._dir, exist_ok=True)
+            # record the TRUE tenant name: reload keys tenants by it, not by
+            # the sanitized directory name (they differ for e.g. "acme/eu")
+            name_path = os.path.join(self._dir, "_tenant.name")
+            if not os.path.exists(name_path):
+                with open(name_path, "w", encoding="utf-8") as fh:
+                    fh.write(tenant)
             self._load()
 
     def _load(self) -> None:
@@ -352,9 +358,15 @@ class ColumnarEventLog:
         if data_dir:
             os.makedirs(data_dir, exist_ok=True)
             for name in sorted(os.listdir(data_dir)):
-                if os.path.isdir(os.path.join(data_dir, name)):
-                    self._tenants[name] = TenantEventLog(
-                        name, data_dir, segment_rows, spill_parquet)
+                tdir = os.path.join(data_dir, name)
+                if not os.path.isdir(tdir):
+                    continue
+                name_path = os.path.join(tdir, "_tenant.name")
+                if os.path.exists(name_path):
+                    with open(name_path, encoding="utf-8") as fh:
+                        name = fh.read().strip() or name
+                self._tenants[name] = TenantEventLog(
+                    name, data_dir, segment_rows, spill_parquet)
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:

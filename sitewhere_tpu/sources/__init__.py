@@ -1,0 +1,29 @@
+"""Event sources: ingest + decode (reference service-event-sources).
+
+An `InboundEventSource` binds receivers (transport listeners) to a decoder
+chain and emits decoded requests onto the bus topics
+(event-source-decoded-events / failed-decode / registration), exactly the
+flow of InboundEventSource.onEncodedEventReceived
+(service-event-sources/…/InboundEventSource.java:189-210). The
+`EventSourcesManager` hosts N sources per tenant.
+"""
+
+from sitewhere_tpu.sources.decoders import (
+    CompositeDecoder, DecodedRequest, DecodeError, JsonBatchDecoder,
+    JsonRequestDecoder, ScriptedDecoder, WireDecoder)
+from sitewhere_tpu.sources.dedup import (
+    AlternateIdDeduplicator, ScriptedDeduplicator)
+from sitewhere_tpu.sources.manager import (
+    EventSourcesManager, InboundEventSource)
+from sitewhere_tpu.sources.receivers import (
+    CoapEventReceiver, HttpEventReceiver, MqttEventReceiver,
+    SocketEventReceiver, WebSocketEventReceiver)
+
+__all__ = [
+    "CompositeDecoder", "DecodedRequest", "DecodeError", "JsonBatchDecoder",
+    "JsonRequestDecoder", "ScriptedDecoder", "WireDecoder",
+    "AlternateIdDeduplicator", "ScriptedDeduplicator",
+    "EventSourcesManager", "InboundEventSource",
+    "CoapEventReceiver", "HttpEventReceiver", "MqttEventReceiver",
+    "SocketEventReceiver", "WebSocketEventReceiver",
+]

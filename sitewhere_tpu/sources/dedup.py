@@ -1,0 +1,75 @@
+"""Event deduplicators (IDeviceEventDeduplicator).
+
+Reference: deduplicator/AlternateIdDeduplicator.java — checks the event
+store for an existing event with the same alternate id — and
+GroovyEventDeduplicator.java (scripted predicate). Here the alternate-id
+check is a bounded in-memory set backed by an optional event-management
+lookup, so the hot path stays off the store for recent duplicates.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Callable, Dict, Optional
+
+from sitewhere_tpu.model.event import DeviceEventBatch
+from sitewhere_tpu.sources.decoders import DecodedRequest
+
+
+class AlternateIdDeduplicator:
+    """Duplicate if any event in the request carries an alternate_id seen
+    before (recent-window LRU, then the event store)."""
+
+    def __init__(self, event_management=None, window: int = 100_000):
+        self.event_management = event_management
+        self._seen: "OrderedDict[str, None]" = OrderedDict()
+        self._window = window
+
+    def _alternate_ids(self, request: DecodedRequest):
+        req = request.request
+        if isinstance(req, DeviceEventBatch):
+            for ev in req.all_events():
+                if ev.alternate_id:
+                    yield ev.alternate_id
+        elif getattr(req, "alternate_id", ""):
+            yield req.alternate_id
+
+    def is_duplicate(self, request: DecodedRequest) -> bool:
+        """Pure check — does NOT record the request's ids. Callers must
+        invoke remember() only after the request is accepted; otherwise a
+        rejected mixed batch would poison the window and a later retry of
+        its never-persisted events would be dropped."""
+        for alt in self._alternate_ids(request):
+            if alt in self._seen:
+                return True
+            if (self.event_management is not None and
+                    self.event_management.get_event_by_alternate_id(alt)
+                    is not None):
+                self._remember(alt)  # store-confirmed duplicate: cache it
+                return True
+        return False
+
+    def remember(self, request: DecodedRequest) -> None:
+        """Record an ACCEPTED request's alternate ids."""
+        for alt in self._alternate_ids(request):
+            self._remember(alt)
+
+    def _remember(self, alt: str) -> None:
+        self._seen[alt] = None
+        self._seen.move_to_end(alt)
+        while len(self._seen) > self._window:
+            self._seen.popitem(last=False)
+
+
+class ScriptedDeduplicator:
+    """Predicate-callable deduplicator (GroovyEventDeduplicator):
+    `fn(request) -> True if duplicate`."""
+
+    def __init__(self, fn: Callable[[DecodedRequest], bool]):
+        self.fn = fn
+
+    def is_duplicate(self, request: DecodedRequest) -> bool:
+        return bool(self.fn(request))
+
+    def remember(self, request: DecodedRequest) -> None:
+        pass  # scripted predicates carry their own state

@@ -1,0 +1,137 @@
+"""Inbound event sources: receivers -> decode -> dedup -> bus topics.
+
+Reference flow (InboundEventSource.java:189-210 / :247-294):
+  onEncodedEventReceived -> decodePayload -> [deduplicator] ->
+  handleDecodedRequest: events -> DecodedEventsProducer,
+  registrations -> deviceRegistrationProducer,
+  decode failures -> onFailedDecode -> failed-decode topic.
+
+Here the producers publish msgpack-serialized requests onto the in-proc bus
+(runtime/bus.py) keyed by device token, preserving per-device ordering into
+the TPU packing stage downstream (pipeline/ingest).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+import msgpack
+
+from sitewhere_tpu.model.common import _asdict
+from sitewhere_tpu.model.event import (
+    DeviceCommandResponse, DeviceEventBatch, DeviceRegistrationRequest,
+    DeviceStreamData)
+from sitewhere_tpu.runtime.bus import EventBus, TopicNaming
+from sitewhere_tpu.runtime.lifecycle import LifecycleComponent
+from sitewhere_tpu.runtime.metrics import MetricsRegistry
+from sitewhere_tpu.sources.decoders import DecodedRequest, DecodeError
+
+
+def _pack_request(source_id: str, request: DecodedRequest) -> bytes:
+    req = request.request
+    kind = type(req).__name__
+    return msgpack.packb({
+        "sourceId": source_id,
+        "deviceToken": request.device_token,
+        "kind": kind,
+        "request": _asdict(req),
+        "metadata": request.metadata,
+    }, use_bin_type=True)
+
+
+class InboundEventSource(LifecycleComponent):
+    """One configured event source: N receivers + decoder (+ deduplicator).
+
+    Receivers call `on_encoded_event_received(payload, metadata)` from any
+    thread; routing onto the bus is thread-safe.
+    """
+
+    def __init__(self, source_id: str, decoder, receivers: List[Any],
+                 bus: EventBus, naming: Optional[TopicNaming] = None,
+                 tenant: str = "default", deduplicator=None,
+                 metrics: Optional[MetricsRegistry] = None):
+        super().__init__(f"event-source:{source_id}")
+        self.source_id = source_id
+        self.decoder = decoder
+        self.receivers = receivers
+        self.deduplicator = deduplicator
+        self.bus = bus
+        self.naming = naming or TopicNaming()
+        self.tenant = tenant
+        m = (metrics or MetricsRegistry()).scoped(f"source.{source_id}")
+        self.decoded_meter = m.meter("decoded")
+        self.failed_counter = m.counter("failed_decode")
+        self.duplicate_counter = m.counter("duplicates")
+
+    # -- lifecycle ---------------------------------------------------------
+    def on_start(self, monitor) -> None:
+        for receiver in self.receivers:
+            receiver.bind(self)
+            receiver.start()
+
+    def on_stop(self, monitor) -> None:
+        for receiver in self.receivers:
+            receiver.stop()
+
+    # -- ingest ------------------------------------------------------------
+    def on_encoded_event_received(self, payload: bytes,
+                                  metadata: Optional[Dict[str, str]] = None
+                                  ) -> None:
+        """Receiver entry point (InboundEventSource.onEncodedEventReceived)."""
+        try:
+            requests = self.decoder.decode(payload, metadata)
+        except DecodeError as exc:
+            self.failed_counter.inc()
+            self.bus.publish(
+                self.naming.event_source_failed_decode_events(self.tenant),
+                b"", msgpack.packb({"sourceId": self.source_id,
+                                    "error": str(exc), "payload": payload},
+                                   use_bin_type=True))
+            return
+        for request in requests:
+            if metadata:  # receiver context (e.g. mqtt.topic) rides along
+                request.metadata = {**metadata, **request.metadata}
+            self.handle_decoded_request(request)
+
+    def handle_decoded_request(self, request: DecodedRequest) -> None:
+        if self.deduplicator is not None:
+            if self.deduplicator.is_duplicate(request):
+                self.duplicate_counter.inc()
+                return
+        key = request.device_token.encode()
+        payload = _pack_request(self.source_id, request)
+        req = request.request
+        if isinstance(req, DeviceRegistrationRequest):
+            topic = self.naming.inbound_device_registration_events(self.tenant)
+        elif isinstance(req, (DeviceEventBatch, DeviceCommandResponse,
+                              DeviceStreamData)):
+            topic = self.naming.event_source_decoded_events(self.tenant)
+            self.decoded_meter.mark(
+                len(req.all_events()) if isinstance(req, DeviceEventBatch)
+                else 1)
+        else:
+            raise TypeError(f"undecodable request type {type(req).__name__}")
+        self.bus.publish(topic, key, payload)
+        if self.deduplicator is not None:
+            self.deduplicator.remember(request)  # only after acceptance
+
+
+class EventSourcesManager(LifecycleComponent):
+    """Hosts all event sources of one tenant (EventSourcesManager.java)."""
+
+    def __init__(self, sources: Optional[List[InboundEventSource]] = None):
+        super().__init__("event-sources-manager")
+        self.sources: List[InboundEventSource] = []
+        for source in sources or []:
+            self.add_source(source)
+
+    def add_source(self, source: InboundEventSource) -> None:
+        self.sources.append(source)
+        self.add_nested(source)
+
+    def source(self, source_id: str) -> Optional[InboundEventSource]:
+        for s in self.sources:
+            if s.source_id == source_id:
+                return s
+        return None

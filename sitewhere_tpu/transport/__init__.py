@@ -1,0 +1,28 @@
+"""Device-facing transports + wire protocol.
+
+Reference: sitewhere-communication (SURVEY.md §2.2) — the device<->cloud
+protobuf protocol (sitewhere.proto:6-133: SiteWhere.Command device->cloud,
+Device.Command cloud->device, Model.* event messages), the MQTT lifecycle
+base (mqtt/MqttLifecycleComponent.java), plus the receiver transports hosted
+by service-event-sources (MQTT/CoAP/socket/WebSocket/HTTP).
+
+TPU-first design: the wire format's hot event types (measurement, location,
+alert) use a fixed-width little-endian binary layout so the host ingest tier
+can decode frames straight into SoA columns (numpy now, the native C++
+batch decoder for the same layout in native/) without per-event object
+churn. Control messages (registration, commands) ride a msgpack profile.
+
+No external broker processes: the MQTT broker and CoAP server here are
+in-process asyncio implementations of the wire protocols themselves, so the
+platform is self-contained the way the reference's embedded ActiveMQ broker
+option is (sources/activemq/ActiveMQBroker).
+"""
+
+from sitewhere_tpu.transport.wire import (
+    MessageType, WireCodec, WireError, decode_frames, encode_frame)
+from sitewhere_tpu.transport.mqtt import MqttBroker, MqttClient
+
+__all__ = [
+    "MessageType", "WireCodec", "WireError", "decode_frames", "encode_frame",
+    "MqttBroker", "MqttClient",
+]

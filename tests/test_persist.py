@@ -228,6 +228,14 @@ class TestEventLog:
                                          assignments[0].area_id)
         assert by_area.num_results == 8
 
+    def test_sanitized_tenant_name_survives_reload(self, world, tmp_data_dir):
+        log = ColumnarEventLog(data_dir=tmp_data_dir, segment_rows=8)
+        log.append_events("acme/eu", [DeviceMeasurement(name="m", value=1.0)])
+        log.flush()
+        log2 = ColumnarEventLog(data_dir=tmp_data_dir, segment_rows=8)
+        assert log2.count("acme/eu") == 1
+        assert log2.query("acme/eu", EventFilter()).num_results == 1
+
     def test_reads_do_not_create_tenants(self, world, tmp_data_dir):
         import os
         log = ColumnarEventLog(data_dir=tmp_data_dir, segment_rows=8)

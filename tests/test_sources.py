@@ -1,0 +1,273 @@
+"""Event-source tests: decoders, dedup, source routing to bus topics, and
+live receiver -> source -> bus flows over real transports."""
+
+import json
+import time
+
+import msgpack
+import pytest
+
+from sitewhere_tpu.model.event import (
+    DeviceEventBatch, DeviceMeasurement, DeviceRegistrationRequest)
+from sitewhere_tpu.runtime.bus import EventBus, TopicNaming
+from sitewhere_tpu.sources import (
+    AlternateIdDeduplicator, CompositeDecoder, DecodedRequest, DecodeError,
+    EventSourcesManager, InboundEventSource, JsonBatchDecoder,
+    JsonRequestDecoder, MqttEventReceiver, ScriptedDecoder, ScriptedDeduplicator,
+    SocketEventReceiver, WireDecoder)
+from sitewhere_tpu.transport import MessageType, WireCodec, encode_frame
+
+
+class TestDecoders:
+    def test_wire_decoder_groups_by_device(self):
+        payload = (
+            encode_frame(MessageType.MEASUREMENT,
+                         WireCodec.encode_measurement("d1", 1, "t", 1.0))
+            + encode_frame(MessageType.MEASUREMENT,
+                           WireCodec.encode_measurement("d2", 2, "t", 2.0))
+            + encode_frame(MessageType.LOCATION,
+                           WireCodec.encode_location("d1", 3, 9, 9)))
+        out = WireDecoder().decode(payload)
+        batches = {r.device_token: r.request for r in out}
+        assert set(batches) == {"d1", "d2"}
+        assert len(batches["d1"].measurements) == 1
+        assert len(batches["d1"].locations) == 1
+        assert batches["d2"].measurements[0].value == 2.0
+
+    def test_wire_decoder_registration(self):
+        payload = encode_frame(
+            MessageType.REGISTER,
+            WireCodec.encode_register("d9", "sensor", area_token="a"))
+        [req] = WireDecoder().decode(payload)
+        assert isinstance(req.request, DeviceRegistrationRequest)
+        assert req.request.device_type_token == "sensor"
+
+    def test_wire_decoder_garbage_raises(self):
+        with pytest.raises(DecodeError):
+            WireDecoder().decode(b"not a frame")
+        with pytest.raises(DecodeError):
+            WireDecoder().decode(b"")
+
+    def test_json_batch_decoder(self):
+        doc = {"deviceToken": "d1",
+               "measurements": [{"name": "temp", "value": 3.5}],
+               "alerts": [{"type": "x", "level": "critical"}]}
+        [req] = JsonBatchDecoder().decode(json.dumps(doc).encode())
+        assert req.device_token == "d1"
+        assert req.request.measurements[0].value == 3.5
+        assert req.request.alerts[0].level.name == "CRITICAL"
+
+    def test_json_request_decoder(self):
+        doc = {"deviceToken": "d2", "type": "DeviceLocation",
+               "request": {"latitude": 1, "longitude": 2}}
+        [req] = JsonRequestDecoder().decode(json.dumps(doc).encode())
+        assert req.request.locations[0].latitude == 1
+        reg = {"deviceToken": "d3", "type": "RegisterDevice",
+               "request": {"deviceTypeToken": "sensor"}}
+        [req] = JsonRequestDecoder().decode(json.dumps(reg).encode())
+        assert isinstance(req.request, DeviceRegistrationRequest)
+
+    def test_scripted_decoder(self):
+        def fn(payload, metadata):
+            token, value = payload.decode().split(":")
+            batch = DeviceEventBatch(device_token=token)
+            batch.measurements.append(
+                DeviceMeasurement(name="v", value=float(value)))
+            return [DecodedRequest(token, batch)]
+
+        [req] = ScriptedDecoder(fn).decode(b"dev-5:42.0")
+        assert req.request.measurements[0].value == 42.0
+        with pytest.raises(DecodeError):
+            ScriptedDecoder(fn).decode(b"garbage")
+
+    def test_composite_decoder_routes_by_device_type(self):
+        from sitewhere_tpu.model import Device, DeviceType
+        from sitewhere_tpu.registry import DeviceManagement
+
+        dm = DeviceManagement()
+        t1 = dm.create_device_type(DeviceType(token="json-type"))
+        dm.create_device(Device(token="dj", device_type_id=t1.id))
+
+        def extractor(payload: bytes) -> str:
+            return json.loads(payload)["deviceToken"]
+
+        composite = CompositeDecoder(
+            dm, extractor, {"json-type": JsonBatchDecoder()})
+        doc = {"deviceToken": "dj",
+               "measurements": [{"name": "m", "value": 1}]}
+        [req] = composite.decode(json.dumps(doc).encode())
+        assert req.device_token == "dj"
+        with pytest.raises(DecodeError):
+            composite.decode(json.dumps(
+                {"deviceToken": "unknown"}).encode())
+
+
+class TestDedup:
+    def test_alternate_id_window(self):
+        dedup = AlternateIdDeduplicator()
+        batch = DeviceEventBatch(device_token="d")
+        batch.measurements.append(DeviceMeasurement(alternate_id="alt-1"))
+        req = DecodedRequest("d", batch)
+        assert not dedup.is_duplicate(req)
+        dedup.remember(req)  # accepted
+        assert dedup.is_duplicate(req)
+
+    def test_rejected_request_does_not_poison_window(self):
+        """A dropped mixed batch must not mark its new ids as seen: a retry
+        of the never-persisted event must be accepted."""
+        dedup = AlternateIdDeduplicator()
+        seen = DeviceEventBatch(device_token="d")
+        seen.measurements.append(DeviceMeasurement(alternate_id="B"))
+        dedup.remember(DecodedRequest("d", seen))
+        mixed = DeviceEventBatch(device_token="d")
+        mixed.measurements.append(DeviceMeasurement(alternate_id="A"))
+        mixed.measurements.append(DeviceMeasurement(alternate_id="B"))
+        assert dedup.is_duplicate(DecodedRequest("d", mixed))  # dropped
+        retry = DeviceEventBatch(device_token="d")
+        retry.measurements.append(DeviceMeasurement(alternate_id="A"))
+        assert not dedup.is_duplicate(DecodedRequest("d", retry))
+
+    def test_no_alternate_id_never_duplicate(self):
+        dedup = AlternateIdDeduplicator()
+        batch = DeviceEventBatch(device_token="d")
+        batch.measurements.append(DeviceMeasurement())
+        req = DecodedRequest("d", batch)
+        assert not dedup.is_duplicate(req)
+        assert not dedup.is_duplicate(req)
+
+    def test_scripted(self):
+        dedup = ScriptedDeduplicator(lambda r: r.device_token == "dup")
+        assert dedup.is_duplicate(DecodedRequest("dup", None))
+        assert not dedup.is_duplicate(DecodedRequest("ok", None))
+
+
+def _mk_source(decoder=None, deduplicator=None, receivers=None):
+    bus = EventBus(partitions=2)
+    naming = TopicNaming()
+    source = InboundEventSource(
+        "src-1", decoder or JsonBatchDecoder(), receivers or [], bus,
+        naming=naming, deduplicator=deduplicator)
+    return source, bus, naming
+
+
+class TestInboundEventSource:
+    def test_decoded_events_routed(self):
+        source, bus, naming = _mk_source()
+        doc = {"deviceToken": "d1",
+               "measurements": [{"name": "m", "value": 5}]}
+        source.on_encoded_event_received(json.dumps(doc).encode())
+        consumer = bus.consumer(
+            naming.event_source_decoded_events("default"), "g")
+        [rec] = consumer.poll()
+        body = msgpack.unpackb(rec.value, raw=False)
+        assert body["deviceToken"] == "d1"
+        assert body["kind"] == "DeviceEventBatch"
+        assert body["request"]["measurements"][0]["value"] == 5
+        assert rec.key == b"d1"
+
+    def test_registration_routed_to_registration_topic(self):
+        source, bus, naming = _mk_source(decoder=JsonRequestDecoder())
+        doc = {"deviceToken": "d9", "type": "RegisterDevice",
+               "request": {"deviceTypeToken": "sensor"}}
+        source.on_encoded_event_received(json.dumps(doc).encode())
+        [rec] = bus.consumer(
+            naming.inbound_device_registration_events("default"), "g").poll()
+        assert msgpack.unpackb(rec.value, raw=False)["kind"] == \
+            "DeviceRegistrationRequest"
+
+    def test_failed_decode_routed(self):
+        source, bus, naming = _mk_source()
+        source.on_encoded_event_received(b"NOT JSON")
+        [rec] = bus.consumer(
+            naming.event_source_failed_decode_events("default"), "g").poll()
+        body = msgpack.unpackb(rec.value, raw=False)
+        assert body["payload"] == b"NOT JSON"
+        assert source.failed_counter.value == 1
+
+    def test_duplicates_dropped(self):
+        dedup = ScriptedDeduplicator(lambda r: True)
+        source, bus, naming = _mk_source(deduplicator=dedup)
+        doc = {"deviceToken": "d1",
+               "measurements": [{"name": "m", "value": 5}]}
+        source.on_encoded_event_received(json.dumps(doc).encode())
+        assert bus.consumer(
+            naming.event_source_decoded_events("default"), "g").poll() == []
+        assert source.duplicate_counter.value == 1
+
+
+class TestLiveReceivers:
+    def _drain(self, bus, naming, n=1, timeout_s=5.0):
+        consumer = bus.consumer(
+            naming.event_source_decoded_events("default"), "g")
+        out = []
+        deadline = time.time() + timeout_s
+        while len(out) < n and time.time() < deadline:
+            out.extend(consumer.poll(64, timeout_s=0.1))
+        return out
+
+    def test_mqtt_receiver_end_to_end(self):
+        """Device publishes wire frames over real MQTT -> source -> bus."""
+        from sitewhere_tpu.sources.receivers import EventLoopThread
+        from sitewhere_tpu.transport.mqtt import MqttBroker, MqttClient
+
+        loop = EventLoopThread.shared()
+        broker = MqttBroker()
+        loop.run(broker.start())
+        receiver = MqttEventReceiver("127.0.0.1", broker.port,
+                                     topic="SW/+/input")
+        source, bus, naming = _mk_source(decoder=WireDecoder(),
+                                         receivers=[receiver])
+        source.initialize()
+        source.start()
+        try:
+            payload = encode_frame(
+                MessageType.MEASUREMENT,
+                WireCodec.encode_measurement("dev-7", 123, "temp", 9.5))
+
+            async def publish():
+                device = MqttClient("127.0.0.1", broker.port, "device-7")
+                await device.connect()
+                await device.publish("SW/dev-7/input", payload, qos=1)
+                await device.disconnect()
+
+            loop.run(publish())
+            [rec] = self._drain(bus, naming)
+            body = msgpack.unpackb(rec.value, raw=False)
+            assert body["deviceToken"] == "dev-7"
+            assert body["metadata"]["mqtt.topic"] == "SW/dev-7/input"
+        finally:
+            source.stop()
+            loop.run(broker.stop())
+
+    def test_socket_receiver_end_to_end(self):
+        import socket as pysocket
+
+        receiver = SocketEventReceiver()
+        source, bus, naming = _mk_source(decoder=WireDecoder(),
+                                         receivers=[receiver])
+        source.initialize()
+        source.start()
+        try:
+            payload = encode_frame(
+                MessageType.LOCATION,
+                WireCodec.encode_location("dev-8", 5, 1.0, 2.0))
+            with pysocket.create_connection(("127.0.0.1", receiver.port)) as s:
+                s.sendall(payload)
+            [rec] = self._drain(bus, naming)
+            assert msgpack.unpackb(rec.value, raw=False)["deviceToken"] == \
+                "dev-8"
+        finally:
+            source.stop()
+
+
+class TestManager:
+    def test_manager_lifecycle(self):
+        source1, _, _ = _mk_source()
+        source2, _, _ = _mk_source()
+        manager = EventSourcesManager([source1, source2])
+        manager.initialize()
+        manager.start()
+        assert source1.is_running() and source2.is_running()
+        assert manager.source("src-1") is source1
+        manager.stop()
+        assert not source1.is_running()

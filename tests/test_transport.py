@@ -1,0 +1,353 @@
+"""Transport tests: wire codec roundtrips, in-proc MQTT broker/client over
+real sockets, CoAP datagrams, socket/WebSocket/HTTP listeners."""
+
+import asyncio
+import struct
+
+import numpy as np
+import pytest
+
+from sitewhere_tpu.transport import (
+    MessageType, MqttBroker, MqttClient, WireCodec, decode_frames,
+    encode_frame)
+from sitewhere_tpu.transport.wire import decode_event_frames_to_columns
+
+
+def run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+class TestWire:
+    def test_measurement_roundtrip(self):
+        payload = WireCodec.encode_measurement("dev-1", 12345, "temp", 21.5)
+        frame = encode_frame(MessageType.MEASUREMENT, payload)
+        frames, rest = decode_frames(frame)
+        assert rest == b""
+        [(mtype, body)] = frames
+        ev = WireCodec.decode_event(mtype, body)
+        assert ev["token"] == "dev-1"
+        assert ev["ts_ms"] == 12345
+        assert ev["name"] == "temp"
+        assert abs(ev["value"] - 21.5) < 1e-6
+
+    def test_location_and_alert_roundtrip(self):
+        loc = WireCodec.decode_event(
+            MessageType.LOCATION,
+            WireCodec.encode_location("d", 7, 1.5, -2.5, 100.0))
+        assert (loc["lat"], loc["lon"], loc["elevation"]) == (1.5, -2.5, 100.0)
+        alert = WireCodec.decode_event(
+            MessageType.ALERT,
+            WireCodec.encode_alert("d", 7, "engine.overheat", 3, "hot!"))
+        assert alert["type"] == "engine.overheat"
+        assert alert["level"] == 3
+        assert alert["message"] == "hot!"
+
+    def test_partial_frames_carry_remainder(self):
+        p1 = encode_frame(MessageType.LOCATION,
+                          WireCodec.encode_location("d", 1, 0, 0))
+        p2 = encode_frame(MessageType.MEASUREMENT,
+                          WireCodec.encode_measurement("d", 2, "m", 1.0))
+        stream = p1 + p2
+        frames, rest = decode_frames(stream[:len(p1) + 3])
+        assert len(frames) == 1
+        assert rest == stream[len(p1):len(p1) + 3]
+        frames2, rest2 = decode_frames(rest + stream[len(p1) + 3:])
+        assert len(frames2) == 1
+        assert rest2 == b""
+
+    def test_bad_magic_raises(self):
+        from sitewhere_tpu.transport.wire import WireError
+        with pytest.raises(WireError):
+            decode_frames(b"XX\x01\x03\x00\x00\x00\x00")
+
+    def test_control_roundtrip(self):
+        reg = WireCodec.decode_control(WireCodec.encode_register(
+            "dev-9", "sensor", area_token="a1", metadata={"fw": "2.1"}))
+        assert reg["token"] == "dev-9"
+        assert reg["deviceType"] == "sensor"
+        assert reg["metadata"]["fw"] == "2.1"
+        cmd = WireCodec.decode_control(WireCodec.encode_command(
+            "dev-9", "reboot", {"delay": "5"}, invocation_id="inv-1"))
+        assert cmd["command"] == "reboot"
+        assert cmd["parameters"] == {"delay": "5"}
+
+    def test_bulk_decode_to_columns(self):
+        frames = [
+            (MessageType.MEASUREMENT,
+             WireCodec.encode_measurement("a", 1, "temp", 1.0)),
+            (MessageType.LOCATION, WireCodec.encode_location("b", 2, 3, 4, 5)),
+            (MessageType.ALERT, WireCodec.encode_alert("c", 3, "t", 2, "m")),
+            (MessageType.REGISTER, b"skipped"),
+        ]
+        cols = decode_event_frames_to_columns(frames)
+        assert cols["tokens"] == ["a", "b", "c"]
+        np.testing.assert_array_equal(cols["event_type"], [0, 1, 2])
+        np.testing.assert_array_equal(cols["ts_ms"], [1, 2, 3])
+        assert cols["names"][0] == "temp"
+        assert cols["alert_types"][2] == "t"
+
+
+class TestTopicMatching:
+    def test_wildcards(self):
+        from sitewhere_tpu.transport.mqtt import topic_matches
+        assert topic_matches("a/b/c", "a/b/c")
+        assert topic_matches("a/+/c", "a/x/c")
+        assert not topic_matches("a/+/c", "a/x/y")
+        assert topic_matches("a/#", "a/b/c/d")
+        assert topic_matches("#", "anything/at/all")
+        assert not topic_matches("a/b", "a/b/c")
+        assert not topic_matches("a/b/c", "a/b")
+
+
+class TestMqtt:
+    def test_pub_sub_qos0_and_qos1(self):
+        async def scenario():
+            broker = MqttBroker()
+            await broker.start()
+            sub = MqttClient("127.0.0.1", broker.port, "subscriber")
+            pub = MqttClient("127.0.0.1", broker.port, "publisher")
+            await sub.connect()
+            await pub.connect()
+            received = []
+            got = asyncio.Event()
+
+            def on_msg(topic, payload):
+                received.append((topic, payload))
+                if len(received) == 2:
+                    got.set()
+
+            await sub.subscribe("SW/+/input", on_msg, qos=1)
+            await pub.publish("SW/dev-1/input", b"hello", qos=0)
+            await pub.publish("SW/dev-2/input", b"world", qos=1)
+            await asyncio.wait_for(got.wait(), 5)
+            await sub.disconnect()
+            await pub.disconnect()
+            await broker.stop()
+            return received
+
+        received = run(scenario())
+        assert sorted(p for _, p in received) == [b"hello", b"world"]
+        topics = {t for t, _ in received}
+        assert topics == {"SW/dev-1/input", "SW/dev-2/input"}
+
+    def test_retained_message_delivered_on_subscribe(self):
+        async def scenario():
+            broker = MqttBroker()
+            await broker.start()
+            pub = MqttClient("127.0.0.1", broker.port, "p")
+            await pub.connect()
+            await pub.publish("status/dev-1", b"online", qos=1, retain=True)
+            sub = MqttClient("127.0.0.1", broker.port, "s")
+            await sub.connect()
+            got = asyncio.Event()
+            box = []
+
+            def on_msg(topic, payload):
+                box.append(payload)
+                got.set()
+
+            await sub.subscribe("status/#", on_msg)
+            await asyncio.wait_for(got.wait(), 5)
+            await pub.disconnect()
+            await sub.disconnect()
+            await broker.stop()
+            return box
+
+        assert run(scenario()) == [b"online"]
+
+    def test_client_id_takeover_keeps_new_session(self):
+        """Reconnect with the same client id must not evict the new session
+        when the old connection's handler unwinds."""
+        async def scenario():
+            broker = MqttBroker()
+            await broker.start()
+            first = MqttClient("127.0.0.1", broker.port, "same-id")
+            await first.connect()
+            second = MqttClient("127.0.0.1", broker.port, "same-id")
+            await second.connect()
+            await asyncio.sleep(0.1)  # let the old handler unwind
+            assert "same-id" in broker._sessions
+            got = asyncio.Event()
+
+            def on_msg(topic, payload):
+                got.set()
+
+            await second.subscribe("t", on_msg)
+            pub = MqttClient("127.0.0.1", broker.port, "pub")
+            await pub.connect()
+            await pub.publish("t", b"x", qos=1)
+            await asyncio.wait_for(got.wait(), 5)
+            await second.disconnect()
+            await pub.disconnect()
+            await asyncio.wait_for(broker.stop(), 5)  # must not hang
+            return True
+
+        assert run(scenario())
+
+    def test_oversized_frame_rejected(self):
+        import struct as pystruct
+
+        from sitewhere_tpu.transport.wire import WireError
+        with pytest.raises(WireError):
+            decode_frames(b"SW\x01\x03" + pystruct.pack("<I", 0xFFFFFFFF))
+
+    def test_unsubscribed_topic_not_delivered(self):
+        async def scenario():
+            broker = MqttBroker()
+            await broker.start()
+            sub = MqttClient("127.0.0.1", broker.port, "s")
+            pub = MqttClient("127.0.0.1", broker.port, "p")
+            await sub.connect()
+            await pub.connect()
+            box = []
+            hit = asyncio.Event()
+
+            def on_msg(topic, payload):
+                box.append((topic, payload))
+                hit.set()
+
+            await sub.subscribe("only/this", on_msg)
+            await pub.publish("other/topic", b"x", qos=1)
+            await pub.publish("only/this", b"y", qos=1)
+            await asyncio.wait_for(hit.wait(), 5)
+            await sub.disconnect()
+            await pub.disconnect()
+            await broker.stop()
+            return box
+
+        assert run(scenario()) == [("only/this", b"y")]
+
+
+class TestCoap:
+    def test_post_roundtrip(self):
+        from sitewhere_tpu.transport.coap import (
+            CoapServer, TYPE_ACK, TYPE_CON, POST, build_response,
+            parse_message)
+
+        async def scenario():
+            seen = []
+
+            def handler(path, payload, method):
+                seen.append((path, payload))
+                return b"ok"
+
+            server = CoapServer(handler)
+            await server.start()
+
+            loop = asyncio.get_running_loop()
+            reply = loop.create_future()
+
+            class Client(asyncio.DatagramProtocol):
+                def connection_made(self, transport):
+                    self.transport = transport
+
+                def datagram_received(self, data, addr):
+                    if not reply.done():
+                        reply.set_result(data)
+
+            transport, _ = await loop.create_datagram_endpoint(
+                Client, remote_addr=("127.0.0.1", server.port))
+            # CON POST coap://host/events/json  (two Uri-Path options)
+            msg = bytearray([0x40 | 0x01, POST, 0x00, 0x01])  # tkl=1 -> 0x41
+            msg = bytearray([0x41, POST, 0x00, 0x01, 0xAA])   # token 0xAA
+            msg += bytes([0xB6]) + b"events"   # opt 11, len 6
+            msg += bytes([0x04]) + b"json"     # delta 0, len 4
+            msg += b"\xff" + b'{"hi":1}'
+            transport.sendto(bytes(msg))
+            data = await asyncio.wait_for(reply, 5)
+            transport.close()
+            await server.stop()
+            parsed = parse_message(data)
+            return seen, parsed
+
+        seen, parsed = run(scenario())
+        assert seen == [("events/json", b'{"hi":1}')]
+        mtype, code, mid, token, path, payload = parsed
+        assert mtype == TYPE_ACK
+        assert code == (2 << 5) | 4  # 2.04 Changed
+        assert token == b"\xaa"
+        assert payload == b"ok"
+
+
+class TestServers:
+    def test_socket_server_reframes_stream(self):
+        from sitewhere_tpu.transport.servers import SocketEventServer
+
+        async def scenario():
+            got = []
+            done = asyncio.Event()
+
+            async def handler(payload: bytes):
+                got.append(payload)
+                if len(got) == 2:
+                    done.set()
+
+            server = SocketEventServer(handler)
+            await server.start()
+            reader, writer = await asyncio.open_connection("127.0.0.1",
+                                                           server.port)
+            f1 = encode_frame(MessageType.MEASUREMENT,
+                              WireCodec.encode_measurement("d", 1, "m", 1.0))
+            f2 = encode_frame(MessageType.LOCATION,
+                              WireCodec.encode_location("d", 2, 1, 2))
+            stream = f1 + f2
+            # split at an awkward boundary to exercise re-framing
+            writer.write(stream[:len(f1) + 5])
+            await writer.drain()
+            await asyncio.sleep(0.05)
+            writer.write(stream[len(f1) + 5:])
+            await writer.drain()
+            await asyncio.wait_for(done.wait(), 5)
+            writer.close()
+            await server.stop()
+            return got, f1, f2
+
+        got, f1, f2 = run(scenario())
+        assert got == [f1, f2]
+
+    def test_websocket_server(self):
+        from sitewhere_tpu.transport.servers import WebSocketEventServer
+
+        async def scenario():
+            import websockets
+            got = []
+            done = asyncio.Event()
+
+            async def handler(payload: bytes):
+                got.append(payload)
+                done.set()
+
+            server = WebSocketEventServer(handler)
+            await server.start()
+            async with websockets.connect(
+                    f"ws://127.0.0.1:{server.port}/events") as ws:
+                await ws.send(b"payload-1")
+                await asyncio.wait_for(done.wait(), 5)
+            await server.stop()
+            return got
+
+        assert run(scenario()) == [b"payload-1"]
+
+    def test_http_server(self):
+        from sitewhere_tpu.transport.servers import HttpEventServer
+
+        async def scenario():
+            import aiohttp
+            got = []
+
+            async def handler(payload: bytes):
+                got.append(payload)
+
+            server = HttpEventServer(handler)
+            await server.start()
+            async with aiohttp.ClientSession() as session:
+                async with session.post(
+                        f"http://127.0.0.1:{server.port}/events",
+                        data=b"body-bytes") as resp:
+                    assert resp.status == 200
+                    body = await resp.json()
+                    assert body["accepted"]
+            await server.stop()
+            return got
+
+        assert run(scenario()) == [b"body-bytes"]
