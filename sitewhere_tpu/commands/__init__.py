@@ -1,0 +1,22 @@
+"""Cloud->device command delivery (reference: service-command-delivery)."""
+
+from sitewhere_tpu.commands.delivery import (
+    CommandDeliveryService, CommandProcessingStrategy, TargetResolver)
+from sitewhere_tpu.commands.destinations import (
+    CoapDeliveryProvider, CommandDestination, InProcDeliveryProvider,
+    MetadataParameterExtractor, MqttDeliveryProvider, MqttParameterExtractor)
+from sitewhere_tpu.commands.encoding import (
+    CommandExecution, JsonCommandEncoder, ScriptedCommandEncoder,
+    SystemCommand, WireCommandEncoder, coerce_parameters)
+from sitewhere_tpu.commands.routing import (
+    BroadcastRouter, DeviceTypeMappingRouter, SingleDestinationRouter)
+
+__all__ = [
+    "BroadcastRouter", "CoapDeliveryProvider", "CommandDeliveryService",
+    "CommandDestination", "CommandExecution", "CommandProcessingStrategy",
+    "DeviceTypeMappingRouter", "InProcDeliveryProvider", "JsonCommandEncoder",
+    "MetadataParameterExtractor", "MqttDeliveryProvider",
+    "MqttParameterExtractor", "ScriptedCommandEncoder",
+    "SingleDestinationRouter", "SystemCommand", "TargetResolver",
+    "WireCommandEncoder", "coerce_parameters",
+]

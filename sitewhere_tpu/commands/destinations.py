@@ -1,0 +1,200 @@
+"""Command destinations: encoder + parameter extractor + delivery provider.
+
+Reference: service-command-delivery destination/ — a CommandDestination
+combines an ICommandExecutionEncoder, an ICommandDeliveryParameterExtractor
+(e.g. MqttParameterExtractor building per-device topic names) and an
+ICommandDeliveryProvider (MqttCommandDeliveryProvider.java, CoAP, SMS).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, Dict, List, Optional, Protocol, Tuple
+
+from sitewhere_tpu.commands.encoding import (
+    CommandEncoder, CommandExecution, SystemCommand, WireCommandEncoder)
+from sitewhere_tpu.model.device import Device, DeviceAssignment
+from sitewhere_tpu.runtime.lifecycle import LifecycleComponent
+from sitewhere_tpu.sources.receivers import EventLoopThread
+from sitewhere_tpu.transport.coap import CoapClient
+from sitewhere_tpu.transport.mqtt import MqttClient
+
+LOGGER = logging.getLogger("sitewhere.commands")
+
+
+class ParameterExtractor(Protocol):
+    """Compute per-delivery routing parameters (topic/path/phone number)."""
+
+    def extract(self, device: Device,
+                assignment: Optional[DeviceAssignment]) -> Dict[str, str]: ...
+
+
+class MqttParameterExtractor:
+    """Default topic scheme: commands on SW/{device}/command, system
+    messages on SW/{device}/system (DefaultMqttParameterExtractor's
+    {command,system}Topic expressions)."""
+
+    def __init__(self, command_topic: str = "SW/{token}/command",
+                 system_topic: str = "SW/{token}/system"):
+        self.command_topic = command_topic
+        self.system_topic = system_topic
+
+    def extract(self, device: Device,
+                assignment: Optional[DeviceAssignment]) -> Dict[str, str]:
+        return {
+            "commandTopic": self.command_topic.format(token=device.token),
+            "systemTopic": self.system_topic.format(token=device.token),
+        }
+
+
+class MetadataParameterExtractor:
+    """Read routing parameters straight from device metadata (the pattern
+    CoapMetadataParameterExtractor uses for per-device host/port)."""
+
+    def __init__(self, keys: Dict[str, str],
+                 defaults: Optional[Dict[str, str]] = None):
+        self.keys = keys  # param name -> metadata key
+        self.defaults = defaults or {}
+
+    def extract(self, device: Device,
+                assignment: Optional[DeviceAssignment]) -> Dict[str, str]:
+        out = dict(self.defaults)
+        for name, meta_key in self.keys.items():
+            if meta_key in device.metadata:
+                out[name] = device.metadata[meta_key]
+        return out
+
+
+class DeliveryProvider(Protocol):
+    def deliver(self, device: Device, encoded: bytes,
+                parameters: Dict[str, str]) -> None: ...
+
+    def deliver_system(self, device: Device, encoded: bytes,
+                       parameters: Dict[str, str]) -> None: ...
+
+
+class MqttDeliveryProvider(LifecycleComponent):
+    """Publish encoded commands to the device's MQTT topics
+    (MqttCommandDeliveryProvider.java)."""
+
+    def __init__(self, host: str, port: int,
+                 loop_thread: Optional[EventLoopThread] = None):
+        super().__init__("mqtt-delivery")
+        self.host = host
+        self.port = port
+        self._loop_thread = loop_thread
+        self._client: Optional[MqttClient] = None
+
+    @property
+    def loop_thread(self) -> EventLoopThread:
+        if self._loop_thread is None:
+            self._loop_thread = EventLoopThread.shared()
+        return self._loop_thread
+
+    def on_start(self, monitor) -> None:
+        client = MqttClient(self.host, self.port, client_id="command-delivery")
+        self.loop_thread.run(client.connect())
+        self._client = client
+
+    def on_stop(self, monitor) -> None:
+        if self._client is not None:
+            self.loop_thread.run(self._client.disconnect())
+            self._client = None
+
+    def _publish(self, topic: str, payload: bytes) -> None:
+        if self._client is None:
+            raise RuntimeError("mqtt delivery provider not started")
+        self.loop_thread.run(self._client.publish(topic, payload))
+
+    def deliver(self, device: Device, encoded: bytes,
+                parameters: Dict[str, str]) -> None:
+        self._publish(parameters["commandTopic"], encoded)
+
+    def deliver_system(self, device: Device, encoded: bytes,
+                       parameters: Dict[str, str]) -> None:
+        self._publish(parameters["systemTopic"], encoded)
+
+
+class CoapDeliveryProvider(LifecycleComponent):
+    """POST encoded commands to the device's CoAP endpoint; host/port/paths
+    come from extractor parameters (CoapCommandDeliveryProvider.java)."""
+
+    def __init__(self, loop_thread: Optional[EventLoopThread] = None,
+                 confirmable: bool = True):
+        super().__init__("coap-delivery")
+        self._loop_thread = loop_thread
+        self.confirmable = confirmable
+
+    @property
+    def loop_thread(self) -> EventLoopThread:
+        if self._loop_thread is None:
+            self._loop_thread = EventLoopThread.shared()
+        return self._loop_thread
+
+    def _post(self, parameters: Dict[str, str], path: str,
+              payload: bytes) -> None:
+        client = CoapClient(parameters["host"], int(parameters["port"]))
+        self.loop_thread.run(
+            client.post(path, payload, confirmable=self.confirmable))
+
+    def deliver(self, device: Device, encoded: bytes,
+                parameters: Dict[str, str]) -> None:
+        self._post(parameters, parameters.get("commandPath", "command"),
+                   encoded)
+
+    def deliver_system(self, device: Device, encoded: bytes,
+                       parameters: Dict[str, str]) -> None:
+        self._post(parameters, parameters.get("systemPath", "system"),
+                   encoded)
+
+
+class InProcDeliveryProvider(LifecycleComponent):
+    """Hand deliveries to a Python callback — used by tests and by co-located
+    device simulators (no reference equivalent needed: the in-proc path)."""
+
+    def __init__(self, callback: Optional[Callable[..., None]] = None):
+        super().__init__("inproc-delivery")
+        self.callback = callback
+        self.delivered: List[Tuple[str, bytes, Dict[str, str]]] = []
+        self.system: List[Tuple[str, bytes, Dict[str, str]]] = []
+
+    def deliver(self, device: Device, encoded: bytes,
+                parameters: Dict[str, str]) -> None:
+        self.delivered.append((device.token, encoded, parameters))
+        if self.callback:
+            self.callback("command", device, encoded, parameters)
+
+    def deliver_system(self, device: Device, encoded: bytes,
+                       parameters: Dict[str, str]) -> None:
+        self.system.append((device.token, encoded, parameters))
+        if self.callback:
+            self.callback("system", device, encoded, parameters)
+
+
+class CommandDestination(LifecycleComponent):
+    """One fully-wired delivery path (ICommandDestination): encoder +
+    parameter extractor + delivery provider, addressed by id from routers."""
+
+    def __init__(self, destination_id: str,
+                 provider: DeliveryProvider,
+                 encoder: Optional[CommandEncoder] = None,
+                 extractor: Optional[ParameterExtractor] = None):
+        super().__init__(f"command-destination:{destination_id}")
+        self.destination_id = destination_id
+        self.encoder = encoder or WireCommandEncoder()
+        self.extractor = extractor or MqttParameterExtractor()
+        self.provider = provider
+        if isinstance(provider, LifecycleComponent):
+            self.add_nested(provider)
+
+    def deliver_command(self, execution: CommandExecution, device: Device,
+                        assignment: Optional[DeviceAssignment]) -> None:
+        encoded = self.encoder.encode(execution, device, assignment)
+        parameters = self.extractor.extract(device, assignment)
+        self.provider.deliver(device, encoded, parameters)
+
+    def deliver_system_command(self, command: SystemCommand,
+                               device: Device) -> None:
+        encoded = self.encoder.encode_system(command, device)
+        parameters = self.extractor.extract(device, None)
+        self.provider.deliver_system(device, encoded, parameters)

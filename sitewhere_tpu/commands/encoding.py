@@ -1,0 +1,122 @@
+"""Command encoders: CommandExecution -> on-the-wire bytes for a device.
+
+Reference: service-command-delivery encoders — per-device-type protobuf via
+ProtobufMessageBuilder (sitewhere-communication
+protobuf/ProtobufMessageBuilder.java), Groovy scripted encoders, and
+JSON encoders. Here the wire encoder emits COMMAND frames of the framework's
+device wire protocol (transport/wire.py), the JSON encoder emits plain JSON
+for HTTP-ish devices, and the scripted encoder takes any Python callable —
+the Groovy extension point without a JVM.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional, Protocol
+
+from sitewhere_tpu.model.device import Device, DeviceAssignment, DeviceCommand
+from sitewhere_tpu.model.event import DeviceCommandInvocation
+from sitewhere_tpu.transport.wire import MessageType, WireCodec, encode_frame
+
+
+@dataclass
+class CommandExecution:
+    """A resolved invocation ready to encode (IDeviceCommandExecution):
+    the invocation event + the command definition + coerced parameters."""
+
+    invocation: DeviceCommandInvocation
+    command: DeviceCommand
+    parameters: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class SystemCommand:
+    """Cloud->device system message (non-invocation), e.g. a registration
+    ack (Device.Command.ACK_REGISTRATION in sitewhere.proto)."""
+
+    message_type: MessageType
+    payload: bytes
+
+
+class CommandEncoder(Protocol):
+    def encode(self, execution: CommandExecution, device: Device,
+               assignment: Optional[DeviceAssignment]) -> bytes: ...
+
+    def encode_system(self, command: SystemCommand, device: Device) -> bytes: ...
+
+
+class WireCommandEncoder:
+    """Encode as wire-protocol frames — the default binary device SDK path
+    (counterpart of ProtobufExecutionEncoder)."""
+
+    def encode(self, execution: CommandExecution, device: Device,
+               assignment: Optional[DeviceAssignment]) -> bytes:
+        payload = WireCodec.encode_command(
+            token=device.token, command=execution.command.name,
+            parameters=execution.parameters,
+            invocation_id=execution.invocation.id)
+        return encode_frame(MessageType.COMMAND, payload)
+
+    def encode_system(self, command: SystemCommand, device: Device) -> bytes:
+        return encode_frame(command.message_type, command.payload)
+
+
+class JsonCommandEncoder:
+    """Encode as a JSON document (JsonCommandExecutionEncoder)."""
+
+    def encode(self, execution: CommandExecution, device: Device,
+               assignment: Optional[DeviceAssignment]) -> bytes:
+        return json.dumps({
+            "deviceToken": device.token,
+            "command": execution.command.name,
+            "namespace": execution.command.namespace,
+            "invocationId": execution.invocation.id,
+            "parameters": execution.parameters,
+        }).encode("utf-8")
+
+    def encode_system(self, command: SystemCommand, device: Device) -> bytes:
+        return json.dumps({
+            "deviceToken": device.token,
+            "systemCommand": MessageType(command.message_type).name,
+            "payload": command.payload.hex(),
+        }).encode("utf-8")
+
+
+class ScriptedCommandEncoder:
+    """User-supplied callable `(execution, device, assignment) -> bytes`
+    (GroovyCommandExecutionEncoder's extension point)."""
+
+    def __init__(self, script: Callable[..., bytes],
+                 system_script: Optional[Callable[..., bytes]] = None):
+        self.script = script
+        self.system_script = system_script
+
+    def encode(self, execution: CommandExecution, device: Device,
+               assignment: Optional[DeviceAssignment]) -> bytes:
+        return self.script(execution, device, assignment)
+
+    def encode_system(self, command: SystemCommand, device: Device) -> bytes:
+        if self.system_script is None:
+            return WireCommandEncoder().encode_system(command, device)
+        return self.system_script(command, device)
+
+
+def coerce_parameters(command: DeviceCommand,
+                      values: Dict[str, Any]) -> Dict[str, str]:
+    """Validate invocation parameter values against the command's declared
+    parameters; required parameters must be present (the validation
+    DefaultCommandProcessingStrategy performs before encoding)."""
+    out: Dict[str, str] = {}
+    declared = {p.name for p in command.parameters}
+    for parameter in command.parameters:
+        if parameter.name in values:
+            out[parameter.name] = str(values[parameter.name])
+        elif parameter.required:
+            raise ValueError(
+                f"missing required parameter '{parameter.name}' "
+                f"for command '{command.name}'")
+    for name, value in values.items():
+        if name not in declared:  # pass through undeclared extras
+            out[name] = str(value)
+    return out

@@ -140,8 +140,8 @@ def _asdict(obj: Any) -> Any:
         return {k: _asdict(v) for k, v in obj.items()}
     if isinstance(obj, (list, tuple)):
         return [_asdict(v) for v in obj]
-    if isinstance(obj, (str, int, float, bool)) or obj is None:
-        return obj
+    if isinstance(obj, (str, int, float, bool, bytes)) or obj is None:
+        return obj  # bytes pass through: msgpack handles them natively
     if hasattr(obj, "value"):  # enums
         return obj.value
     return str(obj)

@@ -211,3 +211,35 @@ EVENT_CLASS_BY_TYPE = {
     DeviceEventType.STATE_CHANGE: DeviceStateChange,
     DeviceEventType.STREAM_DATA: DeviceStreamData,
 }
+
+_EVENT_ENUM_FIELDS = {
+    "event_type": DeviceEventType,
+    "source": AlertSource,
+    "level": AlertLevel,
+    "initiator": CommandInitiator,
+    "target": CommandTarget,
+}
+
+
+def event_from_dict(data: Dict[str, Any]) -> DeviceEvent:
+    """Rebuild a concrete DeviceEvent from its `to_dict()` form.
+
+    The inverse of the proto->API conversion the reference does in
+    EventModelConverter when a consumer pulls a payload off a Kafka topic.
+    Unknown keys (like the redundant "eventType" name) are dropped so payloads
+    stay forward-compatible.
+    """
+    import dataclasses as _dc
+
+    etype = DeviceEventType(data["event_type"])
+    cls = EVENT_CLASS_BY_TYPE[etype]
+    names = {f.name for f in _dc.fields(cls)}
+    kwargs: Dict[str, Any] = {}
+    for key, value in data.items():
+        if key not in names:
+            continue
+        enum_cls = _EVENT_ENUM_FIELDS.get(key)
+        if enum_cls is not None:
+            value = enum_cls(value)
+        kwargs[key] = value
+    return cls(**kwargs)

@@ -16,7 +16,6 @@ a date range — EventIndex mirrors that.
 from __future__ import annotations
 
 import enum
-import threading
 from typing import Callable, Dict, List, Optional, Sequence
 
 import msgpack
@@ -52,6 +51,23 @@ _INDEX_FIELD = {
 }
 
 
+def context_for_assignment(registry, assignment_token: str,
+                           tenant: str) -> DeviceEventContext:
+    """Resolve assignment token -> full event context (the lookup the
+    reference does over gRPC in both persistence and enrichment). Shared by
+    DeviceEventManagement and PayloadEnrichment so their contexts never
+    diverge."""
+    assignment = registry.get_device_assignment_by_token(assignment_token)
+    if assignment is None:
+        raise SiteWhereError(f"unknown assignment: {assignment_token}")
+    device = registry.get_device(assignment.device_id)
+    return DeviceEventContext(
+        device_id=device.id, device_token=device.token,
+        device_type_id=device.device_type_id, assignment_id=assignment.token,
+        customer_id=assignment.customer_id, area_id=assignment.area_id,
+        asset_id=assignment.asset_id, tenant_id=tenant)
+
+
 class DeviceEventManagement(LifecycleComponent):
     """Tenant-scoped event persistence facade.
 
@@ -68,7 +84,6 @@ class DeviceEventManagement(LifecycleComponent):
         self.tenant = tenant
         self.device_interner = device_interner
         self._listeners: List[Callable[[List[DeviceEvent]], None]] = []
-        self._lock = threading.Lock()
 
     # -- lifecycle ---------------------------------------------------------
     def on_start(self, monitor) -> None:
@@ -92,16 +107,8 @@ class DeviceEventManagement(LifecycleComponent):
         if self.registry is None:
             return DeviceEventContext(assignment_id=assignment_token,
                                       tenant_id=self.tenant)
-        assignment = self.registry.get_device_assignment_by_token(assignment_token)
-        if assignment is None:
-            raise SiteWhereError(f"unknown assignment: {assignment_token}")
-        device = self.registry.get_device(assignment.device_id)
-        return DeviceEventContext(
-            device_id=device.id, device_token=device.token,
-            device_type_id=device.device_type_id,
-            assignment_id=assignment.token, customer_id=assignment.customer_id,
-            area_id=assignment.area_id, asset_id=assignment.asset_id,
-            tenant_id=self.tenant)
+        return context_for_assignment(self.registry, assignment_token,
+                                      self.tenant)
 
     def _stamp(self, ev: DeviceEvent, ctx: DeviceEventContext) -> DeviceEvent:
         if not ev.id:

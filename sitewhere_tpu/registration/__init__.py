@@ -1,0 +1,6 @@
+"""Device auto-registration (reference: service-device-registration)."""
+
+from sitewhere_tpu.registration.manager import (
+    RegistrationAckState, RegistrationManager)
+
+__all__ = ["RegistrationAckState", "RegistrationManager"]

@@ -323,6 +323,9 @@ class DeviceManagement:
         self._notify("device_type", result)
         return result
 
+    def get_device_type(self, device_type_id: str) -> Optional[DeviceType]:
+        return self.device_types.get(device_type_id)
+
     def get_device_type_by_token(self, token: str) -> DeviceType:
         return self.device_types.require_by_token(token)
 

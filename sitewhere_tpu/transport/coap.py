@@ -74,6 +74,74 @@ def build_response(mtype: int, code: int, mid: int, token: bytes,
     return head + (b"\xff" + payload if payload else b"")
 
 
+def _encode_option(delta: int, value: bytes) -> bytes:
+    def nibble(n: int) -> Tuple[int, bytes]:
+        if n < 13:
+            return n, b""
+        if n < 269:
+            return 13, bytes([n - 13])
+        return 14, struct.pack("!H", n - 269)
+
+    dn, dext = nibble(delta)
+    ln, lext = nibble(len(value))
+    return bytes([(dn << 4) | ln]) + dext + lext + value
+
+
+def build_request(mtype: int, code: int, mid: int, path: str,
+                  payload: bytes = b"", token: bytes = b"") -> bytes:
+    """Client-side message builder (the piece Californium provides the
+    reference's CoapCommandDeliveryProvider)."""
+    head = bytes([(1 << 6) | (mtype << 4) | len(token), code]) + \
+        struct.pack("!H", mid) + token
+    options = b""
+    previous = 0
+    for segment in path.strip("/").split("/"):
+        if not segment:
+            continue
+        options += _encode_option(OPT_URI_PATH - previous,
+                                  segment.encode("utf-8"))
+        previous = OPT_URI_PATH
+    return head + options + (b"\xff" + payload if payload else b"")
+
+
+class CoapClient:
+    """Minimal CoAP client: POST a payload to host:port/path. CON requests
+    wait for the piggybacked ACK; NON requests are fire-and-forget."""
+
+    def __init__(self, host: str, port: int):
+        self.host = host
+        self.port = port
+        self._mid = 0
+
+    async def post(self, path: str, payload: bytes, confirmable: bool = True,
+                   timeout_s: float = 5.0) -> Optional[int]:
+        """Returns the response code for CON, None for NON."""
+        self._mid = (self._mid + 1) & 0xFFFF
+        mtype = TYPE_CON if confirmable else TYPE_NON
+        message = build_request(mtype, POST, self._mid, path, payload)
+        loop = asyncio.get_running_loop()
+        done: asyncio.Future = loop.create_future()
+        mid = self._mid
+
+        class _ClientProtocol(asyncio.DatagramProtocol):
+            def connection_made(self, transport) -> None:
+                transport.sendto(message)
+
+            def datagram_received(self, data: bytes, addr) -> None:
+                parsed = parse_message(data)
+                if parsed and parsed[2] == mid and not done.done():
+                    done.set_result(parsed[1])
+
+        transport, _ = await loop.create_datagram_endpoint(
+            _ClientProtocol, remote_addr=(self.host, self.port))
+        try:
+            if not confirmable:
+                return None
+            return await asyncio.wait_for(done, timeout_s)
+        finally:
+            transport.close()
+
+
 class CoapServer:
     """`handler(path, payload, method) -> response payload or None` runs for
     every POST/PUT; exceptions map to 5.00."""
