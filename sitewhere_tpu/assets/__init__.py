@@ -1,0 +1,5 @@
+"""Asset management (reference: service-asset-management)."""
+
+from sitewhere_tpu.assets.manager import AssetManagement
+
+__all__ = ["AssetManagement"]

@@ -78,10 +78,15 @@ class MqttDeliveryProvider(LifecycleComponent):
     (MqttCommandDeliveryProvider.java)."""
 
     def __init__(self, host: str, port: int,
+                 client_id: Optional[str] = None,
                  loop_thread: Optional[EventLoopThread] = None):
         super().__init__("mqtt-delivery")
         self.host = host
         self.port = port
+        # unique default: two providers on one broker must not take over
+        # each other's MQTT session
+        from sitewhere_tpu.model.common import new_id
+        self.client_id = client_id or f"command-delivery-{new_id()[:8]}"
         self._loop_thread = loop_thread
         self._client: Optional[MqttClient] = None
 
@@ -92,7 +97,7 @@ class MqttDeliveryProvider(LifecycleComponent):
         return self._loop_thread
 
     def on_start(self, monitor) -> None:
-        client = MqttClient(self.host, self.port, client_id="command-delivery")
+        client = MqttClient(self.host, self.port, client_id=self.client_id)
         self.loop_thread.run(client.connect())
         self._client = client
 

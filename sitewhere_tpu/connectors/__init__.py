@@ -1,0 +1,21 @@
+"""Outbound connectors (reference: service-outbound-connectors)."""
+
+from sitewhere_tpu.connectors.base import OutboundConnector
+from sitewhere_tpu.connectors.filters import (
+    AreaFilter, DeviceTypeFilter, EventTypeFilter, FilterOperation,
+    ScriptedFilter)
+from sitewhere_tpu.connectors.host import (
+    OutboundConnectorHost, OutboundConnectorsManager)
+from sitewhere_tpu.connectors.sinks import (
+    CollectingConnector, DeviceEventMulticaster, EventIndexConnector,
+    HttpPostConnector, MqttOutboundConnector, ScriptedConnector,
+    all_devices_of_type_route, event_to_json)
+
+__all__ = [
+    "AreaFilter", "CollectingConnector", "DeviceEventMulticaster",
+    "DeviceTypeFilter", "EventIndexConnector", "EventTypeFilter",
+    "FilterOperation", "HttpPostConnector", "MqttOutboundConnector",
+    "OutboundConnector", "OutboundConnectorHost", "OutboundConnectorsManager",
+    "ScriptedConnector", "ScriptedFilter", "all_devices_of_type_route",
+    "event_to_json",
+]

@@ -1,0 +1,165 @@
+"""Concrete outbound connectors.
+
+Reference: service-outbound-connectors — MQTT (MqttOutboundConnector),
+Solr indexing (solr/SolrOutboundConnector.java), Groovy scripted, plus
+multicasting with route builders (spi/multicast/IDeviceEventMulticaster,
+groovy/routing/GroovyRouteBuilder). Cloud-vendor sinks (SQS/EventHub/
+InitialState/dweet.io) are network clients the image can't reach; their
+role — JSON-serialized event POST to an external endpoint — is covered by
+HttpPostConnector against any URL.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from typing import Callable, Dict, List, Optional, Tuple
+
+from sitewhere_tpu.connectors.base import OutboundConnector
+from sitewhere_tpu.model.event import DeviceEvent, DeviceEventContext
+from sitewhere_tpu.sources.receivers import EventLoopThread
+from sitewhere_tpu.transport.mqtt import MqttClient
+
+LOGGER = logging.getLogger("sitewhere.connectors")
+
+
+def event_to_json(context: DeviceEventContext, event: DeviceEvent) -> bytes:
+    payload = event.to_dict()
+    payload["device"] = context.device_token
+    payload["area"] = context.area_id
+    payload["assignment"] = context.assignment_id
+    return json.dumps(payload, default=str).encode("utf-8")
+
+
+class MqttOutboundConnector(OutboundConnector):
+    """Publish every accepted event as JSON to an MQTT topic; with a
+    multicaster, to one topic per route (MqttOutboundConnector.java)."""
+
+    def __init__(self, connector_id: str, host: str, port: int,
+                 topic: str = "SW/outbound", filters=None,
+                 multicaster: Optional["DeviceEventMulticaster"] = None,
+                 loop_thread: Optional[EventLoopThread] = None):
+        super().__init__(connector_id, filters)
+        self.host = host
+        self.port = port
+        self.topic = topic
+        self.multicaster = multicaster
+        self._loop_thread = loop_thread
+        self._client: Optional[MqttClient] = None
+
+    @property
+    def loop_thread(self) -> EventLoopThread:
+        if self._loop_thread is None:
+            self._loop_thread = EventLoopThread.shared()
+        return self._loop_thread
+
+    def on_start(self, monitor) -> None:
+        client = MqttClient(self.host, self.port,
+                            client_id=f"connector-{self.connector_id}")
+        self.loop_thread.run(client.connect())
+        self._client = client
+
+    def on_stop(self, monitor) -> None:
+        if self._client is not None:
+            self.loop_thread.run(self._client.disconnect())
+            self._client = None
+
+    def process_batch(self, batch: List[Tuple[DeviceEventContext,
+                                              DeviceEvent]]) -> None:
+        if self._client is None:
+            raise RuntimeError(f"connector {self.connector_id} not started")
+        for context, event in batch:
+            payload = event_to_json(context, event)
+            topics = ([r for r in self.multicaster.routes(context, event)]
+                      if self.multicaster else [self.topic])
+            for topic in topics:
+                self.loop_thread.run(self._client.publish(topic, payload))
+
+
+class ScriptedConnector(OutboundConnector):
+    """User callable `(context, event) -> None` per event (Groovy connector
+    extension point)."""
+
+    def __init__(self, connector_id: str,
+                 script: Callable[[DeviceEventContext, DeviceEvent], None],
+                 filters=None):
+        super().__init__(connector_id, filters)
+        self.script = script
+
+    def process_batch(self, batch) -> None:
+        for context, event in batch:
+            self.script(context, event)
+
+
+class EventIndexConnector(OutboundConnector):
+    """Feed accepted events into an EventSearchIndex (search/index.py) —
+    the role SolrOutboundConnector plays for the reference's event search."""
+
+    def __init__(self, connector_id: str, index, filters=None):
+        super().__init__(connector_id, filters)
+        self.index = index
+
+    def process_batch(self, batch) -> None:
+        self.index.add_batch(batch)
+
+
+class CollectingConnector(OutboundConnector):
+    """Collect events in memory — test double and debugging tap."""
+
+    def __init__(self, connector_id: str = "collector", filters=None):
+        super().__init__(connector_id, filters)
+        self.collected: List[Tuple[DeviceEventContext, DeviceEvent]] = []
+
+    def process_batch(self, batch) -> None:
+        self.collected.extend(batch)
+
+
+class HttpPostConnector(OutboundConnector):
+    """POST JSON events to an HTTP endpoint — the shape of the reference's
+    InitialState/dweet.io connectors, target-agnostic."""
+
+    def __init__(self, connector_id: str, url: str, filters=None,
+                 timeout_s: float = 5.0):
+        super().__init__(connector_id, filters)
+        self.url = url
+        self.timeout_s = timeout_s
+
+    def process_batch(self, batch) -> None:
+        import urllib.request
+        for context, event in batch:
+            request = urllib.request.Request(
+                self.url, data=event_to_json(context, event),
+                headers={"Content-Type": "application/json"}, method="POST")
+            urllib.request.urlopen(request, timeout=self.timeout_s).read()
+
+
+class DeviceEventMulticaster:
+    """Compute delivery routes per event (IDeviceEventMulticaster). Route
+    builders are callables `(context, event) -> list[str]`
+    (GroovyRouteBuilder's extension point)."""
+
+    def __init__(self, builders: Optional[List[Callable[..., List[str]]]] = None):
+        self.builders = builders or []
+
+    def add_builder(self, builder: Callable[..., List[str]]) -> None:
+        self.builders.append(builder)
+
+    def routes(self, context: DeviceEventContext,
+               event: DeviceEvent) -> List[str]:
+        out: List[str] = []
+        for builder in self.builders:
+            out.extend(builder(context, event))
+        return out
+
+
+def all_devices_of_type_route(registry, device_type_token: str,
+                              topic_pattern: str = "SW/{token}/broadcast"
+                              ) -> Callable[..., List[str]]:
+    """AllWithSpecificationStringMulticaster: route an event to a topic per
+    device of the given type."""
+    def builder(context: DeviceEventContext, event: DeviceEvent) -> List[str]:
+        device_type = registry.get_device_type_by_token(device_type_token)
+        return [topic_pattern.format(token=d.token)
+                for d in registry.devices.all()
+                if d.device_type_id == device_type.id]
+    return builder

@@ -221,6 +221,27 @@ _EVENT_ENUM_FIELDS = {
 }
 
 
+_EVENT_HOOK_BY_TYPE = {
+    DeviceEventType.MEASUREMENT: "on_measurement",
+    DeviceEventType.LOCATION: "on_location",
+    DeviceEventType.ALERT: "on_alert",
+    DeviceEventType.COMMAND_INVOCATION: "on_command_invocation",
+    DeviceEventType.COMMAND_RESPONSE: "on_command_response",
+    DeviceEventType.STATE_CHANGE: "on_state_change",
+    DeviceEventType.STREAM_DATA: "on_stream_data",
+}
+
+
+def dispatch_event(handler: Any, context: Any, event: DeviceEvent) -> None:
+    """Route an event to the handler's typed `on_*` hook (the per-type switch
+    of KafkaRuleProcessorHost.attemptToProcess / outbound connector
+    processors). Missing hooks are no-ops."""
+    hook = getattr(handler, _EVENT_HOOK_BY_TYPE.get(event.event_type, ""),
+                   None)
+    if hook is not None:
+        hook(context, event)
+
+
 def event_from_dict(data: Dict[str, Any]) -> DeviceEvent:
     """Rebuild a concrete DeviceEvent from its `to_dict()` form.
 

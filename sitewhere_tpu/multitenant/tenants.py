@@ -1,0 +1,76 @@
+"""Tenant management: CRUD + model-update notifications.
+
+Reference: service-tenant-management — ITenantManagement CRUD and the
+tenant-model-updates Kafka topic (KafkaTopicNaming.java:41) that
+MultitenantMicroservices watch to boot/stop tenant engines.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional
+
+from sitewhere_tpu.errors import ErrorCode, SiteWhereError
+from sitewhere_tpu.model.common import SearchCriteria, SearchResults, new_id
+from sitewhere_tpu.model.tenant import Tenant
+from sitewhere_tpu.registry.store import InMemoryStore, _Collection
+
+
+class TenantManagement:
+    """ITenantManagement. `bus`/`naming` optional: when present, every
+    mutation publishes a tenant-model-update record."""
+
+    def __init__(self, store=None, bus=None, naming=None):
+        store = store or InMemoryStore()
+        self.tenants: _Collection[Tenant] = _Collection(
+            "tenant", Tenant, store, ErrorCode.INVALID_TENANT_TOKEN)
+        self.bus = bus
+        self.naming = naming
+
+    def _notify(self, operation: str, tenant: Tenant) -> None:
+        if self.bus is None or self.naming is None:
+            return
+        self.bus.publish(
+            self.naming.tenant_model_updates(),
+            tenant.token.encode(),
+            json.dumps({"operation": operation,
+                        "tenant": tenant.token}).encode())
+
+    def create_tenant(self, tenant: Tenant) -> Tenant:
+        if not tenant.authentication_token:
+            tenant.authentication_token = new_id()
+        created = self.tenants.create(tenant)
+        self._notify("create", created)
+        return created
+
+    def get_tenant_by_token(self, token: str) -> Optional[Tenant]:
+        return self.tenants.get_by_token(token)
+
+    def get_tenant_by_authentication_token(self, auth_token: str
+                                           ) -> Optional[Tenant]:
+        for tenant in self.tenants.all():
+            if tenant.authentication_token == auth_token:
+                return tenant
+        return None
+
+    def update_tenant(self, token: str, updates: Dict) -> Tenant:
+        entity = self.tenants.require_by_token(token)
+        updated = self.tenants.update(entity.id, updates)
+        self._notify("update", updated)
+        return updated
+
+    def delete_tenant(self, token: str) -> Tenant:
+        entity = self.tenants.require_by_token(token)
+        deleted = self.tenants.delete(entity.id)
+        self._notify("delete", deleted)
+        return deleted
+
+    def list_tenants(self, criteria: Optional[SearchCriteria] = None,
+                     authorized_user_id: Optional[str] = None
+                     ) -> SearchResults[Tenant]:
+        if authorized_user_id is None:
+            return self.tenants.list(criteria)
+        from sitewhere_tpu.model.common import page
+        items = [t for t in self.tenants.all()
+                 if authorized_user_id in t.authorized_user_ids]
+        return page(items, criteria or SearchCriteria())

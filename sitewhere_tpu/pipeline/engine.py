@@ -212,7 +212,7 @@ class PipelineEngine(LifecycleComponent):
     def submit(self, batch: EventBatch) -> ProcessOutputs:
         """Run one fused step; state advances in place (donated)."""
         if self._state is None:  # lazy init for direct (un-started) use
-            self.on_initialize(None)
+            self.initialize()  # full lifecycle init so a later start() won't re-init
         params = self._ensure_params()
         with self._metrics.timer("step").time():
             self._state, outputs = self._step(params, self._state, batch)

@@ -104,7 +104,14 @@ class InboundProcessingService(LifecycleComponent):
                 hot.append((event, token))
             self.processed_meter.mark(len(persisted))
         if self.engine is not None and hot:
-            self._submit_hot(hot)
+            # Never let the hot path poison the consumer: a raising handler
+            # would redeliver the batch and re-persist duplicates forever.
+            try:
+                self._submit_hot(hot)
+            except Exception:
+                self.failed_counter.inc()
+                LOGGER.exception("fused step failed for batch of %d events",
+                                 len(hot))
 
     def _validate(self, token: str, record: Record) -> bool:
         """Device + active-assignment check
@@ -146,8 +153,9 @@ class InboundProcessingService(LifecycleComponent):
                         persisted.extend(self.events.add_stream_data(
                             assignment.token, event))
             return persisted
-        except SiteWhereError:
+        except Exception:
             self.failed_counter.inc()
+            LOGGER.exception("persist failed for device '%s'", token)
             return []
 
     def _submit_hot(self, hot: List[Tuple[DeviceEvent, str]]) -> None:

@@ -120,20 +120,24 @@ class RegistrationManager(LifecycleComponent):
                       or self.default_device_type_token)
         if not type_token:
             raise SiteWhereError("no device type for registration")
+        # Resolve everything BEFORE creating the device: a half-registered
+        # device (no assignment) would ack ALREADY_REGISTERED on retry and
+        # never become able to send events.
         device_type = self.registry.get_device_type_by_token(type_token)
-        device = self.registry.create_device(Device(
-            token=request.device_token, device_type_id=device_type.id,
-            metadata=dict(request.metadata)))
+        area_id = ""
+        customer_id = ""
         if self.auto_assign:
             area_token = request.area_token or self.default_area_token
-            area_id = ""
             if area_token:
                 area_id = self.registry.get_area_by_token(area_token).id
-            customer_id = ""
             if request.customer_token:
                 customer = self.registry.customers.get_by_token(
                     request.customer_token)
                 customer_id = customer.id if customer else ""
+        device = self.registry.create_device(Device(
+            token=request.device_token, device_type_id=device_type.id,
+            metadata=dict(request.metadata)))
+        if self.auto_assign:
             self.registry.create_device_assignment(DeviceAssignment(
                 device_id=device.id, area_id=area_id,
                 customer_id=customer_id))

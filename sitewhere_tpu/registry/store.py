@@ -26,7 +26,8 @@ from sitewhere_tpu.model import (
     DeviceAssignmentStatus, DeviceCommand, DeviceGroup, DeviceGroupElement,
     DeviceStatus, DeviceType, Zone,
 )
-from sitewhere_tpu.model.common import SearchCriteria, SearchResults, now_ms, page
+from sitewhere_tpu.model.common import (
+    SearchCriteria, SearchResults, new_id, now_ms, page)
 from sitewhere_tpu.model.device import CommandParameter, DeviceElementMapping, ParameterType
 
 T = TypeVar("T")
@@ -80,11 +81,22 @@ def _entity_from_json(cls: Type[T], payload: str) -> T:
 
 from sitewhere_tpu.model.device import DeviceContainerPolicy
 from sitewhere_tpu.model.device import DeviceAlarmState
+from sitewhere_tpu.model.asset import AssetCategory
+from sitewhere_tpu.model.batch import (
+    BatchOperationStatus, ElementProcessingStatus)
+from sitewhere_tpu.model.schedule import (
+    ScheduledJobState, ScheduledJobType, TriggerType)
 
 _ENUM_TYPES = {
     "DeviceAssignmentStatus": DeviceAssignmentStatus,
     "DeviceContainerPolicy": DeviceContainerPolicy,
     "DeviceAlarmState": DeviceAlarmState,
+    "AssetCategory": AssetCategory,
+    "BatchOperationStatus": BatchOperationStatus,
+    "ElementProcessingStatus": ElementProcessingStatus,
+    "TriggerType": TriggerType,
+    "ScheduledJobType": ScheduledJobType,
+    "ScheduledJobState": ScheduledJobState,
 }
 
 
@@ -173,12 +185,16 @@ class _Collection(Generic[T]):
     def create(self, entity: T) -> T:
         with self._lock:
             token = getattr(entity, "token", "")
-            if token and token in self.by_token:
+            if not token:
+                # reference behavior: token auto-assigned when not provided
+                # (Persistence.java entityCreateLogic UUID fallback)
+                token = new_id()
+                entity.token = token
+            if token in self.by_token:
                 raise DuplicateTokenError(
                     f"{self.kind} token '{token}' already exists")
             self.by_id[entity.id] = entity
-            if token:
-                self.by_token[token] = entity
+            self.by_token[token] = entity
             self.store.save(self.kind, entity.id, token, _entity_to_json(entity))
             return entity
 

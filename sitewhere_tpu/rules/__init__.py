@@ -1,0 +1,8 @@
+"""Streaming rule processing (reference: service-rule-processing)."""
+
+from sitewhere_tpu.rules.processor import (
+    RuleProcessor, RuleProcessorHost, RuleProcessorsManager,
+    ZoneTestRuleProcessor)
+
+__all__ = ["RuleProcessor", "RuleProcessorHost", "RuleProcessorsManager",
+           "ZoneTestRuleProcessor"]

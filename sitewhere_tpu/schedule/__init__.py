@@ -1,0 +1,10 @@
+"""Scheduling (reference: service-schedule-management)."""
+
+from sitewhere_tpu.schedule.cron import CronError, CronExpression
+from sitewhere_tpu.schedule.manager import (
+    BatchCommandInvocationJobExecutor, CommandInvocationJobExecutor,
+    ScheduleManagement, ScheduleManager)
+
+__all__ = ["BatchCommandInvocationJobExecutor", "CommandInvocationJobExecutor",
+           "CronError", "CronExpression", "ScheduleManagement",
+           "ScheduleManager"]

@@ -1,0 +1,93 @@
+"""User management: users + granted authorities, authentication.
+
+Reference: service-user-management — IUserManagement CRUD, BCrypt password
+checks backing JWT issuance, authority hierarchy
+(GrantedAuthorityHierarchy); global (not multitenant) like the reference.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from sitewhere_tpu.errors import ErrorCode, SiteWhereError
+from sitewhere_tpu.model.common import (
+    SearchCriteria, SearchResults, now_ms, page)
+from sitewhere_tpu.model.user import (
+    ACCOUNT_STATUS, GrantedAuthority, SiteWhereRoles, User)
+from sitewhere_tpu.registry.store import InMemoryStore, _Collection
+from sitewhere_tpu.security.auth import hash_password, verify_password
+
+
+class UserManagement:
+    """IUserManagement: users keyed by username (stored in `token`)."""
+
+    def __init__(self, store=None):
+        store = store or InMemoryStore()
+        self.users: _Collection[User] = _Collection(
+            "user", User, store, ErrorCode.INVALID_USERNAME)
+        self._authorities: Dict[str, GrantedAuthority] = {}
+        for role in SiteWhereRoles.ALL:
+            self._authorities[role] = GrantedAuthority(
+                authority=role, description=role.replace("_", " ").title())
+
+    # -- users -------------------------------------------------------------
+    def create_user(self, user: User, password: str = "") -> User:
+        if not user.username:
+            raise SiteWhereError("username required", ErrorCode.INVALID_USERNAME)
+        if self.users.get_by_token(user.username) is not None:
+            raise SiteWhereError(f"user '{user.username}' exists",
+                                 ErrorCode.DUPLICATE_USER)
+        user.token = user.username
+        if password:
+            user.hashed_password = hash_password(password)
+        return self.users.create(user)
+
+    def get_user_by_username(self, username: str) -> Optional[User]:
+        return self.users.get_by_token(username)
+
+    def update_user(self, username: str, updates: Dict,
+                    password: Optional[str] = None) -> User:
+        user = self.users.require_by_token(username)
+        if password:
+            updates = {**updates, "hashed_password": hash_password(password)}
+        return self.users.update(user.id, updates)
+
+    def delete_user(self, username: str) -> User:
+        user = self.users.require_by_token(username)
+        return self.users.delete(user.id)
+
+    def list_users(self, criteria: Optional[SearchCriteria] = None
+                   ) -> SearchResults[User]:
+        return self.users.list(criteria)
+
+    # -- authentication ----------------------------------------------------
+    def authenticate(self, username: str, password: str,
+                     update_last_login: bool = True) -> User:
+        """Password check backing JWT issuance (reference
+        UserManagementImpl.authenticate)."""
+        user = self.users.get_by_token(username)
+        if user is None or not verify_password(password, user.hashed_password):
+            raise SiteWhereError("invalid credentials",
+                                 ErrorCode.INVALID_PASSWORD, http_status=401)
+        if user.status != ACCOUNT_STATUS.ACTIVE:
+            raise SiteWhereError(f"account {user.status}",
+                                 ErrorCode.NOT_AUTHORIZED, http_status=401)
+        if update_last_login:
+            self.users.update(user.id, {"last_login_date": now_ms()})
+        return user
+
+    # -- authorities -------------------------------------------------------
+    def create_granted_authority(self, authority: GrantedAuthority
+                                 ) -> GrantedAuthority:
+        self._authorities[authority.authority] = authority
+        return authority
+
+    def get_granted_authority(self, name: str) -> Optional[GrantedAuthority]:
+        return self._authorities.get(name)
+
+    def list_granted_authorities(self) -> List[GrantedAuthority]:
+        return sorted(self._authorities.values(), key=lambda a: a.authority)
+
+    def get_user_authorities(self, username: str) -> List[str]:
+        user = self.users.require_by_token(username)
+        return list(user.authorities)

@@ -1,0 +1,186 @@
+"""Batch operations + schedule management."""
+
+import time
+from datetime import datetime
+
+import pytest
+
+from sitewhere_tpu.batch import (
+    BatchCommandInvocationHandler, BatchManagement, BatchOperationManager,
+    batch_command_invocation_request)
+from sitewhere_tpu.model.batch import (
+    BatchOperationStatus, ElementProcessingStatus)
+from sitewhere_tpu.model.device import (
+    CommandParameter, Device, DeviceAssignment, DeviceCommand, DeviceType)
+from sitewhere_tpu.model.schedule import (
+    JobConstants, Schedule, ScheduledJob, ScheduledJobState, ScheduledJobType,
+    TriggerConstants, TriggerType)
+from sitewhere_tpu.persist.event_management import (
+    DeviceEventManagement, EventIndex)
+from sitewhere_tpu.persist.eventlog import ColumnarEventLog
+from sitewhere_tpu.registry.store import DeviceManagement, SqliteStore
+from sitewhere_tpu.schedule import (
+    CommandInvocationJobExecutor, CronError, CronExpression,
+    ScheduleManagement, ScheduleManager)
+
+
+@pytest.fixture
+def world(tmp_path):
+    dm = DeviceManagement()
+    dtype = dm.create_device_type(DeviceType(token="sensor"))
+    dm.create_device_command(DeviceCommand(
+        token="ping", device_type_id=dtype.id, name="ping"))
+    for i in range(5):
+        device = dm.create_device(Device(token=f"dev-{i}",
+                                         device_type_id=dtype.id))
+        dm.create_device_assignment(DeviceAssignment(
+            token=f"assn-{i}", device_id=device.id))
+    log = ColumnarEventLog(str(tmp_path / "log"))
+    events = DeviceEventManagement(log, dm)
+    events.start()
+    yield dm, events, log
+    events.stop()
+
+
+class TestBatchOperations:
+    def test_invoke_command_batch(self, world):
+        dm, events, log = world
+        batch = BatchManagement()
+        manager = BatchOperationManager(batch)
+        manager.register_handler("InvokeCommand",
+                                 BatchCommandInvocationHandler(dm, events))
+        operation = batch_command_invocation_request(
+            "ping", {"n": "1"}, [f"dev-{i}" for i in range(5)])
+        batch.create_batch_operation(operation, dm)
+        finished = manager.process(operation)
+        assert finished.processing_status == \
+            BatchOperationStatus.FINISHED_SUCCESSFULLY
+        elements = batch.list_batch_elements(operation.token)
+        assert elements.num_results == 5
+        assert all(e.processing_status == ElementProcessingStatus.SUCCEEDED
+                   for e in elements.results)
+        log.flush_tenant("default")
+        invocations = events.list_command_invocations(
+            EventIndex.ASSIGNMENT, "assn-0")
+        assert invocations.num_results == 1
+        assert invocations.results[0].parameter_values == {"n": "1"}
+
+    def test_batch_with_failures(self, world):
+        dm, events, log = world
+        # one device without an assignment
+        dm.create_device(Device(
+            token="dev-unassigned",
+            device_type_id=dm.get_device_type_by_token("sensor").id))
+        batch = BatchManagement()
+        manager = BatchOperationManager(batch)
+        manager.register_handler("InvokeCommand",
+                                 BatchCommandInvocationHandler(dm, events))
+        operation = batch_command_invocation_request(
+            "ping", {}, ["dev-0", "dev-unassigned"])
+        batch.create_batch_operation(operation, dm)
+        finished = manager.process(operation)
+        assert finished.processing_status == \
+            BatchOperationStatus.FINISHED_WITH_ERRORS
+        statuses = {e.metadata["deviceToken"]: e.processing_status
+                    for e in batch.list_batch_elements(operation.token).results}
+        assert statuses["dev-0"] == ElementProcessingStatus.SUCCEEDED
+        assert statuses["dev-unassigned"] == ElementProcessingStatus.FAILED
+
+    def test_sqlite_roundtrip(self, world, tmp_path):
+        dm, events, log = world
+        store = SqliteStore(str(tmp_path / "batch.db"))
+        batch = BatchManagement(store)
+        operation = batch_command_invocation_request("ping", {}, ["dev-0"])
+        batch.create_batch_operation(operation, dm)
+        reopened = BatchManagement(SqliteStore(str(tmp_path / "batch.db")))
+        loaded = reopened.get_batch_operation_by_token(operation.token)
+        assert loaded.processing_status == BatchOperationStatus.UNPROCESSED
+        assert loaded.device_tokens == ["dev-0"]
+
+
+class TestCron:
+    def test_parse_and_match(self):
+        expr = CronExpression("*/15 * * * *")
+        assert expr.matches(datetime(2026, 7, 29, 10, 30))
+        assert not expr.matches(datetime(2026, 7, 29, 10, 31))
+
+    def test_next_fire(self):
+        expr = CronExpression("0 12 * * *")  # noon daily
+        after = int(datetime(2026, 7, 29, 10, 0).timestamp() * 1000)
+        fire = datetime.fromtimestamp(expr.next_fire(after) / 1000)
+        assert (fire.hour, fire.minute) == (12, 0)
+        assert fire.day == 29
+
+    def test_dow_vs_dom(self):
+        # both restricted -> OR semantics (standard cron)
+        expr = CronExpression("0 0 13 * 5")  # 13th OR Friday
+        assert expr.matches(datetime(2026, 7, 13, 0, 0))  # a Monday, the 13th
+        assert expr.matches(datetime(2026, 7, 31, 0, 0))  # a Friday, not 13th
+
+    def test_invalid(self):
+        with pytest.raises(CronError):
+            CronExpression("61 * * * *")
+        with pytest.raises(CronError):
+            CronExpression("* * *")
+
+
+class TestScheduleManager:
+    def test_simple_trigger_fires_command(self, world):
+        dm, events, log = world
+        management = ScheduleManagement()
+        schedule = management.create_schedule(Schedule(
+            token="every-50ms", trigger_type=TriggerType.SIMPLE,
+            trigger_configuration={
+                TriggerConstants.REPEAT_INTERVAL: "50",
+                TriggerConstants.REPEAT_COUNT: "1"}))  # fire twice total
+        job = management.create_scheduled_job(ScheduledJob(
+            token="job-1", schedule_token="every-50ms",
+            job_type=ScheduledJobType.COMMAND_INVOCATION,
+            job_configuration={
+                JobConstants.ASSIGNMENT_TOKEN: "assn-1",
+                JobConstants.COMMAND_TOKEN: "ping",
+                JobConstants.PARAMETER_PREFIX + "x": "9"}))
+        manager = ScheduleManager(management)
+        manager.register_executor(ScheduledJobType.COMMAND_INVOCATION,
+                                  CommandInvocationJobExecutor(dm, events))
+        manager.start()
+        try:
+            manager.submit(job)
+            deadline = time.time() + 10
+            while time.time() < deadline and manager.fired_counter.value < 2:
+                time.sleep(0.02)
+        finally:
+            manager.stop()
+        assert manager.fired_counter.value == 2
+        # job completed after repeat_count exhausted
+        done = management.get_scheduled_job_by_token("job-1")
+        assert done.job_state == ScheduledJobState.COMPLETE
+        log.flush_tenant("default")
+        invocations = events.list_command_invocations(
+            EventIndex.ASSIGNMENT, "assn-1")
+        assert invocations.num_results == 2
+        assert invocations.results[0].parameter_values == {"x": "9"}
+
+    def test_cron_schedule_validation(self):
+        management = ScheduleManagement()
+        with pytest.raises(CronError):
+            management.create_schedule(Schedule(
+                token="bad", trigger_type=TriggerType.CRON,
+                trigger_configuration={
+                    TriggerConstants.CRON_EXPRESSION: "nope"}))
+
+    def test_unschedule(self, world):
+        dm, events, log = world
+        management = ScheduleManagement()
+        management.create_schedule(Schedule(
+            token="s", trigger_type=TriggerType.SIMPLE,
+            trigger_configuration={TriggerConstants.REPEAT_INTERVAL: "10000"}))
+        job = management.create_scheduled_job(ScheduledJob(
+            token="j", schedule_token="s",
+            job_type=ScheduledJobType.COMMAND_INVOCATION,
+            job_configuration={}))
+        manager = ScheduleManager(management)
+        manager.submit(job)
+        assert len(manager._heap) == 1
+        manager.unschedule("j")
+        assert manager._heap == []

@@ -1,0 +1,177 @@
+"""Outbound connectors + rule processors over the enriched topic."""
+
+import time
+
+import pytest
+
+from sitewhere_tpu.connectors import (
+    AreaFilter, CollectingConnector, DeviceEventMulticaster, DeviceTypeFilter,
+    EventTypeFilter, FilterOperation, MqttOutboundConnector,
+    OutboundConnectorHost, OutboundConnectorsManager, ScriptedConnector,
+    ScriptedFilter)
+from sitewhere_tpu.model.area import Area
+from sitewhere_tpu.model.common import Location
+from sitewhere_tpu.model.area import Zone
+from sitewhere_tpu.model.device import Device, DeviceAssignment, DeviceType
+from sitewhere_tpu.model.event import (
+    AlertLevel, DeviceEventContext, DeviceEventType, DeviceLocation,
+    DeviceMeasurement)
+from sitewhere_tpu.persist.event_management import (
+    DeviceEventManagement, EventIndex)
+from sitewhere_tpu.persist.eventlog import ColumnarEventLog
+from sitewhere_tpu.pipeline.enrichment import pack_enriched
+from sitewhere_tpu.registry.store import DeviceManagement
+from sitewhere_tpu.rules import (
+    RuleProcessor, RuleProcessorHost, RuleProcessorsManager,
+    ZoneTestRuleProcessor)
+from sitewhere_tpu.rules.processor import point_in_polygon
+from sitewhere_tpu.runtime.bus import EventBus, Record, TopicNaming
+
+
+@pytest.fixture
+def world():
+    dm = DeviceManagement()
+    dtype_a = dm.create_device_type(DeviceType(token="type-a"))
+    dtype_b = dm.create_device_type(DeviceType(token="type-b"))
+    area = dm.create_area(Area(token="area-1"))
+    dm.create_zone(Zone(token="zone-1", area_id=area.id, bounds=[
+        Location(0.0, 0.0), Location(0.0, 10.0), Location(10.0, 10.0),
+        Location(10.0, 0.0)]))
+    da = dm.create_device(Device(token="da", device_type_id=dtype_a.id))
+    db = dm.create_device(Device(token="db", device_type_id=dtype_b.id))
+    dm.create_device_assignment(DeviceAssignment(token="assn-a",
+                                                 device_id=da.id,
+                                                 area_id=area.id))
+    dm.create_device_assignment(DeviceAssignment(token="assn-b",
+                                                 device_id=db.id))
+    return dm
+
+
+def ctx(dm, token):
+    device = dm.get_device_by_token(token)
+    assignment = dm.get_active_assignment(device.id)
+    return DeviceEventContext(
+        device_id=device.id, device_token=token,
+        device_type_id=device.device_type_id, assignment_id=assignment.token,
+        area_id=assignment.area_id, tenant_id="default")
+
+
+def record(dm, token, event, offset=0):
+    return Record(topic="t", partition=0, offset=offset, key=token.encode(),
+                  value=pack_enriched(ctx(dm, token), event), timestamp_ms=0)
+
+
+class TestFilters:
+    def test_device_type_filter(self, world):
+        include_a = DeviceTypeFilter(world, ["type-a"])
+        assert include_a.accepts(ctx(world, "da"), DeviceMeasurement())
+        assert not include_a.accepts(ctx(world, "db"), DeviceMeasurement())
+        exclude_a = DeviceTypeFilter(world, ["type-a"],
+                                     FilterOperation.EXCLUDE)
+        assert not exclude_a.accepts(ctx(world, "da"), DeviceMeasurement())
+
+    def test_area_filter(self, world):
+        f = AreaFilter(world, ["area-1"])
+        assert f.accepts(ctx(world, "da"), DeviceMeasurement())
+        assert not f.accepts(ctx(world, "db"), DeviceMeasurement())
+
+    def test_event_type_and_scripted(self, world):
+        f = EventTypeFilter([DeviceEventType.LOCATION])
+        assert f.accepts(ctx(world, "da"), DeviceLocation())
+        assert not f.accepts(ctx(world, "da"), DeviceMeasurement())
+        s = ScriptedFilter(lambda c, e: e.value > 5.0)
+        assert s.accepts(ctx(world, "da"), DeviceMeasurement(value=6.0))
+        assert not s.accepts(ctx(world, "da"), DeviceMeasurement(value=1.0))
+
+
+class TestConnectorHost:
+    def test_filtering_and_dispatch(self, world):
+        bus = EventBus()
+        connector = CollectingConnector(
+            filters=[DeviceTypeFilter(world, ["type-a"])])
+        host = OutboundConnectorHost(bus, connector)
+        host.process([
+            record(world, "da", DeviceMeasurement(name="m", value=1.0)),
+            record(world, "db", DeviceMeasurement(name="m", value=2.0), 1),
+        ])
+        assert len(connector.collected) == 1
+        assert connector.collected[0][0].device_token == "da"
+        assert host.filtered_counter.value == 1
+
+    def test_manager_consumes_topic(self, world):
+        bus = EventBus()
+        naming = TopicNaming()
+        manager = OutboundConnectorsManager(bus)
+        connector = CollectingConnector()
+        manager.add_connector(connector)
+        manager.start()
+        try:
+            bus.publish(naming.inbound_enriched_events("default"), b"da",
+                        pack_enriched(ctx(world, "da"),
+                                      DeviceMeasurement(name="m", value=3.0)))
+            deadline = time.time() + 5
+            while time.time() < deadline and not connector.collected:
+                time.sleep(0.02)
+            assert len(connector.collected) == 1
+        finally:
+            manager.stop()
+
+    def test_scripted_connector(self, world):
+        seen = []
+        connector = ScriptedConnector("s", lambda c, e: seen.append(e))
+        connector.process_batch([(ctx(world, "da"), DeviceMeasurement())])
+        assert len(seen) == 1
+
+    def test_multicaster_routes(self, world):
+        mc = DeviceEventMulticaster()
+        mc.add_builder(lambda c, e: [f"SW/{c.device_token}/fanout"])
+        mc.add_builder(lambda c, e: ["global"])
+        routes = mc.routes(ctx(world, "da"), DeviceMeasurement())
+        assert routes == ["SW/da/fanout", "global"]
+
+
+class TestRuleProcessors:
+    def test_point_in_polygon(self):
+        import numpy as np
+        square = np.array([(0, 0), (0, 10), (10, 10), (10, 0)], float)
+        assert point_in_polygon(5, 5, square)
+        assert not point_in_polygon(15, 5, square)
+        assert not point_in_polygon(-1, -1, square)
+
+    def test_zone_test_rule_fires_alert(self, world, tmp_path):
+        log = ColumnarEventLog(str(tmp_path / "log"))
+        events = DeviceEventManagement(log, world)
+        events.start()
+        bus = EventBus()
+        processor = ZoneTestRuleProcessor(
+            "geo", world, events, "zone-1", condition="outside",
+            alert_level=AlertLevel.ERROR)
+        host = RuleProcessorHost(bus, processor)
+        host.process([
+            record(world, "da", DeviceLocation(latitude=5, longitude=5)),
+            record(world, "da", DeviceLocation(latitude=50, longitude=50), 1),
+        ])
+        log.flush_tenant("default")
+        alerts = events.list_alerts(EventIndex.ASSIGNMENT, "assn-a")
+        assert alerts.num_results == 1
+        assert alerts.results[0].type == "zone.violation"
+        events.stop()
+
+    def test_custom_processor_hooks(self, world):
+        calls = []
+
+        class Counter(RuleProcessor):
+            def on_measurement(self, context, event):
+                calls.append(("m", event.value))
+
+            def on_location(self, context, event):
+                calls.append(("l", event.latitude))
+
+        bus = EventBus()
+        manager = RuleProcessorsManager(bus)
+        host = manager.add_processor(Counter("count"))
+        host.process([
+            record(world, "da", DeviceMeasurement(value=1.5)),
+            record(world, "da", DeviceLocation(latitude=2.5), 1),
+        ])
+        assert calls == [("m", 1.5), ("l", 2.5)]

@@ -1,0 +1,170 @@
+"""Security (JWT, passwords, users), assets, tenants, engines, bootstrap."""
+
+import time
+
+import pytest
+
+from sitewhere_tpu.assets import AssetManagement
+from sitewhere_tpu.errors import SiteWhereError
+from sitewhere_tpu.model.asset import Asset, AssetCategory, AssetType
+from sitewhere_tpu.model.tenant import Tenant
+from sitewhere_tpu.model.user import SiteWhereRoles, User
+from sitewhere_tpu.multitenant import (
+    InstanceBootstrap, TenantEngine, TenantEngineManager, TenantManagement,
+    builtin_templates)
+from sitewhere_tpu.persist.eventlog import ColumnarEventLog
+from sitewhere_tpu.registry.store import SqliteStore
+from sitewhere_tpu.runtime.bus import EventBus, TopicNaming
+from sitewhere_tpu.security import (
+    InvalidTokenError, TokenManagement, UserManagement, hash_password,
+    verify_password)
+
+
+class TestPasswords:
+    def test_hash_verify(self):
+        stored = hash_password("s3cret", iterations=1000)
+        assert verify_password("s3cret", stored)
+        assert not verify_password("wrong", stored)
+
+    def test_garbage_stored(self):
+        assert not verify_password("x", "not-a-hash")
+
+
+class TestTokens:
+    def test_roundtrip(self):
+        tm = TokenManagement()
+        token = tm.generate_token("admin", [SiteWhereRoles.REST])
+        assert tm.get_username(token) == "admin"
+        assert tm.get_authorities(token) == [SiteWhereRoles.REST]
+
+    def test_tamper_rejected(self):
+        tm = TokenManagement()
+        token = tm.generate_token("admin")
+        header, payload, sig = token.split(".")
+        with pytest.raises(InvalidTokenError):
+            tm.get_claims(f"{header}.{payload}x.{sig}")
+
+    def test_expired(self):
+        tm = TokenManagement()
+        token = tm.generate_token("admin", expiration_minutes=0)
+        time.sleep(0.01)
+        with pytest.raises(InvalidTokenError):
+            tm.get_claims(token)
+
+    def test_other_secret_rejected(self):
+        token = TokenManagement(secret=b"a" * 32).generate_token("admin")
+        with pytest.raises(InvalidTokenError):
+            TokenManagement(secret=b"b" * 32).get_claims(token)
+
+
+class TestUsers:
+    def test_crud_and_authenticate(self):
+        um = UserManagement()
+        um.create_user(User(username="alice",
+                            authorities=[SiteWhereRoles.REST]), "pw")
+        user = um.authenticate("alice", "pw")
+        assert user.username == "alice"
+        assert um.get_user_by_username("alice").last_login_date is not None
+        with pytest.raises(SiteWhereError):
+            um.authenticate("alice", "nope")
+        with pytest.raises(SiteWhereError):
+            um.authenticate("ghost", "pw")
+
+    def test_duplicate_rejected(self):
+        um = UserManagement()
+        um.create_user(User(username="bob"), "x")
+        with pytest.raises(SiteWhereError):
+            um.create_user(User(username="bob"), "y")
+
+    def test_authorities(self):
+        um = UserManagement()
+        um.create_user(User(username="ops",
+                            authorities=[SiteWhereRoles.ADMINISTER_USERS]), "x")
+        assert um.get_user_authorities("ops") == \
+            [SiteWhereRoles.ADMINISTER_USERS]
+        assert um.get_granted_authority(SiteWhereRoles.REST) is not None
+
+
+class TestAssets:
+    def test_crud(self):
+        am = AssetManagement()
+        at = am.create_asset_type(AssetType(
+            token="person", asset_category=AssetCategory.PERSON))
+        am.create_asset(Asset(token="alice", asset_type_id=at.id,
+                              name="Alice"))
+        assert am.get_asset_by_token("alice").name == "Alice"
+        assert am.list_assets("person").num_results == 1
+        with pytest.raises(SiteWhereError):
+            am.delete_asset_type("person")  # in use
+        am.delete_asset("alice")
+        am.delete_asset_type("person")
+
+    def test_sqlite_roundtrip(self, tmp_path):
+        path = str(tmp_path / "assets.db")
+        am = AssetManagement(SqliteStore(path))
+        at = am.create_asset_type(AssetType(
+            token="hw", asset_category=AssetCategory.HARDWARE))
+        am.create_asset(Asset(token="a1", asset_type_id=at.id))
+        reloaded = AssetManagement(SqliteStore(path))
+        assert reloaded.get_asset_type_by_token("hw").asset_category == \
+            AssetCategory.HARDWARE
+        assert reloaded.get_asset_by_token("a1") is not None
+
+
+class TestTenants:
+    def test_crud_and_notify(self):
+        bus = EventBus()
+        naming = TopicNaming()
+        tm = TenantManagement(bus=bus, naming=naming)
+        tenant = tm.create_tenant(Tenant(token="acme", name="Acme"))
+        assert tenant.authentication_token
+        assert tm.get_tenant_by_authentication_token(
+            tenant.authentication_token).token == "acme"
+        consumer = bus.consumer(naming.tenant_model_updates(), "watch")
+        records = consumer.poll()
+        assert len(records) == 1
+
+    def test_engine_manager_lifecycle(self, tmp_path):
+        bus = EventBus()
+        tm = TenantManagement(bus=bus, naming=TopicNaming())
+        tm.create_tenant(Tenant(token="t1", tenant_template_id="demo"))
+        log = ColumnarEventLog(str(tmp_path / "log"))
+        bootstrap = InstanceBootstrap(UserManagement(), tm)
+
+        def factory(tenant):
+            engine = TenantEngine(tenant, bus, log)
+            bootstrap.apply_template(engine)
+            return engine
+
+        manager = TenantEngineManager(tm, factory, bus=bus)
+        manager.start()
+        try:
+            engine = manager.get_engine("t1")
+            assert engine is not None
+            # demo template materialized
+            assert engine.registry.get_device_by_token("demo-0") is not None
+            assert engine.registry.get_zone_by_token("perimeter") is not None
+            # live tenant creation via model-update topic
+            tm.create_tenant(Tenant(token="t2"))
+            deadline = time.time() + 5
+            while time.time() < deadline and manager.get_engine("t2") is None:
+                time.sleep(0.02)
+            assert manager.get_engine("t2") is not None
+            # live deletion
+            tm.delete_tenant("t2")
+            deadline = time.time() + 5
+            while time.time() < deadline and manager.get_engine("t2"):
+                time.sleep(0.02)
+            assert manager.get_engine("t2") is None
+        finally:
+            manager.stop()
+
+    def test_bootstrap_users_and_tenant(self):
+        um = UserManagement()
+        tm = TenantManagement()
+        bootstrap = InstanceBootstrap(um, tm)
+        bootstrap.bootstrap_users()
+        bootstrap.bootstrap_users()  # idempotent
+        assert um.authenticate("admin", "password").username == "admin"
+        tenant = bootstrap.bootstrap_default_tenant()
+        assert tenant.token == "default"
