@@ -1,0 +1,6 @@
+"""REST client (reference: sitewhere-client — ISiteWhereClient /
+rest/client/SiteWhereClient.java:91)."""
+
+from sitewhere_tpu.client.rest import SiteWhereClient, SiteWhereClientError
+
+__all__ = ["SiteWhereClient", "SiteWhereClientError"]

@@ -1,0 +1,149 @@
+"""Top-level instance: the whole platform composed as one component tree.
+
+Reference: in SiteWhere an "instance" is ~20 separate Spring Boot processes
+(service-* dirs, SURVEY.md §2.4) bootstrapped by service-instance-management
+(InstanceTemplateManager.java:32) and coordinated through ZooKeeper + Kafka.
+Here the instance is ONE process (scaling happens on the TPU mesh, not by
+process fan-out): shared event bus + columnar log + TPU pipeline engine,
+per-tenant engines managed by TenantEngineManager, user/tenant managements,
+JWT token service, and instance bootstrap — all under a single lifecycle
+root so `start()`/`stop()` brings the platform up/down deterministically.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Callable, Dict, Optional
+
+from sitewhere_tpu.model.tenant import Tenant
+from sitewhere_tpu.multitenant.engine import TenantEngine, TenantEngineManager
+from sitewhere_tpu.multitenant.instance import InstanceBootstrap
+from sitewhere_tpu.multitenant.tenants import TenantManagement
+from sitewhere_tpu.persist.eventlog import ColumnarEventLog
+from sitewhere_tpu.registry.store import SqliteStore
+from sitewhere_tpu.runtime.bus import EventBus, TopicNaming
+from sitewhere_tpu.runtime.lifecycle import LifecycleComponent
+from sitewhere_tpu.runtime.metrics import GLOBAL_METRICS
+from sitewhere_tpu.security.tokens import TokenManagement
+from sitewhere_tpu.security.users import UserManagement
+
+LOGGER = logging.getLogger("sitewhere.instance")
+
+
+class SiteWhereInstance(LifecycleComponent):
+    """Single-process platform instance.
+
+    Parameters mirror the reference's instance settings
+    (instance/InstanceSettings.java): instance id, data directory (replaces
+    the ZK/Mongo split), and pipeline sizing knobs. With ``enable_pipeline``
+    the fused TPU hot path is attached; without it the control plane still
+    runs fully (useful for API-only deployments and tests).
+    """
+
+    def __init__(self, instance_id: str = "default",
+                 data_dir: Optional[str] = None,
+                 enable_pipeline: bool = False,
+                 max_devices: int = 8192, max_zones: int = 64,
+                 max_zone_vertices: int = 16, batch_size: int = 2048,
+                 measurement_slots: int = 8, max_tenants: int = 16,
+                 bus_partitions: int = 8,
+                 default_tenant: Optional[str] = "default",
+                 admin_username: str = "admin",
+                 admin_password: str = "password"):
+        super().__init__(f"instance:{instance_id}")
+        self.instance_id = instance_id
+        self.data_dir = data_dir
+        self.naming = TopicNaming(instance=instance_id)
+        self.metrics = GLOBAL_METRICS
+
+        bus_dir = os.path.join(data_dir, "bus") if data_dir else None
+        log_dir = os.path.join(data_dir, "events") if data_dir else None
+        self.bus = EventBus(partitions=bus_partitions, data_dir=bus_dir)
+        self.event_log = ColumnarEventLog(data_dir=log_dir)
+
+        self.registry_tensors = None
+        self.pipeline_engine = None
+        if enable_pipeline:
+            from sitewhere_tpu.pipeline.engine import PipelineEngine
+            from sitewhere_tpu.registry.tensors import RegistryTensors
+            self.registry_tensors = RegistryTensors(
+                max_devices=max_devices, max_zones=max_zones,
+                max_zone_vertices=max_zone_vertices)
+            self.pipeline_engine = PipelineEngine(
+                self.registry_tensors, batch_size=batch_size,
+                measurement_slots=measurement_slots, max_tenants=max_tenants)
+
+        # global (non-multitenant) managements — reference:
+        # service-user-management / service-tenant-management
+        self.user_management = UserManagement(self._make_store("users"))
+        self.tenant_management = TenantManagement(
+            self._make_store("tenants"), bus=self.bus, naming=self.naming)
+        self.token_management = TokenManagement()
+        self.bootstrap = InstanceBootstrap(
+            self.user_management, self.tenant_management,
+            admin_username=admin_username, admin_password=admin_password)
+
+        self.engine_manager = TenantEngineManager(
+            self.tenant_management, self._make_engine, bus=self.bus,
+            naming=self.naming)
+        self._default_tenant = default_tenant
+
+        if self.pipeline_engine is not None:
+            self.add_nested(self.pipeline_engine)
+        self.add_nested(self.engine_manager)
+
+    # -- wiring ------------------------------------------------------------
+    def _make_store(self, kind: str):
+        if self.data_dir is None:
+            return None
+        return SqliteStore(os.path.join(self.data_dir, f"{kind}.db"))
+
+    def _make_engine(self, tenant: Tenant) -> TenantEngine:
+        store_factory: Optional[Callable] = None
+        if self.data_dir is not None:
+            tenant_dir = os.path.join(self.data_dir, "tenants", tenant.token)
+            os.makedirs(tenant_dir, exist_ok=True)
+            store_factory = lambda kind: SqliteStore(
+                os.path.join(tenant_dir, f"{kind}.db"))
+        engine = TenantEngine(
+            tenant, self.bus, self.event_log,
+            pipeline_engine=self.pipeline_engine,
+            registry_tensors=self.registry_tensors,
+            store_factory=store_factory, naming=self.naming)
+        self.bootstrap.apply_template(engine)
+        return engine
+
+    # -- lifecycle ---------------------------------------------------------
+    def on_initialize(self, monitor) -> None:
+        self.event_log.start()  # background linger-flush thread
+        self.bootstrap.bootstrap_users()
+        if self._default_tenant:
+            self.bootstrap.bootstrap_default_tenant(self._default_tenant)
+
+    def on_stop(self, monitor) -> None:
+        self.event_log.stop()
+
+    # -- convenience accessors --------------------------------------------
+    def get_tenant_engine(self, tenant_token: str) -> Optional[TenantEngine]:
+        engine = self.engine_manager.get_engine(tenant_token)
+        if engine is None and not self.engine_manager.is_stopped(tenant_token):
+            # lazy boot on first use — but never resurrect an engine an
+            # admin explicitly stopped
+            engine = self.engine_manager.start_engine(tenant_token)
+        return engine
+
+    def topology(self) -> Dict:
+        """Instance topology snapshot (replaces Kafka state heartbeats +
+        TopologyStateAggregator.java for the single-process design)."""
+        with self.engine_manager._lock:
+            engines = {tok: eng.status.name
+                       for tok, eng in self.engine_manager.engines.items()}
+            failed = dict(self.engine_manager.failed)
+        return {
+            "instance_id": self.instance_id,
+            "status": self.status.name,
+            "pipeline_enabled": self.pipeline_engine is not None,
+            "tenant_engines": engines,
+            "failed_tenant_engines": failed,
+        }

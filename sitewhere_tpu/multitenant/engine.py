@@ -135,6 +135,7 @@ class TenantEngineManager(LifecycleComponent):
         self.engines: Dict[str, TenantEngine] = {}
         self.failed: Dict[str, str] = {}  # token -> error
         self._starting: set = set()  # tokens mid-boot (start_engine guard)
+        self._stopped: set = set()   # tokens explicitly stopped by an admin
         self._lock = threading.RLock()
         self._watch: Optional[ConsumerHost] = None
 
@@ -167,13 +168,36 @@ class TenantEngineManager(LifecycleComponent):
         with self._lock:
             return self.engines.get(tenant_token)
 
-    def start_engine(self, tenant_token: str) -> Optional[TenantEngine]:
+    def is_stopped(self, tenant_token: str) -> bool:
+        """True when an admin explicitly stopped this engine (it must not be
+        auto-restarted by lazy request-path resolution)."""
         with self._lock:
-            if tenant_token in self.engines:
-                return self.engines[tenant_token]
-            if tenant_token in self._starting:
-                return None  # another thread is already booting this tenant
-            self._starting.add(tenant_token)
+            return tenant_token in self._stopped
+
+    def start_engine(self, tenant_token: str, wait_seconds: float = 30.0,
+                     force: bool = False) -> Optional[TenantEngine]:
+        """Boot (or return) the engine. A non-forced start respects an
+        explicit admin stop — only `force=True` (the admin start/restart
+        endpoints) clears the stopped flag, so stale async model-update
+        records can't resurrect a stopped engine."""
+        import time as _time
+        deadline = _time.monotonic() + wait_seconds
+        while True:
+            with self._lock:
+                if force:
+                    self._stopped.discard(tenant_token)
+                elif tenant_token in self._stopped:
+                    return None
+                if tenant_token in self.engines:
+                    return self.engines[tenant_token]
+                if tenant_token not in self._starting:
+                    self._starting.add(tenant_token)
+                    break
+            # another thread is booting this tenant — wait for it rather
+            # than surfacing a spurious "unknown tenant" to the caller
+            if _time.monotonic() > deadline:
+                return None
+            _time.sleep(0.02)
         try:
             tenant = self.tenant_management.get_tenant_by_token(tenant_token)
             if tenant is None:
@@ -198,12 +222,13 @@ class TenantEngineManager(LifecycleComponent):
     def stop_engine(self, tenant_token: str) -> None:
         with self._lock:
             engine = self.engines.pop(tenant_token, None)
+            self._stopped.add(tenant_token)
         if engine is not None:
             engine.stop()
 
     def restart_engine(self, tenant_token: str) -> Optional[TenantEngine]:
         self.stop_engine(tenant_token)
-        return self.start_engine(tenant_token)
+        return self.start_engine(tenant_token, force=True)
 
     # -- tenant-model-updates ---------------------------------------------
     def _on_updates(self, records: List) -> None:

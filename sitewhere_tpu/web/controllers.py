@@ -1,0 +1,797 @@
+"""REST controllers: the reference's 27-controller surface on one router.
+
+Reference: service-web-rest/src/main/java/com/sitewhere/web/rest/controllers/
+(Devices.java, DeviceTypes.java, Assignments.java:98-160, Areas.java,
+Zones.java, Customers.java, DeviceGroups.java, Assets.java, AssetTypes.java,
+BatchOperations.java, Schedules.java, Tenants.java, Users.java,
+DeviceEvents.java, DeviceStates.java, Instance.java, …). Each section below
+names the controller it mirrors. Handlers receive a `Request` and return a
+JSON-able object (or `(status, obj)`).
+
+Tenant scoping: the reference resolves a tenant engine per request from the
+X-SiteWhere-Tenant header via per-service gRPC routers; here `_engine()`
+resolves the in-process TenantEngine the same way.
+"""
+
+from __future__ import annotations
+
+import base64
+import dataclasses
+from typing import Any, Dict, List, Optional, Type
+
+from sitewhere_tpu.errors import ErrorCode, NotFoundError, SiteWhereError
+from sitewhere_tpu.model.area import (
+    Area, AreaType, Customer, CustomerType, Zone)
+from sitewhere_tpu.model.asset import Asset, AssetType
+from sitewhere_tpu.model.batch import BatchOperation
+from sitewhere_tpu.model.common import Location, new_id
+from sitewhere_tpu.model.device import (
+    Device, DeviceAssignment, DeviceCommand, DeviceGroup, DeviceGroupElement,
+    DeviceStatus, DeviceType)
+from sitewhere_tpu.model.event import (
+    AlertLevel, AlertSource, CommandInitiator, CommandTarget, DeviceAlert,
+    DeviceCommandInvocation, DeviceCommandResponse, DeviceEventBatch,
+    DeviceLocation, DeviceMeasurement, DeviceStateChange, DeviceStreamData)
+from sitewhere_tpu.model.schedule import Schedule, ScheduledJob
+from sitewhere_tpu.model.tenant import Tenant
+from sitewhere_tpu.model.user import GrantedAuthority, SiteWhereRoles, User
+from sitewhere_tpu.persist.event_management import EventIndex
+from sitewhere_tpu.web.marshal import (
+    entity_from_payload, results_to_jsonable, to_jsonable)
+from sitewhere_tpu.web.router import Request, Router
+
+_EVENT_ENUM_FIELDS = {
+    "source": AlertSource, "level": AlertLevel,
+    "initiator": CommandInitiator, "target": CommandTarget,
+}
+
+
+def event_from_payload(cls: Type, payload: Dict[str, Any]):
+    """JSON body → DeviceEvent subclass (enum + base64 coercion)."""
+    kwargs: Dict[str, Any] = {}
+    for f in dataclasses.fields(cls):
+        if f.name not in payload or f.name == "event_type":
+            continue
+        val = payload[f.name]
+        enum_cls = _EVENT_ENUM_FIELDS.get(f.name)
+        if enum_cls is not None and val is not None:
+            val = enum_cls[val] if isinstance(val, str) else enum_cls(val)
+        if f.name == "data" and isinstance(val, str):
+            val = base64.b64decode(val)
+        kwargs[f.name] = val
+    return cls(**kwargs)
+
+
+def _body(request: Request) -> Dict[str, Any]:
+    if not isinstance(request.body, dict):
+        raise SiteWhereError("JSON object body required", http_status=400)
+    return request.body
+
+
+def register_all(router: Router, instance, server) -> None:
+    REST = SiteWhereRoles.REST
+
+    def _engine(request: Request):
+        token = request.tenant or "default"
+        tenant = instance.tenant_management.get_tenant_by_token(token)
+        if tenant is None:
+            raise NotFoundError(f"unknown tenant: {token}",
+                                ErrorCode.INVALID_TENANT_TOKEN)
+        # tenant access gate (reference: ITenant.getAuthorizedUserIds checked
+        # by the tenant-token interceptors): a non-empty authorized list
+        # restricts access to those users + tenant administrators.
+        if (tenant.authorized_user_ids
+                and request.username not in tenant.authorized_user_ids
+                and SiteWhereRoles.ADMINISTER_TENANTS
+                not in request.authorities):
+            raise SiteWhereError(
+                f"user not authorized for tenant {token}", http_status=403)
+        engine = instance.get_tenant_engine(token)
+        if engine is None:
+            raise NotFoundError(f"tenant engine unavailable: {token}",
+                                ErrorCode.INVALID_TENANT_TOKEN)
+        return engine
+
+    def _registry(request: Request):
+        return _engine(request).registry
+
+    def _events(request: Request):
+        return _engine(request).event_management
+
+    def _assignment_events(request: Request):
+        return _events(request), request.params["token"]
+
+    # ------------------------------------------------------------------
+    # System / instance (reference: Instance.java, System info endpoints)
+    # ------------------------------------------------------------------
+    def get_version(request: Request):
+        import sitewhere_tpu
+        return {"version": sitewhere_tpu.__version__,
+                "edition": "sitewhere-tpu"}
+
+    def get_topology(request: Request):
+        return instance.topology()
+
+    def get_metrics(request: Request):
+        return instance.metrics.snapshot()
+
+    router.get("/api/system/version", get_version, authority=REST)
+    router.get("/api/instance/topology", get_topology,
+               authority=SiteWhereRoles.VIEW_SERVER_INFO)
+    router.get("/api/instance/metrics", get_metrics,
+               authority=SiteWhereRoles.VIEW_SERVER_INFO)
+
+    # ------------------------------------------------------------------
+    # Users + authorities (reference: Users.java, Authorities.java)
+    # ------------------------------------------------------------------
+    def create_user(request: Request):
+        body = _body(request)
+        password = body.pop("password", "")
+        user = entity_from_payload(User, body)
+        return 201, instance.user_management.create_user(user, password)
+
+    def list_users(request: Request):
+        return results_to_jsonable(
+            instance.user_management.list_users(request.criteria()))
+
+    def get_user(request: Request):
+        user = instance.user_management.get_user_by_username(
+            request.params["username"])
+        if user is None:
+            raise NotFoundError("unknown user", ErrorCode.INVALID_USERNAME)
+        return user
+
+    def update_user(request: Request):
+        body = _body(request)
+        password = body.pop("password", None)
+        user = instance.user_management.update_user(
+            request.params["username"], body, password=password)
+        return user
+
+    def delete_user(request: Request):
+        return instance.user_management.delete_user(request.params["username"])
+
+    def get_user_authorities(request: Request):
+        return {"authorities": instance.user_management.get_user_authorities(
+            request.params["username"])}
+
+    def create_authority(request: Request):
+        authority = entity_from_payload(GrantedAuthority, _body(request))
+        return 201, instance.user_management.create_granted_authority(authority)
+
+    def list_authorities(request: Request):
+        return {"results": instance.user_management.list_granted_authorities()}
+
+    ADMIN_USERS = SiteWhereRoles.ADMINISTER_USERS
+    router.post("/api/users", create_user, authority=ADMIN_USERS)
+    router.get("/api/users", list_users, authority=ADMIN_USERS)
+    router.get("/api/users/{username}", get_user, authority=ADMIN_USERS)
+    router.put("/api/users/{username}", update_user, authority=ADMIN_USERS)
+    router.delete("/api/users/{username}", delete_user, authority=ADMIN_USERS)
+    router.get("/api/users/{username}/authorities", get_user_authorities,
+               authority=ADMIN_USERS)
+    router.post("/api/authorities", create_authority, authority=ADMIN_USERS)
+    router.get("/api/authorities", list_authorities, authority=ADMIN_USERS)
+
+    # ------------------------------------------------------------------
+    # Tenants + engine control (reference: Tenants.java)
+    # ------------------------------------------------------------------
+    ADMIN_TENANTS = SiteWhereRoles.ADMINISTER_TENANTS
+
+    def create_tenant(request: Request):
+        tenant = entity_from_payload(Tenant, _body(request))
+        return 201, instance.tenant_management.create_tenant(tenant)
+
+    def list_tenants(request: Request):
+        return results_to_jsonable(
+            instance.tenant_management.list_tenants(request.criteria()))
+
+    def get_tenant(request: Request):
+        tenant = instance.tenant_management.get_tenant_by_token(
+            request.params["token"])
+        if tenant is None:
+            raise NotFoundError("unknown tenant",
+                                ErrorCode.INVALID_TENANT_TOKEN)
+        return tenant
+
+    def update_tenant(request: Request):
+        return instance.tenant_management.update_tenant(
+            request.params["token"], _body(request))
+
+    def delete_tenant(request: Request):
+        instance.engine_manager.stop_engine(request.params["token"])
+        return instance.tenant_management.delete_tenant(request.params["token"])
+
+    def start_tenant_engine(request: Request):
+        engine = instance.engine_manager.start_engine(request.params["token"],
+                                                      force=True)
+        if engine is None:
+            raise NotFoundError("unknown tenant",
+                                ErrorCode.INVALID_TENANT_TOKEN)
+        return {"status": engine.status.name}
+
+    def stop_tenant_engine(request: Request):
+        instance.engine_manager.stop_engine(request.params["token"])
+        return {"status": "STOPPED"}
+
+    def restart_tenant_engine(request: Request):
+        engine = instance.engine_manager.restart_engine(request.params["token"])
+        return {"status": engine.status.name if engine else "FAILED"}
+
+    router.post("/api/tenants", create_tenant, authority=ADMIN_TENANTS)
+    router.get("/api/tenants", list_tenants, authority=ADMIN_TENANTS)
+    router.get("/api/tenants/{token}", get_tenant, authority=ADMIN_TENANTS)
+    router.put("/api/tenants/{token}", update_tenant, authority=ADMIN_TENANTS)
+    router.delete("/api/tenants/{token}", delete_tenant,
+                  authority=ADMIN_TENANTS)
+    router.post("/api/tenants/{token}/engine/start", start_tenant_engine,
+                authority=ADMIN_TENANTS)
+    router.post("/api/tenants/{token}/engine/stop", stop_tenant_engine,
+                authority=ADMIN_TENANTS)
+    router.post("/api/tenants/{token}/engine/restart", restart_tenant_engine,
+                authority=ADMIN_TENANTS)
+
+    # ------------------------------------------------------------------
+    # Device types + commands + statuses (reference: DeviceTypes.java)
+    # ------------------------------------------------------------------
+    def create_device_type(request: Request):
+        return 201, _registry(request).create_device_type(
+            entity_from_payload(DeviceType, _body(request)))
+
+    def list_device_types(request: Request):
+        return results_to_jsonable(
+            _registry(request).list_device_types(request.criteria()))
+
+    def get_device_type(request: Request):
+        return _registry(request).get_device_type_by_token(
+            request.params["token"])
+
+    def update_device_type(request: Request):
+        return _registry(request).update_device_type(
+            request.params["token"], _body(request))
+
+    def delete_device_type(request: Request):
+        return _registry(request).delete_device_type(request.params["token"])
+
+    def create_device_command(request: Request):
+        registry = _registry(request)
+        dtype = registry.get_device_type_by_token(request.params["token"])
+        command = entity_from_payload(DeviceCommand, _body(request))
+        command.device_type_id = dtype.id
+        return 201, registry.create_device_command(command)
+
+    def list_device_commands(request: Request):
+        return results_to_jsonable(_registry(request).list_device_commands(
+            device_type_token=request.params["token"]))
+
+    def create_device_status(request: Request):
+        registry = _registry(request)
+        dtype = registry.get_device_type_by_token(request.params["token"])
+        status = entity_from_payload(DeviceStatus, _body(request))
+        status.device_type_id = dtype.id
+        return 201, registry.create_device_status(status)
+
+    def list_device_statuses(request: Request):
+        return results_to_jsonable(_registry(request).list_device_statuses(
+            device_type_token=request.params["token"]))
+
+    router.post("/api/devicetypes", create_device_type, authority=REST)
+    router.get("/api/devicetypes", list_device_types, authority=REST)
+    router.get("/api/devicetypes/{token}", get_device_type, authority=REST)
+    router.put("/api/devicetypes/{token}", update_device_type, authority=REST)
+    router.delete("/api/devicetypes/{token}", delete_device_type,
+                  authority=REST)
+    router.post("/api/devicetypes/{token}/commands", create_device_command,
+                authority=REST)
+    router.get("/api/devicetypes/{token}/commands", list_device_commands,
+               authority=REST)
+    router.post("/api/devicetypes/{token}/statuses", create_device_status,
+                authority=REST)
+    router.get("/api/devicetypes/{token}/statuses", list_device_statuses,
+               authority=REST)
+
+    # ------------------------------------------------------------------
+    # Devices (reference: Devices.java)
+    # ------------------------------------------------------------------
+    def create_device(request: Request):
+        registry = _registry(request)
+        body = _body(request)
+        type_token = body.pop("device_type_token", None)
+        device = entity_from_payload(Device, body)
+        if type_token and not device.device_type_id:
+            device.device_type_id = registry.get_device_type_by_token(
+                type_token).id
+        return 201, registry.create_device(device)
+
+    def list_devices(request: Request):
+        assigned = request.query_one("assigned")
+        return results_to_jsonable(_registry(request).list_devices(
+            request.criteria(),
+            device_type_token=request.query_one("deviceType"),
+            assigned=None if assigned is None else assigned == "true"))
+
+    def get_device(request: Request):
+        device = _registry(request).get_device_by_token(
+            request.params["token"])
+        if device is None:
+            raise NotFoundError("unknown device",
+                                ErrorCode.INVALID_DEVICE_TOKEN)
+        return device
+
+    def update_device(request: Request):
+        return _registry(request).update_device(request.params["token"],
+                                                _body(request))
+
+    def delete_device(request: Request):
+        return _registry(request).delete_device(request.params["token"])
+
+    def list_device_assignments(request: Request):
+        return results_to_jsonable(_registry(request).list_assignments(
+            request.criteria(), device_token=request.params["token"]))
+
+    def add_device_event_batch(request: Request):
+        body = _body(request)
+        batch = DeviceEventBatch(
+            device_token=request.params["token"],
+            measurements=[event_from_payload(DeviceMeasurement, e)
+                          for e in body.get("measurements", [])],
+            locations=[event_from_payload(DeviceLocation, e)
+                       for e in body.get("locations", [])],
+            alerts=[event_from_payload(DeviceAlert, e)
+                    for e in body.get("alerts", [])])
+        persisted = _events(request).add_device_event_batch(
+            request.params["token"], batch)
+        return 201, {"persisted": len(persisted)}
+
+    def list_device_events(request: Request):
+        return results_to_jsonable(_events(request).list_device_events(
+            request.params["token"], request.date_criteria()))
+
+    router.post("/api/devices", create_device, authority=REST)
+    router.get("/api/devices", list_devices, authority=REST)
+    router.get("/api/devices/{token}", get_device, authority=REST)
+    router.put("/api/devices/{token}", update_device, authority=REST)
+    router.delete("/api/devices/{token}", delete_device, authority=REST)
+    router.get("/api/devices/{token}/assignments", list_device_assignments,
+               authority=REST)
+    router.post("/api/devices/{token}/events", add_device_event_batch,
+                authority=REST)
+    router.get("/api/devices/{token}/events", list_device_events,
+               authority=REST)
+
+    # ------------------------------------------------------------------
+    # Assignments + per-assignment events (reference: Assignments.java)
+    # ------------------------------------------------------------------
+    def create_assignment(request: Request):
+        registry = _registry(request)
+        body = _body(request)
+        device_token = body.pop("device_token", None)
+        assignment = entity_from_payload(DeviceAssignment, body)
+        if device_token and not assignment.device_id:
+            device = registry.get_device_by_token(device_token)
+            if device is None:
+                raise NotFoundError("unknown device",
+                                    ErrorCode.INVALID_DEVICE_TOKEN)
+            assignment.device_id = device.id
+        for token_field, lookup, id_field in (
+                ("area_token", registry.get_area_by_token, "area_id"),
+                ("customer_token", registry.get_customer_by_token,
+                 "customer_id")):
+            tok = body.get(token_field)
+            if tok and not getattr(assignment, id_field):
+                setattr(assignment, id_field, lookup(tok).id)
+        if not assignment.token:
+            assignment.token = new_id()
+        return 201, registry.create_device_assignment(assignment)
+
+    def list_assignments(request: Request):
+        return results_to_jsonable(_registry(request).list_assignments(
+            request.criteria(), device_token=request.query_one("device"),
+            customer_token=request.query_one("customer"),
+            area_token=request.query_one("area")))
+
+    def get_assignment(request: Request):
+        assignment = _registry(request).get_device_assignment_by_token(
+            request.params["token"])
+        if assignment is None:
+            raise NotFoundError("unknown assignment",
+                                ErrorCode.INVALID_ASSIGNMENT_TOKEN)
+        return assignment
+
+    def release_assignment(request: Request):
+        return _registry(request).release_device_assignment(
+            request.params["token"])
+
+    def mark_assignment_missing(request: Request):
+        registry = _registry(request)
+        assignment = registry.get_device_assignment_by_token(
+            request.params["token"])
+        if assignment is None:
+            raise NotFoundError("unknown assignment",
+                                ErrorCode.INVALID_ASSIGNMENT_TOKEN)
+        return registry.mark_assignment_missing(assignment.id)
+
+    router.post("/api/assignments", create_assignment, authority=REST)
+    router.get("/api/assignments", list_assignments, authority=REST)
+    router.get("/api/assignments/{token}", get_assignment, authority=REST)
+    router.post("/api/assignments/{token}/end", release_assignment,
+                authority=REST)
+    router.post("/api/assignments/{token}/missing", mark_assignment_missing,
+                authority=REST)
+
+    def _event_routes(kind: str, cls, add_method: str, list_method: str):
+        def add(request: Request):
+            events_api, token = _assignment_events(request)
+            payloads = request.body
+            if isinstance(payloads, dict):
+                payloads = [payloads]
+            if not isinstance(payloads, list):
+                raise SiteWhereError("JSON event body required",
+                                     http_status=400)
+            events = [event_from_payload(cls, p) for p in payloads]
+            persisted = getattr(events_api, add_method)(token, *events)
+            return 201, (persisted[0] if len(persisted) == 1
+                         else {"persisted": len(persisted)})
+
+        def list_(request: Request):
+            events_api, token = _assignment_events(request)
+            return results_to_jsonable(getattr(events_api, list_method)(
+                EventIndex.ASSIGNMENT, token, request.date_criteria()))
+
+        router.post(f"/api/assignments/{{token}}/{kind}", add, authority=REST)
+        router.get(f"/api/assignments/{{token}}/{kind}", list_,
+                   authority=REST)
+
+    _event_routes("measurements", DeviceMeasurement, "add_measurements",
+                  "list_measurements")
+    _event_routes("locations", DeviceLocation, "add_locations",
+                  "list_locations")
+    _event_routes("alerts", DeviceAlert, "add_alerts", "list_alerts")
+    _event_routes("statechanges", DeviceStateChange, "add_state_changes",
+                  "list_state_changes")
+
+    def create_command_invocation(request: Request):
+        """POST …/invocations — the §3.4 cloud→device flow entry point."""
+        events_api, token = _assignment_events(request)
+        body = _body(request)
+        invocation = event_from_payload(DeviceCommandInvocation, body)
+        if not invocation.target_id:
+            invocation.target_id = token
+        if invocation.initiator == CommandInitiator.REST:
+            invocation.initiator_id = request.username
+        persisted = events_api.add_command_invocations(token, invocation)
+        return 201, persisted[0]
+
+    def list_command_invocations(request: Request):
+        events_api, token = _assignment_events(request)
+        return results_to_jsonable(events_api.list_command_invocations(
+            EventIndex.ASSIGNMENT, token, request.date_criteria()))
+
+    def create_command_response(request: Request):
+        events_api, token = _assignment_events(request)
+        response = event_from_payload(DeviceCommandResponse, _body(request))
+        persisted = events_api.add_command_responses(token, response)
+        return 201, persisted[0]
+
+    def list_command_responses(request: Request):
+        events_api, _ = _assignment_events(request)
+        return results_to_jsonable(
+            events_api.list_command_responses_for_invocation(
+                request.params["invocation_id"], request.date_criteria()))
+
+    router.post("/api/assignments/{token}/invocations",
+                create_command_invocation, authority=REST)
+    router.get("/api/assignments/{token}/invocations",
+               list_command_invocations, authority=REST)
+    router.post("/api/assignments/{token}/responses", create_command_response,
+                authority=REST)
+    router.get("/api/invocations/{invocation_id}/responses",
+               list_command_responses, authority=REST)
+
+    def list_assignment_events(request: Request):
+        from sitewhere_tpu.persist.eventlog import EventFilter
+        events_api, token = _assignment_events(request)
+        return results_to_jsonable(events_api.log.query(
+            events_api.tenant, EventFilter(assignment_token=token),
+            request.date_criteria()))
+
+    router.get("/api/assignments/{token}/events", list_assignment_events,
+               authority=REST)
+
+    # ------------------------------------------------------------------
+    # Events by id (reference: DeviceEvents.java)
+    # ------------------------------------------------------------------
+    def get_event_by_id(request: Request):
+        event = _events(request).get_event_by_id(request.params["event_id"])
+        if event is None:
+            raise NotFoundError("unknown event", ErrorCode.INVALID_EVENT_ID)
+        return event
+
+    def get_event_by_alternate_id(request: Request):
+        event = _events(request).get_event_by_alternate_id(
+            request.params["alternate_id"])
+        if event is None:
+            raise NotFoundError("unknown event", ErrorCode.INVALID_EVENT_ID)
+        return event
+
+    router.get("/api/events/id/{event_id}", get_event_by_id, authority=REST)
+    router.get("/api/events/alternate/{alternate_id}",
+               get_event_by_alternate_id, authority=REST)
+
+    # ------------------------------------------------------------------
+    # Areas / area types / zones (reference: Areas.java, Zones.java)
+    # ------------------------------------------------------------------
+    def create_area_type(request: Request):
+        return 201, _registry(request).create_area_type(
+            entity_from_payload(AreaType, _body(request)))
+
+    def create_area(request: Request):
+        return 201, _registry(request).create_area(
+            entity_from_payload(Area, _body(request)))
+
+    def list_areas(request: Request):
+        return results_to_jsonable(
+            _registry(request).list_areas(request.criteria()))
+
+    def get_area(request: Request):
+        return _registry(request).get_area_by_token(request.params["token"])
+
+    def create_zone(request: Request):
+        registry = _registry(request)
+        area = registry.get_area_by_token(request.params["token"])
+        zone = entity_from_payload(Zone, _body(request))
+        zone.area_id = area.id
+        return 201, registry.create_zone(zone)
+
+    def list_zones(request: Request):
+        return results_to_jsonable(_registry(request).list_zones(
+            area_token=request.params["token"]))
+
+    def get_zone(request: Request):
+        return _registry(request).get_zone_by_token(request.params["token"])
+
+    def update_zone(request: Request):
+        body = _body(request)
+        if "bounds" in body:
+            body["bounds"] = [Location(**b) for b in body["bounds"]]
+        return _registry(request).update_zone(request.params["token"], body)
+
+    def delete_zone(request: Request):
+        return _registry(request).delete_zone(request.params["token"])
+
+    router.post("/api/areatypes", create_area_type, authority=REST)
+    router.post("/api/areas", create_area, authority=REST)
+    router.get("/api/areas", list_areas, authority=REST)
+    router.get("/api/areas/{token}", get_area, authority=REST)
+    router.post("/api/areas/{token}/zones", create_zone, authority=REST)
+    router.get("/api/areas/{token}/zones", list_zones, authority=REST)
+    router.get("/api/zones/{token}", get_zone, authority=REST)
+    router.put("/api/zones/{token}", update_zone, authority=REST)
+    router.delete("/api/zones/{token}", delete_zone, authority=REST)
+
+    # ------------------------------------------------------------------
+    # Customers (reference: Customers.java)
+    # ------------------------------------------------------------------
+    def create_customer_type(request: Request):
+        return 201, _registry(request).create_customer_type(
+            entity_from_payload(CustomerType, _body(request)))
+
+    def create_customer(request: Request):
+        return 201, _registry(request).create_customer(
+            entity_from_payload(Customer, _body(request)))
+
+    def list_customers(request: Request):
+        return results_to_jsonable(
+            _registry(request).list_customers(request.criteria()))
+
+    def get_customer(request: Request):
+        return _registry(request).get_customer_by_token(
+            request.params["token"])
+
+    router.post("/api/customertypes", create_customer_type, authority=REST)
+    router.post("/api/customers", create_customer, authority=REST)
+    router.get("/api/customers", list_customers, authority=REST)
+    router.get("/api/customers/{token}", get_customer, authority=REST)
+
+    # ------------------------------------------------------------------
+    # Device groups (reference: DeviceGroups.java)
+    # ------------------------------------------------------------------
+    def create_device_group(request: Request):
+        return 201, _registry(request).create_device_group(
+            entity_from_payload(DeviceGroup, _body(request)))
+
+    def get_device_group(request: Request):
+        return _registry(request).get_device_group_by_token(
+            request.params["token"])
+
+    def add_group_elements(request: Request):
+        payloads = request.body
+        if isinstance(payloads, dict):
+            payloads = [payloads]
+        if not isinstance(payloads, list):
+            raise SiteWhereError("JSON element body required",
+                                 http_status=400)
+        elements = [entity_from_payload(DeviceGroupElement, p)
+                    for p in payloads]
+        return 201, {"elements": _registry(request).add_device_group_elements(
+            request.params["token"], elements)}
+
+    def list_group_elements(request: Request):
+        return results_to_jsonable(_registry(request)
+                                   .list_device_group_elements(
+                                       request.params["token"]))
+
+    def list_group_devices(request: Request):
+        return {"devices": _registry(request).expand_group_devices(
+            request.params["token"])}
+
+    router.post("/api/devicegroups", create_device_group, authority=REST)
+    router.get("/api/devicegroups/{token}", get_device_group, authority=REST)
+    router.post("/api/devicegroups/{token}/elements", add_group_elements,
+                authority=REST)
+    router.get("/api/devicegroups/{token}/elements", list_group_elements,
+               authority=REST)
+    router.get("/api/devicegroups/{token}/devices", list_group_devices,
+               authority=REST)
+
+    # ------------------------------------------------------------------
+    # Assets (reference: Assets.java, AssetTypes.java)
+    # ------------------------------------------------------------------
+    def _assets(request: Request):
+        return _engine(request).asset_management
+
+    def create_asset_type(request: Request):
+        return 201, _assets(request).create_asset_type(
+            entity_from_payload(AssetType, _body(request)))
+
+    def list_asset_types(request: Request):
+        return results_to_jsonable(
+            _assets(request).list_asset_types(request.criteria()))
+
+    def get_asset_type(request: Request):
+        return _assets(request).get_asset_type_by_token(
+            request.params["token"])
+
+    def create_asset(request: Request):
+        assets = _assets(request)
+        body = _body(request)
+        type_token = body.pop("asset_type_token", None)
+        asset = entity_from_payload(Asset, body)
+        if type_token and not asset.asset_type_id:
+            asset.asset_type_id = assets.get_asset_type_by_token(type_token).id
+        return 201, assets.create_asset(asset)
+
+    def list_assets(request: Request):
+        return results_to_jsonable(_assets(request).list_assets(
+            asset_type_token=request.query_one("assetType"),
+            criteria=request.criteria()))
+
+    def get_asset(request: Request):
+        return _assets(request).get_asset_by_token(request.params["token"])
+
+    def update_asset(request: Request):
+        return _assets(request).update_asset(request.params["token"],
+                                             _body(request))
+
+    def delete_asset(request: Request):
+        return _assets(request).delete_asset(request.params["token"])
+
+    router.post("/api/assettypes", create_asset_type, authority=REST)
+    router.get("/api/assettypes", list_asset_types, authority=REST)
+    router.get("/api/assettypes/{token}", get_asset_type, authority=REST)
+    router.post("/api/assets", create_asset, authority=REST)
+    router.get("/api/assets", list_assets, authority=REST)
+    router.get("/api/assets/{token}", get_asset, authority=REST)
+    router.put("/api/assets/{token}", update_asset, authority=REST)
+    router.delete("/api/assets/{token}", delete_asset, authority=REST)
+
+    # ------------------------------------------------------------------
+    # Batch operations (reference: BatchOperations.java)
+    # ------------------------------------------------------------------
+    def list_batch_operations(request: Request):
+        return results_to_jsonable(
+            _engine(request).batch_management.list_batch_operations(
+                request.criteria()))
+
+    def get_batch_operation(request: Request):
+        return _engine(request).batch_management.get_batch_operation_by_token(
+            request.params["token"])
+
+    def list_batch_elements(request: Request):
+        return results_to_jsonable(
+            _engine(request).batch_management.list_batch_elements(
+                request.params["token"], request.criteria()))
+
+    def create_batch_command_invocation(request: Request):
+        from sitewhere_tpu.batch.manager import \
+            batch_command_invocation_request
+        engine = _engine(request)
+        body = _body(request)
+        device_tokens = list(body.get("device_tokens", []))
+        group_token = body.get("group_token")
+        if group_token:
+            device_tokens.extend(
+                d.token for d in engine.registry.expand_group_devices(
+                    group_token))
+        operation = batch_command_invocation_request(
+            command_token=body["command_token"],
+            parameters=body.get("parameter_values", {}),
+            device_tokens=device_tokens)
+        operation = engine.batch_management.create_batch_operation(
+            operation, engine.registry)
+        engine.batch_manager.submit(operation)
+        return 201, operation
+
+    router.get("/api/batch", list_batch_operations, authority=REST)
+    router.get("/api/batch/{token}", get_batch_operation, authority=REST)
+    router.get("/api/batch/{token}/elements", list_batch_elements,
+               authority=REST)
+    router.post("/api/batch/command", create_batch_command_invocation,
+                authority=REST)
+
+    # ------------------------------------------------------------------
+    # Schedules + jobs (reference: Schedules.java, ScheduledJobs.java)
+    # ------------------------------------------------------------------
+    ADMIN_SCHED = SiteWhereRoles.ADMINISTER_SCHEDULES
+
+    def create_schedule(request: Request):
+        return 201, _engine(request).schedule_management.create_schedule(
+            entity_from_payload(Schedule, _body(request)))
+
+    def list_schedules(request: Request):
+        return results_to_jsonable(
+            _engine(request).schedule_management.list_schedules(
+                request.criteria()))
+
+    def get_schedule(request: Request):
+        return _engine(request).schedule_management.get_schedule_by_token(
+            request.params["token"])
+
+    def delete_schedule(request: Request):
+        return _engine(request).schedule_management.delete_schedule(
+            request.params["token"])
+
+    def create_scheduled_job(request: Request):
+        engine = _engine(request)
+        job = entity_from_payload(ScheduledJob, _body(request))
+        job = engine.schedule_management.create_scheduled_job(job)
+        engine.schedule_manager.submit(job)
+        return 201, job
+
+    def list_scheduled_jobs(request: Request):
+        return results_to_jsonable(
+            _engine(request).schedule_management.list_scheduled_jobs(
+                request.criteria()))
+
+    def delete_scheduled_job(request: Request):
+        engine = _engine(request)
+        engine.schedule_manager.unschedule(request.params["token"])
+        return engine.schedule_management.delete_scheduled_job(
+            request.params["token"])
+
+    router.post("/api/schedules", create_schedule, authority=ADMIN_SCHED)
+    router.get("/api/schedules", list_schedules, authority=REST)
+    router.get("/api/schedules/{token}", get_schedule, authority=REST)
+    router.delete("/api/schedules/{token}", delete_schedule,
+                  authority=ADMIN_SCHED)
+    router.post("/api/jobs", create_scheduled_job, authority=ADMIN_SCHED)
+    router.get("/api/jobs", list_scheduled_jobs, authority=REST)
+    router.delete("/api/jobs/{token}", delete_scheduled_job,
+                  authority=ADMIN_SCHED)
+
+    # ------------------------------------------------------------------
+    # Device state (reference: DeviceStates.java) — reads the TPU-resident
+    # per-device state tensors through the pipeline engine.
+    # ------------------------------------------------------------------
+    def get_device_state(request: Request):
+        engine = instance.pipeline_engine
+        if engine is None:
+            raise SiteWhereError("pipeline engine not enabled",
+                                 http_status=503)
+        state = engine.get_device_state(request.params["token"])
+        if state is None:
+            raise NotFoundError("no state for device",
+                                ErrorCode.INVALID_DEVICE_TOKEN)
+        return state
+
+    router.get("/api/devicestates/{token}", get_device_state, authority=REST)

@@ -1,0 +1,255 @@
+"""REST gateway + client end-to-end tests.
+
+Strategy (SURVEY.md §4): unlike the reference — whose REST tests require a
+running server + live datastores (sitewhere-client ApiTests.java) — these
+boot the full in-process instance with the stdlib HTTP server on an
+ephemeral port and drive it through the real client over real HTTP.
+"""
+
+import pytest
+
+from sitewhere_tpu.client import SiteWhereClient, SiteWhereClientError
+from sitewhere_tpu.instance import SiteWhereInstance
+from sitewhere_tpu.web import RestServer
+
+
+@pytest.fixture(scope="module")
+def server():
+    instance = SiteWhereInstance(instance_id="webtest")
+    instance.start()
+    rest = RestServer(instance, port=0)
+    rest.start()
+    yield rest
+    rest.stop()
+    instance.stop()
+
+
+@pytest.fixture(scope="module")
+def client(server):
+    c = SiteWhereClient(server.base_url)
+    c.authenticate("admin", "password")
+    return c
+
+
+def test_jwt_round_trip(server):
+    c = SiteWhereClient(server.base_url)
+    token = c.authenticate("admin", "password")
+    assert token.count(".") == 2
+    assert c.get_version()["edition"] == "sitewhere-tpu"
+
+
+def test_bad_credentials_rejected(server):
+    c = SiteWhereClient(server.base_url)
+    with pytest.raises(SiteWhereClientError) as err:
+        c.authenticate("admin", "wrong")
+    assert err.value.status == 401
+
+
+def test_unauthenticated_request_rejected(server):
+    c = SiteWhereClient(server.base_url)
+    with pytest.raises(SiteWhereClientError) as err:
+        c.list_devices()
+    assert err.value.status == 401
+
+
+def test_garbage_bearer_token_rejected(server):
+    c = SiteWhereClient(server.base_url)
+    c.token = "not.a.jwt"
+    with pytest.raises(SiteWhereClientError) as err:
+        c.list_devices()
+    assert err.value.status == 401
+
+
+def test_unknown_route_404(client):
+    with pytest.raises(SiteWhereClientError) as err:
+        client.get("/api/nonsense")
+    assert err.value.status == 404
+
+
+def test_device_crud_over_rest(client):
+    client.create_device_type({"token": "dt-web", "name": "Web Sensor"})
+    assert client.get_device_type("dt-web")["name"] == "Web Sensor"
+
+    client.create_device({"token": "web-dev-1",
+                          "device_type_token": "dt-web"})
+    device = client.get_device("web-dev-1")
+    assert device["token"] == "web-dev-1"
+
+    found = client.list_devices(deviceType="dt-web")
+    assert found["numResults"] == 1
+
+    with pytest.raises(SiteWhereClientError) as err:
+        client.get_device("missing-device")
+    assert err.value.status == 404
+
+
+def test_assignment_and_event_flow(client):
+    client.create_device({"token": "web-dev-2",
+                          "device_type_token": "dt-web"})
+    assignment = client.create_assignment({"token": "web-as-2",
+                                           "device_token": "web-dev-2"})
+    assert assignment["status"] == 1  # DeviceAssignmentStatus.ACTIVE
+
+    client.add_measurements("web-as-2",
+                            {"name": "temp", "value": 21.5},
+                            {"name": "temp", "value": 22.5})
+    client.add_locations("web-as-2", {"latitude": 1.0, "longitude": 2.0})
+    client.add_alerts("web-as-2", {"type": "fault", "message": "boom"})
+
+    ms = client.list_measurements("web-as-2")
+    assert ms["numResults"] == 2
+    assert {m["value"] for m in ms["results"]} == {21.5, 22.5}
+    assert client.list_locations("web-as-2")["numResults"] == 1
+    assert client.list_alerts("web-as-2")["numResults"] == 1
+
+    events = client.get("/api/assignments/web-as-2/events")
+    assert events["numResults"] == 4
+
+    # event lookup by id
+    event_id = ms["results"][0]["id"]
+    fetched = client.get(f"/api/events/id/{event_id}")
+    assert fetched["id"] == event_id
+
+    released = client.release_assignment("web-as-2")
+    assert released["status"] == 3  # DeviceAssignmentStatus.RELEASED
+
+
+def test_device_event_batch(client):
+    client.create_device({"token": "web-dev-3",
+                          "device_type_token": "dt-web"})
+    client.create_assignment({"token": "web-as-3",
+                              "device_token": "web-dev-3"})
+    result = client.add_device_event_batch("web-dev-3", {
+        "measurements": [{"name": "hum", "value": 55.0}],
+        "locations": [{"latitude": 3.0, "longitude": 4.0}],
+        "alerts": [],
+    })
+    assert result["persisted"] == 2
+    assert client.list_device_events("web-dev-3")["numResults"] == 2
+
+
+def test_command_invocation_flow(client):
+    client.create_device_command("dt-web", {"token": "reboot",
+                                            "name": "reboot"})
+    client.create_device({"token": "web-dev-4",
+                          "device_type_token": "dt-web"})
+    client.create_assignment({"token": "web-as-4",
+                              "device_token": "web-dev-4"})
+    invocation = client.invoke_command("web-as-4",
+                                       {"command_token": "reboot"})
+    assert invocation["command_token"] == "reboot"
+    assert invocation["initiator_id"] == "admin"
+    invocations = client.get("/api/assignments/web-as-4/invocations")
+    assert invocations["numResults"] == 1
+
+
+def test_areas_zones_over_rest(client):
+    client.create_area({"token": "web-area", "name": "Yard"})
+    client.create_zone("web-area", {
+        "token": "web-zone", "name": "Fence",
+        "bounds": [{"latitude": 0, "longitude": 0},
+                   {"latitude": 0, "longitude": 1},
+                   {"latitude": 1, "longitude": 1}]})
+    zone = client.get("/api/zones/web-zone")
+    assert len(zone["bounds"]) == 3
+    zones = client.get("/api/areas/web-area/zones")
+    assert zones["numResults"] == 1
+
+
+def test_batch_command_over_rest(client):
+    for i in range(3):
+        client.create_device({"token": f"web-batch-{i}",
+                              "device_type_token": "dt-web"})
+        client.create_assignment({"token": f"web-batch-as-{i}",
+                                  "device_token": f"web-batch-{i}"})
+    op = client.create_batch_command_invocation({
+        "command_token": "reboot",
+        "device_tokens": [f"web-batch-{i}" for i in range(3)]})
+    token = op["token"]
+    import time
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        status = client.get_batch_operation(token)["processing_status"]
+        if status in ("FinishedSuccessfully", "FinishedWithErrors"):
+            break
+        time.sleep(0.05)
+    elements = client.get(f"/api/batch/{token}/elements")
+    assert elements["numResults"] == 3
+
+
+def test_users_and_tenants_admin(client):
+    client.create_user({"username": "operator", "password": "pw",
+                        "authorities": ["REST"]})
+    users = client.list_users()
+    assert users["numResults"] >= 2
+
+    # operator lacks ADMINISTER_USERS -> 403
+    c2 = SiteWhereClient(client.base_url)
+    c2.authenticate("operator", "pw")
+    with pytest.raises(SiteWhereClientError) as err:
+        c2.list_users()
+    assert err.value.status == 403
+    # but REST endpoints work
+    assert c2.list_devices()["numResults"] >= 1
+
+    tenant = client.create_tenant({"token": "t2", "name": "Second",
+                                   "tenant_template_id": "empty"})
+    assert tenant["token"] == "t2"
+    assert client.post("/api/tenants/t2/engine/start")["status"] == "STARTED"
+    # tenant isolation: t2 sees no devices
+    c3 = SiteWhereClient(client.base_url, tenant="t2")
+    c3.token = client.token
+    assert c3.list_devices()["numResults"] == 0
+
+
+def test_assets_over_rest(client):
+    client.create_asset_type({"token": "at-web", "name": "Tracker"})
+    client.create_asset({"token": "asset-web", "name": "Tracker 1",
+                         "asset_type_token": "at-web"})
+    asset = client.get("/api/assets/asset-web")
+    assert asset["name"] == "Tracker 1"
+    assert client.get("/api/assets")["numResults"] == 1
+
+
+def test_tenant_authorized_users_gate(client):
+    client.create_user({"username": "outsider", "password": "pw",
+                        "authorities": ["REST"]})
+    client.create_tenant({"token": "gated", "name": "Gated",
+                          "tenant_template_id": "empty",
+                          "authorized_user_ids": ["someone-else"]})
+    c2 = SiteWhereClient(client.base_url, tenant="gated")
+    c2.authenticate("outsider", "pw")
+    with pytest.raises(SiteWhereClientError) as err:
+        c2.list_devices()
+    assert err.value.status == 403
+    # tenant admin is always allowed through the gate
+    c3 = SiteWhereClient(client.base_url, tenant="gated")
+    c3.token = client.token
+    assert c3.list_devices()["numResults"] == 0
+
+
+def test_stopped_engine_stays_stopped(client):
+    client.create_tenant({"token": "t-stop", "name": "Stoppable",
+                          "tenant_template_id": "empty"})
+    c2 = SiteWhereClient(client.base_url, tenant="t-stop")
+    c2.token = client.token
+    assert c2.list_devices()["numResults"] == 0  # lazy boot works
+    client.post("/api/tenants/t-stop/engine/stop")
+    # request traffic must NOT resurrect an explicitly-stopped engine
+    with pytest.raises(SiteWhereClientError) as err:
+        c2.list_devices()
+    assert err.value.status == 404
+    client.post("/api/tenants/t-stop/engine/start")
+    assert c2.list_devices()["numResults"] == 0
+
+
+def test_missing_event_body_is_400(client):
+    with pytest.raises(SiteWhereClientError) as err:
+        client.post("/api/assignments/web-as-2/measurements", None)
+    assert err.value.status == 400
+
+
+def test_topology_endpoint(client):
+    topo = client.get_topology()
+    assert topo["instance_id"] == "webtest"
+    assert "default" in topo["tenant_engines"]
