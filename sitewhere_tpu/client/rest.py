@@ -43,7 +43,10 @@ class SiteWhereClient:
                 {k: v for k, v in params.items() if v is not None})
         data = None
         req_headers = {"Accept": "application/json"}
-        if body is not None:
+        if isinstance(body, bytes):
+            data = body
+            req_headers["Content-Type"] = "application/octet-stream"
+        elif body is not None:
             data = json.dumps(body).encode("utf-8")
             req_headers["Content-Type"] = "application/json"
         if self.token:
@@ -58,6 +61,7 @@ class SiteWhereClient:
             with urllib.request.urlopen(request,
                                         timeout=self.timeout) as resp:
                 raw = resp.read()
+                ctype = resp.headers.get("Content-Type", "")
         except urllib.error.HTTPError as err:
             raw = err.read()
             try:
@@ -65,7 +69,9 @@ class SiteWhereClient:
             except Exception:
                 payload = raw.decode("utf-8", "replace")
             raise SiteWhereClientError(err.code, payload)
-        return json.loads(raw) if raw else None
+        if "json" in ctype:
+            return json.loads(raw) if raw else None
+        return raw  # binary endpoints: empty body is b"", not None
 
     def get(self, path: str, **params) -> Any:
         return self._request("GET", path, params=params or None)
@@ -209,6 +215,34 @@ class SiteWhereClient:
 
     def create_scheduled_job(self, body: Dict) -> Dict:
         return self.post("/api/jobs", body)
+
+    # -- device streams ----------------------------------------------------
+    def create_device_stream(self, assignment_token: str, stream_id: str,
+                             content_type: str = "application/octet-stream"
+                             ) -> Dict:
+        return self.post(f"/api/assignments/{assignment_token}/streams",
+                         {"stream_id": stream_id,
+                          "content_type": content_type})
+
+    def add_stream_data(self, assignment_token: str, stream_id: str,
+                        sequence: int, data: bytes) -> Dict:
+        return self._request(
+            "POST", f"/api/assignments/{assignment_token}/streams/"
+                    f"{stream_id}/data/{sequence}", body=data)
+
+    def get_stream_data(self, assignment_token: str, stream_id: str,
+                        sequence: int) -> bytes:
+        return self.get(f"/api/assignments/{assignment_token}/streams/"
+                        f"{stream_id}/data/{sequence}")
+
+    def get_stream_content(self, assignment_token: str,
+                           stream_id: str) -> bytes:
+        return self.get(f"/api/assignments/{assignment_token}/streams/"
+                        f"{stream_id}/content")
+
+    # -- event search ------------------------------------------------------
+    def search_events(self, provider_id: str = "columnar", **params) -> Dict:
+        return self.get(f"/api/search/{provider_id}/events", **params)
 
     # -- device state ------------------------------------------------------
     def get_device_state(self, device_token: str) -> Dict:

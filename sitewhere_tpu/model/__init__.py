@@ -29,6 +29,7 @@ from sitewhere_tpu.model.device import (
     DeviceAlarm,
     DeviceAlarmState,
     DeviceElementMapping,
+    DeviceStream,
 )
 from sitewhere_tpu.model.area import (
     AreaType,

@@ -177,3 +177,13 @@ class DeviceAlarm(PersistentEntity):
     triggered_date: Optional[int] = None
     acknowledged_date: Optional[int] = None
     resolved_date: Optional[int] = None
+
+
+@dataclass
+class DeviceStream(PersistentEntity):
+    """Binary stream declared by a device under an assignment (IDeviceStream,
+    reference: sitewhere-core-api spi/device/streaming/IDeviceStream.java).
+    `token` holds the stream id; chunks are DeviceStreamData events."""
+
+    assignment_id: str = ""
+    content_type: str = "application/octet-stream"

@@ -39,7 +39,10 @@ from sitewhere_tpu.runtime.lifecycle import LifecycleComponent
 from sitewhere_tpu.schedule import (
     BatchCommandInvocationJobExecutor, CommandInvocationJobExecutor,
     ScheduleManagement, ScheduleManager)
+from sitewhere_tpu.search import (ColumnarSearchProvider,
+                                  SearchProvidersManager)
 from sitewhere_tpu.sources.manager import EventSourcesManager
+from sitewhere_tpu.streams import DeviceStreamManager
 
 LOGGER = logging.getLogger("sitewhere.tenant")
 
@@ -94,6 +97,14 @@ class TenantEngine(LifecycleComponent):
         self.rule_processors = RuleProcessorsManager(bus, tenant.token,
                                                      self.naming)
 
+        # streaming media + federated search
+        self.streams = DeviceStreamManager(self.registry,
+                                           self.event_management,
+                                           store=make_store("streams"))
+        self.search_providers = SearchProvidersManager()
+        self.search_providers.register(
+            ColumnarSearchProvider(log, tenant.token))
+
         # batch + schedule
         self.batch_management = BatchManagement(make_store("batch"))
         self.batch_manager = BatchOperationManager(self.batch_management)
@@ -115,7 +126,8 @@ class TenantEngine(LifecycleComponent):
                           self.command_delivery, self.registration,
                           self.event_sources, self.connectors,
                           self.rule_processors, self.batch_manager,
-                          self.schedule_manager):
+                          self.schedule_manager, self.streams,
+                          self.search_providers):
             self.add_nested(component)
 
 

@@ -117,12 +117,15 @@ class EventFilter:
     mm_name: Optional[str] = None
     originating_event_id: Optional[str] = None
     stream_id: Optional[str] = None
+    sequence_number: Optional[int] = None
 
     def _mask(self, cols: Dict[str, np.ndarray]) -> np.ndarray:
         n = len(cols["event_date"])
         mask = np.ones(n, bool)
         if self.event_type is not None:
             mask &= cols["event_type"] == int(self.event_type)
+        if self.sequence_number is not None:
+            mask &= cols["sequence_number"] == self.sequence_number
         if self.device_idx is not None:
             mask &= cols["device_idx"] == self.device_idx
         if self.start_date is not None:
@@ -591,9 +594,11 @@ class ColumnarEventLog:
         key_col = ("sequence_number" if order_by == "sequence_asc"
                    else "event_date")
         keys = np.concatenate([cols[key_col][idx] for cols, idx in matches])
-        if order_by != "sequence_asc":
-            keys = -keys  # descending
         order = np.argsort(keys, kind="stable")
+        if order_by != "sequence_asc":
+            # descending; reversing the stable ascending order also puts the
+            # latest-appended event first among same-millisecond ties
+            order = order[::-1]
         total = len(order)
         skip = criteria.offset
         page = order[skip:skip + criteria.page_size]

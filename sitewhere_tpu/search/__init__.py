@@ -1,0 +1,8 @@
+"""Federated event search (reference: service-event-search)."""
+
+from sitewhere_tpu.search.providers import (
+    ColumnarSearchProvider, SearchCriteriaSpec, SearchProvider,
+    SearchProvidersManager)
+
+__all__ = ["ColumnarSearchProvider", "SearchCriteriaSpec", "SearchProvider",
+           "SearchProvidersManager"]

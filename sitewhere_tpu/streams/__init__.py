@@ -1,0 +1,5 @@
+"""Device binary streams (reference: service-streaming-media)."""
+
+from sitewhere_tpu.streams.manager import DeviceStreamManager
+
+__all__ = ["DeviceStreamManager"]

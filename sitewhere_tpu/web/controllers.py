@@ -780,6 +780,77 @@ def register_all(router: Router, instance, server) -> None:
                   authority=ADMIN_SCHED)
 
     # ------------------------------------------------------------------
+    # Device streams (reference: Streams.java / service-streaming-media)
+    # ------------------------------------------------------------------
+    def create_device_stream(request: Request):
+        body = _body(request)
+        stream = _engine(request).streams.create_device_stream(
+            request.params["token"], body["stream_id"],
+            content_type=body.get("content_type",
+                                  "application/octet-stream"))
+        return 201, stream
+
+    def list_device_streams(request: Request):
+        return results_to_jsonable(_engine(request).streams
+                                   .list_device_streams(
+                                       request.params["token"],
+                                       request.criteria()))
+
+    def add_stream_data(request: Request):
+        """Chunk upload: raw body bytes, sequence number in the path."""
+        data = request.body
+        if isinstance(data, str):
+            data = data.encode("utf-8")
+        if not isinstance(data, bytes):
+            raise SiteWhereError("binary body required", http_status=400)
+        chunk = _engine(request).streams.add_stream_data(
+            request.params["token"], request.params["stream_id"],
+            int(request.params["sequence"]), data)
+        return 201, {"id": chunk.id,
+                     "sequence_number": chunk.sequence_number,
+                     "size": len(data)}
+
+    def get_stream_data(request: Request):
+        chunk = _engine(request).streams.get_stream_data(
+            request.params["token"], request.params["stream_id"],
+            int(request.params["sequence"]))
+        if chunk is None:
+            raise NotFoundError("unknown chunk", ErrorCode.INVALID_STREAM_ID)
+        return chunk.data  # raw bytes response
+
+    def get_stream_content(request: Request):
+        return _engine(request).streams.reassemble(
+            request.params["token"], request.params["stream_id"])
+
+    router.post("/api/assignments/{token}/streams", create_device_stream,
+                authority=REST)
+    router.get("/api/assignments/{token}/streams", list_device_streams,
+               authority=REST)
+    router.post("/api/assignments/{token}/streams/{stream_id}/data/"
+                "{sequence}", add_stream_data, authority=REST)
+    router.get("/api/assignments/{token}/streams/{stream_id}/data/"
+               "{sequence}", get_stream_data, authority=REST)
+    router.get("/api/assignments/{token}/streams/{stream_id}/content",
+               get_stream_content, authority=REST)
+
+    # ------------------------------------------------------------------
+    # Federated event search (reference: Search.java / service-event-search)
+    # ------------------------------------------------------------------
+    def list_search_providers(request: Request):
+        return {"results": _engine(request).search_providers
+                .list_providers()}
+
+    def search_events(request: Request):
+        from sitewhere_tpu.search import SearchCriteriaSpec
+        spec = SearchCriteriaSpec.from_query(request)
+        return results_to_jsonable(_engine(request).search_providers.search(
+            request.params["provider_id"], spec))
+
+    router.get("/api/search", list_search_providers, authority=REST)
+    router.get("/api/search/{provider_id}/events", search_events,
+               authority=REST)
+
+    # ------------------------------------------------------------------
     # Device state (reference: DeviceStates.java) — reads the TPU-resident
     # per-device state tensors through the pipeline engine.
     # ------------------------------------------------------------------

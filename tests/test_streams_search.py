@@ -1,0 +1,123 @@
+"""Device streams + event search unit tests (no HTTP).
+
+Covers what the REST tests don't: stream-metadata durability across manager
+restarts (the reference persists streams via device management), exact chunk
+lookup beyond one page, duplicate-redelivery semantics, and search criteria
+parsing errors.
+"""
+
+import pytest
+
+from sitewhere_tpu.errors import NotFoundError, SiteWhereError
+from sitewhere_tpu.model.common import SearchCriteria
+from sitewhere_tpu.model.device import Device, DeviceAssignment, DeviceType
+from sitewhere_tpu.model.event import DeviceEventType, DeviceMeasurement
+from sitewhere_tpu.persist.event_management import DeviceEventManagement
+from sitewhere_tpu.persist.eventlog import ColumnarEventLog
+from sitewhere_tpu.registry.store import DeviceManagement, SqliteStore
+from sitewhere_tpu.search import (ColumnarSearchProvider, SearchCriteriaSpec,
+                                  SearchProvidersManager)
+from sitewhere_tpu.streams import DeviceStreamManager
+
+
+@pytest.fixture()
+def world(tmp_path):
+    registry = DeviceManagement()
+    dtype = registry.create_device_type(DeviceType(token="dt"))
+    device = registry.create_device(Device(token="d1",
+                                           device_type_id=dtype.id))
+    registry.create_device_assignment(DeviceAssignment(token="a1",
+                                                       device_id=device.id))
+    log = ColumnarEventLog(data_dir=str(tmp_path / "log"), segment_rows=16)
+    events = DeviceEventManagement(log, registry, "t1")
+    return registry, log, events, tmp_path
+
+
+class TestDeviceStreams:
+    def test_metadata_survives_manager_restart(self, world):
+        registry, log, events, tmp = world
+        store = SqliteStore(str(tmp / "streams.db"))
+        mgr = DeviceStreamManager(registry, events, store=store)
+        mgr.create_device_stream("a1", "fw", content_type="application/fw")
+        mgr.add_stream_data("a1", "fw", 0, b"abc")
+
+        # engine restart: fresh manager over the same store + log
+        mgr2 = DeviceStreamManager(registry, events, store=store)
+        stream = mgr2.require_device_stream("a1", "fw")
+        assert stream.content_type == "application/fw"
+        assert mgr2.reassemble("a1", "fw") == b"abc"
+        # duplicate declaration still rejected after restart
+        with pytest.raises(SiteWhereError):
+            mgr2.create_device_stream("a1", "fw")
+
+    def test_chunk_lookup_beyond_first_page(self, world):
+        registry, log, events, _ = world
+        mgr = DeviceStreamManager(registry, events)
+        mgr.create_device_stream("a1", "s")
+        for seq in range(30):
+            mgr.add_stream_data("a1", "s", seq, bytes([seq]))
+        # exact columnar lookup — no paging scan involved
+        chunk = mgr.get_stream_data("a1", "s", 29)
+        assert chunk is not None and chunk.data == bytes([29])
+        assert mgr.get_stream_data("a1", "s", 99) is None
+
+    def test_reassemble_pages_through_all_chunks(self, world):
+        registry, log, events, _ = world
+        mgr = DeviceStreamManager(registry, events)
+        mgr.create_device_stream("a1", "s")
+        for seq in range(25):
+            mgr.add_stream_data("a1", "s", seq, bytes([seq]))
+        content = mgr.reassemble("a1", "s", page_size=7)  # forces 4 pages
+        assert content == bytes(range(25))
+
+    def test_duplicate_redelivery_last_write_wins_everywhere(self, world):
+        registry, log, events, _ = world
+        mgr = DeviceStreamManager(registry, events)
+        mgr.create_device_stream("a1", "s")
+        mgr.add_stream_data("a1", "s", 0, b"old")
+        mgr.add_stream_data("a1", "s", 1, b"!")
+        mgr.add_stream_data("a1", "s", 0, b"new")
+        assert mgr.reassemble("a1", "s") == b"new!"
+        assert mgr.get_stream_data("a1", "s", 0).data == b"new"
+
+    def test_unknown_stream_and_assignment(self, world):
+        registry, log, events, _ = world
+        mgr = DeviceStreamManager(registry, events)
+        with pytest.raises(NotFoundError):
+            mgr.add_stream_data("a1", "ghost", 0, b"x")
+        with pytest.raises(NotFoundError):
+            mgr.list_device_streams("no-such-assignment")
+
+
+class TestEventSearch:
+    def test_columnar_provider_filters(self, world):
+        registry, log, events, _ = world
+        events.add_measurements("a1", DeviceMeasurement(name="rpm",
+                                                        value=1.0),
+                                DeviceMeasurement(name="temp", value=2.0))
+        manager = SearchProvidersManager()
+        manager.register(ColumnarSearchProvider(log, "t1"))
+        hits = manager.search("columnar", SearchCriteriaSpec(
+            event_type=DeviceEventType.MEASUREMENT,
+            measurement_name="rpm"))
+        assert hits.num_results == 1
+        assert hits.results[0].name == "rpm"
+
+    def test_unknown_provider_raises(self, world):
+        manager = SearchProvidersManager()
+        with pytest.raises(NotFoundError):
+            manager.search("solr", SearchCriteriaSpec())
+
+    def test_from_query_rejects_bad_event_type(self):
+        from sitewhere_tpu.web.router import Request
+        request = Request(query={"eventType": ["bogus"]})
+        with pytest.raises(SiteWhereError) as err:
+            SearchCriteriaSpec.from_query(request)
+        assert err.value.http_status == 400
+
+    def test_from_query_rejects_bad_date(self):
+        from sitewhere_tpu.web.router import Request
+        request = Request(query={"startDate": ["yesterday"]})
+        with pytest.raises(SiteWhereError) as err:
+            SearchCriteriaSpec.from_query(request)
+        assert err.value.http_status == 400

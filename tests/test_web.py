@@ -249,6 +249,64 @@ def test_missing_event_body_is_400(client):
     assert err.value.status == 400
 
 
+def test_device_streams_over_rest(client):
+    client.create_device({"token": "stream-dev",
+                          "device_type_token": "dt-web"})
+    client.create_assignment({"token": "stream-as",
+                              "device_token": "stream-dev"})
+    stream = client.create_device_stream("stream-as", "video-1",
+                                         content_type="video/mp4")
+    assert stream["token"] == "video-1"
+    assert stream["content_type"] == "video/mp4"
+
+    # duplicate stream id rejected
+    with pytest.raises(SiteWhereClientError) as err:
+        client.create_device_stream("stream-as", "video-1")
+    assert err.value.status == 409
+
+    # chunks out of order + a redelivered duplicate
+    client.add_stream_data("stream-as", "video-1", 1, b"world")
+    client.add_stream_data("stream-as", "video-1", 0, b"hello ")
+    client.add_stream_data("stream-as", "video-1", 1, b"world")
+    assert client.get_stream_data("stream-as", "video-1", 0) == b"hello "
+    assert client.get_stream_content("stream-as", "video-1") == b"hello world"
+
+    streams = client.get("/api/assignments/stream-as/streams")
+    assert streams["numResults"] == 1
+
+    # unknown stream -> 404
+    with pytest.raises(SiteWhereClientError) as err:
+        client.add_stream_data("stream-as", "nope", 0, b"x")
+    assert err.value.status == 404
+
+
+def test_event_search_over_rest(client):
+    providers = client.get("/api/search")
+    assert {"id": "columnar", "name": "Columnar event search"} in \
+        providers["results"]
+
+    client.create_device({"token": "search-dev",
+                          "device_type_token": "dt-web"})
+    client.create_assignment({"token": "search-as",
+                              "device_token": "search-dev"})
+    client.add_measurements("search-as", {"name": "rpm", "value": 900.0})
+    client.add_alerts("search-as", {"type": "fault", "message": "x"})
+
+    hits = client.search_events(device="search-dev")
+    assert hits["numResults"] == 2
+    only_alerts = client.search_events(device="search-dev",
+                                       eventType="alert")
+    assert only_alerts["numResults"] == 1
+    assert only_alerts["results"][0]["type"] == "fault"
+    by_name = client.search_events(assignment="search-as",
+                                   measurement="rpm")
+    assert by_name["numResults"] == 1
+
+    with pytest.raises(SiteWhereClientError) as err:
+        client.search_events(provider_id="solr")
+    assert err.value.status == 404
+
+
 def test_topology_endpoint(client):
     topo = client.get_topology()
     assert topo["instance_id"] == "webtest"
