@@ -1,0 +1,15 @@
+"""Batch + streaming analytics over the event plane (sitewhere-spark
+replacement): windowed segment-reduction kernels, replay engines, and a
+micro-batch stream receiver."""
+
+from sitewhere_tpu.analytics.engine import (
+    BusReplayAnalytics, WindowReport, WindowedAnalyticsEngine)
+from sitewhere_tpu.analytics.receiver import EventStreamReceiver, MicroBatch
+from sitewhere_tpu.analytics.windows import (
+    WindowedStats, compact_keys, event_type_histogram, windowed_stats)
+
+__all__ = [
+    "BusReplayAnalytics", "EventStreamReceiver", "MicroBatch",
+    "WindowReport", "WindowedAnalyticsEngine", "WindowedStats",
+    "compact_keys", "event_type_histogram", "windowed_stats",
+]

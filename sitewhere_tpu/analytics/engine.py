@@ -1,0 +1,227 @@
+"""Replay analytics engine: event log / bus -> windowed stat grids.
+
+Reference: the reference's batch-analytics story is "export to Spark"
+(sitewhere-spark/SiteWhereReceiver.java:31 subscribing to Hazelcast event
+topics); all aggregation happens off-platform. Here replay is first-class
+(BASELINE.md config 4 — "Kafka-replay windowed batch analytics"): the
+columnar event log (persist/eventlog.py) yields raw column arrays with no
+per-event materialization, the host compacts keys and rebases timestamps,
+and one accelerator pass (analytics/windows.py) produces the grids.
+
+Two replay sources:
+  * `ColumnarEventLog` — vectorized scan, the fast path.
+  * an `EventBus` topic — decodes enriched payloads (per-record, control-
+    plane rate) and feeds the same kernels; this is the literal
+    Kafka-replay flavor used when only the bus log survives.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from sitewhere_tpu.analytics.windows import (
+    WindowedStats, compact_keys, event_type_histogram, windowed_stats)
+from sitewhere_tpu.model.event import DeviceEventType
+from sitewhere_tpu.persist.eventlog import ColumnarEventLog, EventFilter
+
+_N_EVENT_TYPES = 8  # DeviceEventType codes fit comfortably
+
+
+def _pad_pow2(n: int, floor: int = 8) -> int:
+    """Round a grid dimension up to a power of two so replays of similar
+    size share one compiled kernel (static-shape bucketing, the same trick
+    the ingest packer uses for batch sizes)."""
+    out = floor
+    while out < n:
+        out *= 2
+    return out
+
+
+@dataclass
+class WindowReport:
+    """Host-side result of one windowed replay."""
+
+    t0_ms: int
+    window_ms: int
+    n_windows: int
+    key_ids: np.ndarray        # raw key per grid row (device_idx or hash id)
+    key_tokens: List[str]      # resolved tokens when available ("" otherwise)
+    stats: WindowedStats       # [K_padded, W] — rows past len(key_ids) unused
+    type_counts: Optional[np.ndarray] = None  # int32 [n_types, W]
+
+    @property
+    def num_keys(self) -> int:
+        return len(self.key_ids)
+
+    def window_starts(self) -> np.ndarray:
+        return self.t0_ms + np.arange(self.n_windows, dtype=np.int64) * \
+            self.window_ms
+
+    def series(self, row: int) -> Dict[str, np.ndarray]:
+        """One key's per-window series as numpy arrays."""
+        return {
+            "count": np.asarray(self.stats.count[row, :self.n_windows]),
+            "sum": np.asarray(self.stats.sum[row, :self.n_windows]),
+            "mean": np.asarray(self.stats.mean[row, :self.n_windows]),
+            "min": np.asarray(self.stats.min[row, :self.n_windows]),
+            "max": np.asarray(self.stats.max[row, :self.n_windows]),
+        }
+
+    def totals(self) -> Dict[str, float]:
+        count = np.asarray(self.stats.count)[:self.num_keys, :self.n_windows]
+        vsum = np.asarray(self.stats.sum)[:self.num_keys, :self.n_windows]
+        n = int(count.sum())
+        return {"events": n,
+                "mean": float(vsum.sum() / n) if n else float("nan")}
+
+
+class WindowedAnalyticsEngine:
+    """Windowed replay over the columnar event log."""
+
+    def __init__(self, event_log: ColumnarEventLog):
+        self.event_log = event_log
+
+    def measurement_windows(self, tenant: str, *, window_ms: int = 60_000,
+                            mm_name: Optional[str] = None,
+                            start_ms: Optional[int] = None,
+                            end_ms: Optional[int] = None,
+                            area_id: Optional[str] = None,
+                            max_windows: int = 4096,
+                            with_type_histogram: bool = False
+                            ) -> WindowReport:
+        """Per-device windowed stats over measurement values.
+
+        Replaces the Spark-side `reduceByKeyAndWindow` pattern the reference
+        delegates to: filter -> column scan -> one segment-reduction pass.
+        """
+        flt = EventFilter(event_type=DeviceEventType.MEASUREMENT,
+                          mm_name=mm_name, area_id=area_id,
+                          start_date=start_ms, end_date=end_ms)
+        names = ["device_token", "event_date", "value"]
+        all_flt = (EventFilter(start_date=start_ms, end_date=end_ms,
+                               area_id=area_id)
+                   if with_type_histogram else None)
+        cols = self.event_log.query_columns(tenant, flt, names)
+        tokens = np.asarray(
+            ["" if t is None else str(t) for t in cols["device_token"]],
+            dtype=object)
+        return self._build_report(
+            tokens, cols["event_date"], cols["value"],
+            window_ms=window_ms, start_ms=start_ms, end_ms=end_ms,
+            max_windows=max_windows,
+            hist_cols=(self.event_log.query_columns(
+                tenant, all_flt, ["event_type", "event_date"])
+                if all_flt is not None else None))
+
+    @staticmethod
+    def _build_report(key_raw: np.ndarray, event_date: np.ndarray,
+                      value: np.ndarray, *, window_ms: int,
+                      start_ms: Optional[int], end_ms: Optional[int],
+                      max_windows: int,
+                      hist_cols: Optional[Dict[str, np.ndarray]] = None,
+                      tokens: Optional[List[str]] = None) -> WindowReport:
+        n = len(event_date)
+        # Windows are derived from whatever rows exist — measurement rows
+        # normally, histogram rows when the measurement filter matched none
+        # (a tenant of pure location/alert traffic still gets its histogram).
+        span_dates = event_date
+        if n == 0 and hist_cols is not None and len(hist_cols["event_date"]):
+            span_dates = hist_cols["event_date"]
+        if len(span_dates) == 0:
+            empty = WindowedStats(*(np.zeros((0, 0), d) for d in
+                                    (np.int32, np.float32, np.float32,
+                                     np.float32, np.float32)))
+            return WindowReport(t0_ms=start_ms or 0, window_ms=window_ms,
+                                n_windows=0, key_ids=np.array([], object),
+                                key_tokens=[], stats=empty)
+        t0 = int(start_ms if start_ms is not None else span_dates.min())
+        t_end = int(end_ms if end_ms is not None else span_dates.max())
+        n_windows = max(1, min(max_windows, (t_end - t0) // window_ms + 1))
+
+        def buckets(dates: np.ndarray) -> np.ndarray:
+            """int64-safe host bucketing: replays spanning > 2^31 ms cannot
+            ride the int32 on-device ts lane, so the bucket index (always
+            small — capped by max_windows) is computed here and fed to the
+            kernel with window_ms=1 (bucket // 1 == bucket)."""
+            rel = dates.astype(np.int64) - t0
+            b = rel // window_ms
+            return np.where((rel >= 0) & (b < n_windows), b,
+                            -1).astype(np.int32)
+
+        valid = (event_date >= t0) & (event_date <= t_end)
+        dense, uniq = compact_keys(key_raw, valid)
+
+        K = _pad_pow2(max(len(uniq), 1))
+        W = _pad_pow2(int(n_windows))
+        stats = windowed_stats(dense, buckets(event_date), value, valid,
+                               window_ms=1, num_keys=K, n_windows=W)
+        type_counts = None
+        if hist_cols is not None and len(hist_cols["event_date"]):
+            h_dates = hist_cols["event_date"]
+            h_valid = (h_dates >= t0) & (h_dates <= t_end)
+            type_counts = np.asarray(event_type_histogram(
+                hist_cols["event_type"], buckets(h_dates), h_valid,
+                window_ms=1, n_types=_N_EVENT_TYPES,
+                n_windows=W))[:, :n_windows]
+        if tokens is not None:
+            key_tokens = tokens
+        elif uniq.dtype == object:
+            key_tokens = [str(u) for u in uniq]
+        else:
+            key_tokens = [""] * len(uniq)
+        return WindowReport(t0_ms=t0, window_ms=window_ms,
+                            n_windows=int(n_windows),
+                            key_ids=np.asarray(uniq),
+                            key_tokens=key_tokens, stats=stats,
+                            type_counts=type_counts)
+
+
+class BusReplayAnalytics:
+    """The literal Kafka-replay flavor: re-consume an enriched topic from
+    offset zero into columns, then run the same windowed kernels.
+
+    Reference analogue: re-attaching a Spark job to the Hazelcast topic and
+    letting it rebuild windows from the retained stream.
+    """
+
+    def __init__(self, bus, naming=None):
+        from sitewhere_tpu.runtime.bus import TopicNaming
+        self.bus = bus
+        self.naming = naming or TopicNaming()
+
+    def replay_measurements(self, tenant: str, *, window_ms: int = 60_000,
+                            group_id: str = "analytics-replay",
+                            max_windows: int = 4096) -> WindowReport:
+        from sitewhere_tpu.pipeline.enrichment import unpack_enriched
+        topic = self.naming.inbound_enriched_events(tenant)
+        consumer = self.bus.consumer(topic, group_id)
+        consumer.seek_to_beginning()
+        token_idx: Dict[str, int] = {}
+        keys: List[int] = []
+        dates: List[int] = []
+        values: List[float] = []
+        while True:
+            batch = consumer.poll(8192)
+            if not batch:
+                break
+            for record in batch:
+                try:
+                    _, event = unpack_enriched(record.value)
+                except Exception:
+                    continue
+                if event.event_type != DeviceEventType.MEASUREMENT:
+                    continue
+                token = event.device_id or ""
+                idx = token_idx.setdefault(token, len(token_idx))
+                keys.append(idx)
+                dates.append(int(event.event_date))
+                values.append(float(event.value))
+        tokens = list(token_idx)
+        return WindowedAnalyticsEngine._build_report(
+            np.asarray(keys, np.int64), np.asarray(dates, np.int64),
+            np.asarray(values, np.float32), window_ms=window_ms,
+            start_ms=None, end_ms=None, max_windows=max_windows,
+            tokens=tokens)

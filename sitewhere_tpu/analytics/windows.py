@@ -1,0 +1,157 @@
+"""Windowed tensor reductions: the batch-analytics kernel layer.
+
+Reference: the reference's only analytics bridge is `sitewhere-spark`
+(SiteWhereReceiver.java:31) — it ships events to Spark Streaming and lets
+Spark do windowed aggregation off-platform. Here the analytics run ON the
+accelerator as one segment-reduction pass: events keyed by
+(key, time-bucket) fold into dense [K, W] stat grids (count/sum/mean/min/
+max) in a single XLA program — no external cluster.
+
+Design (TPU-first): a (key, window) pair maps to one segment id
+`key * n_windows + bucket`; out-of-range or invalid rows map to a dropped
+trailing segment. All five statistics come from three `segment_*` calls over
+static shapes, so one compiled program serves any replay size at a given
+(K, W) bucket shape. int64-safe: absolute ms timestamps are rebased to the
+window origin on the host before entering the kernel.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+
+@struct.dataclass
+class WindowedStats:
+    """Dense per-(key, window) statistics, all shape [K, W].
+
+    `mean`/`min`/`max` are NaN where count == 0 (query layers mask on count).
+    """
+
+    count: jnp.ndarray  # int32
+    sum: jnp.ndarray    # float32
+    mean: jnp.ndarray   # float32
+    min: jnp.ndarray    # float32
+    max: jnp.ndarray    # float32
+
+    @property
+    def num_keys(self) -> int:
+        return self.count.shape[0]
+
+    @property
+    def num_windows(self) -> int:
+        return self.count.shape[1]
+
+
+def _windowed_stats_impl(keys: jnp.ndarray, ts_rel: jnp.ndarray,
+                         value: jnp.ndarray, valid: jnp.ndarray,
+                         window_ms: jnp.ndarray,
+                         num_keys: int, n_windows: int) -> WindowedStats:
+    bucket = (ts_rel // window_ms).astype(jnp.int32)
+    in_range = valid & (bucket >= 0) & (bucket < n_windows) & \
+        (keys >= 0) & (keys < num_keys)
+    S = num_keys * n_windows
+    seg = jnp.where(in_range, keys * n_windows + bucket, S)
+
+    ones = in_range.astype(jnp.int32)
+    count = jax.ops.segment_sum(ones, seg, num_segments=S + 1)
+    vsum = jax.ops.segment_sum(jnp.where(in_range, value, 0.0), seg,
+                               num_segments=S + 1)
+    vmin = jax.ops.segment_min(jnp.where(in_range, value, jnp.inf), seg,
+                               num_segments=S + 1)
+    vmax = jax.ops.segment_max(jnp.where(in_range, value, -jnp.inf), seg,
+                               num_segments=S + 1)
+    count = count[:S].reshape(num_keys, n_windows)
+    vsum = vsum[:S].reshape(num_keys, n_windows)
+    vmin = vmin[:S].reshape(num_keys, n_windows)
+    vmax = vmax[:S].reshape(num_keys, n_windows)
+    empty = count == 0
+    nan = jnp.float32(jnp.nan)
+    return WindowedStats(
+        count=count.astype(jnp.int32),
+        sum=vsum.astype(jnp.float32),
+        mean=jnp.where(empty, nan, vsum / jnp.maximum(count, 1)).astype(
+            jnp.float32),
+        min=jnp.where(empty, nan, vmin).astype(jnp.float32),
+        max=jnp.where(empty, nan, vmax).astype(jnp.float32))
+
+
+@lru_cache(maxsize=64)
+def _compiled_stats(num_keys: int, n_windows: int):
+    return jax.jit(lambda k, t, v, m, w: _windowed_stats_impl(
+        k, t, v, m, w, num_keys, n_windows))
+
+
+def windowed_stats(keys, ts_rel, value, valid, *, window_ms: int,
+                   num_keys: int, n_windows: int) -> WindowedStats:
+    """count/sum/mean/min/max of `value` per (key, time-bucket).
+
+    Args:
+      keys:    int32 [B] dense key indices in [0, num_keys)
+      ts_rel:  int  [B] ms relative to the window origin (host-rebased)
+      value:   f32  [B]
+      valid:   bool [B]
+      window_ms: bucket width (dynamic — does not trigger recompiles)
+      num_keys / n_windows: static grid shape (compiled per shape, cached)
+    """
+    fn = _compiled_stats(int(num_keys), int(n_windows))
+    return fn(jnp.asarray(keys, jnp.int32), jnp.asarray(ts_rel, jnp.int32),
+              jnp.asarray(value, jnp.float32), jnp.asarray(valid, bool),
+              jnp.asarray(window_ms, jnp.int32))
+
+
+def _type_histogram_impl(event_type: jnp.ndarray, ts_rel: jnp.ndarray,
+                         valid: jnp.ndarray, window_ms: jnp.ndarray,
+                         n_types: int, n_windows: int) -> jnp.ndarray:
+    bucket = (ts_rel // window_ms).astype(jnp.int32)
+    in_range = valid & (bucket >= 0) & (bucket < n_windows) & \
+        (event_type >= 0) & (event_type < n_types)
+    S = n_types * n_windows
+    seg = jnp.where(in_range, event_type * n_windows + bucket, S)
+    counts = jax.ops.segment_sum(in_range.astype(jnp.int32), seg,
+                                 num_segments=S + 1)
+    return counts[:S].reshape(n_types, n_windows)
+
+
+@lru_cache(maxsize=32)
+def _compiled_histogram(n_types: int, n_windows: int):
+    return jax.jit(lambda e, t, m, w: _type_histogram_impl(
+        e, t, m, w, n_types, n_windows))
+
+
+def event_type_histogram(event_type, ts_rel, valid, *, window_ms: int,
+                         n_types: int, n_windows: int) -> jnp.ndarray:
+    """Event counts per (event-type, time-bucket) -> int32 [n_types, W]."""
+    fn = _compiled_histogram(int(n_types), int(n_windows))
+    return fn(jnp.asarray(event_type, jnp.int32),
+              jnp.asarray(ts_rel, jnp.int32), jnp.asarray(valid, bool),
+              jnp.asarray(window_ms, jnp.int32))
+
+
+def compact_keys(raw: np.ndarray,
+                 valid: Optional[np.ndarray] = None
+                 ) -> Tuple[np.ndarray, np.ndarray]:
+    """Host-side key compaction: sparse ids -> dense [0, U) indices.
+
+    Device ids span the full registry capacity; a replay usually touches a
+    small subset. Compaction keeps the [K, W] stat grid proportional to the
+    keys actually present. Returns (dense_keys, unique_raw_ids); rows not in
+    `valid` get key -1 (dropped by the kernel's range check).
+    """
+    raw = np.asarray(raw)
+    if valid is None:
+        valid = np.ones(len(raw), bool)
+    uniq = np.unique(raw[valid])
+    dense = np.searchsorted(uniq, raw).astype(np.int32)
+    # searchsorted gives arbitrary in-range slots for absent values; mask them
+    if len(uniq):
+        dense = np.where(valid & (uniq[np.clip(dense, 0, len(uniq) - 1)] == raw),
+                         dense, -1).astype(np.int32)
+    else:
+        dense = np.full(len(raw), -1, np.int32)
+    return dense, uniq

@@ -136,20 +136,23 @@ class DeviceStreamManager(LifecycleComponent):
 
     def reassemble(self, assignment_token: str, stream_id: str,
                    page_size: int = 10_000) -> bytes:
-        """Concatenate all chunks in sequence order, paging through the log
-        (no silent cap). Redelivered duplicates: last write wins — chunks
-        arrive sequence-ascending within a page and later pages are later
-        appends, so a plain dict overwrite keeps the newest bytes."""
+        """Concatenate all chunks in sequence order (no silent cap).
+
+        Fetched as ONE page sized to the reported total, growing until a
+        fetch returns everything it reported — fixed page boundaries over a
+        live log would shift when a device appends mid-scan and silently
+        skip a chunk. Redelivered duplicates: last write wins — equal
+        sequence numbers keep append order under the stable sort, so a
+        plain dict overwrite keeps the newest bytes."""
         self.require_device_stream(assignment_token, stream_id)
-        by_seq: Dict[int, bytes] = {}
-        page_number = 1
+        want = max(page_size, 1)
         while True:
             results = self.events.list_stream_data(
                 assignment_token, stream_id,
-                SearchCriteria(page_number=page_number, page_size=page_size))
-            for chunk in results.results:
-                by_seq[chunk.sequence_number] = chunk.data
-            if page_number * page_size >= results.num_results:
+                SearchCriteria(page_number=1, page_size=want))
+            if results.num_results <= want:
                 break
-            page_number += 1
+            want = results.num_results
+        by_seq: Dict[int, bytes] = {
+            chunk.sequence_number: chunk.data for chunk in results.results}
         return b"".join(by_seq[seq] for seq in sorted(by_seq))

@@ -797,10 +797,9 @@ def register_all(router: Router, instance, server) -> None:
                                        request.criteria()))
 
     def add_stream_data(request: Request):
-        """Chunk upload: raw body bytes, sequence number in the path."""
-        data = request.body
-        if isinstance(data, str):
-            data = data.encode("utf-8")
+        """Chunk upload: raw body bytes exactly as sent, sequence number in
+        the path (JSON decoding must never touch chunk content)."""
+        data = request.raw_body
         if not isinstance(data, bytes):
             raise SiteWhereError("binary body required", http_status=400)
         chunk = _engine(request).streams.add_stream_data(
@@ -811,16 +810,23 @@ def register_all(router: Router, instance, server) -> None:
                      "size": len(data)}
 
     def get_stream_data(request: Request):
-        chunk = _engine(request).streams.get_stream_data(
+        streams = _engine(request).streams
+        stream = streams.require_device_stream(request.params["token"],
+                                               request.params["stream_id"])
+        chunk = streams.get_stream_data(
             request.params["token"], request.params["stream_id"],
             int(request.params["sequence"]))
         if chunk is None:
             raise NotFoundError("unknown chunk", ErrorCode.INVALID_STREAM_ID)
-        return chunk.data  # raw bytes response
+        return 200, chunk.data, stream.content_type
 
     def get_stream_content(request: Request):
-        return _engine(request).streams.reassemble(
-            request.params["token"], request.params["stream_id"])
+        streams = _engine(request).streams
+        stream = streams.require_device_stream(request.params["token"],
+                                               request.params["stream_id"])
+        return 200, streams.reassemble(
+            request.params["token"], request.params["stream_id"]), \
+            stream.content_type
 
     router.post("/api/assignments/{token}/streams", create_device_stream,
                 authority=REST)

@@ -26,6 +26,7 @@ class Request:
     params: Dict[str, str] = field(default_factory=dict)
     query: Dict[str, List[str]] = field(default_factory=dict)
     body: Any = None
+    raw_body: Optional[bytes] = None       # undecoded bytes (binary uploads)
     headers: Dict[str, str] = field(default_factory=dict)
     claims: Optional[Dict] = None          # JWT claims once authenticated
     tenant: Optional[str] = None           # resolved tenant token

@@ -113,14 +113,15 @@ class RestServer(LifecycleComponent):
         try:
             parsed = urlparse(handler.path)
             body: Any = None
+            raw_body: Optional[bytes] = None
             length = int(handler.headers.get("Content-Length") or 0)
             if length:
-                raw = handler.rfile.read(length)
+                raw_body = handler.rfile.read(length)
                 ctype = handler.headers.get("Content-Type", "")
                 if "json" in ctype or not ctype:
-                    body = json.loads(raw) if raw.strip() else None
+                    body = json.loads(raw_body) if raw_body.strip() else None
                 else:
-                    body = raw
+                    body = raw_body
 
             # token minting endpoint (basic auth, no bearer required)
             if parsed.path.rstrip("/") == "/authapi/jwt":
@@ -134,16 +135,20 @@ class RestServer(LifecycleComponent):
             request = Request(
                 method=handler.command, path=parsed.path,
                 query=self.router.parse_query(parsed.query), body=body,
+                raw_body=raw_body,
                 headers={k: v for k, v in handler.headers.items()},
                 claims=self._claims_for(handler),
                 tenant=handler.headers.get(
                     "X-SiteWhere-Tenant",
                     handler.headers.get("X-SiteWhere-Tenant-Id")))
             result = self.router.dispatch(request)
-            status = 200
+            status, ctype = 200, None
             if isinstance(result, tuple):
-                status, result = result
-            self._respond(handler, status, result)
+                if len(result) == 3:
+                    status, result, ctype = result
+                else:
+                    status, result = result
+            self._respond(handler, status, result, ctype)
         except SiteWhereError as err:
             self._respond(handler, err.http_status,
                           {"message": str(err), "errorCode": int(err.code)})
@@ -154,10 +159,10 @@ class RestServer(LifecycleComponent):
             self._respond(handler, 500, {"message": str(err)})
 
     def _respond(self, handler: BaseHTTPRequestHandler, status: int,
-                 payload: Any) -> None:
+                 payload: Any, ctype: Optional[str] = None) -> None:
         if isinstance(payload, bytes):
             data = payload
-            ctype = "application/octet-stream"
+            ctype = ctype or "application/octet-stream"
         else:
             data = json.dumps(to_jsonable(payload)).encode("utf-8")
             ctype = "application/json"

@@ -1,0 +1,219 @@
+"""Analytics tests: windowed kernels vs numpy references, log replay,
+bus replay, and the streaming micro-batch receiver (sitewhere-spark
+replacement; BASELINE.md config 4)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from sitewhere_tpu.analytics import (
+    BusReplayAnalytics, EventStreamReceiver, WindowedAnalyticsEngine,
+    compact_keys, event_type_histogram, windowed_stats)
+from sitewhere_tpu.model import Area, Device, DeviceAssignment, DeviceType
+from sitewhere_tpu.model.event import (
+    DeviceEventContext, DeviceEventType, DeviceLocation, DeviceMeasurement)
+from sitewhere_tpu.persist import ColumnarEventLog, DeviceEventManagement
+from sitewhere_tpu.pipeline.enrichment import pack_enriched
+from sitewhere_tpu.registry import DeviceManagement
+from sitewhere_tpu.runtime.bus import EventBus, TopicNaming
+
+
+def _np_grid(keys, ts, value, valid, window_ms, K, W, stat):
+    out = np.full((K, W), np.nan, np.float64)
+    counts = np.zeros((K, W), np.int64)
+    for k, t, v, ok in zip(keys, ts, value, valid):
+        w = t // window_ms
+        if not ok or not (0 <= k < K) or not (0 <= w < W):
+            continue
+        counts[k, w] += 1
+        if stat == "sum":
+            out[k, w] = (0 if np.isnan(out[k, w]) else out[k, w]) + v
+        elif stat == "min":
+            out[k, w] = v if np.isnan(out[k, w]) else min(out[k, w], v)
+        elif stat == "max":
+            out[k, w] = v if np.isnan(out[k, w]) else max(out[k, w], v)
+    return counts, out
+
+
+class TestWindowKernels:
+    def test_stats_match_numpy(self, rng):
+        B, K, W, window = 500, 8, 16, 100
+        keys = rng.integers(-1, K + 1, B).astype(np.int32)
+        ts = rng.integers(-50, W * window + 200, B).astype(np.int32)
+        value = rng.normal(size=B).astype(np.float32)
+        valid = rng.random(B) > 0.1
+        stats = windowed_stats(keys, ts, value, valid, window_ms=window,
+                               num_keys=K, n_windows=W)
+        counts, sums = _np_grid(keys, ts, value, valid, window, K, W, "sum")
+        _, mins = _np_grid(keys, ts, value, valid, window, K, W, "min")
+        _, maxs = _np_grid(keys, ts, value, valid, window, K, W, "max")
+        np.testing.assert_array_equal(np.asarray(stats.count), counts)
+        np.testing.assert_allclose(np.asarray(stats.sum),
+                                   np.nan_to_num(sums), atol=1e-4)
+        np.testing.assert_allclose(np.asarray(stats.min), mins, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(stats.max), maxs, atol=1e-5)
+        with np.errstate(invalid="ignore"):
+            np.testing.assert_allclose(
+                np.asarray(stats.mean), sums / np.maximum(counts, 1),
+                atol=1e-4)
+
+    def test_type_histogram(self):
+        et = np.array([0, 0, 1, 2, 1, 9], np.int32)
+        ts = np.array([0, 150, 10, 10, 250, 10], np.int32)
+        valid = np.array([1, 1, 1, 1, 1, 1], bool)
+        hist = np.asarray(event_type_histogram(
+            et, ts, valid, window_ms=100, n_types=4, n_windows=3))
+        assert hist[0, 0] == 1 and hist[0, 1] == 1
+        assert hist[1, 0] == 1 and hist[1, 2] == 1
+        assert hist[2, 0] == 1
+        assert hist.sum() == 5  # type 9 out of range -> dropped
+
+    def test_compact_keys(self):
+        raw = np.array([100, 5, 100, 7, 5], np.int64)
+        valid = np.array([1, 1, 1, 0, 1], bool)
+        dense, uniq = compact_keys(raw, valid)
+        np.testing.assert_array_equal(uniq, [5, 100])
+        assert dense[0] == dense[2] == 1
+        assert dense[1] == dense[4] == 0
+        assert dense[3] == -1  # invalid row dropped
+
+
+@pytest.fixture
+def world():
+    dm = DeviceManagement()
+    dtype = dm.create_device_type(DeviceType(token="sensor"))
+    area = dm.create_area(Area(token="area-1"))
+    for i in range(3):
+        device = dm.create_device(Device(token=f"dev-{i}",
+                                         device_type_id=dtype.id))
+        dm.create_device_assignment(DeviceAssignment(
+            token=f"as-{i}", device_id=device.id, area_id=area.id))
+    return dm
+
+
+class TestLogReplay:
+    def test_measurement_windows(self, world):
+        log = ColumnarEventLog(segment_rows=16)
+        mgmt = DeviceEventManagement(log, registry=world)
+        base = 1_000_000
+        for i in range(30):
+            mgmt.add_measurements(f"as-{i % 3}", DeviceMeasurement(
+                name="temp", value=float(i), event_date=base + i * 1000))
+        mgmt.add_locations("as-0", DeviceLocation(
+            latitude=1.0, longitude=2.0, event_date=base + 500))
+        engine = WindowedAnalyticsEngine(log)
+        report = engine.measurement_windows(
+            "default", window_ms=10_000, start_ms=base,
+            end_ms=base + 29_999, with_type_histogram=True)
+        assert report.num_keys == 3
+        assert report.n_windows == 3
+        total = report.totals()
+        assert total["events"] == 30
+        assert total["mean"] == pytest.approx(np.mean(np.arange(30)))
+        # each window holds 10 events split across 3 devices
+        counts = np.asarray(report.stats.count)[:3, :3]
+        assert counts.sum() == 30
+        # histogram covers measurements + the location event
+        assert report.type_counts is not None
+        assert report.type_counts[int(DeviceEventType.MEASUREMENT)].sum() == 30
+        assert report.type_counts[int(DeviceEventType.LOCATION)].sum() == 1
+        # mm_name filter
+        empty = engine.measurement_windows("default", window_ms=10_000,
+                                           mm_name="other")
+        assert empty.totals()["events"] == 0
+
+    def test_empty_tenant(self):
+        engine = WindowedAnalyticsEngine(ColumnarEventLog())
+        report = engine.measurement_windows("nobody")
+        assert report.num_keys == 0 and report.totals()["events"] == 0
+
+    def test_long_span_replay(self, world):
+        """Replays spanning > 2^31 ms (~24.8 days) must bucket correctly
+        (int64-safe host bucketing, not int32 clipping)."""
+        log = ColumnarEventLog(segment_rows=64)
+        mgmt = DeviceEventManagement(log, registry=world)
+        day = 86_400_000
+        for i in range(30):
+            mgmt.add_measurements("as-0", DeviceMeasurement(
+                name="t", value=float(i), event_date=i * day))
+        report = WindowedAnalyticsEngine(log).measurement_windows(
+            "default", window_ms=day, start_ms=0, end_ms=30 * day - 1)
+        assert report.n_windows == 30
+        counts = np.asarray(report.stats.count)[0, :30]
+        np.testing.assert_array_equal(counts, np.ones(30))
+
+    def test_histogram_without_measurements(self, world):
+        """A tenant with zero matching measurements still gets the
+        event-type histogram."""
+        log = ColumnarEventLog(segment_rows=64)
+        mgmt = DeviceEventManagement(log, registry=world)
+        for i in range(5):
+            mgmt.add_locations("as-0", DeviceLocation(
+                latitude=1.0, longitude=2.0, event_date=1000 + i))
+        report = WindowedAnalyticsEngine(log).measurement_windows(
+            "default", window_ms=1000, with_type_histogram=True)
+        assert report.totals()["events"] == 0
+        assert report.type_counts is not None
+        assert report.type_counts[int(DeviceEventType.LOCATION)].sum() == 5
+
+
+def _ctx(token="dev-0"):
+    return DeviceEventContext(device_token=token, device_id=token,
+                              device_type_id="sensor", assignment_id="as-0")
+
+
+class TestBusReplay:
+    def test_replay_measurements(self):
+        bus = EventBus(partitions=2)
+        naming = TopicNaming()
+        topic = naming.inbound_enriched_events("default")
+        base = 5_000_000
+        for i in range(20):
+            token = f"dev-{i % 2}"
+            event = DeviceMeasurement(name="m", value=float(i),
+                                      device_id=token,
+                                      event_date=base + i * 500)
+            bus.publish(topic, token.encode(),
+                        pack_enriched(_ctx(token), event))
+        report = BusReplayAnalytics(bus, naming).replay_measurements(
+            "default", window_ms=5_000)
+        assert report.num_keys == 2
+        assert report.totals()["events"] == 20
+        assert set(report.key_tokens) == {"dev-0", "dev-1"}
+        # replay is idempotent: a second pass sees the same stream
+        again = BusReplayAnalytics(bus, naming).replay_measurements(
+            "default", window_ms=5_000, group_id="second")
+        assert again.totals() == report.totals()
+
+
+class TestStreamReceiver:
+    def test_micro_batches(self):
+        bus = EventBus(partitions=2)
+        naming = TopicNaming()
+        topic = naming.inbound_enriched_events("default")
+        got, lock = [], threading.Lock()
+
+        def handler(batch):
+            with lock:
+                got.extend(batch)
+
+        receiver = EventStreamReceiver(bus, "default", handler, naming)
+        receiver.initialize()
+        receiver.start()
+        for i in range(10):
+            event = DeviceMeasurement(name="m", value=float(i),
+                                      device_id="dev-0", event_date=i)
+            bus.publish(topic, b"dev-0", pack_enriched(_ctx(), event))
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            with lock:
+                if len(got) == 10:
+                    break
+            time.sleep(0.02)
+        receiver.stop()
+        assert len(got) == 10
+        ctx, event = got[0]
+        assert ctx.device_token == "dev-0"
+        assert event.event_type == DeviceEventType.MEASUREMENT
