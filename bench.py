@@ -29,11 +29,14 @@ def main() -> None:
 
     # BENCH_SCALE=small gives a CPU-feasible smoke configuration.
     small = os.environ.get("BENCH_SCALE") == "small"
-    BATCH = 2048 if small else 32768
+    BATCH = 2048 if small else 131072
     MAX_DEVICES = 8192 if small else 131072
     N_REGISTERED = 2000 if small else 100_000  # BASELINE config 3: 100k devices
-    STEPS = 10 if small else 50
-    WARMUP = 2 if small else 5
+    STEPS = 10 if small else 60
+    # Long warmup: host->device staging rides a burst buffer on tunneled
+    # runtimes; sustained throughput is what the steady state delivers, so
+    # warm past the burst before measuring.
+    WARMUP = 2 if small else 30
 
     _, tensors = _example_world(max_devices=MAX_DEVICES,
                                 n_registered=N_REGISTERED,
@@ -69,6 +72,24 @@ def main() -> None:
 
     events_per_sec = STEPS * BATCH / total
     lat = np.array(sorted(latencies))
+
+    # aux: compute-only step rate (device-resident staging blob), i.e. the
+    # rate once ingest DMA is overlapped/not the bottleneck
+    from sitewhere_tpu.ops.pack import batch_to_blob
+    params = engine._ensure_params()
+    dblob = jax.device_put(batch_to_blob(pool[0]))
+    state = engine._state
+    state, cout = engine._step_blob(params, state, dblob)
+    jax.block_until_ready(cout.processed)
+    c0 = time.perf_counter()
+    for _ in range(STEPS):
+        state, cout = engine._step_blob(params, state, dblob)
+    jax.block_until_ready(cout.processed)
+    compute_only = STEPS * BATCH / (time.perf_counter() - c0)
+    # the step donates its state argument: hand the final buffers back to the
+    # engine so it is not left referencing deleted arrays
+    engine._state = state
+
     result = {
         "metric": "events/sec ingest->rule->device-state (fused step, "
                   f"{N_REGISTERED} devices, batch {BATCH})",
@@ -77,6 +98,7 @@ def main() -> None:
         "vs_baseline": round(events_per_sec / 1_000_000, 4),
         "p50_step_ms": round(float(lat[len(lat) // 2]) * 1000, 3),
         "p99_step_ms": round(float(lat[int(len(lat) * 0.99)]) * 1000, 3),
+        "compute_only_events_per_sec": round(compute_only, 1),
         "device": str(jax.devices()[0]),
     }
     print(json.dumps(result))

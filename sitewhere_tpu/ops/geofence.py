@@ -102,8 +102,38 @@ def points_in_zones(lat: jnp.ndarray, lon: jnp.ndarray,
     return parity
 
 
+def resolve_geofence_impl(impl: str, platform: str) -> str:
+    """Resolve an `auto` containment implementation choice for a platform.
+
+    `pallas` (the hand-written VPU kernel in ops/pallas_geofence.py) on real
+    TPUs; the XLA scan everywhere else (CPU shard meshes, interpret-less
+    debugging). Explicit choices pass through.
+    """
+    if impl == "auto":
+        return "pallas" if platform == "tpu" else "xla"
+    if impl not in ("xla", "pallas", "pallas_interpret"):
+        raise ValueError(
+            f"geofence impl {impl!r}: expected one of "
+            f"'auto', 'xla', 'pallas', 'pallas_interpret'")
+    return impl
+
+
+def _containment(lat: jnp.ndarray, lon: jnp.ndarray, vertices: jnp.ndarray,
+                 impl: str) -> jnp.ndarray:
+    # Below one lane-width of zones the pallas kernel pads Z to 128 and wastes
+    # most of the VPU; the XLA scan measures faster there (v5e), so "pallas"
+    # only engages at Z >= 128 (explicit "pallas_interpret" always runs the
+    # kernel — that mode exists for CPU correctness tests).
+    if impl == "xla" or (impl == "pallas" and vertices.shape[0] < 128):
+        return points_in_zones(lat, lon, vertices)
+    from sitewhere_tpu.ops.pallas_geofence import points_in_zones_pallas
+    return points_in_zones_pallas(lat, lon, vertices,
+                                  interpret=(impl == "pallas_interpret"))
+
+
 def eval_geofence_rules(batch: EventBatch, zones: ZoneTable,
-                        rules: GeofenceRuleTable) -> Dict[str, jnp.ndarray]:
+                        rules: GeofenceRuleTable,
+                        impl: str = "xla") -> Dict[str, jnp.ndarray]:
     """Evaluate geofence rules against the location events of a batch.
 
     Returns per-event outputs (shape [B]):
@@ -117,7 +147,7 @@ def eval_geofence_rules(batch: EventBatch, zones: ZoneTable,
     is_location = batch.event_type == DeviceEventType.LOCATION
     event_ok = batch.valid & is_location                        # [B]
 
-    inside = points_in_zones(batch.lat, batch.lon, zones.vertices)  # [B,Z]
+    inside = _containment(batch.lat, batch.lon, zones.vertices, impl)  # [B,Z]
     zone_ok = (zones.active[None, :]
                & ((zones.tenant_idx[None, :] == 0)
                   | (zones.tenant_idx[None, :] == batch.tenant_idx[:, None])))

@@ -66,6 +66,68 @@ def empty_batch(batch_size: int) -> EventBatch:
         valid=np.zeros(batch_size, bool))
 
 
+# Wire-blob layout: the host->device staging format is ONE contiguous int32
+# array of WIRE_ROWS rows per batch ([7, B]; [S, 7, B] routed). Host->device
+# bandwidth is the pipeline's hard ceiling (HBM/PCIe/tunnel — SURVEY.md north
+# star analysis), so the wire format is minimized: 28 B/event instead of the
+# 48 B of one row per EventBatch column. Small enums ride a single bit-packed
+# meta row; tenant_idx never crosses (validation re-derives it from the
+# registry mirror on device, pipeline/step.py stage 1).
+#   row 0 device_idx  row 1 ts  row 2 value(f32)  row 3 lat(f32)
+#   row 4 lon(f32)    row 5 elevation(f32)
+#   row 6 meta: bits 0-2 event_type | 3-5 alert_level | 6 valid |
+#               7-18 mm_idx | 19-30 alert_type_idx
+WIRE_ROWS = 7
+_META_MAX_IDX = 1 << 12  # mm_idx / alert_type_idx field width
+
+
+def batch_to_blob(batch: EventBatch) -> np.ndarray:
+    """Pack an EventBatch into the compact wire blob (host side, numpy).
+
+    A single transfer instead of 12 (remote/tunneled runtimes pay a
+    round-trip per device_put), at 28 B/event instead of 48.
+    """
+    lead = batch.device_idx.shape[:-1]   # () flat, (S,) routed
+    B = batch.device_idx.shape[-1]
+    blob = np.empty(lead + (WIRE_ROWS, B), np.int32)
+    blob[..., 0, :] = batch.device_idx
+    blob[..., 1, :] = batch.ts
+    blob[..., 2, :] = np.asarray(batch.value, np.float32).view(np.int32)
+    blob[..., 3, :] = np.asarray(batch.lat, np.float32).view(np.int32)
+    blob[..., 4, :] = np.asarray(batch.lon, np.float32).view(np.int32)
+    blob[..., 5, :] = np.asarray(batch.elevation, np.float32).view(np.int32)
+    meta = (np.asarray(batch.event_type, np.int32) & 7)
+    meta |= (np.asarray(batch.alert_level, np.int32) & 7) << 3
+    meta |= np.asarray(batch.valid).astype(np.int32) << 6
+    meta |= (np.asarray(batch.mm_idx, np.int32) & (_META_MAX_IDX - 1)) << 7
+    meta |= (np.asarray(batch.alert_type_idx, np.int32)
+             & (_META_MAX_IDX - 1)) << 19
+    blob[..., 6, :] = meta
+    return blob
+
+
+def blob_to_batch(blob) -> EventBatch:
+    """Inverse of batch_to_blob on-device (jax ops; call under jit — XLA
+    fuses the unpack into the step's first consumers)."""
+    import jax
+    import jax.numpy as jnp
+
+    def f(i):
+        return jax.lax.bitcast_convert_type(blob[..., i, :], jnp.float32)
+
+    meta = blob[..., 6, :]
+    return EventBatch(
+        device_idx=blob[..., 0, :],
+        tenant_idx=jnp.zeros_like(blob[..., 0, :]),
+        event_type=meta & 7,
+        ts=blob[..., 1, :],
+        mm_idx=(meta >> 7) & (_META_MAX_IDX - 1),
+        value=f(2), lat=f(3), lon=f(4), elevation=f(5),
+        alert_type_idx=(meta >> 19) & (_META_MAX_IDX - 1),
+        alert_level=(meta >> 3) & 7,
+        valid=(meta & (1 << 6)) != 0)
+
+
 class EventPacker:
     """Host-side packer: Python event objects / raw column arrays -> EventBatch.
 
@@ -77,6 +139,11 @@ class EventPacker:
     def __init__(self, batch_size: int, device_interner: TokenInterner,
                  max_measurement_names: int = 1024, max_alert_types: int = 1024,
                  epoch_base_ms: Optional[int] = None):
+        if max_measurement_names > _META_MAX_IDX or \
+                max_alert_types > _META_MAX_IDX:
+            raise ValueError(
+                f"measurement/alert-type interner capacity is limited to "
+                f"{_META_MAX_IDX} by the wire-blob meta field width")
         self.batch_size = batch_size
         self.devices = device_interner
         self.measurements = TokenInterner(max_measurement_names, "measurements")

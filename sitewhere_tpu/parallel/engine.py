@@ -31,7 +31,7 @@ except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map as _shard_map
 
 from sitewhere_tpu.model import DeviceAlert
-from sitewhere_tpu.ops.pack import EventBatch
+from sitewhere_tpu.ops.pack import EventBatch, batch_to_blob, blob_to_batch
 from sitewhere_tpu.parallel.mesh import SHARD_AXIS, make_mesh, shard_axis_size
 from sitewhere_tpu.parallel.router import RoutedBatches, ShardRouter
 from sitewhere_tpu.pipeline.engine import PipelineEngine
@@ -66,13 +66,15 @@ class ShardedPipelineEngine(PipelineEngine):
         from sitewhere_tpu.ops.pack import EventPacker
         self.packer = EventPacker(per_shard_batch * self.n_shards,
                                   registry_tensors.devices)
-        self._step = None  # built lazily once specs are known
-        self._sharded_step = None
+        self._sharded_step = None  # built lazily once specs are known
         # shard-overflow events requeued ahead of the next submit; bounded so
         # a pathological hot shard cannot grow the host queue without limit
         self._overflow: Optional[EventBatch] = None
         self.max_overflow_events = per_shard_batch * self.n_shards * 4
         self.total_dropped = 0  # overflow beyond the bound (permanent loss)
+
+    def _target_platform(self) -> str:
+        return self.mesh.devices.flat[0].platform
 
     # -- initialization -------------------------------------------------------
 
@@ -101,7 +103,7 @@ class ShardedPipelineEngine(PipelineEngine):
             zones=_tree_specs(params_template.zones, rep),
             geofence=_tree_specs(params_template.geofence, rep))
         state_specs = _tree_specs(self._state, dev)
-        batch_specs = _tree_specs(EventBatch(*([0] * 12)), dev)
+        blob_specs = dev  # [S, WIRE_ROWS, B] single staging blob, sharded on S
         out_specs = ProcessOutputs(
             valid=dev, unregistered=dev, threshold_fired=dev,
             threshold_first_rule=dev, threshold_alert_level=dev,
@@ -117,15 +119,16 @@ class ShardedPipelineEngine(PipelineEngine):
         def unsq(a):
             return a[None]
 
-        def sharded(params, state, batch):
+        def sharded(params, state, blob):
             params = params.replace(
                 assignment_status=sq(params.assignment_status),
                 tenant_idx=sq(params.tenant_idx),
                 area_idx=sq(params.area_idx),
                 device_type_idx=sq(params.device_type_idx))
             state = jax.tree_util.tree_map(sq, state)
-            batch = jax.tree_util.tree_map(sq, batch)
-            new_state, out = process_batch(params, state, batch)
+            batch = blob_to_batch(sq(blob))          # [12, B] -> columns
+            new_state, out = process_batch(
+                params, state, batch, geofence_impl=self.geofence_impl)
             new_state = jax.tree_util.tree_map(unsq, new_state)
             out = out.replace(
                 valid=unsq(out.valid), unregistered=unsq(out.unregistered),
@@ -141,7 +144,7 @@ class ShardedPipelineEngine(PipelineEngine):
             return new_state, out
 
         mapped = _shard_map(sharded, mesh=self.mesh,
-                            in_specs=(params_specs, state_specs, batch_specs),
+                            in_specs=(params_specs, state_specs, blob_specs),
                             out_specs=(state_specs, out_specs))
         self._sharded_step = jax.jit(mapped, donate_argnums=(1,))
 
@@ -198,11 +201,10 @@ class ShardedPipelineEngine(PipelineEngine):
             else:
                 self._overflow = routed.overflow
         shard0 = NamedSharding(self.mesh, P(SHARD_AXIS))
-        device_batch = jax.device_put(routed.batch,
-                                      _tree_specs(routed.batch, shard0))
+        blob = jax.device_put(batch_to_blob(routed.batch), shard0)
         with self._metrics.timer("step").time():
             self._state, outputs = self._sharded_step(params, self._state,
-                                                      device_batch)
+                                                      blob)
         self.batches_processed += 1
         self._metrics.meter("events").mark(int(np.asarray(batch.valid).sum()))
         return routed.batch, outputs

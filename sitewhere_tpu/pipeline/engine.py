@@ -25,7 +25,8 @@ import numpy as np
 from sitewhere_tpu.model import DeviceAlert, AlertLevel, AlertSource, DeviceState, PresenceState
 from sitewhere_tpu.model.event import DeviceEventType
 from sitewhere_tpu.ops.geofence import GeofenceCondition, GeofenceRuleTable, ZoneTable, empty_geofence_table
-from sitewhere_tpu.ops.pack import EventBatch, EventPacker
+from sitewhere_tpu.ops.pack import (
+    EventBatch, EventPacker, batch_to_blob, blob_to_batch)
 from sitewhere_tpu.ops.threshold import ThresholdOp, ThresholdRuleTable, empty_threshold_table
 from sitewhere_tpu.pipeline.state_tensors import DeviceStateTensors, init_device_state
 from sitewhere_tpu.pipeline.step import PipelineParams, ProcessOutputs, check_presence, process_batch
@@ -75,7 +76,7 @@ class PipelineEngine(LifecycleComponent):
                  measurement_slots: int = 32, max_tenants: int = 16,
                  max_threshold_rules: int = 256, max_geofence_rules: int = 256,
                  presence_missing_interval_ms: int = 8 * 60 * 60 * 1000,
-                 name: str = "pipeline-engine"):
+                 name: str = "pipeline-engine", geofence_impl: str = "auto"):
         super().__init__(name)
         self.registry = registry_tensors
         self.batch_size = batch_size
@@ -94,9 +95,21 @@ class PipelineEngine(LifecycleComponent):
         self._state: Optional[DeviceStateTensors] = None
         self._lock = threading.RLock()
         self._metrics = GLOBAL_METRICS.scoped(f"pipeline.{name}")
-        self._step = jax.jit(process_batch, donate_argnums=(1,))
+        from sitewhere_tpu.ops.geofence import resolve_geofence_impl
+        self.geofence_impl = resolve_geofence_impl(
+            geofence_impl, self._target_platform())
+        def step_blob(params, state, blob):
+            return process_batch(params, state, blob_to_batch(blob),
+                                 geofence_impl=self.geofence_impl)
+
+        self._step_blob = jax.jit(step_blob, donate_argnums=(1,))
         self._presence = jax.jit(check_presence, donate_argnums=(0,))
         self.batches_processed = 0
+
+    def _target_platform(self) -> str:
+        """Platform the step will compile for (sharded engines override from
+        their mesh devices)."""
+        return jax.default_backend()
 
     # -- lifecycle ------------------------------------------------------------
 
@@ -215,7 +228,9 @@ class PipelineEngine(LifecycleComponent):
             self.initialize()  # full lifecycle init so a later start() won't re-init
         params = self._ensure_params()
         with self._metrics.timer("step").time():
-            self._state, outputs = self._step(params, self._state, batch)
+            # single-transfer host->device staging (see ops.pack.batch_to_blob)
+            blob = batch_to_blob(batch)
+            self._state, outputs = self._step_blob(params, self._state, blob)
         self.batches_processed += 1
         self._metrics.meter("events").mark(int(np.asarray(batch.valid).sum()))
         return outputs

@@ -75,9 +75,14 @@ class ProcessOutputs:
 
 
 def process_batch(params: PipelineParams, state: DeviceStateTensors,
-                  batch: EventBatch
+                  batch: EventBatch, *, geofence_impl: str = "xla"
                   ) -> Tuple[DeviceStateTensors, ProcessOutputs]:
-    """One fused step. Shapes static; jit/shard_map safe; donate `state`."""
+    """One fused step. Shapes static; jit/shard_map safe; donate `state`.
+
+    `geofence_impl` selects the containment kernel ("xla" scan,
+    "pallas" TPU kernel, "pallas_interpret" for CPU tests) — resolved by the
+    engines via ops.geofence.resolve_geofence_impl.
+    """
     D = state.num_devices
     M = state.num_measurement_slots
     T = state.tenant_event_count.shape[0]
@@ -96,7 +101,8 @@ def process_batch(params: PipelineParams, state: DeviceStateTensors,
 
     # ---- stage 2: rule evaluation (replaces rule-processing service) -------
     thr = eval_threshold_rules(batch, params.threshold, device_type)
-    geo = eval_geofence_rules(batch, params.zones, params.geofence)
+    geo = eval_geofence_rules(batch, params.zones, params.geofence,
+                              impl=geofence_impl)
 
     # ---- stage 3: device-state fold (replaces device-state service) --------
     dev = batch.device_idx

@@ -346,3 +346,56 @@ class TestPacker:
         assert batch.valid.tolist() == [True, True] + [False] * 6
         assert batch.value[1] == 2.5
         assert batch.ts[1] == 20
+
+
+class TestWireBlob:
+    """Compact staging blob round-trip (ops/pack.py batch_to_blob)."""
+
+    def test_roundtrip_all_columns(self):
+        import numpy as np
+        from sitewhere_tpu.ops.pack import (
+            WIRE_ROWS, batch_to_blob, blob_to_batch, empty_batch)
+
+        rng = np.random.default_rng(3)
+        B = 257
+        b = empty_batch(B)
+        b = b.replace(
+            device_idx=rng.integers(0, 2 ** 20, B).astype(np.int32),
+            event_type=rng.integers(0, 6, B).astype(np.int32),
+            ts=rng.integers(-2 ** 30, 2 ** 30, B).astype(np.int32),
+            mm_idx=rng.integers(0, 4096, B).astype(np.int32),
+            value=rng.normal(size=B).astype(np.float32),
+            lat=rng.uniform(-90, 90, B).astype(np.float32),
+            lon=rng.uniform(-180, 180, B).astype(np.float32),
+            elevation=rng.normal(size=B).astype(np.float32),
+            alert_type_idx=rng.integers(0, 4096, B).astype(np.int32),
+            alert_level=rng.integers(0, 6, B).astype(np.int32),
+            valid=rng.integers(0, 2, B).astype(bool))
+        blob = batch_to_blob(b)
+        assert blob.shape == (WIRE_ROWS, B) and blob.dtype == np.int32
+        out = blob_to_batch(blob)
+        for field_name in ("device_idx", "event_type", "ts", "mm_idx",
+                           "alert_type_idx", "alert_level"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(out, field_name)),
+                getattr(b, field_name), err_msg=field_name)
+        for field_name in ("value", "lat", "lon", "elevation"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(out, field_name)),
+                getattr(b, field_name), err_msg=field_name)
+        np.testing.assert_array_equal(np.asarray(out.valid), b.valid)
+        # tenant_idx intentionally does not cross the wire
+        assert np.asarray(out.tenant_idx).sum() == 0
+
+    def test_routed_leading_axis(self):
+        import numpy as np
+        from sitewhere_tpu.ops.pack import (
+            WIRE_ROWS, batch_to_blob, blob_to_batch, empty_batch)
+        import jax.tree_util as jtu
+
+        b = empty_batch(8)
+        routed = jtu.tree_map(lambda a: np.stack([a, a]), b)
+        blob = batch_to_blob(routed)
+        assert blob.shape == (2, WIRE_ROWS, 8)
+        out = blob_to_batch(blob)
+        assert np.asarray(out.device_idx).shape == (2, 8)
