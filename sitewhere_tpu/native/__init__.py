@@ -1,0 +1,255 @@
+"""ctypes bindings for the native host runtime (host_runtime.cc).
+
+The shared library is compiled on first import with the toolchain g++ (no
+external build system, no pybind11 — plain `extern "C"` + ctypes) and cached
+next to the source; a stale cache (source newer than .so) rebuilds. Import
+never fails: if the compiler or the build is unavailable the module exposes
+``LIB = None`` and callers fall back to their pure-Python paths.
+
+Set SITEWHERE_TPU_NO_NATIVE=1 to force the fallback (used by tests to cover
+both paths).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "host_runtime.cc")
+_SO = os.path.join(_DIR, "libswt_host.so")
+
+LIB: Optional[ctypes.CDLL] = None
+_build_error: Optional[str] = None
+_lock = threading.Lock()
+
+
+def _build() -> Optional[str]:
+    """Compile the shared library if missing/stale; returns error or None."""
+    try:
+        if (os.path.exists(_SO)
+                and os.path.getmtime(_SO) >= os.path.getmtime(_SRC)):
+            return None
+        cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+               "-o", _SO + ".tmp", _SRC]
+        proc = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+        if proc.returncode != 0:
+            return proc.stderr[-2000:]
+        os.replace(_SO + ".tmp", _SO)
+        return None
+    except (OSError, subprocess.SubprocessError) as exc:
+        return str(exc)
+
+
+def _load() -> None:
+    global LIB, _build_error
+    if os.environ.get("SITEWHERE_TPU_NO_NATIVE") == "1":
+        _build_error = "disabled by SITEWHERE_TPU_NO_NATIVE"
+        return
+    _build_error = _build()
+    if _build_error is not None:
+        return
+    try:
+        lib = ctypes.CDLL(_SO)
+    except OSError as exc:
+        _build_error = str(exc)
+        return
+    c = ctypes
+    i32, i64, vp = c.c_int32, c.c_int64, c.c_void_p
+    p_i32 = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+    p_i64 = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+    p_f32 = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
+    lib.swt_version.restype = i32
+    lib.swt_interner_create.argtypes = [i32]
+    lib.swt_interner_create.restype = vp
+    lib.swt_interner_destroy.argtypes = [vp]
+    lib.swt_interner_size.argtypes = [vp]
+    lib.swt_interner_size.restype = i32
+    lib.swt_interner_add.argtypes = [vp, c.c_char_p, i32]
+    lib.swt_interner_add.restype = i32
+    lib.swt_interner_token_at.argtypes = [vp, i32, c.c_char_p, i32]
+    lib.swt_interner_token_at.restype = i32
+    lib.swt_interner_lookup_offsets.argtypes = [vp, c.c_char_p, p_i64, i32,
+                                                p_i32]
+    lib.swt_interner_lookup_offsets.restype = i32
+    lib.swt_interner_intern_offsets.argtypes = [vp, c.c_char_p, p_i64, i32,
+                                                p_i32, i32]
+    lib.swt_interner_intern_offsets.restype = i32
+    lib.swt_decode_hot_frames.argtypes = [
+        c.c_char_p, i64, i32,
+        p_i32, p_i64, p_f32, p_f32, p_f32, p_f32, p_i32,
+        c.c_char_p, i64, p_i64,
+        c.c_char_p, i64, p_i64,
+        c.c_char_p, i64, p_i64,
+        p_i32, p_i64, p_i64, i32, p_i64]
+    lib.swt_decode_hot_frames.restype = i32
+    if lib.swt_version() != 1:
+        _build_error = "version mismatch"
+        return
+    LIB = lib
+
+
+_load()
+
+
+def available() -> bool:
+    return LIB is not None
+
+
+def build_error() -> Optional[str]:
+    return _build_error
+
+
+def join_tokens(tokens) -> Tuple[bytes, np.ndarray]:
+    """Encode a sequence of str/bytes tokens into (joined buffer, offsets)."""
+    enc = [t.encode() if isinstance(t, str) else t for t in tokens]
+    off = np.zeros(len(enc) + 1, np.int64)
+    np.cumsum([len(t) for t in enc], out=off[1:])
+    return b"".join(enc), off
+
+
+class NativeInterner:
+    """Thin RAII wrapper over swt_interner_* (index 0 = UNKNOWN)."""
+
+    def __init__(self, capacity: int):
+        assert LIB is not None
+        self._h = LIB.swt_interner_create(capacity)
+        if not self._h:
+            raise MemoryError("swt_interner_create failed")
+
+    def __del__(self):
+        h, self._h = getattr(self, "_h", None), None
+        if h and LIB is not None:
+            LIB.swt_interner_destroy(h)
+
+    def __len__(self) -> int:
+        return LIB.swt_interner_size(self._h)
+
+    def add(self, token: str) -> int:
+        """Get-or-assign; -1 signals capacity exceeded."""
+        raw = token.encode()
+        return LIB.swt_interner_add(self._h, raw, len(raw))
+
+    def token_at(self, idx: int) -> Optional[str]:
+        cap = 1024
+        while True:
+            buf = ctypes.create_string_buffer(cap)
+            n = LIB.swt_interner_token_at(self._h, idx, buf, cap)
+            if n >= 0:
+                return buf.raw[:n].decode()
+            if n == -1:
+                return None
+            cap = -n - 2  # buffer was too small; retry at the exact size
+
+    def lookup_offsets(self, buf: bytes, off: np.ndarray) -> np.ndarray:
+        n = len(off) - 1
+        out = np.empty(n, np.int32)
+        LIB.swt_interner_lookup_offsets(self._h, buf, off, n, out)
+        return out
+
+    def intern_offsets(self, buf: bytes, off: np.ndarray,
+                       skip_empty: bool = False) -> Tuple[np.ndarray, bool]:
+        """Returns (indices, capacity_ok). skip_empty maps zero-length
+        tokens to UNKNOWN without interning them."""
+        n = len(off) - 1
+        out = np.empty(n, np.int32)
+        rc = LIB.swt_interner_intern_offsets(self._h, buf, off, n, out,
+                                             1 if skip_empty else 0)
+        return out, rc == 0
+
+    def lookup_batch(self, tokens) -> np.ndarray:
+        buf, off = join_tokens(tokens)
+        return self.lookup_offsets(buf, off)
+
+    def intern_batch(self, tokens) -> Tuple[np.ndarray, bool]:
+        buf, off = join_tokens(tokens)
+        return self.intern_offsets(buf, off)
+
+
+class DecodedColumns:
+    """Output of decode_hot_frames: SoA columns + string buffers + control
+    frames. String columns stay as (bytes, offsets) so they can feed the
+    native interner without materializing Python strings."""
+
+    __slots__ = ("n", "event_type", "ts_ms", "value", "lat", "lon",
+                 "elevation", "alert_level", "tokens", "names", "alert_types",
+                 "others", "consumed")
+
+    def __init__(self, n, event_type, ts_ms, value, lat, lon, elevation,
+                 alert_level, tokens, names, alert_types, others, consumed):
+        self.n = n
+        self.event_type = event_type
+        self.ts_ms = ts_ms
+        self.value = value
+        self.lat = lat
+        self.lon = lon
+        self.elevation = elevation
+        self.alert_level = alert_level
+        self.tokens = tokens            # (bytes, offsets[n+1])
+        self.names = names              # (bytes, offsets[n+1])
+        self.alert_types = alert_types  # (bytes, offsets[n+1])
+        self.others = others            # [(msg_type, payload bytes)]
+        self.consumed = consumed
+
+    def token_list(self) -> List[str]:
+        buf, off = self.tokens
+        return [buf[off[i]:off[i + 1]].decode() for i in range(self.n)]
+
+
+class WireDecodeError(Exception):
+    pass
+
+
+def decode_hot_frames(data: bytes, max_events: Optional[int] = None
+                      ) -> DecodedColumns:
+    """Single-pass native decode of a wire byte stream (see host_runtime.cc).
+
+    Raises WireDecodeError on malformed input; a trailing partial frame is
+    returned via `consumed` (callers keep the remainder buffered).
+    """
+    assert LIB is not None
+    cap = max_events if max_events is not None else max(len(data) // 13, 1)
+    et = np.empty(cap, np.int32)
+    ts = np.empty(cap, np.int64)
+    val = np.empty(cap, np.float32)
+    lat = np.empty(cap, np.float32)
+    lon = np.empty(cap, np.float32)
+    ele = np.empty(cap, np.float32)
+    lvl = np.empty(cap, np.int32)
+    tok_cap = len(data)
+    tok_buf = ctypes.create_string_buffer(tok_cap or 1)
+    name_buf = ctypes.create_string_buffer(tok_cap or 1)
+    atype_buf = ctypes.create_string_buffer(tok_cap or 1)
+    tok_off = np.zeros(cap + 1, np.int64)
+    name_off = np.zeros(cap + 1, np.int64)
+    atype_off = np.zeros(cap + 1, np.int64)
+    other_cap = max(len(data) // 8, 1)
+    other_type = np.empty(other_cap, np.int32)
+    other_off = np.empty(other_cap, np.int64)
+    other_len = np.empty(other_cap, np.int64)
+    counts = np.zeros(4, np.int64)
+    LIB.swt_decode_hot_frames(
+        data, len(data), cap, et, ts, val, lat, lon, ele, lvl,
+        tok_buf, tok_cap, tok_off, name_buf, tok_cap, name_off,
+        atype_buf, tok_cap, atype_off,
+        other_type, other_off, other_len, other_cap, counts)
+    n, m, consumed, err = (int(counts[0]), int(counts[1]), int(counts[2]),
+                           int(counts[3]))
+    if err == 1:
+        raise WireDecodeError("bad magic/version")
+    if err == 3:
+        raise WireDecodeError("malformed frame payload")
+    if err == 2:
+        raise WireDecodeError("decode capacity exceeded")
+    others = [(int(other_type[i]),
+               data[int(other_off[i]):int(other_off[i]) + int(other_len[i])])
+              for i in range(m)]
+    return DecodedColumns(
+        n, et[:n], ts[:n], val[:n], lat[:n], lon[:n], ele[:n], lvl[:n],
+        (tok_buf.raw, tok_off[:n + 1]), (name_buf.raw, name_off[:n + 1]),
+        (atype_buf.raw, atype_off[:n + 1]), others, consumed)

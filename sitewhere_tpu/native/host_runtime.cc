@@ -1,0 +1,354 @@
+// Native host runtime for sitewhere_tpu: the pieces of the ingest path that
+// must run at millions of events/sec on the host CPU, ahead of the TPU step.
+//
+// The reference implements this tier on the JVM (per-event protobuf decode in
+// sitewhere-communication ProtobufDeviceEventDecoder.java + per-event device
+// lookups, InboundPayloadProcessingLogic.java:156); here it is a small C++
+// library driven through ctypes:
+//
+//   1. swt_interner_*: string token -> dense int32 index table
+//      (SURVEY.md §7 hard part (c): token interning at 1M+/s). FNV-1a hash,
+//      open addressing, shared_mutex (concurrent receiver threads).
+//   2. swt_decode_hot_frames: one pass over a wire-protocol byte stream
+//      (transport/wire.py frame layout) producing SoA columns for the hot
+//      event types and an index of control frames for the Python side.
+//
+// Built with: g++ -O3 -std=c++17 -shared -fPIC (see native/__init__.py).
+
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <shared_mutex>
+#include <string>
+#include <vector>
+
+namespace {
+
+constexpr uint64_t kFnvOffset = 1469598103934665603ull;
+constexpr uint64_t kFnvPrime = 1099511628211ull;
+
+inline uint64_t fnv1a(const char* data, int64_t len) {
+  uint64_t h = kFnvOffset;
+  for (int64_t i = 0; i < len; ++i) {
+    h ^= static_cast<uint8_t>(data[i]);
+    h *= kFnvPrime;
+  }
+  return h;
+}
+
+inline size_t next_pow2(size_t v) {
+  size_t p = 1;
+  while (p < v) p <<= 1;
+  return p;
+}
+
+struct Interner {
+  explicit Interner(int32_t capacity)
+      : capacity(capacity), mask(next_pow2(static_cast<size_t>(capacity) * 2) - 1),
+        slots(mask + 1, -1), hashes(mask + 1, 0) {
+    tokens.reserve(capacity);
+    tokens.emplace_back();  // index 0 = UNKNOWN sentinel, never matched
+  }
+
+  int32_t capacity;
+  size_t mask;
+  std::vector<int32_t> slots;     // slot -> token index, -1 empty
+  std::vector<uint64_t> hashes;   // slot -> full hash (cheap reject)
+  std::vector<std::string> tokens;  // index -> bytes
+  mutable std::shared_mutex mu;
+
+  // Requires at least a shared lock.
+  int32_t find(const char* tok, int64_t len, uint64_t h) const {
+    size_t slot = h & mask;
+    while (true) {
+      int32_t idx = slots[slot];
+      if (idx < 0) return -1;
+      if (hashes[slot] == h) {
+        const std::string& s = tokens[static_cast<size_t>(idx)];
+        if (static_cast<int64_t>(s.size()) == len &&
+            std::memcmp(s.data(), tok, static_cast<size_t>(len)) == 0)
+          return idx;
+      }
+      slot = (slot + 1) & mask;
+    }
+  }
+
+  // Requires the unique lock.
+  int32_t add(const char* tok, int64_t len, uint64_t h) {
+    int32_t idx = find(tok, len, h);
+    if (idx >= 0) return idx;
+    if (static_cast<int32_t>(tokens.size()) >= capacity) return -1;
+    idx = static_cast<int32_t>(tokens.size());
+    tokens.emplace_back(tok, static_cast<size_t>(len));
+    size_t slot = h & mask;
+    while (slots[slot] >= 0) slot = (slot + 1) & mask;
+    slots[slot] = idx;
+    hashes[slot] = h;
+    return idx;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+int32_t swt_version() { return 1; }
+
+void* swt_interner_create(int32_t capacity) {
+  if (capacity < 2) return nullptr;
+  return new Interner(capacity);
+}
+
+void swt_interner_destroy(void* h) { delete static_cast<Interner*>(h); }
+
+int32_t swt_interner_size(void* h) {
+  Interner* in = static_cast<Interner*>(h);
+  std::shared_lock<std::shared_mutex> lock(in->mu);
+  return static_cast<int32_t>(in->tokens.size());
+}
+
+// Get-or-assign one token; returns its index, or -1 when capacity exceeded.
+int32_t swt_interner_add(void* h, const char* tok, int32_t len) {
+  Interner* in = static_cast<Interner*>(h);
+  uint64_t hash = fnv1a(tok, len);
+  {
+    std::shared_lock<std::shared_mutex> lock(in->mu);
+    int32_t idx = in->find(tok, len, hash);
+    if (idx >= 0) return idx;
+  }
+  std::unique_lock<std::shared_mutex> lock(in->mu);
+  return in->add(tok, len, hash);
+}
+
+// Copy token bytes for index `idx` into out (cap bytes); returns byte
+// length, -1 if idx is out of range, or -(2 + needed_len) when the buffer
+// is too small (so callers can retry with a bigger one).
+int32_t swt_interner_token_at(void* h, int32_t idx, char* out, int32_t cap) {
+  Interner* in = static_cast<Interner*>(h);
+  std::shared_lock<std::shared_mutex> lock(in->mu);
+  if (idx <= 0 || idx >= static_cast<int32_t>(in->tokens.size())) return -1;
+  const std::string& s = in->tokens[static_cast<size_t>(idx)];
+  if (static_cast<int32_t>(s.size()) > cap)
+    return -(2 + static_cast<int32_t>(s.size()));
+  std::memcpy(out, s.data(), s.size());
+  return static_cast<int32_t>(s.size());
+}
+
+// Batch lookup: n tokens in `buf` delimited by offsets [n+1]; unknown -> 0.
+int32_t swt_interner_lookup_offsets(void* h, const char* buf,
+                                    const int64_t* off, int32_t n,
+                                    int32_t* out_idx) {
+  Interner* in = static_cast<Interner*>(h);
+  std::shared_lock<std::shared_mutex> lock(in->mu);
+  for (int32_t i = 0; i < n; ++i) {
+    const char* tok = buf + off[i];
+    int64_t len = off[i + 1] - off[i];
+    int32_t idx = in->find(tok, len, fnv1a(tok, len));
+    out_idx[i] = idx < 0 ? 0 : idx;
+  }
+  return 0;
+}
+
+// Batch get-or-assign. Returns 0, or -1 if capacity was exceeded (out_idx is
+// filled with 0 for the tokens that no longer fit). With skip_empty != 0,
+// zero-length tokens map to 0 without interning (an "absent" field in a
+// decoded column, e.g. measurement names on location events).
+int32_t swt_interner_intern_offsets(void* h, const char* buf,
+                                    const int64_t* off, int32_t n,
+                                    int32_t* out_idx, int32_t skip_empty) {
+  Interner* in = static_cast<Interner*>(h);
+  int32_t rc = 0;
+  // Fast pass under the shared lock: most tokens already exist.
+  std::vector<int32_t> missing;
+  {
+    std::shared_lock<std::shared_mutex> lock(in->mu);
+    for (int32_t i = 0; i < n; ++i) {
+      const char* tok = buf + off[i];
+      int64_t len = off[i + 1] - off[i];
+      if (skip_empty && len == 0) {
+        out_idx[i] = 0;
+        continue;
+      }
+      out_idx[i] = in->find(tok, len, fnv1a(tok, len));
+      if (out_idx[i] < 0) missing.push_back(i);
+    }
+  }
+  if (!missing.empty()) {
+    std::unique_lock<std::shared_mutex> lock(in->mu);
+    for (int32_t i : missing) {
+      const char* tok = buf + off[i];
+      int64_t len = off[i + 1] - off[i];
+      int32_t idx = in->add(tok, len, fnv1a(tok, len));
+      if (idx < 0) {
+        out_idx[i] = 0;
+        rc = -1;
+      } else {
+        out_idx[i] = idx;
+      }
+    }
+  }
+  return rc;
+}
+
+// ---------------------------------------------------------------------------
+// Wire-protocol hot-frame decoder (layout doc: transport/wire.py).
+//
+// Frame: "SW" u8 version u8 msg_type u32 payload_len payload.
+// Hot payloads (msg_type 3/4/5): u8 token_len, token, i64 ts_ms, then
+//   MEASUREMENT(3): u8 name_len, name, f32 value
+//   LOCATION(4):    f32 lat, f32 lon, f32 elevation
+//   ALERT(5):       u8 type_len, type, u8 level, u16 msg_len, msg
+//
+// Event-type codes written to `event_type` are the model enum values
+// (model/event.py DeviceEventType): MEASUREMENT=0, LOCATION=1, ALERT=2.
+//
+// counts[0]=n_hot, counts[1]=n_other, counts[2]=consumed_bytes,
+// counts[3]=error (0 ok; 1 bad magic/version; 2 capacity; 3 malformed).
+// A trailing partial frame is not an error: it is left unconsumed.
+// ---------------------------------------------------------------------------
+
+namespace {
+inline uint32_t rd_u32(const uint8_t* p) {
+  uint32_t v;
+  std::memcpy(&v, p, 4);
+  return v;
+}
+inline int64_t rd_i64(const uint8_t* p) {
+  int64_t v;
+  std::memcpy(&v, p, 8);
+  return v;
+}
+inline float rd_f32(const uint8_t* p) {
+  float v;
+  std::memcpy(&v, p, 4);
+  return v;
+}
+}  // namespace
+
+int32_t swt_decode_hot_frames(
+    const uint8_t* buf, int64_t len, int32_t cap,
+    int32_t* event_type, int64_t* ts, float* value, float* lat, float* lon,
+    float* elevation, int32_t* alert_level,
+    char* tok_buf, int64_t tok_cap, int64_t* tok_off,
+    char* name_buf, int64_t name_cap, int64_t* name_off,
+    char* atype_buf, int64_t atype_cap, int64_t* atype_off,
+    int32_t* other_type, int64_t* other_off, int64_t* other_len,
+    int32_t other_cap, int64_t* counts) {
+  int64_t pos = 0;
+  int32_t n = 0, m = 0;
+  int64_t tok_pos = 0, name_pos = 0, atype_pos = 0;
+  tok_off[0] = name_off[0] = atype_off[0] = 0;
+  counts[0] = counts[1] = counts[2] = counts[3] = 0;
+  constexpr int64_t kMaxPayload = 16ll * 1024 * 1024;  // wire.MAX_FRAME_PAYLOAD
+
+  while (len - pos >= 8) {
+    const uint8_t* hdr = buf + pos;
+    if (hdr[0] != 'S' || hdr[1] != 'W' || hdr[2] != 1) {
+      counts[3] = 1;
+      break;
+    }
+    uint8_t mtype = hdr[3];
+    int64_t plen = static_cast<int64_t>(rd_u32(hdr + 4));
+    if (plen > kMaxPayload) {
+      counts[3] = 3;
+      break;
+    }
+    if (len - pos - 8 < plen) break;  // partial frame: stop, not an error
+    const uint8_t* p = buf + pos + 8;
+    if (mtype < 3 || mtype > 5) {   // control frame: index for Python
+      if (m >= other_cap) {
+        counts[3] = 2;
+        break;
+      }
+      other_type[m] = mtype;
+      other_off[m] = pos + 8;
+      other_len[m] = plen;
+      ++m;
+      pos += 8 + plen;
+      continue;
+    }
+    if (n >= cap) {
+      counts[3] = 2;
+      break;
+    }
+    // hot event payload
+    const uint8_t* end = p + plen;
+    if (p >= end) {
+      counts[3] = 3;
+      break;
+    }
+    int64_t tlen = *p++;
+    if (p + tlen + 8 > end || tok_pos + tlen > tok_cap) {
+      counts[3] = tok_pos + tlen > tok_cap ? 2 : 3;
+      break;
+    }
+    std::memcpy(tok_buf + tok_pos, p, static_cast<size_t>(tlen));
+    tok_pos += tlen;
+    p += tlen;
+    int64_t ets = rd_i64(p);
+    p += 8;
+    int32_t etype;
+    float ev = 0, ela = 0, elo = 0, eel = 0;
+    int32_t elev = 0;
+    int64_t nlen = 0, alen = 0;
+    bool ok = true;
+    if (mtype == 3) {  // MEASUREMENT
+      etype = 0;
+      ok = p < end;
+      if (ok) {
+        nlen = *p++;
+        ok = p + nlen + 4 <= end && name_pos + nlen <= name_cap;
+      }
+      if (ok) {
+        std::memcpy(name_buf + name_pos, p, static_cast<size_t>(nlen));
+        p += nlen;
+        ev = rd_f32(p);
+      }
+    } else if (mtype == 4) {  // LOCATION
+      etype = 1;
+      ok = p + 12 <= end;
+      if (ok) {
+        ela = rd_f32(p);
+        elo = rd_f32(p + 4);
+        eel = rd_f32(p + 8);
+      }
+    } else {  // ALERT
+      etype = 2;
+      ok = p < end;
+      if (ok) {
+        alen = *p++;
+        ok = p + alen + 3 <= end && atype_pos + alen <= atype_cap;
+      }
+      if (ok) {
+        std::memcpy(atype_buf + atype_pos, p, static_cast<size_t>(alen));
+        p += alen;
+        elev = *p;
+      }
+    }
+    if (!ok) {
+      counts[3] = 3;
+      break;
+    }
+    event_type[n] = etype;
+    ts[n] = ets;
+    value[n] = ev;
+    lat[n] = ela;
+    lon[n] = elo;
+    elevation[n] = eel;
+    alert_level[n] = elev;
+    name_pos += nlen;
+    atype_pos += alen;
+    ++n;
+    tok_off[n] = tok_pos;
+    name_off[n] = name_pos;
+    atype_off[n] = atype_pos;
+    pos += 8 + plen;
+  }
+  counts[0] = n;
+  counts[1] = m;
+  counts[2] = pos;
+  return counts[3] == 0 ? 0 : -1;
+}
+
+}  // extern "C"

@@ -6,9 +6,11 @@ that index HBM lookup tensors. This replaces the reference's per-event
 device-token -> Device gRPC lookup + Hazelcast near-cache
 (InboundPayloadProcessingLogic.java:156, NearCacheManager.java:42).
 
-A native C++ batch interner (sitewhere_tpu/native) accelerates bulk interning;
-this module transparently uses it when the shared library is built and falls
-back to pure Python otherwise.
+The native C++ batch interner (sitewhere_tpu/native/host_runtime.cc)
+accelerates bulk interning; this module transparently uses it when the shared
+library is available (it is mirrored entry-for-entry from the Python side,
+which stays authoritative for token_of/snapshot/restore) and falls back to
+pure Python otherwise (SITEWHERE_TPU_NO_NATIVE=1 forces the fallback).
 """
 
 from __future__ import annotations
@@ -17,6 +19,11 @@ import threading
 from typing import Dict, Iterable, List, Optional, Sequence
 
 import numpy as np
+
+
+def _native():
+    from sitewhere_tpu import native
+    return native if native.available() else None
 
 
 class TokenInterner:
@@ -36,9 +43,17 @@ class TokenInterner:
         self._to_index: Dict[str, int] = {}
         self._to_token: List[Optional[str]] = [None]  # index 0 = UNKNOWN
         self._lock = threading.Lock()
+        nat = _native()
+        self._nat = nat.NativeInterner(capacity) if nat else None
 
     def __len__(self) -> int:
         return len(self._to_token)
+
+    def _raise_capacity(self):
+        from sitewhere_tpu.errors import ErrorCode, SiteWhereError
+        raise SiteWhereError(
+            f"interner '{self.name}' capacity {self.capacity} exceeded",
+            ErrorCode.CAPACITY_EXCEEDED)
 
     def intern(self, token: str) -> int:
         """Get-or-assign the index for a token."""
@@ -51,12 +66,12 @@ class TokenInterner:
                 return idx
             idx = len(self._to_token)
             if idx >= self.capacity:
-                from sitewhere_tpu.errors import ErrorCode, SiteWhereError
-                raise SiteWhereError(
-                    f"interner '{self.name}' capacity {self.capacity} exceeded",
-                    ErrorCode.CAPACITY_EXCEEDED)
+                self._raise_capacity()
             self._to_token.append(token)
             self._to_index[token] = idx
+            if self._nat is not None:
+                nidx = self._nat.add(token)
+                assert nidx == idx, "native interner out of sync"
             return idx
 
     def lookup(self, token: str) -> int:
@@ -70,12 +85,67 @@ class TokenInterner:
 
     def lookup_batch(self, tokens: Sequence[str]) -> np.ndarray:
         """Vectorized lookup of many tokens -> int32 array (no allocation)."""
+        if self._nat is not None:
+            return self._nat.lookup_batch(tokens)
         get = self._to_index.get
         return np.fromiter((get(t, 0) for t in tokens), dtype=np.int32,
                            count=len(tokens))
 
+    def lookup_offsets(self, buf: bytes, off: np.ndarray) -> np.ndarray:
+        """Lookup tokens given as a (joined bytes, offsets[n+1]) pair — the
+        zero-copy contract of the native wire decoder (native/__init__.py
+        DecodedColumns). Falls back through Python slicing."""
+        if self._nat is not None:
+            return self._nat.lookup_offsets(buf, off)
+        get = self._to_index.get
+        n = len(off) - 1
+        return np.fromiter(
+            (get(buf[off[i]:off[i + 1]].decode(), 0) for i in range(n)),
+            dtype=np.int32, count=n)
+
     def intern_batch(self, tokens: Iterable[str]) -> np.ndarray:
-        return np.fromiter((self.intern(t) for t in tokens), dtype=np.int32)
+        if self._nat is None:
+            return np.fromiter((self.intern(t) for t in tokens),
+                               dtype=np.int32)
+        tokens = list(tokens)
+        with self._lock:
+            idx, ok = self._nat.intern_batch(tokens)
+            self._sync_from_native()
+        if not ok:
+            self._raise_capacity()
+        return idx
+
+    def intern_offsets(self, buf: bytes, off: np.ndarray,
+                       skip_empty: bool = False) -> np.ndarray:
+        """intern_batch over a (joined bytes, offsets) pair. skip_empty maps
+        zero-length tokens to UNKNOWN without interning (absent fields in
+        decoded columns)."""
+        if self._nat is None:
+            n = len(off) - 1
+
+            def one(i):
+                if skip_empty and off[i + 1] == off[i]:
+                    return 0
+                return self.intern(buf[off[i]:off[i + 1]].decode())
+
+            return np.fromiter((one(i) for i in range(n)), dtype=np.int32,
+                               count=n)
+        with self._lock:
+            idx, ok = self._nat.intern_offsets(buf, off, skip_empty)
+            self._sync_from_native()
+        if not ok:
+            self._raise_capacity()
+        return idx
+
+    def _sync_from_native(self) -> None:
+        """Mirror tokens the native table assigned that Python hasn't seen.
+        Caller holds self._lock."""
+        n = len(self._nat)
+        while len(self._to_token) < n:
+            idx = len(self._to_token)
+            token = self._nat.token_at(idx)
+            self._to_token.append(token)
+            self._to_index[token] = idx
 
     def snapshot(self) -> List[Optional[str]]:
         with self._lock:
@@ -89,3 +159,11 @@ class TokenInterner:
                 self._to_token.insert(0, None)
             self._to_index = {t: i for i, t in enumerate(self._to_token)
                               if t is not None}
+            if self._nat is not None:
+                nat = _native()
+                self._nat = nat.NativeInterner(self.capacity)
+                for i, t in enumerate(self._to_token[1:], start=1):
+                    # snapshots may hold None gaps (never valid mid-stream);
+                    # keep native slot numbering aligned with an
+                    # un-lookupable placeholder
+                    self._nat.add(t if t is not None else f"\x00gap{i}")

@@ -1,0 +1,274 @@
+"""Native host-runtime (C++/ctypes) vs pure-Python reference parity.
+
+Covers SURVEY.md §7 hard part (c): token interning + wire decode at rate.
+Tests run only when the library built (it always should — g++ is part of the
+toolchain); the pure-Python fallbacks are covered by the existing suites with
+SITEWHERE_TPU_NO_NATIVE=1 via the TokenInterner tests.
+"""
+
+import numpy as np
+import pytest
+
+import sitewhere_tpu.native as nat
+from sitewhere_tpu.transport.wire import (
+    MessageType, WireCodec, decode_frames, decode_event_frames_to_columns,
+    encode_frame)
+
+pytestmark = pytest.mark.skipif(not nat.available(),
+                                reason=f"native lib: {nat.build_error()}")
+
+
+def _stream(n=200, seed=0):
+    rng = np.random.default_rng(seed)
+    out = []
+    for i in range(n):
+        tok = f"dev-{int(rng.integers(0, 50))}"
+        ts = 1_700_000_000_000 + i
+        kind = int(rng.integers(0, 3))
+        if kind == 0:
+            out.append(encode_frame(
+                MessageType.MEASUREMENT, WireCodec.encode_measurement(
+                    tok, ts, f"m{int(rng.integers(0, 5))}",
+                    float(rng.normal()))))
+        elif kind == 1:
+            out.append(encode_frame(
+                MessageType.LOCATION, WireCodec.encode_location(
+                    tok, ts, float(rng.uniform(-90, 90)),
+                    float(rng.uniform(-180, 180)), float(rng.normal()))))
+        else:
+            out.append(encode_frame(
+                MessageType.ALERT, WireCodec.encode_alert(
+                    tok, ts, f"alert.t{int(rng.integers(0, 3))}",
+                    int(rng.integers(0, 5)), "engine hot")))
+    return b"".join(out)
+
+
+class TestNativeDecoder:
+    def test_matches_python_reference(self):
+        data = _stream()
+        cols = nat.decode_hot_frames(data)
+        frames, rest = decode_frames(data)
+        assert rest == b"" and cols.consumed == len(data)
+        ref = decode_event_frames_to_columns(frames)
+        assert cols.n == len(ref["tokens"])
+        np.testing.assert_array_equal(cols.event_type, ref["event_type"])
+        np.testing.assert_array_equal(cols.ts_ms, ref["ts_ms"])
+        np.testing.assert_array_equal(cols.value, ref["value"])
+        np.testing.assert_array_equal(cols.lat, ref["lat"])
+        np.testing.assert_array_equal(cols.lon, ref["lon"])
+        np.testing.assert_array_equal(cols.elevation, ref["elevation"])
+        np.testing.assert_array_equal(cols.alert_level, ref["alert_level"])
+        assert cols.token_list() == ref["tokens"]
+        nbuf, noff = cols.names
+        names = [nbuf[noff[i]:noff[i + 1]].decode() for i in range(cols.n)]
+        assert names == ref["names"]
+        abuf, aoff = cols.alert_types
+        atypes = [abuf[aoff[i]:aoff[i + 1]].decode() for i in range(cols.n)]
+        assert atypes == ref["alert_types"]
+
+    def test_partial_frame_left_unconsumed(self):
+        data = _stream(10)
+        cut = data[:-3]
+        cols = nat.decode_hot_frames(cut)
+        assert cols.n == 9
+        assert cols.consumed < len(cut)
+        assert cut[cols.consumed:cols.consumed + 2] == b"SW"
+
+    def test_control_frames_indexed(self):
+        reg = encode_frame(MessageType.REGISTER, b"\x81\xa1a\xa1b")
+        data = reg + _stream(5) + reg
+        cols = nat.decode_hot_frames(data)
+        assert cols.n == 5
+        assert [t for t, _ in cols.others] == [int(MessageType.REGISTER)] * 2
+        assert cols.others[0][1] == b"\x81\xa1a\xa1b"
+
+    def test_bad_magic_raises(self):
+        with pytest.raises(nat.WireDecodeError):
+            nat.decode_hot_frames(b"XX\x01\x03\x04\x00\x00\x00abcd1234")
+
+    def test_truncated_payload_field_raises(self):
+        good = encode_frame(MessageType.MEASUREMENT,
+                            WireCodec.encode_measurement("d", 1, "m", 1.0))
+        # corrupt: claim payload length 3 (too short for token+ts)
+        bad = good[:4] + (3).to_bytes(4, "little") + good[8:11]
+        with pytest.raises(nat.WireDecodeError):
+            nat.decode_hot_frames(bad)
+
+
+class TestNativeInterner:
+    def test_capacity(self):
+        it = nat.NativeInterner(4)  # 0 sentinel + 3 tokens
+        assert it.add("a") == 1 and it.add("b") == 2 and it.add("c") == 3
+        assert it.add("d") == -1
+        idx, ok = it.intern_batch(["a", "e"])
+        assert not ok and idx[0] == 1 and idx[1] == 0
+
+    def test_agrees_with_python_interner(self):
+        from sitewhere_tpu.registry.interning import TokenInterner
+        rng = np.random.default_rng(1)
+        tokens = [f"t{int(rng.integers(0, 300))}" for _ in range(2000)]
+        py = TokenInterner(1024)
+        ref = np.array([py.intern(t) for t in tokens], np.int32)
+        it = nat.NativeInterner(1024)
+        got, ok = it.intern_batch(tokens)
+        assert ok
+        np.testing.assert_array_equal(got, ref)
+        np.testing.assert_array_equal(it.lookup_batch(tokens), ref)
+        assert it.lookup_batch(["missing"])[0] == 0
+
+    def test_empty_token(self):
+        it = nat.NativeInterner(8)
+        i1 = it.add("")
+        assert i1 > 0 and it.add("") == i1  # empty is a valid distinct token
+
+
+class TestFastWireIngest:
+    def _packer(self, batch_size=64):
+        from sitewhere_tpu.ops.pack import EventPacker
+        from sitewhere_tpu.registry.interning import TokenInterner
+        devices = TokenInterner(256, "devices")
+        for i in range(50):
+            devices.intern(f"dev-{i}")
+        return EventPacker(batch_size, devices, epoch_base_ms=1_700_000_000_000)
+
+    def _check(self, lane, packer):
+        data = _stream(150, seed=2)
+        res = lane.ingest(data)
+        assert res.n_events == 150 and res.remainder == b""
+        assert len(res.batches) == 3  # 150 events / batch 64
+        total_valid = sum(int(b.valid.sum()) for b in res.batches)
+        assert total_valid == 150
+        b0 = res.batches[0]
+        # cross-check against the object path (pack via WireDecoder)
+        frames, _ = decode_frames(data)
+        ref = decode_event_frames_to_columns(frames)
+        np.testing.assert_array_equal(b0.event_type[:64], ref["event_type"][:64])
+        np.testing.assert_array_equal(
+            b0.device_idx[:64], packer.devices.lookup_batch(ref["tokens"][:64]))
+        np.testing.assert_array_equal(b0.value[:64], ref["value"][:64])
+        # measurement names interned only for measurement rows
+        assert packer.measurements.lookup("m0") > 0
+        is_loc = ref["event_type"][:64] == 1
+        assert (np.asarray(b0.mm_idx[:64])[is_loc] == 0).all()
+
+    def test_native_lane(self):
+        from sitewhere_tpu.sources.fastlane import FastWireIngest
+        packer = self._packer()
+        lane = FastWireIngest(packer)
+        assert lane._nat is not None
+        self._check(lane, packer)
+
+    def test_python_lane_matches(self):
+        from sitewhere_tpu.sources.fastlane import FastWireIngest
+        packer = self._packer()
+        lane = FastWireIngest(packer)
+        lane._nat = None  # force fallback
+        self._check(lane, packer)
+
+    def test_native_and_python_identical(self):
+        from sitewhere_tpu.sources.fastlane import FastWireIngest
+        import jax.tree_util as jtu
+        data = _stream(100, seed=5)
+        p1, p2 = self._packer(), self._packer()
+        l1, l2 = FastWireIngest(p1), FastWireIngest(p2)
+        l2._nat = None
+        r1, r2 = l1.ingest(data), l2.ingest(data)
+        for b1, b2 in zip(r1.batches, r2.batches):
+            for a1, a2 in zip(jtu.tree_leaves(b1), jtu.tree_leaves(b2)):
+                np.testing.assert_array_equal(np.asarray(a1), np.asarray(a2))
+        assert p1.measurements.snapshot() == p2.measurements.snapshot()
+        assert p1.alert_types.snapshot() == p2.alert_types.snapshot()
+
+
+class TestBulkWireIngestService:
+    def test_end_to_end_single_chip(self):
+        from sitewhere_tpu.model import (
+            AlertLevel, Area, Device, DeviceAssignment, DeviceType, Zone)
+        from sitewhere_tpu.model.common import Location
+        from sitewhere_tpu.persist.eventlog import ColumnarEventLog
+        from sitewhere_tpu.pipeline.engine import PipelineEngine, ThresholdRule
+        from sitewhere_tpu.registry import DeviceManagement, RegistryTensors
+        from sitewhere_tpu.runtime.bus import EventBus, TopicNaming
+        from sitewhere_tpu.sources.fastlane import BulkWireIngestService
+
+        dm = DeviceManagement()
+        dt = dm.create_device_type(DeviceType(token="sensor"))
+        area = dm.create_area(Area(token="a"))
+        tensors = RegistryTensors(max_devices=64, max_zones=4,
+                                  max_zone_vertices=8)
+        tensors.attach(dm, "t1")
+        for i in range(5):
+            d = dm.create_device(Device(token=f"dev-{i}",
+                                        device_type_id=dt.id))
+            dm.create_device_assignment(DeviceAssignment(
+                token=f"as-{i}", device_id=d.id, area_id=area.id))
+        engine = PipelineEngine(tensors, batch_size=16)
+        engine.packer.measurements.intern("m1")
+        engine.add_threshold_rule(ThresholdRule(
+            token="hot", measurement_name="m1", operator=">", threshold=50.0,
+            alert_level=AlertLevel.CRITICAL))
+        engine.start()
+        bus = EventBus()
+        log = ColumnarEventLog()
+        naming = TopicNaming()
+        controls = []
+        svc = BulkWireIngestService(
+            engine, eventlog=log, bus=bus, tenant="t1", naming=naming,
+            control_sink=lambda p, m: controls.append(p))
+        svc.start()
+
+        now = engine.packer.epoch_base_ms
+        parts = [
+            encode_frame(MessageType.MEASUREMENT,
+                         WireCodec.encode_measurement("dev-0", now, "m1", 75.0)),
+            encode_frame(MessageType.MEASUREMENT,
+                         WireCodec.encode_measurement("dev-1", now, "m1", 10.0)),
+            encode_frame(MessageType.REGISTER, b"\x80"),
+            encode_frame(MessageType.MEASUREMENT,
+                         WireCodec.encode_measurement("ghost", now, "m1", 5.0)),
+        ]
+        svc.on_encoded_event_received(b"".join(parts))
+        assert svc._remainder == b""
+        # persisted rows: all 3 hot events (ghost included: log keeps raw)
+        assert log.count("t1") == 3
+        # control frame forwarded re-framed
+        assert len(controls) == 1 and controls[0][:2] == b"SW"
+        # unregistered token published onto the unregistered-device topic
+        topic = bus.topic(naming.inbound_unregistered_device_events("t1"))
+        recs = []
+        for part in topic.partitions:
+            recs.extend(v.decode() for _, _, v, _ in part.read(0, 100))
+        assert recs == ["ghost"]
+        assert engine.batches_processed == 1
+
+
+class TestReviewRegressions:
+    def test_long_token_mirror_integrity(self):
+        from sitewhere_tpu.registry.interning import TokenInterner
+        it = TokenInterner(16)
+        long_tok = "x" * 2000
+        idx = it.intern_batch([long_tok, "short"])
+        assert idx[0] == 1 and idx[1] == 2
+        assert it.lookup(long_tok) == 1            # mirror holds real token
+        assert it.token_of(1) == long_tok
+        assert None not in it._to_index
+        np.testing.assert_array_equal(it.lookup_batch([long_tok]), [1])
+
+    def test_empty_name_lane_parity(self):
+        from sitewhere_tpu.ops.pack import EventPacker
+        from sitewhere_tpu.registry.interning import TokenInterner
+        from sitewhere_tpu.sources.fastlane import FastWireIngest
+        data = encode_frame(MessageType.MEASUREMENT,
+                            WireCodec.encode_measurement("dev-0", 5, "", 1.5))
+        res = []
+        for native in (True, False):
+            devices = TokenInterner(16, "devices")
+            devices.intern("dev-0")
+            p = EventPacker(8, devices, epoch_base_ms=0)
+            lane = FastWireIngest(p)
+            if not native:
+                lane._nat = None
+            r = lane.ingest(data)
+            res.append((int(r.batches[0].mm_idx[0]),
+                        len(p.measurements)))
+        assert res[0] == res[1] == (0, 1)  # UNKNOWN, nothing interned
