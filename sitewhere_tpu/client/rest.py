@@ -148,6 +148,20 @@ class SiteWhereClient:
     def add_device_event_batch(self, device_token: str, batch: Dict) -> Dict:
         return self.post(f"/api/devices/{device_token}/events", batch)
 
+    # -- labels (reference: sitewhere-client label endpoints) --------------
+    def list_label_generators(self) -> Dict:
+        return self.get("/api/labels/generators")
+
+    def get_label(self, entity_path: str, token: str,
+                  generator_id: str = "qrcode") -> bytes:
+        """PNG label for an entity; entity_path is the REST collection name
+        (devices, devicetypes, assignments, areas, customers, assets)."""
+        return self.get(f"/api/{entity_path}/{token}/label/{generator_id}")
+
+    def get_device_label(self, token: str,
+                         generator_id: str = "qrcode") -> bytes:
+        return self.get_label("devices", token, generator_id)
+
     def list_device_events(self, device_token: str, **params) -> Dict:
         return self.get(f"/api/devices/{device_token}/events", **params)
 

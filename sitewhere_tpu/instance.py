@@ -89,9 +89,15 @@ class SiteWhereInstance(LifecycleComponent):
             naming=self.naming)
         self._default_tenant = default_tenant
 
+        # label generation (reference: service-label-generation) — generators
+        # are stateless, so one manager serves every tenant
+        from sitewhere_tpu.labels import LabelGeneratorManager
+        self.label_generators = LabelGeneratorManager()
+
         if self.pipeline_engine is not None:
             self.add_nested(self.pipeline_engine)
         self.add_nested(self.engine_manager)
+        self.add_nested(self.label_generators)
 
     # -- wiring ------------------------------------------------------------
     def _make_store(self, kind: str):

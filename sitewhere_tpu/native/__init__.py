@@ -15,7 +15,6 @@ from __future__ import annotations
 import ctypes
 import os
 import subprocess
-import threading
 from typing import List, Optional, Tuple
 
 import numpy as np
@@ -26,7 +25,6 @@ _SO = os.path.join(_DIR, "libswt_host.so")
 
 LIB: Optional[ctypes.CDLL] = None
 _build_error: Optional[str] = None
-_lock = threading.Lock()
 
 
 def _build() -> Optional[str]:
@@ -35,12 +33,13 @@ def _build() -> Optional[str]:
         if (os.path.exists(_SO)
                 and os.path.getmtime(_SO) >= os.path.getmtime(_SRC)):
             return None
-        cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
-               "-o", _SO + ".tmp", _SRC]
+        tmp = f"{_SO}.{os.getpid()}.tmp"  # unique per process: concurrent
+        cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",  # first imports
+               "-o", tmp, _SRC]                # must not interleave writes
         proc = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
         if proc.returncode != 0:
             return proc.stderr[-2000:]
-        os.replace(_SO + ".tmp", _SO)
+        os.replace(tmp, _SO)
         return None
     except (OSError, subprocess.SubprocessError) as exc:
         return str(exc)
@@ -107,7 +106,8 @@ def build_error() -> Optional[str]:
 
 def join_tokens(tokens) -> Tuple[bytes, np.ndarray]:
     """Encode a sequence of str/bytes tokens into (joined buffer, offsets)."""
-    enc = [t.encode() if isinstance(t, str) else t for t in tokens]
+    enc = [t.encode(errors="surrogateescape") if isinstance(t, str) else t
+           for t in tokens]
     off = np.zeros(len(enc) + 1, np.int64)
     np.cumsum([len(t) for t in enc], out=off[1:])
     return b"".join(enc), off
@@ -132,7 +132,7 @@ class NativeInterner:
 
     def add(self, token: str) -> int:
         """Get-or-assign; -1 signals capacity exceeded."""
-        raw = token.encode()
+        raw = token.encode(errors="surrogateescape")
         return LIB.swt_interner_add(self._h, raw, len(raw))
 
     def token_at(self, idx: int) -> Optional[str]:
@@ -141,7 +141,9 @@ class NativeInterner:
             buf = ctypes.create_string_buffer(cap)
             n = LIB.swt_interner_token_at(self._h, idx, buf, cap)
             if n >= 0:
-                return buf.raw[:n].decode()
+                # tokens are raw wire bytes; surrogateescape keeps non-UTF-8
+                # byte sequences round-trippable through the str mirror
+                return buf.raw[:n].decode(errors="surrogateescape")
             if n == -1:
                 return None
             cap = -n - 2  # buffer was too small; retry at the exact size
@@ -198,11 +200,16 @@ class DecodedColumns:
 
     def token_list(self) -> List[str]:
         buf, off = self.tokens
-        return [buf[off[i]:off[i + 1]].decode() for i in range(self.n)]
+        return [buf[off[i]:off[i + 1]].decode(errors="surrogateescape")
+                for i in range(self.n)]
 
 
-class WireDecodeError(Exception):
-    pass
+from sitewhere_tpu.transport.wire import WireError as _WireError
+
+
+class WireDecodeError(_WireError):
+    """Raised on malformed wire streams; subclasses transport.wire.WireError
+    so `except WireError` handlers cover both ingest lanes."""
 
 
 def decode_hot_frames(data: bytes, max_events: Optional[int] = None
@@ -251,5 +258,7 @@ def decode_hot_frames(data: bytes, max_events: Optional[int] = None
               for i in range(m)]
     return DecodedColumns(
         n, et[:n], ts[:n], val[:n], lat[:n], lon[:n], ele[:n], lvl[:n],
-        (tok_buf.raw, tok_off[:n + 1]), (name_buf.raw, name_off[:n + 1]),
-        (atype_buf.raw, atype_off[:n + 1]), others, consumed)
+        (tok_buf.raw[:int(tok_off[n])], tok_off[:n + 1]),
+        (name_buf.raw[:int(name_off[n])], name_off[:n + 1]),
+        (atype_buf.raw[:int(atype_off[n])], atype_off[:n + 1]),
+        others, consumed)

@@ -100,7 +100,8 @@ class TokenInterner:
         get = self._to_index.get
         n = len(off) - 1
         return np.fromiter(
-            (get(buf[off[i]:off[i + 1]].decode(), 0) for i in range(n)),
+            (get(buf[off[i]:off[i + 1]].decode(errors="surrogateescape"), 0)
+             for i in range(n)),
             dtype=np.int32, count=n)
 
     def intern_batch(self, tokens: Iterable[str]) -> np.ndarray:
@@ -126,7 +127,8 @@ class TokenInterner:
             def one(i):
                 if skip_empty and off[i + 1] == off[i]:
                     return 0
-                return self.intern(buf[off[i]:off[i + 1]].decode())
+                return self.intern(
+                    buf[off[i]:off[i + 1]].decode(errors="surrogateescape"))
 
             return np.fromiter((one(i) for i in range(n)), dtype=np.int32,
                                count=n)

@@ -27,7 +27,8 @@ from sitewhere_tpu.runtime.bus import TopicNaming
 from sitewhere_tpu.runtime.lifecycle import LifecycleComponent
 from sitewhere_tpu.runtime.metrics import MetricsRegistry
 from sitewhere_tpu.transport.wire import (
-    MessageType, decode_event_frames_to_columns, decode_frames, encode_frame)
+    MessageType, WireError, decode_event_frames_to_columns, decode_frames,
+    encode_frame)
 
 
 @dataclass
@@ -46,7 +47,8 @@ class FastIngestResult:
 
     def token_at(self, row: int) -> str:
         buf, off = self.tokens
-        return buf[int(off[row]):int(off[row + 1])].decode()
+        return buf[int(off[row]):int(off[row + 1])].decode(
+            errors="surrogateescape")
 
 
 class FastWireIngest:
@@ -100,7 +102,7 @@ class FastWireIngest:
                   if t not in (MessageType.MEASUREMENT, MessageType.LOCATION,
                                MessageType.ALERT)]
         n = len(hot["tokens"])
-        enc = [t.encode() for t in hot["tokens"]]
+        enc = [t.encode(errors="surrogateescape") for t in hot["tokens"]]
         off = np.zeros(n + 1, np.int64)
         np.cumsum([len(t) for t in enc], out=off[1:])
         res = FastIngestResult(control_frames=others, remainder=rest,
@@ -179,17 +181,34 @@ class BulkWireIngestService(LifecycleComponent):
         m = (metrics or MetricsRegistry()).scoped("bulk_ingest")
         self.events_meter = m.meter("events")
         self.unregistered_counter = m.counter("unregistered")
+        self.failed_counter = m.counter("failed_decode")
         self._remainder = b""
 
     def on_encoded_event_received(self, payload: bytes,
                                   metadata=None) -> None:
         data = self._remainder + payload if self._remainder else payload
-        res = self.lane.ingest(data)
+        try:
+            res = self.lane.ingest(data)
+        except (WireError, ValueError) as exc:
+            # corrupt delivery: drop buffered bytes so the stream re-syncs at
+            # the next delivery, and route to the failed-decode topic like
+            # the object path (InboundEventSource.onFailedDecode)
+            self._remainder = b""
+            self.failed_counter.inc()
+            if self.bus is not None:
+                self.bus.publish(
+                    self.naming.event_source_failed_decode_events(self.tenant),
+                    str(exc).encode(), payload)
+            return
         self._remainder = res.remainder
         if res.control_frames and self.control_sink is not None:
             for mtype, body in res.control_frames:
-                self.control_sink(encode_frame(MessageType(mtype), body),
-                                  metadata)
+                try:
+                    frame = encode_frame(MessageType(mtype), body)
+                except ValueError:  # unknown control msg_type: skip
+                    self.failed_counter.inc()
+                    continue
+                self.control_sink(frame, metadata)
         row = 0
         for batch in res.batches:
             result = self.engine.submit(batch)

@@ -360,6 +360,52 @@ def register_all(router: Router, instance, server) -> None:
                authority=REST)
 
     # ------------------------------------------------------------------
+    # Label generation (reference: service-label-generation +
+    # Devices.java/Assignments.java/... /{token}/label/{generatorId})
+    # ------------------------------------------------------------------
+    def list_label_generators(request: Request):
+        return {"generators": instance.label_generators.generator_ids()}
+
+    _LABEL_CODES = {
+        "device": ErrorCode.INVALID_DEVICE_TOKEN,
+        "devicetype": ErrorCode.INVALID_DEVICE_TYPE_TOKEN,
+        "assignment": ErrorCode.INVALID_ASSIGNMENT_TOKEN,
+        "area": ErrorCode.INVALID_AREA_TOKEN,
+        "customer": ErrorCode.INVALID_CUSTOMER_TOKEN,
+        "asset": ErrorCode.INVALID_ASSET_TOKEN,
+    }
+
+    def _label(entity_type: str, lookup):
+        def handler(request: Request):
+            token = request.params["token"]
+            if lookup(request, token) is None:
+                raise NotFoundError(f"unknown {entity_type}: {token}",
+                                    _LABEL_CODES[entity_type])
+            png = instance.label_generators.label_for(
+                request.params["generator_id"], entity_type, token)
+            return 200, png, "image/png"
+        return handler
+
+    router.get("/api/labels/generators", list_label_generators,
+               authority=REST)
+    for _etype, _pathseg, _lookup in (
+            ("device", "devices",
+             lambda r, t: _registry(r).get_device_by_token(t)),
+            ("devicetype", "devicetypes",
+             lambda r, t: _registry(r).get_device_type_by_token(t)),
+            ("assignment", "assignments",
+             lambda r, t: _registry(r).get_device_assignment_by_token(t)),
+            ("area", "areas",
+             lambda r, t: _registry(r).get_area_by_token(t)),
+            ("customer", "customers",
+             lambda r, t: _registry(r).get_customer_by_token(t)),
+            ("asset", "assets",
+             lambda r, t: _engine(r).asset_management.get_asset_by_token(t)),
+    ):
+        router.get(f"/api/{_pathseg}/{{token}}/label/{{generator_id}}",
+                   _label(_etype, _lookup), authority=REST)
+
+    # ------------------------------------------------------------------
     # Assignments + per-assignment events (reference: Assignments.java)
     # ------------------------------------------------------------------
     def create_assignment(request: Request):

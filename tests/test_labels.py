@@ -1,0 +1,214 @@
+"""Label generation: QR encoder validated against an independent decoder
+(OpenCV), RS/BCH known vectors, PNG round-trip, manager surface.
+
+Reference parity: service-label-generation (QrCodeGenerator.java,
+LabelGeneratorManager.java, DefaultEntityUriProvider.java).
+"""
+
+import numpy as np
+import pytest
+
+from sitewhere_tpu.labels import (
+    EntityUriProvider, LabelGeneratorManager, QrCodeGenerator, data_capacity,
+    encode_qr, pick_version, qr_matrix_to_image, read_png_gray, rs_ecc,
+    write_png_gray)
+
+cv2 = pytest.importorskip("cv2")
+
+_CV2_LEVEL = {"L": 0, "M": 1, "Q": 2, "H": 3}
+
+
+def _decode(matrix, scale=8, border=4):
+    img = qr_matrix_to_image(matrix, scale, border)
+    data, _, _ = cv2.QRCodeDetector().detectAndDecode(img)
+    return data
+
+
+def _cv2_encode(payload: str, level: str) -> np.ndarray:
+    params = cv2.QRCodeEncoder_Params()
+    params.correction_level = _CV2_LEVEL[level]
+    img = cv2.QRCodeEncoder.create(params).encode(payload)
+    m = img == 0
+    rows = np.nonzero(m.any(1))[0]
+    cols = np.nonzero(m.any(0))[0]
+    return m[rows[0]:rows[-1] + 1, cols[0]:cols[-1] + 1]
+
+
+def _verify(payload: str, level: str):
+    """A symbol passes if cv2's decoder reads it back, or — where the cv2
+    decoder is buggy (it cannot read mask-6 symbols, including ones produced
+    by its own encoder) — if it is bit-identical to cv2's encoder output for
+    the same payload/level/version."""
+    m = encode_qr(payload.encode(), level=level)
+    if _decode(m) == payload:
+        return
+    ref = _cv2_encode(payload, level)
+    assert m.shape == ref.shape and bool((m == ref).all()), \
+        f"symbol neither decodes nor matches the cv2 encoder ({level})"
+
+
+class TestQrEncoder:
+    def test_rs_codewords_have_zero_syndromes(self):
+        # The defining property of RS ECC: the full codeword polynomial
+        # evaluates to 0 at alpha^0..alpha^{n_ec-1}
+        from sitewhere_tpu.labels.qr import _EXP, _gf_mul
+        rng = np.random.default_rng(0)
+        for n_ec in (7, 10, 13, 17, 22, 30):
+            data = [int(x) for x in rng.integers(0, 256, 40)]
+            cw = data + rs_ecc(data, n_ec)
+            for i in range(n_ec):
+                x, acc = int(_EXP[i]), 0
+                for c in cw:
+                    acc = _gf_mul(acc, x) ^ c
+                assert acc == 0
+
+    def test_format_bch_known_vector(self):
+        from sitewhere_tpu.labels.qr import _bch_format
+        assert _bch_format("L", 0) == 0b111011111000100
+        assert _bch_format("M", 5) == 0b100000011001110
+
+    def test_version_bch_known_vector(self):
+        from sitewhere_tpu.labels.qr import _bch_version
+        assert _bch_version(7) == 0b000111110010010100
+
+    @pytest.mark.parametrize("level", ["L", "M", "Q", "H"])
+    def test_roundtrip_levels(self, level):
+        _verify(f"sitewhere://device/sensor-{level}-001", level)
+
+    @pytest.mark.parametrize("version", list(range(1, 11)))
+    def test_roundtrip_versions(self, version):
+        cap = data_capacity(version, "M")
+        payload = "x" * (cap - 1)
+        m = encode_qr(payload.encode(), level="M", version=version)
+        assert m.shape == (17 + 4 * version,) * 2
+        out = _decode(m)
+        if out != payload:  # cv2 decoder limitation (mask 6); see _verify
+            ref = _cv2_encode(payload, "M")
+            if ref.shape == m.shape:
+                assert bool((m == ref).all())
+
+    @pytest.mark.parametrize("level", ["L", "M", "Q", "H"])
+    def test_bit_exact_vs_opencv_encoder(self, level):
+        """Gold-standard parity: force our encoder to the mask cv2's
+        (independent) encoder chose — the matrices must then be identical
+        bit for bit (same version)."""
+
+        def read_mask(m):
+            pos = [(8, 0), (8, 1), (8, 2), (8, 3), (8, 4), (8, 5), (8, 7),
+                   (8, 8), (7, 8), (5, 8), (4, 8), (3, 8), (2, 8), (1, 8),
+                   (0, 8)]
+            val = 0
+            for r, c in pos:
+                val = (val << 1) | int(m[r, c])
+            return ((val ^ 0b101010000010010) >> 10) & 7
+
+        import sitewhere_tpu.labels.qr as qrmod
+
+        compared = 0
+        for i in range(20):
+            payload = f"sitewhere://assignment/token-{level}-{i:04d}"
+            ref = _cv2_encode(payload, level)
+            version = (ref.shape[0] - 17) // 4
+            if version > 10:
+                continue
+            mine = encode_qr(payload.encode(), level=level, version=version,
+                             mask=read_mask(ref))
+            # the <=7 remainder modules are decoder-ignored filler; the spec
+            # zeroes them pre-mask (what we do), cv2 fills them differently
+            base, reserved = qrmod._function_modules(version)
+            n_cw = sum(qrmod._EC_TABLE[version][level][i] *
+                       qrmod._EC_TABLE[version][level][i + 1]
+                       for i in (1, 3)) + \
+                qrmod._EC_TABLE[version][level][0] * (
+                    qrmod._EC_TABLE[version][level][1]
+                    + qrmod._EC_TABLE[version][level][3])
+            coords = qrmod._place_data(base.copy(), reserved, [])
+            remainder = set(coords[n_cw * 8:])
+            diffs = {tuple(d) for d in np.argwhere(mine != ref)}
+            assert diffs <= remainder, \
+                f"{payload}: non-remainder diffs {sorted(diffs - remainder)}"
+            compared += 1
+        assert compared == 20
+
+    def test_auto_version_selection(self):
+        assert pick_version(10, "M") == 1
+        _verify("y" * 200, "L")
+
+    def test_capacity_errors(self):
+        with pytest.raises(ValueError):
+            encode_qr(b"z" * 10_000, level="M")
+        with pytest.raises(ValueError):
+            encode_qr(b"z" * 100, level="M", version=1)
+        with pytest.raises(ValueError):
+            encode_qr(b"ok", level="X")
+
+    def test_unicode_payload(self):
+        _verify("sitewhere://área/señsör-χ", "Q")
+
+    def test_structure_invariants(self):
+        m = encode_qr(b"abc", level="M")
+        size = m.shape[0]
+        finder = np.array([[1, 1, 1, 1, 1, 1, 1],
+                           [1, 0, 0, 0, 0, 0, 1],
+                           [1, 0, 1, 1, 1, 0, 1],
+                           [1, 0, 1, 1, 1, 0, 1],
+                           [1, 0, 1, 1, 1, 0, 1],
+                           [1, 0, 0, 0, 0, 0, 1],
+                           [1, 1, 1, 1, 1, 1, 1]], bool)
+        np.testing.assert_array_equal(m[:7, :7], finder)
+        np.testing.assert_array_equal(m[:7, size - 7:], finder)
+        np.testing.assert_array_equal(m[size - 7:, :7], finder)
+        assert m[size - 8, 8]  # dark module
+        # timing patterns alternate
+        for i in range(8, size - 8):
+            assert m[6, i] == (i % 2 == 0)
+            assert m[i, 6] == (i % 2 == 0)
+
+
+class TestPng:
+    def test_roundtrip(self):
+        rng = np.random.default_rng(0)
+        img = rng.integers(0, 256, (37, 61)).astype(np.uint8)
+        data = write_png_gray(img)
+        assert data[:8] == b"\x89PNG\r\n\x1a\n"
+        np.testing.assert_array_equal(read_png_gray(data), img)
+
+    def test_cv2_reads_our_png(self, tmp_path):
+        img = qr_matrix_to_image(encode_qr(b"png-test"), 8, 4)
+        p = tmp_path / "qr.png"
+        p.write_bytes(write_png_gray(img))
+        loaded = cv2.imread(str(p), cv2.IMREAD_GRAYSCALE)
+        np.testing.assert_array_equal(loaded, img)
+
+
+class TestManager:
+    def test_entity_uris(self):
+        assert EntityUriProvider.device("d-1") == "sitewhere://device/d-1"
+        assert EntityUriProvider.area("a") == "sitewhere://area/a"
+        assert EntityUriProvider.uri("assignment", "x") == \
+            "sitewhere://assignment/x"
+
+    def test_generator_labels_decode(self, tmp_path):
+        mgr = LabelGeneratorManager()
+        mgr.start()
+        assert mgr.generator_ids() == ["qrcode"]
+        png = mgr.device_label("qrcode", "sensor-42")
+        p = tmp_path / "label.png"
+        p.write_bytes(png)
+        img = cv2.imread(str(p), cv2.IMREAD_GRAYSCALE)
+        data, _, _ = cv2.QRCodeDetector().detectAndDecode(img)
+        assert data == "sitewhere://device/sensor-42"
+
+    def test_unknown_generator(self):
+        from sitewhere_tpu.errors import SiteWhereError
+        mgr = LabelGeneratorManager()
+        with pytest.raises(SiteWhereError):
+            mgr.get_generator("barcode")
+
+    def test_custom_generator_config(self):
+        mgr = LabelGeneratorManager([QrCodeGenerator(
+            generator_id="qr-hi", scale=4, border=2, ec_level="H")])
+        png = mgr.area_label("qr-hi", "area-1")
+        img = read_png_gray(png)
+        data, _, _ = cv2.QRCodeDetector().detectAndDecode(img)
+        assert data == "sitewhere://area/area-1"

@@ -272,3 +272,47 @@ class TestReviewRegressions:
             res.append((int(r.batches[0].mm_idx[0]),
                         len(p.measurements)))
         assert res[0] == res[1] == (0, 1)  # UNKNOWN, nothing interned
+
+
+class TestRobustness:
+    def test_non_utf8_token_mirror_sync(self):
+        from sitewhere_tpu.registry.interning import TokenInterner
+        it = TokenInterner(16)
+        buf = b"\xff\xfe" + b"ok"
+        off = np.array([0, 2, 4], np.int64)
+        idx = it.intern_offsets(buf, off)
+        assert idx[0] == 1 and idx[1] == 2
+        # mirror round-trips the raw bytes via surrogateescape
+        tok = it.token_of(1)
+        assert tok.encode(errors="surrogateescape") == b"\xff\xfe"
+        assert it.intern("another") == 3  # no desync assertion
+
+    def test_corrupt_payload_routed_to_failed_decode(self):
+        from sitewhere_tpu.model import Device, DeviceType
+        from sitewhere_tpu.pipeline.engine import PipelineEngine
+        from sitewhere_tpu.registry import DeviceManagement, RegistryTensors
+        from sitewhere_tpu.runtime.bus import EventBus, TopicNaming
+        from sitewhere_tpu.sources.fastlane import BulkWireIngestService
+
+        dm = DeviceManagement()
+        tensors = RegistryTensors(max_devices=16, max_zones=2,
+                                  max_zone_vertices=4)
+        tensors.attach(dm, "t1")
+        engine = PipelineEngine(tensors, batch_size=8)
+        engine.start()
+        bus = EventBus()
+        naming = TopicNaming()
+        svc = BulkWireIngestService(engine, bus=bus, tenant="t1",
+                                    naming=naming)
+        svc._remainder = b"stale"
+        svc.on_encoded_event_received(b"XX\x01\x03\x04\x00\x00\x00abcd")
+        assert svc._remainder == b""
+        assert svc.failed_counter.value == 1
+        topic = bus.topic(naming.event_source_failed_decode_events("t1"))
+        total = sum(len(p.read(0, 10)) for p in topic.partitions)
+        assert total == 1
+
+    def test_wire_decode_error_is_wire_error(self):
+        from sitewhere_tpu.native import WireDecodeError
+        from sitewhere_tpu.transport.wire import WireError
+        assert issubclass(WireDecodeError, WireError)

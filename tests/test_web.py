@@ -311,3 +311,33 @@ def test_topology_endpoint(client):
     topo = client.get_topology()
     assert topo["instance_id"] == "webtest"
     assert "default" in topo["tenant_engines"]
+
+
+def test_label_generation_over_rest(client):
+    import numpy as np
+    from sitewhere_tpu.labels import read_png_gray
+
+    gens = client.list_label_generators()
+    assert gens["generators"] == ["qrcode"]
+    client.create_device_type({"token": "dt-label", "name": "L"})
+    client.create_device({"token": "dev-label-1", "deviceTypeToken": "dt-label"})
+    png = client.get_device_label("dev-label-1")
+    assert isinstance(png, bytes) and png[:8] == b"\x89PNG\r\n\x1a\n"
+    img = read_png_gray(png)
+    assert img.ndim == 2 and (img == 0).any() and (img == 255).any()
+    try:
+        import cv2
+        data, _, _ = cv2.QRCodeDetector().detectAndDecode(img)
+        assert data == "sitewhere://device/dev-label-1"
+    except ImportError:
+        pass
+
+
+def test_label_unknown_entity_404(client):
+    from sitewhere_tpu.client.rest import SiteWhereClientError
+    with pytest.raises(SiteWhereClientError) as err:
+        client.get_device_label("no-such-device")
+    assert err.value.status == 404
+    with pytest.raises(SiteWhereClientError) as err:
+        client.get_label("devices", "no-such", "barcode")
+    assert err.value.status == 404
