@@ -90,7 +90,8 @@ class WindowedAnalyticsEngine:
                             end_ms: Optional[int] = None,
                             area_id: Optional[str] = None,
                             max_windows: int = 4096,
-                            with_type_histogram: bool = False
+                            with_type_histogram: bool = False,
+                            mesh=None, combine: str = "psum"
                             ) -> WindowReport:
         """Per-device windowed stats over measurement values.
 
@@ -114,7 +115,8 @@ class WindowedAnalyticsEngine:
             max_windows=max_windows,
             hist_cols=(self.event_log.query_columns(
                 tenant, all_flt, ["event_type", "event_date"])
-                if all_flt is not None else None))
+                if all_flt is not None else None),
+            mesh=mesh, combine=combine)
 
     @staticmethod
     def _build_report(key_raw: np.ndarray, event_date: np.ndarray,
@@ -122,7 +124,8 @@ class WindowedAnalyticsEngine:
                       start_ms: Optional[int], end_ms: Optional[int],
                       max_windows: int,
                       hist_cols: Optional[Dict[str, np.ndarray]] = None,
-                      tokens: Optional[List[str]] = None) -> WindowReport:
+                      tokens: Optional[List[str]] = None,
+                      mesh=None, combine: str = "psum") -> WindowReport:
         n = len(event_date)
         # Windows are derived from whatever rows exist — measurement rows
         # normally, histogram rows when the measurement filter matched none
@@ -156,8 +159,17 @@ class WindowedAnalyticsEngine:
 
         K = _pad_pow2(max(len(uniq), 1))
         W = _pad_pow2(int(n_windows))
-        stats = windowed_stats(dense, buckets(event_date), value, valid,
-                               window_ms=1, num_keys=K, n_windows=W)
+        if mesh is not None:
+            # window-sharded replay across the mesh (the stream analog of
+            # sequence/context parallelism — parallel/distributed.py)
+            from sitewhere_tpu.parallel.distributed import (
+                sharded_windowed_stats)
+            stats = sharded_windowed_stats(
+                dense, buckets(event_date), value, valid, window_ms=1,
+                num_keys=K, n_windows=W, mesh=mesh, combine=combine)
+        else:
+            stats = windowed_stats(dense, buckets(event_date), value, valid,
+                                   window_ms=1, num_keys=K, n_windows=W)
         type_counts = None
         if hist_cols is not None and len(hist_cols["event_date"]):
             h_dates = hist_cols["event_date"]

@@ -115,11 +115,27 @@ def register_all(router: Router, instance, server) -> None:
     def get_metrics(request: Request):
         return instance.metrics.snapshot()
 
+    def get_configuration_model(request: Request):
+        from sitewhere_tpu.runtime.config_model import (
+            instance_configuration_model)
+        return instance_configuration_model()
+
+    def validate_configuration(request: Request):
+        from sitewhere_tpu.runtime.config_model import validate_config
+        issues = validate_config(_body(request))
+        return {"valid": not issues,
+                "issues": [i.to_json() for i in issues]}
+
     router.get("/api/system/version", get_version, authority=REST)
     router.get("/api/instance/topology", get_topology,
                authority=SiteWhereRoles.VIEW_SERVER_INFO)
     router.get("/api/instance/metrics", get_metrics,
                authority=SiteWhereRoles.VIEW_SERVER_INFO)
+    router.get("/api/instance/configuration/model", get_configuration_model,
+               authority=SiteWhereRoles.VIEW_SERVER_INFO)
+    router.post("/api/instance/configuration/validate",
+                validate_configuration,
+                authority=SiteWhereRoles.VIEW_SERVER_INFO)
 
     # ------------------------------------------------------------------
     # Users + authorities (reference: Users.java, Authorities.java)
