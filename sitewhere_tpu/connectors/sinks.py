@@ -86,6 +86,14 @@ class ScriptedConnector(OutboundConnector):
         super().__init__(connector_id, filters)
         self.script = script
 
+    @classmethod
+    def from_manager(cls, connector_id: str, manager, script_id: str,
+                     scope: str = "global", entry: str = "process",
+                     filters=None) -> "ScriptedConnector":
+        """Bind to a managed script's active version (runtime/scripts.py)."""
+        return cls(connector_id, manager.resolve(scope, script_id, entry),
+                   filters=filters)
+
     def process_batch(self, batch) -> None:
         for context, event in batch:
             self.script(context, event)

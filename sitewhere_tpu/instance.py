@@ -94,10 +94,16 @@ class SiteWhereInstance(LifecycleComponent):
         from sitewhere_tpu.labels import LabelGeneratorManager
         self.label_generators = LabelGeneratorManager()
 
+        # versioned user scripts (reference: Groovy scripting + ZK script
+        # management), synced under data_dir when persistent
+        from sitewhere_tpu.runtime.scripts import ScriptManager
+        self.script_manager = ScriptManager(data_dir=self.data_dir)
+
         if self.pipeline_engine is not None:
             self.add_nested(self.pipeline_engine)
         self.add_nested(self.engine_manager)
         self.add_nested(self.label_generators)
+        self.add_nested(self.script_manager)
 
     # -- wiring ------------------------------------------------------------
     def _make_store(self, kind: str):

@@ -1,0 +1,320 @@
+"""Script management: named, versioned user scripts with live activation.
+
+Reference: the Groovy scripting stack — GroovyComponent.java:32 (script
+host), ScriptSynchronizer.java (ZK -> local-disk sync),
+ZookeeperScriptManagement.java (versioned script storage), and the REST
+surface at Instance.java:304-560 (create/list scripts, versioned content,
+clone, activate, delete; global and per-tenant scopes).
+
+The TPU rebuild keeps the shape but swaps Groovy for Python source: a script
+is versioned text whose ACTIVE version is compiled into a module namespace;
+`resolve(scope, id, entry)` hands components a stable proxy callable that
+always dispatches to the active version, so activating a new version
+hot-swaps behavior without rebinding decoders/connectors (the reference
+restarts components on ZK script-change events; the proxy makes that
+unnecessary). With a data_dir, scripts sync to disk as .py + meta.json and
+reload on start (the ScriptSynchronizer role).
+
+Scripts are an operator extension point: like Groovy in the reference they
+execute with full interpreter privileges — deployment trust model, not a
+sandbox.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import re
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+from sitewhere_tpu.errors import ErrorCode, SiteWhereError
+from sitewhere_tpu.runtime.lifecycle import LifecycleComponent
+
+GLOBAL_SCOPE = "global"
+LOGGER = logging.getLogger("sitewhere.scripts")
+# filesystem- and route-safe: single path segment, no traversal
+_ID_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9._-]*$")
+
+
+@dataclass
+class ScriptVersion:
+    version_id: str
+    comment: str = ""
+    created_ms: int = 0
+
+    def to_json(self) -> Dict[str, Any]:
+        return {"versionId": self.version_id, "comment": self.comment,
+                "createdDate": self.created_ms}
+
+
+@dataclass
+class ScriptInfo:
+    script_id: str
+    name: str = ""
+    description: str = ""
+    active_version: Optional[str] = None
+    versions: List[ScriptVersion] = field(default_factory=list)
+
+    def to_json(self) -> Dict[str, Any]:
+        return {"scriptId": self.script_id, "name": self.name,
+                "description": self.description,
+                "activeVersion": self.active_version,
+                "versions": [v.to_json() for v in self.versions]}
+
+
+class _ScriptProxy:
+    """Stable callable bound to (manager, scope, script_id, entry): always
+    dispatches to the active version's compiled namespace."""
+
+    def __init__(self, manager: "ScriptManager", scope: str, script_id: str,
+                 entry: str):
+        self._m = manager
+        self._key = (scope, script_id)
+        self._entry = entry
+
+    def __call__(self, *args, **kwargs):
+        fn = self._m._active_entry(self._key, self._entry)
+        return fn(*args, **kwargs)
+
+
+class ScriptManager(LifecycleComponent):
+    """Versioned script registry, scoped (GLOBAL_SCOPE or a tenant token)."""
+
+    def __init__(self, data_dir: Optional[str] = None):
+        super().__init__("script-manager")
+        self._data_dir = data_dir
+        self._lock = threading.RLock()
+        # (scope, script_id) -> ScriptInfo
+        self._scripts: Dict[tuple, ScriptInfo] = {}
+        # (scope, script_id, version_id) -> source text
+        self._content: Dict[tuple, str] = {}
+        # (scope, script_id) -> compiled namespace of the active version
+        self._namespaces: Dict[tuple, Dict[str, Any]] = {}
+
+    # -- lifecycle / disk sync ---------------------------------------------
+
+    def on_start(self, monitor) -> None:
+        if self._data_dir:
+            self._load_from_disk()
+
+    def _scope_dir(self, scope: str) -> str:
+        return os.path.join(self._data_dir, "scripts",
+                            scope.replace("/", "_"))
+
+    def _sync_to_disk(self, scope: str, info: ScriptInfo) -> None:
+        if not self._data_dir:
+            return
+        d = os.path.join(self._scope_dir(scope), info.script_id)
+        os.makedirs(d, exist_ok=True)
+        # versions first, meta last, each atomically: a crash can leave
+        # stray .py files but never a meta.json naming a missing version
+        for v in info.versions:
+            path = os.path.join(d, f"{v.version_id}.py")
+            if not os.path.exists(path):
+                self._atomic_write(
+                    path, self._content[(scope, info.script_id,
+                                         v.version_id)])
+        self._atomic_write(os.path.join(d, "meta.json"),
+                           json.dumps({"scope": scope, **info.to_json()}))
+
+    @staticmethod
+    def _atomic_write(path: str, content: str) -> None:
+        tmp = f"{path}.{os.getpid()}.tmp"
+        with open(tmp, "w") as fh:
+            fh.write(content)
+        os.replace(tmp, path)
+
+    def _load_from_disk(self) -> None:
+        root = os.path.join(self._data_dir, "scripts")
+        if not os.path.isdir(root):
+            return
+        for scope_name in os.listdir(root):
+            scope_dir = os.path.join(root, scope_name)
+            for script_id in os.listdir(scope_dir):
+                try:
+                    self._load_one(scope_name, scope_dir, script_id)
+                except Exception:
+                    # one corrupt script directory must not block startup
+                    LOGGER.exception("skipping unreadable script %s/%s",
+                                     scope_name, script_id)
+
+    def _load_one(self, scope_name: str, scope_dir: str,
+                  script_id: str) -> None:
+        meta_path = os.path.join(scope_dir, script_id, "meta.json")
+        if not os.path.exists(meta_path):
+            return
+        with open(meta_path) as fh:
+            meta = json.load(fh)
+        scope = meta.get("scope", scope_name)
+        info = ScriptInfo(
+            script_id=meta["scriptId"], name=meta.get("name", ""),
+            description=meta.get("description", ""),
+            active_version=meta.get("activeVersion"),
+            versions=[ScriptVersion(v["versionId"], v.get("comment", ""),
+                                    v.get("createdDate", 0))
+                      for v in meta.get("versions", [])])
+        key = (scope, info.script_id)
+        for v in info.versions:
+            path = os.path.join(scope_dir, script_id, f"{v.version_id}.py")
+            with open(path) as fh:
+                self._content[key + (v.version_id,)] = fh.read()
+        if info.active_version:
+            self._compile(key, info.active_version)
+        self._scripts[key] = info  # registered only after a clean load
+
+    # -- CRUD ---------------------------------------------------------------
+
+    def create_script(self, scope: str, script_id: str, content: str,
+                      name: str = "", description: str = "",
+                      activate: bool = True) -> ScriptInfo:
+        if not _ID_RE.match(script_id):
+            raise SiteWhereError(
+                f"invalid script id {script_id!r}: must match "
+                f"{_ID_RE.pattern}", http_status=400)
+        with self._lock:
+            key = (scope, script_id)
+            if key in self._scripts:
+                raise SiteWhereError(f"script '{script_id}' already exists",
+                                     ErrorCode.DUPLICATE_TOKEN)
+            if activate:
+                self._check_compiles(key, content)  # before registering
+            info = ScriptInfo(script_id=script_id, name=name or script_id,
+                              description=description)
+            self._scripts[key] = info
+            version = self._add_version_locked(key, content, "initial")
+            if activate:
+                self._activate_locked(key, version.version_id)
+            self._sync_to_disk(scope, info)
+            return info
+
+    def list_scripts(self, scope: str) -> List[ScriptInfo]:
+        with self._lock:
+            return [i for (s, _), i in sorted(self._scripts.items())
+                    if s == scope]
+
+    def get_script(self, scope: str, script_id: str) -> ScriptInfo:
+        info = self._scripts.get((scope, script_id))
+        if info is None:
+            raise SiteWhereError(f"unknown script '{script_id}'",
+                                 ErrorCode.GENERIC, http_status=404)
+        return info
+
+    def delete_script(self, scope: str, script_id: str) -> None:
+        with self._lock:
+            info = self.get_script(scope, script_id)
+            key = (scope, script_id)
+            del self._scripts[key]
+            self._namespaces.pop(key, None)
+            for v in info.versions:
+                self._content.pop(key + (v.version_id,), None)
+            if self._data_dir:
+                d = os.path.join(self._scope_dir(scope), script_id)
+                if os.path.isdir(d):
+                    for f in os.listdir(d):
+                        os.unlink(os.path.join(d, f))
+                    os.rmdir(d)
+
+    # -- versions -----------------------------------------------------------
+
+    def _add_version_locked(self, key: tuple, content: str,
+                            comment: str) -> ScriptVersion:
+        info = self._scripts[key]
+        version = ScriptVersion(
+            version_id=f"v{len(info.versions) + 1}", comment=comment,
+            created_ms=int(time.time() * 1000))
+        info.versions.append(version)
+        self._content[key + (version.version_id,)] = content
+        return version
+
+    def add_version(self, scope: str, script_id: str, content: str,
+                    comment: str = "", activate: bool = False
+                    ) -> ScriptVersion:
+        with self._lock:
+            info = self.get_script(scope, script_id)
+            key = (scope, script_id)
+            version = self._add_version_locked(key, content, comment)
+            if activate:
+                self._activate_locked(key, version.version_id)
+            self._sync_to_disk(scope, info)
+            return version
+
+    def clone_version(self, scope: str, script_id: str, version_id: str,
+                      comment: str = "") -> ScriptVersion:
+        with self._lock:
+            content = self.get_content(scope, script_id, version_id)
+            return self.add_version(scope, script_id, content,
+                                    comment or f"clone of {version_id}")
+
+    def get_content(self, scope: str, script_id: str,
+                    version_id: Optional[str] = None) -> str:
+        info = self.get_script(scope, script_id)
+        vid = version_id or info.active_version
+        content = self._content.get((scope, script_id, vid))
+        if content is None:
+            raise SiteWhereError(f"unknown version '{vid}'",
+                                 ErrorCode.GENERIC, http_status=404)
+        return content
+
+    # -- activation / execution --------------------------------------------
+
+    @staticmethod
+    def _check_compiles(key: tuple, source: str) -> None:
+        try:
+            compile(source, f"<script {key[1]}>", "exec")
+        except SyntaxError as exc:
+            raise SiteWhereError(f"script does not compile: {exc}",
+                                 http_status=400) from exc
+
+    def _compile(self, key: tuple, version_id: str) -> Dict[str, Any]:
+        source = self._content[key + (version_id,)]
+        namespace: Dict[str, Any] = {"__name__":
+                                     f"swtpu_script_{key[1]}_{version_id}"}
+        try:
+            code = compile(source, f"<script {key[1]}:{version_id}>", "exec")
+            exec(code, namespace)  # operator extension point (see module doc)
+        except SiteWhereError:
+            raise
+        except Exception as exc:
+            raise SiteWhereError(
+                f"script '{key[1]}:{version_id}' failed to load: {exc}",
+                http_status=400) from exc
+        self._namespaces[key] = namespace
+        return namespace
+
+    def _activate_locked(self, key: tuple, version_id: str) -> None:
+        info = self._scripts[key]
+        if version_id not in {v.version_id for v in info.versions}:
+            raise SiteWhereError(f"unknown version '{version_id}'",
+                                 ErrorCode.GENERIC, http_status=404)
+        self._compile(key, version_id)  # compile FIRST: bad scripts do not
+        info.active_version = version_id  # replace a working active version
+
+    def activate_version(self, scope: str, script_id: str,
+                         version_id: str) -> ScriptInfo:
+        with self._lock:
+            info = self.get_script(scope, script_id)
+            self._activate_locked((scope, script_id), version_id)
+            self._sync_to_disk(scope, info)
+            return info
+
+    def _active_entry(self, key: tuple, entry: str) -> Callable:
+        ns = self._namespaces.get(key)
+        if ns is None:
+            raise SiteWhereError(
+                f"script '{key[1]}' has no active version", ErrorCode.GENERIC)
+        fn = ns.get(entry)
+        if not callable(fn):
+            raise SiteWhereError(
+                f"script '{key[1]}' defines no callable '{entry}'",
+                ErrorCode.GENERIC)
+        return fn
+
+    def resolve(self, scope: str, script_id: str, entry: str) -> Callable:
+        """A stable callable dispatching to the ACTIVE version's `entry`
+        function — survives later activations (hot swap)."""
+        self.get_script(scope, script_id)  # fail fast on unknown id
+        return _ScriptProxy(self, scope, script_id, entry)

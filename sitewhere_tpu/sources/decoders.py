@@ -211,6 +211,13 @@ class ScriptedDecoder:
                                     List[DecodedRequest]]):
         self.fn = fn
 
+    @classmethod
+    def from_manager(cls, manager, script_id: str, scope: str = "global",
+                     entry: str = "decode") -> "ScriptedDecoder":
+        """Bind to a managed script's active version (hot-swaps on
+        activation — runtime/scripts.py)."""
+        return cls(manager.resolve(scope, script_id, entry))
+
     def decode(self, payload: bytes,
                metadata: Optional[Dict[str, str]] = None
                ) -> List[DecodedRequest]:

@@ -126,6 +126,13 @@ def register_all(router: Router, instance, server) -> None:
         return {"valid": not issues,
                 "issues": [i.to_json() for i in issues]}
 
+    def get_openapi(request: Request):
+        import sitewhere_tpu
+        from sitewhere_tpu.web.openapi import generate_openapi
+        return generate_openapi(router, version=sitewhere_tpu.__version__)
+
+    # unauthenticated like the reference's swagger endpoint
+    router.get("/api/openapi.json", get_openapi, auth=False)
     router.get("/api/system/version", get_version, authority=REST)
     router.get("/api/instance/topology", get_topology,
                authority=SiteWhereRoles.VIEW_SERVER_INFO)
@@ -136,6 +143,82 @@ def register_all(router: Router, instance, server) -> None:
     router.post("/api/instance/configuration/validate",
                 validate_configuration,
                 authority=SiteWhereRoles.VIEW_SERVER_INFO)
+
+    # ------------------------------------------------------------------
+    # Script management (reference: Instance.java:304-560 scripting rpcs,
+    # global + per-tenant scopes)
+    # ------------------------------------------------------------------
+    def _register_script_routes(prefix: str, scope_of) -> None:
+        sm = instance.script_manager
+        ADMIN = SiteWhereRoles.ADMINISTER_TENANTS
+
+        def list_scripts(request: Request):
+            return {"scripts": [i.to_json() for i in
+                                sm.list_scripts(scope_of(request))]}
+
+        def create_script(request: Request):
+            body = _body(request)
+            info = sm.create_script(
+                scope_of(request), body["scriptId"], body.get("content", ""),
+                name=body.get("name", ""),
+                description=body.get("description", ""),
+                activate=body.get("activate", True))
+            return 201, info.to_json()
+
+        def get_script(request: Request):
+            return sm.get_script(scope_of(request),
+                                 request.params["script_id"]).to_json()
+
+        def delete_script(request: Request):
+            sm.delete_script(scope_of(request), request.params["script_id"])
+            return {"deleted": True}
+
+        def get_version_content(request: Request):
+            content = sm.get_content(scope_of(request),
+                                     request.params["script_id"],
+                                     request.params["version_id"])
+            return {"content": content}
+
+        def add_version(request: Request):
+            body = _body(request)
+            v = sm.add_version(scope_of(request),
+                               request.params["script_id"],
+                               body.get("content", ""),
+                               comment=body.get("comment", ""),
+                               activate=body.get("activate", False))
+            return 201, v.to_json()
+
+        def clone_version(request: Request):
+            body = request.body if isinstance(request.body, dict) else {}
+            v = sm.clone_version(scope_of(request),
+                                 request.params["script_id"],
+                                 request.params["version_id"],
+                                 comment=body.get("comment", ""))
+            return 201, v.to_json()
+
+        def activate_version(request: Request):
+            return sm.activate_version(scope_of(request),
+                                       request.params["script_id"],
+                                       request.params["version_id"]).to_json()
+
+        base = f"{prefix}/scripting/scripts"
+        router.get(base, list_scripts, authority=ADMIN)
+        router.post(base, create_script, authority=ADMIN)
+        router.get(base + "/{script_id}", get_script, authority=ADMIN)
+        router.delete(base + "/{script_id}", delete_script, authority=ADMIN)
+        router.get(base + "/{script_id}/versions/{version_id}/content",
+                   get_version_content, authority=ADMIN)
+        router.post(base + "/{script_id}/versions", add_version,
+                    authority=ADMIN)
+        router.post(base + "/{script_id}/versions/{version_id}/clone",
+                    clone_version, authority=ADMIN)
+        router.post(base + "/{script_id}/versions/{version_id}/activate",
+                    activate_version, authority=ADMIN)
+
+    from sitewhere_tpu.runtime.scripts import GLOBAL_SCOPE
+    _register_script_routes("/api", lambda r: GLOBAL_SCOPE)
+    _register_script_routes("/api/tenants/{token}",
+                            lambda r: r.params["token"])
 
     # ------------------------------------------------------------------
     # Users + authorities (reference: Users.java, Authorities.java)

@@ -341,3 +341,19 @@ def test_label_unknown_entity_404(client):
     with pytest.raises(SiteWhereClientError) as err:
         client.get_label("devices", "no-such", "barcode")
     assert err.value.status == 404
+
+
+def test_openapi_document(server):
+    import urllib.request, json as _json
+    with urllib.request.urlopen(server.base_url + "/api/openapi.json") as r:
+        doc = _json.loads(r.read())
+    assert doc["openapi"].startswith("3.0")
+    assert "/api/devices/{token}" in doc["paths"]
+    get_dev = doc["paths"]["/api/devices/{token}"]["get"]
+    assert get_dev["security"] == [{"bearerAuth": []}]
+    assert {p["name"] for p in get_dev["parameters"]} == {"token"}
+    # every registered route appears; the doc cannot drift from the router
+    assert "/api/scripting/scripts/{script_id}/versions/{version_id}/activate" \
+        in doc["paths"]
+    assert "/api/labels/generators" in doc["paths"]
+    assert any(t["name"] == "devices" for t in doc["tags"])
