@@ -99,6 +99,13 @@ class SiteWhereInstance(LifecycleComponent):
         from sitewhere_tpu.runtime.scripts import ScriptManager
         self.script_manager = ScriptManager(data_dir=self.data_dir)
 
+        # centralized logging over the bus (reference:
+        # MicroserviceLogProducer -> instance-logging topic)
+        from sitewhere_tpu.runtime.logs import BusLogHandler, LogAggregator
+        self.log_handler = BusLogHandler(self.bus, self.naming,
+                                         source=instance_id)
+        self.log_aggregator = LogAggregator(self.bus, self.naming)
+
         if self.pipeline_engine is not None:
             self.add_nested(self.pipeline_engine)
         self.add_nested(self.engine_manager)
@@ -133,7 +140,23 @@ class SiteWhereInstance(LifecycleComponent):
         if self._default_tenant:
             self.bootstrap.bootstrap_default_tenant(self._default_tenant)
 
+    def on_start(self, monitor) -> None:
+        # centralized logging wiring lives in on_start (not on_initialize,
+        # which lifecycle runs only once) so instance.restart() re-attaches
+        self.log_handler.start()
+        self.log_aggregator.start()
+        framework_logger = logging.getLogger("sitewhere")
+        if framework_logger.level == logging.NOTSET:
+            # the root default (WARNING) would filter INFO before the bus
+            # handler ever sees it; only set when the operator hasn't
+            framework_logger.setLevel(logging.INFO)
+        if self.log_handler not in framework_logger.handlers:
+            framework_logger.addHandler(self.log_handler)
+
     def on_stop(self, monitor) -> None:
+        logging.getLogger("sitewhere").removeHandler(self.log_handler)
+        self.log_handler.stop()
+        self.log_aggregator.stop()
         self.event_log.stop()
 
     # -- convenience accessors --------------------------------------------

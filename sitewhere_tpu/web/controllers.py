@@ -115,6 +115,43 @@ def register_all(router: Router, instance, server) -> None:
     def get_metrics(request: Request):
         return instance.metrics.snapshot()
 
+    def get_logs(request: Request):
+        return {"records": instance.log_aggregator.recent(
+            limit=request.query_int("limit", 200),
+            level=request.query_one("level"),
+            source=request.query_one("source"))}
+
+    def stream_topology(request: Request):
+        """Live topology feed (SSE) — the reference's WebSocket
+        TopologyBroadcaster. Emits a snapshot immediately, then again
+        whenever it changes (0.5 s poll); keepalive comments every ~2 s of
+        no change surface client disconnects (the write raises), so an
+        abandoned stream never holds its server thread."""
+        import json as _json
+        import time as _time
+        from sitewhere_tpu.web.server import SseStream
+
+        max_s = min(float(request.query_one("max_seconds", "3600")), 3600.0)
+
+        def events():
+            last = None
+            idle = 0
+            deadline = _time.monotonic() + max_s
+            while _time.monotonic() < deadline:
+                snap = instance.topology()
+                enc = _json.dumps(snap, sort_keys=True)
+                if enc != last:
+                    last = enc
+                    idle = 0
+                    yield snap
+                else:
+                    idle += 1
+                    if idle % 4 == 0:
+                        yield ": keepalive"
+                _time.sleep(0.5)
+
+        return SseStream(events())
+
     def get_configuration_model(request: Request):
         from sitewhere_tpu.runtime.config_model import (
             instance_configuration_model)
@@ -137,6 +174,10 @@ def register_all(router: Router, instance, server) -> None:
     router.get("/api/instance/topology", get_topology,
                authority=SiteWhereRoles.VIEW_SERVER_INFO)
     router.get("/api/instance/metrics", get_metrics,
+               authority=SiteWhereRoles.VIEW_SERVER_INFO)
+    router.get("/api/instance/logs", get_logs,
+               authority=SiteWhereRoles.VIEW_SERVER_INFO)
+    router.get("/api/instance/topology/stream", stream_topology,
                authority=SiteWhereRoles.VIEW_SERVER_INFO)
     router.get("/api/instance/configuration/model", get_configuration_model,
                authority=SiteWhereRoles.VIEW_SERVER_INFO)

@@ -32,6 +32,17 @@ from sitewhere_tpu.web.router import Request, Router
 LOGGER = logging.getLogger("sitewhere.web")
 
 
+class SseStream:
+    """Handler return type for server-sent events: the server streams each
+    item from `events()` as an SSE `data:` frame (JSON-encoded unless str)
+    until the generator ends or the client disconnects. The reference pushes
+    the same live feeds over a WebSocket (service-web-rest
+    ws/components/TopologyBroadcaster.java); SSE keeps it dependency-free."""
+
+    def __init__(self, events):
+        self.events = events  # iterable / generator
+
+
 class RestServer(LifecycleComponent):
     """HTTP front door for a SiteWhereInstance."""
 
@@ -142,6 +153,9 @@ class RestServer(LifecycleComponent):
                     "X-SiteWhere-Tenant",
                     handler.headers.get("X-SiteWhere-Tenant-Id")))
             result = self.router.dispatch(request)
+            if isinstance(result, SseStream):
+                self._stream_sse(handler, result)
+                return
             status, ctype = 200, None
             if isinstance(result, tuple):
                 if len(result) == 3:
@@ -157,6 +171,34 @@ class RestServer(LifecycleComponent):
         except Exception as err:  # controller bug — surface as 500
             LOGGER.exception("unhandled REST error")
             self._respond(handler, 500, {"message": str(err)})
+
+    def _stream_sse(self, handler: BaseHTTPRequestHandler,
+                    stream: SseStream) -> None:
+        handler.send_response(200)
+        handler.send_header("Content-Type", "text/event-stream")
+        handler.send_header("Cache-Control", "no-cache")
+        handler.send_header("Connection", "close")
+        handler.end_headers()
+        try:
+            for event in stream.events:
+                if isinstance(event, str) and event.startswith(":"):
+                    frame = f"{event}\n\n"     # SSE comment (keepalive)
+                elif isinstance(event, str):
+                    frame = f"data: {event}\n\n"
+                else:
+                    frame = f"data: {json.dumps(to_jsonable(event))}\n\n"
+                handler.wfile.write(frame.encode())
+                handler.wfile.flush()
+        except (BrokenPipeError, ConnectionResetError):
+            pass  # client went away — the generator's finally cleans up
+        except Exception:
+            # the 200 header block is committed: a second send_response
+            # would corrupt the stream, so terminate it instead
+            LOGGER.exception("SSE stream generator failed")
+        finally:
+            close = getattr(stream.events, "close", None)
+            if close is not None:
+                close()
 
     def _respond(self, handler: BaseHTTPRequestHandler, status: int,
                  payload: Any, ctype: Optional[str] = None) -> None:

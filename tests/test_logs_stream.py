@@ -1,0 +1,141 @@
+"""Centralized logging (runtime/logs.py) + SSE topology broadcast.
+
+Reference parity: MicroserviceLogProducer/instance-logging topic and the
+WebSocket TopologyBroadcaster of service-web-rest.
+"""
+
+import json
+import logging
+import time
+import urllib.request
+
+import pytest
+
+from sitewhere_tpu.runtime.bus import EventBus, TopicNaming
+from sitewhere_tpu.runtime.logs import BusLogHandler, LogAggregator
+
+
+def _wait(predicate, timeout=5.0):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if predicate():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+class TestBusLogging:
+    def test_handler_to_aggregator_roundtrip(self):
+        bus = EventBus()
+        naming = TopicNaming()
+        handler = BusLogHandler(bus, naming, source="svc-a")
+        handler.start()
+        agg = LogAggregator(bus, naming)
+        agg.start()
+        logger = logging.getLogger("sitewhere.test.roundtrip")
+        logger.addHandler(handler)
+        logger.setLevel(logging.INFO)
+        try:
+            logger.info("pipeline started with %d shards", 8)
+            logger.warning("shard overflow")
+            assert _wait(lambda: len(agg.recent()) >= 2)
+        finally:
+            logger.removeHandler(handler)
+            handler.stop()
+            agg.stop()
+        records = agg.recent()
+        assert records[0]["message"] == "pipeline started with 8 shards"
+        assert records[0]["source"] == "svc-a"
+        assert records[1]["level"] == "WARNING"
+        # filters
+        assert len(agg.recent(level="WARNING")) == 1
+        assert agg.recent(source="other") == []
+
+    def test_handler_never_blocks_on_overflow(self):
+        bus = EventBus()
+        handler = BusLogHandler(bus, source="svc-b", max_queue=10)
+        # not started: queue fills and drops oldest without blocking
+        logger = logging.getLogger("sitewhere.test.overflow")
+        logger.addHandler(handler)
+        logger.setLevel(logging.INFO)
+        try:
+            for i in range(50):
+                logger.info("msg %d", i)
+        finally:
+            logger.removeHandler(handler)
+        assert handler.dropped == 40
+
+
+@pytest.fixture(scope="module")
+def rest():
+    from sitewhere_tpu.client.rest import SiteWhereClient
+    from sitewhere_tpu.instance import SiteWhereInstance
+    from sitewhere_tpu.web.server import RestServer
+    instance = SiteWhereInstance(instance_id="logstream")
+    instance.start()
+    server = RestServer(instance, port=0)
+    server.start()
+    client = SiteWhereClient(server.base_url)
+    client.authenticate("admin", "password")
+    yield instance, server, client
+    server.stop()
+    instance.stop()
+
+
+class TestRestLogsAndStream:
+    def test_logs_endpoint(self, rest):
+        instance, server, client = rest
+        logging.getLogger("sitewhere.demo").info("hello from the instance")
+
+        def arrived():
+            records = client.get("/api/instance/logs", limit=10)["records"]
+            return any(r["message"] == "hello from the instance"
+                       for r in records)
+
+        assert _wait(arrived)
+        assert client.get("/api/instance/logs", level="ERROR")["records"] == []
+
+    def test_topology_sse_stream(self, rest):
+        instance, server, client = rest
+        req = urllib.request.Request(
+            server.base_url + "/api/instance/topology/stream?max_seconds=5",
+            headers={"Authorization": f"Bearer {client.token}"})
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            assert resp.headers["Content-Type"] == "text/event-stream"
+            line = resp.readline().decode()
+            assert line.startswith("data: ")
+            snap = json.loads(line[len("data: "):])
+            assert snap["instance_id"] == "logstream"
+            assert "tenant_engines" in snap
+
+    def test_stream_requires_auth(self, rest):
+        instance, server, client = rest
+        req = urllib.request.Request(
+            server.base_url + "/api/instance/topology/stream")
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(req, timeout=10)
+        assert err.value.code == 401
+
+
+def test_instance_restart_reattaches_logging():
+    from sitewhere_tpu.instance import SiteWhereInstance
+    inst = SiteWhereInstance(instance_id="restartlog")
+    inst.start()
+    inst.stop()
+    inst.start()
+    try:
+        logging.getLogger("sitewhere.restart").info("after restart")
+        assert _wait(lambda: any(
+            r["message"] == "after restart"
+            for r in inst.log_aggregator.recent()))
+    finally:
+        inst.stop()
+
+
+def test_recent_limit_edge_cases():
+    bus = EventBus()
+    agg = LogAggregator(bus)
+    agg._records.extend({"message": f"m{i}"} for i in range(5))
+    assert agg.recent(limit=0) == []
+    assert agg.recent(limit=-3) == []
+    assert len(agg.recent(limit=2)) == 2
