@@ -7,15 +7,17 @@ from sitewhere_tpu.connectors.filters import (
 from sitewhere_tpu.connectors.host import (
     OutboundConnectorHost, OutboundConnectorsManager)
 from sitewhere_tpu.connectors.sinks import (
-    CollectingConnector, DeviceEventMulticaster, EventIndexConnector,
-    HttpPostConnector, MqttOutboundConnector, ScriptedConnector,
+    CollectingConnector, DeviceEventMulticaster, DweetConnector,
+    EventIndexConnector, HttpPostConnector, InitialStateConnector,
+    MqttOutboundConnector, ScriptedConnector, SqsConnector,
     all_devices_of_type_route, event_to_json)
 
 __all__ = [
     "AreaFilter", "CollectingConnector", "DeviceEventMulticaster",
-    "DeviceTypeFilter", "EventIndexConnector", "EventTypeFilter",
-    "FilterOperation", "HttpPostConnector", "MqttOutboundConnector",
-    "OutboundConnector", "OutboundConnectorHost", "OutboundConnectorsManager",
-    "ScriptedConnector", "ScriptedFilter", "all_devices_of_type_route",
-    "event_to_json",
+    "DeviceTypeFilter", "DweetConnector", "EventIndexConnector",
+    "EventTypeFilter", "FilterOperation", "HttpPostConnector",
+    "InitialStateConnector", "MqttOutboundConnector", "OutboundConnector",
+    "OutboundConnectorHost", "OutboundConnectorsManager",
+    "ScriptedConnector", "ScriptedFilter", "SqsConnector",
+    "all_devices_of_type_route", "event_to_json",
 ]

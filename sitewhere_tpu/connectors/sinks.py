@@ -1,12 +1,11 @@
 """Concrete outbound connectors.
 
 Reference: service-outbound-connectors — MQTT (MqttOutboundConnector),
-Solr indexing (solr/SolrOutboundConnector.java), Groovy scripted, plus
-multicasting with route builders (spi/multicast/IDeviceEventMulticaster,
-groovy/routing/GroovyRouteBuilder). Cloud-vendor sinks (SQS/EventHub/
-InitialState/dweet.io) are network clients the image can't reach; their
-role — JSON-serialized event POST to an external endpoint — is covered by
-HttpPostConnector against any URL.
+Solr indexing (solr/SolrOutboundConnector.java), Groovy scripted, the SaaS
+sinks (dweet.io, InitialState — thin layers over HTTP POST here), AWS SQS
+(gated on the optional boto3 client like the broker receivers in
+sources/receivers_ext.py), plus multicasting with route builders
+(spi/multicast/IDeviceEventMulticaster, groovy/routing/GroovyRouteBuilder).
 """
 
 from __future__ import annotations
@@ -123,8 +122,8 @@ class CollectingConnector(OutboundConnector):
 
 
 class HttpPostConnector(OutboundConnector):
-    """POST JSON events to an HTTP endpoint — the shape of the reference's
-    InitialState/dweet.io connectors, target-agnostic."""
+    """POST JSON events to an HTTP endpoint — the generic base the SaaS
+    connectors below specialize via `_url_for`/`_post`."""
 
     def __init__(self, connector_id: str, url: str, filters=None,
                  timeout_s: float = 5.0):
@@ -132,13 +131,105 @@ class HttpPostConnector(OutboundConnector):
         self.url = url
         self.timeout_s = timeout_s
 
-    def process_batch(self, batch) -> None:
+    def _url_for(self, context: DeviceEventContext,
+                 event: DeviceEvent) -> str:
+        return self.url
+
+    def _post(self, url: str, data: bytes,
+              headers: Optional[Dict[str, str]] = None) -> None:
         import urllib.request
+        request = urllib.request.Request(
+            url, data=data,
+            headers={"Content-Type": "application/json", **(headers or {})},
+            method="POST")
+        urllib.request.urlopen(request, timeout=self.timeout_s).read()
+
+    def process_batch(self, batch) -> None:
         for context, event in batch:
-            request = urllib.request.Request(
-                self.url, data=event_to_json(context, event),
-                headers={"Content-Type": "application/json"}, method="POST")
-            urllib.request.urlopen(request, timeout=self.timeout_s).read()
+            self._post(self._url_for(context, event),
+                       event_to_json(context, event))
+
+
+class DweetConnector(HttpPostConnector):
+    """dweet.io connector (DweetIoConnector): each event posts to the
+    per-thing dweet endpoint, the thing name defaulting to the device
+    token."""
+
+    def __init__(self, connector_id: str = "dweet", thing_prefix: str = "",
+                 base_url: str = "https://dweet.io", filters=None,
+                 timeout_s: float = 5.0):
+        super().__init__(connector_id, base_url, filters=filters,
+                         timeout_s=timeout_s)
+        self.thing_prefix = thing_prefix
+
+    def _url_for(self, context, event) -> str:
+        return (f"{self.url}/dweet/for/"
+                f"{self.thing_prefix}{context.device_token}")
+
+
+class InitialStateConnector(HttpPostConnector):
+    """InitialState events-API connector (InitialStateEventProcessor): posts
+    measurement values, location coordinates, and alert messages to a
+    bucket keyed by the access-key header."""
+
+    def __init__(self, connector_id: str = "initial-state",
+                 streaming_access_key: str = "",
+                 base_url: str = "https://groker.initialstate.com/api/events",
+                 filters=None, timeout_s: float = 5.0):
+        super().__init__(connector_id, base_url, filters=filters,
+                         timeout_s=timeout_s)
+        self.access_key = streaming_access_key
+
+    @staticmethod
+    def _line(context, event):
+        name = getattr(event, "name", None) or event.event_type.name.lower()
+        value = getattr(event, "value", None)
+        if value is None and hasattr(event, "latitude"):
+            value = f"{event.latitude},{event.longitude}"
+        if value is None:  # alerts and other valueless events: string value
+            value = getattr(event, "message", None) or \
+                getattr(event, "type", None) or name
+        return {"key": f"{context.device_token}.{name}", "value": value,
+                "epoch": event.event_date / 1000.0}
+
+    def process_batch(self, batch) -> None:
+        import json as _json
+        lines = [self._line(context, event) for context, event in batch]
+        if lines:
+            self._post(self.url, _json.dumps(lines).encode(),
+                       headers={"X-IS-AccessKey": self.access_key,
+                                "Accept-Version": "~0"})
+
+
+class SqsConnector(OutboundConnector):
+    """AWS SQS connector (SqsOutboundEventProcessor) over `boto3` when
+    available (optional dependency — start() fails with a clear error
+    otherwise, matching the receiver adapters in sources/receivers_ext.py).
+    """
+
+    def __init__(self, connector_id: str, queue_url: str, region: str =
+                 "us-east-1", filters=None):
+        super().__init__(connector_id, filters)
+        self.queue_url = queue_url
+        self.region = region
+        self._client = None
+
+    def on_start(self, monitor) -> None:
+        try:
+            import boto3
+        except ImportError as exc:
+            from sitewhere_tpu.errors import SiteWhereError
+            raise SiteWhereError(
+                "SqsConnector requires the optional 'boto3' client library, "
+                "which is not installed in this image", http_status=501
+            ) from exc
+        self._client = boto3.client("sqs", region_name=self.region)
+
+    def process_batch(self, batch) -> None:
+        for context, event in batch:
+            self._client.send_message(
+                QueueUrl=self.queue_url,
+                MessageBody=event_to_json(context, event).decode())
 
 
 class DeviceEventMulticaster:

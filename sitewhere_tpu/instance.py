@@ -100,7 +100,11 @@ class SiteWhereInstance(LifecycleComponent):
         self.script_manager = ScriptManager(data_dir=self.data_dir)
 
         # centralized logging over the bus (reference:
-        # MicroserviceLogProducer -> instance-logging topic)
+        # MicroserviceLogProducer -> instance-logging topic). The handler
+        # attaches to the process-global "sitewhere" logger: with several
+        # instances in one process (tests), each captures the shared stream
+        # under its own source label — matching the reference, where one
+        # process is one microservice instance.
         from sitewhere_tpu.runtime.logs import BusLogHandler, LogAggregator
         self.log_handler = BusLogHandler(self.bus, self.naming,
                                          source=instance_id)

@@ -350,3 +350,24 @@ class PipelineEngine(LifecycleComponent):
             "tenant_event_count": np.asarray(s.tenant_event_count).tolist(),
             "tenant_alert_count": np.asarray(s.tenant_alert_count).tolist(),
         }
+
+    # -- device profiling (the reference's Jaeger span surface; on-device
+    # the equivalent is an XLA profiler trace — runtime/tracing.py) ---------
+
+    def start_device_trace(self, log_dir: str) -> None:
+        """Begin capturing an XLA/jax profiler trace (HLO timelines, memory)
+        to `log_dir` (view with TensorBoard or xprof). Idempotent: a second
+        call while tracing is a no-op."""
+        if getattr(self, "_tracing", False):
+            return
+        jax.profiler.start_trace(log_dir)
+        self._tracing = True
+
+    def stop_device_trace(self) -> None:
+        if getattr(self, "_tracing", False):
+            jax.profiler.stop_trace()
+            self._tracing = False
+
+    def on_stop(self, monitor) -> None:
+        # never leave an XLA profiler trace open past the engine
+        self.stop_device_trace()

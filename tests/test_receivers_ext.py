@@ -1,0 +1,149 @@
+"""Extended receivers (polling REST, gated broker adapters) + named SaaS
+connectors — breadth parity with service-event-sources /
+service-outbound-connectors transport lists.
+"""
+
+import http.server
+import json
+import threading
+
+import pytest
+
+from sitewhere_tpu.errors import SiteWhereError
+from sitewhere_tpu.model.event import DeviceEventContext, DeviceMeasurement
+from sitewhere_tpu.sources.receivers_ext import (
+    AmqpEventReceiver, EventHubEventReceiver, PollingRestReceiver,
+    StompEventReceiver)
+
+
+class _Sink:
+    def __init__(self):
+        self.received = []
+
+    def on_encoded_event_received(self, payload, metadata=None):
+        self.received.append((payload, metadata))
+
+
+@pytest.fixture
+def http_server():
+    """Tiny local HTTP server: GET returns a queued body, POST records."""
+    state = {"body": b"", "posts": []}
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            body = state["body"]
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", 0))
+            state["posts"].append((self.path, dict(self.headers),
+                                   self.rfile.read(n)))
+            self.send_response(200)
+            self.send_header("Content-Length", "2")
+            self.end_headers()
+            self.wfile.write(b"ok")
+
+    server = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    yield f"http://127.0.0.1:{server.server_address[1]}", state
+    server.shutdown()
+
+
+class TestPollingRestReceiver:
+    def test_polls_and_forwards(self, http_server):
+        url, state = http_server
+        state["body"] = b"event-bytes"
+        rx = PollingRestReceiver(url + "/feed", interval_s=60)
+        sink = _Sink()
+        rx.bind(sink)
+        assert rx.poll_once() == b"event-bytes"
+        assert sink.received[0][0] == b"event-bytes"
+        assert sink.received[0][1]["rest.url"].endswith("/feed")
+
+    def test_empty_body_dropped(self, http_server):
+        url, state = http_server
+        rx = PollingRestReceiver(url)
+        sink = _Sink()
+        rx.bind(sink)
+        rx.poll_once()
+        assert sink.received == []
+
+    def test_error_counted_not_raised(self):
+        rx = PollingRestReceiver("http://127.0.0.1:9/none", timeout_s=0.2)
+        rx.bind(_Sink())
+        assert rx.poll_once() is None
+        assert rx.poll_errors == 1
+
+    def test_background_loop(self, http_server):
+        import time
+        url, state = http_server
+        state["body"] = b"tick"
+        rx = PollingRestReceiver(url, interval_s=0.05)
+        sink = _Sink()
+        rx.bind(sink)
+        rx.start()
+        t0 = time.monotonic()
+        while len(sink.received) < 2 and time.monotonic() - t0 < 5:
+            time.sleep(0.02)
+        rx.stop()
+        assert len(sink.received) >= 2
+
+
+class TestGatedBrokerReceivers:
+    @pytest.mark.parametrize("rx", [
+        AmqpEventReceiver(), StompEventReceiver(),
+        EventHubEventReceiver("Endpoint=sb://x/;SharedAccessKeyName=k;"
+                              "SharedAccessKey=s", "hub"),
+    ])
+    def test_start_raises_clear_gating_error(self, rx):
+        rx.bind(_Sink())
+        with pytest.raises(SiteWhereError) as err:
+            rx.start()
+        assert err.value.http_status == 501
+        assert "client library" in str(err.value)
+
+
+class TestSaasConnectors:
+    def _batch(self):
+        ctx = DeviceEventContext(device_token="dev-7", tenant_id="t1")
+        ev = DeviceMeasurement(name="temp", value=21.5,
+                               event_date=1_700_000_000_000)
+        return [(ctx, ev)]
+
+    def test_dweet_connector_posts_per_thing(self, http_server):
+        from sitewhere_tpu.connectors.sinks import DweetConnector
+        url, state = http_server
+        conn = DweetConnector(base_url=url, thing_prefix="sw-")
+        conn.process_batch(self._batch())
+        path, headers, body = state["posts"][0]
+        assert path == "/dweet/for/sw-dev-7"
+        payload = json.loads(body)
+        assert payload["value"] == 21.5 and payload["device"] == "dev-7"
+
+    def test_initial_state_connector_batches(self, http_server):
+        from sitewhere_tpu.connectors.sinks import InitialStateConnector
+        url, state = http_server
+        conn = InitialStateConnector(base_url=url,
+                                     streaming_access_key="sekrit")
+        conn.process_batch(self._batch())
+        path, headers, body = state["posts"][0]
+        lower = {k.lower(): v for k, v in headers.items()}
+        assert lower["x-is-accesskey"] == "sekrit"
+        lines = json.loads(body)
+        assert lines[0]["key"] == "dev-7.temp"
+        assert lines[0]["value"] == 21.5
+        assert lines[0]["epoch"] == 1_700_000_000.0
+
+    def test_sqs_connector_gated(self):
+        from sitewhere_tpu.connectors.sinks import SqsConnector
+        conn = SqsConnector("sqs-1", "https://sqs.example/q")
+        with pytest.raises(SiteWhereError) as err:
+            conn.start()  # lifecycle wraps the gating error
+        assert "boto3" in str(err.value)
