@@ -163,8 +163,9 @@ class DweetConnector(HttpPostConnector):
         self.thing_prefix = thing_prefix
 
     def _url_for(self, context, event) -> str:
-        return (f"{self.url}/dweet/for/"
-                f"{self.thing_prefix}{context.device_token}")
+        from urllib.parse import quote
+        thing = quote(f"{self.thing_prefix}{context.device_token}", safe="")
+        return f"{self.url}/dweet/for/{thing}"
 
 
 class InitialStateConnector(HttpPostConnector):
@@ -193,10 +194,9 @@ class InitialStateConnector(HttpPostConnector):
                 "epoch": event.event_date / 1000.0}
 
     def process_batch(self, batch) -> None:
-        import json as _json
         lines = [self._line(context, event) for context, event in batch]
         if lines:
-            self._post(self.url, _json.dumps(lines).encode(),
+            self._post(self.url, json.dumps(lines).encode(),
                        headers={"X-IS-AccessKey": self.access_key,
                                 "Accept-Version": "~0"})
 
@@ -215,14 +215,8 @@ class SqsConnector(OutboundConnector):
         self._client = None
 
     def on_start(self, monitor) -> None:
-        try:
-            import boto3
-        except ImportError as exc:
-            from sitewhere_tpu.errors import SiteWhereError
-            raise SiteWhereError(
-                "SqsConnector requires the optional 'boto3' client library, "
-                "which is not installed in this image", http_status=501
-            ) from exc
+        from sitewhere_tpu.sources.receivers_ext import require_optional
+        boto3 = require_optional("boto3", "AWS SQS")
         self._client = boto3.client("sqs", region_name=self.region)
 
     def process_batch(self, batch) -> None:

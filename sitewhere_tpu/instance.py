@@ -50,7 +50,8 @@ class SiteWhereInstance(LifecycleComponent):
                  bus_partitions: int = 8,
                  default_tenant: Optional[str] = "default",
                  admin_username: str = "admin",
-                 admin_password: str = "password"):
+                 admin_password: str = "password",
+                 shards: int = 1):
         super().__init__(f"instance:{instance_id}")
         self.instance_id = instance_id
         self.data_dir = data_dir
@@ -65,14 +66,26 @@ class SiteWhereInstance(LifecycleComponent):
         self.registry_tensors = None
         self.pipeline_engine = None
         if enable_pipeline:
-            from sitewhere_tpu.pipeline.engine import PipelineEngine
             from sitewhere_tpu.registry.tensors import RegistryTensors
             self.registry_tensors = RegistryTensors(
                 max_devices=max_devices, max_zones=max_zones,
                 max_zone_vertices=max_zone_vertices)
-            self.pipeline_engine = PipelineEngine(
-                self.registry_tensors, batch_size=batch_size,
-                measurement_slots=measurement_slots, max_tenants=max_tenants)
+            if shards > 1:
+                # SPMD hot path over a device mesh (config model's
+                # pipeline.shards; parallel/engine.py)
+                from sitewhere_tpu.parallel import (
+                    ShardedPipelineEngine, make_mesh)
+                self.pipeline_engine = ShardedPipelineEngine(
+                    self.registry_tensors, mesh=make_mesh(shards),
+                    per_shard_batch=batch_size,
+                    measurement_slots=measurement_slots,
+                    max_tenants=max_tenants)
+            else:
+                from sitewhere_tpu.pipeline.engine import PipelineEngine
+                self.pipeline_engine = PipelineEngine(
+                    self.registry_tensors, batch_size=batch_size,
+                    measurement_slots=measurement_slots,
+                    max_tenants=max_tenants)
 
         # global (non-multitenant) managements — reference:
         # service-user-management / service-tenant-management

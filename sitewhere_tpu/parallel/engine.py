@@ -209,6 +209,11 @@ class ShardedPipelineEngine(PipelineEngine):
         self._metrics.meter("events").mark(int(np.asarray(batch.valid).sum()))
         return routed.batch, outputs
 
+    def submit_routed(self, batch: EventBatch):
+        """See PipelineEngine.submit_routed: sharded submit already returns
+        (routed [S, B] batch, outputs)."""
+        return self.submit(batch)
+
     def materialize_alerts(self, routed_batch: EventBatch,
                            outputs: ProcessOutputs,
                            max_alerts: int = 1024) -> List[DeviceAlert]:

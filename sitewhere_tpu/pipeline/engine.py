@@ -235,6 +235,15 @@ class PipelineEngine(LifecycleComponent):
         self._metrics.meter("events").mark(int(np.asarray(batch.valid).sum()))
         return outputs
 
+    def submit_routed(self, batch: EventBatch):
+        """Engine-agnostic submit: returns (batch_for_materialization,
+        outputs) on both engine kinds. The sharded engine's submit already
+        returns its routed [S, B] batch; here the input batch doubles as the
+        materialization batch. Callers that support either engine
+        (pipeline/inbound.py, sources/fastlane.py) use this instead of
+        type-sniffing submit()'s return."""
+        return batch, self.submit(batch)
+
     def materialize_alerts(self, batch: EventBatch, outputs: ProcessOutputs,
                            max_alerts: int = 1024) -> List[DeviceAlert]:
         """Turn fired-rule masks back into API-level DeviceAlert events

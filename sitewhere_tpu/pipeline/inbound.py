@@ -164,7 +164,7 @@ class InboundProcessingService(LifecycleComponent):
         events = [e for e, _ in hot]
         tokens = [t for _, t in hot]
         for batch in self.engine.packer.pack_events(events, tokens):
-            outputs = self.engine.submit(batch)
+            batch, outputs = self.engine.submit_routed(batch)
             if not self.persist_rule_alerts or self.events is None:
                 continue
             for alert in self.engine.materialize_alerts(batch, outputs):

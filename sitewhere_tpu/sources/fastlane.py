@@ -211,13 +211,7 @@ class BulkWireIngestService(LifecycleComponent):
                 self.control_sink(frame, metadata)
         row = 0
         for batch in res.batches:
-            result = self.engine.submit(batch)
-            if isinstance(result, tuple):
-                # ShardedPipelineEngine: (routed [S,B] batch, outputs);
-                # alert materialization needs the routed layout
-                alert_batch, outputs = result
-            else:
-                alert_batch, outputs = batch, result
+            alert_batch, outputs = self.engine.submit_routed(batch)
             if self.eventlog is not None:
                 self.eventlog.append_batch(self.tenant, batch,
                                            self.engine.packer,

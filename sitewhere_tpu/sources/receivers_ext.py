@@ -18,6 +18,8 @@ The in-image equivalents:
 
 from __future__ import annotations
 
+import importlib
+import logging
 import threading
 import urllib.error
 import urllib.request
@@ -25,6 +27,21 @@ from typing import Callable, Dict, Optional
 
 from sitewhere_tpu.errors import SiteWhereError
 from sitewhere_tpu.sources.receivers import _ReceiverBase
+
+LOGGER = logging.getLogger("sitewhere.sources.ext")
+
+
+def require_optional(import_name: str, human_name: str):
+    """Import an optional client library or raise a clear 501 gating error
+    (shared by the broker receivers here and connectors/sinks.py)."""
+    try:
+        return importlib.import_module(import_name)
+    except ImportError as exc:
+        raise SiteWhereError(
+            f"this component requires the optional {human_name} client "
+            f"library ('{import_name}'), which is not installed in this "
+            f"image; use the MQTT/CoAP/socket/HTTP transports or install "
+            f"it in your deployment", http_status=501) from exc
 
 
 class PollingRestReceiver(_ReceiverBase):
@@ -58,18 +75,25 @@ class PollingRestReceiver(_ReceiverBase):
             self._thread = None
 
     def poll_once(self) -> Optional[bytes]:
-        """One poll cycle (public so tests/ops can drive it synchronously)."""
+        """One poll cycle (public so tests/ops can drive it synchronously).
+        Any failure — network, protocol, downstream handler — is counted,
+        never raised: the polling loop must survive a misbehaving endpoint."""
         request = urllib.request.Request(self.url, headers=self.headers)
         try:
             with urllib.request.urlopen(request,
                                         timeout=self.timeout_s) as resp:
                 body = resp.read()
-        except (urllib.error.URLError, OSError, TimeoutError):
+        except Exception:
             self.poll_errors += 1
             return None
         if body:
-            self.source.on_encoded_event_received(
-                body, {"rest.url": self.url})
+            try:
+                self.source.on_encoded_event_received(
+                    body, {"rest.url": self.url})
+            except Exception:
+                self.poll_errors += 1
+                LOGGER.exception("polling-REST delivery failed for %s",
+                                 self.url)
         return body
 
     def _run(self) -> None:
@@ -85,15 +109,7 @@ class _OptionalClientReceiver(_ReceiverBase):
     _LIB: tuple = ("", "")
 
     def _require_lib(self):
-        import importlib
-        try:
-            return importlib.import_module(self._LIB[0])
-        except ImportError as exc:
-            raise SiteWhereError(
-                f"{type(self).__name__} requires the optional {self._LIB[1]} "
-                f"client library ('{self._LIB[0]}'), which is not installed "
-                f"in this image; use the MQTT/CoAP/socket/HTTP receivers or "
-                f"install it in your deployment", http_status=501) from exc
+        return require_optional(self._LIB[0], self._LIB[1])
 
 
 class AmqpEventReceiver(_OptionalClientReceiver):
@@ -115,23 +131,29 @@ class AmqpEventReceiver(_OptionalClientReceiver):
         pika = self._require_lib()
         params = pika.URLParameters(self.url)
         self._conn = pika.BlockingConnection(params)
-        channel = self._conn.channel()
-        channel.queue_declare(queue=self.queue, durable=self.durable)
+        self._channel = self._conn.channel()
+        self._channel.queue_declare(queue=self.queue, durable=self.durable)
 
         def on_message(ch, method, properties, body):
             self.source.on_encoded_event_received(
                 body, {"amqp.queue": self.queue})
             ch.basic_ack(delivery_tag=method.delivery_tag)
 
-        channel.basic_consume(queue=self.queue,
-                              on_message_callback=on_message)
-        self._thread = threading.Thread(target=channel.start_consuming,
+        self._channel.basic_consume(queue=self.queue,
+                                    on_message_callback=on_message)
+        self._thread = threading.Thread(target=self._channel.start_consuming,
                                         daemon=True, name="amqp-receiver")
         self._thread.start()
 
     def stop(self) -> None:
         if self._conn is not None:
+            # pika's BlockingConnection is single-threaded: the consumer
+            # thread owns it, so stop via its thread-safe callback and join
             try:
+                self._conn.add_callback_threadsafe(
+                    self._channel.stop_consuming)
+                if self._thread is not None:
+                    self._thread.join(timeout=5)
                 self._conn.close()
             except Exception:
                 pass
