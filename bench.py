@@ -86,6 +86,17 @@ def main() -> None:
         state, cout = engine._step_blob(params, state, dblob)
     jax.block_until_ready(cout.processed)
     compute_only = STEPS * BATCH / (time.perf_counter() - c0)
+
+    # aux: p99 rule-eval latency (BASELINE's latency target) — synchronous
+    # per-step on device-resident data, i.e. validate+rules+state fold time
+    # without host->device staging
+    rule_lat = []
+    for _ in range(STEPS):
+        s0 = time.perf_counter()
+        state, cout = engine._step_blob(params, state, dblob)
+        cout.processed.block_until_ready()
+        rule_lat.append(time.perf_counter() - s0)
+    rule_lat.sort()
     # the step donates its state argument: hand the final buffers back to the
     # engine so it is not left referencing deleted arrays
     engine._state = state
@@ -99,6 +110,8 @@ def main() -> None:
         "p50_step_ms": round(float(lat[len(lat) // 2]) * 1000, 3),
         "p99_step_ms": round(float(lat[int(len(lat) * 0.99)]) * 1000, 3),
         "compute_only_events_per_sec": round(compute_only, 1),
+        "p99_rule_eval_ms": round(rule_lat[int(len(rule_lat) * 0.99)] * 1000,
+                                  3),
         "device": str(jax.devices()[0]),
     }
     print(json.dumps(result))
