@@ -97,6 +97,24 @@ def main() -> None:
         cout.processed.block_until_ready()
         rule_lat.append(time.perf_counter() - s0)
     rule_lat.sort()
+
+    # aux: BASELINE config 1 — persist rate (columnar event log bulk append)
+    from sitewhere_tpu.persist.eventlog import ColumnarEventLog
+    log = ColumnarEventLog()
+    p0 = time.perf_counter()
+    persist_steps = 3 if small else 5
+    for i in range(persist_steps):
+        log.append_batch("bench", pool[i % len(pool)], engine.packer)
+    persist_rate = persist_steps * BATCH / (time.perf_counter() - p0)
+
+    # aux: BASELINE config 4 — replayed windowed analytics over the log
+    from sitewhere_tpu.analytics.engine import WindowedAnalyticsEngine
+    aeng = WindowedAnalyticsEngine(log)
+    aeng.measurement_windows("bench", window_ms=60_000)  # warm compile
+    a0 = time.perf_counter()
+    report = aeng.measurement_windows("bench", window_ms=60_000)
+    jax.block_until_ready(report.stats)
+    analytics_rate = persist_steps * BATCH / (time.perf_counter() - a0)
     # the step donates its state argument: hand the final buffers back to the
     # engine so it is not left referencing deleted arrays
     engine._state = state
@@ -112,6 +130,8 @@ def main() -> None:
         "compute_only_events_per_sec": round(compute_only, 1),
         "p99_rule_eval_ms": round(rule_lat[int(len(rule_lat) * 0.99)] * 1000,
                                   3),
+        "persist_events_per_sec": round(persist_rate, 1),
+        "analytics_replay_events_per_sec": round(analytics_rate, 1),
         "device": str(jax.devices()[0]),
     }
     print(json.dumps(result))

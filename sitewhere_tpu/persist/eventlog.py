@@ -442,37 +442,46 @@ class ColumnarEventLog:
         # the random prefix keeps ids unique across restarts over the same
         # parquet log (a uuid4 per row would dominate the append cost)
         base = self._next_ids(n)
-        ids = _obj_col(n)
-        ids[:] = [f"ev-{_ID_PREFIX}-{base + i:012x}" for i in range(n)]
+        # vectorized sprintf: ~3x the throughput of a per-row f-string at
+        # 131k-row batches
+        ids = np.char.mod(f"ev-{_ID_PREFIX}-%012x",
+                          np.arange(base, base + n)).astype(object)
 
         def resolve(interner, idx: np.ndarray) -> np.ndarray:
-            out = _obj_col(n)
-            for u in np.unique(idx):
-                tok = interner.token_of(int(u))
-                out[idx == u] = tok
+            # vectorized index -> token gather: one snapshot of the interner
+            # (index-aligned, None at 0) then a fancy-index. The previous
+            # per-unique-value masking was O(U * n) — quadratic at 100k
+            # devices per batch.
+            snap = np.array(interner.snapshot(), dtype=object)
+            clipped = np.clip(idx, 0, len(snap) - 1)
+            out = snap[clipped]
+            out[idx >= len(snap)] = None
             return out
 
         context_cols: Dict[str, np.ndarray] = {}
         if registry is not None:
-            assignment_token = _obj_col(n)
-            customer_id = _obj_col(n)
-            area_id = _obj_col(n)
-            asset_id = _obj_col(n)
-            for u in np.unique(device_idx):
+            # one lookup per unique device, then a vectorized gather through
+            # an inverse index (np.unique is O(n log n), not O(U * n))
+            uniq, inverse = np.unique(device_idx, return_inverse=True)
+            u_assign = np.array([None] * len(uniq), dtype=object)
+            u_customer = np.array([None] * len(uniq), dtype=object)
+            u_area = np.array([None] * len(uniq), dtype=object)
+            u_asset = np.array([None] * len(uniq), dtype=object)
+            for j, u in enumerate(uniq):
                 token = packer.devices.token_of(int(u))
                 device = registry.get_device_by_token(token) if token else None
                 assignment = (registry.get_active_assignment(device.id)
                               if device is not None else None)
                 if assignment is None:
                     continue
-                rows = device_idx == u
-                assignment_token[rows] = assignment.token
-                customer_id[rows] = assignment.customer_id or None
-                area_id[rows] = assignment.area_id or None
-                asset_id[rows] = assignment.asset_id or None
-            context_cols = dict(assignment_token=assignment_token,
-                                customer_id=customer_id, area_id=area_id,
-                                asset_id=asset_id)
+                u_assign[j] = assignment.token
+                u_customer[j] = assignment.customer_id or None
+                u_area[j] = assignment.area_id or None
+                u_asset[j] = assignment.asset_id or None
+            context_cols = dict(assignment_token=u_assign[inverse],
+                                customer_id=u_customer[inverse],
+                                area_id=u_area[inverse],
+                                asset_id=u_asset[inverse])
 
         cols = _full_cols(
             n,

@@ -84,7 +84,8 @@ class TestWireProtocolProperties:
             MessageType, WireCodec, decode_event_frames_to_columns,
             decode_frames, encode_frame)
         if not nat.available():
-            return
+            import pytest
+            pytest.skip(f"native unavailable: {nat.build_error()}")
         data = b"".join(
             encode_frame(MessageType.MEASUREMENT,
                          WireCodec.encode_measurement(t, ts, "m", v))
