@@ -448,10 +448,15 @@ class ColumnarEventLog:
                           np.arange(base, base + n)).astype(object)
 
         def resolve(interner, idx: np.ndarray) -> np.ndarray:
-            # vectorized index -> token gather: one snapshot of the interner
-            # (index-aligned, None at 0) then a fancy-index. The previous
-            # per-unique-value masking was O(U * n) — quadratic at 100k
-            # devices per batch.
+            # Two regimes: for small batches against a big interner, the
+            # per-unique masking is near-free; for large batches a full
+            # interner snapshot + fancy-index gather avoids the O(U * n)
+            # blowup (quadratic at 100k devices per 131k-row batch).
+            if len(interner) > 4 * n:
+                out = _obj_col(n)
+                for u in np.unique(idx):
+                    out[idx == u] = interner.token_of(int(u))
+                return out
             snap = np.array(interner.snapshot(), dtype=object)
             clipped = np.clip(idx, 0, len(snap) - 1)
             out = snap[clipped]

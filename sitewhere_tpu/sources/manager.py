@@ -8,7 +8,8 @@ Reference flow (InboundEventSource.java:189-210 / :247-294):
 
 Here the producers publish msgpack-serialized requests onto the in-proc bus
 (runtime/bus.py) keyed by device token, preserving per-device ordering into
-the TPU packing stage downstream (pipeline/ingest).
+the TPU packing stage downstream (pipeline/inbound.py; bulk alternative:
+sources/fastlane.py).
 """
 
 from __future__ import annotations
