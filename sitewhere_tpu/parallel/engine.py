@@ -21,7 +21,6 @@ from functools import partial
 from typing import List, Optional, Tuple
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -35,7 +34,8 @@ from sitewhere_tpu.ops.pack import EventBatch, batch_to_blob, blob_to_batch
 from sitewhere_tpu.parallel.mesh import SHARD_AXIS, make_mesh, shard_axis_size
 from sitewhere_tpu.parallel.router import RoutedBatches, ShardRouter
 from sitewhere_tpu.pipeline.engine import PipelineEngine
-from sitewhere_tpu.pipeline.state_tensors import DeviceStateTensors, init_device_state
+from sitewhere_tpu.pipeline.state_tensors import (
+    DeviceStateTensors, init_device_state_np)
 from sitewhere_tpu.pipeline.step import PipelineParams, ProcessOutputs, process_batch
 from sitewhere_tpu.registry.tensors import RegistryTensors
 
@@ -80,12 +80,17 @@ class ShardedPipelineEngine(PipelineEngine):
 
     def on_initialize(self, monitor) -> None:
         S = self.n_shards
-        local = init_device_state(
+        # Build the stacked initial state in host numpy and place it with ONE
+        # device_put pinned to the mesh: no op may dispatch on the default
+        # backend here — the mesh can be CPU devices inside a process whose
+        # default backend is a TPU client that is broken or absent (the
+        # driver's dryrun environment).
+        local = init_device_state_np(
             self.registry.devices.capacity // S, self.measurement_slots,
             self.max_tenants)
         stacked = jax.tree_util.tree_map(
-            lambda a: jnp.asarray(np.ascontiguousarray(
-                np.broadcast_to(np.asarray(a), (S,) + a.shape))), local)
+            lambda a: np.ascontiguousarray(
+                np.broadcast_to(a, (S,) + a.shape)), local)
         shard0 = NamedSharding(self.mesh, P(SHARD_AXIS))
         self._state = jax.device_put(
             stacked, _tree_specs(stacked, shard0))

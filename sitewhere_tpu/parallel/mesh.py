@@ -7,6 +7,7 @@ the same axis (a tenant's devices spread over all shards, stats psum'd).
 
 from __future__ import annotations
 
+import logging
 from typing import Optional, Sequence
 
 import jax
@@ -20,8 +21,24 @@ def make_mesh(n_shards: Optional[int] = None,
               devices: Optional[Sequence] = None) -> Mesh:
     devs = list(devices) if devices is not None else jax.devices()
     if n_shards is not None:
+        if n_shards > len(devs) and devices is None:
+            # Some TPU plugins ignore JAX_PLATFORMS=cpu (jax.devices() still
+            # returns the accelerator); the forced host-platform devices are
+            # still present on the cpu backend. Mesh consumers that don't
+            # pin devices explicitly (SiteWhereInstance shards>1 under such
+            # a plugin) get the same fallback as the driver dryrun — loudly,
+            # because a CPU mesh in a production process is a perf cliff.
+            cpu = jax.devices("cpu")
+            if len(cpu) >= n_shards:
+                logging.getLogger("sitewhere.parallel").warning(
+                    "make_mesh: only %d default-backend device(s) for %d "
+                    "shards; falling back to %d virtual CPU devices",
+                    len(devs), n_shards, len(cpu))
+                devs = cpu
         if n_shards > len(devs):
-            raise ValueError(f"requested {n_shards} shards, have {len(devs)} devices")
+            raise ValueError(
+                f"requested {n_shards} shards, have {len(devs)} devices "
+                f"(cpu backend has {len(jax.devices('cpu'))})")
         devs = devs[:n_shards]
     return Mesh(np.asarray(devs), (SHARD_AXIS,))
 

@@ -57,21 +57,34 @@ class DeviceStateTensors:
         return self.last_measurement.shape[1]
 
 
-def init_device_state(max_devices: int, measurement_slots: int = 32,
-                      max_tenants: int = 16) -> DeviceStateTensors:
+def init_device_state_np(max_devices: int, measurement_slots: int = 32,
+                         max_tenants: int = 16) -> DeviceStateTensors:
+    """Numpy-leaved initial state: allocates no device buffers, so callers
+    with a non-default device mesh (sharded engines, the driver's virtual CPU
+    mesh) can place the whole tree with ONE explicit device_put instead of
+    dispatching per-leaf ops on whatever backend happens to be default."""
     D, M, T = max_devices, measurement_slots, max_tenants
     return DeviceStateTensors(
-        last_interaction=jnp.full((D,), _NEG, jnp.int32),
-        present=jnp.zeros((D,), bool),
-        presence_missing_since=jnp.full((D,), _NEG, jnp.int32),
-        event_count=jnp.zeros((D,), jnp.int32),
-        last_location=jnp.zeros((D, 3), jnp.float32),
-        last_location_ts=jnp.full((D,), _NEG, jnp.int32),
-        last_measurement=jnp.zeros((D, M), jnp.float32),
-        last_measurement_ts=jnp.full((D, M), _NEG, jnp.int32),
-        last_alert_type=jnp.zeros((D,), jnp.int32),
-        last_alert_level=jnp.full((D,), -1, jnp.int32),
-        last_alert_ts=jnp.full((D,), _NEG, jnp.int32),
-        tenant_event_count=jnp.zeros((T,), jnp.int32),
-        tenant_alert_count=jnp.zeros((T,), jnp.int32),
+        last_interaction=np.full((D,), _NEG, np.int32),
+        present=np.zeros((D,), bool),
+        presence_missing_since=np.full((D,), _NEG, np.int32),
+        event_count=np.zeros((D,), np.int32),
+        last_location=np.zeros((D, 3), np.float32),
+        last_location_ts=np.full((D,), _NEG, np.int32),
+        last_measurement=np.zeros((D, M), np.float32),
+        last_measurement_ts=np.full((D, M), _NEG, np.int32),
+        last_alert_type=np.zeros((D,), np.int32),
+        last_alert_level=np.full((D,), -1, np.int32),
+        last_alert_ts=np.full((D,), _NEG, np.int32),
+        tenant_event_count=np.zeros((T,), np.int32),
+        tenant_alert_count=np.zeros((T,), np.int32),
     )
+
+
+def init_device_state(max_devices: int, measurement_slots: int = 32,
+                      max_tenants: int = 16) -> DeviceStateTensors:
+    import jax
+
+    return jax.tree_util.tree_map(
+        jnp.asarray,
+        init_device_state_np(max_devices, measurement_slots, max_tenants))
