@@ -17,17 +17,28 @@ from jax.sharding import Mesh
 SHARD_AXIS = "shard"
 
 
+def _cpu_requested() -> bool:
+    """True when this process asked jax for the cpu platform (env var or
+    config) — the only situation in which substituting virtual CPU devices
+    for a too-small default-device list is what the caller meant."""
+    import os
+
+    want = (os.environ.get("JAX_PLATFORMS", "")
+            + (jax.config.jax_platforms or ""))
+    return "cpu" in want
+
+
 def make_mesh(n_shards: Optional[int] = None,
               devices: Optional[Sequence] = None) -> Mesh:
     devs = list(devices) if devices is not None else jax.devices()
     if n_shards is not None:
-        if n_shards > len(devs) and devices is None:
+        if n_shards > len(devs) and devices is None and _cpu_requested():
             # Some TPU plugins ignore JAX_PLATFORMS=cpu (jax.devices() still
             # returns the accelerator); the forced host-platform devices are
-            # still present on the cpu backend. Mesh consumers that don't
-            # pin devices explicitly (SiteWhereInstance shards>1 under such
-            # a plugin) get the same fallback as the driver dryrun — loudly,
-            # because a CPU mesh in a production process is a perf cliff.
+            # still present on the cpu backend. The fallback engages ONLY
+            # when the caller asked for cpu (env or config) and the plugin
+            # ignored it — a production accelerator host with too few chips
+            # still fails fast below rather than silently running on CPU.
             cpu = jax.devices("cpu")
             if len(cpu) >= n_shards:
                 logging.getLogger("sitewhere.parallel").warning(

@@ -28,6 +28,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
+import re
 import threading
 import time
 import uuid
@@ -52,6 +53,12 @@ from sitewhere_tpu.model.event import (
 # tensor indices so analytics can go straight back to tensors.
 _SCHEMA = pa.schema([
     ("id", pa.string()),
+    # Hot-path rows carry (id_prefix, id_seq) instead of a per-row id string:
+    # building 131k formatted strings per batch was 70%+ of append_batch's
+    # cost. The string id is derived on read (`_derive_id`); `id` stays for
+    # control-plane events with caller-chosen ids.
+    ("id_prefix", pa.string()),
+    ("id_seq", pa.int64()),
     ("alternate_id", pa.string()),
     ("event_type", pa.int32()),
     ("device_idx", pa.int32()),
@@ -96,6 +103,13 @@ _COLUMNS = [f.name for f in _SCHEMA]
 _ID_PREFIX = uuid.uuid4().hex[:10]  # process-unique; see append_batch ids
 _INT_COLS = {f.name for f in _SCHEMA if pa.types.is_integer(f.type)}
 _FLOAT_COLS = {f.name for f in _SCHEMA if pa.types.is_floating(f.type)}
+_I64_COLS = ("event_date", "received_date", "sequence_number", "id_seq")
+
+_ID_RE = re.compile(r"ev-([0-9a-f]{10})-([0-9a-f]{12})")
+
+
+def _derive_id(prefix: str, seq: int) -> str:
+    return f"ev-{prefix}-{seq:012x}"
 
 
 @dataclass
@@ -132,12 +146,18 @@ class EventFilter:
             mask &= cols["event_date"] >= self.start_date
         if self.end_date is not None:
             mask &= cols["event_date"] <= self.end_date
+        if self.id is not None:
+            id_mask = cols["id"] == self.id
+            m = _ID_RE.fullmatch(self.id)
+            if m is not None:  # derived hot-path id: match (prefix, seq)
+                id_mask |= ((cols["id_prefix"] == m.group(1))
+                            & (cols["id_seq"] == int(m.group(2), 16)))
+            mask &= id_mask
         for attr, col in (("device_token", "device_token"),
                           ("assignment_token", "assignment_token"),
                           ("area_id", "area_id"),
                           ("customer_id", "customer_id"),
                           ("asset_id", "asset_id"),
-                          ("id", "id"),
                           ("alternate_id", "alternate_id"),
                           ("mm_name", "mm_name"),
                           ("originating_event_id", "originating_event_id"),
@@ -149,11 +169,12 @@ class EventFilter:
 
 
 class _Segment:
-    """Immutable flushed chunk: numpy column dict + min/max event_date for
-    segment pruning (the reference's Cassandra time buckets serve the same
-    skip-scan purpose)."""
+    """Immutable flushed chunk: numpy column dict + min/max skip-index over
+    event_date and device_idx for segment pruning (the reference's Cassandra
+    time buckets serve the same skip-scan purpose for time;
+    device-partitioned logs additionally skip on the device range)."""
 
-    __slots__ = ("cols", "n", "min_date", "max_date")
+    __slots__ = ("cols", "n", "min_date", "max_date", "min_dev", "max_dev")
 
     def __init__(self, cols: Dict[str, np.ndarray]):
         self.cols = cols
@@ -161,6 +182,9 @@ class _Segment:
         dates = cols["event_date"]
         self.min_date = int(dates.min()) if self.n else 0
         self.max_date = int(dates.max()) if self.n else 0
+        devs = cols["device_idx"]
+        self.min_dev = int(devs.min()) if self.n else 0
+        self.max_dev = int(devs.max()) if self.n else 0
 
     def to_arrow(self) -> pa.Table:
         arrays = []
@@ -174,8 +198,14 @@ class _Segment:
 
     @classmethod
     def from_arrow(cls, table: pa.Table) -> "_Segment":
-        cols: Dict[str, np.ndarray] = {}
+        # schema evolution: parquet written by an older build lacks newer
+        # columns (e.g. id_prefix/id_seq) — start from defaults, overwrite
+        # with whatever the file has
+        cols = _full_cols(table.num_rows)
+        names = set(table.column_names)
         for fld in _SCHEMA:
+            if fld.name not in names:
+                continue
             arr = table.column(fld.name)
             if fld.name in _INT_COLS or fld.name in _FLOAT_COLS:
                 np_dtype = arr.type.to_pandas_dtype()
@@ -233,9 +263,8 @@ def _full_cols(n: int, **given: np.ndarray) -> Dict[str, np.ndarray]:
         if name in given:
             cols[name] = given[name]
         elif name in _INT_COLS:
-            cols[name] = np.zeros(n, np.int64 if name in
-                                  ("event_date", "received_date",
-                                   "sequence_number") else np.int32)
+            cols[name] = np.zeros(n, np.int64 if name in _I64_COLS
+                                  else np.int32)
         elif name in _FLOAT_COLS:
             cols[name] = np.zeros(n, np.float32)
         else:
@@ -329,6 +358,9 @@ class TenantEventLog:
             if flt.start_date is not None and seg.max_date < flt.start_date:
                 continue
             if flt.end_date is not None and seg.min_date > flt.end_date:
+                continue
+            if flt.device_idx is not None and not (
+                    seg.min_dev <= flt.device_idx <= seg.max_dev):
                 continue
             idx = np.nonzero(flt._mask(seg.cols))[0]
             if len(idx):
@@ -431,21 +463,29 @@ class ColumnarEventLog:
         if n == 0:
             return 0
         sel = np.nonzero(valid)[0]
-        device_idx = np.asarray(batch.device_idx)[sel].astype(np.int32)
-        event_type = np.asarray(batch.event_type)[sel].astype(np.int32)
-        ts = np.asarray(batch.ts)[sel].astype(np.int64) + packer.epoch_base_ms
-        mm_idx = np.asarray(batch.mm_idx)[sel].astype(np.int32)
-        alert_type_idx = np.asarray(batch.alert_type_idx)[sel].astype(np.int32)
+        # fancy-indexing already copies; astype(copy=False) avoids a second
+        # copy per column when the dtype already matches (it always does on
+        # the hot path — EventBatch columns are i32/f32 by construction)
+        device_idx = np.asarray(batch.device_idx)[sel].astype(
+            np.int32, copy=False)
+        event_type = np.asarray(batch.event_type)[sel].astype(
+            np.int32, copy=False)
+        ts = np.add(np.asarray(batch.ts)[sel], packer.epoch_base_ms,
+                    dtype=np.int64)
+        mm_idx = np.asarray(batch.mm_idx)[sel].astype(np.int32, copy=False)
+        alert_type_idx = np.asarray(batch.alert_type_idx)[sel].astype(
+            np.int32, copy=False)
         now = received_ms if received_ms is not None else int(time.time() * 1000)
 
-        # bulk ids: <process-unique prefix>-<monotonic counter> per row;
-        # the random prefix keeps ids unique across restarts over the same
-        # parquet log (a uuid4 per row would dominate the append cost)
+        # bulk ids: <process-unique prefix> + <monotonic counter>, stored as
+        # (id_prefix, id_seq) columns. The prefix cell is ONE shared Python
+        # string (no per-row allocation); the string form "ev-<prefix>-<seq>"
+        # is derived on read — formatting 131k id strings per batch was 70%+
+        # of append cost. The random prefix keeps ids unique across restarts
+        # over the same parquet log.
         base = self._next_ids(n)
-        # vectorized sprintf: ~3x the throughput of a per-row f-string at
-        # 131k-row batches
-        ids = np.char.mod(f"ev-{_ID_PREFIX}-%012x",
-                          np.arange(base, base + n)).astype(object)
+        id_seq = np.arange(base, base + n, dtype=np.int64)
+        id_prefix = _obj_col(n, _ID_PREFIX)
 
         def resolve(interner, idx: np.ndarray) -> np.ndarray:
             # Two regimes: for small batches against a big interner, the
@@ -490,7 +530,8 @@ class ColumnarEventLog:
 
         cols = _full_cols(
             n,
-            id=ids,
+            id_prefix=id_prefix,
+            id_seq=id_seq,
             event_type=event_type,
             device_idx=device_idx,
             device_token=resolve(packer.devices, device_idx),
@@ -498,11 +539,14 @@ class ColumnarEventLog:
             received_date=np.full(n, now, np.int64),
             mm_idx=mm_idx,
             mm_name=resolve(packer.measurements, mm_idx),
-            value=np.asarray(batch.value)[sel].astype(np.float32),
-            latitude=np.asarray(batch.lat)[sel].astype(np.float32),
-            longitude=np.asarray(batch.lon)[sel].astype(np.float32),
-            elevation=np.asarray(batch.elevation)[sel].astype(np.float32),
-            alert_level=np.asarray(batch.alert_level)[sel].astype(np.int32),
+            value=np.asarray(batch.value)[sel].astype(np.float32, copy=False),
+            latitude=np.asarray(batch.lat)[sel].astype(np.float32, copy=False),
+            longitude=np.asarray(batch.lon)[sel].astype(
+                np.float32, copy=False),
+            elevation=np.asarray(batch.elevation)[sel].astype(
+                np.float32, copy=False),
+            alert_level=np.asarray(batch.alert_level)[sel].astype(
+                np.int32, copy=False),
             alert_type_idx=alert_type_idx,
             alert_type=resolve(packer.alert_types, alert_type_idx),
             **context_cols,
@@ -659,8 +703,11 @@ class ColumnarEventLog:
             return "" if v is None else str(v)
 
         meta = json.loads(s("metadata")) if cols["metadata"][i] else {}
+        event_id = cols["id"][i]
+        if event_id is None and cols["id_prefix"][i] is not None:
+            event_id = _derive_id(cols["id_prefix"][i], int(cols["id_seq"][i]))
         common = dict(
-            id=s("id"), alternate_id=s("alternate_id"), event_type=etype,
+            id=event_id or "", alternate_id=s("alternate_id"), event_type=etype,
             device_id=s("device_token"),
             device_assignment_id=s("assignment_token"),
             customer_id=s("customer_id"), area_id=s("area_id"),

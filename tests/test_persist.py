@@ -228,6 +228,81 @@ class TestEventLog:
                                          assignments[0].area_id)
         assert by_area.num_results == 8
 
+    def test_segment_pruning_skips_cold_segments(self, world, monkeypatch):
+        """The min/max skip-index must prevent full scans: a narrow
+        time-range (or device) query evaluates predicate masks only on
+        segments whose range overlaps."""
+        from sitewhere_tpu.persist import eventlog as el
+
+        mgmt, log = _mk_mgmt(world)
+        for i in range(6):  # 6 sealed segments with disjoint time ranges
+            mgmt.add_measurements("as-0", DeviceMeasurement(
+                name="m", value=float(i), event_date=10_000 * i + 5))
+            log.flush()
+        calls = []
+        orig = el.EventFilter._mask
+
+        def counting_mask(self, cols):
+            calls.append(len(cols["event_date"]))
+            return orig(self, cols)
+
+        monkeypatch.setattr(el.EventFilter, "_mask", counting_mask)
+        res = log.query("default", EventFilter(
+            start_date=20_000, end_date=20_010))
+        assert res.num_results == 1
+        assert len(calls) == 1  # 5 of 6 segments pruned without a mask eval
+        calls.clear()
+        # device pruning: no segment contains device_idx 9999
+        log.query("default", EventFilter(device_idx=9999))
+        assert calls == []
+
+    def test_derived_hot_path_ids(self, world, tmp_data_dir):
+        """Hot-path rows store (id_prefix, id_seq) instead of a per-row id
+        string; the derived id must round-trip through query-by-id and
+        survive a parquet reload (restarted process = new prefix)."""
+        from sitewhere_tpu.ops.pack import EventPacker
+        from sitewhere_tpu.registry.interning import TokenInterner
+
+        interner = TokenInterner(64, "devices")
+        for i in range(4):
+            interner.intern(f"dev-{i}")
+        packer = EventPacker(batch_size=16, device_interner=interner)
+        packer.measurements.intern("temp")
+        log = ColumnarEventLog(data_dir=tmp_data_dir, segment_rows=8)
+        log.append_batch("default", _packed(packer), packer)
+        ev = log.query("default", EventFilter()).results[0]
+        assert ev.id.startswith("ev-")
+        assert log.query("default", EventFilter(id=ev.id)).num_results == 1
+        # ids are stable across queries
+        again = log.query("default", EventFilter(id=ev.id)).results[0]
+        assert again.id == ev.id
+        log.flush()
+        log2 = ColumnarEventLog(data_dir=tmp_data_dir, segment_rows=8)
+        assert log2.query("default", EventFilter(id=ev.id)).num_results == 1
+
+    def test_old_parquet_without_id_columns_loads(self, world, tmp_data_dir):
+        """Segments written before the (id_prefix, id_seq) columns existed
+        must load with defaults (schema evolution)."""
+        import os
+
+        import pyarrow.parquet as pq
+
+        log = ColumnarEventLog(data_dir=tmp_data_dir, segment_rows=8)
+        log.append_events("default",
+                          [DeviceMeasurement(id="fixed-id", name="m",
+                                             value=3.0, event_date=1234)])
+        log.flush()
+        tdir = os.path.join(tmp_data_dir, "default")
+        [name] = [f for f in os.listdir(tdir) if f.endswith(".parquet")]
+        path = os.path.join(tdir, name)
+        table = pq.read_table(path)
+        stripped = table.drop_columns(["id_prefix", "id_seq"])
+        pq.write_table(stripped, path)
+        log2 = ColumnarEventLog(data_dir=tmp_data_dir, segment_rows=8)
+        res = log2.query("default", EventFilter(id="fixed-id"))
+        assert res.num_results == 1
+        assert res.results[0].value == 3.0
+
     def test_sanitized_tenant_name_survives_reload(self, world, tmp_data_dir):
         log = ColumnarEventLog(data_dir=tmp_data_dir, segment_rows=8)
         log.append_events("acme/eu", [DeviceMeasurement(name="m", value=1.0)])
