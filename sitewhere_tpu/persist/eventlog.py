@@ -32,6 +32,7 @@ import re
 import threading
 import time
 import uuid
+import weakref
 from dataclasses import dataclass, field
 from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
 
@@ -106,6 +107,23 @@ _FLOAT_COLS = {f.name for f in _SCHEMA if pa.types.is_floating(f.type)}
 _I64_COLS = ("event_date", "received_date", "sequence_number", "id_seq")
 
 _ID_RE = re.compile(r"ev-([0-9a-f]{10})-([0-9a-f]{12})")
+
+# interner -> (length-at-snapshot, object-array snapshot); see resolve()
+_SNAPSHOT_CACHE = weakref.WeakKeyDictionary()
+
+
+def _snapshot_array(interner) -> np.ndarray:
+    # Keyed on the interner's mutation version (not its length: a
+    # checkpoint restore can swap same-length contents).
+    version = getattr(interner, "version", None)
+    if version is None:  # foreign interner-like object: don't cache
+        return np.array(interner.snapshot(), dtype=object)
+    cached = _SNAPSHOT_CACHE.get(interner)
+    if cached is not None and cached[0] == version:
+        return cached[1]
+    snap = np.array(interner.snapshot(), dtype=object)
+    _SNAPSHOT_CACHE[interner] = (version, snap)
+    return snap
 
 
 def _derive_id(prefix: str, seq: int) -> str:
@@ -190,7 +208,11 @@ class _Segment:
         arrays = []
         for fld in _SCHEMA:
             col = self.cols[fld.name]
-            if fld.name == "stream_data":
+            if _is_const(col) and _const_value(col) is None:
+                arrays.append(pa.nulls(len(col), type=fld.type))
+            elif _is_const(col):
+                arrays.append(pa.array(list(col), type=fld.type))
+            elif fld.name == "stream_data":
                 arrays.append(pa.array(list(col), type=pa.binary()))
             else:
                 arrays.append(pa.array(col, type=fld.type))
@@ -201,7 +223,7 @@ class _Segment:
         # schema evolution: parquet written by an older build lacks newer
         # columns (e.g. id_prefix/id_seq) — start from defaults, overwrite
         # with whatever the file has
-        cols = _full_cols(table.num_rows)
+        cols = _full_cols(table.num_rows, const_strings=True)
         names = set(table.column_names)
         for fld in _SCHEMA:
             if fld.name not in names:
@@ -212,9 +234,24 @@ class _Segment:
                 cols[fld.name] = np.asarray(
                     arr.fill_null(0).to_numpy(zero_copy_only=False),
                     dtype=np_dtype)
+            elif arr.null_count == len(arr):
+                cols[fld.name] = _const_col(table.num_rows)
             else:
                 cols[fld.name] = np.asarray(arr.to_pylist(), dtype=object)
         return cls(cols)
+
+
+def _merge_col(parts: List[np.ndarray]) -> np.ndarray:
+    """Concatenate column chunks, keeping const views const: merging
+    all-None (or same-prefix) const columns must not materialize the 8n
+    bytes a const view exists to avoid."""
+    if len(parts) == 1:
+        return parts[0]
+    if all(_is_const(p) for p in parts):
+        shared = next((_const_value(p) for p in parts if len(p)), None)
+        if all(len(p) == 0 or _const_value(p) is shared for p in parts):
+            return _const_col(sum(len(p) for p in parts), shared)
+    return np.concatenate(parts)
 
 
 class _ColumnBuffer:
@@ -229,10 +266,8 @@ class _ColumnBuffer:
         self.n += n
 
     def _merge(self) -> Dict[str, np.ndarray]:
-        return {
-            name: np.concatenate([c[name] for c in self.chunks])
-            for name in _COLUMNS
-        }
+        return {name: _merge_col([c[name] for c in self.chunks])
+                for name in _COLUMNS}
 
     def drain(self) -> Optional[_Segment]:
         if not self.chunks:
@@ -256,8 +291,31 @@ def _obj_col(n: int, value: Any = None) -> np.ndarray:
     return out
 
 
-def _full_cols(n: int, **given: np.ndarray) -> Dict[str, np.ndarray]:
-    """Build a complete column dict; unspecified columns default to 0/None."""
+def _const_col(n: int, value: Any = None) -> np.ndarray:
+    """All-`value` object column as a stride-0 broadcast view: 8 bytes of
+    storage instead of 8n. Appending 131k-row batches was dominated by
+    page-faulting ~20 fresh 1MB all-None object arrays per batch (cost grows
+    with process RSS); a read-only view sidesteps the allocation entirely.
+    Reads (fancy indexing, ==, scalar access) behave like a real column."""
+    base = np.empty((), object)
+    base[()] = value
+    return np.broadcast_to(base, (n,))
+
+
+def _const_value(col: np.ndarray) -> Any:
+    """The shared value of a stride-0 const column (None for empty)."""
+    return col[0] if len(col) else None
+
+
+def _is_const(col: np.ndarray) -> bool:
+    return col.dtype == object and col.ndim == 1 and col.strides == (0,)
+
+
+def _full_cols(n: int, const_strings: bool = False,
+               **given: np.ndarray) -> Dict[str, np.ndarray]:
+    """Build a complete column dict; unspecified columns default to 0/None.
+    `const_strings=True` makes defaulted object columns read-only const
+    views (hot path); leave False when rows are filled in afterwards."""
     cols: Dict[str, np.ndarray] = {}
     for name in _COLUMNS:
         if name in given:
@@ -267,6 +325,8 @@ def _full_cols(n: int, **given: np.ndarray) -> Dict[str, np.ndarray]:
                                   else np.int32)
         elif name in _FLOAT_COLS:
             cols[name] = np.zeros(n, np.float32)
+        elif const_strings:
+            cols[name] = _const_col(n)
         else:
             cols[name] = _obj_col(n)
     return cols
@@ -485,19 +545,21 @@ class ColumnarEventLog:
         # over the same parquet log.
         base = self._next_ids(n)
         id_seq = np.arange(base, base + n, dtype=np.int64)
-        id_prefix = _obj_col(n, _ID_PREFIX)
+        id_prefix = _const_col(n, _ID_PREFIX)
 
         def resolve(interner, idx: np.ndarray) -> np.ndarray:
             # Two regimes: for small batches against a big interner, the
             # per-unique masking is near-free; for large batches a full
             # interner snapshot + fancy-index gather avoids the O(U * n)
-            # blowup (quadratic at 100k devices per 131k-row batch).
+            # blowup (quadratic at 100k devices per 131k-row batch). The
+            # object-array snapshot is cached while the interner doesn't
+            # grow (token slots are append-only, so length is a version).
             if len(interner) > 4 * n:
                 out = _obj_col(n)
                 for u in np.unique(idx):
                     out[idx == u] = interner.token_of(int(u))
                 return out
-            snap = np.array(interner.snapshot(), dtype=object)
+            snap = _snapshot_array(interner)
             clipped = np.clip(idx, 0, len(snap) - 1)
             out = snap[clipped]
             out[idx >= len(snap)] = None
@@ -530,6 +592,7 @@ class ColumnarEventLog:
 
         cols = _full_cols(
             n,
+            const_strings=True,
             id_prefix=id_prefix,
             id_seq=id_seq,
             event_type=event_type,

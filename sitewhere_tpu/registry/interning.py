@@ -43,6 +43,10 @@ class TokenInterner:
         self._to_index: Dict[str, int] = {}
         self._to_token: List[Optional[str]] = [None]  # index 0 = UNKNOWN
         self._lock = threading.Lock()
+        # Bumped on every mutation INCLUDING restore(): length alone is not
+        # a valid cache key for snapshot consumers — a checkpoint restore
+        # can swap same-length contents.
+        self.version = 0
         nat = _native()
         self._nat = nat.NativeInterner(capacity) if nat else None
 
@@ -69,9 +73,17 @@ class TokenInterner:
                 self._raise_capacity()
             self._to_token.append(token)
             self._to_index[token] = idx
+            self.version += 1
             if self._nat is not None:
                 nidx = self._nat.add(token)
-                assert nidx == idx, "native interner out of sync"
+                if nidx != idx:
+                    # survives `python -O`, unlike an assert: a silent
+                    # native/Python desync would corrupt every later
+                    # native-path lookup
+                    from sitewhere_tpu.errors import ErrorCode, SiteWhereError
+                    raise SiteWhereError(
+                        f"interner '{self.name}' native mirror out of sync "
+                        f"(native {nidx} != {idx})", ErrorCode.GENERIC)
             return idx
 
     def lookup(self, token: str) -> int:
@@ -143,6 +155,8 @@ class TokenInterner:
         """Mirror tokens the native table assigned that Python hasn't seen.
         Caller holds self._lock."""
         n = len(self._nat)
+        if len(self._to_token) < n:
+            self.version += 1
         while len(self._to_token) < n:
             idx = len(self._to_token)
             token = self._nat.token_at(idx)
@@ -159,8 +173,11 @@ class TokenInterner:
             self._to_token = list(tokens) if tokens else [None]
             if not self._to_token or self._to_token[0] is not None:
                 self._to_token.insert(0, None)
+            if len(self._to_token) > self.capacity:
+                self._raise_capacity()
             self._to_index = {t: i for i, t in enumerate(self._to_token)
                               if t is not None}
+            self.version += 1
             if self._nat is not None:
                 nat = _native()
                 self._nat = nat.NativeInterner(self.capacity)
@@ -168,4 +185,10 @@ class TokenInterner:
                     # snapshots may hold None gaps (never valid mid-stream);
                     # keep native slot numbering aligned with an
                     # un-lookupable placeholder
-                    self._nat.add(t if t is not None else f"\x00gap{i}")
+                    if self._nat.add(t if t is not None else f"\x00gap{i}") \
+                            == -1:
+                        from sitewhere_tpu.errors import (
+                            ErrorCode, SiteWhereError)
+                        raise SiteWhereError(
+                            f"interner '{self.name}' native rebuild failed "
+                            f"at slot {i}", ErrorCode.GENERIC)

@@ -151,8 +151,8 @@ def event_sources_model() -> ElementModel:
         name="decoder", role="event-source-decoder", optional=False,
         attributes=[
             _attr("type", required=True,
-                  choices=["wire", "json-batch", "json-request", "scripted",
-                           "composite"]),
+                  choices=["wire", "protobuf", "json-batch", "json-request",
+                           "scripted", "composite"]),
             _attr("script", AttributeType.SCRIPT,
                   description="for type=scripted"),
         ])

@@ -11,6 +11,7 @@ flow of InboundEventSource.onEncodedEventReceived
 from sitewhere_tpu.sources.decoders import (
     CompositeDecoder, DecodedRequest, DecodeError, JsonBatchDecoder,
     JsonRequestDecoder, ScriptedDecoder, WireDecoder)
+from sitewhere_tpu.transport.protobuf_compat import ProtobufCompatDecoder
 from sitewhere_tpu.sources.dedup import (
     AlternateIdDeduplicator, ScriptedDeduplicator)
 from sitewhere_tpu.sources.manager import (
@@ -21,7 +22,8 @@ from sitewhere_tpu.sources.receivers import (
 
 __all__ = [
     "CompositeDecoder", "DecodedRequest", "DecodeError", "JsonBatchDecoder",
-    "JsonRequestDecoder", "ScriptedDecoder", "WireDecoder",
+    "JsonRequestDecoder", "ProtobufCompatDecoder", "ScriptedDecoder",
+    "WireDecoder",
     "AlternateIdDeduplicator", "ScriptedDeduplicator",
     "EventSourcesManager", "InboundEventSource",
     "CoapEventReceiver", "HttpEventReceiver", "MqttEventReceiver",

@@ -21,8 +21,11 @@ option is (sources/activemq/ActiveMQBroker).
 from sitewhere_tpu.transport.wire import (
     MessageType, WireCodec, WireError, decode_frames, encode_frame)
 from sitewhere_tpu.transport.mqtt import MqttBroker, MqttClient
+from sitewhere_tpu.transport.protobuf_compat import (
+    ProtobufCompatDecoder, ProtobufSpecCommandEncoder)
 
 __all__ = [
     "MessageType", "WireCodec", "WireError", "decode_frames", "encode_frame",
     "MqttBroker", "MqttClient",
+    "ProtobufCompatDecoder", "ProtobufSpecCommandEncoder",
 ]

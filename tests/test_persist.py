@@ -280,6 +280,26 @@ class TestEventLog:
         log2 = ColumnarEventLog(data_dir=tmp_data_dir, segment_rows=8)
         assert log2.query("default", EventFilter(id=ev.id)).num_results == 1
 
+    def test_interner_restore_invalidates_snapshot_cache(self, world):
+        """A checkpoint restore with same-length, different tokens must not
+        serve stale device_token strings from the cached snapshot array."""
+        from sitewhere_tpu.ops.pack import EventPacker
+        from sitewhere_tpu.registry.interning import TokenInterner
+
+        interner = TokenInterner(64, "devices")
+        for i in range(4):
+            interner.intern(f"old-{i}")
+        packer = EventPacker(batch_size=16, device_interner=interner)
+        packer.measurements.intern("temp")
+        log = ColumnarEventLog(segment_rows=64)
+        log.append_batch("default", _packed(packer), packer)
+        assert log.query("default", EventFilter(
+            device_token="old-1")).num_results > 0
+        interner.restore([None, "new-0", "new-1", "new-2", "new-3"])
+        log.append_batch("default", _packed(packer), packer)
+        res = log.query("default", EventFilter(device_token="new-1"))
+        assert res.num_results > 0  # stale cache would still say "old-1"
+
     def test_old_parquet_without_id_columns_loads(self, world, tmp_data_dir):
         """Segments written before the (id_prefix, id_seq) columns existed
         must load with defaults (schema evolution)."""
