@@ -67,11 +67,19 @@ class ShardedPipelineEngine(PipelineEngine):
         self.packer = EventPacker(per_shard_batch * self.n_shards,
                                   registry_tensors.devices)
         self._sharded_step = None  # built lazily once specs are known
-        # shard-overflow events requeued ahead of the next submit; bounded so
-        # a pathological hot shard cannot grow the host queue without limit
+        # shard-overflow events requeued ahead of the next submit; when the
+        # backlog exceeds the bound, submit() drains it with extra steps
+        # (backpressure) instead of dropping rows
         self._overflow: Optional[EventBatch] = None
         self.max_overflow_events = per_shard_batch * self.n_shards * 4
-        self.total_dropped = 0  # overflow beyond the bound (permanent loss)
+        self.total_dropped = 0  # kept for the stats contract; stays 0
+        self.drain_steps = 0
+        # alerts fired during drain steps, delivered on the next
+        # materialize_alerts call (drain outputs never reach the caller);
+        # bounded so a caller that never materializes can't leak memory —
+        # overflow is counted on alerts_dropped like any bounded drop
+        self._pending_alerts: List[DeviceAlert] = []
+        self.max_pending_alerts = 65536
 
     def _target_platform(self) -> str:
         return self.mesh.devices.flat[0].platform
@@ -185,10 +193,17 @@ class ShardedPipelineEngine(PipelineEngine):
 
     def submit(self, batch: EventBatch) -> Tuple[EventBatch, ProcessOutputs]:
         """Route a flat host batch (global indices, any length) to shards and
-        run one collective step. Returns (routed batch with a [S, B] layout,
-        outputs). Events overflowing a shard's capacity are requeued ahead of
-        the next submit (at-least-once; order per device preserved because
-        overflow rows predate the next batch's rows)."""
+        run one collective step. Returns (the LAST routed batch with a
+        [S, B] layout, outputs of the last step). Events overflowing a
+        shard's capacity are requeued ahead of the next submit
+        (at-least-once; order per device preserved because overflow rows
+        predate the next batch's rows).
+
+        Backpressure instead of loss: when sustained skew piles overflow
+        past `max_overflow_events`, submit runs extra drain steps (overflow
+        only, no new events) until the backlog fits. The call gets slower —
+        which is the signal the caller needs — and `total_dropped` stays 0;
+        `drain_steps` counts the extra steps for observability."""
         from sitewhere_tpu.parallel.router import concat_flat_batches
 
         params = self._ensure_params()
@@ -196,23 +211,41 @@ class ShardedPipelineEngine(PipelineEngine):
             batch = concat_flat_batches([self._overflow, batch])
             self._overflow = None
         routed = self.router.route_columns(batch)
-        if routed.overflow is not None:
-            n_over = routed.overflow_count
-            if n_over > self.max_overflow_events:
-                self.total_dropped += n_over - self.max_overflow_events
-                keep = jax.tree_util.tree_map(
-                    lambda a: a[:self.max_overflow_events], routed.overflow)
-                self._overflow = keep
-            else:
-                self._overflow = routed.overflow
+        routed_batch, outputs = self._one_step(params, routed.batch)
+        self._overflow = routed.overflow
+        while (self._overflow is not None
+               and int(self._overflow.valid.sum()) > self.max_overflow_events):
+            # the caller only sees the LAST step; materialize the alerts of
+            # the step that is about to be superseded so they aren't lost
+            room = self.max_pending_alerts - len(self._pending_alerts)
+            stash = self._materialize_routed(routed_batch, outputs)
+            if len(stash) > room:
+                dropped = len(stash) - max(0, room)
+                self.alerts_dropped += dropped
+                self._metrics.counter("alerts.dropped").inc(dropped)
+            self._pending_alerts.extend(stash[:max(0, room)])
+            backlog = self._overflow
+            self._overflow = None
+            routed = self.router.route_columns(backlog)
+            self.drain_steps += 1
+            self._metrics.counter("overflow.drain_steps").inc()
+            routed_batch, outputs = self._one_step(params, routed.batch)
+            self._overflow = routed.overflow
+        return routed_batch, outputs
+
+    def _one_step(self, params, routed_batch: EventBatch
+                  ) -> Tuple[EventBatch, ProcessOutputs]:
         shard0 = NamedSharding(self.mesh, P(SHARD_AXIS))
-        blob = jax.device_put(batch_to_blob(routed.batch), shard0)
+        blob = jax.device_put(batch_to_blob(routed_batch), shard0)
         with self._metrics.timer("step").time():
             self._state, outputs = self._sharded_step(params, self._state,
                                                       blob)
         self.batches_processed += 1
-        self._metrics.meter("events").mark(int(np.asarray(batch.valid).sum()))
-        return routed.batch, outputs
+        # rows actually stepped this call: overflow rows are counted by the
+        # step that eventually carries them, so each event marks exactly once
+        self._metrics.meter("events").mark(
+            int(np.asarray(routed_batch.valid).sum()))
+        return routed_batch, outputs
 
     def submit_routed(self, batch: EventBatch):
         """See PipelineEngine.submit_routed: sharded submit already returns
@@ -221,7 +254,18 @@ class ShardedPipelineEngine(PipelineEngine):
 
     def materialize_alerts(self, routed_batch: EventBatch,
                            outputs: ProcessOutputs,
-                           max_alerts: int = 1024) -> List[DeviceAlert]:
+                           max_alerts: Optional[int] = None
+                           ) -> List[DeviceAlert]:
+        """Alerts for the last submit, plus any stashed during overflow
+        drain steps (see submit())."""
+        pending, self._pending_alerts = self._pending_alerts, []
+        return pending + self._materialize_routed(routed_batch, outputs,
+                                                  max_alerts)
+
+    def _materialize_routed(self, routed_batch: EventBatch,
+                            outputs: ProcessOutputs,
+                            max_alerts: Optional[int] = None
+                            ) -> List[DeviceAlert]:
         """Flatten [S, B] rows back to a flat batch with GLOBAL device indices
         and reuse the base materializer."""
         S, B = routed_batch.valid.shape
@@ -283,6 +327,7 @@ class ShardedPipelineEngine(PipelineEngine):
         return {
             "batches": self.batches_processed,
             "dropped": self.total_dropped,
+            "drain_steps": self.drain_steps,
             "pending_overflow": self.pending_overflow,
             "tenant_event_count": np.asarray(s.tenant_event_count).sum(0).tolist(),
             "tenant_alert_count": np.asarray(s.tenant_alert_count).sum(0).tolist(),

@@ -105,6 +105,7 @@ class PipelineEngine(LifecycleComponent):
         self._step_blob = jax.jit(step_blob, donate_argnums=(1,))
         self._presence = jax.jit(check_presence, donate_argnums=(0,))
         self.batches_processed = 0
+        self.alerts_dropped = 0  # only when a caller bounds materialization
 
     def _target_platform(self) -> str:
         """Platform the step will compile for (sharded engines override from
@@ -245,12 +246,28 @@ class PipelineEngine(LifecycleComponent):
         return batch, self.submit(batch)
 
     def materialize_alerts(self, batch: EventBatch, outputs: ProcessOutputs,
-                           max_alerts: int = 1024) -> List[DeviceAlert]:
+                           max_alerts: Optional[int] = None
+                           ) -> List[DeviceAlert]:
         """Turn fired-rule masks back into API-level DeviceAlert events
-        (host-side; only fired rows cross the host boundary)."""
+        (host-side; only fired rows cross the host boundary).
+
+        All fired rows materialize by default. A `max_alerts` bound no
+        longer drops the tail silently (an alert storm is exactly when
+        alerts matter): overflow is counted on `alerts_dropped`, surfaced
+        as a metric, and logged."""
         thr_fired = np.asarray(outputs.threshold_fired)
         geo_fired = np.asarray(outputs.geofence_fired)
-        fired_rows = np.nonzero(thr_fired | geo_fired)[0][:max_alerts]
+        fired_rows = np.nonzero(thr_fired | geo_fired)[0]
+        if max_alerts is not None and fired_rows.size > max_alerts:
+            dropped = int(fired_rows.size) - max_alerts
+            self.alerts_dropped += dropped
+            self._metrics.counter("alerts.dropped").inc(dropped)
+            import logging
+            logging.getLogger("sitewhere.pipeline").warning(
+                "alert storm: %d fired rows exceed max_alerts=%d; "
+                "dropping %d (alerts_dropped=%d total)",
+                fired_rows.size, max_alerts, dropped, self.alerts_dropped)
+            fired_rows = fired_rows[:max_alerts]
         if fired_rows.size == 0:
             return []
         device_idx = np.asarray(batch.device_idx)

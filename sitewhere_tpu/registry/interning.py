@@ -170,11 +170,14 @@ class TokenInterner:
     def restore(self, tokens: Sequence[Optional[str]]) -> None:
         """Rebuild from a snapshot (checkpoint restore)."""
         with self._lock:
-            self._to_token = list(tokens) if tokens else [None]
-            if not self._to_token or self._to_token[0] is not None:
-                self._to_token.insert(0, None)
-            if len(self._to_token) > self.capacity:
+            incoming = list(tokens) if tokens else [None]
+            if not incoming or incoming[0] is not None:
+                incoming.insert(0, None)
+            # validate BEFORE mutating: raising mid-swap would leave
+            # _to_token and _to_index answering from different snapshots
+            if len(incoming) > self.capacity:
                 self._raise_capacity()
+            self._to_token = incoming
             self._to_index = {t: i for i, t in enumerate(self._to_token)
                               if t is not None}
             self.version += 1

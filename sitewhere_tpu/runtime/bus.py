@@ -247,10 +247,19 @@ class ConsumerGroup:
                     self.position[idx] = rows[-1][0] + 1
                     budget -= len(rows)
         if not out and timeout_s > 0:
-            for idx, part in enumerate(self.topic.partitions):
-                if part.wait_for_data(self.position[idx], timeout_s):
-                    return self.poll(max_records, 0.0)
-            return []
+            # Deadline-based wait ACROSS partitions: waiting the full
+            # timeout on each partition in turn would block a
+            # multi-partition idle topic for partitions * timeout (a
+            # remote long-poll would outlive its client's socket timeout).
+            deadline = time.monotonic() + timeout_s
+            while True:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return []
+                slice_s = min(remaining, 0.05)
+                for idx, part in enumerate(self.topic.partitions):
+                    if part.wait_for_data(self.position[idx], slice_s):
+                        return self.poll(max_records, 0.0)
         return out
 
     def commit(self) -> None:
@@ -348,20 +357,35 @@ class ConsumerHost:
     """Background poll loop driving a handler with batches — the reference's
     MicroserviceKafkaConsumer single-thread poll loop (:115-121) as a
     lifecycle-managed thread. Handler exceptions leave offsets uncommitted so
-    the batch redelivers."""
+    the batch redelivers — but only `max_retries` times, with exponential
+    backoff between attempts (0.05s doubling to `max_backoff_s`, ~2 min
+    total at the defaults) so transient downstream outages are ridden out;
+    a batch still failing after that is treated as deterministically
+    poisonous, parks on the dead-letter topic, and offsets advance instead
+    of redelivering forever. The reference parks failures the same way
+    (failed-decode / undelivered topics, KafkaTopicNaming.java:48,69)."""
 
     def __init__(self, bus: EventBus, topic_name: str, group_id: str,
                  handler: Callable[[List[Record]], None],
-                 max_records: int = 4096, poll_timeout_s: float = 0.2):
+                 max_records: int = 4096, poll_timeout_s: float = 0.2,
+                 max_retries: int = 12, max_backoff_s: float = 30.0,
+                 dead_letter_topic: Optional[str] = None):
         self._bus = bus
         self._topic_name = topic_name
         self._group_id = group_id
         self._handler = handler
         self._max_records = max_records
         self._poll_timeout_s = poll_timeout_s
+        self._max_retries = max_retries
+        self._max_backoff_s = max_backoff_s
+        self.dead_letter_topic = (dead_letter_topic
+                                  or f"{topic_name}.dead-letter")
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.errors = 0
+        self.dead_lettered = 0
+        # (position fingerprint of the failing batch, consecutive failures)
+        self._failing: Optional[Tuple[Tuple[int, ...], int]] = None
 
     def start(self) -> None:
         if self._thread is not None:
@@ -370,6 +394,15 @@ class ConsumerHost:
         self._thread = threading.Thread(
             target=self._run, name=f"consumer-{self._group_id}", daemon=True)
         self._thread.start()
+
+    def _park(self, batch: List[Record]) -> None:
+        """Publish a poisonous batch to the dead-letter topic; caller then
+        commits past it. Key/value pass through unchanged so a repair tool
+        can replay them onto the source topic."""
+        dlq = self._bus.topic(self.dead_letter_topic)
+        for record in batch:
+            dlq.publish(record.key, record.value)
+        self.dead_lettered += len(batch)
 
     def _run(self) -> None:
         consumer = self._bus.consumer(self._topic_name, self._group_id)
@@ -381,10 +414,24 @@ class ConsumerHost:
             try:
                 self._handler(batch)
                 self._bus.commit(consumer)
+                self._failing = None
             except Exception:
                 self.errors += 1
-                consumer.seek_to_committed()
-                time.sleep(0.05)
+                fingerprint = tuple(consumer.committed)
+                if self._failing and self._failing[0] == fingerprint:
+                    retries = self._failing[1] + 1
+                else:
+                    retries = 1
+                self._failing = (fingerprint, retries)
+                if retries > self._max_retries:
+                    self._park(batch)
+                    self._bus.commit(consumer)  # advance past the poison
+                    self._failing = None
+                else:
+                    consumer.seek_to_committed()
+                    backoff = min(0.05 * (2 ** (retries - 1)),
+                                  self._max_backoff_s)
+                    self._stop.wait(backoff)
 
     def stop(self, timeout_s: float = 5.0) -> None:
         self._stop.set()

@@ -1,0 +1,322 @@
+"""Networked bus edge: TCP producer/consumer endpoint for the event bus.
+
+Reference: Kafka is a *network* broker — any process can produce to or
+consume from a topic (MicroserviceKafkaConsumer.java:115-121 polls over the
+wire). The in-proc `runtime.bus.EventBus` replaces the broker for the
+single-host fast path; this module is the pod-edge complement: a TPU-host
+process runs `BusServer` over its bus, and edge processes (gateway boxes,
+protocol bridges, non-TPU ingest tiers) use `BusClient` /
+`RemoteConsumerHost` to publish and consume over TCP with the same
+at-least-once committed-offset semantics.
+
+Protocol: length-prefixed msgpack frames, one request -> one response per
+frame, pipelined per connection. Batched publishes amortize round-trips
+(the DeviceEventBuffer trade); polls long-poll server-side so edge
+consumers don't spin.
+"""
+
+from __future__ import annotations
+
+import socket
+import socketserver
+import struct
+import threading
+import time
+from typing import Callable, List, Optional, Tuple
+
+import msgpack
+
+from sitewhere_tpu.runtime.bus import EventBus, Record
+
+_LEN = struct.Struct("<I")
+_MAX_FRAME = 64 * 1024 * 1024
+
+
+class BusNetError(Exception):
+    """Protocol or transport failure on the networked bus edge."""
+
+
+def _read_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise BusNetError("connection closed")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def _send_frame(sock: socket.socket, obj) -> None:
+    payload = msgpack.packb(obj, use_bin_type=True)
+    if len(payload) > _MAX_FRAME:
+        raise BusNetError(f"frame {len(payload)} exceeds {_MAX_FRAME}")
+    sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+def _recv_frame(sock: socket.socket):
+    (length,) = _LEN.unpack(_read_exact(sock, _LEN.size))
+    if length > _MAX_FRAME:
+        raise BusNetError(f"frame {length} exceeds {_MAX_FRAME}")
+    return msgpack.unpackb(_read_exact(sock, length), raw=False)
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self) -> None:
+        bus: EventBus = self.server.bus  # type: ignore[attr-defined]
+        sock = self.request
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        while True:
+            try:
+                req = _recv_frame(sock)
+            except BusNetError:
+                return  # client went away
+            try:
+                _send_frame(sock, self._dispatch(bus, req))
+            except BusNetError:
+                return
+            except Exception as exc:  # report, keep the connection
+                try:
+                    _send_frame(sock, {"ok": False, "error": str(exc)})
+                except BusNetError:
+                    return
+
+    @staticmethod
+    def _dispatch(bus: EventBus, req) -> dict:
+        op = req.get("op")
+        if op == "publish":
+            topic = bus.topic(req["topic"])
+            results = [topic.publish(key, value)
+                       for key, value in req["records"]]
+            return {"ok": True, "count": len(results),
+                    "last": results[-1] if results else None}
+        if op == "poll":
+            consumer = bus.consumer(req["topic"], req["group"])
+            batch = consumer.poll(req.get("max", 4096),
+                                  timeout_s=min(float(req.get("timeout_s",
+                                                              0.0)), 30.0))
+            return {"ok": True, "records": [
+                [r.partition, r.offset, r.key, r.value, r.timestamp_ms]
+                for r in batch]}
+        if op == "commit":
+            bus.commit(bus.consumer(req["topic"], req["group"]))
+            return {"ok": True}
+        if op == "seek_committed":
+            bus.consumer(req["topic"], req["group"]).seek_to_committed()
+            return {"ok": True}
+        if op == "end_offsets":
+            return {"ok": True,
+                    "offsets": bus.topic(req["topic"]).end_offsets()}
+        if op == "topics":
+            return {"ok": True, "topics": bus.topics()}
+        if op == "ping":
+            return {"ok": True, "ts": int(time.time() * 1000)}
+        return {"ok": False, "error": f"unknown op {op!r}"}
+
+
+class _Server(socketserver.ThreadingTCPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+
+class BusServer:
+    """Expose an EventBus on TCP (the broker's network face)."""
+
+    def __init__(self, bus: EventBus, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.bus = bus
+        self._server = _Server((host, port), _Handler)
+        self._server.bus = bus  # type: ignore[attr-defined]
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        name="bus-server", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        if self._thread is None:
+            return
+        self._server.shutdown()
+        self._server.server_close()
+        self._thread.join(timeout=5.0)
+        self._thread = None
+
+
+class BusClient:
+    """Edge-process handle onto a remote bus. Thread-safe (one in-flight
+    request per connection); reconnects on transport failure — safe because
+    every operation is idempotent-or-at-least-once (a retried publish can
+    duplicate, exactly the at-least-once contract)."""
+
+    def __init__(self, host: str, port: int, timeout_s: float = 35.0,
+                 retries: int = 2):
+        self.host = host
+        self.port = port
+        self.timeout_s = timeout_s
+        self.retries = retries
+        self._sock: Optional[socket.socket] = None
+        self._lock = threading.Lock()
+
+    def _connect(self) -> socket.socket:
+        if self._sock is None:
+            sock = socket.create_connection((self.host, self.port),
+                                            timeout=self.timeout_s)
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._sock = sock
+        return self._sock
+
+    def _rpc(self, req: dict, pre_retry: Optional[dict] = None) -> dict:
+        """One request/response. On transport failure, reconnect and retry;
+        `pre_retry` is sent first after a reconnect — poll uses it to re-seek
+        the server-side cursor to committed, because a poll whose RESPONSE
+        was lost already advanced the position (retrying blindly would skip
+        those records and the next commit would lose them permanently)."""
+        with self._lock:
+            last: Optional[Exception] = None
+            for attempt in range(self.retries + 1):
+                try:
+                    sock = self._connect()
+                    if pre_retry is not None and attempt > 0:
+                        _send_frame(sock, pre_retry)
+                        ack = _recv_frame(sock)
+                        if not ack.get("ok"):
+                            raise BusNetError(
+                                ack.get("error", "pre-retry failed"))
+                    _send_frame(sock, req)
+                    resp = _recv_frame(sock)
+                    if not resp.get("ok"):
+                        raise BusNetError(resp.get("error", "request failed"))
+                    return resp
+                except (OSError, BusNetError) as exc:
+                    if isinstance(exc, BusNetError) and self._sock is not None:
+                        # protocol-level error on a healthy connection:
+                        # don't burn the socket or retry a rejected request
+                        if str(exc) != "connection closed":
+                            raise
+                    last = exc
+                    self.close()
+            raise BusNetError(f"bus rpc failed after retries: {last}")
+
+    def publish(self, topic: str, key: bytes, value: bytes
+                ) -> Tuple[int, int]:
+        resp = self._rpc({"op": "publish", "topic": topic,
+                          "records": [[key, value]]})
+        part, offset = resp["last"]
+        return part, offset
+
+    def publish_batch(self, topic: str,
+                      records: List[Tuple[bytes, bytes]]) -> int:
+        if not records:
+            return 0
+        return self._rpc({"op": "publish", "topic": topic,
+                          "records": [[k, v] for k, v in records]})["count"]
+
+    def poll(self, topic: str, group: str, max_records: int = 4096,
+             timeout_s: float = 0.0) -> List[Record]:
+        resp = self._rpc(
+            {"op": "poll", "topic": topic, "group": group,
+             "max": max_records, "timeout_s": timeout_s},
+            pre_retry={"op": "seek_committed", "topic": topic,
+                       "group": group})
+        return [Record(topic, part, offset, key, value, ts)
+                for part, offset, key, value, ts in resp["records"]]
+
+    def commit(self, topic: str, group: str) -> None:
+        self._rpc({"op": "commit", "topic": topic, "group": group})
+
+    def seek_committed(self, topic: str, group: str) -> None:
+        self._rpc({"op": "seek_committed", "topic": topic, "group": group})
+
+    def end_offsets(self, topic: str) -> List[int]:
+        return self._rpc({"op": "end_offsets", "topic": topic})["offsets"]
+
+    def topics(self) -> List[str]:
+        return self._rpc({"op": "topics"})["topics"]
+
+    def ping(self) -> bool:
+        try:
+            return bool(self._rpc({"op": "ping"})["ok"])
+        except BusNetError:
+            return False
+
+    def close(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            finally:
+                self._sock = None
+
+
+class RemoteConsumerHost:
+    """ConsumerHost twin for edge processes: poll/commit over a BusClient.
+    Handler exceptions leave offsets uncommitted server-side; the host
+    re-seeks to committed so the batch redelivers (at-least-once)."""
+
+    def __init__(self, client: BusClient, topic_name: str, group_id: str,
+                 handler: Callable[[List[Record]], None],
+                 max_records: int = 4096, poll_timeout_s: float = 0.5):
+        self._client = client
+        self._topic_name = topic_name
+        self._group_id = group_id
+        self._handler = handler
+        self._max_records = max_records
+        self._poll_timeout_s = poll_timeout_s
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.errors = 0
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name=f"remote-consumer-{self._group_id}",
+            daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        try:
+            self._client.seek_committed(self._topic_name, self._group_id)
+        except BusNetError:
+            pass  # server unreachable at boot: first poll retries anyway
+        while not self._stop.is_set():
+            try:
+                batch = self._client.poll(self._topic_name, self._group_id,
+                                          self._max_records,
+                                          timeout_s=self._poll_timeout_s)
+            except BusNetError:
+                self.errors += 1
+                # a failed poll may have advanced the server-side cursor
+                # (lost response): rewind to committed before polling again
+                try:
+                    self._client.seek_committed(self._topic_name,
+                                                self._group_id)
+                except BusNetError:
+                    pass
+                time.sleep(0.2)
+                continue
+            if not batch:
+                continue
+            try:
+                self._handler(batch)
+                self._client.commit(self._topic_name, self._group_id)
+            except Exception:
+                self.errors += 1
+                try:
+                    self._client.seek_committed(self._topic_name,
+                                                self._group_id)
+                except BusNetError:
+                    pass
+                time.sleep(0.05)
+
+    def stop(self, timeout_s: float = 5.0) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=timeout_s)
+            self._thread = None
