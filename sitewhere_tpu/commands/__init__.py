@@ -4,7 +4,8 @@ from sitewhere_tpu.commands.delivery import (
     CommandDeliveryService, CommandProcessingStrategy, TargetResolver)
 from sitewhere_tpu.commands.destinations import (
     CoapDeliveryProvider, CommandDestination, InProcDeliveryProvider,
-    MetadataParameterExtractor, MqttDeliveryProvider, MqttParameterExtractor)
+    MetadataParameterExtractor, MqttDeliveryProvider, MqttParameterExtractor,
+    SmsDeliveryProvider, SmsParameterExtractor)
 from sitewhere_tpu.commands.encoding import (
     CommandExecution, JsonCommandEncoder, ScriptedCommandEncoder,
     SystemCommand, WireCommandEncoder, coerce_parameters)
@@ -17,6 +18,7 @@ __all__ = [
     "DeviceTypeMappingRouter", "InProcDeliveryProvider", "JsonCommandEncoder",
     "MetadataParameterExtractor", "MqttDeliveryProvider",
     "MqttParameterExtractor", "ScriptedCommandEncoder",
-    "SingleDestinationRouter", "SystemCommand", "TargetResolver",
+    "SingleDestinationRouter", "SmsDeliveryProvider",
+    "SmsParameterExtractor", "SystemCommand", "TargetResolver",
     "WireCommandEncoder", "coerce_parameters",
 ]

@@ -153,6 +153,81 @@ class CoapDeliveryProvider(LifecycleComponent):
                    encoded)
 
 
+class SmsParameterExtractor:
+    """Phone number from device metadata (the reference's
+    SmsParameterExtractor resolves per-device SMS routing the same way)."""
+
+    def __init__(self, phone_metadata_key: str = "sms.phone"):
+        self.phone_metadata_key = phone_metadata_key
+
+    def extract(self, device: Device,
+                assignment: Optional[DeviceAssignment]) -> Dict[str, str]:
+        phone = device.metadata.get(self.phone_metadata_key, "")
+        return {"phone": phone}
+
+
+class SmsDeliveryProvider(LifecycleComponent):
+    """Deliver encoded commands as SMS messages
+    (destination/sms/SmsCommandDestination.java + Twilio provider).
+
+    Gated like the broker adapters: the Twilio client library is optional
+    in this image, so constructing with no `send_fn` requires it at start
+    (require_optional -> clear 501). A custom `send_fn(to, from_, body)`
+    plugs in any SMS gateway (and makes the provider testable in-proc).
+    Binary payloads ride base64; textual payloads go through as-is."""
+
+    def __init__(self, account_sid: str = "", auth_token: str = "",
+                 from_number: str = "",
+                 send_fn: Optional[Callable[[str, str, str], None]] = None):
+        super().__init__("sms-delivery")
+        self.account_sid = account_sid
+        self.auth_token = auth_token
+        self.from_number = from_number
+        self._send_fn = send_fn
+
+    def on_start(self, monitor) -> None:
+        if self._send_fn is None:
+            from sitewhere_tpu.sources.receivers_ext import require_optional
+            twilio_rest = require_optional("twilio.rest", "Twilio SMS")
+            client = twilio_rest.Client(self.account_sid, self.auth_token)
+
+            def send(to: str, from_: str, body: str) -> None:
+                client.messages.create(to=to, from_=from_, body=body)
+
+            self._send_fn = send
+
+    @staticmethod
+    def _as_text(encoded: bytes) -> str:
+        # Always prefixed ("txt:" / "b64:"): an unprefixed scheme would be
+        # ambiguous — a binary frame that happens to decode as UTF-8 would
+        # arrive looking like text, and the device couldn't tell which
+        # decoding to apply.
+        try:
+            return "txt:" + encoded.decode("utf-8")
+        except UnicodeDecodeError:
+            import base64
+            return "b64:" + base64.b64encode(encoded).decode("ascii")
+
+    def _send(self, device: Device, encoded: bytes,
+              parameters: Dict[str, str]) -> None:
+        if self._send_fn is None:
+            raise RuntimeError("sms delivery provider not started")
+        phone = parameters.get("phone", "")
+        if not phone:
+            from sitewhere_tpu.errors import SiteWhereError
+            raise SiteWhereError(
+                f"device {device.token} has no SMS phone number metadata")
+        self._send_fn(phone, self.from_number, self._as_text(encoded))
+
+    def deliver(self, device: Device, encoded: bytes,
+                parameters: Dict[str, str]) -> None:
+        self._send(device, encoded, parameters)
+
+    def deliver_system(self, device: Device, encoded: bytes,
+                       parameters: Dict[str, str]) -> None:
+        self._send(device, encoded, parameters)
+
+
 class InProcDeliveryProvider(LifecycleComponent):
     """Hand deliveries to a Python callback — used by tests and by co-located
     device simulators (no reference equivalent needed: the in-proc path)."""

@@ -51,7 +51,8 @@ class SiteWhereInstance(LifecycleComponent):
                  default_tenant: Optional[str] = "default",
                  admin_username: str = "admin",
                  admin_password: str = "password",
-                 shards: int = 1):
+                 shards: int = 1,
+                 tenant_datastores: Optional[Dict] = None):
         super().__init__(f"instance:{instance_id}")
         self.instance_id = instance_id
         self.data_dir = data_dir
@@ -62,6 +63,14 @@ class SiteWhereInstance(LifecycleComponent):
         log_dir = os.path.join(data_dir, "events") if data_dir else None
         self.bus = EventBus(partitions=bus_partitions, data_dir=bus_dir)
         self.event_log = ColumnarEventLog(data_dir=log_dir)
+        # per-tenant datastore choices (reference: tenants select their
+        # store via DatastoreConfigurationParser) — overrides come from the
+        # operator (config model) or `datastore.*` tenant metadata; tenants
+        # without one share self.event_log
+        from sitewhere_tpu.persist.datastore import TenantDatastoreManager
+        self.datastores = TenantDatastoreManager(
+            self.event_log, base_dir=data_dir,
+            overrides=tenant_datastores)
 
         self.registry_tensors = None
         self.pipeline_engine = None
@@ -143,7 +152,7 @@ class SiteWhereInstance(LifecycleComponent):
             store_factory = lambda kind: SqliteStore(
                 os.path.join(tenant_dir, f"{kind}.db"))
         engine = TenantEngine(
-            tenant, self.bus, self.event_log,
+            tenant, self.bus, self.datastores.event_log_for(tenant),
             pipeline_engine=self.pipeline_engine,
             registry_tensors=self.registry_tensors,
             store_factory=store_factory, naming=self.naming)
@@ -153,6 +162,7 @@ class SiteWhereInstance(LifecycleComponent):
     # -- lifecycle ---------------------------------------------------------
     def on_initialize(self, monitor) -> None:
         self.event_log.start()  # background linger-flush thread
+        self.datastores.start()
         self.bootstrap.bootstrap_users()
         if self._default_tenant:
             self.bootstrap.bootstrap_default_tenant(self._default_tenant)
@@ -174,6 +184,7 @@ class SiteWhereInstance(LifecycleComponent):
         logging.getLogger("sitewhere").removeHandler(self.log_handler)
         self.log_handler.stop()
         self.log_aggregator.stop()
+        self.datastores.stop()
         self.event_log.stop()
 
     # -- convenience accessors --------------------------------------------

@@ -1,0 +1,157 @@
+"""Per-tenant datastore configuration: the DatastoreConfigurationParser role.
+
+Reference: tenants choose their event store via configuration
+(sitewhere-configuration/src/main/java/com/sitewhere/configuration/datastore/
+DatastoreConfigurationParser.java — mongodb/influxdb/cassandra/hbase per
+tenant). This framework has ONE storage engine (the columnar Arrow/Parquet
+event log — the TPU-first answer to all four reference stores), so the
+per-tenant choice becomes: which *instance* of it, where it spills, how it
+buffers, and whether it persists at all:
+
+- kind "columnar": dedicated ColumnarEventLog for the tenant with its own
+  spill dir / segment size / linger (isolation, per-tenant retention).
+- kind "memory": dedicated in-memory log, never touches disk (dev/test or
+  data-residency-restricted tenants).
+- no override: the tenant shares the instance's default log (the default
+  single-store deployment).
+
+Configuration sources, in priority order: explicit overrides passed by the
+operator (config model `event_management.tenant_datastore` elements) and
+`datastore.*` keys in the tenant's metadata (tenant templates can set them
+— the analogue of the reference's per-tenant ZK config).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+from sitewhere_tpu.persist.eventlog import ColumnarEventLog
+
+_KINDS = ("columnar", "memory")
+
+
+@dataclass
+class DatastoreConfig:
+    """One tenant's event-store choice."""
+
+    kind: str = "columnar"           # "columnar" | "memory"
+    data_dir: Optional[str] = None   # spill dir; relative = under base dir
+    segment_rows: int = 65536
+    linger_ms: int = 250
+    spill: bool = True
+
+    def __post_init__(self) -> None:
+        if self.kind not in _KINDS:
+            raise ValueError(
+                f"unknown datastore kind {self.kind!r} (one of {_KINDS})")
+
+    @classmethod
+    def from_metadata(cls, metadata: Dict[str, str]
+                      ) -> Optional["DatastoreConfig"]:
+        """Build from `datastore.*` tenant-metadata keys; None when the
+        tenant doesn't customize (shares the instance default)."""
+        keys = {k: v for k, v in (metadata or {}).items()
+                if k.startswith("datastore.")}
+        if not keys:
+            return None
+        return cls(
+            kind=keys.get("datastore.kind", "columnar"),
+            data_dir=keys.get("datastore.data_dir") or None,
+            segment_rows=int(keys.get("datastore.segment_rows", 65536)),
+            linger_ms=int(keys.get("datastore.linger_ms", 250)),
+            spill=keys.get("datastore.spill", "true").lower()
+            in ("1", "true", "yes", "on"))
+
+
+class TenantDatastoreManager:
+    """Resolves each tenant to its event log and owns the dedicated ones.
+
+    The instance's shared default log is NOT owned here (the instance
+    starts/stops it); dedicated per-tenant logs are created lazily on first
+    resolution and lifecycle-managed by this manager.
+    """
+
+    def __init__(self, default_log: ColumnarEventLog,
+                 base_dir: Optional[str] = None,
+                 overrides: Optional[Dict[str, DatastoreConfig]] = None):
+        self.default_log = default_log
+        self.base_dir = base_dir
+        self.overrides: Dict[str, DatastoreConfig] = dict(overrides or {})
+        self._dedicated: Dict[str, ColumnarEventLog] = {}
+        self._lock = threading.Lock()
+        self._started = False
+
+    def register_override(self, tenant_token: str,
+                          config: DatastoreConfig) -> None:
+        """Operator-level override (config model tenant_datastore element).
+        Takes effect on the tenant's next resolution (engine restart)."""
+        with self._lock:
+            self.overrides[tenant_token] = config
+
+    def config_for(self, tenant) -> Optional[DatastoreConfig]:
+        """tenant: token string or Tenant model object."""
+        token = getattr(tenant, "token", tenant)
+        with self._lock:
+            if token in self.overrides:
+                return self.overrides[token]
+        return DatastoreConfig.from_metadata(
+            getattr(tenant, "metadata", None) or {})
+
+    def event_log_for(self, tenant) -> ColumnarEventLog:
+        token = getattr(tenant, "token", tenant)
+        config = self.config_for(tenant)
+        if config is None:
+            return self.default_log
+        with self._lock:
+            log = self._dedicated.get(token)
+            if log is None:
+                log = self._build(token, config)
+                self._dedicated[token] = log
+                if self._started:
+                    log.start()
+            return log
+
+    def _build(self, token: str, config: DatastoreConfig) -> ColumnarEventLog:
+        data_dir = None
+        if config.kind == "columnar":
+            data_dir = config.data_dir
+            if data_dir is None:
+                data_dir = (os.path.join(self.base_dir, "tenant-stores",
+                                         token.replace("/", "_"))
+                            if self.base_dir else None)
+            elif not os.path.isabs(data_dir) and self.base_dir:
+                data_dir = os.path.join(self.base_dir, data_dir)
+        return ColumnarEventLog(data_dir=data_dir,
+                                segment_rows=config.segment_rows,
+                                linger_ms=config.linger_ms,
+                                spill_parquet=config.spill)
+
+    def dedicated_tenants(self) -> Dict[str, str]:
+        """token -> kind, for topology/observability."""
+        with self._lock:
+            return {tok: ("columnar" if log._data_dir else "memory")
+                    for tok, log in self._dedicated.items()}
+
+    # -- lifecycle (instance calls these around its own) -------------------
+    def start(self) -> None:
+        with self._lock:
+            self._started = True
+            logs = list(self._dedicated.values())
+        for log in logs:
+            log.start()
+
+    def stop(self) -> None:
+        with self._lock:
+            self._started = False
+            logs = list(self._dedicated.values())
+        for log in logs:
+            log.stop()
+
+    def flush(self) -> None:
+        with self._lock:
+            logs = list(self._dedicated.values())
+        for log in logs:
+            log.flush()

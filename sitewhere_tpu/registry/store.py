@@ -624,3 +624,25 @@ class DeviceManagement:
                      if device_token else None)
         return self.alarms.list(
             criteria, (lambda a: a.device_id == device_id) if device_id else None)
+
+    def get_device_alarm(self, alarm_id: str) -> Optional[DeviceAlarm]:
+        return self.alarms.get(alarm_id)
+
+    def update_device_alarm(self, alarm_id: str,
+                            updates: Dict) -> DeviceAlarm:
+        """State transitions stamp their dates (the reference's
+        DeviceAlarmMarshalHelper behavior for acknowledge/resolve)."""
+        from sitewhere_tpu.model.device import DeviceAlarmState
+
+        updates = dict(updates)
+        state = updates.get("state")
+        if state is not None and not isinstance(state, DeviceAlarmState):
+            updates["state"] = state = DeviceAlarmState(state)
+        if state == DeviceAlarmState.ACKNOWLEDGED:
+            updates.setdefault("acknowledged_date", now_ms())
+        elif state == DeviceAlarmState.RESOLVED:
+            updates.setdefault("resolved_date", now_ms())
+        return self.alarms.update(alarm_id, updates)
+
+    def delete_device_alarm(self, alarm_id: str) -> DeviceAlarm:
+        return self.alarms.delete(alarm_id)

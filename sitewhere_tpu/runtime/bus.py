@@ -233,13 +233,19 @@ class ConsumerGroup:
         self.position = list(self.committed)
         self._lock = threading.Lock()
 
-    def poll(self, max_records: int = 4096, timeout_s: float = 0.0) -> List[Record]:
+    def poll(self, max_records: int = 4096, timeout_s: float = 0.0,
+             partitions: Optional[List[int]] = None) -> List[Record]:
+        """`partitions` restricts the poll to a subset (consumer-group
+        member assignment — busnet's networked groups); None = all."""
         out: List[Record] = []
+        owned = (range(len(self.topic.partitions)) if partitions is None
+                 else partitions)
         with self._lock:
             budget = max_records
-            for idx, part in enumerate(self.topic.partitions):
+            for idx in owned:
                 if budget <= 0:
                     break
+                part = self.topic.partitions[idx]
                 rows = part.read(self.position[idx], budget)
                 for offset, key, value, ts in rows:
                     out.append(Record(self.topic.name, idx, offset, key, value, ts))
@@ -253,22 +259,32 @@ class ConsumerGroup:
             # remote long-poll would outlive its client's socket timeout).
             deadline = time.monotonic() + timeout_s
             while True:
-                remaining = deadline - time.monotonic()
-                if remaining <= 0:
-                    return []
-                slice_s = min(remaining, 0.05)
-                for idx, part in enumerate(self.topic.partitions):
-                    if part.wait_for_data(self.position[idx], slice_s):
-                        return self.poll(max_records, 0.0)
+                for idx in owned:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return []
+                    part = self.topic.partitions[idx]
+                    if part.wait_for_data(self.position[idx],
+                                          min(remaining, 0.05)):
+                        return self.poll(max_records, 0.0,
+                                         partitions=partitions)
         return out
 
-    def commit(self) -> None:
+    def commit(self, partitions: Optional[List[int]] = None) -> None:
         with self._lock:
-            self.committed = list(self.position)
+            if partitions is None:
+                self.committed = list(self.position)
+            else:
+                for idx in partitions:
+                    self.committed[idx] = self.position[idx]
 
-    def seek_to_committed(self) -> None:
+    def seek_to_committed(self, partitions: Optional[List[int]] = None) -> None:
         with self._lock:
-            self.position = list(self.committed)
+            if partitions is None:
+                self.position = list(self.committed)
+            else:
+                for idx in partitions:
+                    self.position[idx] = self.committed[idx]
 
     def seek_to_beginning(self) -> None:
         with self._lock:
@@ -326,8 +342,9 @@ class EventBus:
                                                   committed)
             return self._groups[key]
 
-    def commit(self, group: ConsumerGroup) -> None:
-        group.commit()
+    def commit(self, group: ConsumerGroup,
+               partitions: Optional[List[int]] = None) -> None:
+        group.commit(partitions)
         path = self._offsets_path(group.topic.name, group.group_id)
         if path:
             tmp = path + ".tmp"
@@ -384,8 +401,9 @@ class ConsumerHost:
         self._thread: Optional[threading.Thread] = None
         self.errors = 0
         self.dead_lettered = 0
-        # (position fingerprint of the failing batch, consecutive failures)
-        self._failing: Optional[Tuple[Tuple[int, ...], int]] = None
+        # (committed-offset fingerprint, consecutive failures, batch size
+        # at first failure) of the currently-failing batch
+        self._failing: Optional[Tuple[Tuple[int, ...], int, int]] = None
 
     def start(self) -> None:
         if self._thread is not None:
@@ -408,7 +426,13 @@ class ConsumerHost:
         consumer = self._bus.consumer(self._topic_name, self._group_id)
         consumer.seek_to_committed()
         while not self._stop.is_set():
-            batch = consumer.poll(self._max_records, timeout_s=self._poll_timeout_s)
+            # During a retry cycle, poll EXACTLY the size of the batch that
+            # first failed: records arriving during the backoff must not
+            # join the retried batch, or parking would dead-letter (and
+            # commit past) innocent records that were never at fault.
+            max_records = (self._failing[2] if self._failing
+                           else self._max_records)
+            batch = consumer.poll(max_records, timeout_s=self._poll_timeout_s)
             if not batch:
                 continue
             try:
@@ -420,9 +444,11 @@ class ConsumerHost:
                 fingerprint = tuple(consumer.committed)
                 if self._failing and self._failing[0] == fingerprint:
                     retries = self._failing[1] + 1
+                    batch_len = self._failing[2]
                 else:
                     retries = 1
-                self._failing = (fingerprint, retries)
+                    batch_len = len(batch)
+                self._failing = (fingerprint, retries, batch_len)
                 if retries > self._max_retries:
                     self._park(batch)
                     self._bus.commit(consumer)  # advance past the poison

@@ -60,28 +60,83 @@ def _recv_frame(sock: socket.socket):
     return msgpack.unpackb(_read_exact(sock, length), raw=False)
 
 
+class _GroupCoordinator:
+    """Networked consumer-group membership: each connected consumer of a
+    (topic, group) is a member and owns a disjoint partition subset
+    (index i of n members owns partitions p with p % n == i — the Kafka
+    range/round-robin assignment role). Members poll and commit ONLY their
+    partitions, so one member's commit can never advance offsets past
+    another member's in-flight batch; on member loss its partitions re-seek
+    to committed and reassign to the survivors (rebalance + replay)."""
+
+    def __init__(self, bus: EventBus):
+        self.bus = bus
+        self._members: dict = {}   # (topic, group) -> list of member ids
+        self._lock = threading.Lock()
+
+    def _ensure(self, topic: str, group: str, member: int) -> bool:
+        """Register membership; True when this call changed the group."""
+        with self._lock:
+            members = self._members.setdefault((topic, group), [])
+            if member not in members:
+                members.append(member)
+                return True
+            return False
+
+    def owned(self, topic: str, group: str, member: int) -> List[int]:
+        if self._ensure(topic, group, member):
+            # Rebalance: partitions just moved between members, and a
+            # previous owner's uncommitted position advances must not leak
+            # to the new owner — everyone replays from committed
+            # (at-least-once; duplicates possible, loss not).
+            self.bus.consumer(topic, group).seek_to_committed()
+        n_parts = len(self.bus.topic(topic).partitions)
+        with self._lock:
+            members = self._members[(topic, group)]
+            index = members.index(member)
+            count = len(members)
+        return [p for p in range(n_parts) if p % count == index]
+
+    def leave_all(self, member: int) -> None:
+        with self._lock:
+            affected = [(key, members) for key, members in
+                        self._members.items() if member in members]
+            for _, members in affected:
+                members.remove(member)
+        for (topic, group), _ in affected:
+            # released partitions replay from committed on the next owner
+            self.bus.consumer(topic, group).seek_to_committed()
+
+
 class _Handler(socketserver.BaseRequestHandler):
     def handle(self) -> None:
         bus: EventBus = self.server.bus  # type: ignore[attr-defined]
+        coordinator = self.server.coordinator  # type: ignore[attr-defined]
+        member = id(self)
         sock = self.request
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        while True:
-            try:
-                req = _recv_frame(sock)
-            except BusNetError:
-                return  # client went away
-            try:
-                _send_frame(sock, self._dispatch(bus, req))
-            except BusNetError:
-                return
-            except Exception as exc:  # report, keep the connection
+        try:
+            while True:
                 try:
-                    _send_frame(sock, {"ok": False, "error": str(exc)})
+                    req = _recv_frame(sock)
+                except BusNetError:
+                    return  # client went away
+                try:
+                    _send_frame(sock,
+                                self._dispatch(bus, coordinator, member, req))
                 except BusNetError:
                     return
+                except Exception as exc:  # report, keep the connection
+                    try:
+                        _send_frame(sock, {"ok": False, "error": str(exc)})
+                    except BusNetError:
+                        return
+        finally:
+            coordinator.leave_all(member)
 
     @staticmethod
-    def _dispatch(bus: EventBus, req) -> dict:
+    def _dispatch(bus: EventBus, coordinator: _GroupCoordinator,
+                  member: int, req) -> dict:
         op = req.get("op")
         if op == "publish":
             topic = bus.topic(req["topic"])
@@ -90,18 +145,25 @@ class _Handler(socketserver.BaseRequestHandler):
             return {"ok": True, "count": len(results),
                     "last": results[-1] if results else None}
         if op == "poll":
-            consumer = bus.consumer(req["topic"], req["group"])
+            topic, group = req["topic"], req["group"]
+            owned = coordinator.owned(topic, group, member)
+            consumer = bus.consumer(topic, group)
             batch = consumer.poll(req.get("max", 4096),
                                   timeout_s=min(float(req.get("timeout_s",
-                                                              0.0)), 30.0))
+                                                              0.0)), 30.0),
+                                  partitions=owned)
             return {"ok": True, "records": [
                 [r.partition, r.offset, r.key, r.value, r.timestamp_ms]
                 for r in batch]}
         if op == "commit":
-            bus.commit(bus.consumer(req["topic"], req["group"]))
+            topic, group = req["topic"], req["group"]
+            owned = coordinator.owned(topic, group, member)
+            bus.commit(bus.consumer(topic, group), partitions=owned)
             return {"ok": True}
         if op == "seek_committed":
-            bus.consumer(req["topic"], req["group"]).seek_to_committed()
+            topic, group = req["topic"], req["group"]
+            owned = coordinator.owned(topic, group, member)
+            bus.consumer(topic, group).seek_to_committed(partitions=owned)
             return {"ok": True}
         if op == "end_offsets":
             return {"ok": True,
@@ -126,6 +188,7 @@ class BusServer:
         self.bus = bus
         self._server = _Server((host, port), _Handler)
         self._server.bus = bus  # type: ignore[attr-defined]
+        self._server.coordinator = _GroupCoordinator(bus)  # type: ignore[attr-defined]
         self._thread: Optional[threading.Thread] = None
 
     @property
@@ -256,20 +319,32 @@ class BusClient:
 class RemoteConsumerHost:
     """ConsumerHost twin for edge processes: poll/commit over a BusClient.
     Handler exceptions leave offsets uncommitted server-side; the host
-    re-seeks to committed so the batch redelivers (at-least-once)."""
+    re-seeks to committed so the batch redelivers (at-least-once) — with
+    the same exponential-backoff retry budget and dead-letter parking as
+    the in-proc ConsumerHost, so a poison batch can't spin an edge
+    consumer forever."""
 
     def __init__(self, client: BusClient, topic_name: str, group_id: str,
                  handler: Callable[[List[Record]], None],
-                 max_records: int = 4096, poll_timeout_s: float = 0.5):
+                 max_records: int = 4096, poll_timeout_s: float = 0.5,
+                 max_retries: int = 12, max_backoff_s: float = 30.0,
+                 dead_letter_topic: Optional[str] = None):
         self._client = client
         self._topic_name = topic_name
         self._group_id = group_id
         self._handler = handler
         self._max_records = max_records
         self._poll_timeout_s = poll_timeout_s
+        self._max_retries = max_retries
+        self._max_backoff_s = max_backoff_s
+        self.dead_letter_topic = (dead_letter_topic
+                                  or f"{topic_name}.dead-letter")
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.errors = 0
+        self.dead_lettered = 0
+        # ((partition, offset) of the failing batch head, retries, size)
+        self._failing: Optional[tuple] = None
 
     def start(self) -> None:
         if self._thread is not None:
@@ -287,8 +362,13 @@ class RemoteConsumerHost:
             pass  # server unreachable at boot: first poll retries anyway
         while not self._stop.is_set():
             try:
+                # retry cycles re-poll exactly the original failing batch
+                # (see ConsumerHost._run — records arriving during backoff
+                # must not be parked alongside the poison)
+                max_records = (self._failing[2] if self._failing
+                               else self._max_records)
                 batch = self._client.poll(self._topic_name, self._group_id,
-                                          self._max_records,
+                                          max_records,
                                           timeout_s=self._poll_timeout_s)
             except BusNetError:
                 self.errors += 1
@@ -306,14 +386,32 @@ class RemoteConsumerHost:
             try:
                 self._handler(batch)
                 self._client.commit(self._topic_name, self._group_id)
+                self._failing = None
             except Exception:
                 self.errors += 1
+                fingerprint = (batch[0].partition, batch[0].offset)
+                if self._failing and self._failing[0] == fingerprint:
+                    retries = self._failing[1] + 1
+                    batch_len = self._failing[2]
+                else:
+                    retries = 1
+                    batch_len = len(batch)
+                self._failing = (fingerprint, retries, batch_len)
                 try:
-                    self._client.seek_committed(self._topic_name,
-                                                self._group_id)
+                    if retries > self._max_retries:
+                        self._client.publish_batch(
+                            self.dead_letter_topic,
+                            [(r.key, r.value) for r in batch])
+                        self.dead_lettered += len(batch)
+                        self._client.commit(self._topic_name, self._group_id)
+                        self._failing = None
+                    else:
+                        self._client.seek_committed(self._topic_name,
+                                                    self._group_id)
+                        self._stop.wait(min(0.05 * (2 ** (retries - 1)),
+                                            self._max_backoff_s))
                 except BusNetError:
                     pass
-                time.sleep(0.05)
 
     def stop(self, timeout_s: float = 5.0) -> None:
         self._stop.set()

@@ -170,6 +170,22 @@ def event_sources_model() -> ElementModel:
 
 
 def event_management_model() -> ElementModel:
+    # per-tenant store choice — the reference's DatastoreConfigurationParser
+    # role (persist/datastore.py): a tenant either shares the instance log
+    # or gets a dedicated columnar/memory store
+    tenant_datastore = ElementModel(
+        name="tenant_datastore", role="tenant-datastore", multiple=True,
+        description="Dedicated event store for one tenant",
+        attributes=[
+            _attr("tenant", required=True),
+            _attr("kind", choices=["columnar", "memory"],
+                  default="columnar"),
+            _attr("data_dir",
+                  description="spill dir (relative = under instance dir)"),
+            _attr("segment_rows", _I, default=65536),
+            _attr("linger_ms", _I, default=250),
+            _attr("spill", _B, default=True),
+        ])
     return ElementModel(
         name="event_management", role="event-management",
         description="Columnar event log + indices",
@@ -177,7 +193,8 @@ def event_management_model() -> ElementModel:
             _attr("data_dir", description="parquet spill directory"),
             _attr("segment_rows", _I, default=65536),
             _attr("spill", _B, default=True),
-        ])
+        ],
+        children=[tenant_datastore])
 
 
 def device_state_model() -> ElementModel:
@@ -240,8 +257,10 @@ def command_delivery_model() -> ElementModel:
                 multiple=True,
                 attributes=[_attr("destination_id", required=True),
                             _attr("type", required=True,
-                                  choices=["mqtt", "coap", "inproc"]),
+                                  choices=["mqtt", "coap", "sms", "inproc"]),
                             _attr("topic_prefix"),
+                            _attr("sms_from_number",
+                                  description="for type=sms"),
                             _attr("device_type",
                                   AttributeType.DEVICE_TYPE_REF)]),
         ])

@@ -102,8 +102,13 @@ class ScriptManager(LifecycleComponent):
             self._load_from_disk()
 
     def _scope_dir(self, scope: str) -> str:
+        # Percent-encode: collision-free for arbitrary scopes ("a/b" vs
+        # "a_b" previously mapped to the same directory and one scope's
+        # meta.json silently overwrote the other's). Reload is unaffected
+        # either way — meta.json records the true scope.
+        from urllib.parse import quote
         return os.path.join(self._data_dir, "scripts",
-                            scope.replace("/", "_"))
+                            quote(scope, safe=""))
 
     def _sync_to_disk(self, scope: str, info: ScriptInfo) -> None:
         if not self._data_dir:
@@ -129,24 +134,68 @@ class ScriptManager(LifecycleComponent):
         os.replace(tmp, path)
 
     def _load_from_disk(self) -> None:
+        from urllib.parse import quote
+
         root = os.path.join(self._data_dir, "scripts")
         if not os.path.isdir(root):
             return
+        # Canonical scope dirs (percent-encoded) load AFTER legacy ones
+        # (pre-encoding underscore-replacement), so on a (scope, script_id)
+        # conflict the canonical copy wins; legacy dirs then migrate.
+        entries = []
         for scope_name in os.listdir(root):
             scope_dir = os.path.join(root, scope_name)
             for script_id in os.listdir(scope_dir):
-                try:
-                    self._load_one(scope_name, scope_dir, script_id)
-                except Exception:
-                    # one corrupt script directory must not block startup
-                    LOGGER.exception("skipping unreadable script %s/%s",
-                                     scope_name, script_id)
+                entries.append((scope_name, scope_dir, script_id))
+        loaded = []
+        for scope_name, scope_dir, script_id in sorted(
+                entries, key=lambda e: self._is_canonical_dir(
+                    e[0], e[1], e[2])):
+            try:
+                scope = self._load_one(scope_name, scope_dir, script_id)
+                if scope is not None:
+                    loaded.append((scope_name, scope_dir, script_id, scope))
+            except Exception:
+                # one corrupt script directory must not block startup
+                LOGGER.exception("skipping unreadable script %s/%s",
+                                 scope_name, script_id)
+        # migrate legacy-named dirs to the canonical encoding
+        import shutil
+        for scope_name, scope_dir, script_id, scope in loaded:
+            if scope_name == quote(scope, safe=""):
+                continue
+            try:
+                info = self._scripts.get((scope, script_id))
+                if info is not None:
+                    self._sync_to_disk(scope, info)
+                shutil.rmtree(os.path.join(scope_dir, script_id))
+                if not os.listdir(scope_dir):
+                    os.rmdir(scope_dir)
+                LOGGER.info("migrated script dir %s/%s to canonical "
+                            "scope encoding", scope_name, script_id)
+            except OSError:
+                LOGGER.exception("could not migrate legacy script dir "
+                                 "%s/%s", scope_name, script_id)
+
+    @staticmethod
+    def _is_canonical_dir(scope_name: str, scope_dir: str,
+                          script_id: str) -> bool:
+        from urllib.parse import quote
+
+        meta_path = os.path.join(scope_dir, script_id, "meta.json")
+        try:
+            with open(meta_path) as fh:
+                scope = json.load(fh).get("scope", scope_name)
+        except (OSError, ValueError):
+            return False
+        return scope_name == quote(scope, safe="")
 
     def _load_one(self, scope_name: str, scope_dir: str,
-                  script_id: str) -> None:
+                  script_id: str) -> Optional[str]:
+        """Returns the script's true scope, or None if nothing loaded."""
         meta_path = os.path.join(scope_dir, script_id, "meta.json")
         if not os.path.exists(meta_path):
-            return
+            return None
         with open(meta_path) as fh:
             meta = json.load(fh)
         scope = meta.get("scope", scope_name)
@@ -165,6 +214,7 @@ class ScriptManager(LifecycleComponent):
         if info.active_version:
             self._compile(key, info.active_version)
         self._scripts[key] = info  # registered only after a clean load
+        return scope
 
     # -- CRUD ---------------------------------------------------------------
 

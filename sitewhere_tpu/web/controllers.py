@@ -26,8 +26,8 @@ from sitewhere_tpu.model.asset import Asset, AssetType
 from sitewhere_tpu.model.batch import BatchOperation
 from sitewhere_tpu.model.common import Location, new_id
 from sitewhere_tpu.model.device import (
-    Device, DeviceAssignment, DeviceCommand, DeviceGroup, DeviceGroupElement,
-    DeviceStatus, DeviceType)
+    Device, DeviceAlarm, DeviceAssignment, DeviceCommand, DeviceGroup,
+    DeviceGroupElement, DeviceStatus, DeviceType)
 from sitewhere_tpu.model.event import (
     AlertLevel, AlertSource, CommandInitiator, CommandTarget, DeviceAlert,
     DeviceCommandInvocation, DeviceCommandResponse, DeviceEventBatch,
@@ -498,6 +498,54 @@ def register_all(router: Router, instance, server) -> None:
                 authority=REST)
     router.get("/api/devices/{token}/events", list_device_events,
                authority=REST)
+
+    # ------------------------------------------------------------------
+    # Device alarms (reference: device-management alarm rpcs exposed
+    # through Devices REST; DeviceAlarm CRUD + acknowledge/resolve)
+    # ------------------------------------------------------------------
+    def create_device_alarm(request: Request):
+        registry = _registry(request)
+        device = registry.get_device_by_token(request.params["token"])
+        if device is None:
+            raise NotFoundError("unknown device",
+                                ErrorCode.INVALID_DEVICE_TOKEN)
+        alarm = entity_from_payload(DeviceAlarm, _body(request))
+        alarm.device_id = device.id
+        return 201, registry.create_device_alarm(alarm)
+
+    def list_device_alarms(request: Request):
+        return results_to_jsonable(_registry(request).list_device_alarms(
+            device_token=request.params["token"],
+            criteria=request.criteria()))
+
+    def list_all_alarms(request: Request):
+        return results_to_jsonable(_registry(request).list_device_alarms(
+            criteria=request.criteria()))
+
+    def get_alarm(request: Request):
+        alarm = _registry(request).get_device_alarm(
+            request.params["alarm_id"])
+        if alarm is None:
+            raise NotFoundError("alarm not found",
+                                ErrorCode.INVALID_EVENT_ID)
+        return alarm
+
+    def update_alarm(request: Request):
+        return _registry(request).update_device_alarm(
+            request.params["alarm_id"], _body(request))
+
+    def delete_alarm(request: Request):
+        return _registry(request).delete_device_alarm(
+            request.params["alarm_id"])
+
+    router.post("/api/devices/{token}/alarms", create_device_alarm,
+                authority=REST)
+    router.get("/api/devices/{token}/alarms", list_device_alarms,
+               authority=REST)
+    router.get("/api/alarms", list_all_alarms, authority=REST)
+    router.get("/api/alarms/{alarm_id}", get_alarm, authority=REST)
+    router.put("/api/alarms/{alarm_id}", update_alarm, authority=REST)
+    router.delete("/api/alarms/{alarm_id}", delete_alarm, authority=REST)
 
     # ------------------------------------------------------------------
     # Label generation (reference: service-label-generation +

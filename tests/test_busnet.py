@@ -151,3 +151,91 @@ class TestTwoProcessRecovery:
                       if l.startswith("GOT")]
         assert sorted(got_first + got_second) == sorted(
             f"event-{i}" for i in range(50))
+
+
+class TestConsumerGroupMembership:
+    """Partition assignment across connections: one member's commit can
+    never lose another member's in-flight batch."""
+
+    def test_two_members_split_partitions_without_loss(self, server):
+        bus, srv = server
+        producer = BusClient("127.0.0.1", srv.port)
+        producer.publish_batch("g.events", [
+            (b"k%d" % i, b"v%d" % i) for i in range(20)])
+
+        a = BusClient("127.0.0.1", srv.port)
+        b = BusClient("127.0.0.1", srv.port)
+        batch_a1 = a.poll("g.events", "g", timeout_s=1.0)  # A alone: all
+        assert len(batch_a1) == 20
+        # B joins: rebalance re-seeks to committed (nothing committed yet),
+        # so A's uncommitted poll replays — no loss window
+        batch_b = b.poll("g.events", "g", timeout_s=1.0)
+        b.commit("g.events", "g")  # commits ONLY B's partitions
+        # A (re-polling after rebalance) sees its share
+        batch_a2 = a.poll("g.events", "g", timeout_s=1.0)
+        a.commit("g.events", "g")
+        seen = {r.value for r in batch_b} | {r.value for r in batch_a2}
+        assert seen == {b"v%d" % i for i in range(20)}
+        # disjoint ownership
+        parts_a = {r.partition for r in batch_a2}
+        parts_b = {r.partition for r in batch_b}
+        assert not (parts_a & parts_b)
+        # everything committed: a fresh member starts clean
+        a.close()
+        b.close()
+        import time as _t
+        _t.sleep(0.2)  # let the server reap both memberships
+        c = BusClient("127.0.0.1", srv.port)
+        assert c.poll("g.events", "g", timeout_s=0.2) == []
+        c.close()
+        producer.close()
+
+    def test_member_crash_replays_uncommitted(self, server):
+        bus, srv = server
+        producer = BusClient("127.0.0.1", srv.port)
+        producer.publish_batch("g2.events", [
+            (b"k%d" % i, b"v%d" % i) for i in range(10)])
+        a = BusClient("127.0.0.1", srv.port)
+        got = a.poll("g2.events", "g", timeout_s=1.0)
+        assert len(got) == 10
+        a.close()  # crash without commit -> leave_all re-seeks
+        import time as _t
+        _t.sleep(0.2)
+        b = BusClient("127.0.0.1", srv.port)
+        replayed = b.poll("g2.events", "g", timeout_s=2.0)
+        assert {r.value for r in replayed} == {r.value for r in got}
+        b.close()
+        producer.close()
+
+
+class TestRemoteDeadLetter:
+    def test_remote_poison_batch_parks(self, server):
+        bus, srv = server
+        client = BusClient("127.0.0.1", srv.port)
+        processed = []
+
+        def handler(batch):
+            if any(r.value == b"poison" for r in batch):
+                raise RuntimeError("nope")
+            processed.extend(r.value for r in batch)
+
+        host = RemoteConsumerHost(client, "r.events", "edge", handler,
+                                  poll_timeout_s=0.1, max_retries=2)
+        host.start()
+        producer = BusClient("127.0.0.1", srv.port)
+        producer.publish("r.events", b"k", b"poison")
+        deadline = time.time() + 10
+        while time.time() < deadline and host.dead_lettered == 0:
+            time.sleep(0.02)
+        assert host.dead_lettered == 1
+        producer.publish("r.events", b"k", b"good")
+        deadline = time.time() + 5
+        while time.time() < deadline and b"good" not in processed:
+            time.sleep(0.02)
+        host.stop()
+        assert processed == [b"good"]
+        # parked record is replayable from the DLQ
+        dlq = producer.poll(host.dead_letter_topic, "repair", timeout_s=1.0)
+        assert [r.value for r in dlq] == [b"poison"]
+        client.close()
+        producer.close()

@@ -201,3 +201,48 @@ class TestScriptRest:
         tenant_list = client.get("/api/tenants/default/scripting/scripts")
         assert [s["scriptId"] for s in tenant_list["scripts"]] == ["t-dec"]
         assert client.get("/api/scripting/scripts")["scripts"] == []
+
+
+class TestScopeDirectoryCollision:
+    def test_slash_and_underscore_scopes_do_not_collide(self, tmp_path):
+        """'a/b' and 'a_b' previously mapped to the same on-disk directory,
+        so one scope's meta.json overwrote the other's and a reload lost a
+        script (ADVICE r1)."""
+        sm = ScriptManager(data_dir=str(tmp_path))
+        sm.start()
+        sm.create_script("a/b", "dec", V1)
+        sm.create_script("a_b", "dec", V2)
+        sm.stop()
+        sm2 = ScriptManager(data_dir=str(tmp_path))
+        sm2.start()
+        assert sm2.get_content("a/b", "dec", "v1") == V1
+        assert sm2.get_content("a_b", "dec", "v1") == V2
+
+    def test_legacy_underscore_dirs_migrate_to_canonical(self, tmp_path):
+        """Pre-encoding installs stored scope 'a/b' under scripts/a_b; the
+        loader must recover the true scope from meta.json, migrate the dir
+        to the canonical percent-encoded name, and not leave a stale twin
+        that could win a future load nondeterministically."""
+        import json as _json
+        import os as _os
+
+        legacy = tmp_path / "scripts" / "a_b" / "dec"
+        legacy.mkdir(parents=True)
+        (legacy / "v1.py").write_text(V1)
+        (legacy / "meta.json").write_text(_json.dumps({
+            "scope": "a/b", "scriptId": "dec", "name": "", "description": "",
+            "activeVersion": "v1",
+            "versions": [{"versionId": "v1", "comment": "",
+                          "createdDate": 0}]}))
+        sm = ScriptManager(data_dir=str(tmp_path))
+        sm.start()
+        assert sm.get_content("a/b", "dec", "v1") == V1
+        # migrated: canonical dir exists, legacy gone
+        assert _os.path.isdir(str(tmp_path / "scripts" / "a%2Fb" / "dec"))
+        assert not _os.path.exists(str(legacy))
+        # updates + reload now go through one directory only
+        sm.add_version("a/b", "dec", V2, activate=True)
+        sm.stop()
+        sm2 = ScriptManager(data_dir=str(tmp_path))
+        sm2.start()
+        assert sm2.get_script("a/b", "dec").active_version == "v2"

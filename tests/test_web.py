@@ -357,3 +357,46 @@ def test_openapi_document(server):
         in doc["paths"]
     assert "/api/labels/generators" in doc["paths"]
     assert any(t["name"] == "devices" for t in doc["tags"])
+
+
+class TestAlarmRoutes:
+    """Device-alarm REST surface (VERDICT r1 missing #6)."""
+
+    def test_alarm_crud_and_state_transitions(self, client):
+        client.create_device_type({"token": "alarm-dt"})
+        client.post("/api/devices", {"token": "alarm-dev",
+                                     "device_type_token": "alarm-dt"})
+        created = client.post("/api/devices/alarm-dev/alarms", {
+            "alarm_message": "overheat", "state": "Triggered"})
+        assert created["alarm_message"] == "overheat"
+        alarm_id = created["id"]
+
+        listed = client.get("/api/devices/alarm-dev/alarms")
+        assert listed["numResults"] == 1
+        assert client.get("/api/alarms")["numResults"] >= 1
+
+        got = client.get(f"/api/alarms/{alarm_id}")
+        assert got["state"] == "Triggered"
+        assert got.get("acknowledged_date") is None
+
+        acked = client.put(f"/api/alarms/{alarm_id}",
+                           {"state": "Acknowledged"})
+        assert acked["state"] == "Acknowledged"
+        assert acked["acknowledged_date"] is not None
+
+        resolved = client.put(f"/api/alarms/{alarm_id}",
+                              {"state": "Resolved"})
+        assert resolved["resolved_date"] is not None
+
+        client.delete(f"/api/alarms/{alarm_id}")
+        assert client.get("/api/devices/alarm-dev/alarms")["numResults"] == 0
+
+    def test_alarm_unknown_device_404(self, client):
+        with pytest.raises(SiteWhereClientError) as err:
+            client.post("/api/devices/nope/alarms", {"alarm_message": "x"})
+        assert err.value.status == 404
+
+    def test_alarm_unknown_id_404(self, client):
+        with pytest.raises(SiteWhereClientError) as err:
+            client.get("/api/alarms/no-such-id")
+        assert err.value.status == 404
