@@ -194,10 +194,12 @@ def _drive_sharded(jax, engine, n_registered, global_batch, warmup, steps):
         _, out = engine.submit(pool[i % len(pool)])
     jax.block_until_ready(out.processed)
     rate = steps * global_batch / (_time.perf_counter() - t0)
-    # host routing cost alone (pure numpy, runs serially per submit)
+    # host routing cost alone (pack + shard-route, the path submit uses;
+    # native single-pass when the C++ runtime is available)
+    from sitewhere_tpu.ops.pack import batch_to_blob
     r0 = _time.perf_counter()
     for i in range(steps):
-        engine.router.route_columns(pool[i % len(pool)])
+        engine.router.route_blob(batch_to_blob(pool[i % len(pool)]))
     router_ms = (_time.perf_counter() - r0) / steps * 1000
     return rate, router_ms
 
@@ -248,17 +250,19 @@ def _bench_sharded(jax, BATCH, MAX_DEVICES, N_REGISTERED, small):
                                         steps=3)
         out["sharded_cpu8_events_per_sec"] = round(rate8, 1)
         out["sharded_cpu8_router_ms_per_step"] = round(router8, 3)
-        # router cost at full production batch, 8 shards (pure host numpy)
+        # router cost at full production batch, 8 shards (pack + route,
+        # native when available)
         import time as _time
 
         from __graft_entry__ import _synthetic_batch
+        from sitewhere_tpu.ops.pack import batch_to_blob
         from sitewhere_tpu.parallel.router import ShardRouter
         big = _synthetic_batch(eng1.packer, n_reg, BATCH, seed=7)
         router = ShardRouter(8, BATCH // 8)
-        router.route_columns(big)  # warm
+        router.route_blob(batch_to_blob(big))  # warm
         r0 = _time.perf_counter()
         for _ in range(5):
-            router.route_columns(big)
+            router.route_blob(batch_to_blob(big))
         out["router_8shard_full_batch_ms"] = round(
             (_time.perf_counter() - r0) / 5 * 1000, 3)
     return out

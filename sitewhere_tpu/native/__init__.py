@@ -87,6 +87,8 @@ def _load() -> None:
         c.c_char_p, i64, p_i64,
         p_i32, p_i64, p_i64, i32, p_i64]
     lib.swt_decode_hot_frames.restype = i32
+    lib.swt_route_blob.argtypes = [p_i32, i64, i32, i32, p_i32, p_i64, i64]
+    lib.swt_route_blob.restype = i32
     if lib.swt_version() != 1:
         _build_error = "version mismatch"
         return
@@ -262,3 +264,19 @@ def decode_hot_frames(data: bytes, max_events: Optional[int] = None
         (name_buf.raw[:int(name_off[n])], name_off[:n + 1]),
         (atype_buf.raw[:int(atype_off[n])], atype_off[:n + 1]),
         others, consumed)
+
+
+def route_blob(blob: np.ndarray, n_shards: int, per_shard: int
+               ) -> Tuple[np.ndarray, np.ndarray]:
+    """Shard-route a flat wire blob [7, n] -> ([S, 7, B] routed blob,
+    flat-row indices of overflow). Requires available(); callers fall back
+    to the numpy router otherwise."""
+    blob = np.ascontiguousarray(blob, np.int32)
+    n = blob.shape[1]
+    out = np.zeros((n_shards, 7, per_shard), np.int32)
+    overflow = np.empty(max(n, 1), np.int64)
+    n_over = LIB.swt_route_blob(blob.reshape(-1), n, n_shards, per_shard,
+                                out.reshape(-1), overflow, len(overflow))
+    if n_over < 0:  # cannot happen with overflow_cap=n; defensive
+        raise RuntimeError("route_blob overflow capacity exceeded")
+    return out, overflow[:n_over]

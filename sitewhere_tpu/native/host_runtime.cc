@@ -351,4 +351,38 @@ int32_t swt_decode_hot_frames(
   return counts[3] == 0 ? 0 : -1;
 }
 
+// Shard routing of the wire blob (ops/pack.py layout: rows
+// [device_idx, ts, value, lat, lon, elevation, meta], meta bit 6 = valid).
+// One pass with per-shard cursors replaces the Python router's argsort +
+// 12 column gather/scatters. `out` is [S, 7, B] and must arrive zeroed
+// (meta 0 == invalid). Valid rows beyond a shard's capacity report their
+// flat-row indices through `overflow_rows` (stable order). Row 0 of the
+// routed blob holds the LOCAL index dev / S. Returns the overflow count,
+// or -1 when overflow_cap is too small.
+int32_t swt_route_blob(const int32_t* blob, int64_t n, int32_t S, int32_t B,
+                       int32_t* out, int64_t* overflow_rows,
+                       int64_t overflow_cap) {
+  std::vector<int32_t> cursor(static_cast<size_t>(S), 0);
+  const int32_t* dev_row = blob;
+  const int32_t* meta_row = blob + 6 * n;
+  int64_t n_over = 0;
+  const int64_t shard_stride = 7ll * B;
+  for (int64_t i = 0; i < n; ++i) {
+    if ((meta_row[i] & (1 << 6)) == 0) continue;  // padding row
+    int32_t dev = dev_row[i];
+    int32_t s = dev % S;
+    int32_t pos = cursor[s];
+    if (pos >= B) {
+      if (n_over >= overflow_cap) return -1;
+      overflow_rows[n_over++] = i;
+      continue;
+    }
+    cursor[s] = pos + 1;
+    int32_t* dst = out + s * shard_stride + pos;
+    dst[0] = dev / S;
+    for (int r = 1; r < 7; ++r) dst[r * B] = blob[r * n + i];
+  }
+  return static_cast<int32_t>(n_over);
+}
+
 }  // extern "C"

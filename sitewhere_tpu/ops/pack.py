@@ -106,6 +106,28 @@ def batch_to_blob(batch: EventBatch) -> np.ndarray:
     return blob
 
 
+def blob_to_batch_np(blob: np.ndarray) -> EventBatch:
+    """Host-side inverse of batch_to_blob (numpy views/bit ops — cheap).
+    Used to materialize a routed blob back into columns for alert
+    materialization without keeping a second routed copy around."""
+    blob = np.asarray(blob, np.int32)
+
+    def f(i):
+        return blob[..., i, :].view(np.float32)
+
+    meta = blob[..., 6, :]
+    return EventBatch(
+        device_idx=blob[..., 0, :],
+        tenant_idx=np.zeros_like(blob[..., 0, :]),
+        event_type=meta & 7,
+        ts=blob[..., 1, :],
+        mm_idx=(meta >> 7) & (_META_MAX_IDX - 1),
+        value=f(2), lat=f(3), lon=f(4), elevation=f(5),
+        alert_type_idx=(meta >> 19) & (_META_MAX_IDX - 1),
+        alert_level=(meta >> 3) & 7,
+        valid=(meta & (1 << 6)) != 0)
+
+
 def blob_to_batch(blob) -> EventBatch:
     """Inverse of batch_to_blob on-device (jax ops; call under jit — XLA
     fuses the unpack into the step's first consumers)."""

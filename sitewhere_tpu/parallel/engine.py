@@ -32,7 +32,7 @@ except ImportError:  # pragma: no cover
 from sitewhere_tpu.model import DeviceAlert
 from sitewhere_tpu.ops.pack import EventBatch, batch_to_blob, blob_to_batch
 from sitewhere_tpu.parallel.mesh import SHARD_AXIS, make_mesh, shard_axis_size
-from sitewhere_tpu.parallel.router import RoutedBatches, ShardRouter
+from sitewhere_tpu.parallel.router import ShardRouter
 from sitewhere_tpu.pipeline.engine import PipelineEngine
 from sitewhere_tpu.pipeline.state_tensors import (
     DeviceStateTensors, init_device_state_np)
@@ -210,9 +210,15 @@ class ShardedPipelineEngine(PipelineEngine):
         if self._overflow is not None:
             batch = concat_flat_batches([self._overflow, batch])
             self._overflow = None
-        routed = self.router.route_columns(batch)
-        routed_batch, outputs = self._one_step(params, routed.batch)
-        self._overflow = routed.overflow
+        # Blob-first routing: pack the flat batch once (7 int32 rows), then
+        # the router scatters those rows per shard (native single pass when
+        # available) — the routed blob IS the staging format, so no second
+        # pack happens, and the routed EventBatch view is derived by cheap
+        # numpy bit-ops only for materialization.
+        flat_blob = batch_to_blob(batch)
+        routed_blob, over_rows = self.router.route_blob(flat_blob)
+        routed_batch, outputs = self._one_step(params, routed_blob)
+        self._overflow = self._slice_flat(batch, over_rows)
         while (self._overflow is not None
                and int(self._overflow.valid.sum()) > self.max_overflow_events):
             # the caller only sees the LAST step; materialize the alerts of
@@ -226,21 +232,32 @@ class ShardedPipelineEngine(PipelineEngine):
             self._pending_alerts.extend(stash[:max(0, room)])
             backlog = self._overflow
             self._overflow = None
-            routed = self.router.route_columns(backlog)
             self.drain_steps += 1
             self._metrics.counter("overflow.drain_steps").inc()
-            routed_batch, outputs = self._one_step(params, routed.batch)
-            self._overflow = routed.overflow
+            routed_blob, over_rows = self.router.route_blob(
+                batch_to_blob(backlog))
+            routed_batch, outputs = self._one_step(params, routed_blob)
+            self._overflow = self._slice_flat(backlog, over_rows)
         return routed_batch, outputs
 
-    def _one_step(self, params, routed_batch: EventBatch
+    @staticmethod
+    def _slice_flat(batch: EventBatch,
+                    rows: np.ndarray) -> Optional[EventBatch]:
+        if len(rows) == 0:
+            return None
+        return jax.tree_util.tree_map(lambda a: np.asarray(a)[rows], batch)
+
+    def _one_step(self, params, routed_blob: np.ndarray
                   ) -> Tuple[EventBatch, ProcessOutputs]:
+        from sitewhere_tpu.ops.pack import blob_to_batch_np
+
         shard0 = NamedSharding(self.mesh, P(SHARD_AXIS))
-        blob = jax.device_put(batch_to_blob(routed_batch), shard0)
+        blob = jax.device_put(routed_blob, shard0)
         with self._metrics.timer("step").time():
             self._state, outputs = self._sharded_step(params, self._state,
                                                       blob)
         self.batches_processed += 1
+        routed_batch = blob_to_batch_np(routed_blob)
         # rows actually stepped this call: overflow rows are counted by the
         # step that eventually carries them, so each event marks exactly once
         self._metrics.meter("events").mark(

@@ -79,6 +79,38 @@ class ShardRouter:
         return np.ascontiguousarray(
             np.asarray(arr).swapaxes(0, 1).reshape((S * L,) + arr.shape[2:]))
 
+    def route_blob(self, blob: np.ndarray
+                   ) -> Tuple[np.ndarray, np.ndarray]:
+        """Route a flat wire blob [7, n] into ([S, 7, B] routed blob,
+        overflow flat-row indices). The native single-pass router
+        (host_runtime.cc swt_route_blob) replaces argsort + per-column
+        scatters; the numpy fallback routes the 7 blob rows the same way
+        route_columns routes the 12 column arrays."""
+        from sitewhere_tpu import native
+
+        S, B = self.n_shards, self.per_shard_batch
+        if native.available():
+            return native.route_blob(blob, S, B)
+        blob = np.asarray(blob, np.int32)
+        n = blob.shape[1]
+        meta = blob[6]
+        rows = np.nonzero((meta & (1 << 6)) != 0)[0]
+        dev = blob[0, rows]
+        shard = dev % S
+        order = np.argsort(shard, kind="stable")
+        srows = rows[order]
+        sshard = shard[order]
+        counts = np.bincount(sshard, minlength=S)
+        starts = np.concatenate([[0], np.cumsum(counts)[:-1]])
+        pos = np.arange(len(srows), dtype=np.int64) - starts[sshard]
+        keep = pos < B
+        out = np.zeros((S, 7, B), np.int32)
+        ks, kp, krows = sshard[keep], pos[keep], srows[keep]
+        out[ks, 0, kp] = blob[0, krows] // S
+        for r in range(1, 7):
+            out[ks, r, kp] = blob[r, krows]
+        return out, np.sort(srows[~keep])  # arrival order, like the native
+
     def route_columns(self, batch: EventBatch) -> RoutedBatches:
         """Scatter a flat host batch into per-shard sub-batches with local
         device indices — fully vectorized (no per-event Python on the ingest

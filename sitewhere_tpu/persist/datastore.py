@@ -115,12 +115,16 @@ class TenantDatastoreManager:
             return log
 
     def _build(self, token: str, config: DatastoreConfig) -> ColumnarEventLog:
+        from urllib.parse import quote
+
         data_dir = None
         if config.kind == "columnar":
             data_dir = config.data_dir
             if data_dir is None:
+                # percent-encode: "a/b" and "a_b" are distinct tenants and
+                # must not share a spill directory
                 data_dir = (os.path.join(self.base_dir, "tenant-stores",
-                                         token.replace("/", "_"))
+                                         quote(token, safe=""))
                             if self.base_dir else None)
             elif not os.path.isabs(data_dir) and self.base_dir:
                 data_dir = os.path.join(self.base_dir, data_dir)

@@ -234,12 +234,20 @@ class ConsumerGroup:
         self._lock = threading.Lock()
 
     def poll(self, max_records: int = 4096, timeout_s: float = 0.0,
-             partitions: Optional[List[int]] = None) -> List[Record]:
+             partitions: Optional[List[int]] = None,
+             until: Optional[Dict[int, int]] = None) -> List[Record]:
         """`partitions` restricts the poll to a subset (consumer-group
-        member assignment — busnet's networked groups); None = all."""
+        member assignment — busnet's networked groups); None = all.
+        `until` maps partition -> exclusive end offset and bounds the poll
+        to exactly a previously-seen extent (retry cycles re-polling a
+        failing batch — records beyond the extent are neither returned nor
+        skipped); partitions absent from `until` are not read at all, and
+        the long-poll wait is skipped (the bounded rows already exist)."""
         out: List[Record] = []
         owned = (range(len(self.topic.partitions)) if partitions is None
                  else partitions)
+        if until is not None:
+            owned = [idx for idx in owned if idx in until]
         with self._lock:
             budget = max_records
             for idx in owned:
@@ -247,17 +255,24 @@ class ConsumerGroup:
                     break
                 part = self.topic.partitions[idx]
                 rows = part.read(self.position[idx], budget)
+                if until is not None:
+                    rows = [r for r in rows if r[0] < until[idx]]
                 for offset, key, value, ts in rows:
                     out.append(Record(self.topic.name, idx, offset, key, value, ts))
                 if rows:
                     self.position[idx] = rows[-1][0] + 1
                     budget -= len(rows)
-        if not out and timeout_s > 0:
+        if not out and timeout_s > 0 and until is None:
             # Deadline-based wait ACROSS partitions: waiting the full
             # timeout on each partition in turn would block a
             # multi-partition idle topic for partitions * timeout (a
             # remote long-poll would outlive its client's socket timeout).
             deadline = time.monotonic() + timeout_s
+            if not owned:
+                # a member that owns no partitions (more members than
+                # partitions) must idle-wait, not busy-spin
+                time.sleep(timeout_s)
+                return []
             while True:
                 for idx in owned:
                     remaining = deadline - time.monotonic()
@@ -401,9 +416,11 @@ class ConsumerHost:
         self._thread: Optional[threading.Thread] = None
         self.errors = 0
         self.dead_lettered = 0
-        # (committed-offset fingerprint, consecutive failures, batch size
-        # at first failure) of the currently-failing batch
-        self._failing: Optional[Tuple[Tuple[int, ...], int, int]] = None
+        # (committed-offset fingerprint, consecutive failures,
+        # per-partition exclusive end offsets of the batch at first
+        # failure) — retries re-poll exactly that extent
+        self._failing: Optional[
+            Tuple[Tuple[int, ...], int, Dict[int, int]]] = None
 
     def start(self) -> None:
         if self._thread is not None:
@@ -426,13 +443,15 @@ class ConsumerHost:
         consumer = self._bus.consumer(self._topic_name, self._group_id)
         consumer.seek_to_committed()
         while not self._stop.is_set():
-            # During a retry cycle, poll EXACTLY the size of the batch that
-            # first failed: records arriving during the backoff must not
-            # join the retried batch, or parking would dead-letter (and
-            # commit past) innocent records that were never at fault.
-            max_records = (self._failing[2] if self._failing
-                           else self._max_records)
-            batch = consumer.poll(max_records, timeout_s=self._poll_timeout_s)
+            # During a retry cycle, poll EXACTLY the extent of the batch
+            # that first failed (per-partition end offsets): records
+            # arriving during the backoff must not join the retried batch,
+            # or parking would dead-letter (and commit past) innocent
+            # records that were never at fault.
+            until = self._failing[2] if self._failing else None
+            batch = consumer.poll(self._max_records,
+                                  timeout_s=self._poll_timeout_s,
+                                  until=until)
             if not batch:
                 continue
             try:
@@ -444,11 +463,15 @@ class ConsumerHost:
                 fingerprint = tuple(consumer.committed)
                 if self._failing and self._failing[0] == fingerprint:
                     retries = self._failing[1] + 1
-                    batch_len = self._failing[2]
+                    extent = self._failing[2]
                 else:
                     retries = 1
-                    batch_len = len(batch)
-                self._failing = (fingerprint, retries, batch_len)
+                    extent = {}
+                    for record in batch:
+                        extent[record.partition] = max(
+                            extent.get(record.partition, 0),
+                            record.offset + 1)
+                self._failing = (fingerprint, retries, extent)
                 if retries > self._max_retries:
                     self._park(batch)
                     self._bus.commit(consumer)  # advance past the poison

@@ -148,10 +148,13 @@ class _Handler(socketserver.BaseRequestHandler):
             topic, group = req["topic"], req["group"]
             owned = coordinator.owned(topic, group, member)
             consumer = bus.consumer(topic, group)
+            until = req.get("until")
+            if until is not None:
+                until = {int(k): int(v) for k, v in until.items()}
             batch = consumer.poll(req.get("max", 4096),
                                   timeout_s=min(float(req.get("timeout_s",
                                                               0.0)), 30.0),
-                                  partitions=owned)
+                                  partitions=owned, until=until)
             return {"ok": True, "records": [
                 [r.partition, r.offset, r.key, r.value, r.timestamp_ms]
                 for r in batch]}
@@ -281,12 +284,15 @@ class BusClient:
                           "records": [[k, v] for k, v in records]})["count"]
 
     def poll(self, topic: str, group: str, max_records: int = 4096,
-             timeout_s: float = 0.0) -> List[Record]:
+             timeout_s: float = 0.0,
+             until: Optional[dict] = None) -> List[Record]:
+        req = {"op": "poll", "topic": topic, "group": group,
+               "max": max_records, "timeout_s": timeout_s}
+        if until is not None:
+            req["until"] = {str(k): int(v) for k, v in until.items()}
         resp = self._rpc(
-            {"op": "poll", "topic": topic, "group": group,
-             "max": max_records, "timeout_s": timeout_s},
-            pre_retry={"op": "seek_committed", "topic": topic,
-                       "group": group})
+            req, pre_retry={"op": "seek_committed", "topic": topic,
+                            "group": group})
         return [Record(topic, part, offset, key, value, ts)
                 for part, offset, key, value, ts in resp["records"]]
 
@@ -343,7 +349,8 @@ class RemoteConsumerHost:
         self._thread: Optional[threading.Thread] = None
         self.errors = 0
         self.dead_lettered = 0
-        # ((partition, offset) of the failing batch head, retries, size)
+        # ((partition, offset) of the failing batch head, retries,
+        # per-partition exclusive end offsets of the first failing batch)
         self._failing: Optional[tuple] = None
 
     def start(self) -> None:
@@ -362,14 +369,15 @@ class RemoteConsumerHost:
             pass  # server unreachable at boot: first poll retries anyway
         while not self._stop.is_set():
             try:
-                # retry cycles re-poll exactly the original failing batch
-                # (see ConsumerHost._run — records arriving during backoff
-                # must not be parked alongside the poison)
-                max_records = (self._failing[2] if self._failing
-                               else self._max_records)
+                # retry cycles re-poll exactly the original failing batch's
+                # per-partition extent (see ConsumerHost._run — records
+                # arriving during backoff must not be parked with the
+                # poison)
+                until = self._failing[2] if self._failing else None
                 batch = self._client.poll(self._topic_name, self._group_id,
-                                          max_records,
-                                          timeout_s=self._poll_timeout_s)
+                                          self._max_records,
+                                          timeout_s=self._poll_timeout_s,
+                                          until=until)
             except BusNetError:
                 self.errors += 1
                 # a failed poll may have advanced the server-side cursor
@@ -392,11 +400,15 @@ class RemoteConsumerHost:
                 fingerprint = (batch[0].partition, batch[0].offset)
                 if self._failing and self._failing[0] == fingerprint:
                     retries = self._failing[1] + 1
-                    batch_len = self._failing[2]
+                    extent = self._failing[2]
                 else:
                     retries = 1
-                    batch_len = len(batch)
-                self._failing = (fingerprint, retries, batch_len)
+                    extent = {}
+                    for record in batch:
+                        extent[record.partition] = max(
+                            extent.get(record.partition, 0),
+                            record.offset + 1)
+                self._failing = (fingerprint, retries, extent)
                 try:
                     if retries > self._max_retries:
                         self._client.publish_batch(

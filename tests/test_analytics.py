@@ -217,3 +217,39 @@ class TestStreamReceiver:
         ctx, event = got[0]
         assert ctx.device_token == "dev-0"
         assert event.event_type == DeviceEventType.MEASUREMENT
+
+
+class TestMixedPathKeys:
+    def test_device_with_indexed_and_unindexed_rows_is_one_key(self):
+        """REST-persisted rows (device_idx 0) and hot-path rows (real
+        interned idx) of the SAME device must aggregate into one report
+        key, not split."""
+        import numpy as np
+
+        from sitewhere_tpu.analytics.engine import WindowedAnalyticsEngine
+        from sitewhere_tpu.model.event import DeviceMeasurement
+        from sitewhere_tpu.ops.pack import EventPacker
+        from sitewhere_tpu.persist.eventlog import ColumnarEventLog
+        from sitewhere_tpu.registry.interning import TokenInterner
+
+        interner = TokenInterner(32, "devices")
+        interner.intern("dev-x")
+        packer = EventPacker(8, interner, epoch_base_ms=1_000_000)
+        packer.measurements.intern("m")
+        log = ColumnarEventLog()
+        # hot path: real index
+        batch = packer.pack_columns(
+            np.array([1, 1], np.int32), np.zeros(2, np.int32),
+            np.array([1_000_000, 1_001_000], np.int64),
+            mm_idx=np.ones(2, np.int32),
+            value=np.array([1.0, 2.0], np.float32))
+        log.append_batch("t", batch, packer)
+        # control plane: no interner -> device_idx 0, token only
+        log.append_events("t", [DeviceMeasurement(
+            device_id="dev-x", name="m", value=3.0,
+            event_date=1_002_000)])
+        report = WindowedAnalyticsEngine(log).measurement_windows(
+            "t", window_ms=10_000)
+        assert report.num_keys == 1
+        assert report.key_tokens == ["dev-x"]
+        assert report.totals()["events"] == 3

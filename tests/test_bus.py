@@ -174,3 +174,52 @@ def test_poison_batch_parks_on_dead_letter_topic():
     assert [r.value for r in dlq.poll()] == [b"poison"]
     # the good record was processed exactly once after parking
     assert processed == [b"after"]
+
+
+def test_poll_with_no_owned_partitions_idles_not_spins():
+    """A consumer-group member owning zero partitions (more members than
+    partitions) must idle out its timeout, not busy-loop forever."""
+    import time as _t
+
+    bus = EventBus(partitions=2)
+    bus.topic("t")
+    consumer = bus.consumer("t", "g")
+    t0 = _t.monotonic()
+    out = consumer.poll(16, timeout_s=0.3, partitions=[])
+    elapsed = _t.monotonic() - t0
+    assert out == []
+    assert 0.2 < elapsed < 2.0
+
+
+def test_until_poll_pins_failing_batch_extent():
+    """Retry polls bounded by per-partition end offsets must return exactly
+    the original failing batch even when new records arrive on the same
+    partitions (so dead-letter parking never sweeps up innocents)."""
+    bus = EventBus(partitions=2)
+    topic = bus.topic("t")
+    # find keys hashing to each partition
+    keys = {}
+    i = 0
+    while len(keys) < 2:
+        k = b"k%d" % i
+        keys.setdefault(topic.partition_for(k), k)
+        i += 1
+    for p in (0, 1):
+        topic.publish(keys[p], b"orig-%d" % p)
+    consumer = bus.consumer("t", "g")
+    batch = consumer.poll(16)
+    assert len(batch) == 2
+    extent = {}
+    for r in batch:
+        extent[r.partition] = max(extent.get(r.partition, 0), r.offset + 1)
+    # new records land during "backoff"
+    for p in (0, 1):
+        topic.publish(keys[p], b"new-%d" % p)
+    consumer.seek_to_committed()
+    retry = consumer.poll(16, until=extent)
+    assert sorted(r.value for r in retry) == [b"orig-0", b"orig-1"]
+    # committing now advances past ONLY the original extent
+    bus.commit(consumer)
+    consumer.seek_to_committed()
+    rest = consumer.poll(16)
+    assert sorted(r.value for r in rest) == [b"new-0", b"new-1"]
