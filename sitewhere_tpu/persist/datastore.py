@@ -123,9 +123,18 @@ class TenantDatastoreManager:
             if data_dir is None:
                 # percent-encode: "a/b" and "a_b" are distinct tenants and
                 # must not share a spill directory
-                data_dir = (os.path.join(self.base_dir, "tenant-stores",
-                                         quote(token, safe=""))
-                            if self.base_dir else None)
+                if self.base_dir:
+                    stores = os.path.join(self.base_dir, "tenant-stores")
+                    data_dir = os.path.join(stores, quote(token, safe=""))
+                    # migrate a directory created by the pre-encoding
+                    # underscore scheme so its data stays visible
+                    legacy = os.path.join(stores, token.replace("/", "_"))
+                    if (legacy != data_dir and os.path.isdir(legacy)
+                            and not os.path.exists(data_dir)):
+                        try:
+                            os.rename(legacy, data_dir)
+                        except OSError:
+                            pass  # fall through: fresh dir
             elif not os.path.isabs(data_dir) and self.base_dir:
                 data_dir = os.path.join(self.base_dir, data_dir)
         return ColumnarEventLog(data_dir=data_dir,

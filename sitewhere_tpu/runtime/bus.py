@@ -42,6 +42,16 @@ class Record:
     timestamp_ms: int
 
 
+def batch_extent(records: List["Record"]) -> Dict[int, int]:
+    """Per-partition exclusive end offsets of a polled batch — the extent
+    retry cycles re-poll (ConsumerHost / RemoteConsumerHost `until`)."""
+    extent: Dict[int, int] = {}
+    for record in records:
+        extent[record.partition] = max(extent.get(record.partition, 0),
+                                       record.offset + 1)
+    return extent
+
+
 class TopicNaming:
     """Topic name taxonomy (KafkaTopicNaming.java:33-98)."""
 
@@ -453,6 +463,12 @@ class ConsumerHost:
                                   timeout_s=self._poll_timeout_s,
                                   until=until)
             if not batch:
+                if self._failing:
+                    # the failing extent yielded nothing (e.g. retention
+                    # truncated it): abandon the retry cycle rather than
+                    # re-polling an empty extent forever
+                    self._failing = None
+                    consumer.seek_to_committed()
                 continue
             try:
                 self._handler(batch)
@@ -466,11 +482,7 @@ class ConsumerHost:
                     extent = self._failing[2]
                 else:
                     retries = 1
-                    extent = {}
-                    for record in batch:
-                        extent[record.partition] = max(
-                            extent.get(record.partition, 0),
-                            record.offset + 1)
+                    extent = batch_extent(batch)
                 self._failing = (fingerprint, retries, extent)
                 if retries > self._max_retries:
                     self._park(batch)

@@ -390,6 +390,17 @@ class RemoteConsumerHost:
                 time.sleep(0.2)
                 continue
             if not batch:
+                if self._failing:
+                    # empty extent poll (partition reassigned by a
+                    # rebalance, lost seek, retention): abandon the retry
+                    # cycle instead of hot-spinning RPCs on it forever
+                    self._failing = None
+                    try:
+                        self._client.seek_committed(self._topic_name,
+                                                    self._group_id)
+                    except BusNetError:
+                        pass
+                    self._stop.wait(0.2)
                 continue
             try:
                 self._handler(batch)
@@ -397,17 +408,15 @@ class RemoteConsumerHost:
                 self._failing = None
             except Exception:
                 self.errors += 1
+                from sitewhere_tpu.runtime.bus import batch_extent
+
                 fingerprint = (batch[0].partition, batch[0].offset)
                 if self._failing and self._failing[0] == fingerprint:
                     retries = self._failing[1] + 1
                     extent = self._failing[2]
                 else:
                     retries = 1
-                    extent = {}
-                    for record in batch:
-                        extent[record.partition] = max(
-                            extent.get(record.partition, 0),
-                            record.offset + 1)
+                    extent = batch_extent(batch)
                 self._failing = (fingerprint, retries, extent)
                 try:
                     if retries > self._max_retries:

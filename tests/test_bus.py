@@ -209,9 +209,8 @@ def test_until_poll_pins_failing_batch_extent():
     consumer = bus.consumer("t", "g")
     batch = consumer.poll(16)
     assert len(batch) == 2
-    extent = {}
-    for r in batch:
-        extent[r.partition] = max(extent.get(r.partition, 0), r.offset + 1)
+    from sitewhere_tpu.runtime.bus import batch_extent
+    extent = batch_extent(batch)
     # new records land during "backoff"
     for p in (0, 1):
         topic.publish(keys[p], b"new-%d" % p)
