@@ -119,19 +119,22 @@ class _Handler(socketserver.BaseRequestHandler):
             while True:
                 try:
                     req = _recv_frame(sock)
-                except BusNetError:
-                    return  # client went away
+                except (BusNetError, OSError):
+                    return  # client went away (or stop() severed us)
                 try:
                     _send_frame(sock,
                                 self._dispatch(bus, coordinator, member, req))
-                except BusNetError:
+                except (BusNetError, OSError):
                     return
                 except Exception as exc:  # report, keep the connection
                     try:
                         _send_frame(sock, {"ok": False, "error": str(exc)})
-                    except BusNetError:
+                    except (BusNetError, OSError):
                         return
         finally:
+            untrack = getattr(self.server, "untrack_connection", None)
+            if untrack is not None:
+                untrack(sock)
             coordinator.leave_all(member)
 
     @staticmethod
@@ -182,6 +185,43 @@ class _Server(socketserver.ThreadingTCPServer):
     daemon_threads = True
     allow_reuse_address = True
 
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._connections: set = set()
+        self._connections_lock = threading.Lock()
+
+    def process_request(self, request, client_address):
+        # Track in the accept loop, not the handler thread: registration
+        # must happen-before shutdown() returns, or a connection accepted
+        # during stop() would escape sever_connections().
+        self.track_connection(request)
+        super().process_request(request, client_address)
+
+    def track_connection(self, sock) -> None:
+        with self._connections_lock:
+            self._connections.add(sock)
+
+    def untrack_connection(self, sock) -> None:
+        with self._connections_lock:
+            self._connections.discard(sock)
+
+    def sever_connections(self) -> None:
+        """Force-close live client connections. Without this, a stopped
+        server's handler threads keep serving clients against the OLD bus
+        instance — publishes 'succeed' into dead state and are lost when
+        a replacement server takes the port."""
+        with self._connections_lock:
+            conns = list(self._connections)
+        for sock in conns:
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                sock.close()
+            except OSError:
+                pass
+
 
 class BusServer:
     """Expose an EventBus on TCP (the broker's network face)."""
@@ -210,6 +250,7 @@ class BusServer:
             return
         self._server.shutdown()
         self._server.server_close()
+        self._server.sever_connections()
         self._thread.join(timeout=5.0)
         self._thread = None
 
