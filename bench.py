@@ -68,16 +68,30 @@ def main() -> None:
         out = engine.submit(pool[i % len(pool)])
     jax.block_until_ready(out.processed)
 
-    latencies = []
+    # Throughput: depth-3 pipelined submits (dispatch is async; keeping a
+    # small in-flight window overlaps the host->device copy of step i+1
+    # with step i's execution and hides the tunnel round trip). This is the
+    # production ingestion pattern — sources enqueue, they don't block per
+    # batch. Per-step latency is measured separately below, synchronously.
+    from collections import deque
+    inflight = deque()
     t0 = time.perf_counter()
     for i in range(STEPS):
+        inflight.append(engine.submit(pool[i % len(pool)]))
+        if len(inflight) > 3:
+            inflight.popleft().processed.block_until_ready()
+    while inflight:
+        inflight.popleft().processed.block_until_ready()
+    total = time.perf_counter() - t0
+    events_per_sec = STEPS * BATCH / total
+
+    # Synchronous step latency (host blob build + transfer + fused step)
+    latencies = []
+    for i in range(STEPS // 2):
         s0 = time.perf_counter()
         out = engine.submit(pool[i % len(pool)])
         out.processed.block_until_ready()
         latencies.append(time.perf_counter() - s0)
-    total = time.perf_counter() - t0
-
-    events_per_sec = STEPS * BATCH / total
     lat = np.array(sorted(latencies))
 
     # aux: compute-only step rate (device-resident staging blob), i.e. the

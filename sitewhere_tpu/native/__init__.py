@@ -89,7 +89,7 @@ def _load() -> None:
     lib.swt_decode_hot_frames.restype = i32
     lib.swt_route_blob.argtypes = [p_i32, i64, i32, i32, p_i32, p_i64, i64]
     lib.swt_route_blob.restype = i32
-    if lib.swt_version() != 1:
+    if lib.swt_version() != 2:
         _build_error = "version mismatch"
         return
     LIB = lib
@@ -268,12 +268,14 @@ def decode_hot_frames(data: bytes, max_events: Optional[int] = None
 
 def route_blob(blob: np.ndarray, n_shards: int, per_shard: int
                ) -> Tuple[np.ndarray, np.ndarray]:
-    """Shard-route a flat wire blob [7, n] -> ([S, 7, B] routed blob,
-    flat-row indices of overflow). Requires available(); callers fall back
-    to the numpy router otherwise."""
+    """Shard-route a flat wire blob [WIRE_ROWS, n] -> ([S, WIRE_ROWS, B]
+    routed blob, flat-row indices of overflow). Requires available();
+    callers fall back to the numpy router otherwise."""
+    from sitewhere_tpu.ops.pack import WIRE_ROWS
+
     blob = np.ascontiguousarray(blob, np.int32)
     n = blob.shape[1]
-    out = np.zeros((n_shards, 7, per_shard), np.int32)
+    out = np.zeros((n_shards, WIRE_ROWS, per_shard), np.int32)
     overflow = np.empty(max(n, 1), np.int64)
     n_over = LIB.swt_route_blob(blob.reshape(-1), n, n_shards, per_shard,
                                 out.reshape(-1), overflow, len(overflow))

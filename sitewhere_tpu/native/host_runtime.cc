@@ -93,7 +93,7 @@ struct Interner {
 
 extern "C" {
 
-int32_t swt_version() { return 1; }
+int32_t swt_version() { return 2; }
 
 void* swt_interner_create(int32_t capacity) {
   if (capacity < 2) return nullptr;
@@ -351,25 +351,31 @@ int32_t swt_decode_hot_frames(
   return counts[3] == 0 ? 0 : -1;
 }
 
-// Shard routing of the wire blob (ops/pack.py layout: rows
-// [device_idx, ts, value, lat, lon, elevation, meta], meta bit 6 = valid).
+// Shard routing of the wire blob (ops/pack.py v2 layout: 5 rows
+// [dev|type|level|valid packed, ts, payloadA, payloadB, elevation];
+// row 0 bits 0-21 = device_idx, bit 28 = valid).
 // One pass with per-shard cursors replaces the Python router's argsort +
-// 12 column gather/scatters. `out` is [S, 7, B] and must arrive zeroed
-// (meta 0 == invalid). Valid rows beyond a shard's capacity report their
-// flat-row indices through `overflow_rows` (stable order). Row 0 of the
-// routed blob holds the LOCAL index dev / S. Returns the overflow count,
+// 12 column gather/scatters. `out` is [S, 5, B] and must arrive zeroed
+// (row-0 valid bit 0 == invalid). Valid rows beyond a shard's capacity
+// report their flat-row indices through `overflow_rows` (stable order).
+// The device field of the routed row 0 is rewritten to the LOCAL index
+// dev / S (type/level/valid bits preserved). Returns the overflow count,
 // or -1 when overflow_cap is too small.
+static constexpr int kWireRows = 5;
+static constexpr int32_t kWireDevMask = (1 << 22) - 1;
+static constexpr int32_t kWireValidBit = 1 << 28;
+
 int32_t swt_route_blob(const int32_t* blob, int64_t n, int32_t S, int32_t B,
                        int32_t* out, int64_t* overflow_rows,
                        int64_t overflow_cap) {
   std::vector<int32_t> cursor(static_cast<size_t>(S), 0);
-  const int32_t* dev_row = blob;
-  const int32_t* meta_row = blob + 6 * n;
+  const int32_t* head_row = blob;
   int64_t n_over = 0;
-  const int64_t shard_stride = 7ll * B;
+  const int64_t shard_stride = static_cast<int64_t>(kWireRows) * B;
   for (int64_t i = 0; i < n; ++i) {
-    if ((meta_row[i] & (1 << 6)) == 0) continue;  // padding row
-    int32_t dev = dev_row[i];
+    int32_t head = head_row[i];
+    if ((head & kWireValidBit) == 0) continue;  // padding row
+    int32_t dev = head & kWireDevMask;
     int32_t s = dev % S;
     int32_t pos = cursor[s];
     if (pos >= B) {
@@ -379,8 +385,8 @@ int32_t swt_route_blob(const int32_t* blob, int64_t n, int32_t S, int32_t B,
     }
     cursor[s] = pos + 1;
     int32_t* dst = out + s * shard_stride + pos;
-    dst[0] = dev / S;
-    for (int r = 1; r < 7; ++r) dst[r * B] = blob[r * n + i];
+    dst[0] = (head & ~kWireDevMask) | (dev / S);
+    for (int r = 1; r < kWireRows; ++r) dst[r * B] = blob[r * n + i];
   }
   return static_cast<int32_t>(n_over);
 }

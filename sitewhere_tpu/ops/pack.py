@@ -66,43 +66,78 @@ def empty_batch(batch_size: int) -> EventBatch:
         valid=np.zeros(batch_size, bool))
 
 
-# Wire-blob layout: the host->device staging format is ONE contiguous int32
-# array of WIRE_ROWS rows per batch ([7, B]; [S, 7, B] routed). Host->device
-# bandwidth is the pipeline's hard ceiling (HBM/PCIe/tunnel — SURVEY.md north
-# star analysis), so the wire format is minimized: 28 B/event instead of the
-# 48 B of one row per EventBatch column. Small enums ride a single bit-packed
-# meta row; tenant_idx never crosses (validation re-derives it from the
-# registry mirror on device, pipeline/step.py stage 1).
-#   row 0 device_idx  row 1 ts  row 2 value(f32)  row 3 lat(f32)
-#   row 4 lon(f32)    row 5 elevation(f32)
-#   row 6 meta: bits 0-2 event_type | 3-5 alert_level | 6 valid |
-#               7-18 mm_idx | 19-30 alert_type_idx
-WIRE_ROWS = 7
-_META_MAX_IDX = 1 << 12  # mm_idx / alert_type_idx field width
+# Wire-blob layout v2: the host->device staging format is ONE contiguous
+# int32 array of WIRE_ROWS rows per batch ([5, B]; [S, 5, B] routed).
+# Host->device bandwidth is the pipeline's hard ceiling (HBM/PCIe/tunnel —
+# SURVEY.md north star analysis), so the wire format is minimized:
+# 20 B/event instead of the 48 B of one row per EventBatch column. The two
+# payload rows are unions discriminated by event_type — a measurement's
+# (value, mm_idx), a location's (lat, lon) and an alert's alert_type_idx
+# are mutually exclusive, so they share rows with no precision loss.
+# tenant_idx never crosses (validation re-derives it from the registry
+# mirror on device, pipeline/step.py stage 1).
+#   row 0: device_idx (bits 0-21) | event_type (22-24) |
+#          alert_level (25-27) | valid (28)
+#   row 1: ts (int32 ms, relative)
+#   row 2: payload A — value f32 bits (measurement) | lat f32 bits (location)
+#   row 3: payload B — mm_idx (measurement) | lon f32 bits (location) |
+#          alert_type_idx (alert)
+#   row 4: elevation f32 bits (carried for every type; zero unless set)
+WIRE_ROWS = 5
+WIRE_DEV_BITS = 22
+WIRE_DEV_MAX = 1 << WIRE_DEV_BITS   # 4.19M interned devices per wire batch
+_ET_SHIFT = 22
+_LEVEL_SHIFT = 25
+_VALID_SHIFT = 28
+_META_MAX_IDX = 1 << 12  # mm_idx / alert_type_idx interner width (unchanged)
+
+_ET_MEASUREMENT = int(DeviceEventType.MEASUREMENT)
+_ET_LOCATION = int(DeviceEventType.LOCATION)
+_ET_ALERT = int(DeviceEventType.ALERT)
 
 
 def batch_to_blob(batch: EventBatch) -> np.ndarray:
     """Pack an EventBatch into the compact wire blob (host side, numpy).
 
     A single transfer instead of 12 (remote/tunneled runtimes pay a
-    round-trip per device_put), at 28 B/event instead of 48.
+    round-trip per device_put), at 20 B/event instead of 48. Payload
+    fields are preserved per event type (see layout comment); a
+    well-formed batch — anything the packer/decoders produce — round-trips
+    exactly.
     """
     lead = batch.device_idx.shape[:-1]   # () flat, (S,) routed
     B = batch.device_idx.shape[-1]
+    dev = np.asarray(batch.device_idx, np.int32)
+    if dev.size and (int(dev.max()) >= WIRE_DEV_MAX or int(dev.min()) < 0):
+        raise ValueError(
+            f"device_idx out of wire-blob device field range "
+            f"[0, {WIRE_DEV_MAX}): min {int(dev.min())}, "
+            f"max {int(dev.max())}")
+    et = np.asarray(batch.event_type, np.int32) & 7
+    is_loc = et == _ET_LOCATION
+    is_alert = et == _ET_ALERT
     blob = np.empty(lead + (WIRE_ROWS, B), np.int32)
-    blob[..., 0, :] = batch.device_idx
+    blob[..., 0, :] = (
+        dev
+        | (et << _ET_SHIFT)
+        | (np.asarray(batch.alert_level, np.int32) & 7) << _LEVEL_SHIFT
+        | np.asarray(batch.valid).astype(np.int32) << _VALID_SHIFT)
     blob[..., 1, :] = batch.ts
-    blob[..., 2, :] = np.asarray(batch.value, np.float32).view(np.int32)
-    blob[..., 3, :] = np.asarray(batch.lat, np.float32).view(np.int32)
-    blob[..., 4, :] = np.asarray(batch.lon, np.float32).view(np.int32)
-    blob[..., 5, :] = np.asarray(batch.elevation, np.float32).view(np.int32)
-    meta = (np.asarray(batch.event_type, np.int32) & 7)
-    meta |= (np.asarray(batch.alert_level, np.int32) & 7) << 3
-    meta |= np.asarray(batch.valid).astype(np.int32) << 6
-    meta |= (np.asarray(batch.mm_idx, np.int32) & (_META_MAX_IDX - 1)) << 7
-    meta |= (np.asarray(batch.alert_type_idx, np.int32)
-             & (_META_MAX_IDX - 1)) << 19
-    blob[..., 6, :] = meta
+    blob[..., 2, :] = np.where(
+        is_loc, np.asarray(batch.lat, np.float32).view(np.int32),
+        np.asarray(batch.value, np.float32).view(np.int32))
+    # mm_idx/alert_type_idx keep the v1 12-bit wire mask: a negative or
+    # oversized index (reachable via un-validated pack_columns input) must
+    # not reach the device-side `idx < M` guards as a negative — a negative
+    # index would wrap Python-style in the keyed scatter and corrupt a
+    # NEIGHBORING device's state slot.
+    idx_mask = _META_MAX_IDX - 1
+    blob[..., 3, :] = np.where(
+        is_loc, np.asarray(batch.lon, np.float32).view(np.int32),
+        np.where(is_alert,
+                 np.asarray(batch.alert_type_idx, np.int32) & idx_mask,
+                 np.asarray(batch.mm_idx, np.int32) & idx_mask))
+    blob[..., 4, :] = np.asarray(batch.elevation, np.float32).view(np.int32)
     return blob
 
 
@@ -111,43 +146,56 @@ def blob_to_batch_np(blob: np.ndarray) -> EventBatch:
     Used to materialize a routed blob back into columns for alert
     materialization without keeping a second routed copy around."""
     blob = np.asarray(blob, np.int32)
-
-    def f(i):
-        return blob[..., i, :].view(np.float32)
-
-    meta = blob[..., 6, :]
+    r0 = blob[..., 0, :]
+    et = (r0 >> _ET_SHIFT) & 7
+    is_meas = et == _ET_MEASUREMENT
+    is_loc = et == _ET_LOCATION
+    pa = blob[..., 2, :]
+    pb = blob[..., 3, :]
+    zf = np.float32(0)
     return EventBatch(
-        device_idx=blob[..., 0, :],
-        tenant_idx=np.zeros_like(blob[..., 0, :]),
-        event_type=meta & 7,
+        device_idx=r0 & (WIRE_DEV_MAX - 1),
+        tenant_idx=np.zeros_like(r0),
+        event_type=et,
         ts=blob[..., 1, :],
-        mm_idx=(meta >> 7) & (_META_MAX_IDX - 1),
-        value=f(2), lat=f(3), lon=f(4), elevation=f(5),
-        alert_type_idx=(meta >> 19) & (_META_MAX_IDX - 1),
-        alert_level=(meta >> 3) & 7,
-        valid=(meta & (1 << 6)) != 0)
+        mm_idx=np.where(is_meas, pb, 0).astype(np.int32),
+        value=np.where(is_meas, pa.view(np.float32), zf),
+        lat=np.where(is_loc, pa.view(np.float32), zf),
+        lon=np.where(is_loc, pb.view(np.float32), zf),
+        elevation=blob[..., 4, :].view(np.float32),
+        alert_type_idx=np.where(et == _ET_ALERT, pb, 0).astype(np.int32),
+        alert_level=(r0 >> _LEVEL_SHIFT) & 7,
+        valid=(r0 & (1 << _VALID_SHIFT)) != 0)
 
 
 def blob_to_batch(blob) -> EventBatch:
     """Inverse of batch_to_blob on-device (jax ops; call under jit — XLA
-    fuses the unpack into the step's first consumers)."""
+    fuses the unpack + selects into the step's first consumers)."""
     import jax
     import jax.numpy as jnp
 
-    def f(i):
-        return jax.lax.bitcast_convert_type(blob[..., i, :], jnp.float32)
-
-    meta = blob[..., 6, :]
+    r0 = blob[..., 0, :]
+    et = (r0 >> _ET_SHIFT) & 7
+    is_meas = et == _ET_MEASUREMENT
+    is_loc = et == _ET_LOCATION
+    pa = blob[..., 2, :]
+    pb = blob[..., 3, :]
+    fa = jax.lax.bitcast_convert_type(pa, jnp.float32)
+    fb = jax.lax.bitcast_convert_type(pb, jnp.float32)
+    zf = jnp.float32(0)
     return EventBatch(
-        device_idx=blob[..., 0, :],
-        tenant_idx=jnp.zeros_like(blob[..., 0, :]),
-        event_type=meta & 7,
+        device_idx=r0 & (WIRE_DEV_MAX - 1),
+        tenant_idx=jnp.zeros_like(r0),
+        event_type=et,
         ts=blob[..., 1, :],
-        mm_idx=(meta >> 7) & (_META_MAX_IDX - 1),
-        value=f(2), lat=f(3), lon=f(4), elevation=f(5),
-        alert_type_idx=(meta >> 19) & (_META_MAX_IDX - 1),
-        alert_level=(meta >> 3) & 7,
-        valid=(meta & (1 << 6)) != 0)
+        mm_idx=jnp.where(is_meas, pb, 0),
+        value=jnp.where(is_meas, fa, zf),
+        lat=jnp.where(is_loc, fa, zf),
+        lon=jnp.where(is_loc, fb, zf),
+        elevation=jax.lax.bitcast_convert_type(blob[..., 4, :], jnp.float32),
+        alert_type_idx=jnp.where(et == _ET_ALERT, pb, 0),
+        alert_level=(r0 >> _LEVEL_SHIFT) & 7,
+        valid=(r0 & (1 << _VALID_SHIFT)) != 0)
 
 
 class EventPacker:

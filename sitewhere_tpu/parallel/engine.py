@@ -210,7 +210,7 @@ class ShardedPipelineEngine(PipelineEngine):
         if self._overflow is not None:
             batch = concat_flat_batches([self._overflow, batch])
             self._overflow = None
-        # Blob-first routing: pack the flat batch once (7 int32 rows), then
+        # Blob-first routing: pack the flat batch once (WIRE_ROWS int32 rows),
         # the router scatters those rows per shard (native single pass when
         # available) — the routed blob IS the staging format, so no second
         # pack happens, and the routed EventBatch view is derived by cheap

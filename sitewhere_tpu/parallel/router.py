@@ -81,21 +81,23 @@ class ShardRouter:
 
     def route_blob(self, blob: np.ndarray
                    ) -> Tuple[np.ndarray, np.ndarray]:
-        """Route a flat wire blob [7, n] into ([S, 7, B] routed blob,
-        overflow flat-row indices). The native single-pass router
-        (host_runtime.cc swt_route_blob) replaces argsort + per-column
-        scatters; the numpy fallback routes the 7 blob rows the same way
-        route_columns routes the 12 column arrays."""
+        """Route a flat wire blob [WIRE_ROWS, n] into ([S, WIRE_ROWS, B]
+        routed blob, overflow flat-row indices). The native single-pass
+        router (host_runtime.cc swt_route_blob) replaces argsort +
+        per-column scatters; the numpy fallback routes the blob rows the
+        same way route_columns routes the 12 column arrays."""
         from sitewhere_tpu import native
+        from sitewhere_tpu.ops.pack import (
+            WIRE_DEV_MAX, WIRE_ROWS, _VALID_SHIFT)
 
         S, B = self.n_shards, self.per_shard_batch
         if native.available():
             return native.route_blob(blob, S, B)
         blob = np.asarray(blob, np.int32)
         n = blob.shape[1]
-        meta = blob[6]
-        rows = np.nonzero((meta & (1 << 6)) != 0)[0]
-        dev = blob[0, rows]
+        head = blob[0]
+        rows = np.nonzero((head & (1 << _VALID_SHIFT)) != 0)[0]
+        dev = head[rows] & (WIRE_DEV_MAX - 1)
         shard = dev % S
         order = np.argsort(shard, kind="stable")
         srows = rows[order]
@@ -104,10 +106,12 @@ class ShardRouter:
         starts = np.concatenate([[0], np.cumsum(counts)[:-1]])
         pos = np.arange(len(srows), dtype=np.int64) - starts[sshard]
         keep = pos < B
-        out = np.zeros((S, 7, B), np.int32)
+        out = np.zeros((S, WIRE_ROWS, B), np.int32)
         ks, kp, krows = sshard[keep], pos[keep], srows[keep]
-        out[ks, 0, kp] = blob[0, krows] // S
-        for r in range(1, 7):
+        kdev = head[krows] & (WIRE_DEV_MAX - 1)
+        out[ks, 0, kp] = (head[krows] & ~np.int32(WIRE_DEV_MAX - 1)) \
+            | (kdev // S)
+        for r in range(1, WIRE_ROWS):
             out[ks, r, kp] = blob[r, krows]
         return out, np.sort(srows[~keep])  # arrival order, like the native
 

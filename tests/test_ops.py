@@ -358,17 +358,25 @@ class TestWireBlob:
 
         rng = np.random.default_rng(3)
         B = 257
+        # Well-formed batch: payload columns populated per event type, the
+        # shape every producer (packer, decoders, fastlane) emits — the v2
+        # union layout shares payload rows between mutually-exclusive types.
+        et = rng.integers(0, 6, B).astype(np.int32)
+        is_meas = et == 0
+        is_loc = et == 1
+        is_alert = et == 2
         b = empty_batch(B)
         b = b.replace(
             device_idx=rng.integers(0, 2 ** 20, B).astype(np.int32),
-            event_type=rng.integers(0, 6, B).astype(np.int32),
+            event_type=et,
             ts=rng.integers(-2 ** 30, 2 ** 30, B).astype(np.int32),
-            mm_idx=rng.integers(0, 4096, B).astype(np.int32),
-            value=rng.normal(size=B).astype(np.float32),
-            lat=rng.uniform(-90, 90, B).astype(np.float32),
-            lon=rng.uniform(-180, 180, B).astype(np.float32),
+            mm_idx=np.where(is_meas, rng.integers(0, 4096, B), 0).astype(np.int32),
+            value=np.where(is_meas, rng.normal(size=B), 0).astype(np.float32),
+            lat=np.where(is_loc, rng.uniform(-90, 90, B), 0).astype(np.float32),
+            lon=np.where(is_loc, rng.uniform(-180, 180, B), 0).astype(np.float32),
             elevation=rng.normal(size=B).astype(np.float32),
-            alert_type_idx=rng.integers(0, 4096, B).astype(np.int32),
+            alert_type_idx=np.where(is_alert, rng.integers(0, 4096, B),
+                                    0).astype(np.int32),
             alert_level=rng.integers(0, 6, B).astype(np.int32),
             valid=rng.integers(0, 2, B).astype(bool))
         blob = batch_to_blob(b)

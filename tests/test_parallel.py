@@ -277,17 +277,24 @@ class TestRouteBlob:
         from sitewhere_tpu.ops.pack import EventBatch
 
         valid = rng.random(n) > 0.1
+        # Payload columns per event type: the wire blob's union rows
+        # (ops/pack.py v2) only carry the type-relevant fields.
+        et = rng.integers(0, 3, n).astype(np.int32)
+        is_meas, is_loc, is_alert = et == 0, et == 1, et == 2
         return EventBatch(
             device_idx=rng.integers(1, n_dev, n).astype(np.int32),
             tenant_idx=np.zeros(n, np.int32),
-            event_type=rng.integers(0, 3, n).astype(np.int32),
+            event_type=et,
             ts=rng.integers(0, 10_000, n).astype(np.int32),
-            mm_idx=rng.integers(0, 8, n).astype(np.int32),
-            value=rng.uniform(-5, 5, n).astype(np.float32),
-            lat=rng.uniform(-90, 90, n).astype(np.float32),
-            lon=rng.uniform(-180, 180, n).astype(np.float32),
+            mm_idx=np.where(is_meas, rng.integers(0, 8, n), 0).astype(np.int32),
+            value=np.where(is_meas, rng.uniform(-5, 5, n), 0).astype(np.float32),
+            lat=np.where(is_loc, rng.uniform(-90, 90, n), 0).astype(np.float32),
+            lon=np.where(is_loc, rng.uniform(-180, 180, n), 0).astype(np.float32),
+            # elevation rides wire row 4 for EVERY event type — keep it
+            # random on all rows so a regression gating it by is_loc fails
             elevation=rng.uniform(0, 100, n).astype(np.float32),
-            alert_type_idx=rng.integers(0, 8, n).astype(np.int32),
+            alert_type_idx=np.where(is_alert, rng.integers(0, 8, n),
+                                    0).astype(np.int32),
             alert_level=rng.integers(0, 5, n).astype(np.int32),
             valid=valid)
 

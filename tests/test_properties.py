@@ -21,18 +21,27 @@ class TestWireBlobProperties:
     @given(st.integers(1, 64), st.integers(0, 2 ** 31 - 1))
     @settings(max_examples=25, deadline=None)
     def test_roundtrip_lossless(self, n, seed):
-        from sitewhere_tpu.ops.pack import batch_to_blob, blob_to_batch, empty_batch
+        from sitewhere_tpu.ops.pack import (
+            WIRE_DEV_MAX, batch_to_blob, blob_to_batch, empty_batch)
         rng = np.random.default_rng(seed)
+        # Well-formed batches only (payload per event type): the v2 union
+        # layout (ops/pack.py) shares payload rows between the mutually-
+        # exclusive measurement/location/alert fields.
+        et = rng.integers(0, 6, n).astype(np.int32)
+        is_meas = et == 0
+        is_loc = et == 1
+        is_alert = et == 2
         b = empty_batch(n).replace(
-            device_idx=rng.integers(0, 2 ** 31 - 1, n).astype(np.int32),
-            event_type=rng.integers(0, 8, n).astype(np.int32),
+            device_idx=rng.integers(0, WIRE_DEV_MAX, n).astype(np.int32),
+            event_type=et,
             ts=rng.integers(-2 ** 31, 2 ** 31 - 1, n).astype(np.int32),
-            mm_idx=rng.integers(0, 4096, n).astype(np.int32),
-            value=rng.normal(size=n).astype(np.float32),
-            lat=rng.uniform(-90, 90, n).astype(np.float32),
-            lon=rng.uniform(-180, 180, n).astype(np.float32),
+            mm_idx=np.where(is_meas, rng.integers(0, 4096, n), 0).astype(np.int32),
+            value=np.where(is_meas, rng.normal(size=n), 0).astype(np.float32),
+            lat=np.where(is_loc, rng.uniform(-90, 90, n), 0).astype(np.float32),
+            lon=np.where(is_loc, rng.uniform(-180, 180, n), 0).astype(np.float32),
             elevation=rng.normal(size=n).astype(np.float32),
-            alert_type_idx=rng.integers(0, 4096, n).astype(np.int32),
+            alert_type_idx=np.where(is_alert, rng.integers(0, 4096, n),
+                                    0).astype(np.int32),
             alert_level=rng.integers(0, 8, n).astype(np.int32),
             valid=rng.integers(0, 2, n).astype(bool))
         out = blob_to_batch(batch_to_blob(b))
