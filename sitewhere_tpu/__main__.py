@@ -1,0 +1,213 @@
+"""Command-line entrypoint: ``python -m sitewhere_tpu <command>``.
+
+The reference ships each microservice as a runnable Spring Boot app
+(sitewhere-microservice MicroserviceApplication.java:40 — process entry,
+start() at :49); here the whole platform composes into one SPMD process,
+so the CLI boots the single-process instance the same way an operator
+would boot the reference's docker-compose stack.
+
+Commands:
+
+  serve    boot a SiteWhereInstance + REST gateway (+ optional networked
+           bus edge for cross-process producers/consumers)
+  openapi  print the generated OpenAPI 3 document and exit
+  check    environment self-check: jax backend/devices, native runtime,
+           virtual mesh availability
+  version  print the package version
+
+Configuration layers (runtime/config.py — the CLI uses the canonical
+``DEFAULTS`` schema there): built-in defaults <- --config JSON file <-
+SWTPU_* environment variables <- command-line flags. Example config file:
+
+    {"instance": {"id": "prod"},
+     "persist": {"data_dir": "/var/lib/swtpu"},
+     "pipeline": {"enabled": true, "batch_size": 8192,
+                  "max_devices": 131072},
+     "mesh": {"shards": 8},
+     "api": {"host": "0.0.0.0", "port": 8080},
+     "bus": {"edge_port": 9092}}
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import signal
+import sys
+import threading
+from typing import Optional
+
+
+def _build_config(config_path: Optional[str]):
+    from sitewhere_tpu.runtime.config import DEFAULTS, Configuration
+
+    return Configuration(defaults=DEFAULTS, config_path=config_path)
+
+
+def _build_instance(cfg):
+    from sitewhere_tpu.instance import SiteWhereInstance
+
+    return SiteWhereInstance(
+        instance_id=cfg.get("instance.id"),
+        data_dir=cfg.get("persist.data_dir"),
+        enable_pipeline=bool(cfg.get("pipeline.enabled")),
+        max_devices=int(cfg.get("pipeline.max_devices")),
+        max_zones=int(cfg.get("pipeline.max_zones")),
+        max_zone_vertices=int(cfg.get("pipeline.max_zone_vertices")),
+        batch_size=int(cfg.get("pipeline.batch_size")),
+        measurement_slots=int(cfg.get("pipeline.measurement_slots")),
+        max_tenants=int(cfg.get("pipeline.max_tenants")),
+        bus_partitions=int(cfg.get("bus.partitions")),
+        default_tenant=cfg.get("instance.default_tenant"),
+        admin_username=cfg.get("instance.admin_username"),
+        admin_password=cfg.get("instance.admin_password"),
+        shards=int(cfg.get("mesh.shards")))
+
+
+def cmd_serve(args) -> int:
+    from sitewhere_tpu.runtime.busnet import BusServer
+    from sitewhere_tpu.web.server import RestServer
+
+    cfg = _build_config(args.config)
+    # flags override file/env layers
+    if args.data_dir is not None:
+        cfg.set("persist.data_dir", args.data_dir)
+    if args.port is not None:
+        cfg.set("api.port", args.port)
+    if args.host is not None:
+        cfg.set("api.host", args.host)
+    if args.shards is not None:
+        cfg.set("mesh.shards", args.shards)
+    if args.no_pipeline:
+        cfg.set("pipeline.enabled", False)
+    if args.bus_port is not None:
+        cfg.set("bus.edge_port", args.bus_port)
+
+    instance = _build_instance(cfg)
+    instance.start()
+    rest = RestServer(instance, host=cfg.get("api.host"),
+                      port=int(cfg.get("api.port")),
+                      token_expiration_minutes=int(
+                          cfg.get("api.jwt_expiration_min")))
+    rest.start()
+    bus_server = None
+    edge_port = cfg.get("bus.edge_port")
+    if edge_port is not None:
+        bus_server = BusServer(instance.bus, host=cfg.get("api.host"),
+                               port=int(edge_port))
+        bus_server.start()
+
+    print(f"sitewhere-tpu instance '{instance.instance_id}' serving")
+    print(f"  REST gateway : {rest.base_url}")
+    print(f"  OpenAPI doc  : {rest.base_url}/api/openapi.json")
+    if bus_server is not None:
+        print(f"  bus edge     : tcp://{cfg.get('api.host')}:"
+              f"{bus_server.port}")
+
+    stop = threading.Event()
+
+    def _sig(_signum, _frame):
+        stop.set()
+
+    signal.signal(signal.SIGINT, _sig)
+    signal.signal(signal.SIGTERM, _sig)
+    try:
+        while not stop.wait(1.0):
+            pass
+    finally:
+        if bus_server is not None:
+            bus_server.stop()
+        rest.stop()
+        instance.stop()
+    return 0
+
+
+def cmd_openapi(args) -> int:
+    from sitewhere_tpu.web.openapi import generate_openapi
+    from sitewhere_tpu.web.server import RestServer
+
+    import sitewhere_tpu
+
+    cfg = _build_config(args.config)
+    # Doc generation needs only the router: no device engine, and no
+    # durable state — a data_dir would replay bus segments and open
+    # append handles on files a live `serve` process may be writing.
+    cfg.set("pipeline.enabled", False)
+    cfg.set("persist.data_dir", None)
+    instance = _build_instance(cfg)
+    rest = RestServer(instance)  # builds the router; not started
+    doc = generate_openapi(rest.router, version=sitewhere_tpu.__version__)
+    json.dump(doc, sys.stdout, indent=2)
+    print()
+    return 0
+
+
+def cmd_check(_args) -> int:
+    import sitewhere_tpu
+    from sitewhere_tpu import native
+
+    print(f"sitewhere-tpu {sitewhere_tpu.__version__}")
+    ok = True
+    if native.available():
+        print("native host runtime: ok (libswt_host.so)")
+    else:
+        # pure-Python fallback is a supported mode, not a failure
+        print(f"native host runtime: fallback ({native.build_error()})")
+    try:
+        import jax
+
+        devs = jax.devices()
+        print(f"jax backend: {devs[0].platform} x{len(devs)} "
+              f"({devs[0].device_kind})")
+        cpus = jax.devices("cpu")
+        print(f"cpu mesh devices: {len(cpus)} "
+              "(set XLA_FLAGS=--xla_force_host_platform_device_count=N "
+              "for virtual shards)")
+    except Exception as exc:  # noqa: BLE001 - report, don't crash the check
+        ok = False
+        print(f"jax: FAILED ({exc})")
+    return 0 if ok else 1
+
+
+def cmd_version(_args) -> int:
+    import sitewhere_tpu
+
+    print(sitewhere_tpu.__version__)
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m sitewhere_tpu",
+        description="TPU-native IoT application enablement platform")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    serve = sub.add_parser("serve", help="boot instance + REST gateway")
+    serve.add_argument("--config", help="JSON config file (layered)")
+    serve.add_argument("--data-dir", help="durable state directory")
+    serve.add_argument("--host", help="bind host (default 127.0.0.1)")
+    serve.add_argument("--port", type=int, help="REST port (default 8080)")
+    serve.add_argument("--shards", type=int,
+                       help="device-mesh shards for the pipeline engine")
+    serve.add_argument("--no-pipeline", action="store_true",
+                       help="control plane only (no device engine)")
+    serve.add_argument("--bus-port", type=int,
+                       help="expose the event bus on TCP for edge processes")
+    serve.set_defaults(fn=cmd_serve)
+
+    openapi = sub.add_parser("openapi", help="print the OpenAPI document")
+    openapi.add_argument("--config", help="JSON config file")
+    openapi.set_defaults(fn=cmd_openapi)
+
+    check = sub.add_parser("check", help="environment self-check")
+    check.set_defaults(fn=cmd_check)
+
+    version = sub.add_parser("version", help="print version")
+    version.set_defaults(fn=cmd_version)
+
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -163,8 +163,11 @@ class Configuration:
 
 
 DEFAULTS: Dict = {
-    "instance": {"id": "swtpu1", "product_id": "sitewhere-tpu"},
+    "instance": {"id": "swtpu1", "product_id": "sitewhere-tpu",
+                 "default_tenant": "default",
+                 "admin_username": "admin", "admin_password": "password"},
     "pipeline": {
+        "enabled": True,
         "batch_size": 8192,
         "max_devices": 131072,
         "max_zones": 256,
@@ -172,9 +175,11 @@ DEFAULTS: Dict = {
         "max_threshold_rules": 256,
         "max_measurement_names": 1024,
         "max_tenants": 16,
+        "measurement_slots": 8,
         "presence_missing_interval_ms": 8 * 60 * 60 * 1000,  # reference default 8h
     },
-    "bus": {"partitions": 8, "retention_chunks": 64, "chunk_events": 65536},
+    "bus": {"partitions": 8, "retention_chunks": 64, "chunk_events": 65536,
+            "edge_port": None},  # set to expose the bus on TCP (busnet)
     "persist": {"data_dir": "./swtpu-data"},
     "api": {"host": "127.0.0.1", "port": 8080, "jwt_secret": "change-me",
             "jwt_expiration_min": 600},

@@ -1,0 +1,141 @@
+"""CLI entrypoint (`python -m sitewhere_tpu`) — the operator boot surface.
+
+The reference boots each microservice as a runnable app
+(MicroserviceApplication.java:40); here one `serve` process is the whole
+platform, so the CLI is the parity point for "run the thing".
+"""
+
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+import time
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _env():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO
+    env.setdefault("PYTHONUNBUFFERED", "1")
+    return env
+
+
+def _wait_for(proc, pattern, timeout_s=120):
+    """Read child stdout until `pattern` matches; fail fast (with the
+    collected output) if the child exits first."""
+    collected = []
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        line = proc.stdout.readline()
+        if line:
+            collected.append(line)
+            m = re.search(pattern, line)
+            if m:
+                return m
+            continue
+        if proc.poll() is not None:
+            raise AssertionError(
+                f"serve exited rc={proc.returncode} before matching "
+                f"{pattern!r}; output:\n{''.join(collected)}")
+        time.sleep(0.05)
+    raise AssertionError(
+        f"timed out waiting for {pattern!r}; output:\n{''.join(collected)}")
+
+
+def test_version_and_check():
+    out = subprocess.run(
+        [sys.executable, "-m", "sitewhere_tpu", "version"],
+        capture_output=True, text=True, env=_env(), timeout=120)
+    assert out.returncode == 0
+    assert re.match(r"^\d+\.\d+\.\d+$", out.stdout.strip())
+
+    chk = subprocess.run(
+        [sys.executable, "-m", "sitewhere_tpu", "check"],
+        capture_output=True, text=True, env=_env(), timeout=300)
+    assert chk.returncode == 0, chk.stdout + chk.stderr
+    assert "native host runtime" in chk.stdout
+    assert "jax backend" in chk.stdout
+
+
+def test_check_passes_without_native_runtime():
+    env = _env()
+    env["SITEWHERE_TPU_NO_NATIVE"] = "1"  # fallback mode is supported
+    chk = subprocess.run(
+        [sys.executable, "-m", "sitewhere_tpu", "check"],
+        capture_output=True, text=True, env=env, timeout=300)
+    assert chk.returncode == 0, chk.stdout + chk.stderr
+    assert "fallback" in chk.stdout
+
+
+def test_openapi_command(tmp_path):
+    out = subprocess.run(
+        [sys.executable, "-m", "sitewhere_tpu", "openapi"],
+        capture_output=True, text=True, env=_env(), timeout=300,
+        cwd=str(tmp_path))
+    assert out.returncode == 0, out.stderr[-2000:]
+    doc = json.loads(out.stdout)
+    assert doc["openapi"].startswith("3.")
+    assert "/api/devices" in doc["paths"]
+    # no durable state may be created by doc generation
+    assert not (tmp_path / "swtpu-data").exists()
+
+
+def test_serve_boots_and_stops_cleanly(tmp_path):
+    cfg = tmp_path / "cfg.json"
+    cfg.write_text(json.dumps({
+        "instance": {"id": "cli-test"},
+        "persist": {"data_dir": str(tmp_path / "data")},
+        "pipeline": {"enabled": False},
+    }))
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "sitewhere_tpu", "serve",
+         "--config", str(cfg), "--port", "0"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=_env())
+    try:
+        m = _wait_for(proc, r"REST gateway : (http://\S+)")
+        base_url = m.group(1)
+        with urllib.request.urlopen(base_url + "/api/openapi.json",
+                                    timeout=10) as resp:
+            doc = json.loads(resp.read())
+        assert "/api/devices" in doc["paths"]
+        proc.send_signal(signal.SIGTERM)
+        assert proc.wait(timeout=60) == 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+
+
+def test_serve_bus_edge(tmp_path):
+    """--bus-port exposes the instance bus to edge processes (busnet)."""
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "sitewhere_tpu", "serve", "--port", "0",
+         "--no-pipeline", "--bus-port", "0",
+         "--data-dir", str(tmp_path / "data")],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=_env())
+    try:
+        m = _wait_for(proc, r"bus edge     : tcp://[^:]+:(\d+)")
+        bus_port = int(m.group(1))
+
+        from sitewhere_tpu.runtime.busnet import BusClient
+
+        client = BusClient("127.0.0.1", bus_port)
+        client.publish("cli-topic", b"k", b"v")
+        records = client.poll("cli-topic", group="g", max_records=10,
+                              timeout_s=5.0)
+        client.commit("cli-topic", "g")
+        client.close()
+        assert [(r.key, r.value) for r in records] == [(b"k", b"v")]
+        proc.send_signal(signal.SIGTERM)
+        assert proc.wait(timeout=60) == 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
