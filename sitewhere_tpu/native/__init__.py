@@ -63,7 +63,17 @@ def _load() -> None:
     p_i32 = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
     p_i64 = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
     p_f32 = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
-    lib.swt_version.restype = i32
+    # ABI gate FIRST: a stale cached .so (mtime-preserving deploys defeat
+    # the staleness check) must fall back, not crash the import when a
+    # newer binding looks up a symbol the old library doesn't export.
+    try:
+        lib.swt_version.restype = i32
+        if lib.swt_version() != 3:
+            _build_error = "version mismatch (stale libswt_host.so)"
+            return
+    except AttributeError as exc:
+        _build_error = f"stale libswt_host.so: {exc}"
+        return
     lib.swt_interner_create.argtypes = [i32]
     lib.swt_interner_create.restype = vp
     lib.swt_interner_destroy.argtypes = [vp]
@@ -89,9 +99,15 @@ def _load() -> None:
     lib.swt_decode_hot_frames.restype = i32
     lib.swt_route_blob.argtypes = [p_i32, i64, i32, i32, p_i32, p_i64, i64]
     lib.swt_route_blob.restype = i32
-    if lib.swt_version() != 2:
-        _build_error = "version mismatch"
-        return
+    p_u8 = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+    lib.swt_pack_blob.argtypes = [p_i32, p_i32, p_i32, p_i32, p_f32, p_f32,
+                                  p_f32, p_f32, p_i32, p_i32, p_u8, i64,
+                                  p_i32]
+    lib.swt_pack_blob.restype = i32
+    lib.swt_unpack_blob.argtypes = [p_i32, i64, p_i32, p_i32, p_i32, p_i32,
+                                    p_f32, p_f32, p_f32, p_f32, p_i32, p_i32,
+                                    p_u8]
+    lib.swt_unpack_blob.restype = None
     LIB = lib
 
 
@@ -282,3 +298,36 @@ def route_blob(blob: np.ndarray, n_shards: int, per_shard: int
     if n_over < 0:  # cannot happen with overflow_cap=n; defensive
         raise RuntimeError("route_blob overflow capacity exceeded")
     return out, overflow[:n_over]
+
+
+def pack_blob(batch, out: np.ndarray) -> bool:
+    """One-pass EventBatch columns -> [WIRE_ROWS, n] wire blob (flat
+    batches only; leading-axis batches use the numpy path). Returns False
+    when a device_idx is out of wire range (caller raises with detail).
+    Requires available()."""
+    n = batch.device_idx.shape[0]
+
+    def i32(a):
+        return np.ascontiguousarray(a, np.int32)
+
+    def f32(a):
+        return np.ascontiguousarray(a, np.float32)
+
+    rc = LIB.swt_pack_blob(
+        i32(batch.device_idx), i32(batch.event_type), i32(batch.ts),
+        i32(batch.mm_idx), f32(batch.value), f32(batch.lat), f32(batch.lon),
+        f32(batch.elevation), i32(batch.alert_type_idx),
+        i32(batch.alert_level),
+        np.ascontiguousarray(batch.valid, np.uint8), n, out.reshape(-1))
+    return rc == 0
+
+
+def unpack_blob(blob: np.ndarray, cols: dict) -> None:
+    """One-pass [WIRE_ROWS, n] wire blob -> preallocated column arrays
+    (keys: device_idx..valid). Requires available()."""
+    n = blob.shape[-1]
+    LIB.swt_unpack_blob(
+        np.ascontiguousarray(blob, np.int32).reshape(-1), n,
+        cols["device_idx"], cols["event_type"], cols["ts"], cols["mm_idx"],
+        cols["value"], cols["lat"], cols["lon"], cols["elevation"],
+        cols["alert_type_idx"], cols["alert_level"], cols["valid"])

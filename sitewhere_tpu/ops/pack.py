@@ -107,6 +107,18 @@ def batch_to_blob(batch: EventBatch) -> np.ndarray:
     """
     lead = batch.device_idx.shape[:-1]   # () flat, (S,) routed
     B = batch.device_idx.shape[-1]
+    if not lead:
+        from sitewhere_tpu import native
+
+        if native.available():
+            out = np.empty((WIRE_ROWS, B), np.int32)
+            if not native.pack_blob(batch, out):
+                bad = np.asarray(batch.device_idx, np.int32)
+                raise ValueError(
+                    f"device_idx out of wire-blob device field range "
+                    f"[0, {WIRE_DEV_MAX}): min {int(bad.min())}, "
+                    f"max {int(bad.max())}")
+            return out
     dev = np.asarray(batch.device_idx, np.int32)
     if dev.size and (int(dev.max()) >= WIRE_DEV_MAX or int(dev.min()) < 0):
         raise ValueError(
@@ -142,10 +154,38 @@ def batch_to_blob(batch: EventBatch) -> np.ndarray:
 
 
 def blob_to_batch_np(blob: np.ndarray) -> EventBatch:
-    """Host-side inverse of batch_to_blob (numpy views/bit ops — cheap).
-    Used to materialize a routed blob back into columns for alert
-    materialization without keeping a second routed copy around."""
+    """Host-side inverse of batch_to_blob (native one-pass when available,
+    numpy views/bit ops otherwise). Used to materialize a routed blob back
+    into columns for alert materialization without keeping a second routed
+    copy around."""
     blob = np.asarray(blob, np.int32)
+    from sitewhere_tpu import native
+
+    if native.available():
+        shape = blob.shape[:-2] + blob.shape[-1:]   # [n] flat, [S, B] routed
+        cols = {name: np.empty(shape, np.int32) for name in
+                ("device_idx", "event_type", "ts", "mm_idx",
+                 "alert_type_idx", "alert_level")}
+        cols.update({name: np.empty(shape, np.float32) for name in
+                     ("value", "lat", "lon", "elevation")})
+        cols["valid"] = np.empty(shape, np.uint8)
+        if blob.ndim == 2:
+            native.unpack_blob(blob, cols)
+        else:
+            flat = blob.reshape((-1,) + blob.shape[-2:])
+            for s in range(flat.shape[0]):
+                native.unpack_blob(
+                    flat[s], {k: v.reshape(-1, shape[-1])[s]
+                              for k, v in cols.items()})
+        return EventBatch(
+            device_idx=cols["device_idx"],
+            tenant_idx=np.zeros(shape, np.int32),
+            event_type=cols["event_type"], ts=cols["ts"],
+            mm_idx=cols["mm_idx"], value=cols["value"], lat=cols["lat"],
+            lon=cols["lon"], elevation=cols["elevation"],
+            alert_type_idx=cols["alert_type_idx"],
+            alert_level=cols["alert_level"],
+            valid=cols["valid"].view(bool))  # 0/1 uint8 -> bool, no copy
     r0 = blob[..., 0, :]
     et = (r0 >> _ET_SHIFT) & 7
     is_meas = et == _ET_MEASUREMENT

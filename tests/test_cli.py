@@ -8,6 +8,7 @@ platform, so the CLI is the parity point for "run the thing".
 import json
 import os
 import re
+import select
 import signal
 import subprocess
 import sys
@@ -27,22 +28,24 @@ def _env():
 
 def _wait_for(proc, pattern, timeout_s=120):
     """Read child stdout until `pattern` matches; fail fast (with the
-    collected output) if the child exits first."""
+    collected output) if the child exits first. select() guards every
+    readline so a silent hang in the child cannot hang the test."""
     collected = []
     deadline = time.time() + timeout_s
     while time.time() < deadline:
-        line = proc.stdout.readline()
-        if line:
-            collected.append(line)
-            m = re.search(pattern, line)
-            if m:
-                return m
-            continue
+        ready, _, _ = select.select([proc.stdout], [], [], 0.5)
+        if ready:
+            line = proc.stdout.readline()
+            if line:
+                collected.append(line)
+                m = re.search(pattern, line)
+                if m:
+                    return m
+                continue
         if proc.poll() is not None:
             raise AssertionError(
                 f"serve exited rc={proc.returncode} before matching "
                 f"{pattern!r}; output:\n{''.join(collected)}")
-        time.sleep(0.05)
     raise AssertionError(
         f"timed out waiting for {pattern!r}; output:\n{''.join(collected)}")
 

@@ -316,3 +316,91 @@ class TestRobustness:
         from sitewhere_tpu.native import WireDecodeError
         from sitewhere_tpu.transport.wire import WireError
         assert issubclass(WireDecodeError, WireError)
+
+
+class TestNativePackUnpack:
+    """swt_pack_blob / swt_unpack_blob must agree exactly with the numpy
+    batch_to_blob / blob_to_batch_np fallbacks (the hot staging path)."""
+
+    def _batch(self, n=777, seed=11):
+        import numpy as np
+
+        from sitewhere_tpu.ops.pack import EventBatch
+
+        rng = np.random.default_rng(seed)
+        et = rng.integers(0, 6, n).astype(np.int32)
+        is_meas, is_loc, is_alert = et == 0, et == 1, et == 2
+        return EventBatch(
+            device_idx=rng.integers(0, 2 ** 20, n).astype(np.int32),
+            tenant_idx=np.zeros(n, np.int32),
+            event_type=et,
+            ts=rng.integers(-2 ** 30, 2 ** 30, n).astype(np.int32),
+            mm_idx=np.where(is_meas, rng.integers(0, 4096, n), 0).astype(np.int32),
+            value=np.where(is_meas, rng.normal(size=n), 0).astype(np.float32),
+            lat=np.where(is_loc, rng.uniform(-90, 90, n), 0).astype(np.float32),
+            lon=np.where(is_loc, rng.uniform(-180, 180, n), 0).astype(np.float32),
+            elevation=rng.normal(size=n).astype(np.float32),
+            alert_type_idx=np.where(is_alert, rng.integers(0, 4096, n),
+                                    0).astype(np.int32),
+            alert_level=rng.integers(0, 8, n).astype(np.int32),
+            valid=rng.integers(0, 2, n).astype(bool))
+
+    def test_pack_unpack_parity(self, monkeypatch):
+        import numpy as np
+
+        from sitewhere_tpu import native
+        from sitewhere_tpu.ops.pack import batch_to_blob, blob_to_batch_np
+
+        if not native.available():
+            pytest.skip("native library unavailable")
+        b = self._batch()
+        nat_blob = batch_to_blob(b)
+        nat_batch = blob_to_batch_np(nat_blob)
+        monkeypatch.setattr(native, "available", lambda: False)
+        py_blob = batch_to_blob(b)
+        py_batch = blob_to_batch_np(py_blob)
+        np.testing.assert_array_equal(nat_blob, py_blob)
+        for name in ("device_idx", "event_type", "ts", "mm_idx", "value",
+                     "lat", "lon", "elevation", "alert_type_idx",
+                     "alert_level", "valid"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(nat_batch, name)),
+                np.asarray(getattr(py_batch, name)), err_msg=name)
+
+    def test_pack_rejects_out_of_range_device(self):
+        import numpy as np
+
+        from sitewhere_tpu.ops.pack import (
+            WIRE_DEV_MAX, batch_to_blob, empty_batch)
+
+        b = empty_batch(4).replace(
+            device_idx=np.array([0, 1, WIRE_DEV_MAX, 2], np.int32))
+        with pytest.raises(ValueError):
+            batch_to_blob(b)
+        b = empty_batch(4).replace(
+            device_idx=np.array([0, -1, 1, 2], np.int32))
+        with pytest.raises(ValueError):
+            batch_to_blob(b)
+
+    def test_routed_unpack_parity(self, monkeypatch):
+        import numpy as np
+
+        from sitewhere_tpu import native
+        from sitewhere_tpu.ops.pack import batch_to_blob, blob_to_batch_np
+        from sitewhere_tpu.parallel.router import ShardRouter
+
+        if not native.available():
+            pytest.skip("native library unavailable")
+        b = self._batch(n=500, seed=5)
+        b = b.replace(device_idx=(np.asarray(b.device_idx) % 64))
+        router = ShardRouter(n_shards=4, per_shard_batch=160)
+        routed, _ = router.route_blob(batch_to_blob(b))
+        nat = blob_to_batch_np(routed)
+        monkeypatch.setattr(native, "available", lambda: False)
+        py = blob_to_batch_np(routed)
+        for name in ("device_idx", "event_type", "ts", "mm_idx", "value",
+                     "lat", "lon", "elevation", "alert_type_idx",
+                     "alert_level", "valid"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(nat, name)),
+                np.asarray(getattr(py, name)), err_msg=name)
