@@ -45,7 +45,7 @@ def _build() -> Optional[str]:
         return str(exc)
 
 
-def _load() -> None:
+def _load(_retry: bool = True) -> None:
     global LIB, _build_error
     if os.environ.get("SITEWHERE_TPU_NO_NATIVE") == "1":
         _build_error = "disabled by SITEWHERE_TPU_NO_NATIVE"
@@ -64,15 +64,30 @@ def _load() -> None:
     p_i64 = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
     p_f32 = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
     # ABI gate FIRST: a stale cached .so (mtime-preserving deploys defeat
-    # the staleness check) must fall back, not crash the import when a
-    # newer binding looks up a symbol the old library doesn't export.
+    # the staleness check) must not crash the import when a newer binding
+    # looks up a symbol the old library doesn't export. The condition the
+    # gate detects is also repairable: delete the stale cache and rebuild
+    # from source once.
     try:
         lib.swt_version.restype = i32
-        if lib.swt_version() != 3:
-            _build_error = "version mismatch (stale libswt_host.so)"
+        stale = lib.swt_version() != 3
+    except AttributeError:
+        stale = True
+    if stale:
+        if _retry:
+            try:
+                # dlopen dedupes by pathname: the stale mapping must be
+                # dlclose'd or the rebuilt library would never be loaded
+                import _ctypes
+
+                _ctypes.dlclose(lib._handle)
+                os.remove(_SO)
+            except OSError as exc:
+                _build_error = f"stale libswt_host.so (unremovable: {exc})"
+                return
+            _load(_retry=False)
             return
-    except AttributeError as exc:
-        _build_error = f"stale libswt_host.so: {exc}"
+        _build_error = "version mismatch persists after rebuild"
         return
     lib.swt_interner_create.argtypes = [i32]
     lib.swt_interner_create.restype = vp

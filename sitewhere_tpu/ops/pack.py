@@ -112,13 +112,10 @@ def batch_to_blob(batch: EventBatch) -> np.ndarray:
 
         if native.available():
             out = np.empty((WIRE_ROWS, B), np.int32)
-            if not native.pack_blob(batch, out):
-                bad = np.asarray(batch.device_idx, np.int32)
-                raise ValueError(
-                    f"device_idx out of wire-blob device field range "
-                    f"[0, {WIRE_DEV_MAX}): min {int(bad.min())}, "
-                    f"max {int(bad.max())}")
-            return out
+            if native.pack_blob(batch, out):
+                return out
+            # fall through: the numpy range check below raises the
+            # (single, shared) diagnostic for the out-of-range device_idx
     dev = np.asarray(batch.device_idx, np.int32)
     if dev.size and (int(dev.max()) >= WIRE_DEV_MAX or int(dev.min()) < 0):
         raise ValueError(

@@ -28,24 +28,34 @@ def _env():
 
 def _wait_for(proc, pattern, timeout_s=120):
     """Read child stdout until `pattern` matches; fail fast (with the
-    collected output) if the child exits first. select() guards every
-    readline so a silent hang in the child cannot hang the test."""
+    collected output) if the child exits first. Reads the raw fd (select
+    on a buffered TextIOWrapper would miss lines already drained into
+    Python's buffer), so a silent hang in the child cannot hang the
+    test."""
+    fd = proc.stdout.fileno()
+    buf = ""
     collected = []
     deadline = time.time() + timeout_s
     while time.time() < deadline:
-        ready, _, _ = select.select([proc.stdout], [], [], 0.5)
+        ready, _, _ = select.select([fd], [], [], 0.5)
         if ready:
-            line = proc.stdout.readline()
-            if line:
-                collected.append(line)
-                m = re.search(pattern, line)
-                if m:
-                    return m
+            chunk = os.read(fd, 65536).decode(errors="replace")
+            if chunk:
+                buf += chunk
+                while "\n" in buf:
+                    line, buf = buf.split("\n", 1)
+                    collected.append(line + "\n")
+                    m = re.search(pattern, line)
+                    if m:
+                        return m
                 continue
+        # fd at EOF or quiet: check the child, then wait a tick (no hot
+        # spin when stdout is closed but the process lingers)
         if proc.poll() is not None:
             raise AssertionError(
                 f"serve exited rc={proc.returncode} before matching "
                 f"{pattern!r}; output:\n{''.join(collected)}")
+        time.sleep(0.05)
     raise AssertionError(
         f"timed out waiting for {pattern!r}; output:\n{''.join(collected)}")
 

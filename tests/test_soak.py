@@ -1,0 +1,121 @@
+"""Instance-level chaos soak: the whole platform under concurrent load +
+injected faults, asserting the at-least-once contract globally.
+
+The reference has no such harness (SURVEY §4: distribution is "tested" by
+running the real Docker composition); this is the in-proc substitute —
+unique-valued events streamed through the real bus into the real tenant
+engine while the engine is restarted mid-stream and poison records are
+interleaved. No unique value may be lost; duplicates are allowed.
+"""
+
+import threading
+import time
+
+import msgpack
+import numpy as np
+
+from sitewhere_tpu.model import Device, DeviceAssignment, DeviceType
+from sitewhere_tpu.model.common import _asdict
+from sitewhere_tpu.model.event import DeviceEventBatch, DeviceMeasurement
+
+
+def _decoded_payload(token: str, value: float) -> bytes:
+    return msgpack.packb({
+        "sourceId": "soak", "deviceToken": token,
+        "kind": "DeviceEventBatch",
+        "request": _asdict(DeviceEventBatch(
+            device_token=token,
+            measurements=[DeviceMeasurement(name="m", value=value)])),
+        "metadata": {}}, use_bin_type=True)
+
+
+class TestInstanceChaosSoak:
+    N_DEVICES = 12
+    GOOD = 600
+    POISON = 40
+
+    def test_no_loss_under_engine_restarts_and_poison(self, tmp_path):
+        from sitewhere_tpu.instance import SiteWhereInstance
+
+        instance = SiteWhereInstance(
+            instance_id="soak", data_dir=str(tmp_path / "data"),
+            enable_pipeline=True, max_devices=256, batch_size=64,
+            max_tenants=4, default_tenant="default")
+        instance.start()
+        try:
+            self._run(instance)
+        finally:
+            instance.stop()
+
+    def _run(self, instance):
+        engine = instance.engine_manager.get_engine("default")
+        assert engine is not None
+        dt = engine.registry.create_device_type(DeviceType(token="soak-t"))
+        for i in range(self.N_DEVICES):
+            d = engine.registry.create_device(
+                Device(token=f"soak-d{i}", device_type_id=dt.id))
+            engine.registry.create_device_assignment(
+                DeviceAssignment(token=f"soak-a{i}", device_id=d.id))
+
+        topic = instance.naming.event_source_decoded_events("default")
+
+        def produce(worker: int) -> None:
+            # two workers split the value space; every 16th record is poison
+            for i in range(worker, self.GOOD, 2):
+                token = f"soak-d{i % self.N_DEVICES}"
+                instance.bus.publish(topic, token.encode(),
+                                     _decoded_payload(token, float(i)))
+                if i % 16 == worker:
+                    instance.bus.publish(topic, b"poison",
+                                         b"\xc1not-msgpack")
+                time.sleep(0.001)
+
+        workers = [threading.Thread(target=produce, args=(w,), daemon=True)
+                   for w in range(2)]
+        for w in workers:
+            w.start()
+
+        # chaos: restart the tenant engine twice mid-stream (the reference's
+        # MultitenantMicroservice failed-engine restart path); consumer
+        # groups resume from committed offsets, so uncommitted batches
+        # redeliver (dupes OK) and nothing is lost
+        for _ in range(2):
+            time.sleep(0.4)
+            instance.engine_manager.restart_engine("default")
+        for w in workers:
+            w.join(timeout=60)
+
+        # drain: distinct persisted values must reach GOOD and stabilize
+        from sitewhere_tpu.persist.eventlog import EventFilter
+
+        log = instance.datastores.event_log_for(
+            instance.tenant_management.get_tenant_by_token("default"))
+        deadline = time.time() + 90
+        distinct = set()
+        while time.time() < deadline:
+            log.flush_tenant("default")
+            cols = log.query_columns("default", EventFilter(),
+                                     ["value", "event_type"])
+            vals = cols["value"][np.asarray(cols["event_type"]) == 0]
+            distinct = set(np.asarray(vals, np.int64).tolist())
+            if len(distinct) >= self.GOOD:
+                break
+            time.sleep(0.5)
+        missing = set(range(self.GOOD)) - distinct
+        assert not missing, (
+            f"lost {len(missing)} of {self.GOOD} unique events under chaos "
+            f"(sample: {sorted(missing)[:10]})")
+
+        # poison records must be counted, not spun on: liveness probe —
+        # after the storm the engine still consumes fresh events promptly
+        probe_val = float(self.GOOD + 1000)
+        instance.bus.publish(topic, b"soak-d0",
+                             _decoded_payload("soak-d0", probe_val))
+        deadline = time.time() + 30
+        ok = False
+        while time.time() < deadline and not ok:
+            log.flush_tenant("default")
+            cols = log.query_columns("default", EventFilter(), ["value"])
+            ok = probe_val in np.asarray(cols["value"], np.float64)
+            time.sleep(0.25)
+        assert ok, "engine stopped consuming after chaos"
