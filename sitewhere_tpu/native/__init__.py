@@ -81,7 +81,12 @@ def _load(_retry: bool = True) -> None:
                 import _ctypes
 
                 _ctypes.dlclose(lib._handle)
-                os.remove(_SO)
+                # missing_ok: a concurrent process may have repaired the
+                # cache already — that's success, proceed to reload
+                try:
+                    os.remove(_SO)
+                except FileNotFoundError:
+                    pass
             except OSError as exc:
                 _build_error = f"stale libswt_host.so (unremovable: {exc})"
                 return

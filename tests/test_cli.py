@@ -50,14 +50,22 @@ def _wait_for(proc, pattern, timeout_s=120):
                         return m
                 continue
         # fd at EOF or quiet: check the child, then wait a tick (no hot
-        # spin when stdout is closed but the process lingers)
+        # spin when stdout is closed but the process lingers). A final
+        # unterminated line still counts — match and report it too.
         if proc.poll() is not None:
+            m = re.search(pattern, buf)
+            if m:
+                return m
             raise AssertionError(
                 f"serve exited rc={proc.returncode} before matching "
-                f"{pattern!r}; output:\n{''.join(collected)}")
+                f"{pattern!r}; output:\n{''.join(collected)}{buf}")
         time.sleep(0.05)
+    m = re.search(pattern, buf)
+    if m:
+        return m
     raise AssertionError(
-        f"timed out waiting for {pattern!r}; output:\n{''.join(collected)}")
+        f"timed out waiting for {pattern!r}; output:\n"
+        f"{''.join(collected)}{buf}")
 
 
 def test_version_and_check():

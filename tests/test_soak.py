@@ -32,7 +32,6 @@ def _decoded_payload(token: str, value: float) -> bytes:
 class TestInstanceChaosSoak:
     N_DEVICES = 12
     GOOD = 600
-    POISON = 40
 
     def test_no_loss_under_engine_restarts_and_poison(self, tmp_path):
         from sitewhere_tpu.instance import SiteWhereInstance
@@ -60,7 +59,8 @@ class TestInstanceChaosSoak:
         topic = instance.naming.event_source_decoded_events("default")
 
         def produce(worker: int) -> None:
-            # two workers split the value space; every 16th record is poison
+            # two workers split the value space; each injects a poison
+            # record after every 8th of its publishes (~75 total)
             for i in range(worker, self.GOOD, 2):
                 token = f"soak-d{i % self.N_DEVICES}"
                 instance.bus.publish(topic, token.encode(),
