@@ -23,7 +23,8 @@ from typing import Dict, List, Optional, Sequence
 import numpy as np
 
 from sitewhere_tpu.analytics.windows import (
-    WindowedStats, compact_keys, event_type_histogram, windowed_stats)
+    WindowedStats, compact_keys, dense_key_span, event_type_histogram,
+    windowed_stats)
 from sitewhere_tpu.model.event import DeviceEventType
 from sitewhere_tpu.persist.eventlog import ColumnarEventLog, EventFilter
 
@@ -146,15 +147,30 @@ class WindowedAnalyticsEngine:
                 if all_flt is not None else None),
             mesh=mesh, combine=combine)
         if report.num_keys and len(device_idx):
-            uniq, first = np.unique(device_idx, return_index=True)
-            lookup = dict(zip(uniq.tolist(), first.tolist()))
+            # first-occurrence row per key id, vectorized: a reversed fancy
+            # assignment makes the FIRST occurrence's row index win (later
+            # assignments overwrite; reversed order processes row 0 last) —
+            # replaces np.unique(return_index) + a 100k-iteration dict loop
+            # that dominated the replay tail.
+            key_ids = np.asarray(report.key_ids, np.int64)
             token_col = cols["device_token"]
-            tokens = []
-            for k in report.key_ids:
-                row = lookup.get(int(k))
-                token = token_col[row] if row is not None else None
-                tokens.append("" if token is None else str(token))
-            report.key_tokens = tokens
+            # key_ids are unique values of device_idx, so device_idx bounds
+            # cover both; regime decision shared with compact_keys
+            regime = dense_key_span(device_idx)
+            if regime is not None:
+                lo, span = regime
+                first_row = np.full(span, -1, np.int64)
+                first_row[(device_idx - lo)[::-1]] = np.arange(
+                    len(device_idx) - 1, -1, -1, dtype=np.int64)
+                rows = first_row[key_ids - lo].tolist()
+            else:  # tiny result sets / huge key spans: dict fallback
+                lookup: Dict[int, int] = {}
+                for row, k in enumerate(device_idx.tolist()):
+                    lookup.setdefault(k, row)
+                rows = [lookup.get(int(k), -1) for k in key_ids]
+            report.key_tokens = [
+                "" if row < 0 or token_col[row] is None
+                else str(token_col[row]) for row in rows]
         return report
 
     @staticmethod

@@ -260,6 +260,7 @@ class _ColumnBuffer:
     def __init__(self) -> None:
         self.chunks: List[Dict[str, np.ndarray]] = []
         self.n = 0
+        self._peek_cache: Optional[Tuple[int, _Segment]] = None
 
     def append(self, cols: Dict[str, np.ndarray], n: int) -> None:
         self.chunks.append(cols)
@@ -272,17 +273,28 @@ class _ColumnBuffer:
     def drain(self) -> Optional[_Segment]:
         if not self.chunks:
             return None
-        seg = _Segment(self._merge())
+        cached = self._peek_cache
+        seg = (cached[1] if cached is not None and cached[0] == len(self.chunks)
+               else _Segment(self._merge()))
         self.chunks = []
         self.n = 0
+        self._peek_cache = None
         return seg
 
     def peek(self) -> Optional[_Segment]:
         """Transient view of buffered rows for scans — does NOT seal a
-        segment, so trickle-rate tenants don't fragment the log."""
+        segment, so trickle-rate tenants don't fragment the log. The merged
+        view is cached until the next append (chunk count is the version:
+        chunks are append-only), so repeated analytics replays don't pay
+        the column merge each query."""
         if not self.chunks:
             return None
-        return _Segment(self._merge())
+        cached = self._peek_cache
+        if cached is not None and cached[0] == len(self.chunks):
+            return cached[1]
+        seg = _Segment(self._merge())
+        self._peek_cache = (len(self.chunks), seg)
+        return seg
 
 
 def _obj_col(n: int, value: Any = None) -> np.ndarray:

@@ -253,3 +253,63 @@ class TestMixedPathKeys:
         assert report.num_keys == 1
         assert report.key_tokens == ["dev-x"]
         assert report.totals()["events"] == 3
+
+
+class TestCompactKeysParity:
+    """Dense (presence-table) and sparse (unique+searchsorted) regimes of
+    compact_keys must agree exactly; exercised at both range extremes."""
+
+    def _check(self, raw, valid):
+        import numpy as np
+
+        from sitewhere_tpu.analytics.windows import compact_keys
+
+        dense, uniq = compact_keys(raw, valid)
+        ref_uniq = np.unique(raw[valid]) if valid.any() else raw[:0]
+        np.testing.assert_array_equal(uniq, ref_uniq)
+        for i in range(len(raw)):
+            if valid[i]:
+                assert uniq[dense[i]] == raw[i]
+            else:
+                assert dense[i] == -1
+
+    def test_bounded_range_dense_path(self):
+        import numpy as np
+
+        rng = np.random.default_rng(3)
+        raw = rng.integers(-5, 300, 500).astype(np.int64)
+        valid = rng.random(500) > 0.2
+        self._check(raw, valid)
+
+    def test_huge_range_sparse_path(self):
+        import numpy as np
+
+        rng = np.random.default_rng(4)
+        raw = rng.integers(-2**40, 2**40, 300).astype(np.int64)
+        valid = rng.random(300) > 0.2
+        self._check(raw, valid)
+
+    def test_all_invalid(self):
+        import numpy as np
+
+        from sitewhere_tpu.analytics.windows import compact_keys
+
+        dense, uniq = compact_keys(np.array([5, 6, 7]), np.zeros(3, bool))
+        assert (dense == -1).all() and len(uniq) == 0
+
+
+def test_compact_keys_float_and_tiny_inputs():
+    """Non-integer dtypes and tiny row counts must take the sort-based
+    path (the dense presence table requires bounded integer keys)."""
+    import numpy as np
+
+    from sitewhere_tpu.analytics.windows import compact_keys
+
+    dense, uniq = compact_keys(np.array([1.5, 2.5, 1.5]), np.ones(3, bool))
+    np.testing.assert_array_equal(uniq, [1.5, 2.5])
+    np.testing.assert_array_equal(dense, [0, 1, 0])
+    # two rows with far-apart ids: no megabyte scatter table, same result
+    dense, uniq = compact_keys(np.array([-1, 3_000_000], np.int64),
+                               np.ones(2, bool))
+    np.testing.assert_array_equal(uniq, [-1, 3_000_000])
+    np.testing.assert_array_equal(dense, [0, 1])
