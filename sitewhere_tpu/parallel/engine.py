@@ -335,6 +335,82 @@ class ShardedPipelineEngine(PipelineEngine):
                 tokens.append(token)
         return tokens
 
+    # -- elastic checkpoint layout ----------------------------------------
+
+    _TENANT_STATE_FIELDS = ("tenant_event_count", "tenant_alert_count")
+
+    def canonical_state(self) -> DeviceStateTensors:
+        """Flat device-major snapshot: device-indexed tensors un-shard via
+        the router layout (global d lives at (d % S, d // S)); per-shard
+        tenant counters are additive and sum to the global totals. The
+        result is bit-identical to a single-chip engine that processed the
+        same events — a checkpoint taken on ANY mesh restores onto ANY
+        other (elastic recovery)."""
+        import dataclasses as _dc
+
+        s = self._state
+        out = {}
+        for f in _dc.fields(s):
+            a = np.asarray(getattr(s, f.name))
+            out[f.name] = (a.sum(0, dtype=a.dtype)
+                           if f.name in self._TENANT_STATE_FIELDS
+                           else self.router.unshard_param(a))
+        return DeviceStateTensors(**out)
+
+    def load_canonical_state(self, state: DeviceStateTensors) -> None:
+        """Re-shard a flat snapshot onto this engine's mesh. Tenant
+        counters (additive) land on shard 0; device tensors re-lay to the
+        (d % S, d // S) owner. EVERY dimension (device capacity,
+        measurement slots, tenant width) must match this engine — a
+        silent slot mismatch would corrupt state via clamped scatters."""
+        import dataclasses as _dc
+
+        S = self.n_shards
+        cur = self._state
+        out = {}
+        for f in _dc.fields(state):
+            a = np.asarray(getattr(state, f.name))
+            c = np.asarray(getattr(cur, f.name))
+            expect = (c.shape[1:] if f.name in self._TENANT_STATE_FIELDS
+                      else (c.shape[0] * c.shape[1],) + c.shape[2:])
+            if a.shape != expect:
+                raise ValueError(
+                    f"checkpoint shape mismatch for {f.name}: got "
+                    f"{a.shape}, engine expects {expect} (device capacity"
+                    f"/measurement slots/tenant width must match)")
+            if f.name in self._TENANT_STATE_FIELDS:
+                stacked = np.zeros((S,) + a.shape, a.dtype)
+                stacked[0] = a
+                out[f.name] = stacked
+            else:
+                out[f.name] = self.router.shard_param(a)
+        stacked_state = DeviceStateTensors(**out)
+        shard0 = NamedSharding(self.mesh, P(SHARD_AXIS))
+        self._state = jax.device_put(
+            stacked_state, _tree_specs(stacked_state, shard0))
+
+    def set_state(self, state: DeviceStateTensors) -> None:
+        """The sharded engine's resident layout is stacked [S, D/S, ...];
+        checkpoints use the flat canonical layout — there is no native
+        set_state. Use load_canonical_state (flat) explicitly."""
+        raise TypeError(
+            "ShardedPipelineEngine state is mesh-resident; restore flat "
+            "canonical snapshots via load_canonical_state()")
+
+    def drain_pending(self) -> int:
+        """Fold any parked overflow backlog into device state (empty-batch
+        drain steps). Checkpoint save calls this first: backlogged rows'
+        bus offsets may already be committed, so a snapshot that omitted
+        them would break the offsets<=state invariant. Returns the number
+        of drain steps run."""
+        from sitewhere_tpu.ops.pack import empty_batch
+
+        steps = 0
+        while self.pending_overflow > 0:
+            self.submit(empty_batch(1))
+            steps += 1
+        return steps
+
     @property
     def pending_overflow(self) -> int:
         return 0 if self._overflow is None else int(self._overflow.valid.sum())

@@ -54,7 +54,15 @@ class PipelineCheckpointer:
             f"{g.topic.name}@{g.group_id}": list(g.committed)
             for g in consumer_groups or []
         }
-        state = engine.state
+        # parked shard-overflow rows must fold into state before the
+        # snapshot: their bus offsets may already be committed, and a
+        # snapshot without them would break the offsets<=state invariant
+        drain = getattr(engine, "drain_pending", None)
+        if drain is not None:
+            drain()
+        # canonical flat layout: topology-independent, so a checkpoint
+        # taken on an N-shard mesh restores onto any other mesh size
+        state = engine.canonical_state()
         arrays = {
             f"state.{f.name}": np.asarray(getattr(state, f.name))
             for f in dataclasses.fields(state)
@@ -107,10 +115,10 @@ class PipelineCheckpointer:
             return {}
         with np.load(os.path.join(path, "state.npz")) as data:
             kwargs = {
-                f.name: jax.numpy.asarray(data[f"state.{f.name}"])
+                f.name: np.asarray(data[f"state.{f.name}"])
                 for f in dataclasses.fields(DeviceStateTensors)
             }
-        engine.set_state(DeviceStateTensors(**kwargs))
+        engine.load_canonical_state(DeviceStateTensors(**kwargs))
         with open(os.path.join(path, "manifest.json"), encoding="utf-8") as fh:
             manifest = json.load(fh)
         packer = engine.packer

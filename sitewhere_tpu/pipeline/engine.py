@@ -323,6 +323,33 @@ class PipelineEngine(LifecycleComponent):
         """Checkpoint restore."""
         self._state = jax.device_put(state)
 
+    def canonical_state(self) -> DeviceStateTensors:
+        """Topology-independent host snapshot: flat device-major layout,
+        identical no matter how many shards produced it — what checkpoints
+        store, so a checkpoint taken on one mesh restores onto any other
+        (elastic recovery; the reference's equivalent is Kafka replay into
+        a rebuilt store)."""
+        return jax.tree_util.tree_map(
+            lambda a: np.asarray(a), self.state)
+
+    def load_canonical_state(self, state: DeviceStateTensors) -> None:
+        """Inverse of canonical_state (single-chip: plain placement).
+        Every dimension must match this engine — a silent measurement-slot
+        or tenant-width mismatch would corrupt state via clamped
+        scatters."""
+        import dataclasses as _dc
+
+        cur = self.state
+        for f in _dc.fields(state):
+            got = np.asarray(getattr(state, f.name)).shape
+            expect = np.asarray(getattr(cur, f.name)).shape
+            if got != expect:
+                raise ValueError(
+                    f"checkpoint shape mismatch for {f.name}: got {got}, "
+                    f"engine expects {expect} (device capacity/measurement "
+                    f"slots/tenant width must match)")
+        self.set_state(state)
+
     def _state_row(self, idx: int):
         """Fetch one device's row from every state tensor (overridden by the
         sharded engine, which remaps global -> (shard, local))."""
