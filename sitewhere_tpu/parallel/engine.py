@@ -357,27 +357,26 @@ class ShardedPipelineEngine(PipelineEngine):
                            else self.router.unshard_param(a))
         return DeviceStateTensors(**out)
 
+    def _canonical_shape_of(self, field_name: str):
+        # resident layout is stacked [S, L, ...]; canonical flattens the
+        # device axes ([S*L, ...]); tenant counters lose the shard axis
+        c = getattr(self._state, field_name).shape
+        if field_name in self._TENANT_STATE_FIELDS:
+            return c[1:]
+        return (c[0] * c[1],) + tuple(c[2:])
+
     def load_canonical_state(self, state: DeviceStateTensors) -> None:
         """Re-shard a flat snapshot onto this engine's mesh. Tenant
         counters (additive) land on shard 0; device tensors re-lay to the
-        (d % S, d // S) owner. EVERY dimension (device capacity,
-        measurement slots, tenant width) must match this engine — a
-        silent slot mismatch would corrupt state via clamped scatters."""
+        (d % S, d // S) owner. Dimensions validated by
+        _validate_canonical (shared with the single-chip engine)."""
         import dataclasses as _dc
 
+        self._validate_canonical(state)
         S = self.n_shards
-        cur = self._state
         out = {}
         for f in _dc.fields(state):
             a = np.asarray(getattr(state, f.name))
-            c = np.asarray(getattr(cur, f.name))
-            expect = (c.shape[1:] if f.name in self._TENANT_STATE_FIELDS
-                      else (c.shape[0] * c.shape[1],) + c.shape[2:])
-            if a.shape != expect:
-                raise ValueError(
-                    f"checkpoint shape mismatch for {f.name}: got "
-                    f"{a.shape}, engine expects {expect} (device capacity"
-                    f"/measurement slots/tenant width must match)")
             if f.name in self._TENANT_STATE_FIELDS:
                 stacked = np.zeros((S,) + a.shape, a.dtype)
                 stacked[0] = a
@@ -401,13 +400,23 @@ class ShardedPipelineEngine(PipelineEngine):
         """Fold any parked overflow backlog into device state (empty-batch
         drain steps). Checkpoint save calls this first: backlogged rows'
         bus offsets may already be committed, so a snapshot that omitted
-        them would break the offsets<=state invariant. Returns the number
-        of drain steps run."""
+        them would break the offsets<=state invariant. Alerts fired by the
+        drained events stash on _pending_alerts (picked up by the next
+        materialize_alerts) with the same bounded-room accounting as
+        submit()'s internal drain — never silently lost. Returns the
+        number of drain steps run."""
         from sitewhere_tpu.ops.pack import empty_batch
 
         steps = 0
         while self.pending_overflow > 0:
-            self.submit(empty_batch(1))
+            routed, outputs = self.submit(empty_batch(1))
+            stash = self._materialize_routed(routed, outputs)
+            room = self.max_pending_alerts - len(self._pending_alerts)
+            if len(stash) > room:
+                dropped = len(stash) - max(0, room)
+                self.alerts_dropped += dropped
+                self._metrics.counter("alerts.dropped").inc(dropped)
+            self._pending_alerts.extend(stash[:max(0, room)])
             steps += 1
         return steps
 

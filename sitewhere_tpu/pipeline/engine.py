@@ -332,22 +332,30 @@ class PipelineEngine(LifecycleComponent):
         return jax.tree_util.tree_map(
             lambda a: np.asarray(a), self.state)
 
-    def load_canonical_state(self, state: DeviceStateTensors) -> None:
-        """Inverse of canonical_state (single-chip: plain placement).
-        Every dimension must match this engine — a silent measurement-slot
-        or tenant-width mismatch would corrupt state via clamped
-        scatters."""
+    def _canonical_shape_of(self, field_name: str):
+        """Expected canonical (flat) shape for one state field — .shape on
+        the resident array costs nothing (no device transfer)."""
+        return getattr(self.state, field_name).shape
+
+    def _validate_canonical(self, state: DeviceStateTensors) -> None:
+        """Every dimension must match this engine — a silent
+        measurement-slot or tenant-width mismatch would corrupt state via
+        clamped scatters. Shared by both engines (expected shapes differ
+        via _canonical_shape_of)."""
         import dataclasses as _dc
 
-        cur = self.state
         for f in _dc.fields(state):
-            got = np.asarray(getattr(state, f.name)).shape
-            expect = np.asarray(getattr(cur, f.name)).shape
-            if got != expect:
+            got = tuple(getattr(state, f.name).shape)
+            expect = self._canonical_shape_of(f.name)
+            if got != tuple(expect):
                 raise ValueError(
                     f"checkpoint shape mismatch for {f.name}: got {got}, "
-                    f"engine expects {expect} (device capacity/measurement "
-                    f"slots/tenant width must match)")
+                    f"engine expects {tuple(expect)} (device capacity/"
+                    f"measurement slots/tenant width must match)")
+
+    def load_canonical_state(self, state: DeviceStateTensors) -> None:
+        """Inverse of canonical_state (single-chip: plain placement)."""
+        self._validate_canonical(state)
         self.set_state(state)
 
     def _state_row(self, idx: int):

@@ -510,3 +510,25 @@ class TestElasticCheckpoint:
                          mesh=make_mesh(4), per_shard_batch=8)
         with pytest.raises(TypeError, match="load_canonical_state"):
             eng.set_state(eng._state)
+
+    def test_drain_pending_stashes_alerts(self, tmp_path):
+        """Alerts fired by drained overflow events surface on the next
+        materialize_alerts — a pre-checkpoint drain must not lose them."""
+        from sitewhere_tpu.model.event import DeviceMeasurement
+        from sitewhere_tpu.ops.pack import empty_batch
+        from sitewhere_tpu.parallel import ShardedPipelineEngine, make_mesh
+        from sitewhere_tpu.persist.checkpoint import PipelineCheckpointer
+
+        eng = self._make(ShardedPipelineEngine, self._world(),
+                         mesh=make_mesh(4), per_shard_batch=4)
+        # rule fires on value > 1.0; 6 firing events for one device,
+        # per-shard capacity 4 -> 2 overflow rows that also fire
+        events = [DeviceMeasurement(name="m", value=10.0 + i,
+                                    event_date=1000 + i) for i in range(6)]
+        eng.submit(eng.packer.pack_events(events, ["d1"] * 6)[0])
+        assert eng.pending_overflow == 2
+        PipelineCheckpointer(str(tmp_path)).save(eng)  # drains
+        routed, out = eng.submit(empty_batch(1))
+        alerts = eng.materialize_alerts(routed, out)
+        assert len(alerts) == 2  # the drained rows' alerts, stashed
+        assert {a.device_id for a in alerts} == {"d1"}
