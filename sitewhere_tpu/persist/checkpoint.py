@@ -27,7 +27,28 @@ from typing import Any, Dict, List, Optional
 import jax
 import numpy as np
 
+from sitewhere_tpu.model.common import _asdict
+from sitewhere_tpu.model.event import DeviceAlert
 from sitewhere_tpu.pipeline.state_tensors import DeviceStateTensors
+
+
+def _alert_from_dict(d: Dict[str, Any]) -> DeviceAlert:
+    """Manifest dict -> DeviceAlert (enum fields coerced by annotation)."""
+    import enum
+    import typing
+
+    hints = typing.get_type_hints(DeviceAlert)
+    kwargs: Dict[str, Any] = {}
+    for f in dataclasses.fields(DeviceAlert):
+        if f.name not in d:
+            continue
+        val = d[f.name]
+        t = hints.get(f.name)
+        if (isinstance(t, type) and issubclass(t, enum.Enum)
+                and val is not None and not isinstance(val, t)):
+            val = t(val)
+        kwargs[f.name] = val
+    return DeviceAlert(**kwargs)
 
 
 class PipelineCheckpointer:
@@ -76,6 +97,15 @@ class PipelineCheckpointer:
                 "alert_types": packer.alert_types.snapshot(),
             },
             "offsets": captured_offsets,
+            # alerts stashed by the pre-snapshot drain (and any earlier
+            # internal drain steps) travel WITH the checkpoint: the drained
+            # events' offsets are committed, so replay will not re-fire
+            # them — without this, a crash before the next
+            # materialize_alerts would silently lose them. Not cleared
+            # here (a live process still delivers them; a restore may
+            # duplicate — at-least-once, like everything else).
+            "pending_alerts": [_asdict(a) for a in
+                               getattr(engine, "_pending_alerts", [])],
         }
         seq = self._next_seq()
         final = os.path.join(self.directory, f"ckpt-{seq:08d}")
@@ -126,6 +156,10 @@ class PipelineCheckpointer:
         packer.devices.restore(manifest["interners"]["devices"])
         packer.measurements.restore(manifest["interners"]["measurements"])
         packer.alert_types.restore(manifest["interners"]["alert_types"])
+        pending = manifest.get("pending_alerts", [])
+        if pending and hasattr(engine, "_pending_alerts"):
+            engine._pending_alerts.extend(
+                _alert_from_dict(d) for d in pending)
         return manifest.get("offsets", {})
 
     # -- recovery ----------------------------------------------------------

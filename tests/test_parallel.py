@@ -532,3 +532,29 @@ class TestElasticCheckpoint:
         alerts = eng.materialize_alerts(routed, out)
         assert len(alerts) == 2  # the drained rows' alerts, stashed
         assert {a.device_id for a in alerts} == {"d1"}
+
+    def test_pending_alerts_survive_crash_via_checkpoint(self, tmp_path):
+        """Drain-stashed alerts travel WITH the checkpoint: a crash after
+        save() must not lose alerts whose events' offsets are committed."""
+        from sitewhere_tpu.model.event import DeviceMeasurement
+        from sitewhere_tpu.parallel import ShardedPipelineEngine, make_mesh
+        from sitewhere_tpu.persist.checkpoint import PipelineCheckpointer
+
+        eng = self._make(ShardedPipelineEngine, self._world(),
+                         mesh=make_mesh(4), per_shard_batch=4)
+        events = [DeviceMeasurement(name="m", value=10.0 + i,
+                                    event_date=1000 + i) for i in range(6)]
+        eng.submit(eng.packer.pack_events(events, ["d1"] * 6)[0])
+        assert eng.pending_overflow == 2
+        ck = PipelineCheckpointer(str(tmp_path))
+        ck.save(eng)  # drains; stashes the 2 overflow-row alerts
+        del eng  # crash before anyone materialized
+
+        fresh = self._make(ShardedPipelineEngine, self._world(),
+                           mesh=make_mesh(8), per_shard_batch=8)
+        ck.restore(fresh)
+        from sitewhere_tpu.ops.pack import empty_batch
+        routed, out = fresh.submit(empty_batch(1))
+        alerts = fresh.materialize_alerts(routed, out)
+        assert len(alerts) == 2
+        assert all(a.device_id == "d1" for a in alerts)
