@@ -560,13 +560,16 @@ class ColumnarEventLog:
         id_prefix = _const_col(n, _ID_PREFIX)
 
         def resolve(interner, idx: np.ndarray) -> np.ndarray:
-            # Two regimes: for small batches against a big interner, the
-            # per-unique masking is near-free; for large batches a full
-            # interner snapshot + fancy-index gather avoids the O(U * n)
-            # blowup (quadratic at 100k devices per 131k-row batch). The
-            # object-array snapshot is cached while the interner doesn't
-            # grow (token slots are append-only, so length is a version).
-            if len(interner) > 4 * n:
+            # Three regimes. Masking (one boolean pass per DISTINCT value)
+            # wins when few values are possible — a tiny interner
+            # (measurement names, alert types: a handful of tokens vs a
+            # 131k-row gather) or a small batch against a big interner.
+            # In between, a full interner snapshot + fancy-index gather
+            # avoids the O(U * n) blowup (quadratic at 100k devices per
+            # 131k-row batch). The object-array snapshot is cached while
+            # the interner doesn't grow (token slots are append-only, so
+            # length is a version).
+            if len(interner) <= 64 or len(interner) > 4 * n:
                 out = _obj_col(n)
                 for u in np.unique(idx):
                     out[idx == u] = interner.token_of(int(u))

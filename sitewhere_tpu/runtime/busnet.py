@@ -143,10 +143,11 @@ class _Handler(socketserver.BaseRequestHandler):
         op = req.get("op")
         if op == "publish":
             topic = bus.topic(req["topic"])
-            results = [topic.publish(key, value)
-                       for key, value in req["records"]]
-            return {"ok": True, "count": len(results),
-                    "last": results[-1] if results else None}
+            records = req["records"]
+            if not records:
+                return {"ok": True, "count": 0, "last": None}
+            last = topic.publish_many(records)
+            return {"ok": True, "count": len(records), "last": list(last)}
         if op == "poll":
             topic, group = req["topic"], req["group"]
             owned = coordinator.owned(topic, group, member)
