@@ -242,13 +242,14 @@ class Topic:
         touched partition. Per-key partition routing (and therefore
         per-device ordering) is identical to publish(). Returns
         (partition, offset) of the LAST record in arrival order."""
+        if not records:
+            raise ValueError("publish_many requires at least one record")
         by_part: Dict[int, List[Tuple[bytes, bytes]]] = {}
+        last_part = 0
         for key, value in records:
-            by_part.setdefault(self.partition_for(key), []).append(
-                (key, value))
-        last: Tuple[int, int] = (0, -1)
-        last_key = records[-1][0] if records else b""
-        last_part = self.partition_for(last_key) if records else 0
+            last_part = self.partition_for(key)
+            by_part.setdefault(last_part, []).append((key, value))
+        last: Tuple[int, int] = (last_part, -1)
         for part, recs in by_part.items():
             offset = self.partitions[part].append_many(recs)
             if part == last_part:

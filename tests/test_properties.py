@@ -199,3 +199,30 @@ class TestInternerProperties:
         # single-token intern agrees with the batch path
         for tok in toks:
             assert it.intern(tok) == it.lookup(tok)
+
+
+class TestBusBulkProperties:
+    """publish_many must be indistinguishable from N publish() calls:
+    same partition routing, same per-key order, same offsets."""
+
+    @given(st.lists(st.tuples(st.binary(min_size=0, max_size=8),
+                              st.binary(min_size=0, max_size=16)),
+                    min_size=1, max_size=60),
+           st.integers(1, 8))
+    @settings(max_examples=30, deadline=None)
+    def test_bulk_matches_sequential(self, records, partitions):
+        from sitewhere_tpu.runtime.bus import EventBus
+
+        bulk = EventBus(partitions=partitions)
+        seq = EventBus(partitions=partitions)
+        last_bulk = bulk.publish_batch("t", records)
+        for key, value in records:
+            last_seq = seq.publish("t", key, value)
+        assert last_bulk == last_seq
+        tb, ts_ = bulk.topic("t"), seq.topic("t")
+        assert tb.end_offsets() == ts_.end_offsets()
+        for p in range(partitions):
+            rb = tb.partitions[p].read(0, 10_000)
+            rs = ts_.partitions[p].read(0, 10_000)
+            assert [(o, k, v) for o, k, v, _ in rb] == \
+                   [(o, k, v) for o, k, v, _ in rs]
