@@ -52,7 +52,8 @@ class SiteWhereInstance(LifecycleComponent):
                  admin_username: str = "admin",
                  admin_password: str = "password",
                  shards: int = 1,
-                 tenant_datastores: Optional[Dict] = None):
+                 tenant_datastores: Optional[Dict] = None,
+                 checkpoint_interval_s: Optional[float] = None):
         super().__init__(f"instance:{instance_id}")
         self.instance_id = instance_id
         self.data_dir = data_dir
@@ -132,8 +133,22 @@ class SiteWhereInstance(LifecycleComponent):
                                          source=instance_id)
         self.log_aggregator = LogAggregator(self.bus, self.naming)
 
+        # checkpoint manager: restore-at-boot + periodic saves. Nested
+        # AFTER the pipeline engine (whose state it restores) and BEFORE
+        # the tenant engine manager (whose inbound consumers must not
+        # start polling until the cursors are rewound to the checkpoint).
+        self.checkpoint_manager = None
+        if self.pipeline_engine is not None and data_dir is not None:
+            from sitewhere_tpu.persist.checkpoint import (
+                InstanceCheckpointManager)
+            self.checkpoint_manager = InstanceCheckpointManager(
+                self, os.path.join(data_dir, "checkpoints"),
+                interval_s=checkpoint_interval_s)
+
         if self.pipeline_engine is not None:
             self.add_nested(self.pipeline_engine)
+        if self.checkpoint_manager is not None:
+            self.add_nested(self.checkpoint_manager.component)
         self.add_nested(self.engine_manager)
         self.add_nested(self.label_generators)
         self.add_nested(self.script_manager)
@@ -186,6 +201,7 @@ class SiteWhereInstance(LifecycleComponent):
         self.log_aggregator.stop()
         self.datastores.stop()
         self.event_log.stop()
+        self.bus.flush()  # durable bus logs visible to a successor instance
 
     # -- convenience accessors --------------------------------------------
     def get_tenant_engine(self, tenant_token: str) -> Optional[TenantEngine]:

@@ -274,8 +274,9 @@ class ShardedPipelineEngine(PipelineEngine):
         shard0 = NamedSharding(self.mesh, P(SHARD_AXIS))
         blob = jax.device_put(routed_blob, shard0)
         with self._metrics.timer("step").time():
-            self._state, outputs = self._sharded_step(params, self._state,
-                                                      blob)
+            with self._state_lock:  # vs concurrent readers (base __init__)
+                self._state, outputs = self._sharded_step(
+                    params, self._state, blob)
         self.batches_processed += 1
         # rows actually stepped this call: overflow rows are counted by the
         # step that eventually carries them, so each event marks exactly
@@ -342,20 +343,27 @@ class ShardedPipelineEngine(PipelineEngine):
             pass
 
         row = Row()
-        for field_name in ("last_interaction", "present", "presence_missing_since",
-                           "event_count", "last_location", "last_location_ts",
-                           "last_measurement", "last_measurement_ts",
-                           "last_alert_type", "last_alert_level", "last_alert_ts"):
-            setattr(row, field_name, np.asarray(getattr(self._state, field_name)[s, l]))
+        with self._state_lock:  # vs concurrent donation (base __init__)
+            state = self._state
+            for field_name in ("last_interaction", "present",
+                               "presence_missing_since",
+                               "event_count", "last_location",
+                               "last_location_ts",
+                               "last_measurement", "last_measurement_ts",
+                               "last_alert_type", "last_alert_level",
+                               "last_alert_ts"):
+                setattr(row, field_name,
+                        np.asarray(getattr(state, field_name)[s, l]))
         return row
 
     def presence_sweep(self) -> List[str]:
         params = self._ensure_params()
         now_rel = np.int32(self.packer.rel_ts(int(time.time() * 1000)))
         registered = params.assignment_status == 1
-        self._state, newly_missing = self._presence(
-            self._state, registered, now_rel,
-            np.int32(min(self.presence_missing_interval_ms, 2 ** 31 - 1)))
+        with self._state_lock:
+            self._state, newly_missing = self._presence(
+                self._state, registered, now_rel,
+                np.int32(min(self.presence_missing_interval_ms, 2 ** 31 - 1)))
         shards, locals_ = np.nonzero(np.asarray(newly_missing))
         tokens = []
         for s, l in zip(shards, locals_):
@@ -377,10 +385,15 @@ class ShardedPipelineEngine(PipelineEngine):
         other (elastic recovery)."""
         import dataclasses as _dc
 
-        s = self._state
+        import jax.numpy as jnp
+
+        # device-side copy under the lock only (see base canonical_state);
+        # the D2H gather + host re-layout run outside it
+        with self._state_lock:
+            snap = jax.tree_util.tree_map(jnp.copy, self._state)
         out = {}
-        for f in _dc.fields(s):
-            a = np.asarray(getattr(s, f.name))
+        for f in _dc.fields(snap):
+            a = np.asarray(getattr(snap, f.name))
             out[f.name] = (a.sum(0, dtype=a.dtype)
                            if f.name in self._TENANT_STATE_FIELDS
                            else self.router.unshard_param(a))
@@ -414,8 +427,9 @@ class ShardedPipelineEngine(PipelineEngine):
                 out[f.name] = self.router.shard_param(a)
         stacked_state = DeviceStateTensors(**out)
         shard0 = NamedSharding(self.mesh, P(SHARD_AXIS))
-        self._state = jax.device_put(
-            stacked_state, _tree_specs(stacked_state, shard0))
+        with self._state_lock:
+            self._state = jax.device_put(
+                stacked_state, _tree_specs(stacked_state, shard0))
 
     def set_state(self, state: DeviceStateTensors) -> None:
         """The sharded engine's resident layout is stacked [S, D/S, ...];
@@ -462,12 +476,15 @@ class ShardedPipelineEngine(PipelineEngine):
         return 0 if self._overflow is None else int(self._overflow.valid.sum())
 
     def stats(self):
-        s = self._state
+        with self._state_lock:  # tenant-count reads vs donation
+            s = self._state
+            tenant_events = np.asarray(s.tenant_event_count).sum(0).tolist()
+            tenant_alerts = np.asarray(s.tenant_alert_count).sum(0).tolist()
         return {
             "batches": self.batches_processed,
             "dropped": self.total_dropped,
             "drain_steps": self.drain_steps,
             "pending_overflow": self.pending_overflow,
-            "tenant_event_count": np.asarray(s.tenant_event_count).sum(0).tolist(),
-            "tenant_alert_count": np.asarray(s.tenant_alert_count).sum(0).tolist(),
+            "tenant_event_count": tenant_events,
+            "tenant_alert_count": tenant_alerts,
         }

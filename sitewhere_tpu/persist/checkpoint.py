@@ -57,6 +57,12 @@ class PipelineCheckpointer:
     def __init__(self, directory: str, keep: int = 3):
         self.directory = directory
         self.keep = keep
+        # save() has multiple callers (periodic thread + REST POST):
+        # racing saves would compute the same sequence and interleave
+        # writes into one tmp dir, promoting a mixed-snapshot checkpoint
+        import threading
+
+        self._save_lock = threading.Lock()
         os.makedirs(directory, exist_ok=True)
 
     # -- save --------------------------------------------------------------
@@ -71,6 +77,11 @@ class PipelineCheckpointer:
         snapshot then yields offsets <= state, i.e. at worst a duplicate
         replay (at-least-once, like the reference's Kafka semantics);
         offsets ahead of state would silently LOSE events."""
+        with self._save_lock:
+            return self._save_locked(engine, consumer_groups)
+
+    def _save_locked(self, engine,
+                     consumer_groups: Optional[List]) -> str:
         captured_offsets = {
             f"{g.topic.name}@{g.group_id}": list(g.committed)
             for g in consumer_groups or []
@@ -194,3 +205,137 @@ class PipelineCheckpointer:
             bus.commit(consumer)
             replayed += len(batch)
         return replayed
+
+
+class InstanceCheckpointManager:
+    """Wires PipelineCheckpointer into a running SiteWhereInstance: restore
+    the latest checkpoint at boot (rewinding the inbound consumer groups to
+    the checkpointed cursors so replay closes the gap), then save
+    periodically and on demand (REST POST /api/instance/checkpoint).
+
+    Lifecycle-shaped (start/stop) so SiteWhereInstance can nest it between
+    the pipeline engine (whose state it restores — must already be started)
+    and the tenant engine manager (whose consumers must not start polling
+    until the cursors are rewound)."""
+
+    def __init__(self, instance, directory: str,
+                 interval_s: Optional[float] = None):
+        from sitewhere_tpu.runtime.lifecycle import LifecycleComponent
+
+        self.instance = instance
+        self.checkpointer = PipelineCheckpointer(directory)
+        self.interval_s = interval_s
+        self.last_restore_offsets: Dict[str, List[int]] = {}
+        self._stop = None
+        self._thread = None
+
+        outer = self
+
+        class _Component(LifecycleComponent):
+            def __init__(self):
+                super().__init__("checkpoint-manager")
+
+            def on_start(self, monitor) -> None:
+                outer._on_start()
+
+            def on_stop(self, monitor) -> None:
+                outer._on_stop()
+
+        self.component = _Component()
+
+    # -- save --------------------------------------------------------------
+    def _inbound_groups(self):
+        """Consumer groups feeding the pipeline: each running tenant
+        engine's inbound-processing group."""
+        manager = self.instance.engine_manager
+        groups = []
+        with manager._lock:
+            tenants = list(manager.engines)
+        for tenant in tenants:
+            topic = self.instance.naming.event_source_decoded_events(tenant)
+            groups.append(self.instance.bus.consumer(
+                topic, f"inbound-processing-{tenant}"))
+        return groups
+
+    def save(self) -> str:
+        """Checkpoint now (offsets captured before state; see
+        PipelineCheckpointer.save). Returns the checkpoint path."""
+        engine = self.instance.pipeline_engine
+        if engine is None:
+            raise SiteWhereCheckpointError("instance has no pipeline engine")
+        return self.checkpointer.save(engine, bus=self.instance.bus,
+                                      consumer_groups=self._inbound_groups())
+
+    def list_checkpoints(self) -> List[str]:
+        return sorted(
+            name for name in os.listdir(self.checkpointer.directory)
+            if name.startswith("ckpt-") and not name.endswith(".tmp"))
+
+    # -- boot restore ------------------------------------------------------
+    def restore_on_boot(self) -> bool:
+        """Load the latest checkpoint into the engine and rewind every
+        checkpointed consumer group to its saved cursor. Runs before the
+        tenant engines' consumers start polling; the bus's own committed
+        offsets may be AHEAD of the checkpoint (commits raced the save or
+        happened after it), and replaying from the older checkpoint cursor
+        is what makes the restored state catch up (at-least-once)."""
+        engine = self.instance.pipeline_engine
+        if engine is None or self.checkpointer.latest() is None:
+            return False
+        offsets = self.checkpointer.restore(engine)
+        self.last_restore_offsets = offsets
+        for key, saved in offsets.items():
+            topic, _, group = key.rpartition("@")
+            consumer = self.instance.bus.consumer(topic, group)
+            n = len(consumer.topic.partitions)
+            consumer.committed = (list(saved) + [0] * n)[:n]
+            consumer.seek_to_committed()
+        # Inbound groups ABSENT from the manifest (tenant created after the
+        # checkpoint, or a save that raced engine boot): the restored state
+        # has none of their events, but the bus's own persisted committed
+        # offsets may be past them — the only at-least-once choice is a
+        # full replay of the retained log for those groups (mirrors
+        # PipelineCheckpointer.recover's no-cursor rule).
+        for tenant in self.instance.tenant_management.tenants.all():
+            topic = self.instance.naming.event_source_decoded_events(
+                tenant.token)
+            group = f"inbound-processing-{tenant.token}"
+            if f"{topic}@{group}" in offsets:
+                continue
+            consumer = self.instance.bus.consumer(topic, group)
+            consumer.committed = [0] * len(consumer.topic.partitions)
+            consumer.seek_to_committed()
+        return True
+
+    # -- lifecycle ---------------------------------------------------------
+    def _on_start(self) -> None:
+        import threading
+
+        self.restore_on_boot()
+        if self.interval_s:
+            self._stop = threading.Event()
+
+            def _loop():
+                while not self._stop.wait(self.interval_s):
+                    try:
+                        self.save()
+                    except Exception:  # noqa: BLE001 - keep checkpointing
+                        import logging
+
+                        logging.getLogger("sitewhere.checkpoint").exception(
+                            "periodic checkpoint failed")
+
+            self._thread = threading.Thread(target=_loop, daemon=True,
+                                            name="checkpoint-loop")
+            self._thread.start()
+
+    def _on_stop(self) -> None:
+        if self._stop is not None:
+            self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+
+
+class SiteWhereCheckpointError(RuntimeError):
+    pass

@@ -94,6 +94,14 @@ class PipelineEngine(LifecycleComponent):
         self._params: Optional[PipelineParams] = None
         self._state: Optional[DeviceStateTensors] = None
         self._lock = threading.RLock()
+        # Serializes state ADVANCE (submit/presence donate the old buffers,
+        # deleting them at dispatch) against state READS/SWAPS from other
+        # threads (REST get_device_state, presence sweep thread, checkpoint
+        # save, restore) — without it a reader holding the pre-donation
+        # reference crashes on "Array has been deleted". Held only around
+        # dispatch + the reference swap / the row copy, never around
+        # block_until_ready, so hot-path cost is nanoseconds.
+        self._state_lock = threading.RLock()
         self._metrics = GLOBAL_METRICS.scoped(f"pipeline.{name}")
         from sitewhere_tpu.ops.geofence import resolve_geofence_impl
         self.geofence_impl = resolve_geofence_impl(
@@ -231,7 +239,9 @@ class PipelineEngine(LifecycleComponent):
         with self._metrics.timer("step").time():
             # single-transfer host->device staging (see ops.pack.batch_to_blob)
             blob = batch_to_blob(batch)
-            self._state, outputs = self._step_blob(params, self._state, blob)
+            with self._state_lock:
+                self._state, outputs = self._step_blob(params, self._state,
+                                                       blob)
         self.batches_processed += 1
         self._metrics.meter("events").mark(int(np.asarray(batch.valid).sum()))
         return outputs
@@ -305,9 +315,10 @@ class PipelineEngine(LifecycleComponent):
         params = self._ensure_params()
         now_rel = np.int32(self.packer.rel_ts(int(time.time() * 1000)))
         registered = params.assignment_status == 1
-        self._state, newly_missing = self._presence(
-            self._state, registered, now_rel,
-            np.int32(min(self.presence_missing_interval_ms, 2 ** 31 - 1)))
+        with self._state_lock:
+            self._state, newly_missing = self._presence(
+                self._state, registered, now_rel,
+                np.int32(min(self.presence_missing_interval_ms, 2 ** 31 - 1)))
         rows = np.nonzero(np.asarray(newly_missing))[0]
         return [t for t in (self.registry.devices.token_of(int(r)) for r in rows)
                 if t is not None]
@@ -321,7 +332,8 @@ class PipelineEngine(LifecycleComponent):
 
     def set_state(self, state: DeviceStateTensors) -> None:
         """Checkpoint restore."""
-        self._state = jax.device_put(state)
+        with self._state_lock:
+            self._state = jax.device_put(state)
 
     def canonical_state(self) -> DeviceStateTensors:
         """Topology-independent host snapshot: flat device-major layout,
@@ -329,8 +341,14 @@ class PipelineEngine(LifecycleComponent):
         store, so a checkpoint taken on one mesh restores onto any other
         (elastic recovery; the reference's equivalent is Kafka replay into
         a rebuilt store)."""
-        return jax.tree_util.tree_map(
-            lambda a: np.asarray(a), self.state)
+        import jax.numpy as jnp
+
+        # device-side copy under the lock (fast HBM copy that detaches
+        # from the donate-able buffers); the slow D2H conversion runs
+        # OUTSIDE the lock so checkpoint saves don't stall the hot path
+        with self._state_lock:
+            snap = jax.tree_util.tree_map(jnp.copy, self.state)
+        return jax.tree_util.tree_map(lambda a: np.asarray(a), snap)
 
     def _canonical_shape_of(self, field_name: str):
         """Expected canonical (flat) shape for one state field — .shape on
@@ -361,17 +379,21 @@ class PipelineEngine(LifecycleComponent):
     def _state_row(self, idx: int):
         """Fetch one device's row from every state tensor (overridden by the
         sharded engine, which remaps global -> (shard, local))."""
-        s = self._state
-
         class Row:
             pass
 
         row = Row()
-        for field_name in ("last_interaction", "present", "presence_missing_since",
-                           "event_count", "last_location", "last_location_ts",
-                           "last_measurement", "last_measurement_ts",
-                           "last_alert_type", "last_alert_level", "last_alert_ts"):
-            setattr(row, field_name, np.asarray(getattr(s, field_name)[idx]))
+        with self._state_lock:  # vs concurrent donation (see __init__)
+            s = self._state
+            for field_name in ("last_interaction", "present",
+                               "presence_missing_since",
+                               "event_count", "last_location",
+                               "last_location_ts",
+                               "last_measurement", "last_measurement_ts",
+                               "last_alert_type", "last_alert_level",
+                               "last_alert_ts"):
+                setattr(row, field_name,
+                        np.asarray(getattr(s, field_name)[idx]))
         return row
 
     def get_device_state(self, device_token: str) -> Optional[DeviceState]:
@@ -405,11 +427,14 @@ class PipelineEngine(LifecycleComponent):
         return state
 
     def stats(self) -> Dict[str, int]:
-        s = self._state
+        with self._state_lock:  # tenant-count reads vs donation
+            s = self._state
+            tenant_events = np.asarray(s.tenant_event_count).tolist()
+            tenant_alerts = np.asarray(s.tenant_alert_count).tolist()
         return {
             "batches": self.batches_processed,
-            "tenant_event_count": np.asarray(s.tenant_event_count).tolist(),
-            "tenant_alert_count": np.asarray(s.tenant_alert_count).tolist(),
+            "tenant_event_count": tenant_events,
+            "tenant_alert_count": tenant_alerts,
         }
 
     # -- device profiling (the reference's Jaeger span surface; on-device

@@ -153,6 +153,12 @@ class _Partition:
                 self._fh.write(_FRAME.pack(len(key), len(value), ts))
                 self._fh.write(key)
                 self._fh.write(value)
+                # flush to the OS page cache: an accepted record must
+                # survive a process crash (Kafka's default durability —
+                # page cache, not fsync). Without this, records sat in
+                # userspace buffers and a crash lost events producers
+                # thought were accepted.
+                self._fh.flush()
             self._cv.notify_all()
             return offset
 
@@ -174,6 +180,7 @@ class _Partition:
                     chunks.append(value)
             if self._fh is not None and chunks:
                 self._fh.write(b"".join(chunks))
+                self._fh.flush()  # page-cache durability, once per batch
             self._cv.notify_all()
             return offset
 

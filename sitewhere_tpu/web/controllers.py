@@ -185,6 +185,29 @@ def register_all(router: Router, instance, server) -> None:
                 validate_configuration,
                 authority=SiteWhereRoles.VIEW_SERVER_INFO)
 
+    def save_checkpoint(request: Request):
+        """POST /api/instance/checkpoint — snapshot device state +
+        interners + inbound cursors now (persist/checkpoint.py)."""
+        manager = getattr(instance, "checkpoint_manager", None)
+        if manager is None:
+            raise SiteWhereError(
+                "checkpointing requires a pipeline engine and a data_dir",
+                http_status=409)
+        path = manager.save()
+        return {"path": path, "checkpoints": manager.list_checkpoints()}
+
+    def list_checkpoints(request: Request):
+        manager = getattr(instance, "checkpoint_manager", None)
+        if manager is None:
+            return {"checkpoints": []}
+        return {"checkpoints": manager.list_checkpoints(),
+                "restoredOffsets": manager.last_restore_offsets}
+
+    router.post("/api/instance/checkpoint", save_checkpoint,
+                authority=SiteWhereRoles.VIEW_SERVER_INFO)
+    router.get("/api/instance/checkpoints", list_checkpoints,
+               authority=SiteWhereRoles.VIEW_SERVER_INFO)
+
     # ------------------------------------------------------------------
     # Script management (reference: Instance.java:304-560 scripting rpcs,
     # global + per-tenant scopes)

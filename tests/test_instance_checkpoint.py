@@ -1,0 +1,182 @@
+"""Instance-level checkpoint wiring: REST-triggered saves, boot-time
+restore with inbound-cursor rewind, and gap replay — the full crash story
+end to end (SURVEY §5 checkpoint/resume, operationalized)."""
+
+import time
+
+import msgpack
+
+from sitewhere_tpu.model.common import _asdict
+from sitewhere_tpu.model.event import DeviceEventBatch, DeviceMeasurement
+
+
+def _publish(instance, token: str, value: float) -> None:
+    topic = instance.naming.event_source_decoded_events("default")
+    payload = msgpack.packb({
+        "sourceId": "t", "deviceToken": token,
+        "kind": "DeviceEventBatch",
+        "request": _asdict(DeviceEventBatch(
+            device_token=token,
+            measurements=[DeviceMeasurement(name="temp", value=value)])),
+        "metadata": {}}, use_bin_type=True)
+    instance.bus.publish(topic, token.encode(), payload)
+
+
+def _make_instance(data_dir):
+    from sitewhere_tpu.instance import SiteWhereInstance
+
+    instance = SiteWhereInstance(
+        instance_id="ckpt", data_dir=str(data_dir), enable_pipeline=True,
+        max_devices=256, batch_size=32, measurement_slots=4)
+    instance.start()
+    return instance
+
+
+def _wait_for_state(instance, token, value, timeout_s=30):
+    engine = instance.pipeline_engine
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        state = engine.get_device_state(token)
+        if state is not None and \
+                state.last_measurements.get("temp", (0, None))[1] == value:
+            return True
+        time.sleep(0.1)
+    return False
+
+
+def test_checkpoint_boot_restore_and_gap_replay(tmp_path):
+    instance = _make_instance(tmp_path)
+    try:
+        engine = instance.engine_manager.get_engine("default")
+        from sitewhere_tpu.model import Device, DeviceAssignment, DeviceType
+
+        dt = engine.registry.create_device_type(DeviceType(token="t"))
+        for i in range(4):
+            d = engine.registry.create_device(
+                Device(token=f"cd{i}", device_type_id=dt.id))
+            engine.registry.create_device_assignment(
+                DeviceAssignment(token=f"ca{i}", device_id=d.id))
+
+        _publish(instance, "cd1", 11.0)
+        assert _wait_for_state(instance, "cd1", 11.0)
+
+        # checkpoint via the REST surface
+        from sitewhere_tpu.client.rest import SiteWhereClient
+        from sitewhere_tpu.web.server import RestServer
+
+        rest = RestServer(instance, port=0)
+        rest.start()
+        try:
+            client = SiteWhereClient(rest.base_url)
+            client.authenticate("admin", "password")
+            resp = client.post("/api/instance/checkpoint")
+            assert resp["checkpoints"]
+            listed = client.get("/api/instance/checkpoints")
+            assert listed["checkpoints"] == resp["checkpoints"]
+        finally:
+            rest.stop()
+
+        # post-checkpoint event: lands in the bus AFTER the saved cursor,
+        # so the restored instance must replay it to catch up
+        _publish(instance, "cd2", 22.0)
+        assert _wait_for_state(instance, "cd2", 22.0)
+    finally:
+        instance.stop()  # "crash" (bus offsets + checkpoint are durable)
+
+    revived = _make_instance(tmp_path)
+    try:
+        assert revived.checkpoint_manager.last_restore_offsets
+        # checkpointed state restored...
+        assert _wait_for_state(revived, "cd1", 11.0, timeout_s=10)
+        # ...and the post-checkpoint gap replayed from the rewound cursor
+        assert _wait_for_state(revived, "cd2", 22.0, timeout_s=30)
+    finally:
+        revived.stop()
+
+
+def test_periodic_checkpoint_thread(tmp_path):
+    from sitewhere_tpu.instance import SiteWhereInstance
+
+    instance = SiteWhereInstance(
+        instance_id="ckpt2", data_dir=str(tmp_path), enable_pipeline=True,
+        max_devices=128, batch_size=32, checkpoint_interval_s=0.3)
+    instance.start()
+    try:
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if instance.checkpoint_manager.list_checkpoints():
+                break
+            time.sleep(0.1)
+        assert instance.checkpoint_manager.list_checkpoints()
+    finally:
+        instance.stop()
+
+
+def test_tenant_created_after_checkpoint_replays_fully(tmp_path):
+    """A tenant with NO cursor in the checkpoint must replay its topic
+    from the beginning on boot restore — its bus-committed offsets may be
+    past events the restored state never saw (recover()'s no-cursor
+    rule, applied instance-wide)."""
+    instance = _make_instance(tmp_path)
+    try:
+        eng = instance.engine_manager.get_engine("default")
+        from sitewhere_tpu.model import Device, DeviceAssignment, DeviceType
+
+        dt = eng.registry.create_device_type(DeviceType(token="t"))
+        d = eng.registry.create_device(Device(token="cd0",
+                                              device_type_id=dt.id))
+        eng.registry.create_device_assignment(
+            DeviceAssignment(token="ca0", device_id=d.id))
+        _publish(instance, "cd0", 5.0)
+        assert _wait_for_state(instance, "cd0", 5.0)
+        instance.checkpoint_manager.save()
+
+        # tenant created AFTER the checkpoint; its engine processes + the
+        # bus commits its cursor — none of which the checkpoint knows
+        from sitewhere_tpu.model.tenant import Tenant
+
+        instance.tenant_management.create_tenant(Tenant(
+            token="late", name="Late"))
+        late = instance.get_tenant_engine("late")
+        ldt = late.registry.create_device_type(DeviceType(token="lt"))
+        ld = late.registry.create_device(Device(token="ld0",
+                                                device_type_id=ldt.id))
+        late.registry.create_device_assignment(
+            DeviceAssignment(token="la0", device_id=ld.id))
+        topic = instance.naming.event_source_decoded_events("late")
+        import msgpack
+
+        from sitewhere_tpu.model.common import _asdict
+        from sitewhere_tpu.model.event import (
+            DeviceEventBatch, DeviceMeasurement)
+        instance.bus.publish(topic, b"ld0", msgpack.packb({
+            "sourceId": "t", "deviceToken": "ld0",
+            "kind": "DeviceEventBatch",
+            "request": _asdict(DeviceEventBatch(
+                device_token="ld0",
+                measurements=[DeviceMeasurement(name="temp", value=7.0)])),
+            "metadata": {}}, use_bin_type=True))
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            st = instance.pipeline_engine.get_device_state("ld0")
+            if st and st.last_measurements.get("temp", (0, None))[1] == 7.0:
+                break
+            time.sleep(0.2)
+        st = instance.pipeline_engine.get_device_state("ld0")
+        assert st.last_measurements["temp"][1] == 7.0
+    finally:
+        instance.stop()
+
+    revived = _make_instance(tmp_path)
+    try:
+        # late tenant's event replays from the rewound (zeroed) cursor
+        deadline = time.time() + 60
+        ok = False
+        while time.time() < deadline and not ok:
+            st = revived.pipeline_engine.get_device_state("ld0")
+            ok = bool(st and st.last_measurements.get(
+                "temp", (0, None))[1] == 7.0)
+            time.sleep(0.2)
+        assert ok, "late tenant's post-checkpoint events were lost"
+    finally:
+        revived.stop()
