@@ -61,7 +61,11 @@ def _build_instance(cfg):
         default_tenant=cfg.get("instance.default_tenant"),
         admin_username=cfg.get("instance.admin_username"),
         admin_password=cfg.get("instance.admin_password"),
-        shards=int(cfg.get("mesh.shards")))
+        shards=int(cfg.get("mesh.shards")),
+        checkpoint_interval_s=(
+            float(cfg.get("persist.checkpoint_interval_s"))
+            if cfg.get("persist.checkpoint_interval_s") is not None
+            else None))
 
 
 def cmd_serve(args) -> int:

@@ -245,16 +245,19 @@ class InstanceCheckpointManager:
 
     # -- save --------------------------------------------------------------
     def _inbound_groups(self):
-        """Consumer groups feeding the pipeline: each running tenant
-        engine's inbound-processing group."""
-        manager = self.instance.engine_manager
+        """Consumer groups feeding the pipeline: one per KNOWN tenant, not
+        per running engine — a tenant whose engine is admin-stopped (or a
+        save racing shutdown after engines cleared) still has a persisted
+        cursor that must be captured, or the next boot restore would zero
+        it and double-replay the retained log into already-complete
+        state. bus.consumer() loads the persisted committed offsets even
+        when no engine is consuming."""
         groups = []
-        with manager._lock:
-            tenants = list(manager.engines)
-        for tenant in tenants:
-            topic = self.instance.naming.event_source_decoded_events(tenant)
+        for tenant in self.instance.tenant_management.tenants.all():
+            topic = self.instance.naming.event_source_decoded_events(
+                tenant.token)
             groups.append(self.instance.bus.consumer(
-                topic, f"inbound-processing-{tenant}"))
+                topic, f"inbound-processing-{tenant.token}"))
         return groups
 
     def save(self) -> str:
@@ -263,8 +266,8 @@ class InstanceCheckpointManager:
         engine = self.instance.pipeline_engine
         if engine is None:
             raise SiteWhereCheckpointError("instance has no pipeline engine")
-        return self.checkpointer.save(engine, bus=self.instance.bus,
-                                      consumer_groups=self._inbound_groups())
+        return self.checkpointer.save(
+            engine, consumer_groups=self._inbound_groups())
 
     def list_checkpoints(self) -> List[str]:
         return sorted(

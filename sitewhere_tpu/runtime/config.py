@@ -180,7 +180,10 @@ DEFAULTS: Dict = {
     },
     "bus": {"partitions": 8, "retention_chunks": 64, "chunk_events": 65536,
             "edge_port": None},  # set to expose the bus on TCP (busnet)
-    "persist": {"data_dir": "./swtpu-data"},
+    "persist": {"data_dir": "./swtpu-data",
+                # seconds between automatic device-state checkpoints
+                # (None = manual/REST-triggered only)
+                "checkpoint_interval_s": 300},
     "api": {"host": "127.0.0.1", "port": 8080, "jwt_secret": "change-me",
             "jwt_expiration_min": 600},
     "mesh": {"shards": 1},

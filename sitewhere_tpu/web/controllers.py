@@ -203,8 +203,11 @@ def register_all(router: Router, instance, server) -> None:
         return {"checkpoints": manager.list_checkpoints(),
                 "restoredOffsets": manager.last_restore_offsets}
 
+    # mutating + expensive (drains the engine, stalls the hot path,
+    # writes to disk): requires the admin role like engine start/stop,
+    # not the read-only VIEW_SERVER_INFO
     router.post("/api/instance/checkpoint", save_checkpoint,
-                authority=SiteWhereRoles.VIEW_SERVER_INFO)
+                authority=SiteWhereRoles.ADMINISTER_TENANTS)
     router.get("/api/instance/checkpoints", list_checkpoints,
                authority=SiteWhereRoles.VIEW_SERVER_INFO)
 
