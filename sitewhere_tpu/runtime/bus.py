@@ -355,6 +355,32 @@ class ConsumerGroup:
                 for idx in partitions:
                     self.committed[idx] = self.position[idx]
 
+    def commit_at(self, offsets: Dict[int, int],
+                  partitions: Optional[List[int]] = None) -> None:
+        """Commit EXPLICIT per-partition exclusive end offsets (Kafka's
+        commitSync(offsets) shape) — the cursor a consumer actually
+        finished, independent of where the poll position has since moved.
+        Monotonic: never rewinds a committed offset. `partitions`
+        restricts the commit to an owned subset (networked groups)."""
+        with self._lock:
+            for idx, off in offsets.items():
+                if partitions is not None and idx not in partitions:
+                    continue
+                if not 0 <= idx < len(self.committed):
+                    continue
+                # clamp to the real log end: a buggy/corrupted client
+                # extent must never commit past records that don't exist
+                # yet (that would silently skip future deliveries — the
+                # contract here is "duplicates possible, loss not")
+                end = self.topic.partitions[idx].end_offset()
+                off = max(0, min(int(off), end))
+                self.committed[idx] = max(self.committed[idx], off)
+                # preserve the position >= committed invariant, or a
+                # reconnect-triggered seek would redeliver (and possibly
+                # dead-letter) records this very call just committed
+                self.position[idx] = max(self.position[idx],
+                                         self.committed[idx])
+
     def seek_to_committed(self, partitions: Optional[List[int]] = None) -> None:
         with self._lock:
             if partitions is None:
@@ -425,15 +451,24 @@ class EventBus:
                                                   committed)
             return self._groups[key]
 
-    def commit(self, group: ConsumerGroup,
-               partitions: Optional[List[int]] = None) -> None:
-        group.commit(partitions)
+    def _persist_offsets(self, group: ConsumerGroup) -> None:
         path = self._offsets_path(group.topic.name, group.group_id)
         if path:
             tmp = path + ".tmp"
             with open(tmp, "w", encoding="utf-8") as fh:
                 fh.write(" ".join(str(o) for o in group.committed))
             os.replace(tmp, path)
+
+    def commit_at(self, group: ConsumerGroup, offsets: Dict[int, int],
+                  partitions: Optional[List[int]] = None) -> None:
+        """Explicit-offset commit, persisted like commit()."""
+        group.commit_at(offsets, partitions)
+        self._persist_offsets(group)
+
+    def commit(self, group: ConsumerGroup,
+               partitions: Optional[List[int]] = None) -> None:
+        group.commit(partitions)
+        self._persist_offsets(group)
 
     def topics(self) -> List[str]:
         with self._lock:

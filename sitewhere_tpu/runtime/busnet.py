@@ -26,7 +26,7 @@ from typing import Callable, List, Optional, Tuple
 
 import msgpack
 
-from sitewhere_tpu.runtime.bus import EventBus, Record
+from sitewhere_tpu.runtime.bus import EventBus, Record, batch_extent
 
 _LEN = struct.Struct("<I")
 _MAX_FRAME = 64 * 1024 * 1024
@@ -152,6 +152,16 @@ class _Handler(socketserver.BaseRequestHandler):
             topic, group = req["topic"], req["group"]
             owned = coordinator.owned(topic, group, member)
             consumer = bus.consumer(topic, group)
+            commit_at = req.get("commit_at")
+            if commit_at:
+                # piggybacked EXPLICIT-offset commit of the previous batch:
+                # edge consumers save a full round trip per batch
+                # (poll+commit -> one request). Explicit offsets (not
+                # commit-position) so a later failed batch can never be
+                # committed by accident.
+                bus.commit_at(consumer,
+                              {int(k): int(v) for k, v in commit_at.items()},
+                              partitions=owned)
             until = req.get("until")
             if until is not None:
                 until = {int(k): int(v) for k, v in until.items()}
@@ -166,6 +176,14 @@ class _Handler(socketserver.BaseRequestHandler):
             topic, group = req["topic"], req["group"]
             owned = coordinator.owned(topic, group, member)
             bus.commit(bus.consumer(topic, group), partitions=owned)
+            return {"ok": True}
+        if op == "commit_at":
+            topic, group = req["topic"], req["group"]
+            owned = coordinator.owned(topic, group, member)
+            bus.commit_at(bus.consumer(topic, group),
+                          {int(k): int(v)
+                           for k, v in req.get("offsets", {}).items()},
+                          partitions=owned)
             return {"ok": True}
         if op == "seek_committed":
             topic, group = req["topic"], req["group"]
@@ -327,9 +345,12 @@ class BusClient:
 
     def poll(self, topic: str, group: str, max_records: int = 4096,
              timeout_s: float = 0.0,
-             until: Optional[dict] = None) -> List[Record]:
+             until: Optional[dict] = None,
+             commit_at: Optional[dict] = None) -> List[Record]:
         req = {"op": "poll", "topic": topic, "group": group,
                "max": max_records, "timeout_s": timeout_s}
+        if commit_at:
+            req["commit_at"] = {str(k): int(v) for k, v in commit_at.items()}
         if until is not None:
             req["until"] = {str(k): int(v) for k, v in until.items()}
         resp = self._rpc(
@@ -340,6 +361,11 @@ class BusClient:
 
     def commit(self, topic: str, group: str) -> None:
         self._rpc({"op": "commit", "topic": topic, "group": group})
+
+    def commit_at(self, topic: str, group: str, offsets: dict) -> None:
+        """Commit explicit per-partition exclusive end offsets."""
+        self._rpc({"op": "commit_at", "topic": topic, "group": group,
+                   "offsets": {str(k): int(v) for k, v in offsets.items()}})
 
     def seek_committed(self, topic: str, group: str) -> None:
         self._rpc({"op": "seek_committed", "topic": topic, "group": group})
@@ -394,6 +420,11 @@ class RemoteConsumerHost:
         # ((partition, offset) of the failing batch head, retries,
         # per-partition exclusive end offsets of the first failing batch)
         self._failing: Optional[tuple] = None
+        # a successfully-handled batch's commit (its EXPLICIT per-partition
+        # extent) piggybacks on the NEXT poll request — one round trip per
+        # batch instead of two; flushed explicitly on stop and before
+        # failure-path seeks
+        self._pending_extent: Optional[dict] = None
 
     def start(self) -> None:
         if self._thread is not None:
@@ -419,7 +450,9 @@ class RemoteConsumerHost:
                 batch = self._client.poll(self._topic_name, self._group_id,
                                           self._max_records,
                                           timeout_s=self._poll_timeout_s,
-                                          until=until)
+                                          until=until,
+                                          commit_at=self._pending_extent)
+                self._pending_extent = None
             except BusNetError:
                 self.errors += 1
                 # a failed poll may have advanced the server-side cursor
@@ -446,12 +479,14 @@ class RemoteConsumerHost:
                 continue
             try:
                 self._handler(batch)
-                self._client.commit(self._topic_name, self._group_id)
+                self._pending_extent = batch_extent(batch)  # next poll commits
                 self._failing = None
             except Exception:
                 self.errors += 1
-                from sitewhere_tpu.runtime.bus import batch_extent
-
+                # the PREVIOUS batch's deferred commit must land before any
+                # seek_to_committed below, or its records would rejoin (and
+                # eventually be dead-lettered with) the failing batch
+                self._flush_pending_commit()
                 fingerprint = (batch[0].partition, batch[0].offset)
                 if self._failing and self._failing[0] == fingerprint:
                     retries = self._failing[1] + 1
@@ -476,8 +511,31 @@ class RemoteConsumerHost:
                 except BusNetError:
                     pass
 
+    def _flush_pending_commit(self, bounded: bool = False) -> None:
+        if self._pending_extent is None:
+            return
+        old = (self._client.timeout_s, self._client.retries)
+        if bounded:
+            # shutdown must stay bounded: one short attempt, not the
+            # client's full reconnect/retry budget (~minutes against a
+            # hung server). An unflushed commit only costs redelivery.
+            self._client.timeout_s, self._client.retries = 2.0, 0
+        try:
+            self._client.commit_at(self._topic_name, self._group_id,
+                                   self._pending_extent)
+            self._pending_extent = None
+        except BusNetError:
+            pass  # stays pending; redelivery is legal (at-least-once)
+        finally:
+            if bounded:
+                self._client.timeout_s, self._client.retries = old
+
     def stop(self, timeout_s: float = 5.0) -> None:
         self._stop.set()
         if self._thread:
             self._thread.join(timeout=timeout_s)
             self._thread = None
+        # flush the last handled batch's deferred commit (otherwise a
+        # clean shutdown would redeliver it on the next start — legal
+        # under at-least-once, but wasteful)
+        self._flush_pending_commit(bounded=True)

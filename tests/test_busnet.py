@@ -239,3 +239,41 @@ class TestRemoteDeadLetter:
         assert [r.value for r in dlq] == [b"poison"]
         client.close()
         producer.close()
+
+
+class TestDeferredCommit:
+    def test_prior_batch_not_dead_lettered_with_poison(self, server):
+        """A successfully-handled batch whose commit is still deferred
+        (piggyback) must be committed — not re-polled into, retried with,
+        or dead-lettered alongside — a later poison batch."""
+        bus, srv = server
+        client = BusClient("127.0.0.1", srv.port)
+        processed = []
+
+        def handler(batch):
+            if any(r.value == b"poison" for r in batch):
+                raise RuntimeError("nope")
+            processed.extend(r.value for r in batch)
+
+        host = RemoteConsumerHost(client, "dc.events", "edge", handler,
+                                  poll_timeout_s=0.1, max_retries=2)
+        host.start()
+        producer = BusClient("127.0.0.1", srv.port)
+        # same key -> same partition: orders good-then-poison
+        producer.publish("dc.events", b"k", b"good-1")
+        deadline = time.time() + 10
+        while time.time() < deadline and b"good-1" not in processed:
+            time.sleep(0.02)
+        assert b"good-1" in processed
+        # good-1's commit is now pending (deferred to the next poll)
+        producer.publish("dc.events", b"k", b"poison")
+        deadline = time.time() + 15
+        while time.time() < deadline and host.dead_lettered == 0:
+            time.sleep(0.02)
+        host.stop()
+        # ONLY the poison record parked; good-1 was not dragged along
+        dlq = producer.poll(host.dead_letter_topic, "repair", timeout_s=1.0)
+        assert [r.value for r in dlq] == [b"poison"]
+        assert processed.count(b"good-1") == 1  # no redelivery either
+        client.close()
+        producer.close()
