@@ -225,3 +225,93 @@ class TestAlertStormAccounting:
         assert len(alerts) == 10
         assert engine.alerts_dropped == 54  # counted, not silent
         assert engine._metrics.counter("alerts.dropped").value == 54
+
+
+class TestConcurrentStateAccess:
+    """Live reads (REST get_device_state, stats), presence sweeps, and
+    checkpoint snapshots must be safe against concurrent submits — the
+    fused step DONATES its state buffers, so unlocked readers raced into
+    'Array has been deleted' (fixed by the engine state lock)."""
+
+    def _world(self, cls=None, **kw):
+        from sitewhere_tpu.model import Device, DeviceAssignment, DeviceType
+        from sitewhere_tpu.pipeline.engine import PipelineEngine, ThresholdRule
+        from sitewhere_tpu.registry import DeviceManagement, RegistryTensors
+
+        dm = DeviceManagement()
+        dt = dm.create_device_type(DeviceType(token="t"))
+        tensors = RegistryTensors(max_devices=64, max_zones=4,
+                                  max_zone_vertices=4)
+        for i in range(16):
+            d = dm.create_device(Device(token=f"d{i}", device_type_id=dt.id))
+            dm.create_device_assignment(
+                DeviceAssignment(token=f"a{i}", device_id=d.id))
+        tensors.attach(dm, "tenant")
+        engine = (cls or PipelineEngine)(tensors, **kw)
+        engine.start()
+        engine.packer.measurements.intern("m")
+        engine.add_threshold_rule(ThresholdRule(
+            token="r", measurement_name="m", operator=">", threshold=50.0))
+        return engine
+
+    def _hammer(self, engine, submit, ckpt_dir, duration_s=3.0):
+        import threading
+        import time as _time
+
+        from sitewhere_tpu.persist.checkpoint import PipelineCheckpointer
+
+        errors = []
+        stop = threading.Event()
+
+        def guard(fn):
+            def run():
+                while not stop.is_set():
+                    try:
+                        fn()
+                    except Exception as exc:  # noqa: BLE001
+                        errors.append(repr(exc))
+                        return
+            return run
+
+        ck = PipelineCheckpointer(str(ckpt_dir))
+        threads = [
+            threading.Thread(target=guard(submit), daemon=True),
+            threading.Thread(target=guard(
+                lambda: engine.get_device_state("d3")), daemon=True),
+            threading.Thread(target=guard(engine.stats), daemon=True),
+            threading.Thread(target=guard(engine.presence_sweep),
+                             daemon=True),
+            threading.Thread(target=guard(lambda: ck.save(engine)),
+                             daemon=True),
+        ]
+        for t in threads:
+            t.start()
+        _time.sleep(duration_s)
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+            # a worker that never terminates is a deadlock — the exact
+            # bug class this test exists to catch; errors alone can't
+            # see it (a hung thread appends nothing)
+            assert not t.is_alive(), f"thread {t.name} hung (deadlock?)"
+        assert not errors, errors[:3]
+
+    def test_single_chip_engine(self, tmp_path):
+        from sitewhere_tpu.model.event import DeviceMeasurement
+
+        engine = self._world(batch_size=32)
+        batch = engine.packer.pack_events(
+            [DeviceMeasurement(name="m", value=float(i)) for i in range(16)],
+            [f"d{i}" for i in range(16)])[0]
+        self._hammer(engine, lambda: engine.submit(batch), tmp_path)
+
+    def test_sharded_engine(self, tmp_path):
+        from sitewhere_tpu.model.event import DeviceMeasurement
+        from sitewhere_tpu.parallel import ShardedPipelineEngine, make_mesh
+
+        engine = self._world(cls=ShardedPipelineEngine, mesh=make_mesh(8),
+                             per_shard_batch=8)
+        batch = engine.packer.pack_events(
+            [DeviceMeasurement(name="m", value=float(i)) for i in range(16)],
+            [f"d{i}" for i in range(16)])[0]
+        self._hammer(engine, lambda: engine.submit(batch), tmp_path)
