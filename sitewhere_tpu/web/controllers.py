@@ -113,7 +113,7 @@ def register_all(router: Router, instance, server) -> None:
         return instance.topology()
 
     def get_metrics(request: Request):
-        return instance.metrics.snapshot()
+        return instance.metrics.report()
 
     def get_logs(request: Request):
         return {"records": instance.log_aggregator.recent(

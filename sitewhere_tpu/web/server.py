@@ -58,6 +58,8 @@ class RestServer(LifecycleComponent):
         self._thread: Optional[threading.Thread] = None
         from sitewhere_tpu.web.controllers import register_all
         register_all(self.router, instance, self)
+        from sitewhere_tpu.web.admin import register_admin
+        register_admin(self.router)
 
     # -- lifecycle ---------------------------------------------------------
     def on_start(self, monitor) -> None:
