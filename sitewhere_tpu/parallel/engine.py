@@ -49,12 +49,18 @@ class RoutedBlobView:
     the staged wire blob IS the data; EventBatch columns unpack on first
     access (only alert materialization needs them, and only for steps
     that fired). Column attributes proxy to the unpacked batch, so code
-    that treats the handle as an EventBatch keeps working."""
+    that treats the handle as an EventBatch keeps working.
 
-    __slots__ = ("blob", "_batch")
+    `shard_ids` maps the blob's leading axis to GLOBAL shard indices —
+    under multi-process feeding the view holds only this process's local
+    shard blocks."""
 
-    def __init__(self, blob: np.ndarray):
+    __slots__ = ("blob", "shard_ids", "_batch")
+
+    def __init__(self, blob: np.ndarray,
+                 shard_ids: Optional[List[int]] = None):
         self.blob = blob
+        self.shard_ids = shard_ids
         self._batch = None
 
     @property
@@ -108,6 +114,34 @@ class ShardedPipelineEngine(PipelineEngine):
 
     def _target_platform(self) -> str:
         return self.mesh.devices.flat[0].platform
+
+    # -- multi-process topology -------------------------------------------
+
+    @property
+    def local_shards(self) -> List[int]:
+        """Global shard indices whose device lives in THIS process (mesh
+        order). Single-process: all of them."""
+        cached = getattr(self, "_local_shards", None)
+        if cached is None:
+            me = jax.process_index()
+            cached = [i for i, d in enumerate(self.mesh.devices.flat)
+                      if d.process_index == me]
+            self._local_shards = cached
+        return cached
+
+    @property
+    def is_multiprocess(self) -> bool:
+        return len(self.local_shards) < self.n_shards
+
+    def take_foreign(self) -> Optional[EventBatch]:
+        """Events this host ingested whose owner shard lives on ANOTHER
+        process. The multi-host data contract is per-host feeding: each
+        host stages only its local shards' rows; rows owned elsewhere are
+        handed back here for the caller to forward over the bus edge
+        (keyed so the owning host's consumer picks them up) — never
+        silently dropped. Returns a flat batch or None."""
+        batch, self._foreign = getattr(self, "_foreign", None), None
+        return batch
 
     # -- initialization -------------------------------------------------------
 
@@ -272,20 +306,62 @@ class ShardedPipelineEngine(PipelineEngine):
         from sitewhere_tpu.ops.pack import _VALID_SHIFT
 
         shard0 = NamedSharding(self.mesh, P(SHARD_AXIS))
-        blob = jax.device_put(routed_blob, shard0)
+        if self.is_multiprocess:
+            # Per-host feeding (the multi-host jax data contract): this
+            # process stages ONLY its local shards' rows; rows routed to
+            # shards on other processes are stashed for take_foreign()
+            # (the caller forwards them over the bus edge — at-least-once,
+            # never dropped here).
+            local = self.local_shards
+            self._stash_foreign(routed_blob)
+            local_blob = np.ascontiguousarray(routed_blob[local])
+            blob = jax.make_array_from_process_local_data(
+                shard0, local_blob, routed_blob.shape)
+            view = RoutedBlobView(local_blob, shard_ids=local)
+            counted = local_blob
+        else:
+            blob = jax.device_put(routed_blob, shard0)
+            view = RoutedBlobView(routed_blob)
+            counted = routed_blob
         with self._metrics.timer("step").time():
             with self._state_lock:  # vs concurrent readers (base __init__)
                 self._state, outputs = self._sharded_step(
                     params, self._state, blob)
         self.batches_processed += 1
-        # rows actually stepped this call: overflow rows are counted by the
-        # step that eventually carries them, so each event marks exactly
-        # once. Counted from the blob head bits — the full column unpack is
-        # deferred until alert materialization actually needs it (most
-        # steps don't), which was ~25% of sharded submit host time.
+        # rows actually stepped BY THIS PROCESS this call: overflow rows
+        # are counted by the step that eventually carries them, so each
+        # event marks exactly once. Counted from the blob head bits — the
+        # full column unpack is deferred until alert materialization
+        # actually needs it (most steps don't), which was ~25% of sharded
+        # submit host time.
         self._metrics.meter("events").mark(int(
-            ((routed_blob[..., 0, :] >> _VALID_SHIFT) & 1).sum()))
-        return RoutedBlobView(routed_blob), outputs
+            ((counted[..., 0, :] >> _VALID_SHIFT) & 1).sum()))
+        return view, outputs
+
+    def _stash_foreign(self, routed_blob: np.ndarray) -> None:
+        """Extract valid rows routed to NON-local shards as a flat batch
+        with GLOBAL device indices; accumulate for take_foreign()."""
+        from sitewhere_tpu.ops.pack import _VALID_SHIFT, blob_to_batch_np
+        from sitewhere_tpu.parallel.router import concat_flat_batches
+
+        others = [s for s in range(self.n_shards)
+                  if s not in set(self.local_shards)]
+        if not others:
+            return
+        sub = routed_blob[others]                       # [F, 5, B]
+        if not ((sub[:, 0, :] >> _VALID_SHIFT) & 1).any():
+            return
+        batch = blob_to_batch_np(sub)                   # local dev indices
+        shard_of = np.repeat(np.array(others, np.int32), sub.shape[-1])
+        flat = jax.tree_util.tree_map(
+            lambda a: np.asarray(a).reshape((-1,) + np.asarray(a).shape[2:]),
+            batch)
+        flat = flat.replace(
+            device_idx=flat.device_idx * self.n_shards + shard_of)
+        rows = np.nonzero(flat.valid)[0]
+        flat = jax.tree_util.tree_map(lambda a: a[rows], flat)
+        self._foreign = (flat if getattr(self, "_foreign", None) is None
+                         else concat_flat_batches([self._foreign, flat]))
 
     def submit_routed(self, batch: EventBatch):
         """See PipelineEngine.submit_routed: sharded submit already returns
@@ -302,6 +378,14 @@ class ShardedPipelineEngine(PipelineEngine):
         return pending + self._materialize_routed(routed_batch, outputs,
                                                   max_alerts)
 
+    def _gather_local(self, arr) -> np.ndarray:
+        """Local [S_local, B, ...] block of a shard-axis-sharded output —
+        each process materializes its own shards' rows only (np.asarray on
+        the global array would require non-addressable shards)."""
+        shards = sorted(arr.addressable_shards,
+                        key=lambda s: s.index[0].start or 0)
+        return np.concatenate([np.asarray(s.data) for s in shards], axis=0)
+
     def _materialize_routed(self, routed_batch,
                             outputs: ProcessOutputs,
                             max_alerts: Optional[int] = None
@@ -309,32 +393,51 @@ class ShardedPipelineEngine(PipelineEngine):
         """Flatten [S, B] rows back to a flat batch with GLOBAL device indices
         and reuse the base materializer. Accepts the lazy RoutedBlobView
         (sharded submit's return) or a plain routed EventBatch; nothing
-        unpacks when no rule fired."""
-        if (not np.asarray(outputs.threshold_fired).any()
-                and not np.asarray(outputs.geofence_fired).any()):
+        unpacks when no rule fired. Under multi-process feeding the view
+        holds only local shard blocks — each host materializes the alerts
+        of its own devices."""
+        shard_ids = None
+        if isinstance(routed_batch, RoutedBlobView):
+            shard_ids = routed_batch.shard_ids
+        per_row = ("valid", "unregistered", "threshold_fired",
+                   "threshold_first_rule", "threshold_alert_level",
+                   "geofence_fired", "geofence_first_rule",
+                   "geofence_alert_level")
+        if self.is_multiprocess:
+            out_np = {name: self._gather_local(getattr(outputs, name))
+                      for name in per_row}
+        else:
+            out_np = {name: np.asarray(getattr(outputs, name))
+                      for name in per_row}
+        if (not out_np["threshold_fired"].any()
+                and not out_np["geofence_fired"].any()):
             return []
         if isinstance(routed_batch, RoutedBlobView):
             routed_batch = routed_batch.batch
-        S, B = routed_batch.valid.shape
-        shard_of_row = np.repeat(np.arange(S, dtype=np.int32), B)
+        S_rows, B = routed_batch.valid.shape
+        ids = (np.arange(S_rows, dtype=np.int32) if shard_ids is None
+               else np.array(shard_ids, np.int32))
+        shard_of_row = np.repeat(ids, B)
 
         def flat(a):
-            return np.asarray(a).reshape((S * B,) + np.asarray(a).shape[2:])
+            a = np.asarray(a)
+            return a.reshape((S_rows * B,) + a.shape[2:])
 
         flat_batch = jax.tree_util.tree_map(flat, routed_batch)
         flat_batch = flat_batch.replace(
-            device_idx=flat_batch.device_idx * S + shard_of_row)
+            device_idx=flat_batch.device_idx * self.n_shards + shard_of_row)
         flat_out = outputs.replace(
-            valid=flat(outputs.valid), unregistered=flat(outputs.unregistered),
-            threshold_fired=flat(outputs.threshold_fired),
-            threshold_first_rule=flat(outputs.threshold_first_rule),
-            threshold_alert_level=flat(outputs.threshold_alert_level),
-            geofence_fired=flat(outputs.geofence_fired),
-            geofence_first_rule=flat(outputs.geofence_first_rule),
-            geofence_alert_level=flat(outputs.geofence_alert_level))
+            **{name: flat(out_np[name]) for name in per_row})
         return super().materialize_alerts(flat_batch, flat_out, max_alerts)
 
     # -- reads ----------------------------------------------------------------
+
+    _STATE_ROW_FIELDS = ("last_interaction", "present",
+                         "presence_missing_since", "event_count",
+                         "last_location", "last_location_ts",
+                         "last_measurement", "last_measurement_ts",
+                         "last_alert_type", "last_alert_level",
+                         "last_alert_ts")
 
     def _state_row(self, idx: int):
         s, l = idx % self.n_shards, idx // self.n_shards
@@ -345,13 +448,24 @@ class ShardedPipelineEngine(PipelineEngine):
         row = Row()
         with self._state_lock:  # vs concurrent donation (base __init__)
             state = self._state
-            for field_name in ("last_interaction", "present",
-                               "presence_missing_since",
-                               "event_count", "last_location",
-                               "last_location_ts",
-                               "last_measurement", "last_measurement_ts",
-                               "last_alert_type", "last_alert_level",
-                               "last_alert_ts"):
+            if self.is_multiprocess:
+                # Multi-controller jax is SPMD: per-process single-element
+                # indexing of a distributed array is NOT a valid program
+                # (each process would issue a different computation).
+                # Read straight from the addressable shard's host data; a
+                # device owned by another host returns None (query that
+                # host — device ownership is static, d % S).
+                if s not in self.local_shards:
+                    return None
+                for field_name in self._STATE_ROW_FIELDS:
+                    arr = getattr(state, field_name)
+                    block = next(
+                        sh for sh in arr.addressable_shards
+                        if (sh.index[0].start or 0) == s)
+                    setattr(row, field_name,
+                            np.asarray(block.data)[0, l])
+                return row
+            for field_name in self._STATE_ROW_FIELDS:
                 setattr(row, field_name,
                         np.asarray(getattr(state, field_name)[s, l]))
         return row
@@ -364,10 +478,18 @@ class ShardedPipelineEngine(PipelineEngine):
             self._state, newly_missing = self._presence(
                 self._state, registered, now_rel,
                 np.int32(min(self.presence_missing_interval_ms, 2 ** 31 - 1)))
-        shards, locals_ = np.nonzero(np.asarray(newly_missing))
+        if self.is_multiprocess:
+            # each host sweeps (and notifies for) its LOCAL shards only
+            missing_np = self._gather_local(newly_missing)
+            shard_ids = np.array(self.local_shards, np.int32)
+        else:
+            missing_np = np.asarray(newly_missing)
+            shard_ids = np.arange(self.n_shards, dtype=np.int32)
+        rows, locals_ = np.nonzero(missing_np)
         tokens = []
-        for s, l in zip(shards, locals_):
-            token = self.registry.devices.token_of(int(l) * self.n_shards + int(s))
+        for r, l in zip(rows, locals_):
+            token = self.registry.devices.token_of(
+                int(l) * self.n_shards + int(shard_ids[r]))
             if token is not None:
                 tokens.append(token)
         return tokens
@@ -387,6 +509,11 @@ class ShardedPipelineEngine(PipelineEngine):
 
         import jax.numpy as jnp
 
+        if self.is_multiprocess:
+            raise NotImplementedError(
+                "multi-host checkpoint gather is not supported from a "
+                "worker process; checkpoint from a single-controller run "
+                "(each host's bus offsets + replay already cover recovery)")
         # device-side copy under the lock only (see base canonical_state);
         # the D2H gather + host re-layout run outside it
         with self._state_lock:
@@ -478,8 +605,19 @@ class ShardedPipelineEngine(PipelineEngine):
     def stats(self):
         with self._state_lock:  # tenant-count reads vs donation
             s = self._state
-            tenant_events = np.asarray(s.tenant_event_count).sum(0).tolist()
-            tenant_alerts = np.asarray(s.tenant_alert_count).sum(0).tolist()
+            if self.is_multiprocess:
+                # per-process view: counts of THIS host's shards (global
+                # totals need an allgather; tenant psums per step already
+                # travel replicated in ProcessOutputs.tenant_counts)
+                tenant_events = self._gather_local(
+                    s.tenant_event_count).sum(0).tolist()
+                tenant_alerts = self._gather_local(
+                    s.tenant_alert_count).sum(0).tolist()
+            else:
+                tenant_events = np.asarray(
+                    s.tenant_event_count).sum(0).tolist()
+                tenant_alerts = np.asarray(
+                    s.tenant_alert_count).sum(0).tolist()
         return {
             "batches": self.batches_processed,
             "dropped": self.total_dropped,

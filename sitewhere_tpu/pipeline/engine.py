@@ -402,6 +402,8 @@ class PipelineEngine(LifecycleComponent):
         if idx == 0 or self._state is None:
             return None
         row = self._state_row(idx)
+        if row is None:  # multi-host: owned by another process
+            return None
         state = DeviceState(device_id=device_token)
         if int(row.last_interaction) > _NEG:
             state.last_interaction_date = self.packer.abs_ts(int(row.last_interaction))

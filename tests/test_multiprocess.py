@@ -132,3 +132,95 @@ def test_two_process_ring_matches_psum():
     assert ring[0] == ring[1]
     for i in range(4):
         np.testing.assert_allclose(ring[0][i], psum[0][i], rtol=1e-4)
+
+
+_PIPELINE_CHILD = r"""
+import os, sys
+pid = int(sys.argv[1]); port = sys.argv[2]
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+jax.distributed.initialize(coordinator_address=f"127.0.0.1:{port}",
+                           num_processes=2, process_id=pid)
+import numpy as np
+from sitewhere_tpu.model import (
+    Device, DeviceAssignment, DeviceType, DeviceMeasurement)
+from sitewhere_tpu.parallel import ShardedPipelineEngine
+from sitewhere_tpu.parallel.distributed import make_global_mesh
+from sitewhere_tpu.pipeline.engine import ThresholdRule
+from sitewhere_tpu.registry import DeviceManagement, RegistryTensors
+
+dm = DeviceManagement()
+dt = dm.create_device_type(DeviceType(token="t"))
+rt = RegistryTensors(64, 4, 4)
+for i in range(16):
+    d = dm.create_device(Device(token=f"d{i}", device_type_id=dt.id))
+    dm.create_device_assignment(DeviceAssignment(token=f"a{i}", device_id=d.id))
+rt.attach(dm, "tenant")
+e = ShardedPipelineEngine(rt, mesh=make_global_mesh(), per_shard_batch=8)
+e.start()
+e.add_threshold_rule(ThresholdRule(token="r", measurement_name="m",
+                                   operator=">", threshold=1.0))
+assert e.is_multiprocess and len(e.local_shards) == 2
+
+# aligned feeding: each host ingests only devices its local shards own
+mine = [i for i in range(16)
+        if (rt.devices.lookup(f"d{i}") % 4) in e.local_shards]
+b = e.packer.pack_events(
+    [DeviceMeasurement(name="m", value=10.0 + i, event_date=1000 + i)
+     for i in mine], [f"d{i}" for i in mine])[0]
+rb, out = e.submit(b)
+alerts = e.materialize_alerts(rb, out)
+assert int(out.processed) == 16, int(out.processed)   # psum'd global
+assert len(alerts) == len(mine) == 8
+assert {a.device_id for a in alerts} == {f"d{i}" for i in mine}
+assert e.take_foreign() is None
+for i in mine:
+    st = e.get_device_state(f"d{i}")
+    assert st is not None and st.last_measurements["m"][1] == 10.0 + i
+other = next(i for i in range(16)
+             if (rt.devices.lookup(f"d{i}") % 4) not in e.local_shards)
+assert e.get_device_state(f"d{other}") is None  # owned by the peer host
+
+# mixed feeding: foreign-owned rows hand back for bus forwarding
+mixed = [0, 1, 2, 3]
+b2 = e.packer.pack_events(
+    [DeviceMeasurement(name="m", value=50.0 + i) for i in mixed],
+    [f"d{i}" for i in mixed])[0]
+e.submit(b2)
+foreign = e.take_foreign()
+toks = sorted(rt.devices.token_of(int(ix)) for ix in
+              np.asarray(foreign.device_idx)[np.asarray(foreign.valid)])
+expect = sorted(f"d{i}" for i in mixed
+                if (rt.devices.lookup(f"d{i}") % 4) not in e.local_shards)
+assert toks == expect, (toks, expect)
+print(f"PIPEOK {pid}", flush=True)
+"""
+
+
+def test_two_process_pipeline_per_host_feeding():
+    """The SHARDED PIPELINE under a true 2-process mesh: per-host feeding
+    (each host stages only its local shards via process-local data),
+    psum'd global counts, local alert materialization + state reads, and
+    foreign-row handoff for events owned by the peer host."""
+    port = _free_port()
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    env.pop("JAX_PLATFORMS", None)
+    env.pop("XLA_FLAGS", None)
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", _PIPELINE_CHILD, str(pid), str(port)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env)
+        for pid in range(2)]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=300)
+            outs.append(out)
+            assert p.returncode == 0, out[-3000:]
+    finally:
+        for q in procs:
+            if q.poll() is None:
+                q.kill()
+                q.wait(timeout=30)
+    assert all(f"PIPEOK {pid}" in outs[pid] for pid in range(2)), outs
