@@ -70,7 +70,7 @@ def _load(_retry: bool = True) -> None:
     # from source once.
     try:
         lib.swt_version.restype = i32
-        stale = lib.swt_version() != 3
+        stale = lib.swt_version() != 4
     except AttributeError:
         stale = True
     if stale:
@@ -120,6 +120,11 @@ def _load(_retry: bool = True) -> None:
     lib.swt_route_blob.argtypes = [p_i32, i64, i32, i32, p_i32, p_i64, i64]
     lib.swt_route_blob.restype = i32
     p_u8 = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+    lib.swt_pack_route_blob.argtypes = [p_i32, p_i32, p_i32, p_i32, p_f32,
+                                        p_f32, p_f32, p_f32, p_i32, p_i32,
+                                        p_u8, i64, i32, i32, p_i32, p_i64,
+                                        i64]
+    lib.swt_pack_route_blob.restype = i32
     lib.swt_pack_blob.argtypes = [p_i32, p_i32, p_i32, p_i32, p_f32, p_f32,
                                   p_f32, p_f32, p_i32, p_i32, p_u8, i64,
                                   p_i32]
@@ -318,6 +323,43 @@ def route_blob(blob: np.ndarray, n_shards: int, per_shard: int
     if n_over < 0:  # cannot happen with overflow_cap=n; defensive
         raise RuntimeError("route_blob overflow capacity exceeded")
     return out, overflow[:n_over]
+
+
+def pack_route_blob(batch, n_shards: int, per_shard: int,
+                    out: Optional[np.ndarray] = None
+                    ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """Fused pack+route: EventBatch columns -> routed [S, WIRE_ROWS, B]
+    blob + overflow flat-row indices in ONE native pass (see
+    swt_pack_route_blob). `out` may be a reused staging buffer — it does
+    NOT need to be zeroed (the kernel clears exactly the head-row tails
+    whose valid bits must read 0). Returns None when a device_idx is out
+    of wire range (caller raises the shared diagnostic). Requires
+    available()."""
+    from sitewhere_tpu.ops.pack import WIRE_ROWS
+
+    n = batch.device_idx.shape[0]
+    if out is None:
+        out = np.empty((n_shards, WIRE_ROWS, per_shard), np.int32)
+
+    def i32(a):
+        return np.ascontiguousarray(a, np.int32)
+
+    def f32(a):
+        return np.ascontiguousarray(a, np.float32)
+
+    overflow = np.empty(max(n, 1), np.int64)
+    rc = LIB.swt_pack_route_blob(
+        i32(batch.device_idx), i32(batch.event_type), i32(batch.ts),
+        i32(batch.mm_idx), f32(batch.value), f32(batch.lat), f32(batch.lon),
+        f32(batch.elevation), i32(batch.alert_type_idx),
+        i32(batch.alert_level),
+        np.ascontiguousarray(batch.valid, np.uint8), n, n_shards, per_shard,
+        out.reshape(-1), overflow, len(overflow))
+    if rc == -2:
+        return None
+    if rc < 0:  # cannot happen with overflow_cap=n; defensive
+        raise RuntimeError("pack_route_blob overflow capacity exceeded")
+    return out, overflow[:rc]
 
 
 def pack_blob(batch, out: np.ndarray) -> bool:

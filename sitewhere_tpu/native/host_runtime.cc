@@ -93,7 +93,7 @@ struct Interner {
 
 extern "C" {
 
-int32_t swt_version() { return 3; }
+int32_t swt_version() { return 4; }
 
 void* swt_interner_create(int32_t capacity) {
   if (capacity < 2) return nullptr;
@@ -451,6 +451,60 @@ void swt_unpack_blob(const int32_t* blob, int64_t n, int32_t* device_idx,
     }
     elevation[i] = bits_f32(elev[i]);
   }
+}
+
+// Fused pack+route: EventBatch columns -> routed [S, kWireRows, B] blob in
+// ONE pass (replaces swt_pack_blob + swt_route_blob back to back — two full
+// passes over the batch plus a zeroed 5*S*B intermediate). `out` does NOT
+// need to arrive zeroed: after routing, only the head-row tails (positions
+// cursor[s]..B, whose valid bit must read 0) are cleared — the other rows
+// of unfilled positions are never read because the device step masks on the
+// head valid bit. Invalid input rows are skipped (padding). Returns the
+// overflow count, -1 when overflow_cap is too small, or -2 when a valid
+// row's device_idx is outside [0, 2^22) (caller raises the shared
+// diagnostic).
+int32_t swt_pack_route_blob(
+    const int32_t* device_idx, const int32_t* event_type, const int32_t* ts,
+    const int32_t* mm_idx, const float* value, const float* lat,
+    const float* lon, const float* elevation, const int32_t* alert_type_idx,
+    const int32_t* alert_level, const uint8_t* valid, int64_t n, int32_t S,
+    int32_t B, int32_t* out, int64_t* overflow_rows, int64_t overflow_cap) {
+  std::vector<int32_t> cursor(static_cast<size_t>(S), 0);
+  int64_t n_over = 0;
+  const int64_t shard_stride = static_cast<int64_t>(kWireRows) * B;
+  for (int64_t i = 0; i < n; ++i) {
+    if (!valid[i]) continue;
+    int32_t dev = device_idx[i];
+    if (dev < 0 || dev > kWireDevMask) return -2;
+    int32_t s = dev % S;
+    int32_t pos = cursor[s];
+    if (pos >= B) {
+      if (n_over >= overflow_cap) return -1;
+      overflow_rows[n_over++] = i;
+      continue;
+    }
+    cursor[s] = pos + 1;
+    int32_t* dst = out + s * shard_stride + pos;
+    int32_t et = event_type[i] & 7;
+    dst[0] = (dev / S) | (et << 22) | ((alert_level[i] & 7) << 25) |
+             kWireValidBit;
+    dst[B] = ts[i];
+    if (et == kEtLocation) {
+      dst[2 * B] = f32_bits(lat[i]);
+      dst[3 * B] = f32_bits(lon[i]);
+    } else {
+      dst[2 * B] = f32_bits(value[i]);
+      dst[3 * B] = (et == kEtAlert ? alert_type_idx[i] : mm_idx[i]) & kIdxMask;
+    }
+    dst[4 * B] = f32_bits(elevation[i]);
+  }
+  for (int32_t s = 0; s < S; ++s) {
+    int32_t filled = cursor[s];
+    if (filled < B)
+      std::memset(out + s * shard_stride + filled, 0,
+                  static_cast<size_t>(B - filled) * 4);
+  }
+  return static_cast<int32_t>(n_over);
 }
 
 int32_t swt_route_blob(const int32_t* blob, int64_t n, int32_t S, int32_t B,

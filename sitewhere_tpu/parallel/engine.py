@@ -18,7 +18,7 @@ from __future__ import annotations
 
 import time
 from functools import partial
-from typing import List, Optional, Tuple
+from typing import Callable, List, Optional, Tuple
 
 import jax
 import numpy as np
@@ -30,7 +30,7 @@ except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map as _shard_map
 
 from sitewhere_tpu.model import DeviceAlert
-from sitewhere_tpu.ops.pack import EventBatch, batch_to_blob, blob_to_batch
+from sitewhere_tpu.ops.pack import EventBatch, blob_to_batch
 from sitewhere_tpu.parallel.mesh import SHARD_AXIS, make_mesh, shard_axis_size
 from sitewhere_tpu.parallel.router import ShardRouter
 from sitewhere_tpu.pipeline.engine import PipelineEngine
@@ -53,15 +53,27 @@ class RoutedBlobView:
 
     `shard_ids` maps the blob's leading axis to GLOBAL shard indices —
     under multi-process feeding the view holds only this process's local
-    shard blocks."""
+    shard blocks.
 
-    __slots__ = ("blob", "shard_ids", "_batch")
+    When the blob is a pooled staging buffer on loan from the router,
+    `release` returns it for reuse once this view is garbage-collected —
+    holding a view arbitrarily long is always safe (the buffer cannot be
+    recycled underneath it). Recycling is additionally guarded against
+    in-flight async H2D DMA: the release carries the consuming step's
+    output as a transfer-completion guard that the pool blocks on before
+    handing the buffer out again (router.release_staging_buffer). The cpu
+    backend, where jax may zero-copy host buffers outright, never loans
+    buffers (staging_ring=0)."""
+
+    __slots__ = ("blob", "shard_ids", "_batch", "_release", "__weakref__")
 
     def __init__(self, blob: np.ndarray,
-                 shard_ids: Optional[List[int]] = None):
+                 shard_ids: Optional[List[int]] = None,
+                 release: Optional[Callable[[], None]] = None):
         self.blob = blob
         self.shard_ids = shard_ids
         self._batch = None
+        self._release = release
 
     @property
     def batch(self) -> EventBatch:
@@ -73,6 +85,14 @@ class RoutedBlobView:
 
     def __getattr__(self, name):
         return getattr(self.batch, name)
+
+    def __del__(self):
+        release, self._release = self._release, None
+        if release is not None:
+            try:
+                release()
+            except Exception:
+                pass
 
 
 class ShardedPipelineEngine(PipelineEngine):
@@ -92,7 +112,13 @@ class ShardedPipelineEngine(PipelineEngine):
                 f"max_devices {registry_tensors.devices.capacity} must be "
                 f"divisible by {self.n_shards} shards")
         super().__init__(registry_tensors, batch_size=per_shard_batch, **kwargs)
-        self.router = ShardRouter(self.n_shards, per_shard_batch)
+        # staging-ring reuse only on accelerator meshes: the cpu backend
+        # zero-copies aligned numpy arrays into device buffers, so a
+        # recycled routed-blob slot could corrupt an in-flight step's
+        # input (see PipelineEngine._staging_blob_buffer)
+        ring = 0 if self._target_platform() == "cpu" else 4
+        self.router = ShardRouter(self.n_shards, per_shard_batch,
+                                  staging_ring=ring)
         # host packer accepts a full mesh's worth of events per flat batch
         from sitewhere_tpu.ops.pack import EventPacker
         self.packer = EventPacker(per_shard_batch * self.n_shards,
@@ -269,13 +295,12 @@ class ShardedPipelineEngine(PipelineEngine):
         if self._overflow is not None:
             batch = concat_flat_batches([self._overflow, batch])
             self._overflow = None
-        # Blob-first routing: pack the flat batch once (WIRE_ROWS int32 rows),
-        # the router scatters those rows per shard (native single pass when
-        # available) — the routed blob IS the staging format, so no second
-        # pack happens, and the routed EventBatch view is derived by cheap
-        # numpy bit-ops only for materialization.
-        flat_blob = batch_to_blob(batch)
-        routed_blob, over_rows = self.router.route_blob(flat_blob)
+        # Fused pack+route: one native pass from flat columns straight into
+        # the routed [S, WIRE_ROWS, B] staging blob (reused ring buffer, no
+        # per-step allocation) — the routed blob IS the staging format, and
+        # the routed EventBatch view is derived by cheap numpy bit-ops only
+        # for materialization.
+        routed_blob, over_rows = self.router.route_batch(batch)
         routed_batch, outputs = self._one_step(params, routed_blob)
         self._overflow = self._slice_flat(batch, over_rows)
         while (self._overflow is not None
@@ -288,8 +313,7 @@ class ShardedPipelineEngine(PipelineEngine):
             self._overflow = None
             self.drain_steps += 1
             self._metrics.counter("overflow.drain_steps").inc()
-            routed_blob, over_rows = self.router.route_blob(
-                batch_to_blob(backlog))
+            routed_blob, over_rows = self.router.route_batch(backlog)
             routed_batch, outputs = self._one_step(params, routed_blob)
             self._overflow = self._slice_flat(backlog, over_rows)
         return routed_batch, outputs
@@ -315,18 +339,28 @@ class ShardedPipelineEngine(PipelineEngine):
             local = self.local_shards
             self._stash_foreign(routed_blob)
             local_blob = np.ascontiguousarray(routed_blob[local])
+            # the view holds the local copy; the pooled routed blob is
+            # fully consumed at this point and can go back on the shelf
+            self.router.release_staging_buffer(routed_blob)
             blob = jax.make_array_from_process_local_data(
                 shard0, local_blob, routed_blob.shape)
             view = RoutedBlobView(local_blob, shard_ids=local)
             counted = local_blob
         else:
             blob = jax.device_put(routed_blob, shard0)
+            # release wired after the step runs, carrying the step output
+            # as the transfer-completion guard
             view = RoutedBlobView(routed_blob)
             counted = routed_blob
         with self._metrics.timer("step").time():
             with self._state_lock:  # vs concurrent readers (base __init__)
                 self._state, outputs = self._sharded_step(
                     params, self._state, blob)
+        if not self.is_multiprocess:
+            # pooled-blob loan: returns on view GC; outputs.processed is
+            # the transfer-completion guard (step executed => input read)
+            view._release = partial(self.router.release_staging_buffer,
+                                    routed_blob, outputs.processed)
         self.batches_processed += 1
         # rows actually stepped BY THIS PROCESS this call: overflow rows
         # are counted by the step that eventually carries them, so each

@@ -114,6 +114,16 @@ class PipelineEngine(LifecycleComponent):
         self._presence = jax.jit(check_presence, donate_argnums=(0,))
         self.batches_processed = 0
         self.alerts_dropped = 0  # only when a caller bounds materialization
+        # rotating staging buffers for the wire blob (see
+        # _staging_blob_buffer) — fresh 2.6 MB mmap-backed allocations per
+        # step cost page faults on the hot path. _blob_ring_guards[i] is a
+        # device array whose readiness proves slot i's H2D transfer
+        # completed; slot reuse blocks on it (async PJRT DMA reads the
+        # host buffer after dispatch returns).
+        self._blob_ring: Optional[list] = None
+        self._blob_ring_guards: Optional[list] = None
+        self._blob_ring_pos = 0
+        self._blob_ring_lock = threading.Lock()
 
     def _target_platform(self) -> str:
         """Platform the step will compile for (sharded engines override from
@@ -231,19 +241,89 @@ class PipelineEngine(LifecycleComponent):
 
     # -- processing -----------------------------------------------------------
 
+    def _staging_blob_buffer(self, batch: EventBatch) -> Optional[np.ndarray]:
+        """Rotating reusable [WIRE_ROWS, B] staging buffer for full-size flat
+        batches (ring of 6: blob contents stay stable through dispatch +
+        async H2D even with pipelined staging depth 3 and two stager
+        threads). Odd-size batches allocate fresh (returns None).
+
+        ACCELERATOR BACKENDS ONLY: on the cpu backend jax zero-copies
+        suitably-aligned numpy arrays into device buffers — a later pack
+        into the recycled slot would corrupt an in-flight step's input
+        (observed as a flaky one-row diff under pytest). On cpu the
+        "transfer" IS a host copy anyway, so reuse saves nothing; on
+        TPU/GPU device memory is separate and device_put always copies."""
+        from sitewhere_tpu.ops.pack import WIRE_ROWS
+
+        if (self._target_platform() == "cpu"
+                or batch.device_idx.ndim != 1
+                or batch.device_idx.shape[0] != self.batch_size):
+            return None
+        with self._blob_ring_lock:
+            if self._blob_ring is None:
+                self._blob_ring = [
+                    np.empty((WIRE_ROWS, self.batch_size), np.int32)
+                    for _ in range(6)]
+                self._blob_ring_guards = [None] * len(self._blob_ring)
+            pos = self._blob_ring_pos
+            self._blob_ring_pos = (pos + 1) % len(self._blob_ring)
+            buf = self._blob_ring[pos]
+            guard, self._blob_ring_guards[pos] = (
+                self._blob_ring_guards[pos], None)
+        if guard is not None:
+            # slot reuse must wait for the slot's previous H2D transfer:
+            # the guard (consuming step's output, or the transferred
+            # array itself) is ready no earlier than the transfer. By the
+            # time a 6-slot ring cycles back this is almost always ready.
+            try:
+                guard.block_until_ready()
+            except Exception:
+                pass  # a failed step still implies the transfer finished
+        return buf
+
+    def _note_blob_guard(self, buf, guard) -> None:
+        """Record the transfer-completion guard for a ring slot after its
+        blob was handed to jax (no-op for non-ring buffers)."""
+        with self._blob_ring_lock:
+            if self._blob_ring is None:
+                return
+            for i, ring_buf in enumerate(self._blob_ring):
+                if ring_buf is buf:
+                    self._blob_ring_guards[i] = guard
+                    return
+
     def submit(self, batch: EventBatch) -> ProcessOutputs:
         """Run one fused step; state advances in place (donated)."""
+        # single-transfer host->device staging (see ops.pack.batch_to_blob).
+        # timer("pack") keeps host staging visible now that timer("step")
+        # covers only the dispatch (pack used to be inside it).
+        with self._metrics.timer("pack").time():
+            blob = batch_to_blob(batch, out=self._staging_blob_buffer(batch))
+        return self.submit_blob(
+            blob, n_events=int(np.asarray(batch.valid).sum()))
+
+    def submit_blob(self, blob, n_events: Optional[int] = None
+                    ) -> ProcessOutputs:
+        """Run one fused step on an already-packed wire blob (numpy or
+        device-resident). The pipelined feeder (pipeline/feed.py) stages
+        blobs — pack + async device_put — on worker threads so host staging
+        of batch N+1 overlaps device compute of step N. `n_events` feeds
+        the events meter (counting valid bits of a device-resident blob
+        here would force a D2H sync on the hot path)."""
         if self._state is None:  # lazy init for direct (un-started) use
             self.initialize()  # full lifecycle init so a later start() won't re-init
         params = self._ensure_params()
         with self._metrics.timer("step").time():
-            # single-transfer host->device staging (see ops.pack.batch_to_blob)
-            blob = batch_to_blob(batch)
             with self._state_lock:
                 self._state, outputs = self._step_blob(params, self._state,
                                                        blob)
+        if isinstance(blob, np.ndarray):
+            # ring-slot transfer guard: the implicit jit transfer of a
+            # numpy blob completes no later than the step's outputs
+            self._note_blob_guard(blob, outputs.processed)
         self.batches_processed += 1
-        self._metrics.meter("events").mark(int(np.asarray(batch.valid).sum()))
+        if n_events is not None:
+            self._metrics.meter("events").mark(n_events)
         return outputs
 
     def submit_routed(self, batch: EventBatch):

@@ -1,0 +1,231 @@
+"""Pipelined host->device feeding: overlap staging with device compute.
+
+VERDICT r2 finding: the single-chip headline path left the TPU ~3.5% busy —
+the device program finishes in ~0.11 ms while ~2.9 ms of host pack + H2D
+staging serialized ahead of every step. The fix is the classic
+double-buffered accelerator input pipeline (the reference's nearest analog
+is the DeviceEventBuffer linger thread that stages bulk writes ahead of
+Mongo, DeviceEventBuffer.java:99-123 — applied here to the accelerator
+boundary instead of the datastore):
+
+  stager thread(s):  pack batch N+1 into a rotating wire-blob buffer and
+                     start its H2D transfer (jax.device_put is async)
+  step thread:       dispatch the fused step for batch N in submission
+                     order (state donation serializes execution anyway)
+
+Throughput becomes max(host_stage_time, device_step_time) instead of their
+sum. With 2+ stagers, pack of batch N+2 also overlaps the (possibly
+synchronous, on tunneled runtimes) transfer of batch N+1.
+
+Ordering: steps are dispatched strictly in submission order (sequence
+numbers; the step thread waits for the next sequence), so per-device event
+order — the bus's per-key ordering contract — is preserved even though
+stagers pack concurrently.
+"""
+
+from __future__ import annotations
+
+import heapq
+import queue
+import threading
+from typing import List, Optional
+
+import jax
+import numpy as np
+
+from sitewhere_tpu.ops.pack import EventBatch, batch_to_blob
+
+
+class StepFuture:
+    """Result handle for one pipelined submit."""
+
+    __slots__ = ("_event", "_outputs", "_error")
+
+    def __init__(self):
+        self._event = threading.Event()
+        self._outputs = None
+        self._error: Optional[BaseException] = None
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: Optional[float] = None):
+        """The step's ProcessOutputs (dispatch-complete, not necessarily
+        device-complete — block_until_ready a field for that)."""
+        if not self._event.wait(timeout):
+            raise TimeoutError("step not dispatched within timeout")
+        if self._error is not None:
+            raise self._error
+        return self._outputs
+
+    def _resolve(self, outputs=None, error: Optional[BaseException] = None):
+        self._outputs = outputs
+        self._error = error
+        self._event.set()
+
+
+class PipelinedSubmitter:
+    """Stage-ahead feeder for a PipelineEngine.
+
+    `submit(batch)` enqueues and returns a StepFuture immediately (blocking
+    only when `depth` batches are already in flight — natural backpressure).
+    `stagers` host threads pack + device_put ahead; one step thread
+    dispatches in order. Call `flush()` to drain and get the last outputs,
+    `close()` to stop the threads.
+
+    Works with the single-chip PipelineEngine (the sharded engine's
+    submit() already overlaps routing with the previous step's execution
+    because dispatch is async; its host routing is a single fused native
+    pass — see parallel/router.py route_batch).
+    """
+
+    def __init__(self, engine, depth: int = 3, stagers: int = 2):
+        self.engine = engine
+        self.depth = max(1, depth)
+        self._in: "queue.Queue" = queue.Queue(maxsize=self.depth)
+        self._ready_lock = threading.Condition()
+        self._ready: List = []          # heap of (seq, blob, n, future)
+        self._next_seq = 0              # next sequence to assign
+        self._next_step = 0             # next sequence to dispatch
+        self._dispatched = 0            # steps whose dispatch has RETURNED
+        self._stop = threading.Event()
+        self._stagers = [
+            threading.Thread(target=self._stage_loop, name=f"feed-stage-{i}",
+                             daemon=True)
+            for i in range(max(1, stagers))]
+        self._step_thread = threading.Thread(target=self._step_loop,
+                                             name="feed-step", daemon=True)
+        for t in self._stagers:
+            t.start()
+        self._step_thread.start()
+
+    # -- producer ----------------------------------------------------------
+    def submit(self, batch: EventBatch) -> StepFuture:
+        fut = StepFuture()
+        self._in.put((self._alloc_seq(), batch, fut))
+        return fut
+
+    def _alloc_seq(self) -> int:
+        with self._ready_lock:
+            seq = self._next_seq
+            self._next_seq += 1
+            return seq
+
+    # -- stager ------------------------------------------------------------
+    def _stage_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                seq, batch, fut = self._in.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            # Bound the staged-ahead window: without this the ready heap
+            # (and its device-resident blobs) would grow without limit
+            # whenever staging outpaces dispatch, and a staging-ring slot
+            # could be repacked while its H2D copy was still in flight.
+            # With the wait, at most depth staged-undispatched + one
+            # in-stage per stager exist at any moment (the engine's ring
+            # of 6 covers depth 3 + 2 stagers with margin).
+            with self._ready_lock:
+                while (not self._stop.is_set()
+                       and seq - self._next_step > self.depth):
+                    self._ready_lock.wait(timeout=0.1)
+            if self._stop.is_set():
+                fut._resolve(error=RuntimeError("submitter closed"))
+                continue
+            try:
+                blob = batch_to_blob(
+                    batch, out=self.engine._staging_blob_buffer(batch))
+                n = int(np.asarray(batch.valid).sum())
+                # start the H2D transfer now; on async runtimes this
+                # overlaps both other stagers' packs and device compute
+                dev_blob = jax.device_put(blob)
+                # ring-slot guard: the transferred array itself becomes
+                # ready exactly when the DMA stops reading `blob`
+                self.engine._note_blob_guard(blob, dev_blob)
+                item = (seq, dev_blob, n, fut, None)
+            except BaseException as exc:  # surface through the future
+                item = (seq, None, 0, fut, exc)
+            with self._ready_lock:
+                heapq.heappush(self._ready, item)
+                self._ready_lock.notify_all()
+
+    # -- step dispatcher ---------------------------------------------------
+    def _step_loop(self) -> None:
+        from collections import deque
+
+        executing: deque = deque()
+        while not self._stop.is_set():
+            with self._ready_lock:
+                while not (self._ready
+                           and self._ready[0][0] == self._next_step):
+                    if self._stop.is_set():
+                        return
+                    self._ready_lock.wait(timeout=0.1)
+                seq, dev_blob, n, fut, exc = heapq.heappop(self._ready)
+                self._next_step += 1
+            outputs = None
+            try:
+                if exc is None:
+                    outputs = self.engine.submit_blob(dev_blob, n_events=n)
+            except BaseException as step_exc:
+                exc = step_exc
+            finally:
+                with self._ready_lock:
+                    self._dispatched += 1
+                    self._ready_lock.notify_all()
+            if outputs is None:
+                fut._resolve(error=exc)
+                continue
+            fut._resolve(outputs)
+            # bound the device-side queue to `depth` in-flight steps:
+            # keeps memory bounded AND guarantees a staging-ring slot's
+            # H2D transfer finished before a stager can recycle it
+            # (step N executed => its input was consumed)
+            executing.append(outputs.processed)
+            if len(executing) > self.depth:
+                try:
+                    executing.popleft().block_until_ready()
+                except Exception:
+                    pass  # a failed earlier step already surfaced there
+
+    # -- draining ----------------------------------------------------------
+    def flush(self, timeout: Optional[float] = 60.0) -> None:
+        """Wait until every submitted batch's dispatch has RETURNED (so a
+        direct engine.submit() afterwards cannot overtake a pipelined
+        batch). Keep the StepFuture of your last submit if you need its
+        outputs."""
+        import time as _time
+
+        deadline = None if timeout is None else _time.monotonic() + timeout
+        with self._ready_lock:
+            target = self._next_seq
+            while self._dispatched < target:
+                remaining = (None if deadline is None
+                             else deadline - _time.monotonic())
+                if remaining is not None and remaining <= 0:
+                    raise TimeoutError("pipelined flush timed out")
+                self._ready_lock.wait(timeout=0.05 if remaining is None
+                                      else min(0.05, remaining))
+
+    def close(self) -> None:
+        self._stop.set()
+        with self._ready_lock:
+            self._ready_lock.notify_all()
+        for t in self._stagers:
+            t.join(timeout=5.0)
+        self._step_thread.join(timeout=5.0)
+        # resolve anything still queued or staged so no caller blocks
+        # forever on a future the stopped threads will never touch
+        leftovers = []
+        while True:
+            try:
+                leftovers.append(self._in.get_nowait())
+            except queue.Empty:
+                break
+        with self._ready_lock:
+            while self._ready:
+                leftovers.append(heapq.heappop(self._ready))
+        for item in leftovers:
+            fut = item[2] if len(item) == 3 else item[3]
+            if not fut.done():
+                fut._resolve(error=RuntimeError("submitter closed"))
