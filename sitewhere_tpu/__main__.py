@@ -44,10 +44,11 @@ def _build_config(config_path: Optional[str]):
     return Configuration(defaults=DEFAULTS, config_path=config_path)
 
 
-def _build_instance(cfg):
+def _build_instance(cfg, mesh=None):
     from sitewhere_tpu.instance import SiteWhereInstance
 
     return SiteWhereInstance(
+        mesh=mesh,
         instance_id=cfg.get("instance.id"),
         data_dir=cfg.get("persist.data_dir"),
         enable_pipeline=bool(cfg.get("pipeline.enabled")),
@@ -68,6 +69,21 @@ def _build_instance(cfg):
             else None))
 
 
+def _parse_peers(spec: Optional[str]) -> dict:
+    """'0=hostA:9092,1=hostB:9092' -> {0: ("hostA", 9092), ...}."""
+    out = {}
+    if not spec:
+        return out
+    for part in str(spec).split(","):
+        part = part.strip()
+        if not part:
+            continue
+        pid, _, addr = part.partition("=")
+        host, _, port = addr.rpartition(":")
+        out[int(pid)] = (host, int(port))
+    return out
+
+
 def cmd_serve(args) -> int:
     from sitewhere_tpu.runtime.busnet import BusServer
     from sitewhere_tpu.web.server import RestServer
@@ -86,6 +102,17 @@ def cmd_serve(args) -> int:
         cfg.set("pipeline.enabled", False)
     if args.bus_port is not None:
         cfg.set("bus.edge_port", args.bus_port)
+    for flag, key in (("cluster_coordinator", "cluster.coordinator"),
+                      ("cluster_num_processes", "cluster.num_processes"),
+                      ("cluster_process_id", "cluster.process_id"),
+                      ("cluster_peers", "cluster.peers")):
+        value = getattr(args, flag, None)
+        if value is not None:
+            cfg.set(key, value)
+
+    coordinator = cfg.get("cluster.coordinator")
+    if coordinator:
+        return _serve_cluster(cfg)
 
     instance = _build_instance(cfg)
     instance.start()
@@ -123,6 +150,67 @@ def cmd_serve(args) -> int:
             bus_server.stop()
         rest.stop()
         instance.stop()
+    return 0
+
+
+def _serve_cluster(cfg) -> int:
+    """Boot one host of an N-process instance: join the jax.distributed
+    cluster, build the instance over the GLOBAL mesh, and compose the
+    cluster services (lockstep step loop, busnet edge, foreign-row
+    forwarding, heartbeats/topology, peer watchdog) around it
+    (parallel/cluster.py; reference boot: Microservice.java:182-236)."""
+    from sitewhere_tpu.parallel.cluster import ClusterService
+    from sitewhere_tpu.parallel.distributed import (
+        initialize, make_global_mesh)
+    from sitewhere_tpu.web.server import RestServer
+
+    process_id = int(cfg.get("cluster.process_id"))
+    num_processes = int(cfg.get("cluster.num_processes"))
+    initialize(coordinator_address=cfg.get("cluster.coordinator"),
+               num_processes=num_processes, process_id=process_id)
+    mesh = make_global_mesh()
+    instance = _build_instance(cfg, mesh=mesh)
+    peers = _parse_peers(cfg.get("cluster.peers"))
+    edge_port = cfg.get("bus.edge_port")
+    cluster = ClusterService(
+        instance, process_id, num_processes,
+        peer_bus_addrs=peers,
+        bus_host=cfg.get("api.host"),
+        bus_port=int(edge_port) if edge_port is not None else 0,
+        heartbeat_s=float(cfg.get("cluster.heartbeat_s")),
+        stale_after_s=float(cfg.get("cluster.stale_after_s")),
+        fail_after_s=float(cfg.get("cluster.fail_after_s")),
+        presence_every_ticks=int(cfg.get("cluster.presence_every_ticks")),
+        exit_on_peer_loss=bool(cfg.get("cluster.exit_on_peer_loss")),
+        peer_loss_exit_code=int(cfg.get("cluster.peer_loss_exit_code")))
+    cluster.start()
+    rest = RestServer(instance, host=cfg.get("api.host"),
+                      port=int(cfg.get("api.port")),
+                      token_expiration_minutes=int(
+                          cfg.get("api.jwt_expiration_min")))
+    rest.start()
+
+    print(f"sitewhere-tpu cluster host {process_id}/{num_processes} "
+          f"instance '{instance.instance_id}' serving")
+    print(f"  REST gateway : {rest.base_url}")
+    print(f"  bus edge     : tcp://{cfg.get('api.host')}:{cluster.bus_port}")
+    print(f"  mesh         : {mesh.devices.size} shards over "
+          f"{num_processes} hosts", flush=True)
+
+    stop = threading.Event()
+
+    def _sig(_signum, _frame):
+        stop.set()
+
+    signal.signal(signal.SIGINT, _sig)
+    signal.signal(signal.SIGTERM, _sig)
+    try:
+        while not stop.wait(1.0):
+            if cluster.loop.fatal is not None:
+                return 1
+    finally:
+        rest.stop()
+        cluster.stop()
     return 0
 
 
@@ -197,6 +285,15 @@ def main(argv=None) -> int:
                        help="control plane only (no device engine)")
     serve.add_argument("--bus-port", type=int,
                        help="expose the event bus on TCP for edge processes")
+    serve.add_argument("--cluster-coordinator",
+                       help="jax.distributed coordinator host:port — "
+                            "enables multi-host cluster mode")
+    serve.add_argument("--cluster-num-processes", type=int,
+                       help="total processes in the cluster")
+    serve.add_argument("--cluster-process-id", type=int,
+                       help="this process's id (0..N-1)")
+    serve.add_argument("--cluster-peers",
+                       help="peer bus edges: '0=hostA:9092,1=hostB:9092'")
     serve.set_defaults(fn=cmd_serve)
 
     openapi = sub.add_parser("openapi", help="print the OpenAPI document")

@@ -52,11 +52,16 @@ class SiteWhereInstance(LifecycleComponent):
                  admin_username: str = "admin",
                  admin_password: str = "password",
                  shards: int = 1,
+                 mesh=None,
                  tenant_datastores: Optional[Dict] = None,
                  checkpoint_interval_s: Optional[float] = None):
         super().__init__(f"instance:{instance_id}")
         self.instance_id = instance_id
         self.data_dir = data_dir
+        # multi-host deployment hooks (parallel/cluster.py ClusterService
+        # installs itself here BEFORE start(); tenant engines pass it into
+        # their inbound processors for ownership routing + lockstep feeds)
+        self.cluster_hooks = None
         self.naming = TopicNaming(instance=instance_id)
         self.metrics = GLOBAL_METRICS
 
@@ -80,13 +85,17 @@ class SiteWhereInstance(LifecycleComponent):
             self.registry_tensors = RegistryTensors(
                 max_devices=max_devices, max_zones=max_zones,
                 max_zone_vertices=max_zone_vertices)
-            if shards > 1:
+            if shards > 1 or mesh is not None:
                 # SPMD hot path over a device mesh (config model's
-                # pipeline.shards; parallel/engine.py)
+                # pipeline.shards; parallel/engine.py). An explicit `mesh`
+                # (e.g. parallel.distributed.make_global_mesh() under
+                # jax.distributed) overrides the local shard count — the
+                # multi-host serve mode passes the global mesh here.
                 from sitewhere_tpu.parallel import (
                     ShardedPipelineEngine, make_mesh)
                 self.pipeline_engine = ShardedPipelineEngine(
-                    self.registry_tensors, mesh=make_mesh(shards),
+                    self.registry_tensors,
+                    mesh=mesh if mesh is not None else make_mesh(shards),
                     per_shard_batch=batch_size,
                     measurement_slots=measurement_slots,
                     max_tenants=max_tenants)
@@ -170,7 +179,8 @@ class SiteWhereInstance(LifecycleComponent):
             tenant, self.bus, self.datastores.event_log_for(tenant),
             pipeline_engine=self.pipeline_engine,
             registry_tensors=self.registry_tensors,
-            store_factory=store_factory, naming=self.naming)
+            store_factory=store_factory, naming=self.naming,
+            cluster=self.cluster_hooks)
         self.bootstrap.apply_template(engine)
         return engine
 
@@ -219,10 +229,17 @@ class SiteWhereInstance(LifecycleComponent):
             engines = {tok: eng.status.name
                        for tok, eng in self.engine_manager.engines.items()}
             failed = dict(self.engine_manager.failed)
-        return {
+        out = {
             "instance_id": self.instance_id,
             "status": self.status.name,
             "pipeline_enabled": self.pipeline_engine is not None,
             "tenant_engines": engines,
             "failed_tenant_engines": failed,
         }
+        if self.cluster_hooks is not None:
+            # multi-host deployment: per-process heartbeat states with
+            # liveness (reference: TopologyStateAggregator.java)
+            out["processes"] = self.cluster_hooks.processes()
+            out["process_id"] = self.cluster_hooks.process_id
+            out["degraded_peers"] = list(self.cluster_hooks.degraded)
+        return out

@@ -56,7 +56,7 @@ class TenantEngine(LifecycleComponent):
 
     def __init__(self, tenant: Tenant, bus, log, pipeline_engine=None,
                  registry_tensors=None, store_factory: Optional[Callable] = None,
-                 naming: Optional[TopicNaming] = None):
+                 naming: Optional[TopicNaming] = None, cluster=None):
         super().__init__(f"tenant-engine:{tenant.token}")
         self.tenant = tenant
         self.tenant_id = tenant.token
@@ -80,10 +80,12 @@ class TenantEngine(LifecycleComponent):
         EventPersistenceTriggers(bus, self.naming,
                                  tenant.token).attach(self.event_management)
 
-        # pipeline services
+        # pipeline services (cluster hooks route foreign-owned records to
+        # their owner host and feed the lockstep step loop — cluster.py)
         self.inbound = InboundProcessingService(
             bus, self.registry, events=self.event_management,
-            engine=pipeline_engine, tenant=tenant.token, naming=self.naming)
+            engine=pipeline_engine, tenant=tenant.token, naming=self.naming,
+            cluster=cluster)
         self.enrichment = PayloadEnrichment(bus, self.registry, tenant.token,
                                             self.naming)
         self.command_delivery = CommandDeliveryService(

@@ -44,6 +44,33 @@ def _tree_specs(tree, spec):
     return jax.tree_util.tree_map(lambda _: spec, tree)
 
 
+def _put_global(value: np.ndarray, sharding: NamedSharding):
+    """Place a host value onto a (possibly multi-host) sharding using ONLY
+    local single-device transfers.
+
+    jax 0.9's device_put supports cross-host placements by lowering them
+    to COLLECTIVE transfers — under multi-controller that would have to
+    run in lockstep on every process, but params/state refreshes fire at
+    different ticks per host (registry versions bump independently), which
+    desyncs the collective order and aborts the whole cluster (observed:
+    gloo 'Received data size doesn't match expected size'). Every process
+    holds the full host value here, so per-device local placement is
+    always possible and never communicates."""
+    value = np.asarray(value)
+    shards = [
+        jax.device_put(value[index], device)
+        for device, index in sharding.addressable_devices_indices_map(
+            value.shape).items()]
+    return jax.make_array_from_single_device_arrays(
+        value.shape, sharding, shards)
+
+
+def _put_global_tree(tree, sharding_tree):
+    return jax.tree_util.tree_map(
+        lambda value, sharding: _put_global(value, sharding),
+        tree, sharding_tree)
+
+
 class RoutedBlobView:
     """Lazy routed-batch handle returned by ShardedPipelineEngine.submit:
     the staged wire blob IS the data; EventBatch columns unpack on first
@@ -185,8 +212,7 @@ class ShardedPipelineEngine(PipelineEngine):
             lambda a: np.ascontiguousarray(
                 np.broadcast_to(a, (S,) + a.shape)), local)
         shard0 = NamedSharding(self.mesh, P(SHARD_AXIS))
-        self._state = jax.device_put(
-            stacked, _tree_specs(stacked, shard0))
+        self._state = _put_global_tree(stacked, _tree_specs(stacked, shard0))
         self._refresh_params()
         self._build_step()
 
@@ -271,7 +297,7 @@ class ShardedPipelineEngine(PipelineEngine):
             threshold=_tree_specs(threshold, rep),
             zones=_tree_specs(zones, rep),
             geofence=_tree_specs(geofence, rep))
-        self._params = jax.device_put(params, shardings)
+        self._params = _put_global_tree(params, shardings)
         self._params_built_for = (snap.version, self._rules_version)
 
     # -- processing -----------------------------------------------------------
@@ -301,9 +327,23 @@ class ShardedPipelineEngine(PipelineEngine):
         # the routed EventBatch view is derived by cheap numpy bit-ops only
         # for materialization.
         routed_blob, over_rows = self.router.route_batch(batch)
-        routed_batch, outputs = self._one_step(params, routed_blob)
+        try:
+            routed_batch, outputs = self._one_step(params, routed_blob)
+        except BaseException:
+            # transfer state unknown mid-failure: drop the loaned buffer
+            # from the pool instead of leaking it (or recycling a
+            # possibly-in-DMA one)
+            self.router.discard_staging_buffer(routed_blob)
+            raise
         self._overflow = self._slice_flat(batch, over_rows)
-        while (self._overflow is not None
+        # Multi-process lockstep: every host must launch the SAME number of
+        # collective programs per submit — extra drain steps on one host
+        # would pair its psums with a peer's NEXT step (undefined). The
+        # cluster step loop applies backpressure instead (it stops pulling
+        # new work while pending_overflow exceeds the bound, so the
+        # backlog drains one lockstep tick at a time).
+        while (not self.is_multiprocess
+               and self._overflow is not None
                and int(self._overflow.valid.sum()) > self.max_overflow_events):
             # the caller only sees the LAST step; materialize the alerts of
             # the step that is about to be superseded so they aren't lost
@@ -589,7 +629,7 @@ class ShardedPipelineEngine(PipelineEngine):
         stacked_state = DeviceStateTensors(**out)
         shard0 = NamedSharding(self.mesh, P(SHARD_AXIS))
         with self._state_lock:
-            self._state = jax.device_put(
+            self._state = _put_global_tree(
                 stacked_state, _tree_specs(stacked_state, shard0))
 
     def set_state(self, state: DeviceStateTensors) -> None:
@@ -599,6 +639,68 @@ class ShardedPipelineEngine(PipelineEngine):
         raise TypeError(
             "ShardedPipelineEngine state is mesh-resident; restore flat "
             "canonical snapshots via load_canonical_state()")
+
+    # -- per-host shard checkpoint layout (multi-host gang restart) --------
+
+    def local_state_shards(self):
+        """(local shard ids, {field: [S_local, ...] blocks}) — THIS host's
+        slice of the device state, read via addressable shards only (pure
+        local D2H; no collective, so any host checkpoints at any time
+        without lockstep). The per-host complement of canonical_state:
+        each host of a gang-restarting cluster saves its own blocks and
+        restores them onto the SAME topology (elastic any-mesh restores
+        stay the single-controller canonical layout's job)."""
+        import dataclasses as _dc
+
+        with self._state_lock:
+            state = self._state
+            blocks = {}
+            for f in _dc.fields(state):
+                arr = getattr(state, f.name)
+                if self.is_multiprocess:
+                    blocks[f.name] = self._gather_local(arr)
+                else:
+                    blocks[f.name] = np.asarray(arr)
+        return list(self.local_shards), blocks
+
+    def load_local_state_shards(self, shard_ids, blocks) -> None:
+        """Inverse of local_state_shards on the same mesh topology: place
+        this host's blocks back onto its local devices
+        (make_array_from_process_local_data — local transfers only)."""
+        import dataclasses as _dc
+
+        if list(shard_ids) != list(self.local_shards):
+            raise ValueError(
+                f"host-shard checkpoint was taken for shards {shard_ids}; "
+                f"this process owns {self.local_shards} — per-host "
+                f"checkpoints restore onto the same cluster topology only "
+                f"(use a single-controller canonical checkpoint to change "
+                f"topology)")
+        shard0 = NamedSharding(self.mesh, P(SHARD_AXIS))
+        out = {}
+        for f in _dc.fields(DeviceStateTensors):
+            local = np.ascontiguousarray(blocks[f.name])
+            expect = getattr(self._state, f.name).shape
+            global_shape = (self.n_shards,) + tuple(local.shape[1:])
+            if tuple(global_shape) != tuple(expect):
+                raise ValueError(
+                    f"host-shard checkpoint field {f.name}: global shape "
+                    f"{global_shape} != engine {tuple(expect)}")
+            if self.is_multiprocess:
+                out[f.name] = jax.make_array_from_process_local_data(
+                    shard0, local, global_shape)
+            else:
+                out[f.name] = jax.device_put(local, shard0)
+        with self._state_lock:
+            self._state = DeviceStateTensors(**out)
+
+    def pending_overflow_batch(self) -> Optional[EventBatch]:
+        """The parked overflow rows as a flat host batch (checkpoint saves
+        them verbatim when draining is impossible — multi-host lockstep)."""
+        return self._overflow
+
+    def set_pending_overflow_batch(self, batch: Optional[EventBatch]) -> None:
+        self._overflow = batch
 
     def drain_pending(self) -> int:
         """Fold any parked overflow backlog into device state (empty-batch
@@ -613,6 +715,14 @@ class ShardedPipelineEngine(PipelineEngine):
         run."""
         from sitewhere_tpu.ops.pack import empty_batch
 
+        if self.is_multiprocess:
+            # a host-local drain loop would run a varying number of
+            # collective steps per host (lockstep violation); the cluster
+            # checkpoint instead snapshots the pending overflow batch
+            # itself (parallel/cluster.py checkpoint path)
+            raise RuntimeError(
+                "drain_pending is single-controller only; multi-host "
+                "checkpoints persist the overflow batch in the manifest")
         steps = 0
         while self.pending_overflow > 0:
             routed, outputs = self.submit(empty_batch(1))

@@ -130,6 +130,21 @@ class ShardRouter:
             if len(self._pool) < self.staging_ring:
                 self._pool.append((buf, guard))
 
+    def discard_staging_buffer(self, buf: np.ndarray) -> None:
+        """Error-path drop of a loaned blob whose transfer state is
+        unknown (e.g. a step dispatch failed mid-flight): untrack it so a
+        future allocation replaces it — never shrink the pool permanently,
+        never recycle a possibly-in-DMA buffer."""
+        if self.staging_ring <= 0 or self._pool_lock is None:
+            return
+        from sitewhere_tpu.ops.pack import WIRE_ROWS
+
+        if buf.shape != (self.n_shards, WIRE_ROWS, self.per_shard_batch):
+            return
+        with self._pool_lock:
+            if self._pool_total > 0:
+                self._pool_total -= 1
+
     def route_batch(self, batch: EventBatch
                     ) -> Tuple[np.ndarray, np.ndarray]:
         """Fused pack+route: flat EventBatch columns -> ([S, WIRE_ROWS, B]
@@ -145,13 +160,16 @@ class ShardRouter:
         from sitewhere_tpu.ops.pack import batch_to_blob
 
         if native.available():
+            out = self._staging_buffer()
             res = native.pack_route_blob(batch, self.n_shards,
-                                         self.per_shard_batch,
-                                         out=self._staging_buffer())
+                                         self.per_shard_batch, out=out)
             if res is not None:
                 return res
-            # device_idx out of wire range: the numpy pack raises the
+            # device_idx out of wire range: the buffer never reached jax,
+            # so hand it straight back, then let the numpy pack raise the
             # single shared diagnostic with min/max detail
+            if out is not None:
+                self.release_staging_buffer(out)
             batch_to_blob(batch)
             raise AssertionError("unreachable: numpy pack must have raised")
         return self.route_blob(batch_to_blob(batch))

@@ -86,19 +86,41 @@ class PipelineCheckpointer:
             f"{g.topic.name}@{g.group_id}": list(g.committed)
             for g in consumer_groups or []
         }
-        # parked shard-overflow rows must fold into state before the
-        # snapshot: their bus offsets may already be committed, and a
-        # snapshot without them would break the offsets<=state invariant
-        drain = getattr(engine, "drain_pending", None)
-        if drain is not None:
-            drain()
-        # canonical flat layout: topology-independent, so a checkpoint
-        # taken on an N-shard mesh restores onto any other mesh size
-        state = engine.canonical_state()
-        arrays = {
-            f"state.{f.name}": np.asarray(getattr(state, f.name))
-            for f in dataclasses.fields(state)
-        }
+        multihost = bool(getattr(engine, "is_multiprocess", False))
+        layout: Dict[str, Any] = {}
+        if multihost:
+            # Per-HOST shard layout (gang-restart recovery): draining the
+            # overflow would run a host-local number of collective steps
+            # (lockstep violation), so the parked overflow batch is saved
+            # VERBATIM instead — its bus offsets may already be committed,
+            # and restoring it preserves the offsets<=state invariant.
+            # Restores onto the SAME cluster topology only.
+            shard_ids, blocks = engine.local_state_shards()
+            arrays = {f"state.{name}": np.asarray(block)
+                      for name, block in blocks.items()}
+            overflow = engine.pending_overflow_batch()
+            if overflow is not None:
+                for f in dataclasses.fields(overflow):
+                    arrays[f"overflow.{f.name}"] = np.asarray(
+                        getattr(overflow, f.name))
+            layout = {"layout": "host-shards", "shard_ids": list(shard_ids),
+                      "n_shards": engine.n_shards,
+                      "process_id": jax.process_index()}
+        else:
+            # parked shard-overflow rows must fold into state before the
+            # snapshot: their bus offsets may already be committed, and a
+            # snapshot without them would break the offsets<=state
+            # invariant
+            drain = getattr(engine, "drain_pending", None)
+            if drain is not None:
+                drain()
+            # canonical flat layout: topology-independent, so a checkpoint
+            # taken on an N-shard mesh restores onto any other mesh size
+            state = engine.canonical_state()
+            arrays = {
+                f"state.{f.name}": np.asarray(getattr(state, f.name))
+                for f in dataclasses.fields(state)
+            }
         packer = engine.packer
         manifest: Dict[str, Any] = {
             "epoch_base_ms": packer.epoch_base_ms,
@@ -117,6 +139,7 @@ class PipelineCheckpointer:
             # duplicate — at-least-once, like everything else).
             "pending_alerts": [_asdict(a) for a in
                                getattr(engine, "_pending_alerts", [])],
+            **layout,
         }
         seq = self._next_seq()
         final = os.path.join(self.directory, f"ckpt-{seq:08d}")
@@ -154,14 +177,27 @@ class PipelineCheckpointer:
         path = path or self.latest()
         if path is None:
             return {}
+        with open(os.path.join(path, "manifest.json"), encoding="utf-8") as fh:
+            manifest = json.load(fh)
         with np.load(os.path.join(path, "state.npz")) as data:
             kwargs = {
                 f.name: np.asarray(data[f"state.{f.name}"])
                 for f in dataclasses.fields(DeviceStateTensors)
             }
-        engine.load_canonical_state(DeviceStateTensors(**kwargs))
-        with open(os.path.join(path, "manifest.json"), encoding="utf-8") as fh:
-            manifest = json.load(fh)
+            overflow_cols = {
+                key[len("overflow."):]: np.asarray(data[key])
+                for key in data.files if key.startswith("overflow.")
+            }
+        if manifest.get("layout") == "host-shards":
+            # per-host gang-restart checkpoint: same-topology restore of
+            # this host's shard blocks + the verbatim overflow batch
+            engine.load_local_state_shards(manifest["shard_ids"], kwargs)
+            if overflow_cols:
+                from sitewhere_tpu.ops.pack import EventBatch
+
+                engine.set_pending_overflow_batch(EventBatch(**overflow_cols))
+        else:
+            engine.load_canonical_state(DeviceStateTensors(**kwargs))
         packer = engine.packer
         packer.epoch_base_ms = manifest["epoch_base_ms"]
         packer.devices.restore(manifest["interners"]["devices"])
@@ -258,6 +294,14 @@ class InstanceCheckpointManager:
                 tenant.token)
             groups.append(self.instance.bus.consumer(
                 topic, f"inbound-processing-{tenant.token}"))
+        if getattr(self.instance, "cluster_hooks", None) is not None:
+            # the forwarded foreign-rows consumer also advances device
+            # state; capture its cursor so restore replays only the gap
+            from sitewhere_tpu.parallel.cluster import foreign_rows_topic
+
+            groups.append(self.instance.bus.consumer(
+                foreign_rows_topic(self.instance.naming),
+                "cluster-foreign-rows"))
         return groups
 
     def save(self) -> str:

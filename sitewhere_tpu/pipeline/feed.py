@@ -28,6 +28,7 @@ from __future__ import annotations
 import heapq
 import queue
 import threading
+import time
 from typing import List, Optional
 
 import jax
@@ -102,8 +103,18 @@ class PipelinedSubmitter:
     # -- producer ----------------------------------------------------------
     def submit(self, batch: EventBatch) -> StepFuture:
         fut = StepFuture()
-        self._in.put((self._alloc_seq(), batch, fut))
-        return fut
+        item = (self._alloc_seq(), batch, fut)
+        # bounded-blocking put that re-checks closure: a producer parked in
+        # a plain put() could slip its item into the queue AFTER close()
+        # drained it, leaving the future unresolved forever
+        while True:
+            if self._stop.is_set():
+                raise RuntimeError("submitter closed")
+            try:
+                self._in.put(item, timeout=0.1)
+                return fut
+            except queue.Full:
+                continue
 
     def _alloc_seq(self) -> int:
         with self._ready_lock:
@@ -214,6 +225,10 @@ class PipelinedSubmitter:
         for t in self._stagers:
             t.join(timeout=5.0)
         self._step_thread.join(timeout=5.0)
+        # a producer looping in submit() observes _stop within its 0.1 s
+        # put timeout; wait that window out so its item either landed (and
+        # drains below) or its submit raised — then nothing can enqueue
+        time.sleep(0.15)
         # resolve anything still queued or staged so no caller blocks
         # forever on a future the stopped threads will never touch
         leftovers = []

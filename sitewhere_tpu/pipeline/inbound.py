@@ -60,6 +60,7 @@ class InboundProcessingService(LifecycleComponent):
                  tenant: str = "default",
                  naming: Optional[TopicNaming] = None,
                  persist_rule_alerts: bool = True,
+                 cluster=None,
                  metrics: Optional[MetricsRegistry] = None):
         super().__init__(f"inbound-processing:{tenant}")
         self.bus = bus
@@ -69,6 +70,10 @@ class InboundProcessingService(LifecycleComponent):
         self.tenant = tenant
         self.naming = naming or TopicNaming()
         self.persist_rule_alerts = persist_rule_alerts
+        # multi-host hooks (parallel/cluster.py ClusterService): ownership
+        # routing of decoded records + lockstep step-loop feeding. None =
+        # single-process (direct engine submit).
+        self.cluster = cluster
         m = (metrics or MetricsRegistry()).scoped("inbound")
         self.processed_meter = m.meter("processed")
         self.unregistered_counter = m.counter("unregistered")
@@ -88,6 +93,7 @@ class InboundProcessingService(LifecycleComponent):
         """One consumer batch end-to-end. Public so replay/tests can drive
         it synchronously without the poll thread."""
         hot: List[Tuple[DeviceEvent, str]] = []
+        forward: Dict[int, List[Record]] = {}
         for record in records:
             try:
                 data = msgpack.unpackb(record.value, raw=False)
@@ -97,13 +103,47 @@ class InboundProcessingService(LifecycleComponent):
             except Exception:
                 self.failed_counter.inc()
                 continue
+            if self.cluster is not None:
+                # ownership routing (multi-host): records for devices whose
+                # shard lives on another host forward BEFORE persist — the
+                # owner persists + steps its own devices, so event log and
+                # device state agree on ownership (the Kafka analog: the
+                # record key routes to the owning consumer)
+                owner = self.cluster.owner_process(token)
+                if owner != self.cluster.process_id:
+                    if data.get("fwdFrom") is not None:
+                        # already forwarded once and this host STILL does
+                        # not own it: the hosts' registries disagree
+                        # (provisioning drift) — park it, never ping-pong
+                        self.failed_counter.inc()
+                        self.bus.publish(
+                            self.naming.event_source_failed_decode_events(
+                                self.tenant),
+                            token.encode(), record.value)
+                        continue
+                    forward.setdefault(owner, []).append(record)
+                    continue
             if not self._validate(token, record):
                 continue
             persisted = self._persist(token, events)
             for event in persisted:
                 hot.append((event, token))
             self.processed_meter.mark(len(persisted))
-        if self.engine is not None and hot:
+        if forward:
+            # raises on delivery failure -> the whole batch redelivers
+            # (at-least-once; locally-persisted records may duplicate,
+            # which the model's idempotent event ids tolerate)
+            self.cluster.forward_decoded(forward, self.tenant)
+        if self.cluster is not None and hot:
+            # lockstep feeding: queue for the cluster step loop and wait
+            # for the fold ticket so the consumer commit happens only
+            # after the rows reached device state (or were forwarded)
+            for ticket in self.cluster.feed_hot([e for e, _ in hot],
+                                                [t for _, t in hot]):
+                if not ticket.wait(timeout=60.0):
+                    raise TimeoutError(
+                        "cluster step loop did not fold batch in 60s")
+        elif self.engine is not None and hot:
             # Never let the hot path poison the consumer: a raising handler
             # would redeliver the batch and re-persist duplicates forever.
             try:

@@ -186,6 +186,17 @@ class RegistryTensors:
 
     # -- reads ----------------------------------------------------------------
 
+    def tenant_of_device(self, token: str) -> Optional[str]:
+        """Tenant token owning a device token (host-side reverse lookup —
+        the cluster alert-persistence path resolves which tenant engine's
+        event management stores a rule-fired alert)."""
+        idx = self.devices.lookup(token)
+        if idx <= 0:
+            return None
+        with self._lock:
+            tenant_idx = int(self._tenant_idx[idx])
+        return self.tenants.token_of(tenant_idx)
+
     @property
     def version(self) -> int:
         return self._version

@@ -187,4 +187,22 @@ DEFAULTS: Dict = {
     "api": {"host": "127.0.0.1", "port": 8080, "jwt_secret": "change-me",
             "jwt_expiration_min": 600},
     "mesh": {"shards": 1},
+    # multi-host deployment (parallel/cluster.py): N OS processes form one
+    # jax.distributed mesh; `coordinator` ("host:port") turns it on.
+    # `peers` maps process id -> that host's bus-edge address
+    # ("0=hostA:9092,1=hostB:9092").
+    "cluster": {
+        "coordinator": None,
+        "num_processes": 1,
+        "process_id": 0,
+        "peers": None,
+        "heartbeat_s": 1.0,
+        "stale_after_s": 5.0,
+        "fail_after_s": 15.0,
+        "presence_every_ticks": 0,
+        # a stale peer (or step-loop fatal) exits the process for the
+        # supervisor to restart the gang — the TPU pod failure model
+        "exit_on_peer_loss": True,
+        "peer_loss_exit_code": 13,
+    },
 }
