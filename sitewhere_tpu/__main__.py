@@ -268,6 +268,48 @@ def cmd_version(_args) -> int:
     return 0
 
 
+def cmd_deadletters(args) -> int:
+    """Operator loop over parked records on a RUNNING instance (REST):
+    list backlogs, inspect records, replay into the reprocess pipeline
+    (runtime/deadletter.py; reference: inbound-reprocess-events,
+    KafkaTopicNaming.java:48-69)."""
+    from sitewhere_tpu.client.rest import SiteWhereClient
+
+    client = SiteWhereClient(args.url)
+    client.authenticate(args.username, args.password)
+    if args.action == "list":
+        topics = client.get("/api/instance/deadletters")["topics"]
+        if not topics:
+            print("no parked records")
+            return 0
+        for t in topics:
+            print(f"{t['topic']}\n  records={t['records']} "
+                  f"backlog={t['replayBacklog']} -> {t['replayTarget']}")
+        return 0
+    if not args.topic:
+        print("error: --topic required for this action", file=sys.stderr)
+        return 2
+    if args.action == "show":
+        out = client.get("/api/instance/deadletters/records",
+                         topic=args.topic, limit=args.limit)
+        for r in out["records"]:
+            print(f"[{r['partition']}:{r['offset']}] key={r['key']} "
+                  f"{r['size']}B {json.dumps(r['preview'])}")
+        if not out["records"]:
+            print("(no records behind the replay cursor)")
+        return 0
+    if args.action == "replay":
+        body = {"topic": args.topic, "max": args.limit}
+        if args.target:
+            body["target"] = args.target
+        out = client.post("/api/instance/deadletters/replay", body)
+        print(f"replayed {out['replayed']} -> {out['target']} "
+              f"(remaining {out['remaining']})")
+        return 0
+    print(f"unknown action {args.action}", file=sys.stderr)
+    return 2
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m sitewhere_tpu",
@@ -305,6 +347,22 @@ def main(argv=None) -> int:
 
     version = sub.add_parser("version", help="print version")
     version.set_defaults(fn=cmd_version)
+
+    dl = sub.add_parser("deadletters",
+                        help="list/inspect/replay parked records on a "
+                             "running instance")
+    dl.add_argument("action", choices=["list", "show", "replay"])
+    dl.add_argument("--url", default="http://127.0.0.1:8080",
+                    help="REST gateway base URL")
+    dl.add_argument("--username", default="admin")
+    dl.add_argument("--password", default="password")
+    dl.add_argument("--topic", help="parked topic (show/replay)")
+    dl.add_argument("--target",
+                    help="replay destination (default: the reprocess "
+                         "topic for decoded events, else the base topic)")
+    dl.add_argument("--limit", type=int, default=100,
+                    help="records to show / max to replay")
+    dl.set_defaults(fn=cmd_deadletters)
 
     args = parser.parse_args(argv)
     return args.fn(args)

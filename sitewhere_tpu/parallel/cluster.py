@@ -79,6 +79,9 @@ from sitewhere_tpu.runtime.busnet import BusClient, BusNetError
 LOGGER = logging.getLogger("sitewhere.cluster")
 
 FOREIGN_ROWS_SUFFIX = "inbound-foreign-rows"
+# consumer group folding forwarded rows; checkpoint.py captures its
+# offsets so a gang restart replays only the gap — keep in one place
+FOREIGN_ROWS_GROUP = "cluster-foreign-rows"
 
 
 def foreign_rows_topic(naming: TopicNaming) -> str:
@@ -131,13 +134,35 @@ class ClusterControl:
 # foreign-row codec
 # ---------------------------------------------------------------------------
 
+def encode_rows(engine, batch: EventBatch, sel: np.ndarray) -> bytes:
+    """Encode selected flat-batch rows as the self-describing msgpack
+    blob. Rows travel by device TOKEN (and measurement/alert-type names),
+    not interned indices — interning is per-process state that does not
+    survive restarts or necessarily agree across hosts."""
+    packer = engine.packer
+    cols = {
+        "tokens": [packer.devices.token_of(int(i)) or ""
+                   for i in np.asarray(batch.device_idx)[sel]],
+        "event_type": np.asarray(batch.event_type)[sel].tolist(),
+        "ts_ms": (np.asarray(batch.ts, np.int64)[sel]
+                  + np.int64(packer.epoch_base_ms)).tolist(),
+        "value": np.asarray(batch.value)[sel].tolist(),
+        "lat": np.asarray(batch.lat)[sel].tolist(),
+        "lon": np.asarray(batch.lon)[sel].tolist(),
+        "elevation": np.asarray(batch.elevation)[sel].tolist(),
+        "alert_level": np.asarray(batch.alert_level)[sel].tolist(),
+        "mm_names": [packer.measurements.token_of(int(m)) or ""
+                     for m in np.asarray(batch.mm_idx)[sel]],
+        "alert_types": [packer.alert_types.token_of(int(a)) or ""
+                        for a in np.asarray(batch.alert_type_idx)[sel]],
+    }
+    return msgpack.packb(cols, use_bin_type=True)
+
+
 def encode_foreign_rows(engine: ShardedPipelineEngine,
-                        batch: EventBatch) -> Dict[int, bytes]:
-    """Group a flat foreign batch (global device indices) by OWNER process
-    and encode each group as a self-describing msgpack blob. Rows travel
-    by device TOKEN (and measurement/alert-type names), not interned
-    indices — interning is per-process state that does not survive
-    restarts or necessarily agree across hosts."""
+                        batch: EventBatch) -> Dict[int, tuple]:
+    """Group a flat foreign batch (global device indices) by OWNER process:
+    {pid: (payload bytes, row count)}."""
     valid = np.asarray(batch.valid)
     rows = np.nonzero(valid)[0]
     if rows.size == 0:
@@ -147,27 +172,10 @@ def encode_foreign_rows(engine: ShardedPipelineEngine,
     proc_of_shard = np.asarray(
         [d.process_index for d in engine.mesh.devices.flat], np.int32)
     owner = proc_of_shard[shard]
-    packer = engine.packer
-    out: Dict[int, bytes] = {}
+    out: Dict[int, tuple] = {}
     for pid in np.unique(owner):
         sel = rows[owner == np.int32(pid)]
-        cols = {
-            "tokens": [packer.devices.token_of(int(i)) or ""
-                       for i in np.asarray(batch.device_idx)[sel]],
-            "event_type": np.asarray(batch.event_type)[sel].tolist(),
-            "ts_ms": (np.asarray(batch.ts, np.int64)[sel]
-                      + np.int64(packer.epoch_base_ms)).tolist(),
-            "value": np.asarray(batch.value)[sel].tolist(),
-            "lat": np.asarray(batch.lat)[sel].tolist(),
-            "lon": np.asarray(batch.lon)[sel].tolist(),
-            "elevation": np.asarray(batch.elevation)[sel].tolist(),
-            "alert_level": np.asarray(batch.alert_level)[sel].tolist(),
-            "mm_names": [packer.measurements.token_of(int(m)) or ""
-                         for m in np.asarray(batch.mm_idx)[sel]],
-            "alert_types": [packer.alert_types.token_of(int(a)) or ""
-                            for a in np.asarray(batch.alert_type_idx)[sel]],
-        }
-        out[int(pid)] = msgpack.packb(cols, use_bin_type=True)
+        out[int(pid)] = (encode_rows(engine, batch, sel), int(sel.size))
     return out
 
 
@@ -430,7 +438,7 @@ class ForeignRowForwarder:
     def forward(self, engine: ShardedPipelineEngine,
                 batch: EventBatch) -> None:
         groups = encode_foreign_rows(engine, batch)
-        for pid, payload in groups.items():
+        for pid, (payload, n_rows) in groups.items():
             if pid == self.process_id:
                 continue  # should not happen; local rows never stash
             client = self.peers.get(pid)
@@ -439,14 +447,15 @@ class ForeignRowForwarder:
                 if client is None:
                     raise BusNetError(f"no bus edge known for process {pid}")
                 client.publish(self.topic, key, payload)
-                self.forwarded += 1
+                self.forwarded += n_rows  # ROWS, comparable to the owner's
+                #                           consumed_foreign counter
             except BusNetError as exc:
                 LOGGER.error("foreign-row forward to process %d failed: %s",
                              pid, exc)
                 if self.local_bus is not None:
                     self.local_bus.publish(f"{self.topic}.dead-letter",
                                            key, payload)
-                    self.dead_lettered += 1
+                    self.dead_lettered += n_rows
 
 
 class ForeignRowsConsumer:
@@ -458,7 +467,7 @@ class ForeignRowsConsumer:
 
     def __init__(self, bus, naming: TopicNaming, engine, loop: ClusterStepLoop,
                  owner_check: Optional[Callable[[str], bool]] = None,
-                 group_id: str = "cluster-foreign-rows"):
+                 group_id: str = FOREIGN_ROWS_GROUP):
         self.bus = bus
         self.engine = engine
         self.loop = loop
@@ -504,7 +513,12 @@ class ForeignRowsConsumer:
         if bad:
             self.misrouted_rows += len(bad)
             valid[np.asarray(bad)] = False
-            self.bus.publish(self._misroute_topic, record.key, record.value)
+            # park ONLY the misrouted rows (re-encoded): parking the whole
+            # record would double-apply the owned rows — which fold now —
+            # when an operator later replays the misroute topic
+            self.bus.publish(self._misroute_topic, record.key,
+                             encode_rows(self.engine, batch,
+                                         np.asarray(bad)))
             LOGGER.warning("%d forwarded rows not owned here (registry "
                            "drift?) — parked on %s", len(bad),
                            self._misroute_topic)

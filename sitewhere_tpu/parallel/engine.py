@@ -330,10 +330,14 @@ class ShardedPipelineEngine(PipelineEngine):
         try:
             routed_batch, outputs = self._one_step(params, routed_blob)
         except BaseException:
-            # transfer state unknown mid-failure: drop the loaned buffer
-            # from the pool instead of leaking it (or recycling a
-            # possibly-in-DMA one)
-            self.router.discard_staging_buffer(routed_blob)
+            if not self.is_multiprocess:
+                # transfer state unknown mid-failure: drop the loaned
+                # buffer from the pool instead of leaking it (or recycling
+                # a possibly-in-DMA one). The multiprocess path already
+                # released it before the step (it never reaches jax there
+                # — only the local copy does), so discarding again would
+                # under-count the pool.
+                self.router.discard_staging_buffer(routed_blob)
             raise
         self._overflow = self._slice_flat(batch, over_rows)
         # Multi-process lockstep: every host must launch the SAME number of
@@ -769,4 +773,8 @@ class ShardedPipelineEngine(PipelineEngine):
             "pending_overflow": self.pending_overflow,
             "tenant_event_count": tenant_events,
             "tenant_alert_count": tenant_alerts,
+            # multi-process: tenant totals above cover THIS host's shards
+            # only (global totals need an allgather); REST/admin readers
+            # must not misread per-host partials as global
+            "scope": "local" if self.is_multiprocess else "global",
         }

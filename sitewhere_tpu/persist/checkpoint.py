@@ -297,11 +297,12 @@ class InstanceCheckpointManager:
         if getattr(self.instance, "cluster_hooks", None) is not None:
             # the forwarded foreign-rows consumer also advances device
             # state; capture its cursor so restore replays only the gap
-            from sitewhere_tpu.parallel.cluster import foreign_rows_topic
+            from sitewhere_tpu.parallel.cluster import (
+                FOREIGN_ROWS_GROUP, foreign_rows_topic)
 
             groups.append(self.instance.bus.consumer(
                 foreign_rows_topic(self.instance.naming),
-                "cluster-foreign-rows"))
+                FOREIGN_ROWS_GROUP))
         return groups
 
     def save(self) -> str:

@@ -517,6 +517,7 @@ class PipelineEngine(LifecycleComponent):
             "batches": self.batches_processed,
             "tenant_event_count": tenant_events,
             "tenant_alert_count": tenant_alerts,
+            "scope": "global",  # single-controller: totals are global
         }
 
     # -- device profiling (the reference's Jaeger span surface; on-device

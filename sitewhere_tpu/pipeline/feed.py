@@ -90,6 +90,7 @@ class PipelinedSubmitter:
         self._next_step = 0             # next sequence to dispatch
         self._dispatched = 0            # steps whose dispatch has RETURNED
         self._stop = threading.Event()
+        self._close_lock = threading.Lock()  # atomic submit-vs-close gate
         self._stagers = [
             threading.Thread(target=self._stage_loop, name=f"feed-stage-{i}",
                              daemon=True)
@@ -104,17 +105,22 @@ class PipelinedSubmitter:
     def submit(self, batch: EventBatch) -> StepFuture:
         fut = StepFuture()
         item = (self._alloc_seq(), batch, fut)
-        # bounded-blocking put that re-checks closure: a producer parked in
-        # a plain put() could slip its item into the queue AFTER close()
-        # drained it, leaving the future unresolved forever
+        # closure check and enqueue are atomic under _close_lock: close()
+        # sets _stop under the same lock, so once close() proceeds to
+        # drain, no producer can slip an item into the unattended queue
+        # (a sleep-based window would lose the future forever on a
+        # descheduled producer). The lock is never held across a blocking
+        # put — full queues back off outside it.
         while True:
-            if self._stop.is_set():
-                raise RuntimeError("submitter closed")
-            try:
-                self._in.put(item, timeout=0.1)
-                return fut
-            except queue.Full:
-                continue
+            with self._close_lock:
+                if self._stop.is_set():
+                    raise RuntimeError("submitter closed")
+                try:
+                    self._in.put_nowait(item)
+                    return fut
+                except queue.Full:
+                    pass
+            time.sleep(0.005)
 
     def _alloc_seq(self) -> int:
         with self._ready_lock:
@@ -219,16 +225,14 @@ class PipelinedSubmitter:
                                       else min(0.05, remaining))
 
     def close(self) -> None:
-        self._stop.set()
+        with self._close_lock:
+            self._stop.set()
+        # past this point submit() can only raise: nothing new enqueues
         with self._ready_lock:
             self._ready_lock.notify_all()
         for t in self._stagers:
             t.join(timeout=5.0)
         self._step_thread.join(timeout=5.0)
-        # a producer looping in submit() observes _stop within its 0.1 s
-        # put timeout; wait that window out so its item either landed (and
-        # drains below) or its submit raised — then nothing can enqueue
-        time.sleep(0.15)
         # resolve anything still queued or staged so no caller blocks
         # forever on a future the stopped threads will never touch
         leftovers = []

@@ -81,11 +81,20 @@ class InboundProcessingService(LifecycleComponent):
         self._host = ConsumerHost(
             bus, self.naming.event_source_decoded_events(tenant),
             group_id=f"inbound-processing-{tenant}", handler=self.process)
+        # the reprocess loop is a first-class pipeline input (reference:
+        # KafkaTopicNaming.java:48-69): records an operator replays from a
+        # dead-letter topic (runtime/deadletter.py) re-enter here with the
+        # same validate -> persist -> fused-step handling
+        self._reprocess_host = ConsumerHost(
+            bus, self.naming.inbound_reprocess_events(tenant),
+            group_id=f"inbound-reprocess-{tenant}", handler=self.process)
 
     def on_start(self, monitor) -> None:
         self._host.start()
+        self._reprocess_host.start()
 
     def on_stop(self, monitor) -> None:
+        self._reprocess_host.stop()
         self._host.stop()
 
     # -- processing --------------------------------------------------------

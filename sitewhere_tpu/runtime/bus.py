@@ -470,6 +470,20 @@ class EventBus:
         group.commit(partitions)
         self._persist_offsets(group)
 
+    def persisted_topics(self) -> List[str]:
+        """Topic names with on-disk logs from ANY process incarnation.
+        `topics()` lists only lazily-created in-memory topics — after a
+        restart, a durable topic (e.g. parked dead-letter records) exists
+        on disk but not in memory until first touch, and the dead-letter
+        operability surface must still find it. Names containing '/' are
+        stored escaped ('_') and cannot be recovered from the dir listing;
+        no framework topic uses '/'."""
+        if not self._data_dir or not os.path.isdir(self._data_dir):
+            return []
+        return [name for name in os.listdir(self._data_dir)
+                if name != "_offsets"
+                and os.path.isdir(os.path.join(self._data_dir, name))]
+
     def topics(self) -> List[str]:
         with self._lock:
             return sorted(self._topics)

@@ -58,6 +58,11 @@ _PAGE = """<!doctype html>
  <section class="wide"><h2>Tenant engines</h2>
    <table id="tenants"><thead><tr><th>tenant</th><th>engine</th>
    <th>actions</th></tr></thead><tbody></tbody></table></section>
+ <section class="wide" id="clustersec" style="display:none">
+   <h2>Cluster processes</h2>
+   <table id="procs"><thead><tr><th>process</th><th>status</th>
+   <th>tick</th><th>age</th><th>liveness</th></tr></thead>
+   <tbody></tbody></table></section>
  <section><h2>Checkpoints</h2>
    <button onclick="ckpt()">Checkpoint now</button>
    <ul id="ckpts" style="font-size:13px"></ul></section>
@@ -102,6 +107,16 @@ async function tick(){
        <td>${['restart','stop','start'].map(op=>
          `<button data-tok="${esc(tok)}" data-op="${op}">${op}</button>`
         ).join('')}</td></tr>`).join('');
+    if(t.processes){  // multi-host deployment: per-process heartbeats
+      document.getElementById('clustersec').style.display='';
+      document.querySelector('#procs tbody').innerHTML=
+        Object.entries(t.processes).sort().map(([pid,p])=>
+          `<tr><td>${esc(pid)}${pid==String(t.process_id)?' (this)':''}</td>
+           <td>${esc(p.status??'?')}</td><td>${esc(p.tick??'')}</td>
+           <td>${esc(p.age_s??'')}s</td>
+           <td class="${p.stale?'bad':'ok'}">${p.stale?'STALE':'live'}</td>
+           </tr>`).join('');
+    }
     const m=await api('/api/instance/metrics');
     const pick={};
     for(const cat of Object.values(m)){           // {counters:{...},...}

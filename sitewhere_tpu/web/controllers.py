@@ -212,6 +212,44 @@ def register_all(router: Router, instance, server) -> None:
                authority=SiteWhereRoles.VIEW_SERVER_INFO)
 
     # ------------------------------------------------------------------
+    # Dead-letter operability (runtime/deadletter.py; reference: the
+    # inbound-reprocess-events loop, KafkaTopicNaming.java:48-69)
+    # ------------------------------------------------------------------
+    def list_deadletters(request: Request):
+        from sitewhere_tpu.runtime.deadletter import list_parked_topics
+        return {"topics": list_parked_topics(instance.bus, instance.naming)}
+
+    def read_deadletters(request: Request):
+        from sitewhere_tpu.runtime.deadletter import read_parked_records
+        topic = request.query_one("topic")
+        if not topic:
+            raise SiteWhereError("missing required query param 'topic'",
+                                 http_status=400)
+        return {"topic": topic, "records": read_parked_records(
+            instance.bus, topic,
+            limit=min(request.query_int("limit", 100), 1000))}
+
+    def replay_deadletters(request: Request):
+        from sitewhere_tpu.runtime.deadletter import replay_parked_records
+        body = _body(request)
+        topic = body.get("topic")
+        if not topic:
+            raise SiteWhereError("missing required body field 'topic'",
+                                 http_status=400)
+        return replay_parked_records(
+            instance.bus, instance.naming, topic,
+            target=body.get("target"),
+            max_records=int(body.get("max", 65536)))
+
+    router.get("/api/instance/deadletters", list_deadletters,
+               authority=SiteWhereRoles.VIEW_SERVER_INFO)
+    router.get("/api/instance/deadletters/records", read_deadletters,
+               authority=SiteWhereRoles.VIEW_SERVER_INFO)
+    # re-ingests data into the pipeline: admin-scoped like checkpoints
+    router.post("/api/instance/deadletters/replay", replay_deadletters,
+                authority=SiteWhereRoles.ADMINISTER_TENANTS)
+
+    # ------------------------------------------------------------------
     # Script management (reference: Instance.java:304-560 scripting rpcs,
     # global + per-tenant scopes)
     # ------------------------------------------------------------------

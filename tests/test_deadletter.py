@@ -1,0 +1,153 @@
+"""Dead-letter operability (runtime/deadletter.py + REST + reprocess loop).
+
+VERDICT r2 item 6 done criterion: a poison record parks, is listed via
+REST, the broken processor is replaced, replay re-ingests it through
+`inbound-reprocess-events` (a first-class pipeline input, reference
+KafkaTopicNaming.java:48-69), and the replay cursor advances.
+"""
+
+import time
+
+import msgpack
+import numpy as np
+import pytest
+
+from sitewhere_tpu.instance import SiteWhereInstance
+from sitewhere_tpu.model.common import _asdict
+from sitewhere_tpu.model.event import DeviceEventBatch, DeviceMeasurement
+from sitewhere_tpu.runtime.bus import ConsumerHost
+from sitewhere_tpu.runtime.deadletter import (
+    default_replay_target, list_parked_topics, read_parked_records,
+    replay_parked_records)
+
+
+@pytest.fixture()
+def instance():
+    inst = SiteWhereInstance(instance_id="dlx", enable_pipeline=True,
+                             max_devices=64, batch_size=16,
+                             measurement_slots=4)
+    inst.start()
+    yield inst
+    inst.stop()
+
+
+def _decoded_record(token, value):
+    return msgpack.packb({
+        "sourceId": "dl", "deviceToken": token, "kind": "DeviceEventBatch",
+        "request": _asdict(DeviceEventBatch(
+            device_token=token,
+            measurements=[DeviceMeasurement(
+                name="temp", value=value,
+                event_date=int(time.time() * 1000))])),
+        "metadata": {},
+    }, use_bin_type=True)
+
+
+def test_default_replay_targets(instance):
+    naming = instance.naming
+    decoded = naming.event_source_decoded_events("default")
+    assert default_replay_target(f"{decoded}.dead-letter", naming) \
+        == naming.inbound_reprocess_events("default")
+    enriched = naming.inbound_enriched_events("default")
+    assert default_replay_target(f"{enriched}.dead-letter", naming) \
+        == enriched
+    assert default_replay_target("some.global.topic.misrouted", naming) \
+        == "some.global.topic"
+
+
+def test_park_list_inspect_replay_reingest(instance):
+    """The full operator loop, end to end through the real pipeline."""
+    naming = instance.naming
+    bus = instance.bus
+    decoded_topic = naming.event_source_decoded_events("default")
+
+    # a BROKEN processor version (its own consumer group) poisons on
+    # every batch: the batch parks on the dead-letter topic after the
+    # retry budget — the bus's own parking mechanism, nothing synthetic
+    def broken(_records):
+        raise RuntimeError("decoder bug v1")
+
+    broken_host = ConsumerHost(bus, decoded_topic, group_id="broken-proc",
+                               handler=broken, max_retries=1,
+                               max_backoff_s=0.05)
+    broken_host.start()
+    bus.publish(decoded_topic, b"dl-dev", _decoded_record("dl-dev", 41.5))
+    deadline = time.monotonic() + 30
+    while broken_host.dead_lettered == 0 and time.monotonic() < deadline:
+        time.sleep(0.05)
+    broken_host.stop()
+    assert broken_host.dead_lettered >= 1
+
+    # NOTE: the instance's real inbound consumer ALSO saw the record and
+    # (no such device yet) routed it to the unregistered topic — the
+    # device "did not exist until the fix was provisioned"
+    parked_topic = f"{decoded_topic}.dead-letter"
+    listed = list_parked_topics(bus, naming)
+    by_name = {t["topic"]: t for t in listed}
+    assert parked_topic in by_name
+    assert by_name[parked_topic]["replayBacklog"] >= 1
+    assert by_name[parked_topic]["replayTarget"] \
+        == naming.inbound_reprocess_events("default")
+
+    records = read_parked_records(bus, parked_topic)
+    assert records and records[0]["preview"]["deviceToken"] == "dl-dev"
+
+    # deploy the fix: provision the device the record references
+    te = instance.get_tenant_engine("default")
+    from sitewhere_tpu.model import Device, DeviceAssignment, DeviceType
+    dt = te.registry.create_device_type(DeviceType(token="dl-dt"))
+    d = te.registry.create_device(Device(token="dl-dev",
+                                         device_type_id=dt.id))
+    te.registry.create_device_assignment(
+        DeviceAssignment(token="dl-as", device_id=d.id))
+
+    # replay: parked record re-enters through inbound-reprocess-events,
+    # which InboundProcessingService consumes like decoded events
+    result = replay_parked_records(bus, naming, parked_topic)
+    assert result["replayed"] >= 1
+    assert result["target"] == naming.inbound_reprocess_events("default")
+    assert result["remaining"] == 0
+
+    engine = instance.pipeline_engine
+    deadline = time.monotonic() + 60
+    state = None
+    while time.monotonic() < deadline:
+        state = engine.get_device_state("dl-dev")
+        if state is not None and "temp" in state.last_measurements:
+            break
+        time.sleep(0.1)
+    assert state is not None \
+        and state.last_measurements["temp"][1] == 41.5
+
+    # cursor advanced: a second replay finds nothing
+    again = replay_parked_records(bus, naming, parked_topic)
+    assert again["replayed"] == 0
+
+
+def test_rest_surface(instance):
+    from sitewhere_tpu.client.rest import SiteWhereClient
+    from sitewhere_tpu.web.server import RestServer
+
+    naming = instance.naming
+    topic = naming.inbound_enriched_events("default")
+    instance.bus.publish(f"{topic}.dead-letter", b"k", b"\x01opaque")
+
+    rest = RestServer(instance, port=0)
+    rest.start()
+    try:
+        client = SiteWhereClient(rest.base_url)
+        client.authenticate("admin", "password")
+        topics = client.get("/api/instance/deadletters")["topics"]
+        names = [t["topic"] for t in topics]
+        assert f"{topic}.dead-letter" in names
+        out = client.get("/api/instance/deadletters/records",
+                         topic=f"{topic}.dead-letter", limit=10)
+        assert out["records"][0]["preview"]["kind"] == "opaque"
+        replayed = client.post("/api/instance/deadletters/replay",
+                               {"topic": f"{topic}.dead-letter"})
+        assert replayed["replayed"] == 1
+        assert replayed["target"] == topic
+        # the replayed record landed on the base topic
+        assert sum(instance.bus.topic(topic).end_offsets()) >= 1
+    finally:
+        rest.stop()
