@@ -70,7 +70,7 @@ def _load(_retry: bool = True) -> None:
     # from source once.
     try:
         lib.swt_version.restype = i32
-        stale = lib.swt_version() != 4
+        stale = lib.swt_version() != 5
     except AttributeError:
         stale = True
     if stale:
@@ -117,21 +117,22 @@ def _load(_retry: bool = True) -> None:
         c.c_char_p, i64, p_i64,
         p_i32, p_i64, p_i64, i32, p_i64]
     lib.swt_decode_hot_frames.restype = i32
-    lib.swt_route_blob.argtypes = [p_i32, i64, i32, i32, p_i32, p_i64, i64]
+    lib.swt_route_blob.argtypes = [p_i32, i64, i32, i32, i32, p_i32, p_i64,
+                                   i64]
     lib.swt_route_blob.restype = i32
     p_u8 = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
     lib.swt_pack_route_blob.argtypes = [p_i32, p_i32, p_i32, p_i32, p_f32,
                                         p_f32, p_f32, p_f32, p_i32, p_i32,
-                                        p_u8, i64, i32, i32, p_i32, p_i64,
-                                        i64]
+                                        p_u8, i64, i32, i32, i32, p_i32,
+                                        p_i64, i64]
     lib.swt_pack_route_blob.restype = i32
     lib.swt_pack_blob.argtypes = [p_i32, p_i32, p_i32, p_i32, p_f32, p_f32,
                                   p_f32, p_f32, p_i32, p_i32, p_u8, i64,
-                                  p_i32]
+                                  i32, p_i32]
     lib.swt_pack_blob.restype = i32
-    lib.swt_unpack_blob.argtypes = [p_i32, i64, p_i32, p_i32, p_i32, p_i32,
-                                    p_f32, p_f32, p_f32, p_f32, p_i32, p_i32,
-                                    p_u8]
+    lib.swt_unpack_blob.argtypes = [p_i32, i64, i32, p_i32, p_i32, p_i32,
+                                    p_i32, p_f32, p_f32, p_f32, p_f32, p_i32,
+                                    p_i32, p_u8]
     lib.swt_unpack_blob.restype = None
     LIB = lib
 
@@ -309,37 +310,43 @@ def decode_hot_frames(data: bytes, max_events: Optional[int] = None
 
 def route_blob(blob: np.ndarray, n_shards: int, per_shard: int
                ) -> Tuple[np.ndarray, np.ndarray]:
-    """Shard-route a flat wire blob [WIRE_ROWS, n] -> ([S, WIRE_ROWS, B]
-    routed blob, flat-row indices of overflow). Requires available();
-    callers fall back to the numpy router otherwise."""
-    from sitewhere_tpu.ops.pack import WIRE_ROWS
-
+    """Shard-route a flat wire blob [wire_rows, n] -> ([S, wire_rows, B]
+    routed blob, flat-row indices of overflow); wire_rows follows the
+    input blob (4 = compact). Requires available(); callers fall back to
+    the numpy router otherwise."""
     blob = np.ascontiguousarray(blob, np.int32)
-    n = blob.shape[1]
-    out = np.zeros((n_shards, WIRE_ROWS, per_shard), np.int32)
+    rows, n = blob.shape
+    out = np.zeros((n_shards, rows, per_shard), np.int32)
     overflow = np.empty(max(n, 1), np.int64)
     n_over = LIB.swt_route_blob(blob.reshape(-1), n, n_shards, per_shard,
-                                out.reshape(-1), overflow, len(overflow))
+                                rows, out.reshape(-1), overflow,
+                                len(overflow))
     if n_over < 0:  # cannot happen with overflow_cap=n; defensive
         raise RuntimeError("route_blob overflow capacity exceeded")
     return out, overflow[:n_over]
 
 
 def pack_route_blob(batch, n_shards: int, per_shard: int,
-                    out: Optional[np.ndarray] = None
+                    out: Optional[np.ndarray] = None,
+                    wire_rows: Optional[int] = None
                     ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
-    """Fused pack+route: EventBatch columns -> routed [S, WIRE_ROWS, B]
+    """Fused pack+route: EventBatch columns -> routed [S, wire_rows, B]
     blob + overflow flat-row indices in ONE native pass (see
-    swt_pack_route_blob). `out` may be a reused staging buffer — it does
-    NOT need to be zeroed (the kernel clears exactly the head-row tails
-    whose valid bits must read 0). Returns None when a device_idx is out
-    of wire range (caller raises the shared diagnostic). Requires
-    available()."""
+    swt_pack_route_blob). wire_rows 5, or 4 for the compact no-elevation
+    variant; derived from `out` when a buffer is supplied. `out` may be a
+    reused staging buffer — it does NOT need to be zeroed (the kernel
+    clears exactly the head-row tails whose valid bits must read 0).
+    Returns None when a device_idx is out of wire range (caller raises
+    the shared diagnostic). Requires available()."""
     from sitewhere_tpu.ops.pack import WIRE_ROWS
 
     n = batch.device_idx.shape[0]
+    if out is not None:
+        wire_rows = out.shape[1]
+    elif wire_rows is None:
+        wire_rows = WIRE_ROWS
     if out is None:
-        out = np.empty((n_shards, WIRE_ROWS, per_shard), np.int32)
+        out = np.empty((n_shards, wire_rows, per_shard), np.int32)
 
     def i32(a):
         return np.ascontiguousarray(a, np.int32)
@@ -354,7 +361,7 @@ def pack_route_blob(batch, n_shards: int, per_shard: int,
         f32(batch.elevation), i32(batch.alert_type_idx),
         i32(batch.alert_level),
         np.ascontiguousarray(batch.valid, np.uint8), n, n_shards, per_shard,
-        out.reshape(-1), overflow, len(overflow))
+        wire_rows, out.reshape(-1), overflow, len(overflow))
     if rc == -2:
         return None
     if rc < 0:  # cannot happen with overflow_cap=n; defensive
@@ -363,9 +370,10 @@ def pack_route_blob(batch, n_shards: int, per_shard: int,
 
 
 def pack_blob(batch, out: np.ndarray) -> bool:
-    """One-pass EventBatch columns -> [WIRE_ROWS, n] wire blob (flat
-    batches only; leading-axis batches use the numpy path). Returns False
-    when a device_idx is out of wire range (caller raises with detail).
+    """One-pass EventBatch columns -> [wire_rows, n] wire blob (flat
+    batches only; leading-axis batches use the numpy path; wire_rows from
+    out.shape[0] — 4 = compact no-elevation variant). Returns False when
+    a device_idx is out of wire range (caller raises with detail).
     Requires available()."""
     n = batch.device_idx.shape[0]
 
@@ -380,16 +388,18 @@ def pack_blob(batch, out: np.ndarray) -> bool:
         i32(batch.mm_idx), f32(batch.value), f32(batch.lat), f32(batch.lon),
         f32(batch.elevation), i32(batch.alert_type_idx),
         i32(batch.alert_level),
-        np.ascontiguousarray(batch.valid, np.uint8), n, out.reshape(-1))
+        np.ascontiguousarray(batch.valid, np.uint8), n, out.shape[0],
+        out.reshape(-1))
     return rc == 0
 
 
 def unpack_blob(blob: np.ndarray, cols: dict) -> None:
-    """One-pass [WIRE_ROWS, n] wire blob -> preallocated column arrays
-    (keys: device_idx..valid). Requires available()."""
+    """One-pass [wire_rows, n] wire blob -> preallocated column arrays
+    (keys: device_idx..valid; 4-row compact blobs unpack with elevation
+    0). Requires available()."""
     n = blob.shape[-1]
     LIB.swt_unpack_blob(
-        np.ascontiguousarray(blob, np.int32).reshape(-1), n,
+        np.ascontiguousarray(blob, np.int32).reshape(-1), n, blob.shape[-2],
         cols["device_idx"], cols["event_type"], cols["ts"], cols["mm_idx"],
         cols["value"], cols["lat"], cols["lon"], cols["elevation"],
         cols["alert_type_idx"], cols["alert_level"], cols["valid"])

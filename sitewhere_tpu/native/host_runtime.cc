@@ -93,7 +93,7 @@ struct Interner {
 
 extern "C" {
 
-int32_t swt_version() { return 4; }
+int32_t swt_version() { return 5; }
 
 void* swt_interner_create(int32_t capacity) {
   if (capacity < 2) return nullptr;
@@ -382,21 +382,24 @@ inline float bits_f32(int32_t v) {
 }
 }  // namespace
 
-// Pack EventBatch columns into the v2 wire blob (ops/pack.py layout doc)
+// Pack EventBatch columns into the wire blob (ops/pack.py layout doc)
 // in one pass — replaces 8 numpy full-column passes (3 of them np.where
-// selects) on the hottest host path. `out` is [kWireRows, n]. Returns 0,
-// or -1 when a device_idx is outside [0, 2^22) (caller raises).
+// selects) on the hottest host path. `out` is [wire_rows, n]; wire_rows
+// is 5, or 4 for the COMPACT variant that omits the elevation row (the
+// caller chooses it when no row carries a nonzero elevation — 16 B/event
+// instead of 20 on a transfer-bound path). Returns 0, or -1 when a
+// device_idx is outside [0, 2^22) (caller raises).
 int32_t swt_pack_blob(const int32_t* device_idx, const int32_t* event_type,
                       const int32_t* ts, const int32_t* mm_idx,
                       const float* value, const float* lat, const float* lon,
                       const float* elevation, const int32_t* alert_type_idx,
                       const int32_t* alert_level, const uint8_t* valid,
-                      int64_t n, int32_t* out) {
+                      int64_t n, int32_t wire_rows, int32_t* out) {
   int32_t* head = out;
   int32_t* ts_row = out + n;
   int32_t* pa = out + 2 * n;
   int32_t* pb = out + 3 * n;
-  int32_t* elev = out + 4 * n;
+  int32_t* elev = wire_rows >= 5 ? out + 4 * n : nullptr;
   for (int64_t i = 0; i < n; ++i) {
     int32_t dev = device_idx[i];
     if (dev < 0 || dev > kWireDevMask) return -1;
@@ -411,14 +414,16 @@ int32_t swt_pack_blob(const int32_t* device_idx, const int32_t* event_type,
       pa[i] = f32_bits(value[i]);
       pb[i] = (et == kEtAlert ? alert_type_idx[i] : mm_idx[i]) & kIdxMask;
     }
-    elev[i] = f32_bits(elevation[i]);
+    if (elev) elev[i] = f32_bits(elevation[i]);
   }
   return 0;
 }
 
-// Inverse of swt_pack_blob (one pass; `blob` is [kWireRows, n]). tenant_idx
-// is not on the wire — the caller zero-fills it.
-void swt_unpack_blob(const int32_t* blob, int64_t n, int32_t* device_idx,
+// Inverse of swt_pack_blob (one pass; `blob` is [wire_rows, n]; a 4-row
+// compact blob unpacks with elevation 0). tenant_idx is not on the wire —
+// the caller zero-fills it.
+void swt_unpack_blob(const int32_t* blob, int64_t n, int32_t wire_rows,
+                     int32_t* device_idx,
                      int32_t* event_type, int32_t* ts, int32_t* mm_idx,
                      float* value, float* lat, float* lon, float* elevation,
                      int32_t* alert_type_idx, int32_t* alert_level,
@@ -427,7 +432,7 @@ void swt_unpack_blob(const int32_t* blob, int64_t n, int32_t* device_idx,
   const int32_t* ts_row = blob + n;
   const int32_t* pa = blob + 2 * n;
   const int32_t* pb = blob + 3 * n;
-  const int32_t* elev = blob + 4 * n;
+  const int32_t* elev = wire_rows >= 5 ? blob + 4 * n : nullptr;
   for (int64_t i = 0; i < n; ++i) {
     int32_t h = head[i];
     int32_t et = (h >> 22) & 7;
@@ -449,7 +454,7 @@ void swt_unpack_blob(const int32_t* blob, int64_t n, int32_t* device_idx,
       mm_idx[i] = et == kEtMeasurement ? pb[i] : 0;
       alert_type_idx[i] = et == kEtAlert ? pb[i] : 0;
     }
-    elevation[i] = bits_f32(elev[i]);
+    elevation[i] = elev ? bits_f32(elev[i]) : 0.0f;
   }
 }
 
@@ -468,10 +473,12 @@ int32_t swt_pack_route_blob(
     const int32_t* mm_idx, const float* value, const float* lat,
     const float* lon, const float* elevation, const int32_t* alert_type_idx,
     const int32_t* alert_level, const uint8_t* valid, int64_t n, int32_t S,
-    int32_t B, int32_t* out, int64_t* overflow_rows, int64_t overflow_cap) {
+    int32_t B, int32_t wire_rows, int32_t* out, int64_t* overflow_rows,
+    int64_t overflow_cap) {
   std::vector<int32_t> cursor(static_cast<size_t>(S), 0);
   int64_t n_over = 0;
-  const int64_t shard_stride = static_cast<int64_t>(kWireRows) * B;
+  const int64_t shard_stride = static_cast<int64_t>(wire_rows) * B;
+  const bool with_elev = wire_rows >= 5;
   for (int64_t i = 0; i < n; ++i) {
     if (!valid[i]) continue;
     int32_t dev = device_idx[i];
@@ -496,7 +503,7 @@ int32_t swt_pack_route_blob(
       dst[2 * B] = f32_bits(value[i]);
       dst[3 * B] = (et == kEtAlert ? alert_type_idx[i] : mm_idx[i]) & kIdxMask;
     }
-    dst[4 * B] = f32_bits(elevation[i]);
+    if (with_elev) dst[4 * B] = f32_bits(elevation[i]);
   }
   for (int32_t s = 0; s < S; ++s) {
     int32_t filled = cursor[s];
@@ -508,12 +515,12 @@ int32_t swt_pack_route_blob(
 }
 
 int32_t swt_route_blob(const int32_t* blob, int64_t n, int32_t S, int32_t B,
-                       int32_t* out, int64_t* overflow_rows,
-                       int64_t overflow_cap) {
+                       int32_t wire_rows, int32_t* out,
+                       int64_t* overflow_rows, int64_t overflow_cap) {
   std::vector<int32_t> cursor(static_cast<size_t>(S), 0);
   const int32_t* head_row = blob;
   int64_t n_over = 0;
-  const int64_t shard_stride = static_cast<int64_t>(kWireRows) * B;
+  const int64_t shard_stride = static_cast<int64_t>(wire_rows) * B;
   for (int64_t i = 0; i < n; ++i) {
     int32_t head = head_row[i];
     if ((head & kWireValidBit) == 0) continue;  // padding row
@@ -528,7 +535,7 @@ int32_t swt_route_blob(const int32_t* blob, int64_t n, int32_t S, int32_t B,
     cursor[s] = pos + 1;
     int32_t* dst = out + s * shard_stride + pos;
     dst[0] = (head & ~kWireDevMask) | (dev / S);
-    for (int r = 1; r < kWireRows; ++r) dst[r * B] = blob[r * n + i];
+    for (int r = 1; r < wire_rows; ++r) dst[r * B] = blob[r * n + i];
   }
   return static_cast<int32_t>(n_over);
 }

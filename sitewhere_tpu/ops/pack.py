@@ -83,7 +83,16 @@ def empty_batch(batch_size: int) -> EventBatch:
 #   row 3: payload B — mm_idx (measurement) | lon f32 bits (location) |
 #          alert_type_idx (alert)
 #   row 4: elevation f32 bits (carried for every type; zero unless set)
+#
+# COMPACT variant (v3): when no row of a batch carries a nonzero
+# elevation — the common case for measurement/alert traffic and 2-D
+# location fixes — row 4 is omitted entirely: 16 B/event instead of 20.
+# The unpackers derive the variant from the blob's row dimension
+# (elevation reads as 0 for 4-row blobs); jit compiles one program per
+# shape, both cached. On a transfer-bound link (step_breakdown shows H2D
+# dominating the step) this is a direct ~20% throughput lift.
 WIRE_ROWS = 5
+WIRE_ROWS_COMPACT = 4
 WIRE_DEV_BITS = 22
 WIRE_DEV_MAX = 1 << WIRE_DEV_BITS   # 4.19M interned devices per wire batch
 _ET_SHIFT = 22
@@ -94,6 +103,14 @@ _META_MAX_IDX = 1 << 12  # mm_idx / alert_type_idx interner width (unchanged)
 _ET_MEASUREMENT = int(DeviceEventType.MEASUREMENT)
 _ET_LOCATION = int(DeviceEventType.LOCATION)
 _ET_ALERT = int(DeviceEventType.ALERT)
+
+
+def wire_rows_for(batch: EventBatch) -> int:
+    """Wire variant for a flat batch: compact 4-row when no row carries a
+    nonzero elevation (the full-column any() costs ~30 us at bench scale
+    and saves a 20% slice of a transfer-bound step when it hits)."""
+    return (WIRE_ROWS_COMPACT
+            if not np.any(np.asarray(batch.elevation)) else WIRE_ROWS)
 
 
 def batch_to_blob(batch: EventBatch,
@@ -109,18 +126,24 @@ def batch_to_blob(batch: EventBatch,
     `out` (flat batches only) is an optional preallocated [WIRE_ROWS, B]
     int32 buffer — engines pass a rotating staging buffer so the hot path
     does not pay a fresh 2.6 MB mmap-backed allocation (page faults) per
-    step. Every element is overwritten; no pre-zeroing needed.
+    step. Every element is overwritten; no pre-zeroing needed. When the
+    batch carries no elevation, only the first WIRE_ROWS_COMPACT rows are
+    written and that contiguous view is returned (16 B/event on the
+    wire).
     """
     lead = batch.device_idx.shape[:-1]   # () flat, (S,) routed
     B = batch.device_idx.shape[-1]
+    # routed blobs always carry the full layout; flat batches may compact
+    rows = WIRE_ROWS if lead else wire_rows_for(batch)
     if not lead:
         from sitewhere_tpu import native
 
         if native.available():
-            if out is None or out.shape != (WIRE_ROWS, B):
-                out = np.empty((WIRE_ROWS, B), np.int32)
-            if native.pack_blob(batch, out):
-                return out
+            if out is None or out.shape[-1] != B or out.shape[0] < rows:
+                out = np.empty((rows, B), np.int32)
+            view = out[:rows]
+            if native.pack_blob(batch, view):
+                return view
             # fall through: the numpy range check below raises the
             # (single, shared) diagnostic for the out-of-range device_idx
     dev = np.asarray(batch.device_idx, np.int32)
@@ -132,10 +155,11 @@ def batch_to_blob(batch: EventBatch,
     et = np.asarray(batch.event_type, np.int32) & 7
     is_loc = et == _ET_LOCATION
     is_alert = et == _ET_ALERT
-    if out is not None and out.shape == lead + (WIRE_ROWS, B):
-        blob = out
+    if out is not None and out.shape[-1] == B \
+            and out.shape[:-2] == lead and out.shape[-2] >= rows:
+        blob = out[..., :rows, :]
     else:
-        blob = np.empty(lead + (WIRE_ROWS, B), np.int32)
+        blob = np.empty(lead + (rows, B), np.int32)
     blob[..., 0, :] = (
         dev
         | (et << _ET_SHIFT)
@@ -156,7 +180,9 @@ def batch_to_blob(batch: EventBatch,
         np.where(is_alert,
                  np.asarray(batch.alert_type_idx, np.int32) & idx_mask,
                  np.asarray(batch.mm_idx, np.int32) & idx_mask))
-    blob[..., 4, :] = np.asarray(batch.elevation, np.float32).view(np.int32)
+    if rows >= WIRE_ROWS:
+        blob[..., 4, :] = np.asarray(batch.elevation,
+                                     np.float32).view(np.int32)
     return blob
 
 
@@ -200,6 +226,10 @@ def blob_to_batch_np(blob: np.ndarray) -> EventBatch:
     pa = blob[..., 2, :]
     pb = blob[..., 3, :]
     zf = np.float32(0)
+    if blob.shape[-2] >= WIRE_ROWS:
+        elevation = np.ascontiguousarray(blob[..., 4, :]).view(np.float32)
+    else:  # compact variant: elevation row omitted, reads as 0
+        elevation = np.zeros(r0.shape, np.float32)
     return EventBatch(
         device_idx=r0 & (WIRE_DEV_MAX - 1),
         tenant_idx=np.zeros_like(r0),
@@ -209,7 +239,7 @@ def blob_to_batch_np(blob: np.ndarray) -> EventBatch:
         value=np.where(is_meas, pa.view(np.float32), zf),
         lat=np.where(is_loc, pa.view(np.float32), zf),
         lon=np.where(is_loc, pb.view(np.float32), zf),
-        elevation=blob[..., 4, :].view(np.float32),
+        elevation=elevation,
         alert_type_idx=np.where(et == _ET_ALERT, pb, 0).astype(np.int32),
         alert_level=(r0 >> _LEVEL_SHIFT) & 7,
         valid=(r0 & (1 << _VALID_SHIFT)) != 0)
@@ -230,6 +260,11 @@ def blob_to_batch(blob) -> EventBatch:
     fa = jax.lax.bitcast_convert_type(pa, jnp.float32)
     fb = jax.lax.bitcast_convert_type(pb, jnp.float32)
     zf = jnp.float32(0)
+    if blob.shape[-2] >= WIRE_ROWS:  # static shape: resolved at trace time
+        elevation = jax.lax.bitcast_convert_type(blob[..., 4, :],
+                                                 jnp.float32)
+    else:  # compact variant: elevation row omitted, reads as 0
+        elevation = jnp.zeros(r0.shape, jnp.float32)
     return EventBatch(
         device_idx=r0 & (WIRE_DEV_MAX - 1),
         tenant_idx=jnp.zeros_like(r0),
@@ -239,7 +274,7 @@ def blob_to_batch(blob) -> EventBatch:
         value=jnp.where(is_meas, fa, zf),
         lat=jnp.where(is_loc, fa, zf),
         lon=jnp.where(is_loc, fb, zf),
-        elevation=jax.lax.bitcast_convert_type(blob[..., 4, :], jnp.float32),
+        elevation=elevation,
         alert_type_idx=jnp.where(et == _ET_ALERT, pb, 0),
         alert_level=(r0 >> _LEVEL_SHIFT) & 7,
         valid=(r0 & (1 << _VALID_SHIFT)) != 0)

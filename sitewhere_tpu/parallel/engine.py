@@ -146,6 +146,14 @@ class ShardedPipelineEngine(PipelineEngine):
         ring = 0 if self._target_platform() == "cpu" else 4
         self.router = ShardRouter(self.n_shards, per_shard_batch,
                                   staging_ring=ring)
+        if self.is_multiprocess:
+            # lockstep invariant: every host must launch the SAME-shaped
+            # collective program per tick; a per-host compact-vs-full wire
+            # choice (driven by local batch content) would pair
+            # differently-shaped collectives across hosts. Pin the full
+            # layout cluster-wide.
+            from sitewhere_tpu.ops.pack import WIRE_ROWS
+            self.router.fixed_wire_rows = WIRE_ROWS
         # host packer accepts a full mesh's worth of events per flat batch
         from sitewhere_tpu.ops.pack import EventPacker
         self.packer = EventPacker(per_shard_batch * self.n_shards,

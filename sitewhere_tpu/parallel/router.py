@@ -73,33 +73,52 @@ class ShardRouter:
         # accelerator meshes, where device memory is separate and the H2D
         # copy is real (parallel/engine.py).
         self.staging_ring = staging_ring
-        self._pool: List[tuple] = []      # free (buffer, guard) pairs, FIFO
+        # Per-variant free lists (5-row full / 4-row compact), ONE shared
+        # allocation bound across variants — alternating traffic must not
+        # double the pooled memory. Entries are (buffer, guard) pairs,
+        # FIFO.
+        self._pools: Dict[int, List[tuple]] = {}
         self._pool_lock = None
-        self._pool_total = 0
+        self._pool_totals: Dict[int, int] = {}
+        # multi-host lockstep pins the wire variant (see route_batch)
+        self.fixed_wire_rows: Optional[int] = None
 
-    def _staging_buffer(self) -> Optional[np.ndarray]:
+    def _buf_rows(self, buf: np.ndarray) -> Optional[int]:
+        if (buf.ndim == 3 and buf.shape[0] == self.n_shards
+                and buf.shape[2] == self.per_shard_batch):
+            return buf.shape[1]
+        return None
+
+    def _staging_buffer(self, rows: int) -> Optional[np.ndarray]:
         import threading
-
-        from sitewhere_tpu.ops.pack import WIRE_ROWS
 
         if self.staging_ring <= 0:
             return None
         if self._pool_lock is None:
             self._pool_lock = threading.Lock()
         with self._pool_lock:
-            if self._pool:
-                buf, guard = self._pool.pop(0)
-            elif self._pool_total < self.staging_ring:
-                self._pool_total += 1
+            pool = self._pools.setdefault(rows, [])
+            if pool:
+                buf, guard = pool.pop(0)
+            elif sum(self._pool_totals.values()) < self.staging_ring:
+                # shared bound across variants
+                self._pool_totals[rows] = self._pool_totals.get(rows, 0) + 1
                 return np.empty(
-                    (self.n_shards, WIRE_ROWS, self.per_shard_batch),
-                    np.int32)
+                    (self.n_shards, rows, self.per_shard_batch), np.int32)
+            elif self._pools.get(5 if rows == 4 else 4):
+                # bound reached but the OTHER variant has a free buffer:
+                # retire it in favor of this variant (traffic switched)
+                other = 5 if rows == 4 else 4
+                self._pools[other].pop(0)
+                self._pool_totals[other] -= 1
+                self._pool_totals[rows] = self._pool_totals.get(rows, 0) + 1
+                return np.empty(
+                    (self.n_shards, rows, self.per_shard_batch), np.int32)
             else:
                 # every pooled buffer is on loan: allocate an untracked
                 # fresh one (returns beyond the pool bound are dropped)
                 return np.empty(
-                    (self.n_shards, WIRE_ROWS, self.per_shard_batch),
-                    np.int32)
+                    (self.n_shards, rows, self.per_shard_batch), np.int32)
         if guard is not None:
             # device_put's H2D DMA may still be reading the host buffer
             # (PJRT immutable-until-transfer-completes): repacking before
@@ -115,20 +134,21 @@ class ShardRouter:
         return buf
 
     def release_staging_buffer(self, buf: np.ndarray, guard=None) -> None:
-        """Return a loaned routed blob to the pool (bounded; extras drop).
+        """Return a loaned routed blob to its variant's pool (bounded;
+        extras drop).
 
         `guard`: optional device array whose readiness proves the blob's
         H2D transfer completed (see _staging_buffer) — pass the consuming
         step's output when the blob was device_put."""
         if self.staging_ring <= 0 or self._pool_lock is None:
             return
-        from sitewhere_tpu.ops.pack import WIRE_ROWS
-
-        if buf.shape != (self.n_shards, WIRE_ROWS, self.per_shard_batch):
+        rows = self._buf_rows(buf)
+        if rows is None:
             return
         with self._pool_lock:
-            if len(self._pool) < self.staging_ring:
-                self._pool.append((buf, guard))
+            pool = self._pools.setdefault(rows, [])
+            if len(pool) < self.staging_ring:
+                pool.append((buf, guard))
 
     def discard_staging_buffer(self, buf: np.ndarray) -> None:
         """Error-path drop of a loaned blob whose transfer state is
@@ -137,13 +157,12 @@ class ShardRouter:
         never recycle a possibly-in-DMA buffer."""
         if self.staging_ring <= 0 or self._pool_lock is None:
             return
-        from sitewhere_tpu.ops.pack import WIRE_ROWS
-
-        if buf.shape != (self.n_shards, WIRE_ROWS, self.per_shard_batch):
+        rows = self._buf_rows(buf)
+        if rows is None:
             return
         with self._pool_lock:
-            if self._pool_total > 0:
-                self._pool_total -= 1
+            if self._pool_totals.get(rows, 0) > 0:
+                self._pool_totals[rows] -= 1
 
     def route_batch(self, batch: EventBatch
                     ) -> Tuple[np.ndarray, np.ndarray]:
@@ -157,12 +176,19 @@ class ShardRouter:
         back to exactly the two-pass path when the native runtime is
         unavailable."""
         from sitewhere_tpu import native
-        from sitewhere_tpu.ops.pack import batch_to_blob
+        from sitewhere_tpu.ops.pack import batch_to_blob, wire_rows_for
 
         if native.available():
-            out = self._staging_buffer()
+            # Wire variant: per-batch compact decision — EXCEPT when
+            # pinned (fixed_wire_rows). Multi-host lockstep requires every
+            # host to launch the same-shaped collective program per tick;
+            # a host-local rows choice would desync the cluster, so the
+            # sharded engine pins the full layout under is_multiprocess.
+            rows = self.fixed_wire_rows or wire_rows_for(batch)
+            out = self._staging_buffer(rows)
             res = native.pack_route_blob(batch, self.n_shards,
-                                         self.per_shard_batch, out=out)
+                                         self.per_shard_batch, out=out,
+                                         wire_rows=rows)
             if res is not None:
                 return res
             # device_idx out of wire range: the buffer never reached jax,
@@ -205,14 +231,13 @@ class ShardRouter:
         per-column scatters; the numpy fallback routes the blob rows the
         same way route_columns routes the 12 column arrays."""
         from sitewhere_tpu import native
-        from sitewhere_tpu.ops.pack import (
-            WIRE_DEV_MAX, WIRE_ROWS, _VALID_SHIFT)
+        from sitewhere_tpu.ops.pack import WIRE_DEV_MAX, _VALID_SHIFT
 
         S, B = self.n_shards, self.per_shard_batch
         if native.available():
             return native.route_blob(blob, S, B)
         blob = np.asarray(blob, np.int32)
-        n = blob.shape[1]
+        wire_rows, n = blob.shape
         head = blob[0]
         rows = np.nonzero((head & (1 << _VALID_SHIFT)) != 0)[0]
         dev = head[rows] & (WIRE_DEV_MAX - 1)
@@ -224,12 +249,12 @@ class ShardRouter:
         starts = np.concatenate([[0], np.cumsum(counts)[:-1]])
         pos = np.arange(len(srows), dtype=np.int64) - starts[sshard]
         keep = pos < B
-        out = np.zeros((S, WIRE_ROWS, B), np.int32)
+        out = np.zeros((S, wire_rows, B), np.int32)
         ks, kp, krows = sshard[keep], pos[keep], srows[keep]
         kdev = head[krows] & (WIRE_DEV_MAX - 1)
         out[ks, 0, kp] = (head[krows] & ~np.int32(WIRE_DEV_MAX - 1)) \
             | (kdev // S)
-        for r in range(1, WIRE_ROWS):
+        for r in range(1, wire_rows):
             out[ks, r, kp] = blob[r, krows]
         return out, np.sort(srows[~keep])  # arrival order, like the native
 

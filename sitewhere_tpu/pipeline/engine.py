@@ -283,12 +283,15 @@ class PipelineEngine(LifecycleComponent):
 
     def _note_blob_guard(self, buf, guard) -> None:
         """Record the transfer-completion guard for a ring slot after its
-        blob was handed to jax (no-op for non-ring buffers)."""
+        blob was handed to jax (no-op for non-ring buffers). Compact
+        4-row blobs are VIEWS into the 5-row ring slots — match through
+        .base as well as identity."""
+        base = getattr(buf, "base", None)
         with self._blob_ring_lock:
             if self._blob_ring is None:
                 return
             for i, ring_buf in enumerate(self._blob_ring):
-                if ring_buf is buf:
+                if ring_buf is buf or ring_buf is base:
                     self._blob_ring_guards[i] = guard
                     return
 

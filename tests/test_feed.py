@@ -164,6 +164,110 @@ class TestFusedRouteBatch:
         assert (int(np.asarray(view.valid).sum())
                 == int(valid.sum()) - len(got_over))
 
+    def test_compact_variant_roundtrip_and_parity(self, rng):
+        """Batches with no elevation ride the 4-row compact wire variant
+        (16 B/event): pack -> route -> unpack must agree with the 5-row
+        path on every field, with elevation reading 0."""
+        import dataclasses
+
+        _, tensors = _world(n_devices=30, capacity=64)
+        engine = PipelineEngine(tensors, batch_size=64)
+        n = 64
+        batch = engine.packer.pack_columns(
+            rng.integers(1, 31, n).astype(np.int32),
+            rng.integers(0, 3, n).astype(np.int32),
+            rng.integers(0, 10 ** 6, n).astype(np.int64)
+            + engine.packer.epoch_base_ms,
+            mm_idx=rng.integers(0, 8, n).astype(np.int32),
+            value=rng.uniform(-5, 5, n).astype(np.float32),
+            lat=rng.uniform(-80, 80, n).astype(np.float32),
+            lon=rng.uniform(-170, 170, n).astype(np.float32),
+            alert_type_idx=rng.integers(0, 8, n).astype(np.int32),
+            alert_level=rng.integers(0, 4, n).astype(np.int32))
+        from sitewhere_tpu.ops.pack import WIRE_ROWS_COMPACT
+
+        blob = batch_to_blob(batch)
+        assert blob.shape[0] == WIRE_ROWS_COMPACT  # elevation all-zero
+        view = blob_to_batch_np(blob)
+        # wire payload rows are event-type unions: fields round-trip for
+        # the event types that carry them; others read 0
+        et = np.asarray(batch.event_type)
+        is_meas, is_loc, is_alert = et == 0, et == 1, et == 2
+        expected = batch.replace(
+            mm_idx=np.where(is_meas, batch.mm_idx, 0).astype(np.int32),
+            value=np.where(is_meas, batch.value, 0).astype(np.float32),
+            lat=np.where(is_loc, batch.lat, 0).astype(np.float32),
+            lon=np.where(is_loc, batch.lon, 0).astype(np.float32),
+            alert_type_idx=np.where(is_alert, batch.alert_type_idx,
+                                    0).astype(np.int32))
+        for f in dataclasses.fields(batch):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(view, f.name)),
+                np.asarray(getattr(expected, f.name)), err_msg=f.name)
+        # the fused router also rides the compact variant
+        router = ShardRouter(4, 32, staging_ring=4)
+        routed, over = router.route_batch(batch)
+        assert routed.shape[1] == WIRE_ROWS_COMPACT and len(over) == 0
+        routed_view = blob_to_batch_np(routed)
+        assert int(np.asarray(routed_view.valid).sum()) == n
+        assert not np.asarray(routed_view.elevation).any()
+        # any nonzero elevation anywhere forces the full 5-row layout
+        elev = np.zeros(n, np.float32)
+        elev[7] = 12.5
+        full = batch.replace(elevation=elev)
+        assert batch_to_blob(full).shape[0] == 5
+        routed5, _ = router.route_batch(full)
+        assert routed5.shape[1] == 5
+
+    def test_compact_step_matches_full(self):
+        """The fused step produces identical outputs/state whether the
+        batch arrived on the 4-row or (padded) 5-row wire."""
+        _, t1 = _world()
+        _, t2 = _world()
+        a = _engine(t1)
+        b = _engine(t2)
+        batches = _batches(a, 4)  # measurements: no elevation -> compact
+        outs_a = [a.submit(x) for x in batches]
+        # force the full layout on engine b by an explicit 5-row pack
+        from sitewhere_tpu.ops.pack import WIRE_ROWS
+
+        for x, want in zip(batches, outs_a):
+            blob5 = np.zeros((WIRE_ROWS, x.valid.shape[0]), np.int32)
+            blob5[:4] = batch_to_blob(x)
+            got = b.submit_blob(blob5)
+            assert int(got.processed) == int(want.processed)
+            assert int(got.alerts) == int(want.alerts)
+        import dataclasses
+        sa, sb = a.canonical_state(), b.canonical_state()
+        for f in dataclasses.fields(sa):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(sa, f.name)),
+                np.asarray(getattr(sb, f.name)), err_msg=f.name)
+
+    def test_fixed_wire_rows_pins_the_variant(self, rng):
+        """Multi-host lockstep pins the full layout: with fixed_wire_rows
+        set, even elevation-free batches route as 5 rows (every host must
+        launch the same-shaped collective program per tick)."""
+        _, tensors = _world(n_devices=30, capacity=64)
+        engine = PipelineEngine(tensors, batch_size=64)
+        batch = engine.packer.pack_columns(
+            rng.integers(1, 31, 64).astype(np.int32),
+            np.zeros(64, np.int32),
+            rng.integers(0, 10 ** 6, 64).astype(np.int64)
+            + engine.packer.epoch_base_ms,
+            value=rng.uniform(-5, 5, 64).astype(np.float32))
+        router = ShardRouter(4, 32, staging_ring=4)
+        compact, _ = router.route_batch(batch)
+        assert compact.shape[1] == 4
+        router.fixed_wire_rows = 5
+        pinned, _ = router.route_batch(batch)
+        assert pinned.shape[1] == 5
+        # pool bound is shared across variants: releasing both then
+        # cycling must not track more than staging_ring buffers total
+        router.release_staging_buffer(compact)
+        router.release_staging_buffer(pinned)
+        assert sum(router._pool_totals.values()) <= router.staging_ring
+
     def test_out_of_range_device_raises_shared_diagnostic(self):
         _, tensors = _world()
         engine = PipelineEngine(tensors, batch_size=8)
