@@ -73,13 +73,16 @@ class ShardRouter:
         # accelerator meshes, where device memory is separate and the H2D
         # copy is real (parallel/engine.py).
         self.staging_ring = staging_ring
-        # Per-variant free lists (5-row full / 4-row compact), ONE shared
-        # allocation bound across variants — alternating traffic must not
-        # double the pooled memory. Entries are (buffer, guard) pairs,
-        # FIFO.
+        # Free-list model (no allocation bookkeeping): _staging_buffer
+        # pops a FREE buffer of the right variant or allocates a fresh
+        # one; release_ appends to the free lists under ONE shared bound
+        # of `staging_ring` buffers across BOTH variants (5-row full /
+        # 4-row compact), preferring the variant just used — alternating
+        # traffic cannot double the pooled memory, and buffers never
+        # returned (error paths) are simply garbage-collected. Entries
+        # are (buffer, guard) pairs, FIFO.
         self._pools: Dict[int, List[tuple]] = {}
         self._pool_lock = None
-        self._pool_totals: Dict[int, int] = {}
         # multi-host lockstep pins the wire variant (see route_batch)
         self.fixed_wire_rows: Optional[int] = None
 
@@ -88,6 +91,9 @@ class ShardRouter:
                 and buf.shape[2] == self.per_shard_batch):
             return buf.shape[1]
         return None
+
+    def _free_count(self) -> int:
+        return sum(len(p) for p in self._pools.values())
 
     def _staging_buffer(self, rows: int) -> Optional[np.ndarray]:
         import threading
@@ -98,27 +104,10 @@ class ShardRouter:
             self._pool_lock = threading.Lock()
         with self._pool_lock:
             pool = self._pools.setdefault(rows, [])
-            if pool:
-                buf, guard = pool.pop(0)
-            elif sum(self._pool_totals.values()) < self.staging_ring:
-                # shared bound across variants
-                self._pool_totals[rows] = self._pool_totals.get(rows, 0) + 1
+            if not pool:
                 return np.empty(
                     (self.n_shards, rows, self.per_shard_batch), np.int32)
-            elif self._pools.get(5 if rows == 4 else 4):
-                # bound reached but the OTHER variant has a free buffer:
-                # retire it in favor of this variant (traffic switched)
-                other = 5 if rows == 4 else 4
-                self._pools[other].pop(0)
-                self._pool_totals[other] -= 1
-                self._pool_totals[rows] = self._pool_totals.get(rows, 0) + 1
-                return np.empty(
-                    (self.n_shards, rows, self.per_shard_batch), np.int32)
-            else:
-                # every pooled buffer is on loan: allocate an untracked
-                # fresh one (returns beyond the pool bound are dropped)
-                return np.empty(
-                    (self.n_shards, rows, self.per_shard_batch), np.int32)
+            buf, guard = pool.pop(0)
         if guard is not None:
             # device_put's H2D DMA may still be reading the host buffer
             # (PJRT immutable-until-transfer-completes): repacking before
@@ -134,8 +123,10 @@ class ShardRouter:
         return buf
 
     def release_staging_buffer(self, buf: np.ndarray, guard=None) -> None:
-        """Return a loaned routed blob to its variant's pool (bounded;
-        extras drop).
+        """Return a loaned routed blob to the free pool. ONE bound across
+        variants: when full, a free buffer of the OTHER variant is evicted
+        in favor of this one (traffic switched variants); otherwise the
+        extra simply drops to the garbage collector.
 
         `guard`: optional device array whose readiness proves the blob's
         H2D transfer completed (see _staging_buffer) — pass the consuming
@@ -146,23 +137,18 @@ class ShardRouter:
         if rows is None:
             return
         with self._pool_lock:
-            pool = self._pools.setdefault(rows, [])
-            if len(pool) < self.staging_ring:
-                pool.append((buf, guard))
+            if self._free_count() >= self.staging_ring:
+                other = self._pools.get(5 if rows == 4 else 4)
+                if not other:
+                    return  # bound reached by this variant: drop
+                other.pop(0)  # evict stale variant, keep the active one
+            self._pools.setdefault(rows, []).append((buf, guard))
 
     def discard_staging_buffer(self, buf: np.ndarray) -> None:
         """Error-path drop of a loaned blob whose transfer state is
-        unknown (e.g. a step dispatch failed mid-flight): untrack it so a
-        future allocation replaces it — never shrink the pool permanently,
-        never recycle a possibly-in-DMA buffer."""
-        if self.staging_ring <= 0 or self._pool_lock is None:
-            return
-        rows = self._buf_rows(buf)
-        if rows is None:
-            return
-        with self._pool_lock:
-            if self._pool_totals.get(rows, 0) > 0:
-                self._pool_totals[rows] -= 1
+        unknown (e.g. a step dispatch failed mid-flight): simply do not
+        pool it — a later route allocates fresh; nothing to untrack."""
+        return
 
     def route_batch(self, batch: EventBatch
                     ) -> Tuple[np.ndarray, np.ndarray]:
@@ -198,7 +184,16 @@ class ShardRouter:
                 self.release_staging_buffer(out)
             batch_to_blob(batch)
             raise AssertionError("unreachable: numpy pack must have raised")
-        return self.route_blob(batch_to_blob(batch))
+        blob = batch_to_blob(batch)
+        if (self.fixed_wire_rows is not None
+                and blob.shape[0] != self.fixed_wire_rows):
+            # the lockstep pin applies on the numpy fallback too: pad the
+            # compact blob to the pinned layout (extra rows are zeros —
+            # elevation 0 — exactly the full-layout encoding)
+            full = np.zeros((self.fixed_wire_rows, blob.shape[1]), np.int32)
+            full[:blob.shape[0]] = blob
+            blob = full
+        return self.route_blob(blob)
 
     def global_to_local(self, device_idx: np.ndarray
                         ) -> Tuple[np.ndarray, np.ndarray]:

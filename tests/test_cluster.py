@@ -236,6 +236,10 @@ bus0, bus1 = int(sys.argv[3]), int(sys.argv[4])
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
 import jax
+# axon ignores the JAX_PLATFORMS env var; the config update is
+# honored (see __graft_entry__.dryrun_multichip) — without it a
+# child can grab the tunneled TPU and build a 1-device mesh
+jax.config.update("jax_platforms", "cpu")
 jax.distributed.initialize(coordinator_address=coord, num_processes=2,
                            process_id=pid)
 import msgpack
@@ -429,6 +433,10 @@ data_root = sys.argv[5]; phase = int(sys.argv[6])
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
 import jax
+# axon ignores the JAX_PLATFORMS env var; the config update is
+# honored (see __graft_entry__.dryrun_multichip) — without it a
+# child can grab the tunneled TPU and build a 1-device mesh
+jax.config.update("jax_platforms", "cpu")
 jax.distributed.initialize(coordinator_address=coord, num_processes=2,
                            process_id=pid)
 import msgpack
