@@ -81,10 +81,21 @@ class SiteWhereInstance(LifecycleComponent):
         self.registry_tensors = None
         self.pipeline_engine = None
         if enable_pipeline:
+            from sitewhere_tpu.parallel.mesh import shard_axis_size
             from sitewhere_tpu.registry.tensors import RegistryTensors
+            n_shards = (shard_axis_size(mesh) if mesh is not None
+                        else max(1, shards))
+            if max_devices % max(1, n_shards):
+                raise ValueError(
+                    f"max_devices {max_devices} must be divisible by "
+                    f"{n_shards} shards")
+            # shard-congruent device interning: ownership (idx % S) is a
+            # pure function of the token, so cluster hosts need not
+            # provision in identical order (registry/tensors.py)
             self.registry_tensors = RegistryTensors(
                 max_devices=max_devices, max_zones=max_zones,
-                max_zone_vertices=max_zone_vertices)
+                max_zone_vertices=max_zone_vertices,
+                shard_classes=n_shards)
             if shards > 1 or mesh is not None:
                 # SPMD hot path over a device mesh (config model's
                 # pipeline.shards; parallel/engine.py). An explicit `mesh`

@@ -70,7 +70,7 @@ def _load(_retry: bool = True) -> None:
     # from source once.
     try:
         lib.swt_version.restype = i32
-        stale = lib.swt_version() != 5
+        stale = lib.swt_version() != 7
     except AttributeError:
         stale = True
     if stale:
@@ -103,6 +103,8 @@ def _load(_retry: bool = True) -> None:
     lib.swt_interner_add.restype = i32
     lib.swt_interner_token_at.argtypes = [vp, i32, c.c_char_p, i32]
     lib.swt_interner_token_at.restype = i32
+    lib.swt_interner_set_at.argtypes = [vp, i32, c.c_char_p, i32]
+    lib.swt_interner_set_at.restype = i32
     lib.swt_interner_lookup_offsets.argtypes = [vp, c.c_char_p, p_i64, i32,
                                                 p_i32]
     lib.swt_interner_lookup_offsets.restype = i32
@@ -178,6 +180,13 @@ class NativeInterner:
         """Get-or-assign; -1 signals capacity exceeded."""
         raw = token.encode(errors="surrogateescape")
         return LIB.swt_interner_add(self._h, raw, len(raw))
+
+    def set_at(self, idx: int, token: str) -> int:
+        """Overwrite a gap-placeholder slot with a real token (the
+        shard-congruent allocator). 0 ok, -1 bad index, -2 token exists
+        at a different index."""
+        raw = token.encode(errors="surrogateescape")
+        return LIB.swt_interner_set_at(self._h, idx, raw, len(raw))
 
     def token_at(self, idx: int) -> Optional[str]:
         cap = 1024

@@ -44,8 +44,12 @@ inline size_t next_pow2(size_t v) {
 }
 
 struct Interner {
+  // 4x capacity hash slots: worst case holds `capacity` tokens PLUS one
+  // dangling placeholder entry per swt_interner_set_at overwrite (the
+  // shard-congruent allocator), i.e. up to 2*capacity entries — 4x keeps
+  // the load factor <= 0.5 so open-addressing probes stay short.
   explicit Interner(int32_t capacity)
-      : capacity(capacity), mask(next_pow2(static_cast<size_t>(capacity) * 2) - 1),
+      : capacity(capacity), mask(next_pow2(static_cast<size_t>(capacity) * 4) - 1),
         slots(mask + 1, -1), hashes(mask + 1, 0) {
     tokens.reserve(capacity);
     tokens.emplace_back();  // index 0 = UNKNOWN sentinel, never matched
@@ -58,8 +62,13 @@ struct Interner {
   std::vector<std::string> tokens;  // index -> bytes
   mutable std::shared_mutex mu;
 
-  // Requires at least a shared lock.
+  // Requires at least a shared lock. NUL-prefixed tokens are gap
+  // placeholders of the shard-congruent allocator: they must NEVER
+  // satisfy a lookup (a wire token with those bytes would otherwise be
+  // attributed to a gap row — or a later real device's row), whether the
+  // placeholder is still live or already overwritten via set_at.
   int32_t find(const char* tok, int64_t len, uint64_t h) const {
+    if (len > 0 && tok[0] == '\0') return -1;
     size_t slot = h & mask;
     while (true) {
       int32_t idx = slots[slot];
@@ -93,7 +102,7 @@ struct Interner {
 
 extern "C" {
 
-int32_t swt_version() { return 5; }
+int32_t swt_version() { return 7; }
 
 void* swt_interner_create(int32_t capacity) {
   if (capacity < 2) return nullptr;
@@ -119,6 +128,29 @@ int32_t swt_interner_add(void* h, const char* tok, int32_t len) {
   }
   std::unique_lock<std::shared_mutex> lock(in->mu);
   return in->add(tok, len, hash);
+}
+
+// Overwrite the token at an EXISTING index (a gap placeholder from the
+// shard-congruent allocator — registry/interning.py). The real token is
+// inserted into the hash pointing at idx; the placeholder's hash entry is
+// left dangling (its \x00-prefixed token can never collide with a real
+// lookup), and the token table slot is replaced so token_at/snapshot read
+// the real token. Returns 0, -1 for an out-of-range idx, -2 when the
+// token already exists at a DIFFERENT index (caller bug).
+int32_t swt_interner_set_at(void* h, int32_t idx, const char* tok,
+                            int32_t len) {
+  Interner* in = static_cast<Interner*>(h);
+  uint64_t hash = fnv1a(tok, len);
+  std::unique_lock<std::shared_mutex> lock(in->mu);
+  if (idx <= 0 || idx >= static_cast<int32_t>(in->tokens.size())) return -1;
+  int32_t existing = in->find(tok, len, hash);
+  if (existing >= 0) return existing == idx ? 0 : -2;
+  in->tokens[static_cast<size_t>(idx)].assign(tok, static_cast<size_t>(len));
+  size_t slot = hash & in->mask;
+  while (in->slots[slot] >= 0) slot = (slot + 1) & in->mask;
+  in->slots[slot] = idx;
+  in->hashes[slot] = hash;
+  return 0;
 }
 
 // Copy token bytes for index `idx` into out (cap bytes); returns byte

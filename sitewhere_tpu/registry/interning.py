@@ -31,18 +31,37 @@ class TokenInterner:
 
     Index 0 is reserved as UNKNOWN so that lookup tensors can keep a sentinel
     row and failed lookups stay in-band on device.
+
+    ``shard_classes`` > 1 turns on SHARD-CONGRUENT allocation: a token's
+    index is chosen within the congruence class ``crc32(token) % classes``
+    (the same keying the bus uses for partitions), so the engine's
+    structural shard mapping ``idx % S`` depends only on the token — NEVER
+    on per-host creation order. That is what lets cluster hosts provision
+    in different orders yet agree on device ownership
+    (parallel/cluster.py owner_process). The index table becomes sparse
+    (gap slots are None; the native mirror holds un-lookupable
+    placeholders overwritten in place via set_at); capacity is effectively
+    per class (capacity/classes devices per shard family). classes == 1
+    is the exact sequential behavior every other interner uses.
     """
 
     UNKNOWN = 0
 
-    def __init__(self, capacity: int, name: str = "tokens"):
+    def __init__(self, capacity: int, name: str = "tokens",
+                 shard_classes: int = 1):
         if capacity < 2:
             raise ValueError("capacity must be >= 2")
+        if shard_classes < 1 or shard_classes >= capacity:
+            raise ValueError("shard_classes must be in [1, capacity)")
         self.capacity = capacity
         self.name = name
+        self.shard_classes = shard_classes
         self._to_index: Dict[str, int] = {}
         self._to_token: List[Optional[str]] = [None]  # index 0 = UNKNOWN
         self._lock = threading.Lock()
+        # per-class next-candidate index (class 0 starts past the
+        # reserved UNKNOWN slot)
+        self._class_next: Dict[int, int] = {}
         # Bumped on every mutation INCLUDING restore(): length alone is not
         # a valid cache key for snapshot consumers — a checkpoint restore
         # can swap same-length contents.
@@ -53,11 +72,75 @@ class TokenInterner:
     def __len__(self) -> int:
         return len(self._to_token)
 
-    def _raise_capacity(self):
+    def _raise_capacity(self, congruence_class: Optional[int] = None):
         from sitewhere_tpu.errors import ErrorCode, SiteWhereError
+        if congruence_class is not None:
+            # per-class exhaustion can hit while the table is mostly
+            # empty (crc32 skew): name the real limit so operators don't
+            # chase the global capacity number
+            per_class = self.capacity // self.shard_classes
+            raise SiteWhereError(
+                f"interner '{self.name}' congruence class "
+                f"{congruence_class} exhausted (~{per_class} slots per "
+                f"class = capacity {self.capacity} / {self.shard_classes} "
+                f"shard classes; raise max_devices)",
+                ErrorCode.CAPACITY_EXCEEDED)
         raise SiteWhereError(
             f"interner '{self.name}' capacity {self.capacity} exceeded",
             ErrorCode.CAPACITY_EXCEEDED)
+
+    def _mirror_sync_error(self, nidx: int, idx: int):
+        # survives `python -O`, unlike an assert: a silent native/Python
+        # desync would corrupt every later native-path lookup
+        from sitewhere_tpu.errors import ErrorCode, SiteWhereError
+        raise SiteWhereError(
+            f"interner '{self.name}' native mirror out of sync "
+            f"(native {nidx} != {idx})", ErrorCode.GENERIC)
+
+    def _gap_token(self, idx: int) -> str:
+        # \x00-prefixed: no wire/API token starts with NUL, so a gap
+        # placeholder can never satisfy a real lookup
+        return f"\x00gap{idx}"
+
+    def _class_of(self, token: str) -> int:
+        import zlib
+
+        return zlib.crc32(token.encode(errors="surrogateescape")) \
+            % self.shard_classes
+
+    def _intern_congruent(self, token: str) -> int:
+        """Assign within the token's congruence class (caller holds lock)."""
+        cls = self._class_of(token)
+        start = cls if cls != 0 else self.shard_classes
+        idx = self._class_next.get(cls, start)
+        # skip slots already occupied (e.g. restored snapshots)
+        while idx < len(self._to_token) and self._to_token[idx] is not None:
+            idx += self.shard_classes
+        if idx >= self.capacity:
+            self._raise_capacity(congruence_class=cls)
+        if idx < len(self._to_token):
+            # a gap slot left by another class growing past: overwrite in
+            # place (native set_at replaces the placeholder)
+            self._to_token[idx] = token
+            if self._nat is not None:
+                if self._nat.set_at(idx, token) != 0:
+                    self._mirror_sync_error(-1, idx)
+        else:
+            while len(self._to_token) < idx:
+                gap = len(self._to_token)
+                self._to_token.append(None)
+                if self._nat is not None:
+                    if self._nat.add(self._gap_token(gap)) != gap:
+                        self._mirror_sync_error(-1, gap)
+            self._to_token.append(token)
+            if self._nat is not None:
+                nidx = self._nat.add(token)
+                if nidx != idx:
+                    self._mirror_sync_error(nidx, idx)
+        self._to_index[token] = idx
+        self._class_next[cls] = idx + self.shard_classes
+        self.version += 1
+        return idx
 
     def intern(self, token: str) -> int:
         """Get-or-assign the index for a token."""
@@ -68,6 +151,8 @@ class TokenInterner:
             idx = self._to_index.get(token)
             if idx is not None:
                 return idx
+            if self.shard_classes > 1:
+                return self._intern_congruent(token)
             idx = len(self._to_token)
             if idx >= self.capacity:
                 self._raise_capacity()
@@ -77,13 +162,7 @@ class TokenInterner:
             if self._nat is not None:
                 nidx = self._nat.add(token)
                 if nidx != idx:
-                    # survives `python -O`, unlike an assert: a silent
-                    # native/Python desync would corrupt every later
-                    # native-path lookup
-                    from sitewhere_tpu.errors import ErrorCode, SiteWhereError
-                    raise SiteWhereError(
-                        f"interner '{self.name}' native mirror out of sync "
-                        f"(native {nidx} != {idx})", ErrorCode.GENERIC)
+                    self._mirror_sync_error(nidx, idx)
             return idx
 
     def lookup(self, token: str) -> int:
@@ -117,7 +196,10 @@ class TokenInterner:
             dtype=np.int32, count=n)
 
     def intern_batch(self, tokens: Iterable[str]) -> np.ndarray:
-        if self._nat is None:
+        if self._nat is None or self.shard_classes > 1:
+            # congruent allocation goes token-by-token (the native bulk
+            # assign is sequential-only); no current congruent interner
+            # uses the bulk path on a hot loop
             return np.fromiter((self.intern(t) for t in tokens),
                                dtype=np.int32)
         tokens = list(tokens)
@@ -133,7 +215,7 @@ class TokenInterner:
         """intern_batch over a (joined bytes, offsets) pair. skip_empty maps
         zero-length tokens to UNKNOWN without interning (absent fields in
         decoded columns)."""
-        if self._nat is None:
+        if self._nat is None or self.shard_classes > 1:
             n = len(off) - 1
 
             def one(i):
@@ -177,9 +259,34 @@ class TokenInterner:
             # _to_token and _to_index answering from different snapshots
             if len(incoming) > self.capacity:
                 self._raise_capacity()
+            if self.shard_classes > 1:
+                # a snapshot from a sequential (pre-congruent) or
+                # different-S layout would silently break the ownership
+                # contract (idx % S must equal crc32(token) % S for every
+                # device) — refuse loudly instead of misrouting forever
+                bad = [t for i, t in enumerate(incoming)
+                       if t is not None and i > 0
+                       and i % self.shard_classes != self._class_of(t)]
+                if bad:
+                    raise ValueError(
+                        f"interner '{self.name}' snapshot is not "
+                        f"congruent with {self.shard_classes} shard "
+                        f"classes ({len(bad)} tokens at non-congruent "
+                        f"indices, e.g. {bad[0]!r}); it was taken on a "
+                        f"different shard layout — restore it onto the "
+                        f"original layout, or re-provision")
             self._to_token = incoming
             self._to_index = {t: i for i, t in enumerate(self._to_token)
                               if t is not None}
+            # congruent allocator: resume each class past its restored max
+            self._class_next = {}
+            if self.shard_classes > 1:
+                for idx, token in enumerate(self._to_token):
+                    if token is not None and idx > 0:
+                        cls = idx % self.shard_classes
+                        self._class_next[cls] = max(
+                            self._class_next.get(cls, 0),
+                            idx + self.shard_classes)
             self.version += 1
             if self._nat is not None:
                 nat = _native()

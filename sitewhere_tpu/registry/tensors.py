@@ -64,8 +64,15 @@ class RegistryTensors:
 
     def __init__(self, max_devices: int, max_zones: int, max_zone_vertices: int,
                  device_interner: Optional[TokenInterner] = None,
-                 tenant_interner: Optional[TokenInterner] = None):
-        self.devices = device_interner or TokenInterner(max_devices, "devices")
+                 tenant_interner: Optional[TokenInterner] = None,
+                 shard_classes: int = 1):
+        # shard_classes = the device mesh size: device indices allocate
+        # within crc32(token) % S congruence classes so shard ownership
+        # (idx % S) depends only on the token, never on creation order —
+        # cluster hosts provisioned in different orders still agree on
+        # which host owns which device (registry/interning.py)
+        self.devices = device_interner or TokenInterner(
+            max_devices, "devices", shard_classes=shard_classes)
         self.tenants = tenant_interner or TokenInterner(64, "tenants")
         self.areas = TokenInterner(4096, "areas")
         self.device_types = TokenInterner(4096, "device_types")

@@ -268,16 +268,18 @@ cluster.start()
 engine = instance.pipeline_engine
 assert engine.is_multiprocess
 
-# identical provisioning on both hosts (deterministic interning)
+# both hosts provision the same device SET — in OPPOSITE orders:
+# shard-congruent interning (registry/interning.py) makes ownership a
+# pure function of the token, so creation order must not matter
 te = instance.get_tenant_engine("default")
 dt = te.registry.create_device_type(DeviceType(token="dt"))
-tokens = []
-for i in range(8):
-    d = te.registry.create_device(Device(token=f"cd{i}",
+tokens = [f"cd{i}" for i in range(8)]
+order = tokens if pid == 0 else list(reversed(tokens))
+for tok in order:
+    d = te.registry.create_device(Device(token=tok,
                                          device_type_id=dt.id))
     te.registry.create_device_assignment(
-        DeviceAssignment(token=f"ca{i}", device_id=d.id))
-    tokens.append(f"cd{i}")
+        DeviceAssignment(token="ca" + tok[2:], device_id=d.id))
 engine.packer.measurements.intern("temp")
 engine.add_threshold_rule(ThresholdRule(
     token="hot", measurement_name="temp", operator=">", threshold=50.0))

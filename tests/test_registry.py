@@ -197,3 +197,77 @@ class TestRegistryTensors:
         snap = tensors.snapshot()
         row = tensors.zones_interner.lookup("line") - 1
         assert not snap.zone_active[row]
+
+
+class TestShardCongruentInterning:
+    """shard_classes > 1: device index allocation within crc32(token) % S
+    congruence classes — shard ownership (idx % S) is a pure function of
+    the token, independent of per-host creation order (the cluster
+    ownership contract, parallel/cluster.py owner_process)."""
+
+    S = 8
+
+    def _cls(self, token):
+        import zlib
+        return zlib.crc32(token.encode()) % self.S
+
+    def test_order_independent_ownership(self):
+        from sitewhere_tpu.registry.interning import TokenInterner
+        tokens = [f"dev-{i}" for i in range(40)]
+        fwd = TokenInterner(256, "fwd", shard_classes=self.S)
+        rev = TokenInterner(256, "rev", shard_classes=self.S)
+        ia = {t: fwd.intern(t) for t in tokens}
+        ib = {t: rev.intern(t) for t in reversed(tokens)}
+        for t in tokens:
+            assert ia[t] % self.S == self._cls(t)
+            assert ia[t] % self.S == ib[t] % self.S
+            assert fwd.token_of(ia[t]) == t
+            assert fwd.lookup(t) == ia[t]
+        # native mirror answers identically through gap-overwritten slots
+        assert list(fwd.lookup_batch(tokens)) == [ia[t] for t in tokens]
+
+    def test_snapshot_restore_with_gaps(self):
+        from sitewhere_tpu.registry.interning import TokenInterner
+        src = TokenInterner(256, "src", shard_classes=self.S)
+        tokens = [f"dev-{i}" for i in range(17)]
+        idx = {t: src.intern(t) for t in tokens}
+        dst = TokenInterner(256, "dst", shard_classes=self.S)
+        dst.restore(src.snapshot())
+        assert all(dst.lookup(t) == idx[t] for t in tokens)
+        assert list(dst.lookup_batch(tokens)) == [idx[t] for t in tokens]
+        # allocation resumes in the right classes after restore
+        extra = dst.intern("post-restore")
+        assert extra % self.S == self._cls("post-restore")
+        assert dst.token_of(extra) == "post-restore"
+
+    def test_per_class_capacity(self):
+        from sitewhere_tpu.registry.interning import TokenInterner
+        interner = TokenInterner(16, "cap", shard_classes=self.S)
+        # three tokens of one class into 16/8 = 2 slots per class
+        same = [t for t in (f"tok{i}" for i in range(500))
+                if self._cls(t) == 3][:3]
+        interner.intern(same[0])
+        interner.intern(same[1])
+        with pytest.raises(SiteWhereError):
+            interner.intern(same[2])
+
+    def test_classes_1_is_sequential(self):
+        from sitewhere_tpu.registry.interning import TokenInterner
+        interner = TokenInterner(16, "seq")
+        assert [interner.intern(f"x{i}") for i in range(5)] == [1, 2, 3, 4, 5]
+
+    def test_registry_tensors_wiring(self):
+        dm, dtype, area = make_registry()
+        tensors = RegistryTensors(max_devices=64, max_zones=4,
+                                  max_zone_vertices=8, shard_classes=self.S)
+        for i in range(10):
+            register(dm, dtype, area, f"cg-{i}")
+        tensors.attach(dm, "acme")
+        for i in range(10):
+            idx = tensors.devices.lookup(f"cg-{i}")
+            assert idx > 0 and idx % self.S == self._cls(f"cg-{i}")
+        snap = tensors.snapshot()
+        # registered rows live at the congruent indices
+        for i in range(10):
+            assert snap.assignment_status[tensors.devices.lookup(f"cg-{i}")] \
+                == int(DeviceAssignmentStatus.ACTIVE)
