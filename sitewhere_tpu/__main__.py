@@ -182,7 +182,8 @@ def _serve_cluster(cfg) -> int:
         fail_after_s=float(cfg.get("cluster.fail_after_s")),
         presence_every_ticks=int(cfg.get("cluster.presence_every_ticks")),
         exit_on_peer_loss=bool(cfg.get("cluster.exit_on_peer_loss")),
-        peer_loss_exit_code=int(cfg.get("cluster.peer_loss_exit_code")))
+        peer_loss_exit_code=int(cfg.get("cluster.peer_loss_exit_code")),
+        registry_gossip=bool(cfg.get("cluster.registry_gossip")))
     cluster.start()
     rest = RestServer(instance, host=cfg.get("api.host"),
                       port=int(cfg.get("api.port")),

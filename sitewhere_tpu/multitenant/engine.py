@@ -73,6 +73,12 @@ class TenantEngine(LifecycleComponent):
                                                 tenant.token)
         if registry_tensors is not None:
             registry_tensors.attach(self.registry, tenant.token)
+        if cluster is not None and hasattr(cluster, "gossip") \
+                and cluster.gossip is not None:
+            # cross-host registry replication: this tenant's mutations
+            # broadcast to peers; theirs apply here (cluster.py)
+            cluster.gossip.register_tenant_registry(tenant.token,
+                                                    self.registry)
 
         # event persistence + triggers
         self.event_management = DeviceEventManagement(

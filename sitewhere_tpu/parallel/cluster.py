@@ -712,6 +712,241 @@ class PeerWatchdog:
 
 
 # ---------------------------------------------------------------------------
+# gossip registry replication
+# ---------------------------------------------------------------------------
+
+REGISTRY_GOSSIP_SUFFIX = "registry-model-updates"
+
+# entity kinds that replicate, with their reference fields resolved by
+# TOKEN on the wire (entity ids are per-host UUIDs except when the
+# creating host's id is adopted at create time): (id_field, collection)
+_GOSSIP_REFS = {
+    "device_type": [],
+    "area": [("area_type_id", "area_types"), ("parent_area_id", "areas")],
+    "zone": [("area_id", "areas")],
+    "device": [("device_type_id", "device_types")],
+    "assignment": [("device_id", "devices"),
+                   ("device_type_id", "device_types"),
+                   ("area_id", "areas"), ("customer_id", "customers")],
+}
+_GOSSIP_CLASSES = {}  # kind -> model class, resolved lazily
+
+
+def _gossip_class(kind: str):
+    if not _GOSSIP_CLASSES:
+        from sitewhere_tpu.model import (
+            Area, Device, DeviceAssignment, DeviceType, Zone)
+
+        _GOSSIP_CLASSES.update({
+            "device_type": DeviceType, "area": Area, "zone": Zone,
+            "device": Device, "assignment": DeviceAssignment})
+    return _GOSSIP_CLASSES.get(kind)
+
+
+def registry_gossip_topic(naming: TopicNaming) -> str:
+    return naming._global(REGISTRY_GOSSIP_SUFFIX)
+
+
+class RegistryGossip:
+    """Leaderless cross-host registry replication.
+
+    The reference gets cross-process registry consistency from a shared
+    database; here every host broadcasts its registry mutations to its
+    peers' bus edges and applies incoming ones idempotently. No
+    sequencer is needed because shard OWNERSHIP no longer depends on
+    creation order (shard-congruent interning, registry/interning.py) —
+    hosts only need to converge on CONTENT, and the misroute guards
+    cover the convergence window.
+
+    Mechanics: entity references travel by TOKEN (ids are per-host
+    UUIDs; a brand-new entity adopts the creating host's id, an existing
+    one keeps its local id). An applier whose dependency has not arrived
+    yet raises — the consumer's at-least-once redelivery retries until
+    the dependency converges, and a genuine conflict (e.g. a device
+    already actively assigned elsewhere) parks on the dead-letter
+    surface for the operator. Deletions do not replicate (admin ops are
+    applied per host; documented).
+    """
+
+    def __init__(self, process_id: int, peers: Dict[int, BusClient],
+                 instance, naming: TopicNaming):
+        self.process_id = process_id
+        self.peers = peers
+        self.instance = instance
+        self.topic = registry_gossip_topic(naming)
+        self.published = 0
+        self.applied = 0
+        self.conflicts = 0
+        self.publish_errors = 0
+        self._applying = threading.local()
+        self._registries: Dict[str, object] = {}
+        self._host = ConsumerHost(instance.bus, self.topic,
+                                  group_id=f"registry-gossip-{process_id}",
+                                  handler=self._handle)
+
+    # -- publish side ------------------------------------------------------
+    def register_tenant_registry(self, tenant_token: str, registry) -> None:
+        """Called by TenantEngine construction: subscribe to this
+        tenant's registry mutations."""
+        self._registries[tenant_token] = registry
+        registry.add_listener(
+            lambda kind, entity, _t=tenant_token, _r=registry:
+            self._on_mutation(_t, _r, kind, entity))
+
+    def _on_mutation(self, tenant: str, registry, kind, entity) -> None:
+        if getattr(self._applying, "active", False):
+            return  # echo of an applied peer mutation
+        if _gossip_class(kind) is None or not self.peers:
+            return
+        from sitewhere_tpu.web.marshal import to_jsonable
+
+        try:
+            refs = {}
+            for field, coll_name in _GOSSIP_REFS.get(kind, []):
+                ref_id = getattr(entity, field, None)
+                if ref_id:
+                    ref = getattr(registry, coll_name).get(ref_id)
+                    if ref is not None:
+                        refs[field] = ref.token
+            payload = msgpack.packb(
+                {"tenant": tenant, "kind": kind,
+                 "entity": to_jsonable(entity), "refs": refs},
+                use_bin_type=True)
+        except Exception:
+            LOGGER.exception("registry gossip encode failed (%s)", kind)
+            return
+        key = getattr(entity, "token", "").encode()
+        for pid, client in self.peers.items():
+            try:
+                client.publish(self.topic, key, payload)
+                self.published += 1
+            except BusNetError:
+                self.publish_errors += 1
+                # park for operator replay toward the peer
+                self.instance.bus.publish(f"{self.topic}.dead-letter",
+                                          key, payload)
+
+    # -- apply side --------------------------------------------------------
+    def start(self) -> None:
+        self._host.start()
+
+    def stop(self) -> None:
+        self._host.stop()
+
+    def _handle(self, records: List[Record]) -> None:
+        for record in records:
+            data = msgpack.unpackb(record.value, raw=False)
+            self._applying.active = True
+            try:
+                self._apply(data)
+            finally:
+                self._applying.active = False
+
+    def _apply(self, data: Dict) -> None:
+        from sitewhere_tpu.errors import (
+            DuplicateTokenError, NotFoundError, SiteWhereError)
+        from sitewhere_tpu.web.marshal import entity_from_payload
+
+        from sitewhere_tpu.errors import ErrorCode
+
+        kind = data.get("kind")
+        cls = _gossip_class(kind)
+        if cls is None:
+            return
+        engine = self.instance.get_tenant_engine(data.get("tenant", ""))
+        if engine is None:
+            raise NotFoundError(
+                f"gossip for unknown tenant {data.get('tenant')!r}",
+                ErrorCode.INVALID_TENANT_TOKEN)
+        registry = engine.registry
+        entity_data = dict(data.get("entity") or {})
+        token = entity_data.get("token", "")
+        # remap reference ids through tokens; a missing dependency raises
+        # -> the batch redelivers until the dependency gossip arrives
+        for field, coll_name in _GOSSIP_REFS.get(kind, []):
+            ref_token = (data.get("refs") or {}).get(field)
+            if ref_token:
+                local = getattr(registry, coll_name).get_by_token(ref_token)
+                if local is None:
+                    raise NotFoundError(
+                        f"gossip dependency {coll_name}:{ref_token!r} not "
+                        f"yet replicated", ErrorCode.GENERIC)
+                entity_data[field] = local.id
+        existing = self._get_by_token(registry, kind, token)
+        if existing is None:
+            entity = entity_from_payload(cls, entity_data)
+            try:
+                self._create(registry, kind, entity)
+                self.applied += 1
+            except DuplicateTokenError:
+                pass  # raced another replica of the same create
+            except SiteWhereError:
+                # genuine conflict (e.g. device already actively
+                # assigned): re-raise -> retry budget -> dead-letter
+                self.conflicts += 1
+                raise
+        else:
+            self._update_existing(registry, kind, token, existing,
+                                  entity_data)
+
+    @staticmethod
+    def _get_by_token(registry, kind: str, token: str):
+        return {
+            "device_type": registry.device_types,
+            "area": registry.areas,
+            "zone": registry.zones,
+            "device": registry.devices,
+            "assignment": registry.assignments,
+        }[kind].get_by_token(token)
+
+    @staticmethod
+    def _create(registry, kind: str, entity) -> None:
+        {"device_type": registry.create_device_type,
+         "area": registry.create_area,
+         "zone": registry.create_zone,
+         "device": registry.create_device,
+         "assignment": registry.create_device_assignment}[kind](entity)
+
+    def _update_existing(self, registry, kind: str, token: str, existing,
+                         entity_data: Dict) -> None:
+        from sitewhere_tpu.model import DeviceAssignmentStatus
+
+        if kind == "assignment":
+            # lifecycle transitions replicate through their real methods
+            # (they maintain the active-assignment index)
+            status = entity_data.get("status")
+            if status in (DeviceAssignmentStatus.RELEASED,
+                          DeviceAssignmentStatus.RELEASED.value,
+                          DeviceAssignmentStatus.RELEASED.name) \
+                    and existing.status == DeviceAssignmentStatus.ACTIVE:
+                registry.release_device_assignment(token)
+                self.applied += 1
+            return
+        update = {"device_type": registry.update_device_type,
+                  "device": registry.update_device,
+                  "zone": registry.update_zone}.get(kind)
+        if update is None:
+            return  # kinds without an update surface converge on create
+        import dataclasses as _dc
+
+        skip = {"id", "token", "created_date", "updated_date"}
+        fields = {f.name for f in _dc.fields(type(existing))} - skip
+        from sitewhere_tpu.web.marshal import to_jsonable
+
+        current = to_jsonable(existing)
+        diff = {k: v for k, v in entity_data.items()
+                if k in fields and current.get(k) != v}
+        if diff:
+            try:
+                update(token, diff)
+                self.applied += 1
+            except Exception:
+                self.conflicts += 1
+                LOGGER.exception("gossip update of %s %r failed", kind,
+                                 token)
+
+
+# ---------------------------------------------------------------------------
 # composition root: one cluster host
 # ---------------------------------------------------------------------------
 
@@ -740,7 +975,8 @@ class ClusterService:
                  presence_every_ticks: int = 0,
                  idle_interval_s: float = 0.005,
                  exit_on_peer_loss: bool = False,
-                 peer_loss_exit_code: int = 13):
+                 peer_loss_exit_code: int = 13,
+                 registry_gossip: bool = True):
         from sitewhere_tpu.runtime.busnet import BusServer
 
         engine = instance.pipeline_engine
@@ -785,6 +1021,8 @@ class ClusterService:
         self.reporter = ProcessStateReporter(
             process_id, instance.bus, naming, self.peers,
             build_state=self._build_state, interval_s=heartbeat_s)
+        self.gossip = (RegistryGossip(process_id, self.peers, instance,
+                                      naming) if registry_gossip else None)
         self.aggregator = TopologyAggregator(
             instance.bus, naming, stale_after_s=stale_after_s)
         expected_peers = [p for p in range(num_processes)
@@ -883,13 +1121,17 @@ class ClusterService:
                                  "for %s", token)
 
     def _build_state(self) -> Dict:
-        return {
+        state = {
             "instance_id": self.instance.instance_id,
             "status": self.instance.status.name,
             "tick": self.loop.tick_count,
             "forwarded_rows": self.forwarder.forwarded,
             "consumed_foreign": self.foreign_consumer.consumed_rows,
         }
+        if self.gossip is not None:
+            state["gossip_published"] = self.gossip.published
+            state["gossip_applied"] = self.gossip.applied
+        return state
 
     def _on_fatal(self, exc: BaseException) -> None:
         LOGGER.critical("cluster host %d step loop fatal: %s",
@@ -926,12 +1168,16 @@ class ClusterService:
         self.instance.start()
         self.loop.start()
         self.foreign_consumer.start()
+        if self.gossip is not None:
+            self.gossip.start()
         self.reporter.start()
         self.watchdog.start()
 
     def stop(self) -> None:
         self.watchdog.stop()
         self.reporter.stop()
+        if self.gossip is not None:
+            self.gossip.stop()
         self.instance.stop()
         self.foreign_consumer.stop()
         self.loop.stop()

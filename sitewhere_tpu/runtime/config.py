@@ -204,5 +204,9 @@ DEFAULTS: Dict = {
         # supervisor to restart the gang — the TPU pod failure model
         "exit_on_peer_loss": True,
         "peer_loss_exit_code": 13,
+        # leaderless cross-host registry replication (parallel/cluster.py
+        # RegistryGossip): creates + assignment lifecycle broadcast to
+        # peers and apply idempotently
+        "registry_gossip": True,
     },
 }

@@ -427,6 +427,159 @@ def test_cli_cluster_serve_boots_and_stops(tmp_path):
                 p.wait(timeout=30)
 
 
+_GOSSIP_CHILD = r"""
+import os, sys, time
+pid = int(sys.argv[1]); coord = sys.argv[2]
+bus0, bus1 = int(sys.argv[3]), int(sys.argv[4])
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(coordinator_address=coord, num_processes=2,
+                           process_id=pid)
+import msgpack
+from sitewhere_tpu.instance import SiteWhereInstance
+from sitewhere_tpu.model import (
+    Device, DeviceAssignment, DeviceAssignmentStatus, DeviceType)
+from sitewhere_tpu.model.common import _asdict
+from sitewhere_tpu.model.event import DeviceEventBatch, DeviceMeasurement
+from sitewhere_tpu.parallel.cluster import ClusterService
+from sitewhere_tpu.parallel.distributed import make_global_mesh
+
+mesh = make_global_mesh()
+instance = SiteWhereInstance(
+    instance_id="cluster-gossip", enable_pipeline=True, mesh=mesh,
+    max_devices=64, batch_size=16, measurement_slots=4, max_tenants=4)
+cluster = ClusterService(
+    instance, pid, 2,
+    peer_bus_addrs={0: ("127.0.0.1", bus0), 1: ("127.0.0.1", bus1)},
+    bus_port=bus0 if pid == 0 else bus1, heartbeat_s=0.3,
+    exit_on_peer_loss=False, idle_interval_s=0.005)
+cluster.start()
+engine = instance.pipeline_engine
+te = instance.get_tenant_engine("default")
+
+# ONLY host 0 provisions; gossip must replicate everything to host 1
+tokens = [f"gd{i}" for i in range(6)]
+if pid == 0:
+    dt = te.registry.create_device_type(DeviceType(token="gdt"))
+    for tok in tokens:
+        d = te.registry.create_device(Device(token=tok,
+                                             device_type_id=dt.id))
+        te.registry.create_device_assignment(
+            DeviceAssignment(token="ga" + tok[2:], device_id=d.id))
+engine.packer.measurements.intern("temp")
+from sitewhere_tpu.pipeline.engine import ThresholdRule
+engine.add_threshold_rule(ThresholdRule(
+    token="hot", measurement_name="temp", operator=">", threshold=50.0))
+
+# host 1: wait until gossip delivered the full registry
+deadline = time.monotonic() + 120
+while time.monotonic() < deadline:
+    devs = [te.registry.get_device_by_token(t) for t in tokens]
+    if all(d is not None for d in devs) and all(
+            te.registry.get_active_assignment(d.id) is not None
+            for d in devs):
+        break
+    time.sleep(0.1)
+else:
+    raise SystemExit(f"host {pid}: registry never converged")
+print(f"GOSSIPOK {pid} applied={cluster.gossip.applied}", flush=True)
+
+# identical ownership despite one-sided provisioning (shard-congruent
+# interning: ownership is a pure function of the token)
+mine = [t for t in tokens if cluster.owner_process(t) == pid]
+theirs = [t for t in tokens if cluster.owner_process(t) != pid]
+assert mine and theirs, (pid, mine, theirs)
+
+# host 1 publishes an event for a host-0-owned REPLICATED device to its
+# own edge: ownership routing + forwarding must work on gossiped state
+if pid == 1:
+    target = theirs[0]
+    payload = msgpack.packb({
+        "sourceId": "gsp", "deviceToken": target,
+        "kind": "DeviceEventBatch",
+        "request": _asdict(DeviceEventBatch(
+            device_token=target,
+            measurements=[DeviceMeasurement(
+                name="temp", value=77.0,
+                event_date=int(time.time() * 1000))])),
+        "metadata": {},
+    }, use_bin_type=True)
+    instance.bus.publish(
+        instance.naming.event_source_decoded_events("default"),
+        target.encode(), payload)
+if pid == 0:
+    expect = mine[0]
+    deadline = time.monotonic() + 120
+    state = None
+    while time.monotonic() < deadline:
+        state = engine.get_device_state(expect)
+        if state is not None and "temp" in state.last_measurements \
+                and state.last_measurements["temp"][1] == 77.0:
+            break
+        time.sleep(0.1)
+    assert state is not None \
+        and state.last_measurements["temp"][1] == 77.0, (
+            expect, state and state.last_measurements)
+    # assignment release on host 0 replicates to host 1
+    te.registry.release_device_assignment("ga" + expect[2:])
+if pid == 1:
+    # host 0 released the assignment of ITS first owned token (the same
+    # deterministic choice rule on both sides); wait for the gossip
+    released = "ga" + [t for t in tokens
+                       if cluster.owner_process(t) == 0][0][2:]
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        a = te.registry.assignments.get_by_token(released)
+        if a is not None and a.status == DeviceAssignmentStatus.RELEASED:
+            break
+        time.sleep(0.1)
+    else:
+        raise SystemExit("release never replicated")
+print(f"E2EOK {pid}", flush=True)
+time.sleep(1.0)
+cluster.stop()
+print(f"STOPOK {pid}", flush=True)
+"""
+
+
+def test_two_process_registry_gossip():
+    """Leaderless registry replication: host 0 provisions the entire
+    device fleet; host 1 receives it all by gossip, both hosts agree on
+    ownership (shard-congruent interning), an event for a replicated
+    device routes across hosts, and an assignment release replicates."""
+    coord = _free_port()
+    bus0, bus1 = _free_port(), _free_port()
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    env.pop("JAX_PLATFORMS", None)
+    env.pop("XLA_FLAGS", None)
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", _GOSSIP_CHILD, str(pid),
+         f"127.0.0.1:{coord}", str(bus0), str(bus1)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env)
+        for pid in range(2)]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=540)
+            outs.append(out)
+            assert p.returncode == 0, out[-4000:]
+    finally:
+        for q in procs:
+            if q.poll() is None:
+                q.kill()
+                q.wait(timeout=30)
+    for pid in range(2):
+        assert f"GOSSIPOK {pid}" in outs[pid], outs[pid][-4000:]
+        assert f"E2EOK {pid}" in outs[pid], outs[pid][-4000:]
+        assert f"STOPOK {pid}" in outs[pid], outs[pid][-4000:]
+    # host 1 never provisioned anything locally: everything it has came
+    # over the wire
+    assert "applied=0" not in outs[1].split("GOSSIPOK 1", 1)[1][:40]
+
+
 _RECOVERY_CHILD = r"""
 import os, sys, time
 pid = int(sys.argv[1]); coord = sys.argv[2]
