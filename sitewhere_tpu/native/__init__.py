@@ -70,7 +70,7 @@ def _load(_retry: bool = True) -> None:
     # from source once.
     try:
         lib.swt_version.restype = i32
-        stale = lib.swt_version() != 7
+        stale = lib.swt_version() != 8
     except AttributeError:
         stale = True
     if stale:
@@ -101,6 +101,8 @@ def _load(_retry: bool = True) -> None:
     lib.swt_interner_size.restype = i32
     lib.swt_interner_add.argtypes = [vp, c.c_char_p, i32]
     lib.swt_interner_add.restype = i32
+    lib.swt_interner_add_gap.argtypes = [vp]
+    lib.swt_interner_add_gap.restype = i32
     lib.swt_interner_token_at.argtypes = [vp, i32, c.c_char_p, i32]
     lib.swt_interner_token_at.restype = i32
     lib.swt_interner_set_at.argtypes = [vp, i32, c.c_char_p, i32]
@@ -180,6 +182,11 @@ class NativeInterner:
         """Get-or-assign; -1 signals capacity exceeded."""
         raw = token.encode(errors="surrogateescape")
         return LIB.swt_interner_add(self._h, raw, len(raw))
+
+    def add_gap(self) -> int:
+        """Append an unfindable gap-placeholder slot (the shard-congruent
+        allocator); returns its index, -1 on capacity exceeded."""
+        return LIB.swt_interner_add_gap(self._h)
 
     def set_at(self, idx: int, token: str) -> int:
         """Overwrite a gap-placeholder slot with a real token (the

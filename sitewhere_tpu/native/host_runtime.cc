@@ -44,10 +44,9 @@ inline size_t next_pow2(size_t v) {
 }
 
 struct Interner {
-  // 4x capacity hash slots: worst case holds `capacity` tokens PLUS one
-  // dangling placeholder entry per swt_interner_set_at overwrite (the
-  // shard-congruent allocator), i.e. up to 2*capacity entries — 4x keeps
-  // the load factor <= 0.5 so open-addressing probes stay short.
+  // 4x capacity hash slots: at most `capacity` tokens are ever hashed
+  // (gap placeholders from swt_interner_add_gap never enter the hash),
+  // so the load factor stays <= 0.25 and open-addressing probes short.
   explicit Interner(int32_t capacity)
       : capacity(capacity), mask(next_pow2(static_cast<size_t>(capacity) * 4) - 1),
         slots(mask + 1, -1), hashes(mask + 1, 0) {
@@ -62,13 +61,11 @@ struct Interner {
   std::vector<std::string> tokens;  // index -> bytes
   mutable std::shared_mutex mu;
 
-  // Requires at least a shared lock. NUL-prefixed tokens are gap
-  // placeholders of the shard-congruent allocator: they must NEVER
-  // satisfy a lookup (a wire token with those bytes would otherwise be
-  // attributed to a gap row — or a later real device's row), whether the
-  // placeholder is still live or already overwritten via set_at.
+  // Requires at least a shared lock. Gap placeholders (shard-congruent
+  // allocator) are appended via add_gap WITHOUT a hash entry, so they can
+  // never satisfy a lookup — no byte pattern is reserved, and arbitrary
+  // wire tokens (including NUL-prefixed ones) intern normally.
   int32_t find(const char* tok, int64_t len, uint64_t h) const {
-    if (len > 0 && tok[0] == '\0') return -1;
     size_t slot = h & mask;
     while (true) {
       int32_t idx = slots[slot];
@@ -96,13 +93,23 @@ struct Interner {
     hashes[slot] = h;
     return idx;
   }
+
+  // Requires the unique lock. Append a gap placeholder: occupies the next
+  // index in the token table but is NOT inserted into the hash, so no
+  // lookup can ever return it. set_at later fills it with a real token.
+  int32_t add_gap() {
+    if (static_cast<int32_t>(tokens.size()) >= capacity) return -1;
+    int32_t idx = static_cast<int32_t>(tokens.size());
+    tokens.emplace_back();
+    return idx;
+  }
 };
 
 }  // namespace
 
 extern "C" {
 
-int32_t swt_version() { return 7; }
+int32_t swt_version() { return 8; }
 
 void* swt_interner_create(int32_t capacity) {
   if (capacity < 2) return nullptr;
@@ -130,13 +137,23 @@ int32_t swt_interner_add(void* h, const char* tok, int32_t len) {
   return in->add(tok, len, hash);
 }
 
+// Append a gap placeholder slot (shard-congruent allocator —
+// registry/interning.py): takes the next index without a hash entry, so
+// it is unfindable by construction. Returns the new index, or -1 when
+// capacity is exceeded.
+int32_t swt_interner_add_gap(void* h) {
+  Interner* in = static_cast<Interner*>(h);
+  std::unique_lock<std::shared_mutex> lock(in->mu);
+  return in->add_gap();
+}
+
 // Overwrite the token at an EXISTING index (a gap placeholder from the
 // shard-congruent allocator — registry/interning.py). The real token is
-// inserted into the hash pointing at idx; the placeholder's hash entry is
-// left dangling (its \x00-prefixed token can never collide with a real
-// lookup), and the token table slot is replaced so token_at/snapshot read
-// the real token. Returns 0, -1 for an out-of-range idx, -2 when the
-// token already exists at a DIFFERENT index (caller bug).
+// inserted into the hash pointing at idx; the placeholder had no hash
+// entry, so nothing dangles, and the token table slot is replaced so
+// token_at/snapshot read the real token. Returns 0, -1 for an
+// out-of-range idx, -2 when the token already exists at a DIFFERENT
+// index (caller bug).
 int32_t swt_interner_set_at(void* h, int32_t idx, const char* tok,
                             int32_t len) {
   Interner* in = static_cast<Interner*>(h);

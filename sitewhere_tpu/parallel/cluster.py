@@ -834,13 +834,42 @@ class RegistryGossip:
         self._host.stop()
 
     def _handle(self, records: List[Record]) -> None:
-        for record in records:
-            data = msgpack.unpackb(record.value, raw=False)
-            self._applying.active = True
-            try:
-                self._apply(data)
-            finally:
-                self._applying.active = False
+        # The topic is partitioned by entity token, so a poll can hand us a
+        # dependent entity BEFORE its dependency (a device ahead of its
+        # device type). Multi-pass over the DEPENDENCY misses until a full
+        # pass makes no progress: any topological order inside the batch
+        # resolves without relying on redelivery (which would replay the
+        # batch in the same order and fail deterministically). A dependency
+        # in a LATER batch still resolves via the consumer's at-least-once
+        # retry. Non-dependency failures (genuine conflicts) never succeed
+        # on a later pass, so they are applied once and re-raised at the
+        # end — toward the retry budget and the dead-letter surface.
+        from sitewhere_tpu.errors import NotFoundError
+
+        pending = [msgpack.unpackb(r.value, raw=False) for r in records]
+        conflict: Optional[BaseException] = None
+        self._applying.active = True
+        try:
+            while pending:
+                missing: List[Dict] = []
+                dep_error: Optional[BaseException] = None
+                for data in pending:
+                    try:
+                        self._apply(data)
+                    except NotFoundError as exc:
+                        missing.append(data)
+                        if dep_error is None:
+                            dep_error = exc
+                    except Exception as exc:
+                        if conflict is None:
+                            conflict = exc
+                if len(missing) == len(pending):
+                    raise dep_error  # no progress: retry budget applies
+                pending = missing
+            if conflict is not None:
+                raise conflict
+        finally:
+            self._applying.active = False
 
     def _apply(self, data: Dict) -> None:
         from sitewhere_tpu.errors import (
@@ -872,22 +901,27 @@ class RegistryGossip:
                         f"gossip dependency {coll_name}:{ref_token!r} not "
                         f"yet replicated", ErrorCode.GENERIC)
                 entity_data[field] = local.id
-        existing = self._get_by_token(registry, kind, token)
-        if existing is None:
-            entity = entity_from_payload(cls, entity_data)
-            try:
-                self._create(registry, kind, entity)
-                self.applied += 1
-            except DuplicateTokenError:
-                pass  # raced another replica of the same create
-            except SiteWhereError:
-                # genuine conflict (e.g. device already actively
-                # assigned): re-raise -> retry budget -> dead-letter
-                self.conflicts += 1
-                raise
-        else:
-            self._update_existing(registry, kind, token, existing,
-                                  entity_data)
+        with registry.replication():
+            # replication context: creates are idempotent get-or-create,
+            # and stay claimable by a later identical local create
+            # (registry/store.py _Collection) — the contract that lets
+            # every host provision the same world in any order
+            existing = self._get_by_token(registry, kind, token)
+            if existing is None:
+                entity = entity_from_payload(cls, entity_data)
+                try:
+                    self._create(registry, kind, entity)
+                    self.applied += 1
+                except DuplicateTokenError:
+                    pass  # raced another replica of the same create
+                except SiteWhereError:
+                    # genuine conflict (e.g. device already actively
+                    # assigned): re-raise -> retry budget -> dead-letter
+                    self.conflicts += 1
+                    raise
+            else:
+                self._update_existing(registry, kind, token, existing,
+                                      entity_data)
 
     @staticmethod
     def _get_by_token(registry, kind: str, token: str):

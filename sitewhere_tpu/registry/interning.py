@@ -97,11 +97,6 @@ class TokenInterner:
             f"interner '{self.name}' native mirror out of sync "
             f"(native {nidx} != {idx})", ErrorCode.GENERIC)
 
-    def _gap_token(self, idx: int) -> str:
-        # \x00-prefixed: no wire/API token starts with NUL, so a gap
-        # placeholder can never satisfy a real lookup
-        return f"\x00gap{idx}"
-
     def _class_of(self, token: str) -> int:
         import zlib
 
@@ -130,7 +125,9 @@ class TokenInterner:
                 gap = len(self._to_token)
                 self._to_token.append(None)
                 if self._nat is not None:
-                    if self._nat.add(self._gap_token(gap)) != gap:
+                    # gap slots never enter the native hash: unfindable by
+                    # construction, no byte pattern is reserved
+                    if self._nat.add_gap() != gap:
                         self._mirror_sync_error(-1, gap)
             self._to_token.append(token)
             if self._nat is not None:
@@ -293,10 +290,10 @@ class TokenInterner:
                 self._nat = nat.NativeInterner(self.capacity)
                 for i, t in enumerate(self._to_token[1:], start=1):
                     # snapshots may hold None gaps (never valid mid-stream);
-                    # keep native slot numbering aligned with an
-                    # un-lookupable placeholder
-                    if self._nat.add(t if t is not None else f"\x00gap{i}") \
-                            == -1:
+                    # keep native slot numbering aligned with a hash-less
+                    # (un-lookupable) placeholder
+                    if (self._nat.add(t) if t is not None
+                            else self._nat.add_gap()) == -1:
                         from sitewhere_tpu.errors import (
                             ErrorCode, SiteWhereError)
                         raise SiteWhereError(

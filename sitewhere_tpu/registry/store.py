@@ -18,6 +18,7 @@ import dataclasses
 import json
 import sqlite3
 import threading
+from contextlib import contextmanager
 from typing import Any, Callable, Dict, Generic, Iterable, List, Optional, Type, TypeVar
 
 from sitewhere_tpu.errors import DuplicateTokenError, ErrorCode, NotFoundError, SiteWhereError
@@ -165,10 +166,25 @@ class SqliteStore:
 # ---------------------------------------------------------------------------
 
 class _Collection(Generic[T]):
-    """Token+id indexed entity map with write-through persistence."""
+    """Token+id indexed entity map with write-through persistence.
+
+    ``replicating`` (a nullary callable) marks threads applying
+    PEER-REPLICATED mutations (parallel/cluster.py RegistryGossip): a
+    replicated create of an existing token is idempotent (at-least-once
+    redelivery), a fresh replicated create marks its token so a later
+    IDENTICAL local create merges into it instead of raising — cluster
+    hosts provision the same world in any order, the way the reference's
+    shared store makes creates race-free across processes
+    (service-device-management persistence/mongodb/MongoDeviceManagement.java).
+    """
+
+    # identity + provenance fields a local create never overwrites when
+    # claiming a replicated entity
+    _MERGE_SKIP = frozenset({"id", "token", "created_date", "created_by"})
 
     def __init__(self, kind: str, cls: Type[T], store: Any,
-                 not_found: ErrorCode):
+                 not_found: ErrorCode,
+                 replicating: Optional[Callable[[], bool]] = None):
         self.kind = kind
         self.cls = cls
         self.store = store
@@ -176,6 +192,13 @@ class _Collection(Generic[T]):
         self.by_id: Dict[str, T] = {}
         self.by_token: Dict[str, T] = {}
         self._lock = threading.RLock()
+        self._is_replicating = replicating or (lambda: False)
+        # unclaimed-replica markers persist under a reserved kind (load_all
+        # is always kind-filtered) so the claim contract survives the gang
+        # restarts that rebuild every host from durable state
+        self._replica_kind = f"{kind}#replica"
+        self._replicated_tokens: set = {
+            tok for _, tok, _ in store.load_all(self._replica_kind)}
         for _id, _token, payload in store.load_all(kind):
             entity = _entity_from_json(cls, payload)
             self.by_id[_id] = entity
@@ -190,13 +213,56 @@ class _Collection(Generic[T]):
                 # (Persistence.java entityCreateLogic UUID fallback)
                 token = new_id()
                 entity.token = token
-            if token in self.by_token:
+            existing = self.by_token.get(token)
+            if existing is not None:
+                if self._is_replicating():
+                    return existing  # peer redelivery: idempotent
+                merged = self._merge_replicated_locked(entity, existing)
+                if merged is not None:
+                    return merged
                 raise DuplicateTokenError(
                     f"{self.kind} token '{token}' already exists")
+            if self._is_replicating():
+                self._replicated_tokens.add(token)
+                self.store.save(self._replica_kind, token, token, "{}")
             self.by_id[entity.id] = entity
             self.by_token[token] = entity
             self.store.save(self.kind, entity.id, token, _entity_to_json(entity))
             return entity
+
+    def claimable_replica(self, token: str) -> bool:
+        """True when `token` names an unclaimed replicated entity a local
+        create may merge into (callers peek before mutating their input)."""
+        with self._lock:
+            return token in self._replicated_tokens
+
+    def merge_replicated(self, entity: T) -> Optional[T]:
+        """Claim an unclaimed replica for a colliding local create; None
+        when the existing entity is a genuine duplicate (or absent)."""
+        with self._lock:
+            existing = self.by_token.get(getattr(entity, "token", ""))
+            if existing is None:
+                return None
+            return self._merge_replicated_locked(entity, existing)
+
+    def _merge_replicated_locked(self, entity: T, existing: T) -> Optional[T]:
+        token = getattr(entity, "token", "")
+        if token not in self._replicated_tokens:
+            return None
+        # the replica keeps its (peer-adopted) id so references already
+        # bound to it stay valid; the local create intent wins the fields
+        self._discard_replica_locked(token)
+        for field in dataclasses.fields(existing):
+            if field.name not in self._MERGE_SKIP:
+                setattr(existing, field.name, getattr(entity, field.name))
+        self.store.save(self.kind, existing.id, token,
+                        _entity_to_json(existing))
+        return existing
+
+    def _discard_replica_locked(self, token: str) -> None:
+        if token in self._replicated_tokens:
+            self._replicated_tokens.discard(token)
+            self.store.delete(self._replica_kind, token)
 
     def get(self, entity_id: str) -> Optional[T]:
         return self.by_id.get(entity_id)
@@ -237,6 +303,7 @@ class _Collection(Generic[T]):
                     raise DuplicateTokenError(
                         f"{self.kind} token '{new_token}' already exists")
                 self.by_token.pop(old_token, None)
+                self._discard_replica_locked(old_token)
                 if new_token:
                     self.by_token[new_token] = entity
             self.store.save(self.kind, entity.id, new_token, _entity_to_json(entity))
@@ -249,6 +316,7 @@ class _Collection(Generic[T]):
             token = getattr(entity, "token", "")
             if token:
                 self.by_token.pop(token, None)
+                self._discard_replica_locked(token)
             self.store.delete(self.kind, entity_id)
             return entity
 
@@ -288,33 +356,45 @@ class DeviceManagement:
         store = store or InMemoryStore()
         self.tenant_id = tenant_id
         self.store = store
+        self._replication = threading.local()
+        rep = self._replicating
         E = ErrorCode
         self.device_types: _Collection[DeviceType] = _Collection(
-            "device_type", DeviceType, store, E.INVALID_DEVICE_TYPE_TOKEN)
+            "device_type", DeviceType, store, E.INVALID_DEVICE_TYPE_TOKEN,
+            replicating=rep)
         self.device_commands: _Collection[DeviceCommand] = _Collection(
-            "device_command", DeviceCommand, store, E.INVALID_COMMAND_TOKEN)
+            "device_command", DeviceCommand, store, E.INVALID_COMMAND_TOKEN,
+            replicating=rep)
         self.device_statuses: _Collection[DeviceStatus] = _Collection(
-            "device_status", DeviceStatus, store, E.INVALID_DEVICE_TOKEN)
+            "device_status", DeviceStatus, store, E.INVALID_DEVICE_TOKEN,
+            replicating=rep)
         self.devices: _Collection[Device] = _Collection(
-            "device", Device, store, E.INVALID_DEVICE_TOKEN)
+            "device", Device, store, E.INVALID_DEVICE_TOKEN, replicating=rep)
         self.assignments: _Collection[DeviceAssignment] = _Collection(
-            "assignment", DeviceAssignment, store, E.INVALID_ASSIGNMENT_TOKEN)
+            "assignment", DeviceAssignment, store, E.INVALID_ASSIGNMENT_TOKEN,
+            replicating=rep)
         self.area_types: _Collection[AreaType] = _Collection(
-            "area_type", AreaType, store, E.INVALID_AREA_TOKEN)
+            "area_type", AreaType, store, E.INVALID_AREA_TOKEN,
+            replicating=rep)
         self.areas: _Collection[Area] = _Collection(
-            "area", Area, store, E.INVALID_AREA_TOKEN)
+            "area", Area, store, E.INVALID_AREA_TOKEN, replicating=rep)
         self.zones: _Collection[Zone] = _Collection(
-            "zone", Zone, store, E.INVALID_ZONE_TOKEN)
+            "zone", Zone, store, E.INVALID_ZONE_TOKEN, replicating=rep)
         self.customer_types: _Collection[CustomerType] = _Collection(
-            "customer_type", CustomerType, store, E.INVALID_CUSTOMER_TOKEN)
+            "customer_type", CustomerType, store, E.INVALID_CUSTOMER_TOKEN,
+            replicating=rep)
         self.customers: _Collection[Customer] = _Collection(
-            "customer", Customer, store, E.INVALID_CUSTOMER_TOKEN)
+            "customer", Customer, store, E.INVALID_CUSTOMER_TOKEN,
+            replicating=rep)
         self.device_groups: _Collection[DeviceGroup] = _Collection(
-            "device_group", DeviceGroup, store, E.INVALID_GROUP_TOKEN)
+            "device_group", DeviceGroup, store, E.INVALID_GROUP_TOKEN,
+            replicating=rep)
         self.group_elements: _Collection[DeviceGroupElement] = _Collection(
-            "group_element", DeviceGroupElement, store, E.INVALID_GROUP_TOKEN)
+            "group_element", DeviceGroupElement, store, E.INVALID_GROUP_TOKEN,
+            replicating=rep)
         self.alarms: _Collection[DeviceAlarm] = _Collection(
-            "alarm", DeviceAlarm, store, E.INVALID_DEVICE_TOKEN)
+            "alarm", DeviceAlarm, store, E.INVALID_DEVICE_TOKEN,
+            replicating=rep)
         self._listeners: List[Callable[[str, Any], None]] = []
         # device_id -> active assignment (the hot lookup of
         # InboundPayloadProcessingLogic.validateAssignment:179)
@@ -322,6 +402,24 @@ class DeviceManagement:
         for assignment in self.assignments.all():
             if assignment.status == DeviceAssignmentStatus.ACTIVE:
                 self._active_assignment[assignment.device_id] = assignment
+
+    # -- replication context --------------------------------------------------
+
+    def _replicating(self) -> bool:
+        return getattr(self._replication, "active", False)
+
+    @contextmanager
+    def replication(self):
+        """Mark this thread as applying peer-replicated mutations
+        (parallel/cluster.py RegistryGossip): creates become idempotent
+        get-or-create and their entities stay claimable by a later
+        identical local create, so cluster hosts can provision the same
+        world in any order relative to gossip arrival."""
+        self._replication.active = True
+        try:
+            yield
+        finally:
+            self._replication.active = False
 
     # -- change notification --------------------------------------------------
 
@@ -440,14 +538,33 @@ class DeviceManagement:
     def create_device_assignment(self, assignment: DeviceAssignment
                                  ) -> DeviceAssignment:
         device = self.devices.require(assignment.device_id)
-        if device.id in self._active_assignment:
+        if not assignment.device_type_id:
+            assignment.device_type_id = device.device_type_id
+        active = self._active_assignment.get(device.id)
+        if active is not None:
+            token = getattr(assignment, "token", "")
+            if active.token == token:
+                if self._replicating():
+                    return active  # peer redelivery: idempotent
+                # the replication applier may have installed this very
+                # assignment before the operator's own provisioning ran:
+                # claim it instead of refusing (peek first — the genuine-
+                # duplicate path must raise without mutating the input)
+                if self.assignments.claimable_replica(token):
+                    assignment.status = DeviceAssignmentStatus.ACTIVE
+                    assignment.active_date = active.active_date
+                    merged = self.assignments.merge_replicated(assignment)
+                    if merged is not None:
+                        self._notify("assignment", merged)
+                        return merged
             raise SiteWhereError(
                 f"device '{device.token}' already has an active assignment",
                 ErrorCode.DEVICE_ALREADY_ASSIGNED)
-        if not assignment.device_type_id:
-            assignment.device_type_id = device.device_type_id
         assignment.status = DeviceAssignmentStatus.ACTIVE
-        assignment.active_date = now_ms()
+        # a replicated create carries the CREATING host's activation time —
+        # keep it so replicas agree on active_date
+        if not (self._replicating() and assignment.active_date):
+            assignment.active_date = now_ms()
         result = self.assignments.create(assignment)
         self._active_assignment[device.id] = result
         self._notify("assignment", result)

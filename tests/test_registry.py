@@ -271,3 +271,86 @@ class TestShardCongruentInterning:
         for i in range(10):
             assert snap.assignment_status[tensors.devices.lookup(f"cg-{i}")] \
                 == int(DeviceAssignmentStatus.ACTIVE)
+
+
+class TestReplicationMerge:
+    """Cluster replication contract (parallel/cluster.py RegistryGossip):
+    a gossip-applied create must be claimable by a later identical local
+    create (hosts provision the same world in any order), idempotent
+    under redelivery, and must NOT weaken duplicate detection for
+    genuinely duplicate local creates."""
+
+    def _replicate_world(self, dm):
+        """Apply a peer's provisioning through the replication context."""
+        with dm.replication():
+            dtype = dm.create_device_type(
+                DeviceType(token="rt", name="peer-name"))
+            device = dm.create_device(
+                Device(token="rd", device_type_id=dtype.id))
+            dm.create_device_assignment(DeviceAssignment(
+                token="ra", device_id=device.id, active_date=111))
+        return dtype, device
+
+    def test_local_create_claims_replica(self):
+        dm = DeviceManagement()
+        dtype, device = self._replicate_world(dm)
+        # operator provisions the same world afterwards: merge, not raise
+        local_dt = dm.create_device_type(DeviceType(token="rt", name="mine"))
+        assert local_dt.id == dtype.id  # replica id kept: references hold
+        assert local_dt.name == "mine"  # local create intent wins fields
+        local_d = dm.create_device(Device(token="rd",
+                                          device_type_id=local_dt.id))
+        assert local_d.id == device.id
+        merged_a = dm.create_device_assignment(
+            DeviceAssignment(token="ra", device_id=local_d.id))
+        assert merged_a.status == DeviceAssignmentStatus.ACTIVE
+        assert merged_a.active_date == 111  # replicated activation kept
+        assert dm.get_active_assignment(local_d.id) is merged_a
+        # the claim is single-use: a SECOND create is a genuine duplicate
+        with pytest.raises(DuplicateTokenError):
+            dm.create_device_type(DeviceType(token="rt"))
+        with pytest.raises(SiteWhereError):
+            dm.create_device_assignment(
+                DeviceAssignment(token="ra", device_id=local_d.id))
+
+    def test_replicated_create_idempotent(self):
+        dm = DeviceManagement()
+        dtype, device = self._replicate_world(dm)
+        with dm.replication():
+            again = dm.create_device_type(DeviceType(token="rt", name="x"))
+            assert again is dm.device_types.get_by_token("rt")
+            a = dm.create_device_assignment(DeviceAssignment(
+                token="ra", device_id=device.id))
+            assert a.active_date == 111  # peer's activation preserved
+
+    def test_duplicate_raise_does_not_mutate_input(self):
+        dm, dtype, area = make_registry()
+        device, _ = register(dm, dtype, area, "d1")
+        probe = DeviceAssignment(token="as-d1", device_id=device.id)
+        status_before = probe.status
+        with pytest.raises(SiteWhereError):
+            dm.create_device_assignment(probe)
+        assert probe.status == status_before
+        assert probe.active_date is None
+
+    def test_claim_survives_restart(self, tmp_path):
+        path = str(tmp_path / "registry.db")
+        dm = DeviceManagement(SqliteStore(path))
+        self._replicate_world(dm)
+        dm.store.close()
+        # gang restart: every host rebuilds from durable state; the
+        # operator's provisioning then re-runs and must still claim
+        dm2 = DeviceManagement(SqliteStore(path))
+        claimed = dm2.create_device_type(DeviceType(token="rt", name="mine"))
+        assert claimed.name == "mine"
+        with pytest.raises(DuplicateTokenError):
+            dm2.create_device_type(DeviceType(token="rt"))
+
+    def test_delete_clears_claimability(self):
+        dm = DeviceManagement()
+        with dm.replication():
+            dm.create_device_type(DeviceType(token="rt"))
+        dm.delete_device_type("rt")
+        dm.create_device_type(DeviceType(token="rt", name="fresh"))
+        with pytest.raises(DuplicateTokenError):
+            dm.create_device_type(DeviceType(token="rt"))
