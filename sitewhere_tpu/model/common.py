@@ -36,7 +36,12 @@ class PersistentEntity:
     metadata: Dict[str, str] = field(default_factory=dict)
 
     def touch(self, username: str = "") -> None:
-        self.updated_date = now_ms()
+        # monotonic past the current stamp: a host whose clock trails a
+        # replicated update it already applied must still produce a NEWER
+        # last-writer-wins stamp, or its local edit would lose everywhere
+        # else while winning locally (cluster registry replication)
+        self.updated_date = max(now_ms(),
+                                (self.updated_date or self.created_date) + 1)
         self.updated_by = username
 
     def to_dict(self) -> Dict[str, Any]:

@@ -72,6 +72,7 @@ except ImportError:  # pragma: no cover
 
 from sitewhere_tpu.ops.pack import EventBatch, empty_batch
 from sitewhere_tpu.parallel.engine import ShardedPipelineEngine
+from sitewhere_tpu.model.common import now_ms
 from sitewhere_tpu.parallel.mesh import SHARD_AXIS
 from sitewhere_tpu.runtime.bus import ConsumerHost, Record, TopicNaming
 from sitewhere_tpu.runtime.busnet import BusClient, BusNetError
@@ -717,17 +718,33 @@ class PeerWatchdog:
 
 REGISTRY_GOSSIP_SUFFIX = "registry-model-updates"
 
-# entity kinds that replicate, with their reference fields resolved by
-# TOKEN on the wire (entity ids are per-host UUIDs except when the
-# creating host's id is adopted at create time): (id_field, collection)
+# Every registry kind replicates. Reference fields are resolved by TOKEN
+# on the wire (entity ids are per-host UUIDs except when the creating
+# host's id is adopted at create time): (id_field, collection). Fields
+# NOT listed here (asset_id, triggering_event_id) travel verbatim — they
+# reference managers outside the replicated registry.
 _GOSSIP_REFS = {
     "device_type": [],
-    "area": [("area_type_id", "area_types"), ("parent_area_id", "areas")],
-    "zone": [("area_id", "areas")],
-    "device": [("device_type_id", "device_types")],
+    "device_command": [("device_type_id", "device_types")],
+    "device_status": [("device_type_id", "device_types")],
+    "device": [("device_type_id", "device_types"),
+               ("parent_device_id", "devices")],
     "assignment": [("device_id", "devices"),
                    ("device_type_id", "device_types"),
                    ("area_id", "areas"), ("customer_id", "customers")],
+    "area_type": [],
+    "area": [("area_type_id", "area_types"), ("parent_area_id", "areas")],
+    "zone": [("area_id", "areas")],
+    "customer_type": [],
+    "customer": [("customer_type_id", "customer_types"),
+                 ("parent_customer_id", "customers")],
+    "device_group": [],
+    "group_element": [("group_id", "device_groups"),
+                      ("device_id", "devices"),
+                      ("nested_group_id", "device_groups")],
+    "alarm": [("device_id", "devices"),
+              ("device_assignment_id", "assignments"),
+              ("customer_id", "customers"), ("area_id", "areas")],
 }
 _GOSSIP_CLASSES = {}  # kind -> model class, resolved lazily
 
@@ -735,12 +752,40 @@ _GOSSIP_CLASSES = {}  # kind -> model class, resolved lazily
 def _gossip_class(kind: str):
     if not _GOSSIP_CLASSES:
         from sitewhere_tpu.model import (
-            Area, Device, DeviceAssignment, DeviceType, Zone)
+            Area, AreaType, Customer, CustomerType, Device, DeviceAlarm,
+            DeviceAssignment, DeviceCommand, DeviceGroup, DeviceGroupElement,
+            DeviceStatus, DeviceType, Zone)
 
         _GOSSIP_CLASSES.update({
-            "device_type": DeviceType, "area": Area, "zone": Zone,
-            "device": Device, "assignment": DeviceAssignment})
+            "device_type": DeviceType, "device_command": DeviceCommand,
+            "device_status": DeviceStatus, "device": Device,
+            "assignment": DeviceAssignment, "area_type": AreaType,
+            "area": Area, "zone": Zone, "customer_type": CustomerType,
+            "customer": Customer, "device_group": DeviceGroup,
+            "group_element": DeviceGroupElement, "alarm": DeviceAlarm})
     return _GOSSIP_CLASSES.get(kind)
+
+
+def _gossip_stamp(data: Dict) -> int:
+    """Last-writer-wins timestamp of a serialized entity."""
+    return int(data.get("updated_date") or data.get("created_date") or 0)
+
+
+def _gossip_content_key(kind: str, data: Dict,
+                        ref_tokens: Dict[str, str]) -> str:
+    """Deterministic tiebreak for equal-stamp concurrent writes: a digest
+    over the entity's HOST-INDEPENDENT content — the per-host UUID id
+    fields are dropped and the replicated references appear by token, so
+    every host hashing its local copy and the incoming copy computes the
+    same pair of keys and therefore picks the same winner."""
+    import hashlib
+
+    ref_fields = {field for field, _ in _GOSSIP_REFS.get(kind, ())}
+    content = {k: v for k, v in data.items()
+               if k != "id" and k not in ref_fields}
+    content["_refs"] = dict(sorted(ref_tokens.items()))
+    blob = json.dumps(content, sort_keys=True, default=str)
+    return hashlib.sha1(blob.encode()).hexdigest()
 
 
 def registry_gossip_topic(naming: TopicNaming) -> str:
@@ -758,14 +803,24 @@ class RegistryGossip:
     hosts only need to converge on CONTENT, and the misroute guards
     cover the convergence window.
 
-    Mechanics: entity references travel by TOKEN (ids are per-host
-    UUIDs; a brand-new entity adopts the creating host's id, an existing
-    one keeps its local id). An applier whose dependency has not arrived
+    Mechanics: EVERY registry kind replicates, including deletions.
+    Entity references travel by TOKEN (ids are per-host UUIDs; a
+    brand-new entity adopts the creating host's id, an existing one
+    keeps its local id). An applier whose dependency has not arrived
     yet raises — the consumer's at-least-once redelivery retries until
-    the dependency converges, and a genuine conflict (e.g. a device
-    already actively assigned elsewhere) parks on the dead-letter
-    surface for the operator. Deletions do not replicate (admin ops are
-    applied per host; documented).
+    the dependency converges, and a genuine conflict parks on the
+    dead-letter surface for the operator.
+
+    Conflict order: last-writer-wins on the entity's updated/created
+    stamp (local touch() is monotonic past any applied stamp), with a
+    host-independent content digest breaking exact ties — every host
+    compares the same pair of (stamp, digest) keys and picks the same
+    winner, so concurrent updates converge identically everywhere.
+    Deletes stamp past the entity's last write and leave a tombstone:
+    a LATER write resurrects the entity (and the same comparison makes
+    the delete a no-op on hosts that already applied that write), an
+    EARLIER one stays dead. Same-token operations ride one partition in
+    order; only cross-entity reordering needs the multi-pass applier.
     """
 
     def __init__(self, process_id: int, peers: Dict[int, BusClient],
@@ -780,6 +835,10 @@ class RegistryGossip:
         self.publish_errors = 0
         self._applying = threading.local()
         self._registries: Dict[str, object] = {}
+        # (tenant, kind, token) -> delete stamp; in-memory (a restarted
+        # host re-learns deletions from the durable store, which the
+        # delete already mutated)
+        self._tombstones: Dict[tuple, int] = {}
         self._host = ConsumerHost(instance.bus, self.topic,
                                   group_id=f"registry-gossip-{process_id}",
                                   handler=self._handle)
@@ -787,13 +846,14 @@ class RegistryGossip:
     # -- publish side ------------------------------------------------------
     def register_tenant_registry(self, tenant_token: str, registry) -> None:
         """Called by TenantEngine construction: subscribe to this
-        tenant's registry mutations."""
+        tenant's registry mutations (the complete collection-level feed —
+        no wrapper can forget to replicate)."""
         self._registries[tenant_token] = registry
-        registry.add_listener(
-            lambda kind, entity, _t=tenant_token, _r=registry:
-            self._on_mutation(_t, _r, kind, entity))
+        registry.add_mutation_listener(
+            lambda kind, op, entity, _t=tenant_token, _r=registry:
+            self._on_mutation(_t, _r, kind, op, entity))
 
-    def _on_mutation(self, tenant: str, registry, kind, entity) -> None:
+    def _on_mutation(self, tenant: str, registry, kind, op, entity) -> None:
         if getattr(self._applying, "active", False):
             return  # echo of an applied peer mutation
         if _gossip_class(kind) is None or not self.peers:
@@ -801,17 +861,34 @@ class RegistryGossip:
         from sitewhere_tpu.web.marshal import to_jsonable
 
         try:
-            refs = {}
-            for field, coll_name in _GOSSIP_REFS.get(kind, []):
-                ref_id = getattr(entity, field, None)
-                if ref_id:
-                    ref = getattr(registry, coll_name).get(ref_id)
-                    if ref is not None:
-                        refs[field] = ref.token
-            payload = msgpack.packb(
-                {"tenant": tenant, "kind": kind,
-                 "entity": to_jsonable(entity), "refs": refs},
-                use_bin_type=True)
+            if op == "delete":
+                # the delete is a write AFTER the entity's last one: stamp
+                # past it so LWW orders it against concurrent updates
+                data = to_jsonable(entity)
+                stamp = max(now_ms(), _gossip_stamp(data) + 1)
+                token = getattr(entity, "token", "")
+                # the deleting host never consumes its own publish: record
+                # the tombstone HERE too, or an in-flight concurrent peer
+                # update would resurrect the entity on this host only
+                key = (tenant, kind, token)
+                self._tombstones[key] = max(self._tombstones.get(key, 0),
+                                            stamp)
+                payload = msgpack.packb(
+                    {"tenant": tenant, "kind": kind, "op": "delete",
+                     "token": token, "stamp": stamp},
+                    use_bin_type=True)
+            else:
+                refs = {}
+                for field, coll_name in _GOSSIP_REFS.get(kind, []):
+                    ref_id = getattr(entity, field, None)
+                    if ref_id:
+                        ref = getattr(registry, coll_name).get(ref_id)
+                        if ref is not None:
+                            refs[field] = ref.token
+                payload = msgpack.packb(
+                    {"tenant": tenant, "kind": kind, "op": op,
+                     "entity": to_jsonable(entity), "refs": refs},
+                    use_bin_type=True)
         except Exception:
             LOGGER.exception("registry gossip encode failed (%s)", kind)
             return
@@ -844,8 +921,6 @@ class RegistryGossip:
         # retry. Non-dependency failures (genuine conflicts) never succeed
         # on a later pass, so they are applied once and re-raised at the
         # end — toward the retry budget and the dead-letter surface.
-        from sitewhere_tpu.errors import NotFoundError
-
         pending = [msgpack.unpackb(r.value, raw=False) for r in records]
         conflict: Optional[BaseException] = None
         self._applying.active = True
@@ -856,12 +931,12 @@ class RegistryGossip:
                 for data in pending:
                     try:
                         self._apply(data)
-                    except NotFoundError as exc:
-                        missing.append(data)
-                        if dep_error is None:
-                            dep_error = exc
                     except Exception as exc:
-                        if conflict is None:
+                        if self._retryable(exc):
+                            missing.append(data)
+                            if dep_error is None:
+                                dep_error = exc
+                        elif conflict is None:
                             conflict = exc
                 if len(missing) == len(pending):
                     raise dep_error  # no progress: retry budget applies
@@ -871,12 +946,25 @@ class RegistryGossip:
         finally:
             self._applying.active = False
 
+    @staticmethod
+    def _retryable(exc: BaseException) -> bool:
+        """Failures that a LATER record in the same batch can clear:
+        missing dependencies, plus referential-ordering refusals (a type
+        delete ahead of its devices' deletes, an assignment create ahead
+        of the prior assignment's release — cross-entity records ride
+        different partitions, so order is not guaranteed)."""
+        from sitewhere_tpu.errors import (
+            ErrorCode, NotFoundError, SiteWhereError)
+
+        if isinstance(exc, NotFoundError):
+            return True
+        return isinstance(exc, SiteWhereError) and exc.code in (
+            ErrorCode.DEVICE_TYPE_IN_USE, ErrorCode.DEVICE_ALREADY_ASSIGNED)
+
     def _apply(self, data: Dict) -> None:
         from sitewhere_tpu.errors import (
-            DuplicateTokenError, NotFoundError, SiteWhereError)
+            DuplicateTokenError, ErrorCode, NotFoundError, SiteWhereError)
         from sitewhere_tpu.web.marshal import entity_from_payload
-
-        from sitewhere_tpu.errors import ErrorCode
 
         kind = data.get("kind")
         cls = _gossip_class(kind)
@@ -888,12 +976,23 @@ class RegistryGossip:
                 f"gossip for unknown tenant {data.get('tenant')!r}",
                 ErrorCode.INVALID_TENANT_TOKEN)
         registry = engine.registry
+        tenant = data.get("tenant", "")
+        if data.get("op") == "delete":
+            self._apply_delete(registry, tenant, kind, data)
+            return
         entity_data = dict(data.get("entity") or {})
         token = entity_data.get("token", "")
+        # a write that lost to an applied deletion stays dead; a NEWER
+        # write resurrects the entity (the winning side of the LWW pair —
+        # hosts that saw the write first make the delete a no-op instead)
+        tomb = self._tombstones.get((tenant, kind, token))
+        if tomb is not None and _gossip_stamp(entity_data) <= tomb:
+            return
         # remap reference ids through tokens; a missing dependency raises
         # -> the batch redelivers until the dependency gossip arrives
+        ref_tokens = dict(data.get("refs") or {})
         for field, coll_name in _GOSSIP_REFS.get(kind, []):
-            ref_token = (data.get("refs") or {}).get(field)
+            ref_token = ref_tokens.get(field)
             if ref_token:
                 local = getattr(registry, coll_name).get_by_token(ref_token)
                 if local is None:
@@ -906,11 +1005,11 @@ class RegistryGossip:
             # and stay claimable by a later identical local create
             # (registry/store.py _Collection) — the contract that lets
             # every host provision the same world in any order
-            existing = self._get_by_token(registry, kind, token)
+            existing = registry.collection_of(kind).get_by_token(token)
             if existing is None:
                 entity = entity_from_payload(cls, entity_data)
                 try:
-                    self._create(registry, kind, entity)
+                    registry.create_by_kind(kind, entity)
                     self.applied += 1
                 except DuplicateTokenError:
                     pass  # raced another replica of the same create
@@ -921,63 +1020,82 @@ class RegistryGossip:
                     raise
             else:
                 self._update_existing(registry, kind, token, existing,
-                                      entity_data)
+                                      entity_data, ref_tokens)
 
-    @staticmethod
-    def _get_by_token(registry, kind: str, token: str):
-        return {
-            "device_type": registry.device_types,
-            "area": registry.areas,
-            "zone": registry.zones,
-            "device": registry.devices,
-            "assignment": registry.assignments,
-        }[kind].get_by_token(token)
-
-    @staticmethod
-    def _create(registry, kind: str, entity) -> None:
-        {"device_type": registry.create_device_type,
-         "area": registry.create_area,
-         "zone": registry.create_zone,
-         "device": registry.create_device,
-         "assignment": registry.create_device_assignment}[kind](entity)
-
-    def _update_existing(self, registry, kind: str, token: str, existing,
-                         entity_data: Dict) -> None:
-        from sitewhere_tpu.model import DeviceAssignmentStatus
-
-        if kind == "assignment":
-            # lifecycle transitions replicate through their real methods
-            # (they maintain the active-assignment index)
-            status = entity_data.get("status")
-            if status in (DeviceAssignmentStatus.RELEASED,
-                          DeviceAssignmentStatus.RELEASED.value,
-                          DeviceAssignmentStatus.RELEASED.name) \
-                    and existing.status == DeviceAssignmentStatus.ACTIVE:
-                registry.release_device_assignment(token)
-                self.applied += 1
-            return
-        update = {"device_type": registry.update_device_type,
-                  "device": registry.update_device,
-                  "zone": registry.update_zone}.get(kind)
-        if update is None:
-            return  # kinds without an update surface converge on create
-        import dataclasses as _dc
-
-        skip = {"id", "token", "created_date", "updated_date"}
-        fields = {f.name for f in _dc.fields(type(existing))} - skip
+    def _apply_delete(self, registry, tenant: str, kind: str,
+                      data: Dict) -> None:
         from sitewhere_tpu.web.marshal import to_jsonable
 
+        token = data.get("token", "")
+        stamp = int(data.get("stamp") or 0)
+        key = (tenant, kind, token)
+        self._tombstones[key] = max(self._tombstones.get(key, 0), stamp)
+        existing = registry.collection_of(kind).get_by_token(token)
+        if existing is None:
+            return  # idempotent redelivery, or the entity never arrived
+        if _gossip_stamp(to_jsonable(existing)) > stamp:
+            return  # a concurrent write outranked the delete: keep it
+        with registry.replication():
+            registry.delete_by_kind(kind, token)
+        self.applied += 1
+
+    def _local_ref_tokens(self, registry, kind: str, entity) -> Dict[str, str]:
+        """The entity's replicated references by token — the local half of
+        the host-independent content digest."""
+        out: Dict[str, str] = {}
+        for field, coll_name in _GOSSIP_REFS.get(kind, []):
+            ref_id = getattr(entity, field, None)
+            if ref_id:
+                ref = getattr(registry, coll_name).get(ref_id)
+                if ref is not None:
+                    out[field] = ref.token
+        return out
+
+    def _update_existing(self, registry, kind: str, token: str, existing,
+                         entity_data: Dict, ref_tokens: Dict) -> None:
+        import dataclasses as _dc
+
+        from sitewhere_tpu.web.marshal import entity_from_payload, to_jsonable
+
         current = to_jsonable(existing)
-        diff = {k: v for k, v in entity_data.items()
-                if k in fields and current.get(k) != v}
-        if diff:
-            try:
-                update(token, diff)
-                self.applied += 1
-            except Exception:
-                self.conflicts += 1
-                LOGGER.exception("gossip update of %s %r failed", kind,
-                                 token)
+        # last-writer-wins: stamps first, host-independent digest on exact
+        # ties — every host compares the same (stamp, digest) pair, so
+        # concurrent updates converge to the same winner everywhere. The
+        # digests (json + sha1 over the full entity) are only computed on
+        # a tie, the rare case.
+        inc_ts, loc_ts = _gossip_stamp(entity_data), _gossip_stamp(current)
+        if inc_ts < loc_ts:
+            return  # stale: the local copy already won
+        if inc_ts == loc_ts:
+            inc_key = _gossip_content_key(kind, entity_data, ref_tokens)
+            loc_key = _gossip_content_key(
+                kind, current,
+                self._local_ref_tokens(registry, kind, existing))
+            if inc_key <= loc_key:
+                return  # identical, or the local copy wins the tiebreak
+        # coerce through the marshal layer so enum/location fields apply
+        # with model types, not raw wire values
+        coerced = entity_from_payload(type(existing), entity_data)
+        inc_json = to_jsonable(coerced)
+        # the writer's updated_date is part of the diff: adopting the
+        # winning stamp is what keeps later comparisons consistent
+        fields = {f.name for f in _dc.fields(type(existing))} \
+            - {"id", "token", "created_date"}
+        diff = {name: getattr(coerced, name) for name in fields
+                if current.get(name) != inc_json.get(name)}
+        if not diff:
+            return
+        try:
+            with registry.replication():
+                result = registry.update_by_kind(kind, token, diff)
+                if kind == "assignment":
+                    # status may have moved through the generic diff path:
+                    # re-derive the active-assignment index entry
+                    registry.reconcile_active_assignment(result)
+            self.applied += 1
+        except Exception:
+            self.conflicts += 1
+            LOGGER.exception("gossip update of %s %r failed", kind, token)
 
 
 # ---------------------------------------------------------------------------

@@ -184,7 +184,8 @@ class _Collection(Generic[T]):
 
     def __init__(self, kind: str, cls: Type[T], store: Any,
                  not_found: ErrorCode,
-                 replicating: Optional[Callable[[], bool]] = None):
+                 replicating: Optional[Callable[[], bool]] = None,
+                 on_mutation: Optional[Callable[[str, str, T], None]] = None):
         self.kind = kind
         self.cls = cls
         self.store = store
@@ -193,6 +194,10 @@ class _Collection(Generic[T]):
         self.by_token: Dict[str, T] = {}
         self._lock = threading.RLock()
         self._is_replicating = replicating or (lambda: False)
+        # complete (kind, op, entity) feed across every mutation path —
+        # what the cluster replicates; fired OUTSIDE the collection lock
+        # (the callback may do network I/O)
+        self._on_mutation = on_mutation
         # unclaimed-replica markers persist under a reserved kind (load_all
         # is always kind-filtered) so the claim contract survives the gang
         # restarts that rebuild every host from durable state
@@ -204,6 +209,10 @@ class _Collection(Generic[T]):
             self.by_id[_id] = entity
             if _token:
                 self.by_token[_token] = entity
+
+    def _emit(self, op: str, entity: T) -> None:
+        if self._on_mutation is not None:
+            self._on_mutation(self.kind, op, entity)
 
     def create(self, entity: T) -> T:
         with self._lock:
@@ -218,17 +227,22 @@ class _Collection(Generic[T]):
                 if self._is_replicating():
                     return existing  # peer redelivery: idempotent
                 merged = self._merge_replicated_locked(entity, existing)
-                if merged is not None:
-                    return merged
-                raise DuplicateTokenError(
-                    f"{self.kind} token '{token}' already exists")
-            if self._is_replicating():
-                self._replicated_tokens.add(token)
-                self.store.save(self._replica_kind, token, token, "{}")
-            self.by_id[entity.id] = entity
-            self.by_token[token] = entity
-            self.store.save(self.kind, entity.id, token, _entity_to_json(entity))
-            return entity
+                if merged is None:
+                    raise DuplicateTokenError(
+                        f"{self.kind} token '{token}' already exists")
+            else:
+                if self._is_replicating():
+                    self._replicated_tokens.add(token)
+                    self.store.save(self._replica_kind, token, token, "{}")
+                self.by_id[entity.id] = entity
+                self.by_token[token] = entity
+                self.store.save(self.kind, entity.id, token,
+                                _entity_to_json(entity))
+        if existing is not None:
+            self._emit("update", existing)  # claimed replica
+            return existing
+        self._emit("create", entity)
+        return entity
 
     def claimable_replica(self, token: str) -> bool:
         """True when `token` names an unclaimed replicated entity a local
@@ -243,7 +257,10 @@ class _Collection(Generic[T]):
             existing = self.by_token.get(getattr(entity, "token", ""))
             if existing is None:
                 return None
-            return self._merge_replicated_locked(entity, existing)
+            merged = self._merge_replicated_locked(entity, existing)
+        if merged is not None:
+            self._emit("update", merged)
+        return merged
 
     def _merge_replicated_locked(self, entity: T, existing: T) -> Optional[T]:
         token = getattr(entity, "token", "")
@@ -255,6 +272,11 @@ class _Collection(Generic[T]):
         for field in dataclasses.fields(existing):
             if field.name not in self._MERGE_SKIP:
                 setattr(existing, field.name, getattr(entity, field.name))
+        # the claim is a NEW write: stamp past the replica's so the
+        # emitted update wins last-writer-wins on every peer (without
+        # this, it would tie the original create's stamp and the digest
+        # could keep the pre-claim content on other hosts)
+        existing.touch()
         self.store.save(self.kind, existing.id, token,
                         _entity_to_json(existing))
         return existing
@@ -296,7 +318,16 @@ class _Collection(Generic[T]):
                     raise SiteWhereError(f"unknown field '{key}' on {self.kind}")
             for key, val in updates.items():
                 setattr(entity, key, val)
-            entity.touch(username)
+            if not self._is_replicating():
+                entity.touch(username)
+            # else: a replicated update carries the WRITER's updated_date in
+            # `updates` — adopting it (not re-stamping) is what makes
+            # last-writer-wins comparisons agree on every host
+            # Any update ends the claim window: a late local create of this
+            # token must now raise on EVERY host (the claim-merge contract
+            # covers boot-time provisioning races only, not clobbering an
+            # entity that has since moved on — e.g. a released assignment)
+            self._discard_replica_locked(old_token)
             new_token = getattr(entity, "token", "")
             if new_token != old_token:
                 if new_token in self.by_token:
@@ -307,7 +338,8 @@ class _Collection(Generic[T]):
                 if new_token:
                     self.by_token[new_token] = entity
             self.store.save(self.kind, entity.id, new_token, _entity_to_json(entity))
-            return entity
+        self._emit("update", entity)
+        return entity
 
     def delete(self, entity_id: str) -> T:
         with self._lock:
@@ -318,12 +350,17 @@ class _Collection(Generic[T]):
                 self.by_token.pop(token, None)
                 self._discard_replica_locked(token)
             self.store.delete(self.kind, entity_id)
-            return entity
+        self._emit("delete", entity)
+        return entity
 
     def save(self, entity: T) -> None:
         """Persist in-place mutations."""
-        self.store.save(self.kind, entity.id, getattr(entity, "token", ""),
-                        _entity_to_json(entity))
+        token = getattr(entity, "token", "")
+        with self._lock:
+            self.store.save(self.kind, entity.id, token,
+                            _entity_to_json(entity))
+            self._discard_replica_locked(token)  # mutation ends the claim
+        self._emit("update", entity)
 
     def list(self, criteria: Optional[SearchCriteria] = None,
              where: Optional[Callable[[T], bool]] = None) -> SearchResults[T]:
@@ -357,45 +394,41 @@ class DeviceManagement:
         self.tenant_id = tenant_id
         self.store = store
         self._replication = threading.local()
-        rep = self._replicating
         E = ErrorCode
-        self.device_types: _Collection[DeviceType] = _Collection(
-            "device_type", DeviceType, store, E.INVALID_DEVICE_TYPE_TOKEN,
-            replicating=rep)
-        self.device_commands: _Collection[DeviceCommand] = _Collection(
-            "device_command", DeviceCommand, store, E.INVALID_COMMAND_TOKEN,
-            replicating=rep)
-        self.device_statuses: _Collection[DeviceStatus] = _Collection(
-            "device_status", DeviceStatus, store, E.INVALID_DEVICE_TOKEN,
-            replicating=rep)
-        self.devices: _Collection[Device] = _Collection(
-            "device", Device, store, E.INVALID_DEVICE_TOKEN, replicating=rep)
-        self.assignments: _Collection[DeviceAssignment] = _Collection(
-            "assignment", DeviceAssignment, store, E.INVALID_ASSIGNMENT_TOKEN,
-            replicating=rep)
-        self.area_types: _Collection[AreaType] = _Collection(
-            "area_type", AreaType, store, E.INVALID_AREA_TOKEN,
-            replicating=rep)
-        self.areas: _Collection[Area] = _Collection(
-            "area", Area, store, E.INVALID_AREA_TOKEN, replicating=rep)
-        self.zones: _Collection[Zone] = _Collection(
-            "zone", Zone, store, E.INVALID_ZONE_TOKEN, replicating=rep)
-        self.customer_types: _Collection[CustomerType] = _Collection(
-            "customer_type", CustomerType, store, E.INVALID_CUSTOMER_TOKEN,
-            replicating=rep)
-        self.customers: _Collection[Customer] = _Collection(
-            "customer", Customer, store, E.INVALID_CUSTOMER_TOKEN,
-            replicating=rep)
-        self.device_groups: _Collection[DeviceGroup] = _Collection(
-            "device_group", DeviceGroup, store, E.INVALID_GROUP_TOKEN,
-            replicating=rep)
-        self.group_elements: _Collection[DeviceGroupElement] = _Collection(
-            "group_element", DeviceGroupElement, store, E.INVALID_GROUP_TOKEN,
-            replicating=rep)
-        self.alarms: _Collection[DeviceAlarm] = _Collection(
-            "alarm", DeviceAlarm, store, E.INVALID_DEVICE_TOKEN,
-            replicating=rep)
+
+        def coll(kind: str, cls: Type, err: ErrorCode) -> _Collection:
+            return _Collection(kind, cls, store, err,
+                               replicating=self._replicating,
+                               on_mutation=self._emit_mutation)
+
+        self.device_types: _Collection[DeviceType] = coll(
+            "device_type", DeviceType, E.INVALID_DEVICE_TYPE_TOKEN)
+        self.device_commands: _Collection[DeviceCommand] = coll(
+            "device_command", DeviceCommand, E.INVALID_COMMAND_TOKEN)
+        self.device_statuses: _Collection[DeviceStatus] = coll(
+            "device_status", DeviceStatus, E.INVALID_DEVICE_TOKEN)
+        self.devices: _Collection[Device] = coll(
+            "device", Device, E.INVALID_DEVICE_TOKEN)
+        self.assignments: _Collection[DeviceAssignment] = coll(
+            "assignment", DeviceAssignment, E.INVALID_ASSIGNMENT_TOKEN)
+        self.area_types: _Collection[AreaType] = coll(
+            "area_type", AreaType, E.INVALID_AREA_TOKEN)
+        self.areas: _Collection[Area] = coll(
+            "area", Area, E.INVALID_AREA_TOKEN)
+        self.zones: _Collection[Zone] = coll(
+            "zone", Zone, E.INVALID_ZONE_TOKEN)
+        self.customer_types: _Collection[CustomerType] = coll(
+            "customer_type", CustomerType, E.INVALID_CUSTOMER_TOKEN)
+        self.customers: _Collection[Customer] = coll(
+            "customer", Customer, E.INVALID_CUSTOMER_TOKEN)
+        self.device_groups: _Collection[DeviceGroup] = coll(
+            "device_group", DeviceGroup, E.INVALID_GROUP_TOKEN)
+        self.group_elements: _Collection[DeviceGroupElement] = coll(
+            "group_element", DeviceGroupElement, E.INVALID_GROUP_TOKEN)
+        self.alarms: _Collection[DeviceAlarm] = coll(
+            "alarm", DeviceAlarm, E.INVALID_DEVICE_TOKEN)
         self._listeners: List[Callable[[str, Any], None]] = []
+        self._mutation_listeners: List[Callable[[str, str, Any], None]] = []
         # device_id -> active assignment (the hot lookup of
         # InboundPayloadProcessingLogic.validateAssignment:179)
         self._active_assignment: Dict[str, DeviceAssignment] = {}
@@ -414,12 +447,14 @@ class DeviceManagement:
         (parallel/cluster.py RegistryGossip): creates become idempotent
         get-or-create and their entities stay claimable by a later
         identical local create, so cluster hosts can provision the same
-        world in any order relative to gossip arrival."""
+        world in any order relative to gossip arrival. Reentrant: nested
+        contexts restore the prior flag, not False."""
+        prev = getattr(self._replication, "active", False)
         self._replication.active = True
         try:
             yield
         finally:
-            self._replication.active = False
+            self._replication.active = prev
 
     # -- change notification --------------------------------------------------
 
@@ -429,6 +464,93 @@ class DeviceManagement:
     def _notify(self, kind: str, entity: Any) -> None:
         for callback in list(self._listeners):
             callback(kind, entity)
+
+    def add_mutation_listener(
+            self, callback: Callable[[str, str, Any], None]) -> None:
+        """Subscribe to the COMPLETE (kind, op, entity) mutation feed —
+        every create/update/delete on every collection, fired from the
+        collections themselves so no wrapper can forget to notify. This is
+        what cluster replication rides (parallel/cluster.py RegistryGossip,
+        the role of the reference's DeviceManagementTriggers Kafka
+        notifications, sitewhere-microservice DeviceManagementTriggers)."""
+        self._mutation_listeners.append(callback)
+
+    def _emit_mutation(self, kind: str, op: str, entity: Any) -> None:
+        for callback in list(self._mutation_listeners):
+            callback(kind, op, entity)
+
+    # -- kind dispatch (replication appliers) ----------------------------------
+
+    def collection_of(self, kind: str) -> _Collection:
+        return {
+            "device_type": self.device_types,
+            "device_command": self.device_commands,
+            "device_status": self.device_statuses,
+            "device": self.devices,
+            "assignment": self.assignments,
+            "area_type": self.area_types,
+            "area": self.areas,
+            "zone": self.zones,
+            "customer_type": self.customer_types,
+            "customer": self.customers,
+            "device_group": self.device_groups,
+            "group_element": self.group_elements,
+            "alarm": self.alarms,
+        }[kind]
+
+    def create_by_kind(self, kind: str, entity: Any) -> Any:
+        """Create through the kind's wrapper (side effects: active-
+        assignment index, mirror notifications) — the uniform entry the
+        replication applier uses for every entity kind."""
+        wrapper = {
+            "device_type": self.create_device_type,
+            "device_command": self.create_device_command,
+            "device_status": self.create_device_status,
+            "device": self.create_device,
+            "assignment": self.create_device_assignment,
+            "area_type": self.create_area_type,
+            "area": self.create_area,
+            "zone": self.create_zone,
+            "customer_type": self.create_customer_type,
+            "customer": self.create_customer,
+            "device_group": self.create_device_group,
+            "alarm": self.create_device_alarm,
+        }.get(kind)
+        if wrapper is not None:
+            return wrapper(entity)
+        return self.collection_of(kind).create(entity)
+
+    def update_by_kind(self, kind: str, token: str, updates: Dict) -> Any:
+        """Update by token through the kind's wrapper where one exists
+        (mirror notifications), the collection otherwise."""
+        wrapper = {
+            "device_type": self.update_device_type,
+            "device": self.update_device,
+            "zone": self.update_zone,
+        }.get(kind)
+        if wrapper is not None:
+            return wrapper(token, updates)
+        collection = self.collection_of(kind)
+        result = collection.update(collection.require_by_token(token).id,
+                                   updates)
+        self._notify(kind, result)
+        return result
+
+    def delete_by_kind(self, kind: str, token: str) -> Any:
+        """Delete by token through the kind's wrapper where one exists
+        (referential validation + index upkeep), the collection otherwise."""
+        wrapper = {
+            "device_type": self.delete_device_type,
+            "device": self.delete_device,
+            "zone": self.delete_zone,
+            "assignment": self.delete_device_assignment,
+        }.get(kind)
+        if wrapper is not None:
+            return wrapper(token)
+        collection = self.collection_of(kind)
+        result = collection.delete(collection.require_by_token(token).id)
+        self._notify(kind, result)
+        return result
 
     # -- device types / commands / statuses -----------------------------------
 
@@ -591,6 +713,23 @@ class DeviceManagement:
             del self._active_assignment[assignment.device_id]
         self._notify("assignment", assignment)
         return assignment
+
+    def reconcile_active_assignment(self, assignment: DeviceAssignment) -> None:
+        """Re-derive the active-assignment index entry for one assignment
+        after a replicated field update (the replication applier mutates
+        status through the generic diff path, not the lifecycle methods)."""
+        if assignment.status == DeviceAssignmentStatus.ACTIVE:
+            self._active_assignment[assignment.device_id] = assignment
+        elif self._active_assignment.get(assignment.device_id) is assignment:
+            del self._active_assignment[assignment.device_id]
+
+    def delete_device_assignment(self, token: str) -> DeviceAssignment:
+        assignment = self.assignments.require_by_token(token)
+        result = self.assignments.delete(assignment.id)
+        if self._active_assignment.get(assignment.device_id) is assignment:
+            del self._active_assignment[assignment.device_id]
+        self._notify("assignment", result)
+        return result
 
     def mark_assignment_missing(self, assignment_id: str) -> DeviceAssignment:
         assignment = self.assignments.require(assignment_id)

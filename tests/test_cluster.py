@@ -522,21 +522,23 @@ if pid == 0:
     assert state is not None \
         and state.last_measurements["temp"][1] == 77.0, (
             expect, state and state.last_measurements)
-    # assignment release on host 0 replicates to host 1
+    # assignment release on host 0 replicates to host 1, then the full
+    # decommission (assignment + device DELETE) must replicate too
     te.registry.release_device_assignment("ga" + expect[2:])
+    te.registry.delete_device_assignment("ga" + expect[2:])
+    te.registry.delete_device(expect)
 if pid == 1:
-    # host 0 released the assignment of ITS first owned token (the same
+    # host 0 released + deleted ITS first owned token (the same
     # deterministic choice rule on both sides); wait for the gossip
-    released = "ga" + [t for t in tokens
-                       if cluster.owner_process(t) == 0][0][2:]
+    gone = [t for t in tokens if cluster.owner_process(t) == 0][0]
     deadline = time.monotonic() + 120
     while time.monotonic() < deadline:
-        a = te.registry.assignments.get_by_token(released)
-        if a is not None and a.status == DeviceAssignmentStatus.RELEASED:
+        if te.registry.assignments.get_by_token("ga" + gone[2:]) is None \
+                and te.registry.get_device_by_token(gone) is None:
             break
         time.sleep(0.1)
     else:
-        raise SystemExit("release never replicated")
+        raise SystemExit("delete never replicated")
 print(f"E2EOK {pid}", flush=True)
 time.sleep(1.0)
 cluster.stop()

@@ -1,0 +1,294 @@
+"""Registry gossip replication semantics (parallel/cluster.py
+RegistryGossip), in-process: two SiteWhereInstances exchange captured
+gossip payloads directly, covering ALL entity kinds, deletions,
+last-writer-wins convergence of concurrent updates, resurrection, and
+dependency-order-independent batch application.
+
+The two-OS-process transport path is covered by
+tests/test_cluster.py::test_two_process_registry_gossip; these tests pin
+the replication ALGEBRA, which needs exact control over apply order.
+
+Reference analogue: the shared-store consistency every microservice gets
+from one MongoDB (service-device-management
+persistence/mongodb/MongoDeviceManagement.java) — rebuilt leaderless.
+"""
+
+import random
+
+import msgpack
+import pytest
+
+from sitewhere_tpu.instance import SiteWhereInstance
+from sitewhere_tpu.model import (
+    Area, AreaType, Customer, CustomerType, Device, DeviceAlarm,
+    DeviceAssignment, DeviceAssignmentStatus, DeviceCommand, DeviceGroup,
+    DeviceGroupElement, DeviceStatus, DeviceType, Zone,
+)
+from sitewhere_tpu.parallel.cluster import RegistryGossip
+from sitewhere_tpu.runtime.bus import Record
+
+
+class _Capture:
+    """BusClient stand-in collecting published gossip payloads."""
+
+    def __init__(self):
+        self.sent = []
+
+    def publish(self, topic, key, value):
+        self.sent.append(value)
+
+    def drain(self):
+        out, self.sent = self.sent, []
+        return out
+
+
+def _host(instance_id="gossip-algebra"):
+    instance = SiteWhereInstance(instance_id=instance_id)
+    instance.start()
+    capture = _Capture()
+    gossip = RegistryGossip(0, {1: capture}, instance, instance.naming)
+    engine = instance.get_tenant_engine("default")
+    gossip.register_tenant_registry("default", engine.registry)
+    return instance, engine.registry, gossip, capture
+
+
+def _apply(gossip, payloads):
+    gossip._handle([Record("t", 0, i, b"", p, 0)
+                    for i, p in enumerate(payloads)])
+
+
+class TestAllKindsReplicate:
+    def test_full_registry_replicates_in_any_order(self):
+        _, reg_a, _gossip_a, cap = _host()
+        _, reg_b, gossip_b, _ = _host()
+
+        dtype = reg_a.create_device_type(DeviceType(token="dt", name="T"))
+        reg_a.create_device_command(DeviceCommand(
+            token="cmd", device_type_id=dtype.id, name="reboot"))
+        reg_a.create_device_status(DeviceStatus(
+            token="st", device_type_id=dtype.id, name="ok"))
+        atype = reg_a.create_area_type(AreaType(token="at", name="site"))
+        area = reg_a.create_area(Area(token="ar", area_type_id=atype.id))
+        reg_a.create_zone(Zone(token="zn", area_id=area.id))
+        ctype = reg_a.create_customer_type(CustomerType(token="ct"))
+        cust = reg_a.create_customer(Customer(token="cu",
+                                              customer_type_id=ctype.id))
+        device = reg_a.create_device(Device(token="dv",
+                                            device_type_id=dtype.id))
+        assignment = reg_a.create_device_assignment(DeviceAssignment(
+            token="as", device_id=device.id, area_id=area.id,
+            customer_id=cust.id))
+        group = reg_a.create_device_group(DeviceGroup(token="gr"))
+        reg_a.add_device_group_elements(
+            "gr", [DeviceGroupElement(token="ge", device_id=device.id)])
+        reg_a.create_device_alarm(DeviceAlarm(
+            token="al", device_id=device.id,
+            device_assignment_id=assignment.id))
+
+        payloads = cap.drain()
+        assert len(payloads) == 13
+        # worst-case ordering: dependencies after dependents
+        shuffled = list(payloads)
+        random.Random(7).shuffle(shuffled)
+        _apply(gossip_b, shuffled)
+
+        for coll, token in [
+                ("device_types", "dt"), ("device_commands", "cmd"),
+                ("device_statuses", "st"), ("area_types", "at"),
+                ("areas", "ar"), ("zones", "zn"), ("customer_types", "ct"),
+                ("customers", "cu"), ("devices", "dv"),
+                ("assignments", "as"), ("device_groups", "gr"),
+                ("group_elements", "ge"), ("alarms", "al")]:
+            assert getattr(reg_b, coll).get_by_token(token) is not None, \
+                (coll, token)
+        # references remapped to B-LOCAL ids
+        b_device = reg_b.get_device_by_token("dv")
+        assert b_device.device_type_id == \
+            reg_b.device_types.get_by_token("dt").id
+        b_as = reg_b.assignments.get_by_token("as")
+        assert b_as.device_id == b_device.id
+        assert b_as.status == DeviceAssignmentStatus.ACTIVE
+        assert reg_b.get_active_assignment(b_device.id) is b_as
+        assert b_as.active_date == assignment.active_date
+        b_ge = reg_b.group_elements.get_by_token("ge")
+        assert b_ge.group_id == reg_b.device_groups.get_by_token("gr").id
+        assert b_ge.device_id == b_device.id
+
+
+class TestDeletionReplication:
+    def _provisioned_pair(self):
+        _, reg_a, _ga, cap = _host()
+        _, reg_b, gossip_b, _ = _host()
+        dtype = reg_a.create_device_type(DeviceType(token="dt"))
+        device = reg_a.create_device(Device(token="dv",
+                                            device_type_id=dtype.id))
+        reg_a.create_device_assignment(DeviceAssignment(token="as",
+                                                        device_id=device.id))
+        _apply(gossip_b, cap.drain())
+        return reg_a, cap, reg_b, gossip_b
+
+    def test_delete_replicates(self):
+        reg_a, cap, reg_b, gossip_b = self._provisioned_pair()
+        reg_a.release_device_assignment("as")
+        reg_a.delete_device_assignment("as")
+        reg_a.delete_device("dv")
+        reg_a.delete_device_type("dt")
+        _apply(gossip_b, cap.drain())
+        assert reg_b.assignments.get_by_token("as") is None
+        assert reg_b.get_device_by_token("dv") is None
+        assert reg_b.device_types.get_by_token("dt") is None
+
+    def test_delete_order_independent(self):
+        # deletes ride different partitions per token: apply them in
+        # REVERSE dependency order; the multi-pass applier must resolve
+        reg_a, cap, reg_b, gossip_b = self._provisioned_pair()
+        reg_a.release_device_assignment("as")
+        reg_a.delete_device_assignment("as")
+        reg_a.delete_device("dv")
+        reg_a.delete_device_type("dt")
+        _apply(gossip_b, list(reversed(cap.drain())))
+        assert reg_b.get_device_by_token("dv") is None
+        assert reg_b.device_types.get_by_token("dt") is None
+
+    def test_release_clears_active_index_on_peer(self):
+        reg_a, cap, reg_b, gossip_b = self._provisioned_pair()
+        reg_a.release_device_assignment("as")
+        _apply(gossip_b, cap.drain())
+        b_device = reg_b.get_device_by_token("dv")
+        assert reg_b.get_active_assignment(b_device.id) is None
+        assert reg_b.assignments.get_by_token("as").status == \
+            DeviceAssignmentStatus.RELEASED
+
+
+class TestLastWriterWins:
+    def _pair_with_device(self):
+        ia, reg_a, gossip_a, cap_a = _host()
+        ib, reg_b, gossip_b, cap_b = _host()
+        dtype = reg_a.create_device_type(DeviceType(token="dt"))
+        reg_a.create_device(Device(token="dv", device_type_id=dtype.id,
+                                   comments="base"))
+        for p in cap_a.drain():
+            _apply(gossip_b, [p])
+        cap_b.drain()  # drop echoes of B's claim merges (none expected)
+        return reg_a, gossip_a, cap_a, reg_b, gossip_b, cap_b
+
+    def test_concurrent_updates_converge_identically(self):
+        reg_a, gossip_a, cap_a, reg_b, gossip_b, cap_b = \
+            self._pair_with_device()
+        # concurrent conflicting updates on both hosts
+        reg_a.update_device("dv", {"comments": "from-A"})
+        reg_b.update_device("dv", {"comments": "from-B"})
+        from_a, from_b = cap_a.drain(), cap_b.drain()
+        # cross-apply in OPPOSITE orders: both hosts must converge on the
+        # same winner regardless of arrival order
+        _apply(gossip_b, from_a)
+        _apply(gossip_a, from_b)
+        a_final = reg_a.get_device_by_token("dv")
+        b_final = reg_b.get_device_by_token("dv")
+        assert a_final.comments == b_final.comments
+        assert a_final.updated_date == b_final.updated_date
+
+    def test_equal_stamp_tie_breaks_deterministically(self):
+        reg_a, gossip_a, cap_a, reg_b, gossip_b, cap_b = \
+            self._pair_with_device()
+        base = msgpack.unpackb(self._update_payload(reg_a, cap_a),
+                               raw=False)
+        # craft two same-stamp writers differing only in content
+        w1, w2 = dict(base), dict(base)
+        w1["entity"] = dict(base["entity"], comments="tie-one",
+                            updated_date=9_999_999_999_999)
+        w2["entity"] = dict(base["entity"], comments="tie-two",
+                            updated_date=9_999_999_999_999)
+        p1 = msgpack.packb(w1, use_bin_type=True)
+        p2 = msgpack.packb(w2, use_bin_type=True)
+        _apply(gossip_a, [p1, p2])
+        _apply(gossip_b, [p2, p1])  # reverse order
+        assert reg_a.get_device_by_token("dv").comments == \
+            reg_b.get_device_by_token("dv").comments
+
+    @staticmethod
+    def _update_payload(reg, cap):
+        reg.update_device("dv", {"comments": "probe"})
+        return cap.drain()[-1]
+
+    def test_stale_update_skipped(self):
+        reg_a, gossip_a, cap_a, reg_b, gossip_b, cap_b = \
+            self._pair_with_device()
+        stale = msgpack.unpackb(self._update_payload(reg_a, cap_a),
+                                raw=False)
+        stale["entity"] = dict(stale["entity"], comments="ancient",
+                               updated_date=1)
+        reg_b.update_device("dv", {"comments": "current"})
+        cap_b.drain()
+        _apply(gossip_b, [msgpack.packb(stale, use_bin_type=True)])
+        assert reg_b.get_device_by_token("dv").comments == "current"
+
+    def test_delete_vs_newer_update_resurrects_everywhere(self):
+        reg_a, gossip_a, cap_a, reg_b, gossip_b, cap_b = \
+            self._pair_with_device()
+        # A deletes; B updates with a LATER stamp than the delete
+        reg_a.delete_device("dv")
+        (delete_payload,) = cap_a.drain()
+        delete_stamp = msgpack.unpackb(delete_payload, raw=False)["stamp"]
+        reg_b.update_device("dv", {"comments": "survivor"})
+        b_dev = reg_b.get_device_by_token("dv")
+        if (b_dev.updated_date or 0) <= delete_stamp:
+            reg_b.update_device("dv", {"comments": "survivor"})  # re-stamp
+        (update_payload,) = cap_b.drain()[-1:]
+        # A (already deleted) receives the newer update: resurrection
+        _apply(gossip_a, [update_payload])
+        assert reg_a.get_device_by_token("dv") is not None
+        assert reg_a.get_device_by_token("dv").comments == "survivor"
+        # B receives the older delete: no-op, the write outranked it
+        _apply(gossip_b, [delete_payload])
+        assert reg_b.get_device_by_token("dv") is not None
+
+    def test_delete_vs_older_update_stays_dead(self):
+        reg_a, gossip_a, cap_a, reg_b, gossip_b, cap_b = \
+            self._pair_with_device()
+        stale = msgpack.unpackb(self._update_payload(reg_a, cap_a),
+                                raw=False)
+        cap_a.drain()
+        reg_b.delete_device("dv")
+        (delete_payload,) = cap_b.drain()
+        _apply(gossip_a, [delete_payload])
+        assert reg_a.get_device_by_token("dv") is None
+        # the pre-delete update arrives late on A: tombstone wins
+        stale["entity"] = dict(stale["entity"], updated_date=2)
+        _apply(gossip_a, [msgpack.packb(stale, use_bin_type=True)])
+        assert reg_a.get_device_by_token("dv") is None
+
+    def test_own_delete_tombstones_locally(self):
+        # the deleting host must not resurrect the entity when a peer's
+        # concurrent (older) update arrives after its own delete
+        reg_a, gossip_a, cap_a, reg_b, gossip_b, cap_b = \
+            self._pair_with_device()
+        reg_b.update_device("dv", {"comments": "in-flight"})
+        (update_payload,) = cap_b.drain()
+        reg_a.delete_device("dv")  # stamps past everything A has seen
+        cap_a.drain()
+        _apply(gossip_a, [update_payload])
+        assert reg_a.get_device_by_token("dv") is None
+
+
+class TestClaimWindow:
+    def test_any_update_ends_claimability(self):
+        # an entity that moved on since its replicated create must raise
+        # on a late local create — on EVERY host — instead of merging
+        from sitewhere_tpu.errors import DuplicateTokenError
+        from sitewhere_tpu.registry import DeviceManagement
+
+        dm = DeviceManagement()
+        with dm.replication():
+            dtype = dm.create_device_type(DeviceType(token="rt"))
+            device = dm.create_device(Device(token="rd",
+                                             device_type_id=dtype.id))
+            dm.create_device_assignment(
+                DeviceAssignment(token="ra", device_id=device.id))
+        dm.release_device_assignment("ra")  # lifecycle moved on
+        with pytest.raises(Exception):
+            dm.create_device_assignment(
+                DeviceAssignment(token="ra", device_id=device.id))
+        dm.update_device("rd", {"comments": "operator-touched"})
+        with pytest.raises(DuplicateTokenError):
+            dm.create_device(Device(token="rd", device_type_id=dtype.id))
