@@ -69,6 +69,28 @@ def _build_instance(cfg, mesh=None):
             else None))
 
 
+def _apply_rule_config(instance, cfg) -> None:
+    """Install the config-declared fused rules on the booted engine (the
+    reference's RuleProcessingParser spring wiring of
+    ZoneTestRuleProcessor; the metamodel element is
+    runtime/config_model.py rule_processing_model)."""
+    rules = cfg.get("rules") or []
+    engine = instance.pipeline_engine
+    if engine is None:
+        if rules:
+            print("warning: config declares rules but the pipeline is "
+                  "disabled; ignoring", file=sys.stderr)
+        return
+    from sitewhere_tpu.pipeline.engine import rule_from_dict
+
+    for data in rules:
+        kind, rule = rule_from_dict(dict(data))
+        # upsert: config wins over a restored checkpoint's copy of the
+        # same token (restore_on_boot runs inside instance.start(),
+        # BEFORE this) without duplicating it
+        engine.upsert_rule(kind, rule)
+
+
 def _parse_peers(spec: Optional[str]) -> dict:
     """'0=hostA:9092,1=hostB:9092' -> {0: ("hostA", 9092), ...}."""
     out = {}
@@ -116,6 +138,7 @@ def cmd_serve(args) -> int:
 
     instance = _build_instance(cfg)
     instance.start()
+    _apply_rule_config(instance, cfg)
     rest = RestServer(instance, host=cfg.get("api.host"),
                       port=int(cfg.get("api.port")),
                       token_expiration_minutes=int(
@@ -185,6 +208,10 @@ def _serve_cluster(cfg) -> int:
         peer_loss_exit_code=int(cfg.get("cluster.peer_loss_exit_code")),
         registry_gossip=bool(cfg.get("cluster.registry_gossip")))
     cluster.start()
+    # config rules install AFTER cluster.start (the gossip hook is live,
+    # but every host boots the same config, so applies are idempotent
+    # replace-on-add at the peers)
+    _apply_rule_config(instance, cfg)
     rest = RestServer(instance, host=cfg.get("api.host"),
                       port=int(cfg.get("api.port")),
                       token_expiration_minutes=int(

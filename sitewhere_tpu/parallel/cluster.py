@@ -892,7 +892,9 @@ class RegistryGossip:
         except Exception:
             LOGGER.exception("registry gossip encode failed (%s)", kind)
             return
-        key = getattr(entity, "token", "").encode()
+        self._publish(getattr(entity, "token", "").encode(), payload)
+
+    def _publish(self, key: bytes, payload: bytes) -> None:
         for pid, client in self.peers.items():
             try:
                 client.publish(self.topic, key, payload)
@@ -902,6 +904,46 @@ class RegistryGossip:
                 # park for operator replay toward the peer
                 self.instance.bus.publish(f"{self.topic}.dead-letter",
                                           key, payload)
+
+    # -- fused-rule replication --------------------------------------------
+    def register_rules_engine(self, engine) -> None:
+        """Replicate fused-rule mutations (pipeline/engine.py rule feed):
+        a rule added or removed on any host applies on every host, so the
+        42M ev/s rule engine has ONE cluster-wide rule set (the reference
+        re-configures every microservice instance from shared tenant
+        config; here the mutation itself travels)."""
+        engine.add_rules_listener(self._on_rule_mutation)
+
+    def _on_rule_mutation(self, op: str, kind: str, payload) -> None:
+        if getattr(self._applying, "active", False) or not self.peers:
+            return
+        from sitewhere_tpu.pipeline.engine import rule_to_dict
+
+        if op == "remove":
+            token = str(payload)
+            data = {"kind": "_rule", "op": "remove", "token": token}
+        else:
+            token = payload.token
+            data = {"kind": "_rule", "op": "add",
+                    "rule": rule_to_dict(kind, payload)}
+        self._publish(token.encode(),
+                      msgpack.packb(data, use_bin_type=True))
+
+    def _apply_rule(self, data: Dict) -> None:
+        engine = self.instance.pipeline_engine
+        if engine is None:
+            return
+        if data.get("op") == "remove":
+            if engine.remove_rule(data.get("token", "")):
+                self.applied += 1
+            return
+        from sitewhere_tpu.pipeline.engine import rule_from_dict
+
+        kind, rule = rule_from_dict(dict(data.get("rule") or {}))
+        # replace-on-add: idempotent under redelivery and under every
+        # host applying the same boot config
+        engine.upsert_rule(kind, rule)
+        self.applied += 1
 
     # -- apply side --------------------------------------------------------
     def start(self) -> None:
@@ -967,6 +1009,9 @@ class RegistryGossip:
         from sitewhere_tpu.web.marshal import entity_from_payload
 
         kind = data.get("kind")
+        if kind == "_rule":
+            self._apply_rule(data)
+            return
         cls = _gossip_class(kind)
         if cls is None:
             return
@@ -1175,6 +1220,8 @@ class ClusterService:
             build_state=self._build_state, interval_s=heartbeat_s)
         self.gossip = (RegistryGossip(process_id, self.peers, instance,
                                       naming) if registry_gossip else None)
+        if self.gossip is not None:
+            self.gossip.register_rules_engine(engine)
         self.aggregator = TopologyAggregator(
             instance.bus, naming, stale_after_s=stale_after_s)
         expected_peers = [p for p in range(num_processes)

@@ -139,6 +139,10 @@ class PipelineCheckpointer:
             # duplicate — at-least-once, like everything else).
             "pending_alerts": [_asdict(a) for a in
                                getattr(engine, "_pending_alerts", [])],
+            # rules are config, but REST-added ones exist only in the
+            # engine — a restart must not silently drop the operator's
+            # alerting (pipeline/engine.py rule management surface)
+            "rules": self._rules_manifest(engine),
             **layout,
         }
         seq = self._next_seq()
@@ -207,7 +211,24 @@ class PipelineCheckpointer:
         if pending and hasattr(engine, "_pending_alerts"):
             engine._pending_alerts.extend(
                 _alert_from_dict(d) for d in pending)
+        self._restore_rules(engine, manifest.get("rules", []))
         return manifest.get("offsets", {})
+
+    @staticmethod
+    def _rules_manifest(engine) -> List[Dict]:
+        from sitewhere_tpu.pipeline.engine import rule_to_dict
+
+        return [rule_to_dict(kind, rule)
+                for kind, rule_list in engine.list_rules().items()
+                for rule in rule_list]
+
+    @staticmethod
+    def _restore_rules(engine, rules: List[Dict]) -> None:
+        from sitewhere_tpu.pipeline.engine import rule_from_dict
+
+        for data in rules:
+            kind, rule = rule_from_dict(dict(data))
+            engine.upsert_rule(kind, rule)
 
     # -- recovery ----------------------------------------------------------
     def recover(self, engine, bus, topic: str, group_id: str,

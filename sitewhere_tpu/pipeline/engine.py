@@ -16,7 +16,7 @@ from __future__ import annotations
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -67,6 +67,79 @@ class GeofenceRule:
     active: bool = True
 
 
+def rule_to_dict(kind: str, rule) -> Dict:
+    """Wire/REST form of a rule: plain JSON types plus a `type` tag."""
+    import dataclasses
+
+    data = dataclasses.asdict(rule)
+    data["alert_level"] = int(rule.alert_level)
+    data["type"] = kind
+    return data
+
+
+def rule_from_dict(data: Dict):
+    """(kind, rule) from the wire/REST form; validates against the same
+    choices the config metamodel declares (runtime/config_model.py
+    rule_processing_model) AND coerces field types — a rule that passes
+    here must compile into the rule tables without crashing the hot path.
+    Raises SiteWhereError on bad input."""
+    from sitewhere_tpu.errors import ErrorCode, SiteWhereError
+
+    kind = data.get("type")
+    token = data.get("token") or ""
+    if not token or not isinstance(token, str):
+        raise SiteWhereError("rule requires a string token",
+                             ErrorCode.GENERIC)
+
+    def fields_for(cls):
+        import dataclasses
+
+        names = {f.name for f in dataclasses.fields(cls)}
+        out = {k: v for k, v in data.items() if k in names and v is not None}
+        try:
+            if "threshold" in out:
+                out["threshold"] = float(out["threshold"])
+            if "active" in out:
+                out["active"] = bool(out["active"])
+            if "alert_level" in out:
+                level = out["alert_level"]
+                out["alert_level"] = (AlertLevel[level]
+                                      if isinstance(level, str)
+                                      and not level.lstrip("-").isdigit()
+                                      else AlertLevel(int(level)))
+        except (KeyError, ValueError, TypeError) as exc:
+            raise SiteWhereError(f"invalid rule field value: {exc}",
+                                 ErrorCode.GENERIC)
+        for name, value in out.items():
+            if name not in ("threshold", "active", "alert_level") \
+                    and not isinstance(value, str):
+                raise SiteWhereError(
+                    f"rule field '{name}' must be a string",
+                    ErrorCode.GENERIC)
+        return out
+
+    if kind == "threshold":
+        rule = ThresholdRule(**fields_for(ThresholdRule))
+        if rule.operator not in ThresholdOp.BY_NAME:
+            raise SiteWhereError(
+                f"unknown operator {rule.operator!r} (one of "
+                f"{sorted(ThresholdOp.BY_NAME)})", ErrorCode.GENERIC)
+        return kind, rule
+    if kind == "geofence":
+        rule = GeofenceRule(**fields_for(GeofenceRule))
+        if rule.condition not in ("inside", "outside"):
+            raise SiteWhereError(
+                f"geofence condition must be inside|outside, got "
+                f"{rule.condition!r}", ErrorCode.GENERIC)
+        if not rule.zone_token:
+            raise SiteWhereError("geofence rule requires zone_token",
+                                 ErrorCode.GENERIC)
+        return kind, rule
+    raise SiteWhereError(
+        f"unknown rule type {kind!r} (threshold|geofence)",
+        ErrorCode.GENERIC)
+
+
 class PipelineEngine(LifecycleComponent):
     """One engine per process; multi-tenant by construction (tenant axis is a
     tensor column, not a separate engine — SURVEY.md §2.5 tenant parallelism).
@@ -90,6 +163,11 @@ class PipelineEngine(LifecycleComponent):
         self._threshold_rules: List[ThresholdRule] = []
         self._geofence_rules: List[GeofenceRule] = []
         self._rules_version = 0
+        # (op, kind, rule-or-token) feed over rule mutations — the rule
+        # management surface rides it (REST audit, cluster replication)
+        self._rules_listeners: List[Callable[[str, str, object], None]] = []
+        # serializes rule mutation + listener fire (see _mutate_rule)
+        self._rules_io_lock = threading.RLock()
         self._params_built_for: Tuple[int, int] = (-1, -1)
         self._params: Optional[PipelineParams] = None
         self._state: Optional[DeviceStateTensors] = None
@@ -143,35 +221,102 @@ class PipelineEngine(LifecycleComponent):
 
     # -- rules ----------------------------------------------------------------
 
+    def add_rules_listener(
+            self, callback: Callable[[str, str, object], None]) -> None:
+        """Subscribe to rule mutations: callback(op, kind, payload) with
+        op 'add' (payload = the rule) or 'remove' (payload = token)."""
+        self._rules_listeners.append(callback)
+
+    def _fire_rules(self, op: str, kind: str, payload) -> None:
+        for callback in list(self._rules_listeners):
+            callback(op, kind, payload)
+
+    def _mutate_rule(self, kind: str, rule, replace: bool) -> None:
+        """Single mutation path for rule installs. `_rules_io_lock` is
+        held across mutate + listener fire so listeners (cluster gossip)
+        observe mutations in the order they happened; `_lock` (shared
+        with the hot path's params compile) is held only around the list
+        mutation — a stalled gossip publish must never block a step."""
+        from sitewhere_tpu.errors import (
+            DuplicateTokenError, ErrorCode, SiteWhereError)
+
+        if kind == "threshold" and not isinstance(rule, ThresholdRule):
+            raise SiteWhereError("threshold rule expected", ErrorCode.GENERIC)
+        if kind == "geofence" and not isinstance(rule, GeofenceRule):
+            raise SiteWhereError("geofence rule expected", ErrorCode.GENERIC)
+        with self._rules_io_lock:
+            with self._lock:
+                exists = any(
+                    r.token == rule.token
+                    for r in self._threshold_rules + self._geofence_rules)
+                if exists and not replace:
+                    raise DuplicateTokenError(
+                        f"rule '{rule.token}' already exists")
+                if exists:
+                    self._threshold_rules = [
+                        r for r in self._threshold_rules
+                        if r.token != rule.token]
+                    self._geofence_rules = [
+                        r for r in self._geofence_rules
+                        if r.token != rule.token]
+                target, cap = (
+                    (self._threshold_rules, self.max_threshold_rules)
+                    if kind == "threshold"
+                    else (self._geofence_rules, self.max_geofence_rules))
+                if len(target) >= cap:
+                    raise SiteWhereError(f"{kind} rule capacity exceeded",
+                                         ErrorCode.CAPACITY_EXCEEDED)
+                target.append(rule)
+                self._rules_version += 1
+            self._fire_rules("add", kind, rule)
+
+    def create_rule(self, kind: str, rule) -> None:
+        """Install a NEW rule; raises DuplicateTokenError on a token
+        collision (atomically — the REST create contract)."""
+        self._mutate_rule(kind, rule, replace=False)
+
+    def upsert_rule(self, kind: str, rule) -> None:
+        """Install or replace the rule with this token — the idempotent
+        entry used by boot config, checkpoint restore, and cluster
+        replication."""
+        self._mutate_rule(kind, rule, replace=True)
+
+    # upsert semantics: in a cluster, replication may install the same
+    # rule concurrently with local provisioning (every host boots the
+    # same config) — programmatic installs must be idempotent. The strict
+    # duplicate check lives in create_rule (the REST create contract).
     def add_threshold_rule(self, rule: ThresholdRule) -> None:
-        with self._lock:
-            if len(self._threshold_rules) >= self.max_threshold_rules:
-                from sitewhere_tpu.errors import ErrorCode, SiteWhereError
-                raise SiteWhereError("threshold rule capacity exceeded",
-                                     ErrorCode.CAPACITY_EXCEEDED)
-            self._threshold_rules.append(rule)
-            self._rules_version += 1
+        self.upsert_rule("threshold", rule)
 
     def add_geofence_rule(self, rule: GeofenceRule) -> None:
-        with self._lock:
-            if len(self._geofence_rules) >= self.max_geofence_rules:
-                from sitewhere_tpu.errors import ErrorCode, SiteWhereError
-                raise SiteWhereError("geofence rule capacity exceeded",
-                                     ErrorCode.CAPACITY_EXCEEDED)
-            self._geofence_rules.append(rule)
-            self._rules_version += 1
+        self.upsert_rule("geofence", rule)
 
     def remove_rule(self, token: str) -> bool:
-        with self._lock:
-            n = len(self._threshold_rules) + len(self._geofence_rules)
-            self._threshold_rules = [r for r in self._threshold_rules
-                                     if r.token != token]
-            self._geofence_rules = [r for r in self._geofence_rules
-                                    if r.token != token]
-            changed = n != len(self._threshold_rules) + len(self._geofence_rules)
+        with self._rules_io_lock:
+            with self._lock:
+                n = len(self._threshold_rules) + len(self._geofence_rules)
+                self._threshold_rules = [r for r in self._threshold_rules
+                                         if r.token != token]
+                self._geofence_rules = [r for r in self._geofence_rules
+                                        if r.token != token]
+                changed = n != (len(self._threshold_rules)
+                                + len(self._geofence_rules))
+                if changed:
+                    self._rules_version += 1
             if changed:
-                self._rules_version += 1
-            return changed
+                self._fire_rules("remove", "", token)
+        return changed
+
+    def get_rule(self, token: str):
+        """(kind, rule) for a token, or (None, None)."""
+        with self._lock:
+            for rule in self._threshold_rules:
+                if rule.token == token:
+                    return "threshold", rule
+            for rule in self._geofence_rules:
+                if rule.token == token:
+                    return "geofence", rule
+        return None, None
 
     def list_rules(self) -> Dict[str, list]:
         with self._lock:

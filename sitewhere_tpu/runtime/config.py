@@ -180,6 +180,10 @@ DEFAULTS: Dict = {
     },
     "bus": {"partitions": 8, "retention_chunks": 64, "chunk_events": 65536,
             "edge_port": None},  # set to expose the bus on TCP (busnet)
+    # fused pipeline rules applied at boot (list of dicts matching the
+    # `rules` config-model element — runtime/config_model.py
+    # rule_processing_model; same shape as POST /api/rules bodies)
+    "rules": [],
     "persist": {"data_dir": "./swtpu-data",
                 # seconds between automatic device-state checkpoints
                 # (None = manual/REST-triggered only)

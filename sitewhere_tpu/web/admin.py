@@ -63,6 +63,10 @@ _PAGE = """<!doctype html>
    <table id="procs"><thead><tr><th>process</th><th>status</th>
    <th>tick</th><th>age</th><th>liveness</th></tr></thead>
    <tbody></tbody></table></section>
+ <section class="wide"><h2>Pipeline rules</h2>
+   <table id="rules"><thead><tr><th>token</th><th>type</th>
+   <th>definition</th><th>active</th><th>actions</th></tr></thead>
+   <tbody></tbody></table></section>
  <section><h2>Checkpoints</h2>
    <button onclick="ckpt()">Checkpoint now</button>
    <ul id="ckpts" style="font-size:13px"></ul></section>
@@ -130,6 +134,17 @@ async function tick(){
     document.getElementById('logs').textContent=
       lg.records.map(r=>`${r.level??''} ${r.message??JSON.stringify(r)}`)
         .join('\\n')||'(no records)';
+    try{const r=await api('/api/rules');
+      const rows=[...(r.threshold||[]).map(x=>[x,'threshold',
+          `${esc(x.measurement_name||'any')} ${esc(x.operator)} ${esc(x.threshold)}`]),
+        ...(r.geofence||[]).map(x=>[x,'geofence',
+          `${esc(x.condition)} zone ${esc(x.zone_token)}`])];
+      document.querySelector('#rules tbody').innerHTML=rows.map(
+        ([x,kind,def])=>`<tr><td>${esc(x.token)}</td><td>${kind}</td>
+         <td>${def} → ${esc(x.alert_type)}</td>
+         <td class="${x.active?'ok':'bad'}">${x.active?'yes':'no'}</td>
+         <td><button data-rule="${esc(x.token)}">delete</button></td></tr>`
+        ).join('')||'<tr><td colspan="5">(none)</td></tr>';}catch(e){}
     try{const c=await api('/api/instance/checkpoints');
       document.getElementById('ckpts').innerHTML=
         (c.checkpoints||[]).map(x=>`<li>${esc(x)}</li>`).join('')||
@@ -139,7 +154,13 @@ async function tick(){
   }catch(e){document.getElementById('stamp').textContent=e.message}}
 document.addEventListener('click',ev=>{
   const b=ev.target.closest('button[data-tok]');
-  if(b)eng(b.dataset.tok,b.dataset.op);});
+  if(b)eng(b.dataset.tok,b.dataset.op);
+  const r=ev.target.closest('button[data-rule]');
+  if(r)delRule(r.dataset.rule);});
+async function delRule(tok){
+  try{await api(`/api/rules/${encodeURIComponent(tok)}`,
+                {method:'DELETE'});}
+  catch(e){alert(e.message)}tick();}
 async function eng(tok,op){
   try{await api(`/api/tenants/${encodeURIComponent(tok)}/engine/${op}`,
                 {method:'POST'});}

@@ -212,6 +212,65 @@ def register_all(router: Router, instance, server) -> None:
                authority=SiteWhereRoles.VIEW_SERVER_INFO)
 
     # ------------------------------------------------------------------
+    # Rule management — the operator surface of the fused pipeline rules
+    # (pipeline/engine.py add_threshold_rule/add_geofence_rule; reference:
+    # service-rule-processing ZoneTestRuleProcessor.java:33 configured via
+    # RuleProcessingParser spring config, here live CRUD over REST)
+    # ------------------------------------------------------------------
+    def _pipeline_engine():
+        engine = instance.pipeline_engine
+        if engine is None:
+            raise SiteWhereError(
+                "rule management requires a pipeline engine "
+                "(pipeline.enabled)", http_status=409)
+        return engine
+
+    def list_pipeline_rules(request: Request):
+        from sitewhere_tpu.pipeline.engine import rule_to_dict
+
+        rules = _pipeline_engine().list_rules()
+        return {kind: [rule_to_dict(kind, rule) for rule in rule_list]
+                for kind, rule_list in rules.items()}
+
+    def create_pipeline_rule(request: Request):
+        from sitewhere_tpu.pipeline.engine import rule_from_dict, rule_to_dict
+
+        engine = _pipeline_engine()
+        kind, rule = rule_from_dict(_body(request))
+        engine.create_rule(kind, rule)  # atomic duplicate-token check
+        return rule_to_dict(kind, rule)
+
+    def get_pipeline_rule(request: Request):
+        from sitewhere_tpu.pipeline.engine import rule_to_dict
+
+        kind, rule = _pipeline_engine().get_rule(request.params["token"])
+        if kind is None:
+            raise NotFoundError(
+                f"rule '{request.params['token']}' not found",
+                ErrorCode.GENERIC)
+        return rule_to_dict(kind, rule)
+
+    def delete_pipeline_rule(request: Request):
+        from sitewhere_tpu.pipeline.engine import rule_to_dict
+
+        engine = _pipeline_engine()
+        token = request.params["token"]
+        kind, rule = engine.get_rule(token)
+        if kind is None or not engine.remove_rule(token):
+            raise NotFoundError(f"rule '{token}' not found",
+                                ErrorCode.GENERIC)
+        return rule_to_dict(kind, rule)
+
+    router.get("/api/rules", list_pipeline_rules,
+               authority=SiteWhereRoles.VIEW_SERVER_INFO)
+    router.post("/api/rules", create_pipeline_rule,
+                authority=SiteWhereRoles.ADMINISTER_TENANTS)
+    router.get("/api/rules/{token}", get_pipeline_rule,
+               authority=SiteWhereRoles.VIEW_SERVER_INFO)
+    router.delete("/api/rules/{token}", delete_pipeline_rule,
+                  authority=SiteWhereRoles.ADMINISTER_TENANTS)
+
+    # ------------------------------------------------------------------
     # Dead-letter operability (runtime/deadletter.py; reference: the
     # inbound-reprocess-events loop, KafkaTopicNaming.java:48-69)
     # ------------------------------------------------------------------
