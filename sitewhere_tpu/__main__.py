@@ -91,6 +91,17 @@ def _apply_rule_config(instance, cfg) -> None:
         engine.upsert_rule(kind, rule)
 
 
+def cmd_assemble_checkpoint(args) -> int:
+    """Merge one per-host shard checkpoint from every cluster host into a
+    canonical checkpoint that restores onto any topology (other host
+    counts, shard counts, or a single chip)."""
+    from sitewhere_tpu.persist.checkpoint import write_assembled
+
+    path = write_assembled(list(args.sources), args.out)
+    print(path)
+    return 0
+
+
 def _parse_peers(spec: Optional[str]) -> dict:
     """'0=hostA:9092,1=hostB:9092' -> {0: ("hostA", 9092), ...}."""
     out = {}
@@ -375,6 +386,17 @@ def main(argv=None) -> int:
 
     version = sub.add_parser("version", help="print version")
     version.set_defaults(fn=cmd_version)
+
+    assemble = sub.add_parser(
+        "assemble-checkpoint",
+        help="merge per-host cluster checkpoints into one canonical "
+             "checkpoint restorable on ANY topology")
+    assemble.add_argument("sources", nargs="+",
+                          help="one ckpt-* directory per cluster host")
+    assemble.add_argument("--out", required=True,
+                          help="checkpoint directory to write into "
+                               "(e.g. <data_dir>/checkpoints)")
+    assemble.set_defaults(fn=cmd_assemble_checkpoint)
 
     dl = sub.add_parser("deadletters",
                         help="list/inspect/replay parked records on a "

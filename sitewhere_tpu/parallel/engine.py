@@ -597,9 +597,13 @@ class ShardedPipelineEngine(PipelineEngine):
 
         if self.is_multiprocess:
             raise NotImplementedError(
-                "multi-host checkpoint gather is not supported from a "
-                "worker process; checkpoint from a single-controller run "
-                "(each host's bus offsets + replay already cover recovery)")
+                "multi-host canonical gather would need a collective "
+                "inside the lockstep protocol; each host saves its own "
+                "shard blocks instead (local_state_shards — no collective, "
+                "any host any time), and persist/checkpoint.py "
+                "assemble_canonical merges every host's checkpoint into "
+                "the canonical any-topology snapshot offline "
+                "(`python -m sitewhere_tpu assemble-checkpoint`)")
         # device-side copy under the lock only (see base canonical_state);
         # the D2H gather + host re-layout run outside it
         with self._state_lock:

@@ -51,6 +51,299 @@ def _alert_from_dict(d: Dict[str, Any]) -> DeviceAlert:
     return DeviceAlert(**kwargs)
 
 
+_TENANT_FIELDS = ("tenant_event_count", "tenant_alert_count")
+# rebased-int32 timestamp fields (EventPacker.epoch_base_ms); -2^31 = never
+_TS_FIELDS = ("last_interaction", "presence_missing_since",
+              "last_location_ts", "last_measurement_ts", "last_alert_ts")
+_NEG = -(2 ** 31)
+
+
+def _permute_device_rows(kwargs: Dict[str, np.ndarray],
+                         perm: np.ndarray) -> Dict[str, np.ndarray]:
+    """Re-index device-major state rows old-index -> perm[old-index]
+    (elastic restore across shard-congruent interner layouts). Rows with
+    no device (perm 0) fall away; untouched rows keep init sentinels."""
+    from sitewhere_tpu.pipeline.state_tensors import init_device_state_np
+
+    sample = kwargs["last_measurement"]
+    init = init_device_state_np(sample.shape[0], sample.shape[1],
+                                kwargs["tenant_event_count"].shape[0])
+    out = {}
+    old_idx = np.nonzero(perm)[0]
+    new_idx = perm[old_idx]
+    for name, array in kwargs.items():
+        if name in _TENANT_FIELDS:
+            out[name] = array
+            continue
+        fresh = np.array(getattr(init, name))
+        fresh[new_idx] = array[old_idx]
+        out[name] = fresh
+    return out
+
+
+def _shift_ts(array: np.ndarray, delta_ms: int) -> np.ndarray:
+    """Shift rebased timestamps between epoch bases; the 'never' sentinel
+    stays put."""
+    if delta_ms == 0:
+        return array
+    return np.where(array == _NEG, _NEG,
+                    array + np.int32(delta_ms)).astype(array.dtype)
+
+
+def _install_overflow(engine, overflow_cols: Dict[str, np.ndarray]) -> None:
+    """Hand a restored overflow backlog to the engine: engines with a
+    pending-overflow slot park it (drained before the next checkpoint);
+    others fold it immediately in batch-size chunks, stashing any fired
+    alerts on the engine's pending list (never silently lost — the same
+    contract as ShardedPipelineEngine.drain_pending)."""
+    from sitewhere_tpu.ops.pack import EventBatch
+
+    batch = EventBatch(**overflow_cols)
+    setter = getattr(engine, "set_pending_overflow_batch", None)
+    if setter is not None:
+        setter(batch)
+        return
+    n = batch.device_idx.shape[0]
+    B = engine.batch_size
+    for start in range(0, n, B):
+        chunk = {}
+        for field in dataclasses.fields(EventBatch):
+            col = getattr(batch, field.name)[start:start + B]
+            if col.shape[0] < B:
+                pad = np.zeros((B - col.shape[0],) + col.shape[1:],
+                               col.dtype)
+                col = np.concatenate([col, pad])
+            chunk[field.name] = col
+        fold = EventBatch(**chunk)
+        routed, outputs = engine.submit_routed(fold)
+        engine._pending_alerts.extend(
+            engine.materialize_alerts(routed, outputs))
+
+
+def _write_checkpoint_dir(directory: str, arrays: Dict[str, np.ndarray],
+                          manifest: Dict[str, Any]) -> str:
+    """Write one `ckpt-<seq>/` directory (state.npz + manifest.json) with
+    the next sequence number, atomically via tmp-dir rename — the single
+    writer behind PipelineCheckpointer.save and write_assembled."""
+    existing = [int(n.split("-")[1]) for n in os.listdir(directory)
+                if n.startswith("ckpt-") and not n.endswith(".tmp")]
+    seq = (max(existing) + 1) if existing else 0
+    final = os.path.join(directory, f"ckpt-{seq:08d}")
+    tmp = final + ".tmp"
+    os.makedirs(tmp, exist_ok=True)
+    np.savez_compressed(os.path.join(tmp, "state.npz"), **arrays)
+    with open(os.path.join(tmp, "manifest.json"), "w",
+              encoding="utf-8") as fh:
+        json.dump(manifest, fh)
+    os.replace(tmp, final)
+    return final
+
+
+def _union_tokens(per_host: List[List[Optional[str]]]):
+    """Union sequential interner snapshots by token; returns the merged
+    table plus one old-index -> merged-index array per host."""
+    tokens: List[Optional[str]] = [None]
+    index: Dict[str, int] = {}
+    remaps = []
+    for snapshot in per_host:
+        snapshot = snapshot or [None]
+        remap = np.zeros(max(len(snapshot), 1), np.int32)
+        for i, token in enumerate(snapshot):
+            if i == 0 or token is None:
+                continue
+            if token not in index:
+                index[token] = len(tokens)
+                tokens.append(token)
+            remap[i] = index[token]
+        remaps.append(remap)
+    return tokens, remaps
+
+
+def _merge_congruent_tokens(per_host: List[List[Optional[str]]]):
+    """Merge shard-congruent DEVICE tables: the index of a token is a pure
+    function of the token, so hosts must agree wherever they overlap."""
+    size = max(len(s) for s in per_host)
+    out: List[Optional[str]] = [None] * size
+    for snapshot in per_host:
+        for i, token in enumerate(snapshot):
+            if i == 0 or token is None:
+                continue
+            if out[i] is None:
+                out[i] = token
+            elif out[i] != token:
+                raise SiteWhereCheckpointError(
+                    f"device interner disagreement at index {i}: "
+                    f"{out[i]!r} vs {token!r} — per-host checkpoints were "
+                    f"not taken from one converged cluster")
+    return out
+
+
+def assemble_canonical(paths: List[str]):
+    """Merge one per-host shard checkpoint from EVERY host of a cluster
+    into a single canonical (topology-independent) snapshot: returns
+    (manifest, state_arrays, overflow_cols-or-None).
+
+    This closes the multi-host elasticity gap: per-host checkpoints alone
+    restore only onto the same topology (parallel/engine.py
+    load_local_state_shards); the assembled canonical form restores onto
+    ANY mesh — other host counts, shard counts, or a single chip —
+    via the elastic restore path. Host-local divergences are normalized:
+    measurement/alert-type/tenant interner tables union (state columns,
+    values, and counter rows remap), and rebased timestamps shift onto
+    one epoch base. Bus offsets do NOT travel (they name per-host bus
+    logs); a restored instance replays its retained log from the start —
+    at-least-once, the reference's recovery semantics.
+
+    The reference gets topology-independent durability from its
+    datastores (SURVEY.md §5 checkpoint/resume); this is the explicit
+    TPU-cache equivalent."""
+    loads = []
+    for path in paths:
+        with open(os.path.join(path, "manifest.json"),
+                  encoding="utf-8") as fh:
+            manifest = json.load(fh)
+        with np.load(os.path.join(path, "state.npz")) as data:
+            arrays = {key: np.asarray(data[key]) for key in data.files}
+        loads.append((manifest, arrays))
+
+    for manifest, _ in loads:
+        if manifest.get("layout") != "host-shards":
+            raise SiteWhereCheckpointError(
+                "assemble_canonical expects per-host shard checkpoints "
+                "(layout=host-shards); canonical checkpoints already "
+                "restore anywhere")
+    n_shards = {m["n_shards"] for m, _ in loads}
+    if len(n_shards) != 1:
+        raise SiteWhereCheckpointError(
+            f"checkpoints disagree on n_shards: {sorted(n_shards)}")
+    S = n_shards.pop()
+    covered: List[int] = []
+    for manifest, _ in loads:
+        covered.extend(manifest["shard_ids"])
+    if sorted(covered) != list(range(S)):
+        raise SiteWhereCheckpointError(
+            f"shard coverage {sorted(covered)} != 0..{S - 1} — need "
+            f"exactly one checkpoint per host of the full cluster")
+
+    base = min(m["epoch_base_ms"] for m, _ in loads)
+    device_tokens = _merge_congruent_tokens(
+        [m["interners"]["devices"] for m, _ in loads])
+    mm_tokens, mm_remaps = _union_tokens(
+        [m["interners"]["measurements"] for m, _ in loads])
+    at_tokens, at_remaps = _union_tokens(
+        [m["interners"]["alert_types"] for m, _ in loads])
+    tenant_tokens, tenant_remaps = _union_tokens(
+        [m["interners"].get("tenants") or [None] for m, _ in loads])
+
+    from sitewhere_tpu.pipeline.state_tensors import init_device_state_np
+
+    sample = loads[0][1]["state.last_measurement"]
+    L, M = sample.shape[1], sample.shape[2]
+    T = loads[0][1]["state.tenant_event_count"].shape[-1]
+    D = S * L
+    init = init_device_state_np(D, M, T)
+    canonical = {f.name: np.array(getattr(init, f.name))
+                 for f in dataclasses.fields(DeviceStateTensors)}
+    overflow_parts: List[Dict[str, np.ndarray]] = []
+    pending_alerts: List[Dict] = []
+
+    for host, (manifest, arrays) in enumerate(loads):
+        delta = manifest["epoch_base_ms"] - base
+        mm_remap, at_remap = mm_remaps[host], at_remaps[host]
+        for f in dataclasses.fields(DeviceStateTensors):
+            block = np.array(arrays[f"state.{f.name}"])
+            if f.name in _TS_FIELDS:
+                block = _shift_ts(block, delta)
+            if f.name in ("last_measurement", "last_measurement_ts"):
+                # slot column = interned measurement index: remap columns
+                # host-local -> union (columns past capacity M drop);
+                # untouched slots keep init semantics (0 value, NEVER ts)
+                remapped = (np.zeros(block.shape, block.dtype)
+                            if f.name == "last_measurement"
+                            else np.full(block.shape, _NEG, block.dtype))
+                for old_col in range(1, min(block.shape[-1],
+                                            len(mm_remap))):
+                    new_col = mm_remap[old_col]
+                    if 0 < new_col < M:
+                        remapped[..., new_col] = block[..., old_col]
+                block = remapped
+            if f.name == "last_alert_type":
+                block = np.where(
+                    (block > 0) & (block < len(at_remap)),
+                    at_remap[np.clip(block, 0, len(at_remap) - 1)],
+                    np.where(block > 0, 0, block)).astype(block.dtype)
+            if f.name in _TENANT_FIELDS:
+                remap = tenant_remaps[host]
+                rows = block.sum(0, dtype=block.dtype) \
+                    if block.ndim == 2 else block
+                for old_row in range(1, min(rows.shape[-1], len(remap))):
+                    new_row = remap[old_row]
+                    if 0 < new_row < T:
+                        canonical[f.name][new_row] += rows[old_row]
+                canonical[f.name][0] += rows[0]
+                continue
+            # global device d lives at (d % S, d // S): shard s's row l is
+            # device l*S + s
+            for si, shard in enumerate(manifest["shard_ids"]):
+                canonical[f.name][shard::S] = block[si]
+        part = {key[len("overflow."):]: np.array(val)
+                for key, val in arrays.items()
+                if key.startswith("overflow.")}
+        if part:
+            part["ts"] = _shift_ts(part["ts"], delta)
+            part["mm_idx"] = np.where(
+                part["mm_idx"] < len(mm_remap),
+                mm_remaps[host][np.clip(part["mm_idx"], 0,
+                                        len(mm_remap) - 1)],
+                0).astype(np.int32)
+            part["alert_type_idx"] = np.where(
+                part["alert_type_idx"] < len(at_remap),
+                at_remap[np.clip(part["alert_type_idx"], 0,
+                                 len(at_remap) - 1)],
+                0).astype(np.int32)
+            overflow_parts.append(part)
+        pending_alerts.extend(manifest.get("pending_alerts", []))
+
+    overflow_cols = None
+    if overflow_parts:
+        overflow_cols = {
+            key: np.concatenate([p[key] for p in overflow_parts])
+            for key in overflow_parts[0]
+        }
+    rules: List[Dict] = []
+    seen_rules = set()
+    for manifest, _ in loads:
+        for rule in manifest.get("rules", []):
+            if rule.get("token") not in seen_rules:
+                seen_rules.add(rule.get("token"))
+                rules.append(rule)
+    out_manifest: Dict[str, Any] = {
+        "epoch_base_ms": base,
+        "interners": {"devices": device_tokens,
+                      "measurements": mm_tokens,
+                      "alert_types": at_tokens,
+                      "tenants": tenant_tokens},
+        "offsets": {},
+        "pending_alerts": pending_alerts,
+        "rules": rules,
+        "assembled_from": [os.path.basename(p) for p in paths],
+    }
+    return out_manifest, canonical, overflow_cols
+
+
+def write_assembled(paths: List[str], out_dir: str) -> str:
+    """assemble_canonical + write the result as a regular canonical
+    checkpoint directory under `out_dir` (ready for restore_on_boot /
+    PipelineCheckpointer.restore on ANY topology). Returns the path."""
+    manifest, canonical, overflow_cols = assemble_canonical(paths)
+    os.makedirs(out_dir, exist_ok=True)
+    arrays = {f"state.{name}": arr for name, arr in canonical.items()}
+    if overflow_cols:
+        arrays.update({f"overflow.{name}": arr
+                       for name, arr in overflow_cols.items()})
+    return _write_checkpoint_dir(out_dir, arrays, manifest)
+
+
 class PipelineCheckpointer:
     """Snapshot/restore a PipelineEngine's recoverable state."""
 
@@ -128,6 +421,9 @@ class PipelineCheckpointer:
                 "devices": packer.devices.snapshot(),
                 "measurements": packer.measurements.snapshot(),
                 "alert_types": packer.alert_types.snapshot(),
+                # tenant table gives tenant_* counter rows meaning when a
+                # checkpoint moves across hosts/topologies (assemble)
+                "tenants": engine.registry.tenants.snapshot(),
             },
             "offsets": captured_offsets,
             # alerts stashed by the pre-snapshot drain (and any earlier
@@ -145,22 +441,9 @@ class PipelineCheckpointer:
             "rules": self._rules_manifest(engine),
             **layout,
         }
-        seq = self._next_seq()
-        final = os.path.join(self.directory, f"ckpt-{seq:08d}")
-        tmp = final + ".tmp"
-        os.makedirs(tmp, exist_ok=True)
-        np.savez_compressed(os.path.join(tmp, "state.npz"), **arrays)
-        with open(os.path.join(tmp, "manifest.json"), "w",
-                  encoding="utf-8") as fh:
-            json.dump(manifest, fh)
-        os.replace(tmp, final)
+        final = _write_checkpoint_dir(self.directory, arrays, manifest)
         self._gc()
         return final
-
-    def _next_seq(self) -> int:
-        existing = [int(n.split("-")[1]) for n in os.listdir(self.directory)
-                    if n.startswith("ckpt-") and not n.endswith(".tmp")]
-        return (max(existing) + 1) if existing else 0
 
     def _gc(self) -> None:
         ckpts = sorted(n for n in os.listdir(self.directory)
@@ -192,6 +475,7 @@ class PipelineCheckpointer:
                 key[len("overflow."):]: np.asarray(data[key])
                 for key in data.files if key.startswith("overflow.")
             }
+        packer = engine.packer
         if manifest.get("layout") == "host-shards":
             # per-host gang-restart checkpoint: same-topology restore of
             # this host's shard blocks + the verbatim overflow batch
@@ -200,19 +484,94 @@ class PipelineCheckpointer:
                 from sitewhere_tpu.ops.pack import EventBatch
 
                 engine.set_pending_overflow_batch(EventBatch(**overflow_cols))
+            packer.devices.restore(manifest["interners"]["devices"])
         else:
+            # canonical (topology-independent) restore. The device interner
+            # may use a DIFFERENT shard-congruent layout than the saving
+            # engine (elastic 4-shard -> 8-shard/single-chip restore):
+            # re-intern congruently and permute the device-major rows.
+            perm = self._restore_devices_elastic(
+                engine, manifest["interners"]["devices"])
+            if perm is not None:
+                kwargs = _permute_device_rows(kwargs, perm)
+                if overflow_cols:
+                    valid_rows = overflow_cols["device_idx"] < len(perm)
+                    overflow_cols["device_idx"] = np.where(
+                        valid_rows,
+                        perm[np.clip(overflow_cols["device_idx"], 0,
+                                     len(perm) - 1)],
+                        0).astype(np.int32)
             engine.load_canonical_state(DeviceStateTensors(**kwargs))
-        packer = engine.packer
+            if overflow_cols:
+                _install_overflow(engine, overflow_cols)
         packer.epoch_base_ms = manifest["epoch_base_ms"]
-        packer.devices.restore(manifest["interners"]["devices"])
         packer.measurements.restore(manifest["interners"]["measurements"])
         packer.alert_types.restore(manifest["interners"]["alert_types"])
+        self._remap_tenant_rows(engine,
+                                manifest["interners"].get("tenants"))
         pending = manifest.get("pending_alerts", [])
         if pending and hasattr(engine, "_pending_alerts"):
             engine._pending_alerts.extend(
                 _alert_from_dict(d) for d in pending)
         self._restore_rules(engine, manifest.get("rules", []))
         return manifest.get("offsets", {})
+
+    @staticmethod
+    def _restore_devices_elastic(engine, tokens) -> Optional[np.ndarray]:
+        """Restore the device interner; when the snapshot's shard-congruent
+        layout differs from this engine's (different shard count, or a
+        sequential pre-congruent snapshot), re-intern every token into THIS
+        layout and return old-index -> new-index (None when the snapshot
+        loaded verbatim)."""
+        devices = engine.packer.devices
+        try:
+            devices.restore(tokens)
+            return None
+        except ValueError:
+            pass
+        devices.restore([None])  # reset, then allocate congruently
+        perm = np.zeros(max(len(tokens), 1), np.int32)
+        for i, token in enumerate(tokens):
+            if i and token is not None:
+                perm[i] = devices.intern(token)
+        # the registry mirror's rows were built for the pre-reset index
+        # assignment: re-mirror onto the new one
+        rebuild = getattr(engine.registry, "rebuild", None)
+        if rebuild is not None:
+            rebuild()
+        return perm
+
+    @staticmethod
+    def _remap_tenant_rows(engine, tenant_tokens) -> None:
+        """Move tenant_* counter rows from the checkpoint's tenant table to
+        the LIVE engine's (tenant interning order differs across
+        hosts/boots). Old checkpoints without a tenant table keep rows
+        as-is."""
+        if not tenant_tokens:
+            return
+        live = engine.registry.tenants
+        mapping = []
+        for old_idx, token in enumerate(tenant_tokens):
+            if old_idx == 0 or token is None:
+                continue
+            mapping.append((old_idx, live.intern(token)))
+        if all(old == new for old, new in mapping):
+            return
+        with engine._state_lock:
+            state = engine._state
+            for name in ("tenant_event_count", "tenant_alert_count"):
+                ref = getattr(state, name)
+                rows = np.asarray(ref)
+                out = np.zeros_like(rows)
+                out[..., 0] = rows[..., 0]  # unknown-tenant bucket stays
+                for old_idx, new_idx in mapping:
+                    # sharded layout is [S, T]; flat is [T] — index the
+                    # trailing axis either way
+                    if old_idx < rows.shape[-1] and new_idx < out.shape[-1]:
+                        out[..., new_idx] += rows[..., old_idx]
+                state = state.replace(
+                    **{name: jax.device_put(out, ref.sharding)})
+            engine._state = state
 
     @staticmethod
     def _rules_manifest(engine) -> List[Dict]:

@@ -192,6 +192,10 @@ class PipelineEngine(LifecycleComponent):
         self._presence = jax.jit(check_presence, donate_argnums=(0,))
         self.batches_processed = 0
         self.alerts_dropped = 0  # only when a caller bounds materialization
+        # alerts stashed outside the submit->materialize cycle (overflow
+        # restored from a checkpoint, restored manifests): drained by the
+        # next materialize_alerts, persisted by checkpoint save
+        self._pending_alerts: List[DeviceAlert] = []
         # rotating staging buffers for the wire blob (see
         # _staging_blob_buffer) — fresh 2.6 MB mmap-backed allocations per
         # step cost page faults on the hot path. _blob_ring_guards[i] is a
@@ -252,6 +256,18 @@ class PipelineEngine(LifecycleComponent):
                 if exists and not replace:
                     raise DuplicateTokenError(
                         f"rule '{rule.token}' already exists")
+                target, cap = (
+                    (self._threshold_rules, self.max_threshold_rules)
+                    if kind == "threshold"
+                    else (self._geofence_rules, self.max_geofence_rules))
+                # capacity BEFORE any removal: a failed upsert must leave
+                # the rule set untouched (the replaced rule frees a slot
+                # only when it lives in the same kind's table)
+                freed = exists and any(r.token == rule.token
+                                       for r in target)
+                if len(target) - (1 if freed else 0) >= cap:
+                    raise SiteWhereError(f"{kind} rule capacity exceeded",
+                                         ErrorCode.CAPACITY_EXCEEDED)
                 if exists:
                     self._threshold_rules = [
                         r for r in self._threshold_rules
@@ -259,13 +275,8 @@ class PipelineEngine(LifecycleComponent):
                     self._geofence_rules = [
                         r for r in self._geofence_rules
                         if r.token != rule.token]
-                target, cap = (
-                    (self._threshold_rules, self.max_threshold_rules)
-                    if kind == "threshold"
-                    else (self._geofence_rules, self.max_geofence_rules))
-                if len(target) >= cap:
-                    raise SiteWhereError(f"{kind} rule capacity exceeded",
-                                         ErrorCode.CAPACITY_EXCEEDED)
+                    target = (self._threshold_rules if kind == "threshold"
+                              else self._geofence_rules)
                 target.append(rule)
                 self._rules_version += 1
             self._fire_rules("add", kind, rule)
@@ -493,6 +504,7 @@ class PipelineEngine(LifecycleComponent):
         longer drops the tail silently (an alert storm is exactly when
         alerts matter): overflow is counted on `alerts_dropped`, surfaced
         as a metric, and logged."""
+        pending, self._pending_alerts = self._pending_alerts, []
         thr_fired = np.asarray(outputs.threshold_fired)
         geo_fired = np.asarray(outputs.geofence_fired)
         fired_rows = np.nonzero(thr_fired | geo_fired)[0]
@@ -507,7 +519,7 @@ class PipelineEngine(LifecycleComponent):
                 fired_rows.size, max_alerts, dropped, self.alerts_dropped)
             fired_rows = fired_rows[:max_alerts]
         if fired_rows.size == 0:
-            return []
+            return pending
         device_idx = np.asarray(batch.device_idx)
         thr_level = np.asarray(outputs.threshold_alert_level)
         geo_level = np.asarray(outputs.geofence_alert_level)
@@ -534,7 +546,7 @@ class PipelineEngine(LifecycleComponent):
                     level=AlertLevel(int(geo_level[row])), type=rule.alert_type,
                     message=rule.alert_message or f"geofence rule {rule.token} fired",
                     event_date=self.packer.abs_ts(int(ts[row]))))
-        return alerts
+        return pending + alerts
 
     # -- presence -------------------------------------------------------------
 

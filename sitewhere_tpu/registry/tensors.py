@@ -183,6 +183,14 @@ class RegistryTensors:
             area = management.areas.get(zone.area_id)
             self._zone_area[zidx] = self.areas.intern(area.token) if area else 0
 
+    def rebuild(self) -> None:
+        """Re-mirror every attached tenant's registry. Needed after a
+        checkpoint restore replaces the device interner assignment (the
+        elastic cross-layout path re-interns tokens in snapshot order, so
+        rows built at attach time may have moved)."""
+        for tenant_token, management in self._managements.items():
+            self._full_rebuild(management, self.tenants.intern(tenant_token))
+
     def _full_rebuild(self, management: DeviceManagement, tenant_idx: int) -> None:
         with self._lock:
             for device in management.devices.all():

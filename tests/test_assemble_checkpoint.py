@@ -1,0 +1,268 @@
+"""Multi-host checkpoint assembly (persist/checkpoint.py
+assemble_canonical) + elastic cross-layout restore.
+
+The cluster story: each host saves its OWN shard blocks (no collective —
+parallel/engine.py local_state_shards); `assemble_canonical` merges one
+checkpoint per host into the canonical any-topology snapshot, normalizing
+host-local divergences (measurement/alert-type/tenant interner order,
+epoch bases). The restore side re-interns device tokens into the target
+engine's shard-congruent layout and permutes state rows, so a checkpoint
+taken on 2-hosts/4-shards restores onto 8 shards or a single chip.
+
+Reference analogue: topology-independent durability the reference gets
+for free from its datastores (SURVEY.md §5 checkpoint/resume).
+"""
+
+import dataclasses
+import json
+import os
+
+import numpy as np
+import pytest
+
+from sitewhere_tpu.model import Device, DeviceAssignment, DeviceType
+from sitewhere_tpu.model.event import DeviceMeasurement
+from sitewhere_tpu.persist.checkpoint import (
+    PipelineCheckpointer, assemble_canonical, write_assembled)
+from sitewhere_tpu.pipeline.state_tensors import (
+    DeviceStateTensors, init_device_state_np)
+from sitewhere_tpu.registry import DeviceManagement, RegistryTensors
+
+_NEG = -(2 ** 31)
+
+
+def _write_host_ckpt(path, shard_ids, n_shards, blocks, interners,
+                     epoch_base_ms, process_id=0, pending=None,
+                     overflow=None, rules=None):
+    """Write a per-host shard checkpoint in the exact on-disk format
+    PipelineCheckpointer.save produces for multi-host engines."""
+    os.makedirs(path, exist_ok=True)
+    arrays = {f"state.{name}": np.asarray(block)
+              for name, block in blocks.items()}
+    if overflow:
+        arrays.update({f"overflow.{name}": np.asarray(col)
+                       for name, col in overflow.items()})
+    np.savez_compressed(os.path.join(path, "state.npz"), **arrays)
+    manifest = {
+        "epoch_base_ms": epoch_base_ms,
+        "interners": interners,
+        "offsets": {},
+        "pending_alerts": pending or [],
+        "rules": rules or [],
+        "layout": "host-shards",
+        "shard_ids": list(shard_ids),
+        "n_shards": n_shards,
+        "process_id": process_id,
+    }
+    with open(os.path.join(path, "manifest.json"), "w") as fh:
+        json.dump(manifest, fh)
+    return str(path)
+
+
+def _world(n=24, cap=64, shard_classes=1):
+    dm = DeviceManagement()
+    dt = dm.create_device_type(DeviceType(token="t"))
+    tensors = RegistryTensors(max_devices=cap, max_zones=4,
+                              max_zone_vertices=4,
+                              shard_classes=shard_classes)
+    for i in range(n):
+        d = dm.create_device(Device(token=f"d{i}", device_type_id=dt.id))
+        dm.create_device_assignment(
+            DeviceAssignment(token=f"a{i}", device_id=d.id))
+    tensors.attach(dm, "tenant")
+    return tensors
+
+
+def _engine(tensors, shards=4):
+    from sitewhere_tpu.parallel import ShardedPipelineEngine, make_mesh
+    from sitewhere_tpu.pipeline.engine import ThresholdRule
+
+    engine = ShardedPipelineEngine(tensors, mesh=make_mesh(shards),
+                                   per_shard_batch=16)
+    engine.start()
+    engine.packer.measurements.intern("m")
+    engine.add_threshold_rule(ThresholdRule(
+        token="r", measurement_name="m", operator=">", threshold=1e9))
+    return engine
+
+
+def _feed(engine, n=24):
+    events = [DeviceMeasurement(name="m", value=float(i)) for i in range(n)]
+    batch = engine.packer.pack_events(events, [f"d{i}" for i in range(n)])[0]
+    engine.submit_routed(batch)
+    return engine
+
+
+class TestAssembleCanonical:
+    def _split_hosts(self, engine, tmp_path):
+        """Split a 4-shard engine's state into two per-host checkpoints
+        (host0 owns shards [0, 2], host1 [1, 3]) — the on-disk shape a
+        real 2-process cluster produces."""
+        shard_ids, blocks = engine.local_state_shards()
+        assert shard_ids == [0, 1, 2, 3]
+        interners = {
+            "devices": engine.packer.devices.snapshot(),
+            "measurements": engine.packer.measurements.snapshot(),
+            "alert_types": engine.packer.alert_types.snapshot(),
+            "tenants": engine.registry.tenants.snapshot(),
+        }
+        paths = []
+        for host, ids in enumerate([[0, 2], [1, 3]]):
+            host_blocks = {name: np.asarray(block)[ids]
+                           for name, block in blocks.items()}
+            paths.append(_write_host_ckpt(
+                tmp_path / f"h{host}", ids, 4, host_blocks, interners,
+                engine.packer.epoch_base_ms, process_id=host))
+        return paths
+
+    def test_assembled_equals_single_controller_canonical(self, tmp_path):
+        engine = _feed(_engine(_world(shard_classes=4), shards=4))
+        truth = engine.canonical_state()
+        paths = self._split_hosts(engine, tmp_path)
+        manifest, canonical, overflow = assemble_canonical(paths)
+        assert overflow is None
+        for f in dataclasses.fields(DeviceStateTensors):
+            np.testing.assert_array_equal(
+                canonical[f.name], np.asarray(getattr(truth, f.name)),
+                err_msg=f.name)
+        assert manifest["interners"]["devices"] == \
+            engine.packer.devices.snapshot()
+
+    def test_restores_onto_other_topologies(self, tmp_path):
+        engine = _feed(_engine(_world(shard_classes=4), shards=4))
+        paths = self._split_hosts(engine, tmp_path)
+        out = write_assembled(paths, str(tmp_path / "assembled"))
+        ckpt = PipelineCheckpointer(str(tmp_path / "assembled"))
+
+        # 4-congruent snapshot onto an 8-shard engine: different interner
+        # layout -> the elastic re-intern + row-permutation path
+        e8 = _engine(_world(shard_classes=8), shards=8)
+        ckpt.restore(e8, out)
+        for i in range(24):
+            st = e8.get_device_state(f"d{i}")
+            assert st.last_measurements["m"][1] == float(i), i
+        # the restored engine keeps processing
+        _feed(e8)
+        assert e8.get_device_state("d3").last_measurements["m"][1] == 3.0
+
+        # ... and onto a single chip
+        from sitewhere_tpu.pipeline.engine import PipelineEngine
+
+        single = PipelineEngine(_world(), batch_size=32)
+        single.start()
+        ckpt.restore(single, out)
+        for i in range(24):
+            st = single.get_device_state(f"d{i}")
+            assert st.last_measurements["m"][1] == float(i), i
+
+    def test_validation(self, tmp_path):
+        from sitewhere_tpu.persist.checkpoint import SiteWhereCheckpointError
+
+        engine = _feed(_engine(_world(shard_classes=4), shards=4))
+        paths = self._split_hosts(engine, tmp_path)
+        with pytest.raises(SiteWhereCheckpointError):
+            assemble_canonical([paths[0]])  # shards 1,3 missing
+        with pytest.raises(SiteWhereCheckpointError):
+            assemble_canonical([paths[0], paths[0]])  # double coverage
+
+
+class TestDivergentHosts:
+    """Hand-built two-host checkpoints with DIVERGENT measurement
+    interner orders, alert-type tables, tenant orders, and epoch bases —
+    the normalizations assemble_canonical must perform. Expected values
+    are computed by hand, not by the code under test."""
+
+    S, L, M, T = 2, 4, 4, 4
+
+    def _blocks(self):
+        init = init_device_state_np(self.L, self.M, self.T)
+        return {f.name: np.asarray(getattr(init, f.name))[None]
+                if f.name not in ("tenant_event_count",
+                                  "tenant_alert_count")
+                else np.asarray(getattr(init, f.name))[None]
+                for f in dataclasses.fields(DeviceStateTensors)}
+
+    def test_interner_and_epoch_normalization(self, tmp_path):
+        # host0 owns shard 0 (devices 0,2,4,6 at rows 0..3); epoch 1000;
+        # measurement order [t, a]; tenants [acme]
+        b0 = self._blocks()
+        b0["last_measurement"][0, 1, 1] = 5.0      # device 2, "t"
+        b0["last_measurement_ts"][0, 1, 1] = 100
+        b0["last_alert_type"][0, 1] = 1            # "hot" in host0's table
+        b0["tenant_event_count"][0, 1] = 7         # acme
+        p0 = _write_host_ckpt(
+            tmp_path / "h0", [0], self.S, b0,
+            {"devices": [None, "d0", "d1"],
+             "measurements": [None, "t", "a"],
+             "alert_types": [None, "hot"],
+             "tenants": [None, "acme"]},
+            epoch_base_ms=1000)
+
+        # host1 owns shard 1 (devices 1,3,5,7); epoch 3000 (delta 2000);
+        # measurement order [a, t]; alert types [cold, hot];
+        # tenants [beta, acme]
+        b1 = self._blocks()
+        b1["last_measurement"][0, 1, 2] = 7.0      # device 3, "t" (its idx 2)
+        b1["last_measurement_ts"][0, 1, 2] = 50
+        b1["last_alert_type"][0, 1] = 2            # "hot" in host1's table
+        b1["tenant_event_count"][0, 2] = 9         # acme (its idx 2)
+        b1["tenant_event_count"][0, 1] = 3         # beta
+        p1 = _write_host_ckpt(
+            tmp_path / "h1", [1], self.S, b1,
+            {"devices": [None, "d0", "d1"],
+             "measurements": [None, "a", "t"],
+             "alert_types": [None, "cold", "hot"],
+             "tenants": [None, "beta", "acme"]},
+            epoch_base_ms=3000)
+
+        manifest, canonical, _ = assemble_canonical([p0, p1])
+        # union orders follow host0-first discovery
+        assert manifest["interners"]["measurements"] == [None, "t", "a"]
+        assert manifest["interners"]["alert_types"] == \
+            [None, "hot", "cold"]
+        assert manifest["interners"]["tenants"] == \
+            [None, "acme", "beta"]
+        assert manifest["epoch_base_ms"] == 1000
+        # host0's device 2: value in "t" column (union idx 1), ts as-is
+        assert canonical["last_measurement"][2, 1] == 5.0
+        assert canonical["last_measurement_ts"][2, 1] == 100
+        # host1's device 3: its col 2 ("t") remapped to union col 1,
+        # ts shifted by the 2000 ms epoch delta
+        assert canonical["last_measurement"][3, 1] == 7.0
+        assert canonical["last_measurement_ts"][3, 1] == 2050
+        # untouched slots keep the NEVER sentinel (no shift applied)
+        assert canonical["last_measurement_ts"][0, 1] == _NEG
+        # alert types: both hosts' "hot" converge on union value 1
+        assert canonical["last_alert_type"][2] == 1
+        assert canonical["last_alert_type"][3] == 1
+        # tenant rows remap by token and SUM across hosts
+        assert canonical["tenant_event_count"][1] == 16   # acme 7+9
+        assert canonical["tenant_event_count"][2] == 3    # beta
+
+
+class TestElasticInstanceRestore:
+    """ADVICE r3 (medium): a sharded instance's device interner is
+    shard-congruent, so restoring a checkpoint saved on a DIFFERENT
+    layout (other shard count, or a pre-congruent sequential snapshot)
+    used to raise ValueError. The elastic restore path re-interns and
+    permutes instead."""
+
+    def test_sequential_checkpoint_onto_congruent_engine(self, tmp_path):
+        from sitewhere_tpu.pipeline.engine import PipelineEngine
+
+        single = PipelineEngine(_world(), batch_size=32)
+        single.start()
+        single.packer.measurements.intern("m")
+        _feed(single)
+        ckpt = PipelineCheckpointer(str(tmp_path))
+        path = ckpt.save(single)
+
+        e4 = _engine(_world(shard_classes=4), shards=4)
+        ckpt.restore(e4, path)
+        for i in range(24):
+            st = e4.get_device_state(f"d{i}")
+            assert st.last_measurements["m"][1] == float(i), i
+        # events keep flowing after the cross-layout restore (the registry
+        # mirror was rebuilt onto the re-interned indices)
+        _feed(e4)
+        assert e4.get_device_state("d7").last_measurements["m"][1] == 7.0
