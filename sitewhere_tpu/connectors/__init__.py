@@ -8,15 +8,18 @@ from sitewhere_tpu.connectors.host import (
     OutboundConnectorHost, OutboundConnectorsManager)
 from sitewhere_tpu.connectors.sinks import (
     CollectingConnector, DeviceEventMulticaster, DweetConnector,
-    EventIndexConnector, HttpPostConnector, InitialStateConnector,
-    MqttOutboundConnector, ScriptedConnector, SqsConnector,
-    all_devices_of_type_route, event_to_json)
+    EventHubConnector, EventIndexConnector, HttpPostConnector,
+    InitialStateConnector, MqttOutboundConnector, RabbitMqConnector,
+    ScriptedConnector, SqsConnector, all_devices_of_type_route,
+    event_to_json)
 
 __all__ = [
     "AreaFilter", "CollectingConnector", "DeviceEventMulticaster",
-    "DeviceTypeFilter", "DweetConnector", "EventIndexConnector",
+    "DeviceTypeFilter", "DweetConnector", "EventHubConnector",
+    "EventIndexConnector",
     "EventTypeFilter", "FilterOperation", "HttpPostConnector",
     "InitialStateConnector", "MqttOutboundConnector", "OutboundConnector",
+    "RabbitMqConnector",
     "OutboundConnectorHost", "OutboundConnectorsManager",
     "ScriptedConnector", "ScriptedFilter", "SqsConnector",
     "all_devices_of_type_route", "event_to_json",

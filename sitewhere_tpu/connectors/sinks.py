@@ -226,6 +226,99 @@ class SqsConnector(OutboundConnector):
                 MessageBody=event_to_json(context, event).decode())
 
 
+class RabbitMqConnector(OutboundConnector):
+    """RabbitMQ outbound sink (RabbitMqOutboundConnector.java): publish
+    each accepted event as JSON to an exchange/routing key over `pika`
+    when available (optional dependency — start() fails with a clear 501
+    gating error otherwise, like the inbound AmqpEventReceiver)."""
+
+    def __init__(self, connector_id: str, url: str = "amqp://localhost",
+                 exchange: str = "", routing_key: str = "sitewhere.events",
+                 durable: bool = False, filters=None,
+                 multicaster: Optional["DeviceEventMulticaster"] = None):
+        super().__init__(connector_id, filters)
+        self.url = url
+        self.exchange = exchange
+        self.routing_key = routing_key
+        self.durable = durable
+        self.multicaster = multicaster
+        self._connection = None
+        self._channel = None
+
+    def on_start(self, monitor) -> None:
+        from sitewhere_tpu.sources.receivers_ext import require_optional
+        pika = require_optional("pika", "RabbitMQ")
+        self._connection = pika.BlockingConnection(
+            pika.URLParameters(self.url))
+        self._channel = self._connection.channel()
+        if self.exchange:
+            self._channel.exchange_declare(exchange=self.exchange,
+                                           durable=self.durable)
+        else:
+            self._channel.queue_declare(queue=self.routing_key,
+                                        durable=self.durable)
+
+    def on_stop(self, monitor) -> None:
+        if self._connection is not None:
+            self._connection.close()
+            self._connection = self._channel = None
+
+    def process_batch(self, batch: List[Tuple[DeviceEventContext,
+                                              DeviceEvent]]) -> None:
+        if self._channel is None:
+            raise RuntimeError(f"connector {self.connector_id} not started")
+        for context, event in batch:
+            payload = event_to_json(context, event)
+            keys = (self.multicaster.routes(context, event)
+                    if self.multicaster else [self.routing_key])
+            for key in keys:
+                self._channel.basic_publish(exchange=self.exchange,
+                                            routing_key=key, body=payload)
+
+
+class EventHubConnector(OutboundConnector):
+    """Azure Event Hub outbound sink (EventHubOutboundConnector.java) over
+    `azure-eventhub` when available (same optional-dependency gating as
+    the inbound EventHubEventReceiver). Events batch per process_batch
+    call — the hub client's native batching unit."""
+
+    def __init__(self, connector_id: str, connection_str: str,
+                 eventhub_name: str, filters=None):
+        super().__init__(connector_id, filters)
+        self.connection_str = connection_str
+        self.eventhub_name = eventhub_name
+        self._producer = None
+        self._event_cls = None
+
+    def on_start(self, monitor) -> None:
+        from sitewhere_tpu.sources.receivers_ext import require_optional
+        eventhub = require_optional("azure.eventhub", "Azure Event Hub")
+        self._event_cls = eventhub.EventData
+        self._producer = eventhub.EventHubProducerClient.from_connection_string(
+            self.connection_str, eventhub_name=self.eventhub_name)
+
+    def on_stop(self, monitor) -> None:
+        if self._producer is not None:
+            self._producer.close()
+            self._producer = None
+
+    def process_batch(self, batch: List[Tuple[DeviceEventContext,
+                                              DeviceEvent]]) -> None:
+        if self._producer is None:
+            raise RuntimeError(f"connector {self.connector_id} not started")
+        hub_batch = self._producer.create_batch()
+        for context, event in batch:
+            data = self._event_cls(event_to_json(context, event))
+            try:
+                hub_batch.add(data)
+            except ValueError:
+                # hub batch size limit (~1 MB): flush and keep going
+                self._producer.send_batch(hub_batch)
+                hub_batch = self._producer.create_batch()
+                hub_batch.add(data)
+        self._producer.send_batch(hub_batch)
+
+
 class DeviceEventMulticaster:
     """Compute delivery routes per event (IDeviceEventMulticaster). Route
     builders are callables `(context, event) -> list[str]`

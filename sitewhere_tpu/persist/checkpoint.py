@@ -291,16 +291,18 @@ def assemble_canonical(paths: List[str]):
                 if key.startswith("overflow.")}
         if part:
             part["ts"] = _shift_ts(part["ts"], delta)
-            part["mm_idx"] = np.where(
-                part["mm_idx"] < len(mm_remap),
-                mm_remaps[host][np.clip(part["mm_idx"], 0,
-                                        len(mm_remap) - 1)],
-                0).astype(np.int32)
-            part["alert_type_idx"] = np.where(
-                part["alert_type_idx"] < len(at_remap),
-                at_remap[np.clip(part["alert_type_idx"], 0,
-                                 len(at_remap) - 1)],
-                0).astype(np.int32)
+
+            def _remap_values(col, remap):
+                return np.where(
+                    col < len(remap),
+                    remap[np.clip(col, 0, len(remap) - 1)],
+                    0).astype(np.int32)
+
+            part["mm_idx"] = _remap_values(part["mm_idx"], mm_remap)
+            part["alert_type_idx"] = _remap_values(part["alert_type_idx"],
+                                                   at_remap)
+            part["tenant_idx"] = _remap_values(part["tenant_idx"],
+                                               tenant_remaps[host])
             overflow_parts.append(part)
         pending_alerts.extend(manifest.get("pending_alerts", []))
 
@@ -502,8 +504,6 @@ class PipelineCheckpointer:
                                      len(perm) - 1)],
                         0).astype(np.int32)
             engine.load_canonical_state(DeviceStateTensors(**kwargs))
-            if overflow_cols:
-                _install_overflow(engine, overflow_cols)
         packer.epoch_base_ms = manifest["epoch_base_ms"]
         packer.measurements.restore(manifest["interners"]["measurements"])
         packer.alert_types.restore(manifest["interners"]["alert_types"])
@@ -514,6 +514,11 @@ class PipelineCheckpointer:
             engine._pending_alerts.extend(
                 _alert_from_dict(d) for d in pending)
         self._restore_rules(engine, manifest.get("rules", []))
+        if overflow_cols and manifest.get("layout") != "host-shards":
+            # fold LAST: the overflow's indices/timestamps only mean
+            # something under the restored interners + epoch base, and
+            # its events must fire the restored rules, not an empty set
+            _install_overflow(engine, overflow_cols)
         return manifest.get("offsets", {})
 
     @staticmethod

@@ -9,9 +9,17 @@ timers keep a bounded reservoir for p50/p95/p99.
 
 from __future__ import annotations
 
+import re
 import threading
 import time
 from typing import Dict, List, Optional
+
+
+def _prom_name(name: str) -> str:
+    """Metric key -> prometheus-legal name (dots and dashes collapse to
+    underscores; leading digits get a prefix)."""
+    out = re.sub(r"[^a-zA-Z0-9_]", "_", name)
+    return f"m_{out}" if out and out[0].isdigit() else out
 
 
 class Counter:
@@ -143,6 +151,49 @@ class MetricsRegistry:
                        for k, v in meters.items()},
             "timers": {k: v.snapshot() for k, v in timers.items()},
         }
+
+    def prometheus_text(self, extra_gauges: Optional[Dict[str, float]] = None
+                        ) -> str:
+        """Prometheus text exposition (version 0.0.4) of every registered
+        metric — the role of the reference's Dropwizard reporters
+        (Microservice.java:146,244-246), scrapeable at GET /metrics.
+        Counters/meter-counts become prometheus counters, meter 1-minute
+        rates and `extra_gauges` become gauges, timers become summaries
+        with p50/p95/p99 quantiles."""
+        with self._lock:
+            counters = dict(self._counters)
+            meters = dict(self._meters)
+            timers = dict(self._timers)
+        lines: List[str] = []
+
+        def emit(name: str, kind: str, value, labels: str = "") -> None:
+            if kind:
+                lines.append(f"# TYPE {name} {kind}")
+            lines.append(f"{name}{labels} {value}")
+
+        for key in sorted(counters):
+            emit(f"swtpu_{_prom_name(key)}_total", "counter",
+                 counters[key].value)
+        for key in sorted(meters):
+            meter = meters[key]
+            base = f"swtpu_{_prom_name(key)}"
+            emit(f"{base}_total", "counter", meter.count)
+            emit(f"{base}_m1_rate", "gauge",
+                 round(meter.one_minute_rate, 6))
+        for key in sorted(timers):
+            snap = timers[key].snapshot()
+            base = f"swtpu_{_prom_name(key)}_seconds"
+            lines.append(f"# TYPE {base} summary")
+            for quantile in ("p50", "p95", "p99"):
+                lines.append(
+                    f'{base}{{quantile="0.{quantile[1:]}"}} '
+                    f'{snap[f"{quantile}_s"]:.9f}')
+            lines.append(f"{base}_count {snap['count']}")
+            lines.append(
+                f"{base}_sum {snap['mean_s'] * snap['count']:.9f}")
+        for key in sorted(extra_gauges or {}):
+            emit(f"swtpu_{_prom_name(key)}", "gauge", extra_gauges[key])
+        return "\n".join(lines) + "\n"
 
 
 class ScopedMetrics:

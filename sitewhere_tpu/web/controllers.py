@@ -271,6 +271,68 @@ def register_all(router: Router, instance, server) -> None:
                   authority=SiteWhereRoles.ADMINISTER_TENANTS)
 
     # ------------------------------------------------------------------
+    # Prometheus exposition + on-demand device profiling (reference:
+    # Dropwizard reporters, Microservice.java:146,244-246; Jaeger spans)
+    # ------------------------------------------------------------------
+    def metrics_prometheus(request: Request):
+        """GET /metrics — Prometheus text format. Public like every
+        scrape endpoint (operational counters only; front with a network
+        policy if the deployment needs to)."""
+        extra: Dict[str, float] = {}
+        engine = instance.pipeline_engine
+        if engine is not None:
+            extra["pipeline.batches_processed"] = engine.batches_processed
+            extra["pipeline.alerts_dropped"] = engine.alerts_dropped
+        hooks = getattr(instance, "cluster_hooks", None)
+        if hooks is not None:
+            gossip = hooks.gossip
+            if gossip is not None:
+                extra.update({
+                    "cluster.gossip.published": gossip.published,
+                    "cluster.gossip.applied": gossip.applied,
+                    "cluster.gossip.conflicts": gossip.conflicts,
+                    "cluster.gossip.publish_errors": gossip.publish_errors,
+                })
+            extra["cluster.forwarded_rows"] = hooks.forwarder.forwarded
+            extra["cluster.forward_dead_lettered"] = \
+                hooks.forwarder.dead_lettered
+            extra["cluster.step_ticks"] = hooks.loop.tick_count
+            extra["cluster.degraded_peers"] = len(hooks.degraded)
+        text = instance.metrics.prometheus_text(extra)
+        return 200, text.encode("utf-8"), "text/plain; version=0.0.4"
+
+    def start_device_trace(request: Request):
+        """POST /api/instance/trace/start {log_dir?} — begin an XLA
+        profiler capture on the live engine (view with xprof/TensorBoard);
+        idempotent while tracing."""
+        engine = instance.pipeline_engine
+        if engine is None:
+            raise SiteWhereError("device tracing requires a pipeline "
+                                 "engine", http_status=409)
+        import os as _os
+
+        body = request.body if isinstance(request.body, dict) else {}
+        log_dir = (body.get("log_dir")
+                   or _os.path.join(instance.data_dir or ".",
+                                    "device-trace"))
+        engine.start_device_trace(log_dir)
+        return {"tracing": True, "log_dir": log_dir}
+
+    def stop_device_trace(request: Request):
+        engine = instance.pipeline_engine
+        if engine is None:
+            raise SiteWhereError("device tracing requires a pipeline "
+                                 "engine", http_status=409)
+        engine.stop_device_trace()
+        return {"tracing": False}
+
+    router.get("/metrics", metrics_prometheus, auth=False)
+    router.post("/api/instance/trace/start", start_device_trace,
+                authority=SiteWhereRoles.ADMINISTER_TENANTS)
+    router.post("/api/instance/trace/stop", stop_device_trace,
+                authority=SiteWhereRoles.ADMINISTER_TENANTS)
+
+    # ------------------------------------------------------------------
     # Dead-letter operability (runtime/deadletter.py; reference: the
     # inbound-reprocess-events loop, KafkaTopicNaming.java:48-69)
     # ------------------------------------------------------------------

@@ -175,11 +175,10 @@ class TestDivergentHosts:
     S, L, M, T = 2, 4, 4, 4
 
     def _blocks(self):
+        # per-host blocks carry one leading local-shard axis on EVERY
+        # field: device-major [1, L, ...] and tenant counters [1, T]
         init = init_device_state_np(self.L, self.M, self.T)
         return {f.name: np.asarray(getattr(init, f.name))[None]
-                if f.name not in ("tenant_event_count",
-                                  "tenant_alert_count")
-                else np.asarray(getattr(init, f.name))[None]
                 for f in dataclasses.fields(DeviceStateTensors)}
 
     def test_interner_and_epoch_normalization(self, tmp_path):

@@ -1,0 +1,109 @@
+"""Prometheus exposition (runtime/metrics.py prometheus_text + GET
+/metrics) and the on-demand device-trace REST hooks.
+
+Reference: Dropwizard metric reporters per microservice
+(sitewhere-microservice Microservice.java:146,244-246); the trace hooks
+are the on-device analogue of its Jaeger span surface.
+"""
+
+import urllib.request
+
+import pytest
+
+
+class TestPrometheusText:
+    def test_exposition_format(self):
+        from sitewhere_tpu.runtime.metrics import MetricsRegistry
+
+        registry = MetricsRegistry()
+        registry.counter("bus.records").inc(5)
+        registry.meter("pipeline.events").mark(100)
+        with registry.timer("pipeline.step").time():
+            pass
+        text = registry.prometheus_text(
+            {"cluster.gossip.published": 7})
+        lines = text.splitlines()
+        assert "# TYPE swtpu_bus_records_total counter" in lines
+        assert "swtpu_bus_records_total 5" in lines
+        assert "swtpu_pipeline_events_total 100" in lines
+        assert any(line.startswith("swtpu_pipeline_events_m1_rate ")
+                   for line in lines)
+        assert "# TYPE swtpu_pipeline_step_seconds summary" in lines
+        assert any('quantile="0.99"' in line for line in lines)
+        assert "swtpu_pipeline_step_seconds_count 1" in lines
+        assert "swtpu_cluster_gossip_published 7" in lines
+        # prometheus-legal names only
+        import re
+
+        for line in lines:
+            if line.startswith("#"):
+                continue
+            name = line.split("{")[0].split(" ")[0]
+            assert re.fullmatch(r"[a-zA-Z_][a-zA-Z0-9_]*", name), name
+
+    def test_name_sanitization(self):
+        from sitewhere_tpu.runtime.metrics import _prom_name
+
+        assert _prom_name("a.b-c d") == "a_b_c_d"
+        assert _prom_name("9lives") == "m_9lives"
+
+
+@pytest.fixture(scope="module")
+def rig():
+    from sitewhere_tpu.client.rest import SiteWhereClient
+    from sitewhere_tpu.instance import SiteWhereInstance
+    from sitewhere_tpu.web.server import RestServer
+
+    instance = SiteWhereInstance(
+        instance_id="promtest", enable_pipeline=True,
+        max_devices=64, batch_size=16, measurement_slots=4)
+    instance.start()
+    rest = RestServer(instance, port=0)
+    rest.start()
+    client = SiteWhereClient(rest.base_url)
+    client.authenticate("admin", "password")
+    yield instance, rest, client
+    rest.stop()
+    instance.stop()
+
+
+class TestMetricsEndpoint:
+    def test_scrape_without_auth(self, rig):
+        _instance, rest, _client = rig
+        with urllib.request.urlopen(f"{rest.base_url}/metrics") as resp:
+            assert resp.status == 200
+            assert resp.headers["Content-Type"].startswith("text/plain")
+            body = resp.read().decode()
+        assert "swtpu_" in body
+        assert "swtpu_pipeline_batches_processed" in body
+
+    def test_cluster_counters_absent_single_host(self, rig):
+        _instance, rest, _client = rig
+        with urllib.request.urlopen(f"{rest.base_url}/metrics") as resp:
+            body = resp.read().decode()
+        assert "cluster_gossip" not in body  # no cluster hooks installed
+
+
+class TestDeviceTraceRest:
+    def test_trace_round_trip(self, rig, tmp_path):
+        _instance, _rest, client = rig
+        out = client.post("/api/instance/trace/start",
+                          {"log_dir": str(tmp_path / "trace")})
+        assert out["tracing"] is True
+        # idempotent second start
+        client.post("/api/instance/trace/start",
+                    {"log_dir": str(tmp_path / "trace")})
+        out = client.post("/api/instance/trace/stop", {})
+        assert out["tracing"] is False
+        import os
+
+        assert os.path.isdir(str(tmp_path / "trace"))
+
+    def test_trace_requires_admin(self, rig):
+        from sitewhere_tpu.client.rest import (
+            SiteWhereClient, SiteWhereClientError)
+
+        _instance, rest, _client = rig
+        anon = SiteWhereClient(rest.base_url)
+        with pytest.raises(SiteWhereClientError):
+            anon.post("/api/instance/trace/start", {})
