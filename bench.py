@@ -99,6 +99,33 @@ def main() -> None:
         latencies.append(time.perf_counter() - s0)
     lat = np.array(sorted(latencies))
 
+    # aux: telemetry-class traffic (measurements+alerts, no locations) —
+    # the PACKED 3-row wire (12 B/event, delta ts + lane-embedded base)
+    # engages; on a transfer-bound link this is the bytes/event lever
+    # VERDICT r3 item 6 names. Same engine, same rules, same feeder.
+    telemetry_pool = [
+        _synthetic_batch(engine.packer, N_REGISTERED, BATCH,
+                         seed=500 + s, p_types=(0.9, 0.0, 0.1))
+        for s in range(8)]
+    from sitewhere_tpu.ops.pack import WIRE_ROWS_PACKED, wire_variant_for
+    telemetry_rows = wire_variant_for(telemetry_pool[0])[0]
+    # the label says packed: fail loudly if eligibility ever regresses
+    # (otherwise this section would silently report the classic rate)
+    assert telemetry_rows == WIRE_ROWS_PACKED, telemetry_rows
+    submitter2 = PipelinedSubmitter(engine, depth=3, stagers=2)
+    warm_fut = None
+    for i in range(6):
+        warm_fut = submitter2.submit(telemetry_pool[i % len(telemetry_pool)])
+    submitter2.flush()
+    jax.block_until_ready(warm_fut.result().processed)
+    t0 = time.perf_counter()
+    futs = [submitter2.submit(telemetry_pool[i % len(telemetry_pool)])
+            for i in range(STEPS)]
+    submitter2.flush()
+    jax.block_until_ready(futs[-1].result().processed)
+    telemetry_rate = STEPS * BATCH / (time.perf_counter() - t0)
+    submitter2.close()
+
     # aux: compute-only step rate (device-resident staging blob), i.e. the
     # rate once ingest DMA is overlapped/not the bottleneck
     from sitewhere_tpu.ops.pack import batch_to_blob
@@ -143,6 +170,9 @@ def main() -> None:
         "h2d_ms": round(h2d_ms, 3),
         "device_ms": round(device_ms, 3),
         "sync_total_ms": round(float(lat[len(lat) // 2]) * 1000, 3),
+        # what the mixed headline batch actually costs on the wire (the
+        # 60/30/10 mix carries locations -> classic compact layout)
+        "wire_bytes_per_event": blob_i.shape[0] * 4,
     }
 
     # aux: BASELINE config 1 — persist rate (columnar event log bulk append)
@@ -167,8 +197,12 @@ def main() -> None:
     engine._state = state
 
     aux = {}
-    aux.update(_bench_sharded(jax, BATCH, MAX_DEVICES, N_REGISTERED, small))
-    aux.update(_bench_multitenant(jax, BATCH, small))
+    sharded_aux, single_engine, single_nreg = _bench_sharded(
+        jax, BATCH, MAX_DEVICES, N_REGISTERED, small)
+    aux.update(sharded_aux)
+    aux.update(_bench_multitenant(jax, BATCH, small,
+                                  single_engine=single_engine,
+                                  single_nreg=single_nreg))
     aux.update(_bench_query_10m(BATCH, engine.packer, pool, small))
 
     result = {
@@ -183,6 +217,9 @@ def main() -> None:
         "p99_rule_eval_ms": round(rule_lat[int(len(rule_lat) * 0.99)] * 1000,
                                   3),
         "step_breakdown": step_breakdown,
+        "telemetry_packed_events_per_sec": round(telemetry_rate, 1),
+        "telemetry_wire_rows": int(telemetry_rows),
+        "telemetry_wire_bytes_per_event": int(telemetry_rows) * 4,
         "persist_events_per_sec": round(persist_rate, 1),
         "analytics_replay_events_per_sec": round(analytics_rate, 1),
         **aux,
@@ -219,6 +256,18 @@ def _sharded_world(max_devices, n_registered, n_tenants=1):
     return tensors
 
 
+def _measure_rate(jax, engine, pool, steps, global_batch):
+    """Sustained submit rate over a warm engine (no warmup inside — the
+    interleaved sections depend on measuring back-to-back)."""
+    import time as _time
+
+    t0 = _time.perf_counter()
+    for i in range(steps):
+        _, out = engine.submit(pool[i % len(pool)])
+    jax.block_until_ready(out.processed)
+    return steps * global_batch / (_time.perf_counter() - t0)
+
+
 def _drive_sharded(jax, engine, n_registered, global_batch, warmup, steps):
     """Warm + measure a sharded engine; returns (events/sec, router ms)."""
     import time as _time
@@ -230,11 +279,7 @@ def _drive_sharded(jax, engine, n_registered, global_batch, warmup, steps):
     for i in range(warmup):
         _, out = engine.submit(pool[i % len(pool)])
     jax.block_until_ready(out.processed)
-    t0 = _time.perf_counter()
-    for i in range(steps):
-        _, out = engine.submit(pool[i % len(pool)])
-    jax.block_until_ready(out.processed)
-    rate = steps * global_batch / (_time.perf_counter() - t0)
+    rate = _measure_rate(jax, engine, pool, steps, global_batch)
     # host routing cost alone (the path submit uses: fused native
     # pack+route into the pooled staging buffers when the C++ runtime is
     # available, two-pass numpy otherwise). Loaned blobs are released per
@@ -310,16 +355,52 @@ def _bench_sharded(jax, BATCH, MAX_DEVICES, N_REGISTERED, small):
             router.release_staging_buffer(blob)
         out["router_8shard_full_batch_ms"] = round(
             (_time.perf_counter() - r0) / 5 * 1000, 3)
-    return out
+
+        # shard-scaling decomposition (VERDICT r3 item 10): host routing
+        # cost at the FULL production batch per shard count, plus the
+        # end-to-end routed step on the virtual CPU mesh per shard count
+        # at one fixed small shape — the data v5e-8 projections rest on
+        # (the CPU-mesh step rate is NOT a hardware claim; its SLOPE vs
+        # shard count is the signal: how much the routed path costs as
+        # S grows with total work held constant).
+        scaling = {}
+        for S in (1, 2, 4, 8):
+            rt = ShardRouter(S, BATCH // S, staging_ring=4)
+            blob, _ = rt.route_batch(big)
+            rt.release_staging_buffer(blob)
+            r0 = _time.perf_counter()
+            for _ in range(5):
+                blob, _ = rt.route_batch(big)
+                rt.release_staging_buffer(blob)
+            scaling[f"router_full_batch_ms_s{S}"] = round(
+                (_time.perf_counter() - r0) / 5 * 1000, 3)
+        g_small = 8192
+        for S in (2, 4, 8):
+            tensors_s = _sharded_world(16384, 2000)
+            eng_s = build(tensors_s, make_mesh(S, devices=cpus[:S]),
+                          g_small // S)
+            rate_s, _ = _drive_sharded(jax, eng_s, 2000, g_small,
+                                       warmup=1, steps=3)
+            scaling[f"cpu_mesh_step_events_per_sec_s{S}"] = round(rate_s, 1)
+        out["shard_scaling"] = scaling
+    return out, eng1, n_reg
 
 
-def _bench_multitenant(jax, BATCH, small):
+def _bench_multitenant(jax, BATCH, small, single_engine=None,
+                       single_nreg=None):
     """BASELINE config 5: tenant-partitioned rule eval + device-state on the
     sharded engine — per-tenant scoped threshold rules + per-tenant zone
-    geofences, tenant stats psum'd across the mesh every step."""
+    geofences, tenant stats psum'd across the mesh every step.
+
+    Measured INTERLEAVED with the single-tenant sharded engine (VERDICT
+    r3 item 10): on a tunneled link with a burst bucket, back-to-back
+    sections see the same bucket state, so the recorded single-vs-multi
+    spread is attributable to the workload, not to when each section ran
+    — the json itself carries the evidence (docs/PERF.md)."""
     from sitewhere_tpu.model import AlertLevel
     from sitewhere_tpu.parallel import ShardedPipelineEngine, make_mesh
     from sitewhere_tpu.pipeline.engine import GeofenceRule, ThresholdRule
+    from __graft_entry__ import _synthetic_batch
 
     T = 8
     n_reg = 2048 if small else 16384
@@ -341,6 +422,19 @@ def _bench_multitenant(jax, BATCH, small):
     rate, route_ms = _drive_sharded(jax, eng, n_reg, batch,
                                     warmup=2 if small else 15,
                                     steps=5 if small else 30)
+    interleaved = {}
+    if single_engine is not None:
+        steps = 3 if small else 10
+        multi_pool = [_synthetic_batch(eng.packer, n_reg, batch,
+                                       seed=100 + s) for s in range(4)]
+        single_pool = [_synthetic_batch(single_engine.packer, single_nreg,
+                                        batch, seed=100 + s)
+                       for s in range(4)]
+        for tag in ("a", "b"):
+            interleaved[f"multi_{tag}"] = round(_measure_rate(
+                jax, eng, multi_pool, steps, batch), 1)
+            interleaved[f"single_{tag}"] = round(_measure_rate(
+                jax, single_engine, single_pool, steps, batch), 1)
     # decomposition (VERDICT r2 item 7): synchronous per-step wall time vs
     # host routing alone; the remainder is dispatch + device execution —
     # with T per-tenant zone geofences the containment kernel does T x the
@@ -363,7 +457,8 @@ def _bench_multitenant(jax, BATCH, small):
             "multitenant_active_tenants": active_tenants,
             "multitenant_route_ms_per_step": round(route_ms, 3),
             "multitenant_sync_step_ms": round(sync_ms, 3),
-            "multitenant_device_dispatch_ms": round(sync_ms - route_ms, 3)}
+            "multitenant_device_dispatch_ms": round(sync_ms - route_ms, 3),
+            "interleaved_single_vs_multitenant": interleaved}
 
 
 def _bench_query_10m(BATCH, packer, pool, small):

@@ -70,7 +70,7 @@ def _load(_retry: bool = True) -> None:
     # from source once.
     try:
         lib.swt_version.restype = i32
-        stale = lib.swt_version() != 8
+        stale = lib.swt_version() != 9
     except AttributeError:
         stale = True
     if stale:
@@ -127,12 +127,12 @@ def _load(_retry: bool = True) -> None:
     p_u8 = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
     lib.swt_pack_route_blob.argtypes = [p_i32, p_i32, p_i32, p_i32, p_f32,
                                         p_f32, p_f32, p_f32, p_i32, p_i32,
-                                        p_u8, i64, i32, i32, i32, p_i32,
-                                        p_i64, i64]
+                                        p_u8, i64, i32, i32, i32, i32,
+                                        p_i32, p_i64, i64]
     lib.swt_pack_route_blob.restype = i32
     lib.swt_pack_blob.argtypes = [p_i32, p_i32, p_i32, p_i32, p_f32, p_f32,
                                   p_f32, p_f32, p_i32, p_i32, p_u8, i64,
-                                  i32, p_i32]
+                                  i32, i32, p_i32]
     lib.swt_pack_blob.restype = i32
     lib.swt_unpack_blob.argtypes = [p_i32, i64, i32, p_i32, p_i32, p_i32,
                                     p_i32, p_f32, p_f32, p_f32, p_f32, p_i32,
@@ -344,7 +344,8 @@ def route_blob(blob: np.ndarray, n_shards: int, per_shard: int
 
 def pack_route_blob(batch, n_shards: int, per_shard: int,
                     out: Optional[np.ndarray] = None,
-                    wire_rows: Optional[int] = None
+                    wire_rows: Optional[int] = None,
+                    ts_base: int = 0
                     ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
     """Fused pack+route: EventBatch columns -> routed [S, wire_rows, B]
     blob + overflow flat-row indices in ONE native pass (see
@@ -377,7 +378,7 @@ def pack_route_blob(batch, n_shards: int, per_shard: int,
         f32(batch.elevation), i32(batch.alert_type_idx),
         i32(batch.alert_level),
         np.ascontiguousarray(batch.valid, np.uint8), n, n_shards, per_shard,
-        wire_rows, out.reshape(-1), overflow, len(overflow))
+        wire_rows, ts_base, out.reshape(-1), overflow, len(overflow))
     if rc == -2:
         return None
     if rc < 0:  # cannot happen with overflow_cap=n; defensive
@@ -385,7 +386,7 @@ def pack_route_blob(batch, n_shards: int, per_shard: int,
     return out, overflow[:rc]
 
 
-def pack_blob(batch, out: np.ndarray) -> bool:
+def pack_blob(batch, out: np.ndarray, ts_base: int = 0) -> bool:
     """One-pass EventBatch columns -> [wire_rows, n] wire blob (flat
     batches only; leading-axis batches use the numpy path; wire_rows from
     out.shape[0] — 4 = compact no-elevation variant). Returns False when
@@ -405,7 +406,7 @@ def pack_blob(batch, out: np.ndarray) -> bool:
         f32(batch.elevation), i32(batch.alert_type_idx),
         i32(batch.alert_level),
         np.ascontiguousarray(batch.valid, np.uint8), n, out.shape[0],
-        out.reshape(-1))
+        ts_base, out.reshape(-1))
     return rc == 0
 
 

@@ -109,7 +109,7 @@ struct Interner {
 
 extern "C" {
 
-int32_t swt_version() { return 8; }
+int32_t swt_version() { return 9; }
 
 void* swt_interner_create(int32_t capacity) {
   if (capacity < 2) return nullptr;
@@ -417,6 +417,33 @@ static constexpr int32_t kIdxMask = (1 << 12) - 1;  // mm/alert-type width
 static constexpr int32_t kEtMeasurement = 0;  // model/event.py DeviceEventType
 static constexpr int32_t kEtLocation = 1;
 static constexpr int32_t kEtAlert = 2;
+// PACKED 3-row variant (ops/pack.py WIRE_ROWS_PACKED): ts travels as a
+// 16-bit delta against a per-batch base embedded in row 0's spare bits
+// (3 per lane, lanes 0..10); mm/alert idx shares row 1 with the delta.
+static constexpr int32_t kTsDeltaMask = (1 << 16) - 1;
+static constexpr int32_t kPkIdxShift = 16;
+static constexpr int32_t kBaseShift = 29;
+static constexpr int32_t kBaseLanes = 11;
+
+// OR the 32-bit ts base into row0's spare bits (row0 has >= kBaseLanes
+// lanes — enforced by the packed-variant eligibility check host-side).
+static inline void embed_ts_base(int32_t* row0, int32_t ts_base) {
+  uint32_t base = static_cast<uint32_t>(ts_base);
+  for (int32_t lane = 0; lane < kBaseLanes; ++lane) {
+    uint32_t bits = (base >> (3 * lane)) & 7u;
+    row0[lane] |= static_cast<int32_t>(bits << kBaseShift);
+  }
+}
+
+static inline int32_t extract_ts_base(const int32_t* row0) {
+  uint32_t base = 0;
+  for (int32_t lane = 0; lane < kBaseLanes; ++lane) {
+    uint32_t bits =
+        (static_cast<uint32_t>(row0[lane]) >> kBaseShift) & 7u;
+    base |= bits << (3 * lane);
+  }
+  return static_cast<int32_t>(base);
+}
 
 namespace {
 inline int32_t f32_bits(float v) {
@@ -443,10 +470,27 @@ int32_t swt_pack_blob(const int32_t* device_idx, const int32_t* event_type,
                       const float* value, const float* lat, const float* lon,
                       const float* elevation, const int32_t* alert_type_idx,
                       const int32_t* alert_level, const uint8_t* valid,
-                      int64_t n, int32_t wire_rows, int32_t* out) {
+                      int64_t n, int32_t wire_rows, int32_t ts_base,
+                      int32_t* out) {
   int32_t* head = out;
   int32_t* ts_row = out + n;
   int32_t* pa = out + 2 * n;
+  if (wire_rows == 3) {  // packed: delta ts | idx, value bits, no location
+    for (int64_t i = 0; i < n; ++i) {
+      int32_t dev = device_idx[i];
+      if (dev < 0 || dev > kWireDevMask) return -1;
+      int32_t et = event_type[i] & 7;
+      head[i] = dev | (et << 22) | ((alert_level[i] & 7) << 25) |
+                ((valid[i] ? 1 : 0) << 28);
+      int32_t delta = valid[i] ? (ts[i] - ts_base) & kTsDeltaMask : 0;
+      int32_t idx =
+          (et == kEtAlert ? alert_type_idx[i] : mm_idx[i]) & kIdxMask;
+      ts_row[i] = delta | (idx << kPkIdxShift);
+      pa[i] = f32_bits(value[i]);
+    }
+    embed_ts_base(head, ts_base);
+    return 0;
+  }
   int32_t* pb = out + 3 * n;
   int32_t* elev = wire_rows >= 5 ? out + 4 * n : nullptr;
   for (int64_t i = 0; i < n; ++i) {
@@ -480,6 +524,26 @@ void swt_unpack_blob(const int32_t* blob, int64_t n, int32_t wire_rows,
   const int32_t* head = blob;
   const int32_t* ts_row = blob + n;
   const int32_t* pa = blob + 2 * n;
+  if (wire_rows == 3) {  // packed variant
+    int32_t base = extract_ts_base(head);
+    for (int64_t i = 0; i < n; ++i) {
+      int32_t h = head[i];
+      int32_t et = (h >> 22) & 7;
+      device_idx[i] = h & kWireDevMask;
+      event_type[i] = et;
+      alert_level[i] = (h >> 25) & 7;
+      valid[i] = (h & kWireValidBit) ? 1 : 0;
+      ts[i] = base + (ts_row[i] & kTsDeltaMask);
+      int32_t idx = (ts_row[i] >> kPkIdxShift) & kIdxMask;
+      mm_idx[i] = et == kEtMeasurement ? idx : 0;
+      alert_type_idx[i] = et == kEtAlert ? idx : 0;
+      value[i] = et == kEtMeasurement ? bits_f32(pa[i]) : 0.0f;
+      lat[i] = 0.0f;
+      lon[i] = 0.0f;
+      elevation[i] = 0.0f;
+    }
+    return;
+  }
   const int32_t* pb = blob + 3 * n;
   const int32_t* elev = wire_rows >= 5 ? blob + 4 * n : nullptr;
   for (int64_t i = 0; i < n; ++i) {
@@ -522,12 +586,13 @@ int32_t swt_pack_route_blob(
     const int32_t* mm_idx, const float* value, const float* lat,
     const float* lon, const float* elevation, const int32_t* alert_type_idx,
     const int32_t* alert_level, const uint8_t* valid, int64_t n, int32_t S,
-    int32_t B, int32_t wire_rows, int32_t* out, int64_t* overflow_rows,
-    int64_t overflow_cap) {
+    int32_t B, int32_t wire_rows, int32_t ts_base, int32_t* out,
+    int64_t* overflow_rows, int64_t overflow_cap) {
   std::vector<int32_t> cursor(static_cast<size_t>(S), 0);
   int64_t n_over = 0;
   const int64_t shard_stride = static_cast<int64_t>(wire_rows) * B;
   const bool with_elev = wire_rows >= 5;
+  const bool packed = wire_rows == 3;
   for (int64_t i = 0; i < n; ++i) {
     if (!valid[i]) continue;
     int32_t dev = device_idx[i];
@@ -544,6 +609,14 @@ int32_t swt_pack_route_blob(
     int32_t et = event_type[i] & 7;
     dst[0] = (dev / S) | (et << 22) | ((alert_level[i] & 7) << 25) |
              kWireValidBit;
+    if (packed) {
+      int32_t delta = (ts[i] - ts_base) & kTsDeltaMask;
+      int32_t idx =
+          (et == kEtAlert ? alert_type_idx[i] : mm_idx[i]) & kIdxMask;
+      dst[B] = delta | (idx << kPkIdxShift);
+      dst[2 * B] = f32_bits(value[i]);
+      continue;
+    }
     dst[B] = ts[i];
     if (et == kEtLocation) {
       dst[2 * B] = f32_bits(lat[i]);
@@ -559,6 +632,7 @@ int32_t swt_pack_route_blob(
     if (filled < B)
       std::memset(out + s * shard_stride + filled, 0,
                   static_cast<size_t>(B - filled) * 4);
+    if (packed) embed_ts_base(out + s * shard_stride, ts_base);
   }
   return static_cast<int32_t>(n_over);
 }
@@ -570,6 +644,14 @@ int32_t swt_route_blob(const int32_t* blob, int64_t n, int32_t S, int32_t B,
   const int32_t* head_row = blob;
   int64_t n_over = 0;
   const int64_t shard_stride = static_cast<int64_t>(wire_rows) * B;
+  // packed 3-row blobs carry the ts base in row 0's spare bits by LANE
+  // POSITION: routing scatters lanes, so the base must be lifted out of
+  // the flat head first and re-embedded per shard afterwards (spare bits
+  // are stripped from every routed head; they are zero on 4/5-row blobs)
+  const bool packed = wire_rows == 3;
+  const int32_t base =
+      packed && n >= kBaseLanes ? extract_ts_base(head_row) : 0;
+  constexpr int32_t kSpareClear = (1 << kBaseShift) - 1;
   for (int64_t i = 0; i < n; ++i) {
     int32_t head = head_row[i];
     if ((head & kWireValidBit) == 0) continue;  // padding row
@@ -583,9 +665,12 @@ int32_t swt_route_blob(const int32_t* blob, int64_t n, int32_t S, int32_t B,
     }
     cursor[s] = pos + 1;
     int32_t* dst = out + s * shard_stride + pos;
-    dst[0] = (head & ~kWireDevMask) | (dev / S);
+    dst[0] = ((head & ~kWireDevMask) & kSpareClear) | (dev / S);
     for (int r = 1; r < wire_rows; ++r) dst[r * B] = blob[r * n + i];
   }
+  if (packed)
+    for (int32_t s = 0; s < S; ++s)
+      embed_ts_base(out + s * shard_stride, base);
   return static_cast<int32_t>(n_over);
 }
 

@@ -1339,6 +1339,11 @@ class ClusterService:
             import os
 
             os._exit(self.peer_loss_exit_code)
+        # exit_on_peer_loss=False (examples/tests): the process survives
+        # with a dead loop — STOP heartbeating so peers' staleness
+        # watchdogs see the failure instead of a live-looking host whose
+        # vote/step collectives hang forever
+        self.reporter.stop()
 
     def _on_peer_loss(self, stale: List[str]) -> None:
         self.degraded = stale

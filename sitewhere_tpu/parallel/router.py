@@ -18,7 +18,11 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-from sitewhere_tpu.ops.pack import EventBatch
+from sitewhere_tpu.ops.pack import EventBatch, _BASE_LANES
+
+# the packed 3-row wire embeds its ts base in 11 row-0 lanes PER SHARD:
+# routed layouts need at least that per-shard width (ops/pack.py)
+_ROUTABLE_PACKED_MIN = _BASE_LANES
 
 
 _I32_COLS = ("device_idx", "tenant_idx", "event_type", "ts", "mm_idx",
@@ -138,10 +142,12 @@ class ShardRouter:
             return
         with self._pool_lock:
             if self._free_count() >= self.staging_ring:
-                other = self._pools.get(5 if rows == 4 else 4)
-                if not other:
+                other = next(
+                    (pool for variant, pool in self._pools.items()
+                     if variant != rows and pool), None)
+                if other is None:
                     return  # bound reached by this variant: drop
-                other.pop(0)  # evict stale variant, keep the active one
+                other.pop(0)  # evict a stale variant, keep the active one
             self._pools.setdefault(rows, []).append((buf, guard))
 
     def discard_staging_buffer(self, buf: np.ndarray) -> None:
@@ -162,19 +168,25 @@ class ShardRouter:
         back to exactly the two-pass path when the native runtime is
         unavailable."""
         from sitewhere_tpu import native
-        from sitewhere_tpu.ops.pack import batch_to_blob, wire_rows_for
+        from sitewhere_tpu.ops.pack import (
+            WIRE_ROWS_PACKED, batch_to_blob, wire_variant_for)
 
         if native.available():
-            # Wire variant: per-batch compact decision — EXCEPT when
-            # pinned (fixed_wire_rows). Multi-host lockstep requires every
-            # host to launch the same-shaped collective program per tick;
-            # a host-local rows choice would desync the cluster, so the
-            # sharded engine pins the full layout under is_multiprocess.
-            rows = self.fixed_wire_rows or wire_rows_for(batch)
+            # Wire variant: per-batch packed/compact decision — EXCEPT
+            # when pinned (fixed_wire_rows). Multi-host lockstep requires
+            # every host to launch the same-shaped collective program per
+            # tick; a host-local rows choice would desync the cluster, so
+            # the sharded engine pins the full layout under
+            # is_multiprocess.
+            if self.fixed_wire_rows is not None:
+                rows, ts_base = self.fixed_wire_rows, 0
+            else:
+                rows, ts_base = wire_variant_for(batch)
+                rows, ts_base = self._routable_variant(rows, ts_base)
             out = self._staging_buffer(rows)
             res = native.pack_route_blob(batch, self.n_shards,
                                          self.per_shard_batch, out=out,
-                                         wire_rows=rows)
+                                         wire_rows=rows, ts_base=ts_base)
             if res is not None:
                 return res
             # device_idx out of wire range: the buffer never reached jax,
@@ -184,16 +196,30 @@ class ShardRouter:
                 self.release_staging_buffer(out)
             batch_to_blob(batch)
             raise AssertionError("unreachable: numpy pack must have raised")
-        blob = batch_to_blob(batch)
-        if (self.fixed_wire_rows is not None
-                and blob.shape[0] != self.fixed_wire_rows):
-            # the lockstep pin applies on the numpy fallback too: pad the
-            # compact blob to the pinned layout (extra rows are zeros —
-            # elevation 0 — exactly the full-layout encoding)
-            full = np.zeros((self.fixed_wire_rows, blob.shape[1]), np.int32)
-            full[:blob.shape[0]] = blob
-            blob = full
+        # the lockstep pin applies on the numpy fallback too: pack
+        # directly at the pinned layout (a packed 3-row blob is not a
+        # zero-padded prefix of the classic one, so padding cannot widen)
+        blob = batch_to_blob(batch, wire_rows=self.fixed_wire_rows)
+        if blob.shape[0] == WIRE_ROWS_PACKED \
+                and self.per_shard_batch < _ROUTABLE_PACKED_MIN:
+            # per-shard rows cannot carry the lane-embedded ts base:
+            # re-pack classic (tiny-shard test rigs only)
+            from sitewhere_tpu.ops.pack import WIRE_ROWS_COMPACT
+
+            blob = batch_to_blob(batch, wire_rows=WIRE_ROWS_COMPACT)
         return self.route_blob(blob)
+
+    def _routable_variant(self, rows: int, ts_base: int):
+        """Downgrade the packed variant when the PER-SHARD width cannot
+        hold the lane-embedded ts base (11 lanes) — wire_variant_for
+        checks the flat batch width, but routed row 0 is per shard."""
+        from sitewhere_tpu.ops.pack import (
+            WIRE_ROWS_COMPACT, WIRE_ROWS_PACKED)
+
+        if rows == WIRE_ROWS_PACKED \
+                and self.per_shard_batch < _ROUTABLE_PACKED_MIN:
+            return WIRE_ROWS_COMPACT, 0
+        return rows, ts_base
 
     def global_to_local(self, device_idx: np.ndarray
                         ) -> Tuple[np.ndarray, np.ndarray]:
@@ -226,14 +252,28 @@ class ShardRouter:
         per-column scatters; the numpy fallback routes the blob rows the
         same way route_columns routes the 12 column arrays."""
         from sitewhere_tpu import native
-        from sitewhere_tpu.ops.pack import WIRE_DEV_MAX, _VALID_SHIFT
+        from sitewhere_tpu.ops.pack import (
+            WIRE_DEV_MAX, WIRE_ROWS_PACKED, _BASE_SHIFT, _VALID_SHIFT,
+            _embed_ts_base, _extract_ts_base_np)
 
         S, B = self.n_shards, self.per_shard_batch
+        if np.asarray(blob).shape[-2] == WIRE_ROWS_PACKED \
+                and B < _ROUTABLE_PACKED_MIN:
+            raise ValueError(
+                f"packed 3-row blobs need a per-shard width of at least "
+                f"{_ROUTABLE_PACKED_MIN} lanes to carry the lane-embedded "
+                f"ts base (per_shard_batch={B}); route a classic-layout "
+                f"blob instead")
         if native.available():
             return native.route_blob(blob, S, B)
         blob = np.asarray(blob, np.int32)
         wire_rows, n = blob.shape
         head = blob[0]
+        # packed blobs carry the ts base by LANE POSITION in row 0's spare
+        # bits: lift it before scattering, strip the spare bits from every
+        # routed head (zero on 4/5-row blobs), re-embed per shard after
+        packed = wire_rows == WIRE_ROWS_PACKED
+        base = int(_extract_ts_base_np(head)) if packed else 0
         rows = np.nonzero((head & (1 << _VALID_SHIFT)) != 0)[0]
         dev = head[rows] & (WIRE_DEV_MAX - 1)
         shard = dev % S
@@ -247,10 +287,13 @@ class ShardRouter:
         out = np.zeros((S, wire_rows, B), np.int32)
         ks, kp, krows = sshard[keep], pos[keep], srows[keep]
         kdev = head[krows] & (WIRE_DEV_MAX - 1)
-        out[ks, 0, kp] = (head[krows] & ~np.int32(WIRE_DEV_MAX - 1)) \
-            | (kdev // S)
+        spare_clear = np.int32((1 << _BASE_SHIFT) - 1)
+        out[ks, 0, kp] = (head[krows] & ~np.int32(WIRE_DEV_MAX - 1)
+                          & spare_clear) | (kdev // S)
         for r in range(1, wire_rows):
             out[ks, r, kp] = blob[r, krows]
+        if packed:
+            _embed_ts_base(out[:, 0, :], base)
         return out, np.sort(srows[~keep])  # arrival order, like the native
 
     def route_columns(self, batch: EventBatch) -> RoutedBatches:

@@ -123,11 +123,14 @@ class InboundProcessingService(LifecycleComponent):
                     if data.get("fwdFrom") is not None:
                         # already forwarded once and this host STILL does
                         # not own it: the hosts' registries disagree
-                        # (provisioning drift) — park it, never ping-pong
+                        # (provisioning drift) — park it on the misroute
+                        # surface (visible to `deadletters list`, like
+                        # ForeignRowsConsumer's disowned rows), never
+                        # ping-pong
                         self.failed_counter.inc()
                         self.bus.publish(
-                            self.naming.event_source_failed_decode_events(
-                                self.tenant),
+                            self.naming.event_source_decoded_events(
+                                self.tenant) + ".misrouted",
                             token.encode(), record.value)
                         continue
                     forward.setdefault(owner, []).append(record)

@@ -219,30 +219,43 @@ class TestFusedRouteBatch:
         routed5, _ = router.route_batch(full)
         assert routed5.shape[1] == 5
 
-    def test_compact_step_matches_full(self):
+    def test_wire_variants_step_identically(self):
         """The fused step produces identical outputs/state whether the
-        batch arrived on the 4-row or (padded) 5-row wire."""
+        batch arrived on the packed 3-row, compact 4-row, or 5-row
+        wire (measurement batches are eligible for all three)."""
+        from sitewhere_tpu.ops.pack import (
+            WIRE_ROWS, WIRE_ROWS_COMPACT, WIRE_ROWS_PACKED)
+
         _, t1 = _world()
         _, t2 = _world()
+        _, t3 = _world()
         a = _engine(t1)
         b = _engine(t2)
-        batches = _batches(a, 4)  # measurements: no elevation -> compact
-        outs_a = [a.submit(x) for x in batches]
-        # force the full layout on engine b by an explicit 5-row pack
-        from sitewhere_tpu.ops.pack import WIRE_ROWS
-
+        c = _engine(t3)
+        batches = _batches(a, 4)  # measurements: packed-eligible
+        outs_a = [a.submit(x) for x in batches]  # default: packed 3-row
+        assert batch_to_blob(batches[0]).shape[0] == WIRE_ROWS_PACKED
         for x, want in zip(batches, outs_a):
+            # engine b: explicit compact 4-row padded onto the full wire
             blob5 = np.zeros((WIRE_ROWS, x.valid.shape[0]), np.int32)
-            blob5[:4] = batch_to_blob(x)
+            blob5[:4] = batch_to_blob(x, wire_rows=WIRE_ROWS_COMPACT)
             got = b.submit_blob(blob5)
             assert int(got.processed) == int(want.processed)
             assert int(got.alerts) == int(want.alerts)
+            # engine c: explicit compact, unpadded
+            got_c = c.submit_blob(
+                batch_to_blob(x, wire_rows=WIRE_ROWS_COMPACT))
+            assert int(got_c.processed) == int(want.processed)
         import dataclasses
-        sa, sb = a.canonical_state(), b.canonical_state()
+        sa, sb, sc = (a.canonical_state(), b.canonical_state(),
+                      c.canonical_state())
         for f in dataclasses.fields(sa):
             np.testing.assert_array_equal(
                 np.asarray(getattr(sa, f.name)),
                 np.asarray(getattr(sb, f.name)), err_msg=f.name)
+            np.testing.assert_array_equal(
+                np.asarray(getattr(sa, f.name)),
+                np.asarray(getattr(sc, f.name)), err_msg=f.name)
 
     def test_fixed_wire_rows_pins_the_variant(self, rng):
         """Multi-host lockstep pins the full layout: with fixed_wire_rows

@@ -407,3 +407,132 @@ class TestWireBlob:
         assert blob.shape == (2, WIRE_ROWS, 8)
         out = blob_to_batch(blob)
         assert np.asarray(out.device_idx).shape == (2, 8)
+
+
+class TestPackedWireBlob:
+    """3-row packed variant (12 B/event): delta-ts + lane-embedded base
+    (ops/pack.py WIRE_ROWS_PACKED). Covers flat + routed, native + numpy,
+    host + device decode, negative bases, and variant eligibility."""
+
+    def _batch(self, B=193, base=1_234_567, span=4_000, seed=7):
+        import numpy as np
+        from sitewhere_tpu.ops.pack import empty_batch
+
+        rng = np.random.default_rng(seed)
+        et = np.where(rng.integers(0, 2, B) > 0, 2, 0).astype(np.int32)
+        is_meas = et == 0
+        b = empty_batch(B)
+        return b.replace(
+            device_idx=rng.integers(0, 2 ** 20, B).astype(np.int32),
+            event_type=et,
+            ts=(base + rng.integers(0, span, B)).astype(np.int32),
+            mm_idx=np.where(is_meas, rng.integers(0, 4096, B),
+                            0).astype(np.int32),
+            value=np.where(is_meas, rng.normal(size=B), 0).astype(np.float32),
+            alert_type_idx=np.where(et == 2, rng.integers(0, 4096, B),
+                                    0).astype(np.int32),
+            alert_level=rng.integers(0, 6, B).astype(np.int32),
+            valid=rng.integers(0, 2, B).astype(bool))
+
+    def _assert_roundtrip(self, b, dec):
+        import numpy as np
+
+        v = np.asarray(b.valid)
+        np.testing.assert_array_equal(np.asarray(dec.valid), v)
+        for name in ("device_idx", "event_type", "ts", "mm_idx", "value",
+                     "alert_type_idx", "alert_level"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(dec, name))[v],
+                np.asarray(getattr(b, name))[v], err_msg=name)
+        assert not np.asarray(dec.elevation).any()
+
+    @pytest.mark.parametrize("base", [1_234_567, -9_876_543, 0])
+    def test_flat_roundtrip_host_and_device(self, base):
+        import numpy as np
+        from sitewhere_tpu.ops.pack import (
+            WIRE_ROWS_PACKED, batch_to_blob, blob_to_batch,
+            blob_to_batch_np, wire_variant_for)
+
+        b = self._batch(base=base)
+        rows, ts_base = wire_variant_for(b)
+        assert rows == WIRE_ROWS_PACKED
+        blob = batch_to_blob(b)
+        assert blob.shape[0] == WIRE_ROWS_PACKED
+        self._assert_roundtrip(b, blob_to_batch_np(blob))
+        self._assert_roundtrip(b, jax.jit(blob_to_batch)(blob))
+
+    def test_routed_roundtrip(self):
+        import numpy as np
+        from sitewhere_tpu.ops.pack import blob_to_batch_np
+        from sitewhere_tpu.parallel.router import ShardRouter
+
+        b = self._batch(B=96, base=-55_555)
+        S = 4
+        router = ShardRouter(S, 64)
+        routed, overflow = router.route_batch(b)
+        assert routed.shape[1:] == (3, 64)
+        dec = blob_to_batch_np(routed)
+        vr = np.asarray(dec.valid)
+        got = sorted(
+            (int(dec.device_idx[s, p]) * S + s, int(dec.ts[s, p]))
+            for s in range(S) for p in np.nonzero(vr[s])[0])
+        v = np.asarray(b.valid)
+        exp = sorted((int(b.device_idx[i]), int(b.ts[i]))
+                     for i in np.nonzero(v)[0] if i not in overflow)
+        assert got == exp
+
+    def test_variant_eligibility(self):
+        import numpy as np
+        from sitewhere_tpu.ops.pack import (
+            WIRE_ROWS, WIRE_ROWS_COMPACT, WIRE_ROWS_PACKED,
+            wire_variant_for)
+
+        b = self._batch()
+        assert wire_variant_for(b)[0] == WIRE_ROWS_PACKED
+        # a single location event forces the classic compact layout
+        et = np.array(b.event_type)
+        et[5] = 1
+        assert wire_variant_for(b.replace(event_type=et))[0] == \
+            WIRE_ROWS_COMPACT
+        # elevation forces the full layout
+        ele = np.array(b.elevation)
+        ele[3] = 12.5
+        assert wire_variant_for(b.replace(elevation=ele))[0] == WIRE_ROWS
+        # a ts span wider than 2^16 ms forces compact
+        ts = np.array(b.ts)
+        ts[0], ts[1] = 0, 1 << 17
+        valid = np.ones_like(np.asarray(b.valid))
+        assert wire_variant_for(b.replace(ts=ts, valid=valid))[0] == \
+            WIRE_ROWS_COMPACT
+
+    def test_fixed_rows_pin_never_packs(self):
+        from sitewhere_tpu.ops.pack import WIRE_ROWS, batch_to_blob
+        from sitewhere_tpu.parallel.router import ShardRouter
+
+        b = self._batch(B=64)
+        assert batch_to_blob(b, wire_rows=WIRE_ROWS).shape[0] == WIRE_ROWS
+        router = ShardRouter(4, 32)
+        router.fixed_wire_rows = WIRE_ROWS
+        routed, _ = router.route_batch(b)
+        assert routed.shape[1] == WIRE_ROWS
+
+    def test_tiny_per_shard_downgrades_packed(self):
+        # the lane-embedded base needs 11 lanes PER SHARD: a router whose
+        # per-shard width is smaller must fall back to the classic layout
+        # (regression: the embed overran row 0 into row 1)
+        import numpy as np
+        from sitewhere_tpu.ops.pack import (
+            WIRE_ROWS_COMPACT, blob_to_batch_np)
+        from sitewhere_tpu.parallel.router import ShardRouter
+
+        b = self._batch(B=24, base=777_777)
+        b = b.replace(device_idx=(np.arange(24, dtype=np.int32) % 8),
+                      valid=np.ones(24, bool))
+        router = ShardRouter(8, 4)
+        routed, _ = router.route_batch(b)
+        assert routed.shape[1] == WIRE_ROWS_COMPACT
+        dec = blob_to_batch_np(routed)
+        vr = np.asarray(dec.valid)
+        got = sorted(int(dec.ts[s, p]) for s in range(8)
+                     for p in np.nonzero(vr[s])[0])
+        assert got == sorted(int(t) for t in np.asarray(b.ts))
