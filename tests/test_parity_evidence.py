@@ -1,0 +1,50 @@
+"""Mechanical verification of docs/PARITY.md's evidence column.
+
+VERDICT r3 item 9: the parity map cited tests that were failing (or
+could silently rot). This test makes every citation checkable: each
+`test_*.py` file named in PARITY.md must exist under tests/, and each
+`file::Node` reference must name a class or function defined in that
+file. The full suite being green then transitively makes every cited
+evidence real.
+"""
+
+import os
+import re
+
+DOCS = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "docs")
+TESTS = os.path.dirname(os.path.abspath(__file__))
+
+_REF = re.compile(r"`(test_[a-z0-9_]+\.py)(::([A-Za-z_][A-Za-z0-9_:]*))?`")
+
+
+def _parity_refs():
+    with open(os.path.join(DOCS, "PARITY.md"), encoding="utf-8") as fh:
+        text = fh.read()
+    return sorted({(m.group(1), m.group(3)) for m in _REF.finditer(text)},
+                  key=lambda ref: (ref[0], ref[1] or ""))
+
+
+def test_every_cited_test_file_exists():
+    refs = _parity_refs()
+    assert refs, "PARITY.md cites no test files — the regex or doc broke"
+    missing = [f for f, _ in refs
+               if not os.path.exists(os.path.join(TESTS, f))]
+    assert not missing, f"PARITY.md cites missing test files: {missing}"
+
+
+def test_every_cited_node_is_defined():
+    bad = []
+    for fname, node in _parity_refs():
+        if node is None:
+            continue
+        path = os.path.join(TESTS, fname)
+        if not os.path.exists(path):
+            continue  # covered by the file-existence test
+        with open(path, encoding="utf-8") as fh:
+            source = fh.read()
+        head = node.split("::")[0]
+        if not re.search(rf"^(class|def)\s+{re.escape(head)}\b", source,
+                         re.MULTILINE):
+            bad.append(f"{fname}::{node}")
+    assert not bad, f"PARITY.md cites undefined test nodes: {bad}"
