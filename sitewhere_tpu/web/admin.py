@@ -74,6 +74,7 @@ _PAGE = """<!doctype html>
 </main></div>
 <script>
 let TOKEN=null;
+__SHARED_JS__
 const api=(p,opt={})=>fetch(p,{...opt,headers:{
   'Authorization':'Bearer '+TOKEN,'Content-Type':'application/json',
   ...(opt.headers||{})}}).then(r=>{
@@ -81,19 +82,11 @@ const api=(p,opt={})=>fetch(p,{...opt,headers:{
 async function signin(){
   const u=document.getElementById('u').value,p=document.getElementById('p').value;
   try{
-    const r=await fetch('/authapi/jwt',{method:'POST',
-      headers:{'Authorization':'Basic '+btoa(u+':'+p)}});
-    if(!r.ok)throw new Error('auth failed ('+r.status+')');
-    TOKEN=(await r.json()).token;
+    TOKEN=await mintJwt(u,p);
     document.getElementById('login').style.display='none';
     document.getElementById('app').style.display='';
     tick();setInterval(tick,2000);
   }catch(e){document.getElementById('lerr').textContent=e.message}}
-// tenant tokens / metric names are free-form operator data: everything
-// interpolated into markup is escaped (stored-XSS in an admin page would
-// execute with the admin JWT in scope)
-const esc=s=>String(s).replace(/[&<>"']/g,
-  c=>({'&':'&amp;','<':'&lt;','>':'&gt;','"':'&quot;',"'":'&#39;'}[c]));
 function kv(el,obj){el.innerHTML=Object.entries(obj).map(
   ([k,v])=>`<div>${esc(k)}</div><div>${esc(v)}</div>`).join('')}
 async function tick(){
@@ -176,7 +169,11 @@ def register_admin(router) -> None:
     """Serve the console at /admin (the page itself is public; every API
     call it makes carries the JWT it mints on sign-in)."""
 
+    from sitewhere_tpu.web.pagejs import ESC_JS, MINT_JWT_JS
+
+    page = _PAGE.replace("__SHARED_JS__", ESC_JS + MINT_JWT_JS)
+
     def admin_page(request):
-        return 200, _PAGE.encode("utf-8"), "text/html; charset=utf-8"
+        return 200, page.encode("utf-8"), "text/html; charset=utf-8"
 
     router.get("/admin", admin_page, auth=False)

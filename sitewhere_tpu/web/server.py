@@ -60,6 +60,8 @@ class RestServer(LifecycleComponent):
         register_all(self.router, instance, self)
         from sitewhere_tpu.web.admin import register_admin
         register_admin(self.router)
+        from sitewhere_tpu.web.explorer import register_explorer
+        register_explorer(self.router)
 
     # -- lifecycle ---------------------------------------------------------
     def on_start(self, monitor) -> None:

@@ -43,8 +43,12 @@ def test_every_cited_node_is_defined():
             continue  # covered by the file-existence test
         with open(path, encoding="utf-8") as fh:
             source = fh.read()
-        head = node.split("::")[0]
-        if not re.search(rf"^(class|def)\s+{re.escape(head)}\b", source,
-                         re.MULTILINE):
-            bad.append(f"{fname}::{node}")
+        # EVERY segment of a Class::method chain must be defined, or a
+        # renamed method rots the citation silently
+        for segment in node.split("::"):
+            if not re.search(
+                    rf"^\s*(class|def)\s+{re.escape(segment)}\b",
+                    source, re.MULTILINE):
+                bad.append(f"{fname}::{node} (segment {segment!r})")
+                break
     assert not bad, f"PARITY.md cites undefined test nodes: {bad}"

@@ -428,3 +428,19 @@ def test_instance_metrics_endpoint(client):
     # report values are typed snapshots (counters/meters/timers)
     sample = next(iter(report.values()))
     assert isinstance(sample, dict)
+
+
+class TestApiExplorer:
+    def test_explorer_page_served(self, server):
+        import urllib.request
+
+        with urllib.request.urlopen(
+                f"{server.base_url}/api/explorer") as resp:
+            assert resp.status == 200
+            assert resp.headers["Content-Type"].startswith("text/html")
+            page = resp.read().decode()
+        # self-contained: renders the live openapi doc, no external assets
+        assert "/api/openapi.json" in page
+        assert "/authapi/jwt" in page
+        assert "http://" not in page.replace("http://'+", "")
+        assert "https://" not in page
