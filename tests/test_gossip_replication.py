@@ -292,3 +292,148 @@ class TestClaimWindow:
         dm.update_device("rd", {"comments": "operator-touched"})
         with pytest.raises(DuplicateTokenError):
             dm.create_device(Device(token="rd", device_type_id=dtype.id))
+
+
+class TestConvergenceStress:
+    """Randomized two-host mutation storm: both hosts create/update/
+    delete overlapping device fleets concurrently; their gossip streams
+    cross-apply in interleaved chunks (per-token order preserved, as the
+    token-partitioned transport guarantees). Registries must converge to
+    IDENTICAL host-independent content. Seeded: failures reproduce."""
+
+    def _content(self, reg):
+        """Host-independent view: token -> (exists, comparable fields)."""
+        from sitewhere_tpu.web.marshal import to_jsonable
+
+        out = {}
+        for device in reg.devices.all():
+            data = to_jsonable(device)
+            dtype = reg.device_types.get(device.device_type_id)
+            out[device.token] = {
+                k: v for k, v in data.items()
+                if k not in ("id", "device_type_id")}
+            out[device.token]["_type"] = dtype.token if dtype else None
+        return out
+
+    def test_randomized_storm_converges(self):
+        import random as _random
+
+        from sitewhere_tpu.errors import SiteWhereError
+
+        rng = _random.Random(1234)
+        _, reg_a, gossip_a, cap_a = _host("storm-a")
+        _, reg_b, gossip_b, cap_b = _host("storm-b")
+        # shared type arrives on both sides first
+        dt_a = reg_a.create_device_type(DeviceType(token="st"))
+        _apply(gossip_b, cap_a.drain())
+        dt_b = reg_b.device_types.get_by_token("st")
+
+        tokens = [f"sd{i}" for i in range(12)]
+        for _round in range(6):
+            for reg, dt in ((reg_a, dt_a), (reg_b, dt_b)):
+                for _ in range(8):
+                    token = rng.choice(tokens)
+                    op = rng.random()
+                    try:
+                        if op < 0.45:
+                            reg.create_device(Device(
+                                token=token, device_type_id=dt.id,
+                                comments=f"c{rng.randrange(1000)}"))
+                        elif op < 0.8:
+                            reg.update_device(token, {
+                                "comments": f"u{rng.randrange(1000)}"})
+                        else:
+                            reg.delete_device(token)
+                    except SiteWhereError:
+                        pass  # duplicate create / missing update target
+            # cross-apply in interleaved chunks; per-host stream order
+            # is preserved (the transport keys by token, and one host's
+            # stream for one token is ordered)
+            stream_a, stream_b = cap_a.drain(), cap_b.drain()
+            while stream_a or stream_b:
+                if stream_a:
+                    n = rng.randrange(1, 4)
+                    _apply(gossip_b, stream_a[:n])
+                    stream_a = stream_a[n:]
+                if stream_b:
+                    n = rng.randrange(1, 4)
+                    _apply(gossip_a, stream_b[:n])
+                    stream_b = stream_b[n:]
+            # applying may publish echo-suppressed... nothing; claims
+            # emit updates though: drain and cross-apply those too
+            extra_a, extra_b = cap_a.drain(), cap_b.drain()
+            _apply(gossip_b, extra_a)
+            _apply(gossip_a, extra_b)
+        # final drains until quiescent
+        for _ in range(4):
+            _apply(gossip_b, cap_a.drain())
+            _apply(gossip_a, cap_b.drain())
+        content_a, content_b = self._content(reg_a), self._content(reg_b)
+        assert content_a == content_b
+
+
+class TestCreateCreateRace:
+    """Both hosts create the same token independently (no updates, so
+    each entity's LWW stamp IS its created_date) — the regression that
+    once flipped strict LWW wins into digest ties after the created_date
+    min-merge mutated the entity before the comparison."""
+
+    def _make(self, iid, created, comments):
+        instance, reg, gossip, cap = _host(iid)
+        dt = reg.create_device_type(DeviceType(token="ct"))
+        with reg.replication():  # type arrives identically on both
+            pass
+        device = Device(token="cc", device_type_id=dt.id,
+                        comments=comments)
+        device.created_date = created
+        reg.create_device(device)
+        return reg, gossip, cap
+
+    def test_content_and_stamp_converge(self):
+        reg_a, gossip_a, cap_a = self._make("ccr-a", 1_000, "from-A")
+        reg_b, gossip_b, cap_b = self._make("ccr-b", 2_000, "from-B")
+        # drop the device_type gossip, apply the type first manually
+        (type_a, create_a) = cap_a.drain()
+        (type_b, create_b) = cap_b.drain()
+        _apply(gossip_b, [type_a])
+        _apply(gossip_a, [type_b])
+        _apply(gossip_b, [create_a])
+        _apply(gossip_a, [create_b])
+        a_dev = reg_a.get_device_by_token("cc")
+        b_dev = reg_b.get_device_by_token("cc")
+        # strict LWW: the t2 create wins content on BOTH hosts
+        assert a_dev.comments == "from-B"
+        assert b_dev.comments == "from-B"
+        # created_date converges on the minimum
+        assert a_dev.created_date == 1_000
+        assert b_dev.created_date == 1_000
+
+    def test_stale_stamp_does_not_end_claim(self):
+        from sitewhere_tpu.errors import DuplicateTokenError
+
+        _, reg_b, gossip_b, cap_b = _host("claim-b")
+        _, reg_a, _ga, cap_a = _host("claim-a")
+        dt = reg_a.create_device_type(DeviceType(token="ct"))
+        device = Device(token="cl", device_type_id=dt.id, comments="v1")
+        device.created_date = 5_000
+        reg_a.create_device(device)
+        _apply(gossip_b, cap_a.drain())  # B holds an unclaimed replica
+        # a stale message with an OLDER created_date arrives: adjusts the
+        # stamp but must NOT end B's claim window
+        import msgpack as _mp
+
+        reg_a.update_device("cl", {"comments": "v1"})  # produce a payload
+        payload = _mp.unpackb(cap_a.drain()[-1], raw=False)
+        payload["entity"] = dict(payload["entity"], created_date=1_000,
+                                 updated_date=1)  # stale stamp
+        _apply(gossip_b, [_mp.packb(payload, use_bin_type=True)])
+        assert reg_b.get_device_by_token("cl").created_date == 1_000
+        # the claim survives: an identical local create still merges
+        dt_b = reg_b.device_types.get_by_token("ct")
+        merged = reg_b.create_device(Device(token="cl",
+                                            device_type_id=dt_b.id,
+                                            comments="mine"))
+        assert merged.comments == "mine"
+        with pytest.raises(DuplicateTokenError):
+            reg_b.create_device(Device(token="cl",
+                                       device_type_id=dt_b.id))
