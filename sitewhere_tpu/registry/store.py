@@ -362,17 +362,6 @@ class _Collection(Generic[T]):
             self._discard_replica_locked(token)  # mutation ends the claim
         self._emit("update", entity)
 
-    def persist_stamp(self, entity: T) -> None:
-        """Write-through a replication-side STAMP adjustment (created_date
-        min-convergence — parallel/cluster.py) without emitting a mutation
-        or ending a claim window: the adjustment is not a write the peers
-        need (every host converges on it independently), and it must not
-        make a later identical local create stop merging."""
-        with self._lock:
-            self.store.save(self.kind, entity.id,
-                            getattr(entity, "token", ""),
-                            _entity_to_json(entity))
-
     def list(self, criteria: Optional[SearchCriteria] = None,
              where: Optional[Callable[[T], bool]] = None) -> SearchResults[T]:
         with self._lock:

@@ -309,9 +309,12 @@ class TestConvergenceStress:
         for device in reg.devices.all():
             data = to_jsonable(device)
             dtype = reg.device_types.get(device.device_type_id)
+            # created_date deliberately does NOT replicate (per-host
+            # observation; converging it destabilizes the LWW stamp of
+            # never-updated entities — see RegistryGossip._update_existing)
             out[device.token] = {
                 k: v for k, v in data.items()
-                if k not in ("id", "device_type_id")}
+                if k not in ("id", "device_type_id", "created_date")}
             out[device.token]["_type"] = dtype.token if dtype else None
         return out
 
@@ -374,41 +377,44 @@ class TestConvergenceStress:
 
 class TestCreateCreateRace:
     """Both hosts create the same token independently (no updates, so
-    each entity's LWW stamp IS its created_date) — the regression that
-    once flipped strict LWW wins into digest ties after the created_date
-    min-merge mutated the entity before the comparison."""
+    each entity's LWW stamp IS its created_date). CONTENT must converge
+    to the strict LWW winner on both hosts — and must KEEP converging
+    under at-least-once redelivery of the losing create (the scenario
+    that killed two attempts at also converging created_date: any
+    mutation of the stamp lets a redelivery tie and flip one host).
+    created_date itself deliberately stays a per-host observation."""
 
     def _make(self, iid, created, comments):
         instance, reg, gossip, cap = _host(iid)
         dt = reg.create_device_type(DeviceType(token="ct"))
-        with reg.replication():  # type arrives identically on both
-            pass
         device = Device(token="cc", device_type_id=dt.id,
                         comments=comments)
         device.created_date = created
         reg.create_device(device)
         return reg, gossip, cap
 
-    def test_content_and_stamp_converge(self):
+    def test_content_converges_and_redelivery_is_stable(self):
         reg_a, gossip_a, cap_a = self._make("ccr-a", 1_000, "from-A")
         reg_b, gossip_b, cap_b = self._make("ccr-b", 2_000, "from-B")
-        # drop the device_type gossip, apply the type first manually
         (type_a, create_a) = cap_a.drain()
         (type_b, create_b) = cap_b.drain()
         _apply(gossip_b, [type_a])
         _apply(gossip_a, [type_b])
         _apply(gossip_b, [create_a])
         _apply(gossip_a, [create_b])
-        a_dev = reg_a.get_device_by_token("cc")
-        b_dev = reg_b.get_device_by_token("cc")
         # strict LWW: the t2 create wins content on BOTH hosts
-        assert a_dev.comments == "from-B"
-        assert b_dev.comments == "from-B"
-        # created_date converges on the minimum
-        assert a_dev.created_date == 1_000
-        assert b_dev.created_date == 1_000
+        assert reg_a.get_device_by_token("cc").comments == "from-B"
+        assert reg_b.get_device_by_token("cc").comments == "from-B"
+        # at-least-once: redeliver the LOSING create to the winner's
+        # host (and both creates everywhere) — verdicts must not change
+        for _ in range(2):
+            _apply(gossip_b, [create_a])
+            _apply(gossip_a, [create_b])
+            _apply(gossip_a, [create_a])
+        assert reg_a.get_device_by_token("cc").comments == "from-B"
+        assert reg_b.get_device_by_token("cc").comments == "from-B"
 
-    def test_stale_stamp_does_not_end_claim(self):
+    def test_stale_message_does_not_end_claim(self):
         from sitewhere_tpu.errors import DuplicateTokenError
 
         _, reg_b, gossip_b, cap_b = _host("claim-b")
@@ -418,8 +424,8 @@ class TestCreateCreateRace:
         device.created_date = 5_000
         reg_a.create_device(device)
         _apply(gossip_b, cap_a.drain())  # B holds an unclaimed replica
-        # a stale message with an OLDER created_date arrives: adjusts the
-        # stamp but must NOT end B's claim window
+        # a STALE message arrives (older stamp): skipped, and it must
+        # not end B's claim window
         import msgpack as _mp
 
         reg_a.update_device("cl", {"comments": "v1"})  # produce a payload
@@ -427,7 +433,6 @@ class TestCreateCreateRace:
         payload["entity"] = dict(payload["entity"], created_date=1_000,
                                  updated_date=1)  # stale stamp
         _apply(gossip_b, [_mp.packb(payload, use_bin_type=True)])
-        assert reg_b.get_device_by_token("cl").created_date == 1_000
         # the claim survives: an identical local create still merges
         dt_b = reg_b.device_types.get_by_token("ct")
         merged = reg_b.create_device(Device(token="cl",

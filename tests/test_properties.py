@@ -226,3 +226,88 @@ class TestBusBulkProperties:
             rs = ts_.partitions[p].read(0, 10_000)
             assert [(o, k, v) for o, k, v, _ in rb] == \
                    [(o, k, v) for o, k, v, _ in rs]
+
+
+class TestPackedWireProperties:
+    """Packed 3-row wire (ops/pack.py WIRE_ROWS_PACKED): for EVERY
+    eligible batch — arbitrary base (incl. negative rebased values),
+    arbitrary in-window deltas, arbitrary f32 payloads — host pack,
+    native/numpy unpack, and device decode are the identity on valid
+    rows, and the variant choice itself is correct."""
+
+    @given(st.integers(11, 96),
+           st.integers(-(2 ** 31) + 2 ** 17, 2 ** 31 - 2 ** 17),
+           st.integers(0, 2 ** 31 - 1))
+    @settings(max_examples=40, deadline=None)
+    def test_packed_roundtrip(self, n, base, seed):
+        from sitewhere_tpu.ops.pack import (
+            WIRE_ROWS_PACKED, batch_to_blob, blob_to_batch_np,
+            empty_batch, wire_variant_for)
+
+        rng = np.random.default_rng(seed)
+        et = np.where(rng.integers(0, 2, n) > 0, 2, 0).astype(np.int32)
+        is_meas = et == 0
+        batch = empty_batch(n).replace(
+            device_idx=rng.integers(0, 2 ** 20, n).astype(np.int32),
+            event_type=et,
+            ts=(base + rng.integers(0, 2 ** 16, n)).astype(np.int32),
+            mm_idx=np.where(is_meas, rng.integers(0, 4096, n),
+                            0).astype(np.int32),
+            value=np.where(
+                is_meas,
+                rng.normal(size=n) * 10.0 ** rng.integers(-20, 20, n),
+                0).astype(np.float32),
+            alert_type_idx=np.where(et == 2, rng.integers(0, 4096, n),
+                                    0).astype(np.int32),
+            alert_level=rng.integers(0, 6, n).astype(np.int32),
+            valid=rng.integers(0, 2, n).astype(bool))
+        rows, _ = wire_variant_for(batch)
+        assert rows == WIRE_ROWS_PACKED
+        decoded = blob_to_batch_np(batch_to_blob(batch))
+        valid = np.asarray(batch.valid)
+        np.testing.assert_array_equal(np.asarray(decoded.valid), valid)
+        for name in ("device_idx", "event_type", "ts", "mm_idx",
+                     "value", "alert_type_idx", "alert_level"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(decoded, name))[valid],
+                np.asarray(getattr(batch, name))[valid], err_msg=name)
+
+    @given(st.integers(11, 48), st.integers(0, 2 ** 31 - 1))
+    @settings(max_examples=20, deadline=None)
+    def test_variant_choice_is_sound(self, n, seed):
+        """Whatever variant wire_variant_for picks, the round-trip is
+        lossless for well-formed batches — the decision can be wrong
+        only by being SLOWER, never by corrupting data."""
+        from sitewhere_tpu.ops.pack import (
+            batch_to_blob, blob_to_batch_np, empty_batch)
+
+        rng = np.random.default_rng(seed)
+        et = rng.integers(0, 3, n).astype(np.int32)
+        is_meas, is_loc = et == 0, et == 1
+        batch = empty_batch(n).replace(
+            device_idx=rng.integers(0, 2 ** 20, n).astype(np.int32),
+            event_type=et,
+            ts=rng.integers(-2 ** 30, 2 ** 30, n).astype(np.int32),
+            mm_idx=np.where(is_meas, rng.integers(0, 4096, n),
+                            0).astype(np.int32),
+            value=np.where(is_meas, rng.normal(size=n),
+                           0).astype(np.float32),
+            lat=np.where(is_loc, rng.uniform(-90, 90, n),
+                         0).astype(np.float32),
+            lon=np.where(is_loc, rng.uniform(-180, 180, n),
+                         0).astype(np.float32),
+            elevation=np.where(
+                is_loc & (rng.integers(0, 2, n) > 0),
+                rng.normal(size=n), 0).astype(np.float32),
+            alert_type_idx=np.where(et == 2, rng.integers(0, 4096, n),
+                                    0).astype(np.int32),
+            alert_level=rng.integers(0, 6, n).astype(np.int32),
+            valid=rng.integers(0, 2, n).astype(bool))
+        decoded = blob_to_batch_np(batch_to_blob(batch))
+        valid = np.asarray(batch.valid)
+        for name in ("device_idx", "event_type", "ts", "mm_idx", "value",
+                     "lat", "lon", "elevation", "alert_type_idx",
+                     "alert_level"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(decoded, name))[valid],
+                np.asarray(getattr(batch, name))[valid], err_msg=name)
