@@ -84,11 +84,45 @@ def _apply_rule_config(instance, cfg) -> None:
     from sitewhere_tpu.pipeline.engine import rule_from_dict
 
     for data in rules:
+        if data.get("type") == "scripted":
+            _apply_scripted_rule(instance, dict(data))
+            continue
         kind, rule = rule_from_dict(dict(data))
         # upsert: config wins over a restored checkpoint's copy of the
         # same token (restore_on_boot runs inside instance.start(),
         # BEFORE this) without duplicating it
         engine.upsert_rule(kind, rule)
+
+
+def _apply_scripted_rule(instance, data: dict) -> None:
+    """Install a config-declared script-backed rule processor on a tenant
+    engine (the reference's Groovy ZoneTest-style processors, spring-wired
+    there; declared in the same `rules` config list here)."""
+    from sitewhere_tpu.errors import SiteWhereError
+    from sitewhere_tpu.rules import ScriptedRuleProcessor
+    from sitewhere_tpu.runtime.scripts import GLOBAL_SCOPE
+
+    token = data.get("token") or ""
+    script_id = data.get("script") or ""
+    if not token or not script_id:
+        raise SiteWhereError("scripted rules require 'token' and 'script'")
+    tenant = data.get("tenant") or instance._default_tenant or "default"
+    engine = instance.get_tenant_engine(tenant)
+    if engine is None:
+        raise SiteWhereError(f"scripted rule {token!r}: unknown tenant "
+                             f"{tenant!r}")
+    if engine.rule_processors.get_processor(token) is not None:
+        return  # idempotent reboot
+    try:
+        handler = instance.script_manager.resolve(tenant, script_id,
+                                                  "process",
+                                                  require_entry=True)
+    except Exception:
+        handler = instance.script_manager.resolve(GLOBAL_SCOPE, script_id,
+                                                  "process",
+                                                  require_entry=True)
+    engine.rule_processors.add_processor(
+        ScriptedRuleProcessor(token, handler, script_id=script_id))
 
 
 def cmd_assemble_checkpoint(args) -> int:

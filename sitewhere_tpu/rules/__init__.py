@@ -2,7 +2,7 @@
 
 from sitewhere_tpu.rules.processor import (
     RuleProcessor, RuleProcessorHost, RuleProcessorsManager,
-    ZoneTestRuleProcessor)
+    ScriptedRuleProcessor, ZoneTestRuleProcessor)
 
 __all__ = ["RuleProcessor", "RuleProcessorHost", "RuleProcessorsManager",
-           "ZoneTestRuleProcessor"]
+           "ScriptedRuleProcessor", "ZoneTestRuleProcessor"]

@@ -100,6 +100,30 @@ class RuleProcessorHost(LifecycleComponent):
                                  self.processor.processor_id)
 
 
+class ScriptedRuleProcessor(RuleProcessor):
+    """User-script rule processor (the reference's Groovy rule processor
+    role): every enriched event dispatches to the script's entry callable
+    `(context, event)`. Wired from the rule management surface with a
+    hot-swappable ScriptManager proxy (runtime/scripts.py resolve), so
+    activating a new script version retargets live processors.
+
+    HOST-LOCAL and non-durable by design: the processor wraps a live
+    Python callable on THIS process; it re-installs from config at boot
+    (`__main__._apply_scripted_rule`) but, unlike fused rules, is not
+    checkpointed or gossiped. `script_id` records which script backs it
+    (operator audit surface)."""
+
+    def __init__(self, processor_id: str, handler,
+                 script_id: str = ""):
+        super().__init__(processor_id)
+        self.handler = handler
+        self.script_id = script_id
+
+    def process(self, context: DeviceEventContext,
+                event: DeviceEvent) -> None:
+        self.handler(context, event)
+
+
 class RuleProcessorsManager(LifecycleComponent):
     """Hosts all rule processors of one tenant (RuleProcessorsManager)."""
 
@@ -112,10 +136,63 @@ class RuleProcessorsManager(LifecycleComponent):
         self.hosts: List[RuleProcessorHost] = []
 
     def add_processor(self, processor: RuleProcessor) -> RuleProcessorHost:
+        """Install a processor; atomic duplicate-id check, and live start
+        when the manager is running (REST rule management). A failed live
+        start rolls the install back so a retry is not met with a
+        duplicate error for a rule that never ran. Mutations hold the
+        component _lock — lifecycle start/stop iterate _nested under it."""
+        from sitewhere_tpu.errors import DuplicateTokenError
+
         host = RuleProcessorHost(self.bus, processor, self.tenant, self.naming)
-        self.hosts.append(host)
-        self.add_nested(host)
+        with self._lock:
+            if any(h.processor.processor_id == processor.processor_id
+                   for h in self.hosts):
+                raise DuplicateTokenError(
+                    f"rule processor '{processor.processor_id}' already "
+                    f"exists")
+            self.hosts.append(host)
+            self._nested.append(host)
+            if host.tenant_id is None:  # add_nested's propagation
+                host.tenant_id = self.tenant_id
+            live = self.is_running()
+        if live:
+            try:
+                host.start()
+            except Exception:
+                with self._lock:
+                    if host in self.hosts:
+                        self.hosts.remove(host)
+                    if host in self._nested:
+                        self._nested.remove(host)
+                raise
         return host
+
+    def get_processor(self, processor_id: str) -> Optional[RuleProcessor]:
+        with self._lock:
+            for host in self.hosts:
+                if host.processor.processor_id == processor_id:
+                    return host.processor
+        return None
+
+    def list_processors(self) -> List[RuleProcessorHost]:
+        with self._lock:
+            return list(self.hosts)
+
+    def remove_processor(self, processor_id: str) -> bool:
+        """Stop + detach one processor's host (live uninstall)."""
+        with self._lock:
+            target = None
+            for host in self.hosts:
+                if host.processor.processor_id == processor_id:
+                    target = host
+                    break
+            if target is None:
+                return False
+            self.hosts.remove(target)
+            if target in self._nested:
+                self._nested.remove(target)
+        target.stop()  # outside the lock: stop joins consumer threads
+        return True
 
 
 def point_in_polygon(lat: float, lon: float,

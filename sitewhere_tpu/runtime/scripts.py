@@ -363,8 +363,15 @@ class ScriptManager(LifecycleComponent):
                 ErrorCode.GENERIC)
         return fn
 
-    def resolve(self, scope: str, script_id: str, entry: str) -> Callable:
+    def resolve(self, scope: str, script_id: str, entry: str,
+                require_entry: bool = False) -> Callable:
         """A stable callable dispatching to the ACTIVE version's `entry`
-        function — survives later activations (hot swap)."""
+        function — survives later activations (hot swap).
+        ``require_entry`` additionally fail-fasts when the CURRENT active
+        version does not define a callable `entry` (callers installing
+        long-lived consumers want a 4xx at install time, not a silently
+        dead component)."""
         self.get_script(scope, script_id)  # fail fast on unknown id
+        if require_entry:
+            self._active_entry((scope, script_id), entry)
         return _ScriptProxy(self, scope, script_id, entry)

@@ -229,25 +229,89 @@ def register_all(router: Router, instance, server) -> None:
         from sitewhere_tpu.pipeline.engine import rule_to_dict
 
         rules = _pipeline_engine().list_rules()
-        return {kind: [rule_to_dict(kind, rule) for rule in rule_list]
-                for kind, rule_list in rules.items()}
+        out = {kind: [rule_to_dict(kind, rule) for rule in rule_list]
+               for kind, rule_list in rules.items()}
+        out["scripted"] = _list_scripted(request)
+        return out
+
+    def _scripted_rules(request: Request):
+        """The REQUEST tenant's host-side rule processors (the scripted
+        extension point; fused rules are instance-level)."""
+        return _engine(request).rule_processors
 
     def create_pipeline_rule(request: Request):
         from sitewhere_tpu.pipeline.engine import rule_from_dict, rule_to_dict
 
+        body = _body(request)
+        if body.get("type") == "scripted":
+            return _create_scripted_rule(request, body)
         engine = _pipeline_engine()
-        kind, rule = rule_from_dict(_body(request))
+        kind, rule = rule_from_dict(body)
+        from sitewhere_tpu.errors import DuplicateTokenError
+
+        # one token namespace across fused AND scripted rules
+        if _scripted_rules(request).get_processor(rule.token) is not None:
+            raise DuplicateTokenError(f"rule '{rule.token}' already exists")
         engine.create_rule(kind, rule)  # atomic duplicate-token check
         return rule_to_dict(kind, rule)
+
+    def _create_scripted_rule(request: Request, body: Dict):
+        """Install a script-backed rule processor on the request tenant
+        (the reference's Groovy rule processor, configured live instead
+        of via spring restart). `script` names a ScriptManager script
+        whose active version defines `process(context, event)` — verified
+        at install time — and the resolve proxy hot-swaps on version
+        activation. HOST-LOCAL and non-durable (unlike fused rules):
+        declare it in config for boot persistence; in a cluster install
+        it on every host that should run it."""
+        from sitewhere_tpu.errors import DuplicateTokenError
+        from sitewhere_tpu.rules import ScriptedRuleProcessor
+        from sitewhere_tpu.runtime.scripts import GLOBAL_SCOPE
+
+        token = body.get("token") or ""
+        script_id = body.get("script") or ""
+        if not token or not script_id:
+            raise SiteWhereError(
+                "scripted rules require 'token' and 'script'",
+                http_status=400)
+        # one token namespace across fused AND scripted rules
+        if instance.pipeline_engine is not None \
+                and instance.pipeline_engine.get_rule(token)[0] is not None:
+            raise DuplicateTokenError(f"rule '{token}' already exists")
+        scripts = instance.script_manager
+        tenant_scope = request.tenant or "default"
+        try:
+            handler = scripts.resolve(tenant_scope, script_id, "process",
+                                      require_entry=True)
+        except Exception:
+            handler = scripts.resolve(GLOBAL_SCOPE, script_id, "process",
+                                      require_entry=True)
+        # add_processor is the atomic duplicate check for scripted tokens
+        _scripted_rules(request).add_processor(
+            ScriptedRuleProcessor(token, handler, script_id=script_id))
+        return {"type": "scripted", "token": token, "script": script_id,
+                "scope": "host-local"}
+
+    def _list_scripted(request: Request):
+        return [{"type": "scripted",
+                 "token": host.processor.processor_id,
+                 "script": getattr(host.processor, "script_id", ""),
+                 "active": host.is_running()}
+                for host in _scripted_rules(request).list_processors()]
 
     def get_pipeline_rule(request: Request):
         from sitewhere_tpu.pipeline.engine import rule_to_dict
 
-        kind, rule = _pipeline_engine().get_rule(request.params["token"])
+        token = request.params["token"]
+        kind, rule = _pipeline_engine().get_rule(token)
         if kind is None:
-            raise NotFoundError(
-                f"rule '{request.params['token']}' not found",
-                ErrorCode.GENERIC)
+            processor = _scripted_rules(request).get_processor(token)
+            if processor is not None:
+                return {"type": "scripted", "token": token,
+                        "script": getattr(processor, "script_id", ""),
+                        "scope": "host-local"}
+            raise NotFoundError(f"rule '{token}' not found",
+                                ErrorCode.GENERIC)
         return rule_to_dict(kind, rule)
 
     def delete_pipeline_rule(request: Request):
@@ -257,6 +321,8 @@ def register_all(router: Router, instance, server) -> None:
         token = request.params["token"]
         kind, rule = engine.get_rule(token)
         if kind is None or not engine.remove_rule(token):
+            if _scripted_rules(request).remove_processor(token):
+                return {"type": "scripted", "token": token}
             raise NotFoundError(f"rule '{token}' not found",
                                 ErrorCode.GENERIC)
         return rule_to_dict(kind, rule)

@@ -304,3 +304,103 @@ class TestRuleEngineContract:
                                                       threshold=2.0))
         rules = engine.list_rules()["threshold"]
         assert len(rules) == 1 and rules[0].threshold == 2.0
+
+
+class TestScriptedRules:
+    def test_scripted_rule_over_rest_fires(self, rig):
+        """POST a scripted rule (the Groovy-processor role): its script
+        sees every enriched event; deleting the rule detaches it live."""
+        instance, _rest, client = rig
+        from sitewhere_tpu.runtime.scripts import GLOBAL_SCOPE
+
+        hits = []
+        instance.script_manager.create_script(
+            GLOBAL_SCOPE, "tag-hot", "def process(context, event):\n"
+            "    _HITS.append((context.device_token,\n"
+            "                  type(event).__name__))\n",
+            activate=True)
+        # inject the capture list into the active namespace (tests only)
+        instance.script_manager._namespaces[
+            (GLOBAL_SCOPE, "tag-hot")]["_HITS"] = hits
+
+        client.create_device_type({"token": "sdt", "name": "S"})
+        client.create_device({"token": "sdev",
+                              "device_type_token": "sdt"})
+        client.create_assignment({"token": "sas", "device_token": "sdev"})
+        client.post("/api/rules", {"type": "scripted",
+                                   "token": "tagger",
+                                   "script": "tag-hot"})
+        listed = client.get("/api/rules")
+        assert any(r["token"] == "tagger" for r in listed["scripted"])
+        assert client.get("/api/rules/tagger")["type"] == "scripted"
+
+        instance.bus.publish(
+            instance.naming.event_source_decoded_events("default"),
+            b"sdev",
+            msgpack.packb({"sourceId": "t", "deviceToken": "sdev",
+                           "kind": "DeviceEventBatch",
+                           "request": _asdict_event_batch(),
+                           "metadata": {}}, use_bin_type=True))
+        # a fresh consumer group replays the enriched topic from the
+        # beginning (at-least-once), so earlier rig events arrive too —
+        # wait for OUR device's hit specifically
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline \
+                and not any(h[0] == "sdev" for h in hits):
+            time.sleep(0.1)
+        assert ("sdev", "DeviceMeasurement") in hits
+
+        gone = client.delete("/api/rules/tagger")
+        assert gone["type"] == "scripted"
+        listed = client.get("/api/rules")
+        assert not any(r["token"] == "tagger"
+                       for r in listed["scripted"])
+
+    def test_scripted_contract_hardening(self, rig):
+        """Shared token namespace with fused rules, install-time entry
+        validation, and script-id audit in GET/list."""
+        instance, _rest, client = rig
+        from sitewhere_tpu.client.rest import SiteWhereClientError
+        from sitewhere_tpu.runtime.scripts import GLOBAL_SCOPE
+
+        instance.script_manager.create_script(
+            GLOBAL_SCOPE, "noop-rule",
+            "def process(context, event):\n    pass\n", activate=True)
+        instance.script_manager.create_script(
+            GLOBAL_SCOPE, "no-entry",
+            "def other(context, event):\n    pass\n", activate=True)
+        # entry validation at install time, not silently-dead at runtime
+        with pytest.raises(SiteWhereClientError):
+            client.post("/api/rules", {"type": "scripted", "token": "bad",
+                                       "script": "no-entry"})
+        # fused + scripted share one token namespace, both directions
+        client.post("/api/rules", {"type": "threshold", "token": "ns1",
+                                   "operator": ">", "threshold": 1.0})
+        with pytest.raises(SiteWhereClientError):
+            client.post("/api/rules", {"type": "scripted", "token": "ns1",
+                                       "script": "noop-rule"})
+        client.post("/api/rules", {"type": "scripted", "token": "ns2",
+                                   "script": "noop-rule"})
+        with pytest.raises(SiteWhereClientError):
+            client.post("/api/rules", {"type": "threshold", "token": "ns2",
+                                       "operator": ">", "threshold": 2.0})
+        # audit: GET and list report the backing script
+        got = client.get("/api/rules/ns2")
+        assert got["script"] == "noop-rule"
+        listed = client.get("/api/rules")["scripted"]
+        assert any(r["token"] == "ns2" and r["script"] == "noop-rule"
+                   for r in listed)
+        client.delete("/api/rules/ns1")
+        client.delete("/api/rules/ns2")
+
+
+def _asdict_event_batch():
+    from sitewhere_tpu.model.common import _asdict
+    from sitewhere_tpu.model.event import (
+        DeviceEventBatch, DeviceMeasurement)
+
+    return _asdict(DeviceEventBatch(
+        device_token="sdev",
+        measurements=[DeviceMeasurement(name="s1", value=7.0,
+                                        event_date=int(time.time() * 1000))]))
+
