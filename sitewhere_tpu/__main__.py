@@ -136,6 +136,26 @@ def cmd_assemble_checkpoint(args) -> int:
     return 0
 
 
+def _install_stop_handlers(stop: Optional[threading.Event] = None
+                           ) -> threading.Event:
+    """SIGINT/SIGTERM set `stop` for a graceful serve-loop exit; the
+    handler then restores the DEFAULT disposition, so a second signal
+    force-exits — a boot hung inside a blocking call (unreachable
+    cluster coordinator, stuck replay) stays killable with a repeated
+    Ctrl+C / SIGTERM. Re-call with the same event after
+    jax.distributed.initialize, which installs its own handlers over
+    ours."""
+    stop = stop or threading.Event()
+
+    def _sig(signum, _frame):
+        stop.set()
+        signal.signal(signum, signal.SIG_DFL)
+
+    signal.signal(signal.SIGINT, _sig)
+    signal.signal(signal.SIGTERM, _sig)
+    return stop
+
+
 def _parse_peers(spec: Optional[str]) -> dict:
     """'0=hostA:9092,1=hostB:9092' -> {0: ("hostA", 9092), ...}."""
     out = {}
@@ -181,6 +201,11 @@ def cmd_serve(args) -> int:
     if coordinator:
         return _serve_cluster(cfg)
 
+    # graceful-shutdown handlers BEFORE the (slow) boot: a SIGTERM that
+    # lands mid-boot or in the window right after the serving banner must
+    # stop the loop and exit 0, never die on the default handler
+    stop = _install_stop_handlers()
+
     instance = _build_instance(cfg)
     instance.start()
     _apply_rule_config(instance, cfg)
@@ -196,20 +221,14 @@ def cmd_serve(args) -> int:
                                port=int(edge_port))
         bus_server.start()
 
-    print(f"sitewhere-tpu instance '{instance.instance_id}' serving")
-    print(f"  REST gateway : {rest.base_url}")
-    print(f"  OpenAPI doc  : {rest.base_url}/api/openapi.json")
+    print(f"sitewhere-tpu instance '{instance.instance_id}' serving",
+          flush=True)
+    print(f"  REST gateway : {rest.base_url}", flush=True)
+    print(f"  OpenAPI doc  : {rest.base_url}/api/openapi.json", flush=True)
     if bus_server is not None:
         print(f"  bus edge     : tcp://{cfg.get('api.host')}:"
-              f"{bus_server.port}")
+              f"{bus_server.port}", flush=True)
 
-    stop = threading.Event()
-
-    def _sig(_signum, _frame):
-        stop.set()
-
-    signal.signal(signal.SIGINT, _sig)
-    signal.signal(signal.SIGTERM, _sig)
     try:
         while not stop.wait(1.0):
             pass
@@ -232,10 +251,17 @@ def _serve_cluster(cfg) -> int:
         initialize, make_global_mesh)
     from sitewhere_tpu.web.server import RestServer
 
+    # handlers before the (very slow) distributed boot — see cmd_serve
+    stop = _install_stop_handlers()
+
     process_id = int(cfg.get("cluster.process_id"))
     num_processes = int(cfg.get("cluster.num_processes"))
     initialize(coordinator_address=cfg.get("cluster.coordinator"),
                num_processes=num_processes, process_id=process_id)
+    # jax.distributed.initialize installs its own signal handling:
+    # re-assert ours immediately so a SIGTERM during the rest of the
+    # (slow) boot still reaches the stop event
+    _install_stop_handlers(stop)
     mesh = make_global_mesh()
     instance = _build_instance(cfg, mesh=mesh)
     peers = _parse_peers(cfg.get("cluster.peers"))
@@ -270,13 +296,10 @@ def _serve_cluster(cfg) -> int:
     print(f"  mesh         : {mesh.devices.size} shards over "
           f"{num_processes} hosts", flush=True)
 
-    stop = threading.Event()
-
-    def _sig(_signum, _frame):
-        stop.set()
-
-    signal.signal(signal.SIGINT, _sig)
-    signal.signal(signal.SIGTERM, _sig)
+    # belt-and-braces: nothing later in boot is known to stomp the
+    # handlers, but re-asserting next to the serve loop keeps the
+    # shutdown contract local and obvious
+    _install_stop_handlers(stop)
     try:
         while not stop.wait(1.0):
             if cluster.loop.fatal is not None:

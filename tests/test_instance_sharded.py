@@ -72,8 +72,10 @@ def test_rest_ingest_through_sharded_step(sharded_instance):
                                          }, use_bin_type=True))
 
         # generous under full-suite load: one CPU core shared with
-        # consumer threads and possible first-compile of the step
-        deadline = time.monotonic() + 90
+        # consumer threads and possible first-compile of the step (the
+        # 90s margin still flaked ~1-in-10 full-suite runs under
+        # concurrent bench/compile load)
+        deadline = time.monotonic() + 240
         while time.monotonic() < deadline:
             if engine.batches_processed > 0:
                 counts = np.asarray(engine._state.tenant_event_count).sum()
@@ -85,7 +87,7 @@ def test_rest_ingest_through_sharded_step(sharded_instance):
 
         # threshold fired for values > 50 (i >= 4): alerts persisted back
         events = sharded_instance.get_tenant_engine("default")
-        deadline = time.monotonic() + 60
+        deadline = time.monotonic() + 120
         n_alerts = 0
         while time.monotonic() < deadline:
             hits = client.get("/api/assignments/sas-9/alerts")
