@@ -44,11 +44,20 @@ watchdog makes this deterministic instead of hang-forever: a peer stale
 past `fail_after_s` exits the process with a distinct code for the
 supervisor to restart the gang.
 
-**Registry scope (documented limitation).** Control-plane writes (devices,
-zones, rules) apply to the host that received them; a cluster deployment
-provisions every host identically (same bootstrap/templates, or replayed
-admin calls). Events for devices a host has never seen intern to UNKNOWN
-and surface on the unregistered path rather than corrupting anything.
+**Registry scope.** Control-plane writes replicate cluster-wide via
+leaderless gossip (`RegistryGossip` below): every registry kind —
+device types/commands/statuses, devices, assignments, area types/areas/
+zones, customer types/customers, groups/elements, alarms — plus
+deletions (tombstones) and fused-rule mutations, broadcast to every
+peer's bus edge and applied idempotently with last-writer-wins ordering
+(update stamp, host-independent content-digest tiebreak). References
+travel by token; a multi-pass applier plus at-least-once redelivery
+absorbs cross-entity reordering. Residual limits: tenant/user
+provisioning still rides identical boot templates (mutations of those
+kinds are not gossiped), scripted rule processors are host-local and
+non-durable (rules/processor.py), and events for devices whose gossip has not
+yet arrived intern to UNKNOWN and surface on the unregistered path
+during the convergence window rather than corrupting anything.
 """
 
 from __future__ import annotations
