@@ -1,0 +1,167 @@
+"""Perf gate (perf_gate.py): the mechanical ratio comparison SURVEY §7
+step 8 calls for. The gate must cancel tunnel state (ratios, not
+absolutes), tolerate one anomalous recorded round, accept every recorded
+file layout the driver produces, and flag intra-run inconsistency
+(VERDICT r4: sync_total 16.7 ms vs 3.1 ms of parts went unflagged)."""
+
+import json
+
+import pytest
+
+from perf_gate import (
+    compare, extract_bench, gate_against_recorded, main, ratios_of,
+    self_consistency)
+
+
+def _bench(headline=40e6, telemetry=44e6, sharded=36e6, persist=8e6,
+           multitenant=34e6, analytics=10e6, compute=600e6,
+           unaccounted_pct=5.0, spreads=None):
+    return {
+        "metric": "events/sec ...", "value": headline,
+        "telemetry_packed_events_per_sec": telemetry,
+        "sharded_1chip_events_per_sec": sharded,
+        "persist_events_per_sec": persist,
+        "multitenant_sharded_events_per_sec": multitenant,
+        "analytics_replay_events_per_sec": analytics,
+        "compute_only_events_per_sec": compute,
+        "step_breakdown": {"unaccounted_pct": unaccounted_pct},
+        "spread_pct": spreads or {"headline": 8.0},
+    }
+
+
+def test_extract_bench_raw_parsed_and_tail_layouts():
+    raw = _bench()
+    assert extract_bench(raw) is raw
+    assert extract_bench({"parsed": raw, "rc": 0}) is raw
+    tail = "WARNING: noise\n" + json.dumps(raw) + "\n"
+    got = extract_bench({"tail": tail, "rc": 0})
+    assert got["value"] == raw["value"]
+    # garbage after the result line: the LAST parseable bench line wins
+    got = extract_bench({"tail": tail + "{not json\n"})
+    assert got["value"] == raw["value"]
+    assert extract_bench({"tail": "no json here"}) is None
+    assert extract_bench({"rc": 1}) is None
+
+
+def test_ratios_cancel_tunnel_scale():
+    # a slower link scales every tunnel-transfer-bound section together;
+    # the gated ratios are between exactly those sections, so they cancel
+    fast, slow = _bench(), _bench()
+    for key in ("value", "telemetry_packed_events_per_sec",
+                "sharded_1chip_events_per_sec",
+                "multitenant_sharded_events_per_sec"):
+        slow[key] = slow[key] * 0.4
+    assert ratios_of(fast) == pytest.approx(ratios_of(slow))
+    assert compare(fast, slow, tol=0.05)["ok"]
+
+
+def test_compare_flags_shape_change():
+    prev = _bench()
+    cur = _bench(sharded=36e6 * 0.6)  # sharded regressed 40% vs headline
+    out = compare(prev, cur, tol=0.25)
+    assert not out["ok"]
+    # both ratios involving the sharded rate move past tolerance
+    assert set(out["failures"]) == {"sharded_vs_headline",
+                                    "multitenant_vs_sharded"}
+    assert out["ratios"]["sharded_vs_headline"]["drift_pct"] == -40.0
+
+
+def test_compare_absolute_host_sections():
+    # persist never touches the tunnel: judged absolutely, not vs headline
+    prev = _bench()
+    out = compare(prev, _bench(persist=8e6 * 0.5))
+    assert not out["ok"]
+    assert out["failures"] == ["persist_events_per_sec"]
+    assert out["absolutes"]["persist_events_per_sec"]["drift_pct"] == -50.0
+    # a uniformly slower tunnel does NOT move the absolute host sections
+    slow = _bench(headline=40e6 * 0.4, telemetry=44e6 * 0.4,
+                  sharded=36e6 * 0.4, multitenant=34e6 * 0.4)
+    assert compare(prev, slow, tol=0.05)["ok"]
+    # compute_only mixes resource domains: never part of the gate
+    assert compare(prev, _bench(compute=600e6 * 3.0))["ok"]
+
+
+def test_self_consistency_breakdown_and_spread():
+    assert self_consistency(_bench())["ok"]
+    bad = self_consistency(_bench(unaccounted_pct=80.0))
+    assert not bad["ok"]
+    assert not bad["checks"]["breakdown_explains_sync_total"]["ok"]
+    wild = self_consistency(_bench(spreads={"headline": 75.0}))
+    assert not wild["ok"]
+    assert wild["checks"]["trial_spread_bounded"]["wild"] == {
+        "headline": 75.0}
+    # a bench with no breakdown/spread fields (old rounds) has nothing to
+    # check and must not crash
+    assert self_consistency({"value": 1.0})["ok"]
+
+
+def test_gate_accepts_either_of_last_two_rounds(tmp_path):
+    # r03 is a healthy round; r04 is the anomalous one (sharded ratio
+    # collapsed). A current run matching r03's shape must PASS even though
+    # it drifts >tol from r04 — one bad round must not poison the gate.
+    (tmp_path / "BENCH_r03.json").write_text(json.dumps(
+        {"parsed": _bench()}))
+    (tmp_path / "BENCH_r04.json").write_text(json.dumps(
+        {"parsed": _bench(sharded=36e6 * 0.6)}))
+    gate = gate_against_recorded(_bench(), root=str(tmp_path))
+    assert gate["ok"]
+    assert not gate["vs_recorded"]["r04"]["ok"]
+    assert gate["vs_recorded"]["r03"]["ok"]
+    # drifted from BOTH -> fail
+    gate = gate_against_recorded(_bench(persist=8e6 * 3.0),
+                                 root=str(tmp_path))
+    assert not gate["ok"]
+
+
+def test_gate_with_no_recorded_rounds_passes_on_consistency_alone(tmp_path):
+    assert gate_against_recorded(_bench(), root=str(tmp_path))["ok"]
+    assert not gate_against_recorded(
+        _bench(unaccounted_pct=60.0), root=str(tmp_path))["ok"]
+
+
+def test_scale_mismatch_skips_ratio_comparison(tmp_path):
+    # A BENCH_SCALE=small smoke must never be judged against a recorded
+    # full-scale round — the metric string embeds the workload config.
+    full = _bench()
+    small = _bench(sharded=36e6 * 0.3)
+    small["metric"] = "events/sec ... (fused step, 2000 devices, batch 2048)"
+    out = compare(full, small)
+    assert out["ok"] and out["skipped"] == "scale_mismatch"
+    (tmp_path / "BENCH_r04.json").write_text(json.dumps({"parsed": full}))
+    gate = gate_against_recorded(small, root=str(tmp_path))
+    # fails OPEN but visibly: ok without compared means no drift check ran
+    assert gate["ok"] and not gate["compared"]
+
+
+def test_gate_compared_flag_reflects_real_comparisons(tmp_path):
+    (tmp_path / "BENCH_r04.json").write_text(json.dumps(
+        {"parsed": _bench()}))
+    gate = gate_against_recorded(_bench(), root=str(tmp_path))
+    assert gate["ok"] and gate["compared"]
+    # corrupt recorded file -> fail-open, flagged
+    (tmp_path / "BENCH_r04.json").write_text("{broken")
+    gate = gate_against_recorded(_bench(), root=str(tmp_path))
+    assert gate["ok"] and not gate["compared"]
+
+
+def test_small_scale_spread_and_breakdown_not_judged():
+    noisy = _bench(spreads={"sync_total": 110.0}, unaccounted_pct=40.0)
+    noisy["scale"] = "small"
+    assert self_consistency(noisy)["ok"]
+    noisy["scale"] = "full"
+    out = self_consistency(noisy)
+    assert not out["ok"]
+    assert not out["checks"]["breakdown_explains_sync_total"]["ok"]
+    assert not out["checks"]["trial_spread_bounded"]["ok"]
+
+
+def test_cli_exit_codes(tmp_path, capsys):
+    prev, cur = tmp_path / "prev.json", tmp_path / "cur.json"
+    prev.write_text(json.dumps(_bench()))
+    cur.write_text(json.dumps(_bench()))
+    assert main([str(prev), str(cur)]) == 0
+    cur.write_text(json.dumps(_bench(sharded=36e6 * 0.5)))
+    assert main([str(prev), str(cur)]) == 1
+    assert "sharded_vs_headline" in capsys.readouterr().err
+    cur.write_text(json.dumps({"rc": 1}))
+    assert main([str(prev), str(cur)]) == 2
