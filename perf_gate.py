@@ -58,6 +58,10 @@ RATIO_KEYS: List[Tuple[str, str, str]] = [
 # absolute is the honest comparison.
 ABS_KEYS: List[str] = [
     "persist_events_per_sec",
+    # the sustained composite is persist/consumer-bound (host CPU), not
+    # tunnel-bound — same reasoning as persist: its ratio to the
+    # transfer-bound headline would track link weather
+    "system_sustained_events_per_sec",
     "sharded_1chip_router_ms_per_step",
     "query_10m_narrow_window_ms",
 ]

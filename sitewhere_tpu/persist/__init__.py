@@ -13,8 +13,9 @@ from sitewhere_tpu.persist.eventlog import ColumnarEventLog, EventFilter
 from sitewhere_tpu.persist.event_management import (
     DeviceEventManagement, EventIndex, EventPersistenceTriggers)
 from sitewhere_tpu.persist.checkpoint import PipelineCheckpointer
+from sitewhere_tpu.persist.worker import AsyncEventPersister
 
 __all__ = [
     "ColumnarEventLog", "EventFilter", "DeviceEventManagement", "EventIndex",
-    "EventPersistenceTriggers", "PipelineCheckpointer",
+    "EventPersistenceTriggers", "PipelineCheckpointer", "AsyncEventPersister",
 ]

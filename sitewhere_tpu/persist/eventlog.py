@@ -182,7 +182,9 @@ class EventFilter:
                           ("stream_id", "stream_id")):
             want = getattr(self, attr)
             if want is not None:
-                mask &= cols[col] == want
+                val = cols[col]
+                mask &= (val.eq_mask(want)
+                         if isinstance(val, _LazyTokenCol) else val == want)
         return mask
 
 
@@ -208,6 +210,9 @@ class _Segment:
         arrays = []
         for fld in _SCHEMA:
             col = self.cols[fld.name]
+            if isinstance(col, _LazyTokenCol):
+                # spill runs on the linger thread, off the append hot path
+                col = col.materialize()
             if _is_const(col) and _const_value(col) is None:
                 arrays.append(pa.nulls(len(col), type=fld.type))
             elif _is_const(col):
@@ -242,11 +247,24 @@ class _Segment:
 
 
 def _merge_col(parts: List[np.ndarray]) -> np.ndarray:
-    """Concatenate column chunks, keeping const views const: merging
-    all-None (or same-prefix) const columns must not materialize the 8n
-    bytes a const view exists to avoid."""
+    """Concatenate column chunks, keeping const views const (merging
+    all-None const columns must not materialize the 8n bytes a const view
+    exists to avoid) and lazy token chunks lazy when they share one
+    dictionary snapshot (the steady-state ingest case: the interner is not
+    growing, so `_snapshot_array` hands every chunk the same cached
+    array). Mixed or differing-snapshot chunks materialize — a restore can
+    swap same-length interner contents, so identity is the only safe
+    fast-path key."""
     if len(parts) == 1:
         return parts[0]
+    if any(isinstance(p, _LazyTokenCol) for p in parts):
+        first = next(p for p in parts if isinstance(p, _LazyTokenCol))
+        if all(isinstance(p, _LazyTokenCol) and p.snap is first.snap
+               for p in parts):
+            return _LazyTokenCol(np.concatenate([p.idx for p in parts]),
+                                 first.snap)
+        parts = [p.materialize() if isinstance(p, _LazyTokenCol) else p
+                 for p in parts]
     if all(_is_const(p) for p in parts):
         shared = next((_const_value(p) for p in parts if len(p)), None)
         if all(len(p) == 0 or _const_value(p) is shared for p in parts):
@@ -297,6 +315,65 @@ class _ColumnBuffer:
         return seg
 
 
+class _LazyTokenCol:
+    """Dictionary-encoded token column: row i reads `snap[idx[i]]` (None
+    when the index is out of the snapshot's range or the reserved slot 0 —
+    exactly `TokenInterner.token_of` semantics).
+
+    The append hot path stores only the (already-materialized) int32 index
+    column plus a reference to the interner's cached snapshot; the object
+    column of Python strings materializes lazily — at Parquet spill (linger
+    thread), or per-row/per-page at query time. Building those strings
+    eagerly was >40% of `append_batch` cost at the 131k production batch,
+    paid for rows whose tokens nobody ever reads (VERDICT r5 item 2: the
+    sustained-system rate was persist-bound). Supports exactly the access
+    patterns the log uses: len, scalar/fancy indexing, equality masking
+    (on the int dictionary — cheaper than string compares), merge, and
+    full materialization."""
+
+    __slots__ = ("idx", "snap", "_mat")
+    dtype = np.dtype(object)
+
+    def __init__(self, idx: np.ndarray, snap: np.ndarray):
+        self.idx = idx
+        self.snap = snap
+        self._mat: Optional[np.ndarray] = None
+
+    def __len__(self) -> int:
+        return len(self.idx)
+
+    def materialize(self) -> np.ndarray:
+        if self._mat is None:
+            clipped = np.clip(self.idx, 0, len(self.snap) - 1)
+            out = self.snap[clipped]
+            out[(self.idx <= 0) | (self.idx >= len(self.snap))] = None
+            self._mat = out
+        return self._mat
+
+    def __getitem__(self, key):
+        if self._mat is not None:
+            return self._mat[key]
+        if isinstance(key, (int, np.integer)):
+            i = int(self.idx[key])
+            return self.snap[i] if 0 < i < len(self.snap) else None
+        sub = self.idx[key]
+        clipped = np.clip(sub, 0, len(self.snap) - 1)
+        out = self.snap[clipped]
+        out[(sub <= 0) | (sub >= len(self.snap))] = None
+        return out
+
+    def eq_mask(self, want) -> np.ndarray:
+        """Boolean column == `want`, computed as integer compares against
+        the dictionary instead of n string compares."""
+        hits = np.nonzero(self.snap == want)[0]
+        hits = hits[hits > 0]
+        if len(hits) == 0:
+            return np.zeros(len(self.idx), bool)
+        if len(hits) == 1:
+            return self.idx == hits[0]
+        return np.isin(self.idx, hits)
+
+
 def _obj_col(n: int, value: Any = None) -> np.ndarray:
     out = np.empty(n, object)
     out[:] = value
@@ -320,7 +397,8 @@ def _const_value(col: np.ndarray) -> Any:
 
 
 def _is_const(col: np.ndarray) -> bool:
-    return col.dtype == object and col.ndim == 1 and col.strides == (0,)
+    return (isinstance(col, np.ndarray) and col.dtype == object
+            and col.ndim == 1 and col.strides == (0,))
 
 
 def _full_cols(n: int, const_strings: bool = False,
@@ -559,27 +637,6 @@ class ColumnarEventLog:
         id_seq = np.arange(base, base + n, dtype=np.int64)
         id_prefix = _const_col(n, _ID_PREFIX)
 
-        def resolve(interner, idx: np.ndarray) -> np.ndarray:
-            # Three regimes. Masking (one boolean pass per DISTINCT value)
-            # wins when few values are possible — a tiny interner
-            # (measurement names, alert types: a handful of tokens vs a
-            # 131k-row gather) or a small batch against a big interner.
-            # In between, a full interner snapshot + fancy-index gather
-            # avoids the O(U * n) blowup (quadratic at 100k devices per
-            # 131k-row batch). The object-array snapshot is cached while
-            # the interner doesn't grow (token slots are append-only, so
-            # length is a version).
-            if len(interner) <= 64 or len(interner) > 4 * n:
-                out = _obj_col(n)
-                for u in np.unique(idx):
-                    out[idx == u] = interner.token_of(int(u))
-                return out
-            snap = _snapshot_array(interner)
-            clipped = np.clip(idx, 0, len(snap) - 1)
-            out = snap[clipped]
-            out[idx >= len(snap)] = None
-            return out
-
         context_cols: Dict[str, np.ndarray] = {}
         if registry is not None:
             # one lookup per unique device, then a vectorized gather through
@@ -612,11 +669,16 @@ class ColumnarEventLog:
             id_seq=id_seq,
             event_type=event_type,
             device_idx=device_idx,
-            device_token=resolve(packer.devices, device_idx),
+            # token strings are dictionary-encoded: the idx columns are
+            # already selected above, so the string columns cost two
+            # pointer stores here and materialize off the hot path
+            device_token=_LazyTokenCol(device_idx,
+                                       _snapshot_array(packer.devices)),
             event_date=ts,
             received_date=np.full(n, now, np.int64),
             mm_idx=mm_idx,
-            mm_name=resolve(packer.measurements, mm_idx),
+            mm_name=_LazyTokenCol(mm_idx,
+                                  _snapshot_array(packer.measurements)),
             value=np.asarray(batch.value)[sel].astype(np.float32, copy=False),
             latitude=np.asarray(batch.lat)[sel].astype(np.float32, copy=False),
             longitude=np.asarray(batch.lon)[sel].astype(
@@ -626,7 +688,8 @@ class ColumnarEventLog:
             alert_level=np.asarray(batch.alert_level)[sel].astype(
                 np.int32, copy=False),
             alert_type_idx=alert_type_idx,
-            alert_type=resolve(packer.alert_types, alert_type_idx),
+            alert_type=_LazyTokenCol(alert_type_idx,
+                                     _snapshot_array(packer.alert_types)),
             **context_cols,
         )
         self.tenant(tenant).append(cols, n)

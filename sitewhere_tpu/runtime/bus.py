@@ -95,6 +95,15 @@ class TopicNaming:
     def inbound_enriched_events(self, tenant: str) -> str:
         return self._tenant(tenant, "inbound-enriched-events")
 
+    def inbound_enriched_batches(self, tenant: str) -> str:
+        """Batch-granularity enriched stream for the bulk lane: one compact
+        marker per persisted EventBatch (tenant, row count, event-date
+        span) instead of one envelope per event — consumers read the
+        referenced rows back from the columnar log. The per-event
+        `inbound_enriched_events` topic stays the control-plane-rate
+        surface; no per-event Python object survives the bulk path."""
+        return self._tenant(tenant, "inbound-enriched-batches")
+
     def inbound_enriched_command_invocations(self, tenant: str) -> str:
         return self._tenant(tenant, "inbound-enriched-command-invocations")
 

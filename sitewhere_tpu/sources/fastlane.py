@@ -166,11 +166,24 @@ class BulkWireIngestService(LifecycleComponent):
     def __init__(self, engine, eventlog=None, events=None, bus=None,
                  tenant: str = "default", naming=None, control_sink=None,
                  persist_rule_alerts: bool = True, registry=None,
-                 metrics=None):
+                 metrics=None, persist_async: bool = False,
+                 persist_depth: int = 8):
         super().__init__(f"bulk-wire-ingest:{tenant}")
         self.engine = engine
         self.lane = FastWireIngest(engine.packer)
         self.eventlog = eventlog
+        # persist_async moves the columnar append onto a writer thread
+        # (persist/worker.py, the DeviceEventBuffer role) so the durable
+        # append overlaps the next delivery's decode+step instead of
+        # serializing after it; the bounded queue backpressures ingest
+        # when the datastore is the bottleneck.
+        self.persister = None
+        if persist_async and eventlog is not None:
+            from sitewhere_tpu.persist.worker import AsyncEventPersister
+            self.persister = self.add_nested(AsyncEventPersister(
+                eventlog, engine.packer, tenant=tenant, bus=bus,
+                naming=naming, registry=registry, depth=persist_depth,
+                metrics=metrics))
         self.events = events
         self.registry = registry
         self.bus = bus
@@ -212,7 +225,9 @@ class BulkWireIngestService(LifecycleComponent):
         row = 0
         for batch in res.batches:
             alert_batch, outputs = self.engine.submit_routed(batch)
-            if self.eventlog is not None:
+            if self.persister is not None:
+                self.persister.submit(batch, self.tenant)
+            elif self.eventlog is not None:
                 self.eventlog.append_batch(self.tenant, batch,
                                            self.engine.packer,
                                            registry=self.registry)
