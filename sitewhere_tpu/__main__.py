@@ -47,6 +47,16 @@ def _build_config(config_path: Optional[str]):
 def _build_instance(cfg, mesh=None):
     from sitewhere_tpu.instance import SiteWhereInstance
 
+    mode = cfg.get("pipeline.mode") or "throughput"
+    if mode not in ("throughput", "latency"):
+        raise SystemExit(f"pipeline.mode must be 'throughput' or 'latency',"
+                         f" got {mode!r}")
+    # latency mode: the engine's compiled batch shape IS the latency
+    # lever (pack + H2D + step scale with it); ingest then flushes
+    # adaptively (pipeline/feed.py AdaptiveBatcher semantics)
+    batch_size = int(cfg.get("pipeline.latency_batch_size")
+                     if mode == "latency"
+                     else cfg.get("pipeline.batch_size"))
     return SiteWhereInstance(
         mesh=mesh,
         instance_id=cfg.get("instance.id"),
@@ -55,7 +65,7 @@ def _build_instance(cfg, mesh=None):
         max_devices=int(cfg.get("pipeline.max_devices")),
         max_zones=int(cfg.get("pipeline.max_zones")),
         max_zone_vertices=int(cfg.get("pipeline.max_zone_vertices")),
-        batch_size=int(cfg.get("pipeline.batch_size")),
+        batch_size=batch_size,
         measurement_slots=int(cfg.get("pipeline.measurement_slots")),
         max_tenants=int(cfg.get("pipeline.max_tenants")),
         bus_partitions=int(cfg.get("bus.partitions")),
@@ -66,7 +76,9 @@ def _build_instance(cfg, mesh=None):
         checkpoint_interval_s=(
             float(cfg.get("persist.checkpoint_interval_s"))
             if cfg.get("persist.checkpoint_interval_s") is not None
-            else None))
+            else None),
+        latency_linger_ms=(float(cfg.get("pipeline.linger_ms"))
+                           if mode == "latency" else None))
 
 
 def _apply_rule_config(instance, cfg) -> None:

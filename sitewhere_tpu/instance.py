@@ -54,7 +54,8 @@ class SiteWhereInstance(LifecycleComponent):
                  shards: int = 1,
                  mesh=None,
                  tenant_datastores: Optional[Dict] = None,
-                 checkpoint_interval_s: Optional[float] = None):
+                 checkpoint_interval_s: Optional[float] = None,
+                 latency_linger_ms: Optional[float] = None):
         super().__init__(f"instance:{instance_id}")
         self.instance_id = instance_id
         self.data_dir = data_dir
@@ -116,6 +117,15 @@ class SiteWhereInstance(LifecycleComponent):
                     self.registry_tensors, batch_size=batch_size,
                     measurement_slots=measurement_slots,
                     max_tenants=max_tenants)
+        # latency tier (pipeline.mode="latency"): one shared adaptive
+        # batcher coalesces every tenant's hot events and flushes on fill
+        # or linger (pipeline/feed.py) — inbound consumers offer to it
+        # instead of packing per-poll batches
+        self.latency_batcher = None
+        if latency_linger_ms is not None and self.pipeline_engine is not None:
+            from sitewhere_tpu.pipeline.feed import AdaptiveBatcher
+            self.latency_batcher = AdaptiveBatcher(
+                self.pipeline_engine, linger_ms=latency_linger_ms)
 
         # global (non-multitenant) managements — reference:
         # service-user-management / service-tenant-management
@@ -191,7 +201,7 @@ class SiteWhereInstance(LifecycleComponent):
             pipeline_engine=self.pipeline_engine,
             registry_tensors=self.registry_tensors,
             store_factory=store_factory, naming=self.naming,
-            cluster=self.cluster_hooks)
+            cluster=self.cluster_hooks, batcher=self.latency_batcher)
         self.bootstrap.apply_template(engine)
         return engine
 
@@ -220,6 +230,8 @@ class SiteWhereInstance(LifecycleComponent):
         logging.getLogger("sitewhere").removeHandler(self.log_handler)
         self.log_handler.stop()
         self.log_aggregator.stop()
+        if self.latency_batcher is not None:
+            self.latency_batcher.close()  # flushes pending offers
         self.datastores.stop()
         self.event_log.stop()
         self.bus.flush()  # durable bus logs visible to a successor instance

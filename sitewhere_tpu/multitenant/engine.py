@@ -56,7 +56,8 @@ class TenantEngine(LifecycleComponent):
 
     def __init__(self, tenant: Tenant, bus, log, pipeline_engine=None,
                  registry_tensors=None, store_factory: Optional[Callable] = None,
-                 naming: Optional[TopicNaming] = None, cluster=None):
+                 naming: Optional[TopicNaming] = None, cluster=None,
+                 batcher=None):
         super().__init__(f"tenant-engine:{tenant.token}")
         self.tenant = tenant
         self.tenant_id = tenant.token
@@ -91,7 +92,7 @@ class TenantEngine(LifecycleComponent):
         self.inbound = InboundProcessingService(
             bus, self.registry, events=self.event_management,
             engine=pipeline_engine, tenant=tenant.token, naming=self.naming,
-            cluster=cluster)
+            cluster=cluster, batcher=batcher)
         self.enrichment = PayloadEnrichment(bus, self.registry, tenant.token,
                                             self.naming)
         self.command_delivery = CommandDeliveryService(

@@ -61,6 +61,7 @@ class InboundProcessingService(LifecycleComponent):
                  naming: Optional[TopicNaming] = None,
                  persist_rule_alerts: bool = True,
                  cluster=None,
+                 batcher=None,
                  metrics: Optional[MetricsRegistry] = None):
         super().__init__(f"inbound-processing:{tenant}")
         self.bus = bus
@@ -70,6 +71,11 @@ class InboundProcessingService(LifecycleComponent):
         self.tenant = tenant
         self.naming = naming or TopicNaming()
         self.persist_rule_alerts = persist_rule_alerts
+        # latency tier (pipeline.mode="latency"): hot events route through
+        # the shared AdaptiveBatcher (pipeline/feed.py) instead of packing
+        # a per-consumer-poll batch — offers coalesce across tenants and
+        # flush on fill or linger, bounding ingest->alert wall time
+        self.batcher = batcher
         # multi-host hooks (parallel/cluster.py ClusterService): ownership
         # routing of decoded records + lockstep step-loop feeding. None =
         # single-process (direct engine submit).
@@ -215,8 +221,16 @@ class InboundProcessingService(LifecycleComponent):
         (the reference's ZoneTestRuleProcessor -> addDeviceAlerts loop)."""
         events = [e for e, _ in hot]
         tokens = [t for _, t in hot]
-        for batch in self.engine.packer.pack_events(events, tokens):
-            batch, outputs = self.engine.submit_routed(batch)
+        if self.batcher is not None:
+            # latency tier: coalesce into the shared adaptive batcher and
+            # wait for the flush (so consumer commit still means "reached
+            # device state", the same contract as the direct path)
+            pairs = self.batcher.offer(events, tokens).result(timeout=60.0)
+        else:
+            pairs = (self.engine.submit_routed(batch)
+                     for batch in self.engine.packer.pack_events(events,
+                                                                 tokens))
+        for batch, outputs in pairs:
             if not self.persist_rule_alerts or self.events is None:
                 continue
             for alert in self.engine.materialize_alerts(batch, outputs):

@@ -169,6 +169,14 @@ DEFAULTS: Dict = {
     "pipeline": {
         "enabled": True,
         "batch_size": 8192,
+        # "throughput" feeds full batches via the pipelined submitter;
+        # "latency" boots the engine at latency_batch_size and ingest
+        # flushes adaptively (fill or linger_ms) so one event's
+        # ingest->rules->alert wall time meets a p99 budget
+        # (pipeline/feed.py AdaptiveBatcher)
+        "mode": "throughput",
+        "latency_batch_size": 4096,
+        "linger_ms": 2.0,
         "max_devices": 131072,
         "max_zones": 256,
         "max_zone_vertices": 32,

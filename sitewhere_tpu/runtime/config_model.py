@@ -110,6 +110,17 @@ def pipeline_model() -> ElementModel:
         description="Fused TPU hot-path engine",
         attributes=[
             _attr("batch_size", _I, default=8192),
+            _attr("mode", choices=["throughput", "latency"],
+                  default="throughput",
+                  description="throughput: full batches via the pipelined "
+                              "feeder; latency: the engine boots at "
+                              "latency_batch_size and ingest flushes "
+                              "adaptively (fill or linger_ms) for a p99 "
+                              "ingest->alert budget"),
+            _attr("latency_batch_size", _I, default=4096),
+            _attr("linger_ms", _D, default=2.0,
+                  description="latency mode: max ms an offered event "
+                              "waits before a partial batch flushes"),
             _attr("measurement_slots", _I, default=32),
             _attr("max_tenants", _I, default=16),
             _attr("max_threshold_rules", _I, default=256),

@@ -302,3 +302,86 @@ class TestFusedRouteBatch:
         router = ShardRouter(2, 8)
         with pytest.raises(ValueError, match="wire-blob device field"):
             router.route_batch(batch)
+
+
+class TestAdaptiveBatcher:
+    """Latency-tier submitter (pipeline.mode="latency"): flush on linger
+    deadline or fill, shared flush outputs, clean close semantics."""
+
+    def _mk(self, linger_ms=30.0, batch_size=32, max_rows=None):
+        from sitewhere_tpu.pipeline.feed import AdaptiveBatcher
+        _, tensors = _world()
+        engine = _engine(tensors, batch_size=batch_size)
+        return engine, AdaptiveBatcher(engine, linger_ms=linger_ms,
+                                       max_rows=max_rows)
+
+    def test_linger_flush_and_alerts(self):
+        import time
+        engine, batcher = self._mk(linger_ms=20.0)
+        events = [DeviceMeasurement(name="m", value=150.0 + i)
+                  for i in range(4)]
+        t0 = time.perf_counter()
+        fut = batcher.offer(events, [f"d{i}" for i in range(4)])
+        pairs = fut.result(timeout=120.0)
+        waited = time.perf_counter() - t0
+        # partial batch: the flush had to come from the linger deadline
+        assert waited >= 0.015
+        assert len(pairs) == 1
+        batch, outputs = pairs[0]
+        outputs.processed.block_until_ready()
+        alerts = engine.materialize_alerts(batch, outputs)
+        assert len(alerts) == 4  # every value crosses the threshold
+        batcher.close()
+
+    def test_empty_offer_resolves_immediately(self):
+        engine, batcher = self._mk(linger_ms=10_000.0)
+        fut = batcher.offer([], [])
+        assert fut.result(timeout=1.0) == []
+        batcher.close()
+
+    def test_overflow_flush_covers_every_chunk(self):
+        # a flush larger than the engine batch packs into several batches;
+        # every chunk's (batch, outputs) must come back, or alerts in the
+        # earlier chunks would be silently lost
+        engine, batcher = self._mk(linger_ms=20.0, batch_size=8)
+        events = [DeviceMeasurement(name="m", value=150.0 + i)
+                  for i in range(20)]
+        fut = batcher.offer(events, [f"d{i % 16}" for i in range(20)])
+        pairs = fut.result(timeout=120.0)
+        assert len(pairs) == 3  # 20 events / batch 8
+        alerts = []
+        for batch, outputs in pairs:
+            outputs.processed.block_until_ready()
+            alerts.extend(engine.materialize_alerts(batch, outputs))
+        assert len(alerts) == 20
+        batcher.close()
+
+    def test_fill_flushes_before_linger(self):
+        import time
+        engine, batcher = self._mk(linger_ms=10_000.0, batch_size=8)
+        events = [DeviceMeasurement(name="m", value=1.0) for _ in range(8)]
+        t0 = time.perf_counter()
+        fut = batcher.offer(events, [f"d{i}" for i in range(8)])
+        fut.result(timeout=120.0)
+        # a full batch must not wait out the 10 s linger
+        assert time.perf_counter() - t0 < 60.0
+        batcher.close()
+
+    def test_offers_coalesce_into_one_flush(self):
+        engine, batcher = self._mk(linger_ms=60.0)
+        f1 = batcher.offer([DeviceMeasurement(name="m", value=1.0)], ["d0"])
+        f2 = batcher.offer([DeviceMeasurement(name="m", value=2.0)], ["d1"])
+        [(b1, o1)] = f1.result(timeout=120.0)
+        [(b2, o2)] = f2.result(timeout=120.0)
+        assert o1 is o2  # one fused step covered both offers
+        assert engine.batches_processed == 1
+        batcher.close()
+
+    def test_close_flushes_pending_then_refuses(self):
+        engine, batcher = self._mk(linger_ms=10_000.0)
+        fut = batcher.offer([DeviceMeasurement(name="m", value=1.0)], ["d0"])
+        batcher.close()  # pending rows must flush, not vanish
+        [(batch, outputs)] = fut.result(timeout=5.0)
+        assert outputs is not None
+        with pytest.raises(RuntimeError):
+            batcher.offer([DeviceMeasurement(name="m", value=1.0)], ["d0"])
