@@ -109,10 +109,11 @@ def _apply_rule_config(instance, cfg) -> None:
 def _apply_scripted_rule(instance, data: dict) -> None:
     """Install a config-declared script-backed rule processor on a tenant
     engine (the reference's Groovy ZoneTest-style processors, spring-wired
-    there; declared in the same `rules` config list here)."""
+    there; declared in the same `rules` config list here). Goes through
+    the instance's durable install path, so a config-declared rule is
+    indistinguishable from a REST-installed one (replicated, restored at
+    boot)."""
     from sitewhere_tpu.errors import SiteWhereError
-    from sitewhere_tpu.rules import ScriptedRuleProcessor
-    from sitewhere_tpu.runtime.scripts import GLOBAL_SCOPE
 
     token = data.get("token") or ""
     script_id = data.get("script") or ""
@@ -123,18 +124,12 @@ def _apply_scripted_rule(instance, data: dict) -> None:
     if engine is None:
         raise SiteWhereError(f"scripted rule {token!r}: unknown tenant "
                              f"{tenant!r}")
-    if engine.rule_processors.get_processor(token) is not None:
-        return  # idempotent reboot
-    try:
-        handler = instance.script_manager.resolve(tenant, script_id,
-                                                  "process",
-                                                  require_entry=True)
-    except Exception:
-        handler = instance.script_manager.resolve(GLOBAL_SCOPE, script_id,
-                                                  "process",
-                                                  require_entry=True)
-    engine.rule_processors.add_processor(
-        ScriptedRuleProcessor(token, handler, script_id=script_id))
+    existing = engine.rule_processors.get_processor(token)
+    if existing is not None and getattr(existing, "script_id",
+                                        None) == script_id:
+        return  # idempotent reboot (boot restore already installed it)
+    # config declares desired state: replace whatever is installed
+    instance.install_scripted_rule(tenant, token, script_id, replace=True)
 
 
 def cmd_assemble_checkpoint(args) -> int:

@@ -151,6 +151,12 @@ class SiteWhereInstance(LifecycleComponent):
         # management), synced under data_dir when persistent
         from sitewhere_tpu.runtime.scripts import ScriptManager
         self.script_manager = ScriptManager(data_dir=self.data_dir)
+        # durable scripted-rule installs (reference: ZK-synced script
+        # config, ScriptSynchronizer.java:32): survives restarts, rides
+        # the instance checkpoint, and replicates via cluster gossip —
+        # tenant engines re-install from it at boot (_make_engine)
+        from sitewhere_tpu.rules.store import ScriptedRuleStore
+        self.scripted_rules = ScriptedRuleStore(data_dir=self.data_dir)
 
         # centralized logging over the bus (reference:
         # MicroserviceLogProducer -> instance-logging topic). The handler
@@ -175,13 +181,16 @@ class SiteWhereInstance(LifecycleComponent):
                 self, os.path.join(data_dir, "checkpoints"),
                 interval_s=checkpoint_interval_s)
 
+        # scripts load from disk FIRST so the checkpoint restore's
+        # last-writer-wins apply sees the local copies (and tenant
+        # engines, built later, can resolve script-backed rules)
+        self.add_nested(self.script_manager)
         if self.pipeline_engine is not None:
             self.add_nested(self.pipeline_engine)
         if self.checkpoint_manager is not None:
             self.add_nested(self.checkpoint_manager.component)
         self.add_nested(self.engine_manager)
         self.add_nested(self.label_generators)
-        self.add_nested(self.script_manager)
 
     # -- wiring ------------------------------------------------------------
     def _make_store(self, kind: str):
@@ -203,7 +212,105 @@ class SiteWhereInstance(LifecycleComponent):
             store_factory=store_factory, naming=self.naming,
             cluster=self.cluster_hooks, batcher=self.latency_batcher)
         self.bootstrap.apply_template(engine)
+        # re-install this tenant's durable scripted rules (they start with
+        # the engine's rule_processors manager)
+        for row in self.scripted_rules.installs_for(tenant.token):
+            try:
+                self._install_scripted_processor(
+                    engine, tenant.token, row["token"], row["script"])
+            except Exception:
+                logging.getLogger("sitewhere.instance").exception(
+                    "could not restore scripted rule %r (script %r) for "
+                    "tenant %s", row["token"], row["script"], tenant.token)
         return engine
+
+    # -- scripted rules (durable + replicated) -----------------------------
+    def _install_scripted_processor(self, engine, tenant: str, token: str,
+                                    script_id: str,
+                                    replace: bool = True) -> None:
+        """Resolve + attach the processor on an engine. With `replace`
+        (boot restore, gossip apply — LWW semantics) an existing processor
+        for the token is swapped when its backing script differs; without
+        it (REST create) `add_processor`'s atomic duplicate check raises,
+        so two concurrent installs of one token cannot both succeed."""
+        from sitewhere_tpu.errors import ErrorCode, NotFoundError
+        from sitewhere_tpu.rules import ScriptedRuleProcessor
+        from sitewhere_tpu.runtime.scripts import GLOBAL_SCOPE
+
+        if replace:
+            existing = engine.rule_processors.get_processor(token)
+            if existing is not None:
+                if getattr(existing, "script_id", None) == script_id:
+                    return
+                engine.rule_processors.remove_processor(token)
+        try:
+            try:
+                handler = self.script_manager.resolve(
+                    tenant, script_id, "process", require_entry=True)
+            except Exception:
+                handler = self.script_manager.resolve(
+                    GLOBAL_SCOPE, script_id, "process", require_entry=True)
+        except Exception as exc:
+            # normalized for the gossip applier: a not-yet-replicated
+            # script is a retryable dependency miss, not a hard failure
+            raise NotFoundError(
+                f"script '{script_id}' not resolvable for rule '{token}': "
+                f"{exc}", ErrorCode.GENERIC) from exc
+        engine.rule_processors.add_processor(
+            ScriptedRuleProcessor(token, handler, script_id=script_id))
+
+    def install_scripted_rule(self, tenant: str, token: str,
+                              script_id: str,
+                              replace: bool = False) -> None:
+        """Install a script-backed rule processor on `tenant`: live attach
+        + durable record (+ gossip via the store's listeners). The default
+        is create semantics (duplicate token raises, atomically); config
+        boot passes `replace=True` because config declares desired state."""
+        engine = self.get_tenant_engine(tenant)
+        if engine is None:
+            from sitewhere_tpu.errors import ErrorCode, NotFoundError
+            raise NotFoundError(f"unknown tenant '{tenant}'",
+                                ErrorCode.INVALID_TENANT_TOKEN)
+        self._install_scripted_processor(engine, tenant, token, script_id,
+                                         replace=replace)
+        self.scripted_rules.record(tenant, token, script_id)
+
+    def remove_scripted_rule(self, tenant: str, token: str) -> bool:
+        """Live detach + durable tombstone (+ gossip). True if removed."""
+        engine = self.get_tenant_engine(tenant)
+        removed = bool(engine is not None
+                       and engine.rule_processors.remove_processor(token))
+        return bool(self.scripted_rules.erase(tenant, token)) or removed
+
+    def apply_replicated_scripted_rule(self, op: str, tenant: str,
+                                       token: str, payload) -> bool:
+        """Gossip receive side (parallel/cluster.py): converge the durable
+        store, then mirror the live processor state. Raises NotFoundError
+        while the backing script has not replicated yet — the caller's
+        at-least-once redelivery retries until it has. Returns True when
+        local state actually changed (the caller's applied counter)."""
+        if op == "add":
+            script_id, stamp = payload["script"], payload["stamp"]
+            if not self.scripted_rules.would_apply_add(tenant, token,
+                                                       script_id, stamp):
+                return False  # older than local state: idempotent no-op
+            # live attach FIRST: if the backing script has not replicated
+            # yet this raises NotFoundError and the store stays unchanged,
+            # so the redelivered record retries the whole apply
+            engine = self.get_tenant_engine(tenant)
+            if engine is not None:
+                self._install_scripted_processor(engine, tenant, token,
+                                                 script_id)
+            return self.scripted_rules.apply_add(tenant, token, script_id,
+                                                 stamp)
+        if op == "remove":
+            if self.scripted_rules.apply_remove(tenant, token,
+                                                int(payload)):
+                engine = self.engine_manager.get_engine(tenant)
+                if engine is not None:
+                    engine.rule_processors.remove_processor(token)
+                return True
+        return False
 
     # -- lifecycle ---------------------------------------------------------
     def on_initialize(self, monitor) -> None:

@@ -52,10 +52,12 @@ deletions (tombstones) and fused-rule mutations, broadcast to every
 peer's bus edge and applied idempotently with last-writer-wins ordering
 (update stamp, host-independent content-digest tiebreak). References
 travel by token; a multi-pass applier plus at-least-once redelivery
-absorbs cross-entity reordering. Residual limits: tenant/user
-provisioning still rides identical boot templates (mutations of those
-kinds are not gossiped), scripted rule processors are host-local and
-non-durable (rules/processor.py), and events for devices whose gossip has not
+absorbs cross-entity reordering. User scripts and scripted-rule installs
+replicate the same way (whole-state script payloads + stamped installs,
+`register_scripts`) and persist in the scripted-rule store + instance
+checkpoint. Residual limits: tenant/user provisioning still rides
+identical boot templates (mutations of those kinds are not gossiped),
+and events for devices whose gossip has not
 yet arrived intern to UNKNOWN and surface on the unregistered path
 during the convergence window rather than corrupting anything.
 """
@@ -954,6 +956,55 @@ class RegistryGossip:
         engine.upsert_rule(kind, rule)
         self.applied += 1
 
+    # -- script + scripted-rule replication --------------------------------
+    def register_scripts(self, instance) -> None:
+        """Replicate the script store and scripted-rule installs
+        (reference: ZK-backed ScriptSynchronizer.java:32 gives every node
+        the same scripts; here the mutation itself travels). Script
+        payloads are whole-state (metadata + every version's content) so
+        the applier is idempotent and order-free; scripted-rule installs
+        are (token -> script, stamp) with tombstoned removals. A rule
+        install arriving before its script replays via the dependency-miss
+        retry path, like any registry reference."""
+        instance.script_manager.add_listener(self._on_script_mutation)
+        instance.scripted_rules.add_listener(
+            self._on_scripted_rule_mutation)
+
+    def _on_script_mutation(self, op: str, scope: str, script_id: str,
+                            payload) -> None:
+        if getattr(self._applying, "active", False) or not self.peers:
+            return
+        data = {"kind": "_script", "op": op, "scope": scope,
+                "scriptId": script_id, "payload": payload}
+        self._publish(f"script:{scope}:{script_id}".encode(),
+                      msgpack.packb(data, use_bin_type=True))
+
+    def _on_scripted_rule_mutation(self, op: str, tenant: str, token: str,
+                                   payload) -> None:
+        if getattr(self._applying, "active", False) or not self.peers:
+            return
+        data = {"kind": "_scripted_rule", "op": op, "tenant": tenant,
+                "token": token, "payload": payload}
+        self._publish(token.encode(),
+                      msgpack.packb(data, use_bin_type=True))
+
+    def _apply_script(self, data: Dict) -> None:
+        scripts = self.instance.script_manager
+        if data.get("op") == "delete":
+            if scripts.apply_delete(data.get("scope", ""),
+                                    data.get("scriptId", ""),
+                                    int(data.get("payload") or 0)):
+                self.applied += 1
+            return
+        if scripts.apply_replicated(dict(data.get("payload") or {})):
+            self.applied += 1
+
+    def _apply_scripted_rule(self, data: Dict) -> None:
+        if self.instance.apply_replicated_scripted_rule(
+                data.get("op", ""), data.get("tenant", ""),
+                data.get("token", ""), data.get("payload")):
+            self.applied += 1
+
     # -- apply side --------------------------------------------------------
     def start(self) -> None:
         self._host.start()
@@ -1020,6 +1071,12 @@ class RegistryGossip:
         kind = data.get("kind")
         if kind == "_rule":
             self._apply_rule(data)
+            return
+        if kind == "_script":
+            self._apply_script(data)
+            return
+        if kind == "_scripted_rule":
+            self._apply_scripted_rule(data)
             return
         cls = _gossip_class(kind)
         if cls is None:
@@ -1242,6 +1299,7 @@ class ClusterService:
                                       naming) if registry_gossip else None)
         if self.gossip is not None:
             self.gossip.register_rules_engine(engine)
+            self.gossip.register_scripts(instance)
         self.aggregator = TopologyAggregator(
             instance.bus, naming, stale_after_s=stale_after_s)
         expected_peers = [p for p in range(num_processes)

@@ -1,0 +1,180 @@
+"""Durable scripted-rule install registry.
+
+Reference: scripted (Groovy) rule processors exist cluster-wide and
+survive restarts because their configuration lives in ZooKeeper and syncs
+to every node's disk (ScriptSynchronizer.java:32,
+ZookeeperScriptManagement.java). The rebuild's equivalent records every
+scripted-rule INSTALL — (tenant, token, script_id) — in one JSON file
+under the instance data_dir, with a last-writer-wins stamp per install so
+cluster gossip converges the same way the registry does (tombstones for
+removals, stamp+digest would be overkill: the payload IS the identity).
+
+`SiteWhereInstance` owns one of these; REST installs/removes go through
+`instance.install_scripted_rule` / `remove_scripted_rule`, which update
+this store, fire its listeners (the cluster gossip publish side), and
+re-install at boot when a tenant engine is built.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+LOGGER = logging.getLogger("sitewhere.rules.store")
+
+
+class ScriptedRuleStore:
+    """(tenant, token) -> {script_id, stamp}; JSON-durable, LWW, with
+    removal tombstones."""
+
+    def __init__(self, data_dir: Optional[str] = None):
+        self._path = (os.path.join(data_dir, "scripted_rules.json")
+                      if data_dir else None)
+        self._lock = threading.Lock()
+        # (tenant, token) -> {"script": str, "stamp": int}
+        self._installs: Dict[tuple, Dict] = {}
+        self._tombstones: Dict[tuple, int] = {}
+        self._listeners: List[Callable] = []
+        self._load()
+
+    # -- durability --------------------------------------------------------
+    def _load(self) -> None:
+        if not self._path or not os.path.exists(self._path):
+            return
+        try:
+            with open(self._path, encoding="utf-8") as fh:
+                data = json.load(fh)
+        except (OSError, ValueError):
+            LOGGER.exception("unreadable scripted-rule store %s", self._path)
+            return
+        for row in data.get("installs", []):
+            self._installs[(row["tenant"], row["token"])] = {
+                "script": row["script"], "stamp": int(row.get("stamp", 0))}
+        for row in data.get("tombstones", []):
+            self._tombstones[(row["tenant"], row["token"])] = int(
+                row.get("stamp", 0))
+
+    def _sync(self) -> None:
+        if not self._path:
+            return
+        data = {
+            "installs": [{"tenant": t, "token": k, **v}
+                         for (t, k), v in sorted(self._installs.items())],
+            "tombstones": [{"tenant": t, "token": k, "stamp": s}
+                           for (t, k), s in sorted(self._tombstones.items())],
+        }
+        tmp = f"{self._path}.{os.getpid()}.tmp"
+        os.makedirs(os.path.dirname(self._path), exist_ok=True)
+        with open(tmp, "w", encoding="utf-8") as fh:
+            json.dump(data, fh)
+        os.replace(tmp, self._path)
+
+    # -- replication surface ----------------------------------------------
+    def add_listener(self, fn: Callable) -> None:
+        """fn(op: "add"|"remove", tenant, token, payload) — fired on LOCAL
+        mutations only (record/erase, not apply_*)."""
+        self._listeners.append(fn)
+
+    def _notify(self, op: str, tenant: str, token: str, payload) -> None:
+        for fn in list(self._listeners):
+            try:
+                fn(op, tenant, token, payload)
+            except Exception:
+                LOGGER.exception("scripted-rule listener failed (%s %s/%s)",
+                                 op, tenant, token)
+
+    # -- mutations ---------------------------------------------------------
+    def record(self, tenant: str, token: str, script_id: str) -> Dict:
+        """Local install; returns the payload the gossip side publishes."""
+        with self._lock:
+            stamp = max(int(time.time() * 1000),
+                        self._tombstones.get((tenant, token), -1) + 1,
+                        self._installs.get((tenant, token),
+                                           {"stamp": -1})["stamp"] + 1)
+            payload = {"script": script_id, "stamp": stamp}
+            self._installs[(tenant, token)] = payload
+            self._tombstones.pop((tenant, token), None)
+            self._sync()
+        self._notify("add", tenant, token, payload)
+        return payload
+
+    def erase(self, tenant: str, token: str) -> Optional[int]:
+        """Local removal; returns the tombstone stamp (None if unknown)."""
+        with self._lock:
+            existing = self._installs.pop((tenant, token), None)
+            if existing is None:
+                return None
+            stamp = max(int(time.time() * 1000), existing["stamp"] + 1)
+            self._tombstones[(tenant, token)] = stamp
+            self._sync()
+        self._notify("remove", tenant, token, stamp)
+        return stamp
+
+    def _add_wins_locked(self, key: tuple, script_id: str,
+                         stamp: int) -> bool:
+        if stamp <= self._tombstones.get(key, -1):
+            return False
+        local = self._installs.get(key)
+        return local is None or (local["stamp"], local["script"]) < (
+            stamp, script_id)
+
+    def would_apply_add(self, tenant: str, token: str, script_id: str,
+                        stamp: int) -> bool:
+        """Non-mutating LWW check: would `apply_add` win right now? Lets a
+        caller attach the live processor BEFORE committing the store (an
+        attach that fails must leave the store unchanged so redelivery
+        retries cleanly)."""
+        with self._lock:
+            return self._add_wins_locked((tenant, token), script_id, stamp)
+
+    def apply_add(self, tenant: str, token: str, script_id: str,
+                  stamp: int) -> bool:
+        """Replicated install: LWW against local install/tombstone;
+        idempotent, never notifies. Returns True when it newly wins."""
+        with self._lock:
+            key = (tenant, token)
+            if not self._add_wins_locked(key, script_id, stamp):
+                return False
+            self._installs[key] = {"script": script_id, "stamp": stamp}
+            self._tombstones.pop(key, None)
+            self._sync()
+            return True
+
+    def apply_remove(self, tenant: str, token: str, stamp: int) -> bool:
+        with self._lock:
+            key = (tenant, token)
+            local = self._installs.get(key)
+            if local is not None and local["stamp"] > stamp:
+                return False
+            self._tombstones[key] = max(stamp,
+                                        self._tombstones.get(key, -1))
+            if local is None:
+                return False
+            del self._installs[key]
+            self._sync()
+            return True
+
+    # -- reads -------------------------------------------------------------
+    def installs_for(self, tenant: str) -> List[Dict]:
+        with self._lock:
+            return [{"token": token, "script": v["script"],
+                     "stamp": v["stamp"]}
+                    for (t, token), v in sorted(self._installs.items())
+                    if t == tenant]
+
+    def get(self, tenant: str, token: str) -> Optional[Dict]:
+        with self._lock:
+            v = self._installs.get((tenant, token))
+            return dict(v) if v else None
+
+    def export_state(self) -> Dict:
+        """Checkpoint payload (installs only; tombstones are a gossip
+        convergence aid, not durable state worth moving cross-topology)."""
+        with self._lock:
+            return {"installs": [{"tenant": t, "token": k, **v}
+                                 for (t, k), v in
+                                 sorted(self._installs.items())]}

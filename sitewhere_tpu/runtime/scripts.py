@@ -58,11 +58,15 @@ class ScriptInfo:
     description: str = ""
     active_version: Optional[str] = None
     versions: List[ScriptVersion] = field(default_factory=list)
+    # last-writer-wins stamp for cross-host replication (cluster gossip);
+    # bumped on every mutation, adopted from the winner on apply
+    updated_ms: int = 0
 
     def to_json(self) -> Dict[str, Any]:
         return {"scriptId": self.script_id, "name": self.name,
                 "description": self.description,
                 "activeVersion": self.active_version,
+                "updatedMs": self.updated_ms,
                 "versions": [v.to_json() for v in self.versions]}
 
 
@@ -94,6 +98,14 @@ class ScriptManager(LifecycleComponent):
         self._content: Dict[tuple, str] = {}
         # (scope, script_id) -> compiled namespace of the active version
         self._namespaces: Dict[tuple, Dict[str, Any]] = {}
+        # (scope, script_id) -> deletion stamp: an upsert older than the
+        # tombstone stays dead; a NEWER one resurrects (same contract as
+        # the registry gossip tombstones, parallel/cluster.py)
+        self._tombstones: Dict[tuple, int] = {}
+        # mutation listeners: fn(op: "upsert"|"delete", scope, script_id,
+        # state_or_stamp) — called AFTER the mutation, outside the lock
+        # (cluster gossip replicates through this)
+        self._listeners: List[Callable] = []
 
     # -- lifecycle / disk sync ---------------------------------------------
 
@@ -116,13 +128,23 @@ class ScriptManager(LifecycleComponent):
         d = os.path.join(self._scope_dir(scope), info.script_id)
         os.makedirs(d, exist_ok=True)
         # versions first, meta last, each atomically: a crash can leave
-        # stray .py files but never a meta.json naming a missing version
+        # stray .py files but never a meta.json naming a missing version.
+        # Always rewrite — apply_replicated can REPLACE content under an
+        # existing version id (per-host version counters collide), and a
+        # skip-if-exists here would persist the losing content, diverging
+        # hosts after the next restart.
         for v in info.versions:
             path = os.path.join(d, f"{v.version_id}.py")
-            if not os.path.exists(path):
-                self._atomic_write(
-                    path, self._content[(scope, info.script_id,
-                                         v.version_id)])
+            self._atomic_write(
+                path, self._content[(scope, info.script_id, v.version_id)])
+        # drop version files the winning state no longer names
+        keep = {f"{v.version_id}.py" for v in info.versions} | {"meta.json"}
+        for name in os.listdir(d):
+            if name.endswith(".py") and name not in keep:
+                try:
+                    os.unlink(os.path.join(d, name))
+                except OSError:
+                    pass
         self._atomic_write(os.path.join(d, "meta.json"),
                            json.dumps({"scope": scope, **info.to_json()}))
 
@@ -203,6 +225,7 @@ class ScriptManager(LifecycleComponent):
             script_id=meta["scriptId"], name=meta.get("name", ""),
             description=meta.get("description", ""),
             active_version=meta.get("activeVersion"),
+            updated_ms=meta.get("updatedMs", 0),
             versions=[ScriptVersion(v["versionId"], v.get("comment", ""),
                                     v.get("createdDate", 0))
                       for v in meta.get("versions", [])])
@@ -215,6 +238,133 @@ class ScriptManager(LifecycleComponent):
             self._compile(key, info.active_version)
         self._scripts[key] = info  # registered only after a clean load
         return scope
+
+    # -- replication surface ------------------------------------------------
+
+    def add_listener(self, fn: Callable) -> None:
+        """Register a mutation listener `fn(op, scope, script_id, payload)`
+        — op "upsert" carries the full exported script state, op "delete"
+        carries the tombstone stamp. Fired after every LOCAL mutation
+        (apply_replicated/apply_delete do NOT fire it: appliers are the
+        receive side)."""
+        self._listeners.append(fn)
+
+    def _notify(self, op: str, scope: str, script_id: str, payload) -> None:
+        for fn in list(self._listeners):
+            try:
+                fn(op, scope, script_id, payload)
+            except Exception:
+                LOGGER.exception("script listener failed for %s %s/%s",
+                                 op, scope, script_id)
+
+    @staticmethod
+    def _now_ms() -> int:
+        return int(time.time() * 1000)
+
+    def export_script(self, scope: str, script_id: str) -> Dict[str, Any]:
+        """Full replicable state of one script: metadata + every version's
+        content. Scripts are small text; whole-state transfer keeps the
+        applier idempotent and order-free (same reasoning as the registry
+        gossip's by-token entity payloads)."""
+        with self._lock:
+            info = self.get_script(scope, script_id)
+            return {"scope": scope, **info.to_json(),
+                    "contents": {v.version_id:
+                                 self._content[(scope, script_id,
+                                                v.version_id)]
+                                 for v in info.versions}}
+
+    def export_state(self) -> List[Dict[str, Any]]:
+        """Every script's exported state (instance checkpoint payload)."""
+        with self._lock:
+            return [self.export_script(scope, script_id)
+                    for (scope, script_id) in sorted(self._scripts)]
+
+    @staticmethod
+    def _state_digest(state: Dict[str, Any]) -> str:
+        import hashlib
+
+        blob = json.dumps({k: v for k, v in state.items()
+                           if k != "updatedMs"}, sort_keys=True)
+        return hashlib.sha1(blob.encode()).hexdigest()
+
+    def _lww_key(self, key: tuple) -> tuple:
+        info = self._scripts.get(key)
+        if info is None:
+            return (self._tombstones.get(key, -1), "")
+        return (info.updated_ms,
+                self._state_digest(self.export_script(*key)))
+
+    def apply_replicated(self, state: Dict[str, Any]) -> bool:
+        """Upsert a replicated script if it wins last-writer-wins against
+        the local copy (stamp, then host-independent digest — every host
+        compares the same keys and picks the same winner). Idempotent;
+        never fires listeners. Returns True when applied."""
+        scope, script_id = state["scope"], state["scriptId"]
+        incoming = (int(state.get("updatedMs", 0)),
+                    self._state_digest(state))
+        with self._lock:
+            key = (scope, script_id)
+            if incoming[0] <= self._tombstones.get(key, -1):
+                return False  # deleted with a newer stamp: stays dead
+            if self._scripts.get(key) is not None \
+                    and incoming <= self._lww_key(key):
+                return False
+            info = ScriptInfo(
+                script_id=script_id, name=state.get("name", ""),
+                description=state.get("description", ""),
+                active_version=state.get("activeVersion"),
+                updated_ms=incoming[0],
+                versions=[ScriptVersion(v["versionId"],
+                                        v.get("comment", ""),
+                                        v.get("createdDate", 0))
+                          for v in state.get("versions", [])])
+            # stage content + compile BEFORE replacing the local copy so a
+            # broken payload cannot take down a working script
+            contents = dict(state.get("contents", {}))
+            for v in info.versions:
+                if v.version_id not in contents:
+                    raise SiteWhereError(
+                        f"replicated script '{script_id}' missing content "
+                        f"for {v.version_id}", http_status=400)
+            old_content = {k: v for k, v in self._content.items()
+                           if k[:2] == key}
+            # the winner's version set REPLACES the local one: drop every
+            # old content key first so versions absent from the winning
+            # state don't linger readable through get_content
+            for k in old_content:
+                del self._content[k]
+            for vid, text in contents.items():
+                self._content[key + (vid,)] = text
+            try:
+                if info.active_version:
+                    self._compile(key, info.active_version)
+                else:
+                    self._namespaces.pop(key, None)
+            except Exception:
+                for k in [k for k in self._content if k[:2] == key]:
+                    del self._content[k]
+                self._content.update(old_content)
+                raise
+            self._scripts[key] = info
+            self._tombstones.pop(key, None)
+            self._sync_to_disk(scope, info)
+            return True
+
+    def apply_delete(self, scope: str, script_id: str, stamp: int) -> bool:
+        """Replicated deletion: applies when the local copy is not newer;
+        always records the tombstone. Never fires listeners."""
+        with self._lock:
+            key = (scope, script_id)
+            info = self._scripts.get(key)
+            if info is not None and info.updated_ms > stamp:
+                return False  # local write is newer: delete loses
+            self._tombstones[key] = max(stamp,
+                                        self._tombstones.get(key, -1))
+            if info is None:
+                return False
+            self._delete_locked(scope, script_id)
+            return True
 
     # -- CRUD ---------------------------------------------------------------
 
@@ -232,14 +382,22 @@ class ScriptManager(LifecycleComponent):
                                      ErrorCode.DUPLICATE_TOKEN)
             if activate:
                 self._check_compiles(key, content)  # before registering
+            # stamp PAST any local tombstone (delete-then-recreate in the
+            # same millisecond must still replicate) and clear it
             info = ScriptInfo(script_id=script_id, name=name or script_id,
-                              description=description)
+                              description=description,
+                              updated_ms=max(self._now_ms(),
+                                             self._tombstones.get(key, -1)
+                                             + 1))
+            self._tombstones.pop(key, None)
             self._scripts[key] = info
             version = self._add_version_locked(key, content, "initial")
             if activate:
                 self._activate_locked(key, version.version_id)
             self._sync_to_disk(scope, info)
-            return info
+        self._notify("upsert", scope, script_id,
+                     self.export_script(scope, script_id))
+        return info
 
     def list_scripts(self, scope: str) -> List[ScriptInfo]:
         with self._lock:
@@ -257,16 +415,25 @@ class ScriptManager(LifecycleComponent):
         with self._lock:
             info = self.get_script(scope, script_id)
             key = (scope, script_id)
-            del self._scripts[key]
-            self._namespaces.pop(key, None)
-            for v in info.versions:
-                self._content.pop(key + (v.version_id,), None)
-            if self._data_dir:
-                d = os.path.join(self._scope_dir(scope), script_id)
-                if os.path.isdir(d):
-                    for f in os.listdir(d):
-                        os.unlink(os.path.join(d, f))
-                    os.rmdir(d)
+            # stamp past the script's last write so a concurrent remote
+            # update with an older stamp cannot resurrect it
+            stamp = max(self._now_ms(), info.updated_ms + 1)
+            self._tombstones[key] = stamp
+            self._delete_locked(scope, script_id)
+        self._notify("delete", scope, script_id, stamp)
+
+    def _delete_locked(self, scope: str, script_id: str) -> None:
+        key = (scope, script_id)
+        info = self._scripts.pop(key)
+        self._namespaces.pop(key, None)
+        for v in info.versions:
+            self._content.pop(key + (v.version_id,), None)
+        if self._data_dir:
+            d = os.path.join(self._scope_dir(scope), script_id)
+            if os.path.isdir(d):
+                for f in os.listdir(d):
+                    os.unlink(os.path.join(d, f))
+                os.rmdir(d)
 
     # -- versions -----------------------------------------------------------
 
@@ -289,15 +456,22 @@ class ScriptManager(LifecycleComponent):
             version = self._add_version_locked(key, content, comment)
             if activate:
                 self._activate_locked(key, version.version_id)
+            # monotonic past the previous write: same-millisecond
+            # mutations must still order under last-writer-wins
+            info.updated_ms = max(self._now_ms(), info.updated_ms + 1)
             self._sync_to_disk(scope, info)
-            return version
+        self._notify("upsert", scope, script_id,
+                     self.export_script(scope, script_id))
+        return version
 
     def clone_version(self, scope: str, script_id: str, version_id: str,
                       comment: str = "") -> ScriptVersion:
-        with self._lock:
-            content = self.get_content(scope, script_id, version_id)
-            return self.add_version(scope, script_id, content,
-                                    comment or f"clone of {version_id}")
+        # read under the manager's internal locking, then delegate OUTSIDE
+        # any held lock: add_version's listener notification does network
+        # publishes in a cluster and must not run under self._lock
+        content = self.get_content(scope, script_id, version_id)
+        return self.add_version(scope, script_id, content,
+                                comment or f"clone of {version_id}")
 
     def get_content(self, scope: str, script_id: str,
                     version_id: Optional[str] = None) -> str:
@@ -348,8 +522,11 @@ class ScriptManager(LifecycleComponent):
         with self._lock:
             info = self.get_script(scope, script_id)
             self._activate_locked((scope, script_id), version_id)
+            info.updated_ms = max(self._now_ms(), info.updated_ms + 1)
             self._sync_to_disk(scope, info)
-            return info
+        self._notify("upsert", scope, script_id,
+                     self.export_script(scope, script_id))
+        return info
 
     def _active_entry(self, key: tuple, entry: str) -> Callable:
         ns = self._namespaces.get(key)

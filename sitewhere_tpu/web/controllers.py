@@ -261,12 +261,11 @@ def register_all(router: Router, instance, server) -> None:
         of via spring restart). `script` names a ScriptManager script
         whose active version defines `process(context, event)` — verified
         at install time — and the resolve proxy hot-swaps on version
-        activation. HOST-LOCAL and non-durable (unlike fused rules):
-        declare it in config for boot persistence; in a cluster install
-        it on every host that should run it."""
+        activation. DURABLE and REPLICATED (round 5): the install records
+        in the scripted-rule store (restored when the tenant engine
+        boots, carried by the instance checkpoint) and gossips to every
+        cluster host like a registry mutation."""
         from sitewhere_tpu.errors import DuplicateTokenError
-        from sitewhere_tpu.rules import ScriptedRuleProcessor
-        from sitewhere_tpu.runtime.scripts import GLOBAL_SCOPE
 
         token = body.get("token") or ""
         script_id = body.get("script") or ""
@@ -278,19 +277,12 @@ def register_all(router: Router, instance, server) -> None:
         if instance.pipeline_engine is not None \
                 and instance.pipeline_engine.get_rule(token)[0] is not None:
             raise DuplicateTokenError(f"rule '{token}' already exists")
-        scripts = instance.script_manager
-        tenant_scope = request.tenant or "default"
-        try:
-            handler = scripts.resolve(tenant_scope, script_id, "process",
-                                      require_entry=True)
-        except Exception:
-            handler = scripts.resolve(GLOBAL_SCOPE, script_id, "process",
-                                      require_entry=True)
-        # add_processor is the atomic duplicate check for scripted tokens
-        _scripted_rules(request).add_processor(
-            ScriptedRuleProcessor(token, handler, script_id=script_id))
+        if _scripted_rules(request).get_processor(token) is not None:
+            raise DuplicateTokenError(f"rule '{token}' already exists")
+        instance.install_scripted_rule(request.tenant or "default", token,
+                                       script_id)
         return {"type": "scripted", "token": token, "script": script_id,
-                "scope": "host-local"}
+                "scope": "replicated"}
 
     def _list_scripted(request: Request):
         return [{"type": "scripted",
@@ -309,7 +301,7 @@ def register_all(router: Router, instance, server) -> None:
             if processor is not None:
                 return {"type": "scripted", "token": token,
                         "script": getattr(processor, "script_id", ""),
-                        "scope": "host-local"}
+                        "scope": "replicated"}
             raise NotFoundError(f"rule '{token}' not found",
                                 ErrorCode.GENERIC)
         return rule_to_dict(kind, rule)
@@ -321,7 +313,8 @@ def register_all(router: Router, instance, server) -> None:
         token = request.params["token"]
         kind, rule = engine.get_rule(token)
         if kind is None or not engine.remove_rule(token):
-            if _scripted_rules(request).remove_processor(token):
+            if instance.remove_scripted_rule(request.tenant or "default",
+                                             token):
                 return {"type": "scripted", "token": token}
             raise NotFoundError(f"rule '{token}' not found",
                                 ErrorCode.GENERIC)

@@ -866,3 +866,149 @@ def test_two_process_cluster_end_to_end():
         # reached THIS host's device state and alert log
         assert f"E2EOK {pid}" in outs[pid], outs[pid][-4000:]
         assert f"STOPOK {pid}" in outs[pid], outs[pid][-4000:]
+
+
+_SCRIPTED_RULE_CHILD = r"""
+import os, sys, time
+pid = int(sys.argv[1]); coord = sys.argv[2]
+bus0, bus1 = int(sys.argv[3]), int(sys.argv[4])
+data_root = sys.argv[5]; phase = int(sys.argv[6])
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(coordinator_address=coord, num_processes=2,
+                           process_id=pid)
+from sitewhere_tpu.instance import SiteWhereInstance
+from sitewhere_tpu.model import DeviceType, Device, DeviceAssignment
+from sitewhere_tpu.model.event import DeviceMeasurement
+from sitewhere_tpu.parallel.cluster import ClusterService
+from sitewhere_tpu.parallel.distributed import make_global_mesh
+from sitewhere_tpu.runtime.busnet import BusClient
+
+mesh = make_global_mesh()
+instance = SiteWhereInstance(
+    instance_id="scripted-repl", enable_pipeline=True, mesh=mesh,
+    data_dir=os.path.join(data_root, f"h{pid}"),
+    max_devices=64, batch_size=16, measurement_slots=4, max_tenants=4)
+my_bus = bus0 if pid == 0 else bus1
+cluster = ClusterService(
+    instance, pid, 2,
+    peer_bus_addrs={0: ("127.0.0.1", bus0), 1: ("127.0.0.1", bus1)},
+    bus_port=my_bus, heartbeat_s=0.4, stale_after_s=6.0,
+    fail_after_s=30.0, idle_interval_s=0.005)
+cluster.start()
+te = instance.get_tenant_engine("default")
+
+def barrier(tag):
+    peer = BusClient("127.0.0.1", bus1 if pid == 0 else bus0)
+    peer.publish(f"barrier-{tag}", b"r", str(pid).encode())
+    peer.close()
+    deadline = time.monotonic() + 120
+    while sum(instance.bus.topic(f"barrier-{tag}").end_offsets()) < 1:
+        assert time.monotonic() < deadline, f"barrier {tag} timeout"
+        time.sleep(0.05)
+
+# the script appends to ONE shared sentinel file (the replicated
+# script CONTENT embeds the path, so it must be host-independent);
+# each host proves its own firing by its distinct value
+mark = os.path.join(data_root, "fired.log").replace("\\", "/")
+SCRIPT = (
+    "def process(context, event):\n"
+    f"    with open({mark!r}, 'a') as fh:\n"
+    "        fh.write(f'{event.value}\\n')\n"
+)
+
+if phase == 1:
+    if pid == 0:
+        # host A: script + scripted rule installed HERE only
+        instance.script_manager.create_script("default", "firemark", SCRIPT)
+        instance.install_scripted_rule("default", "mark-rule", "firemark")
+        dt = te.registry.create_device_type(DeviceType(token="sdt"))
+        d = te.registry.create_device(Device(token="sdev",
+                                             device_type_id=dt.id))
+        te.registry.create_device_assignment(
+            DeviceAssignment(token="sas", device_id=d.id))
+    barrier("installed")
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        proc = te.rule_processors.get_processor("mark-rule")
+        dev = te.registry.get_device_by_token("sdev")
+        if proc is not None and dev is not None \
+                and te.registry.get_active_assignment(dev.id) is not None:
+            break
+        time.sleep(0.1)
+    else:
+        raise SystemExit(f"host {pid}: scripted rule never replicated")
+    print(f"REPLICATED {pid}", flush=True)
+else:
+    # gang restart: nothing is installed in this phase — everything must
+    # come back from each host's durable state (script store + install
+    # registry restored when the tenant engine boots)
+    proc = te.rule_processors.get_processor("mark-rule")
+    assert proc is not None, f"host {pid}: rule lost across gang restart"
+    print(f"RESTORED {pid}", flush=True)
+
+# BOTH phases: the rule must actually FIRE on this host — persist an
+# event locally; the enrichment pipeline publishes it on the enriched
+# topic and the scripted processor's consumer runs the script
+my_value = 42.0 + pid + (100 if phase == 2 else 0)
+te.event_management.add_measurements(
+    "sas", DeviceMeasurement(name="m", value=my_value))
+deadline = time.monotonic() + 120
+while time.monotonic() < deadline:
+    if os.path.exists(mark) and str(my_value) in open(mark).read():
+        break
+    time.sleep(0.1)
+else:
+    raise SystemExit(f"host {pid}: scripted rule never fired")
+print(f"FIRED {pid}", flush=True)
+barrier(f"fired-p{phase}")
+time.sleep(0.5)
+cluster.stop()
+print(f"STOPOK {pid}", flush=True)
+"""
+
+
+def test_two_process_scripted_rule_replication_and_gang_restart(tmp_path):
+    """VERDICT r4 item 3: a scripted rule installed on host A (script
+    content + install) replicates to host B and FIRES there through B's
+    own enriched pipeline; after a full gang restart with nothing
+    reinstalled, both hosts restore the script + rule from durable state
+    and it still fires."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    env.pop("JAX_PLATFORMS", None)
+    env.pop("XLA_FLAGS", None)
+    data_root = str(tmp_path)
+
+    def run_phase(phase):
+        coord = _free_port()
+        bus0, bus1 = _free_port(), _free_port()
+        procs = [subprocess.Popen(
+            [sys.executable, "-c", _SCRIPTED_RULE_CHILD, str(pid),
+             f"127.0.0.1:{coord}", str(bus0), str(bus1), data_root,
+             str(phase)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env) for pid in range(2)]
+        outs = []
+        try:
+            for p in procs:
+                out, _ = p.communicate(timeout=540)
+                outs.append(out)
+                assert p.returncode == 0, out[-4000:]
+        finally:
+            for q in procs:
+                if q.poll() is None:
+                    q.kill()
+                    q.wait(timeout=30)
+        return outs
+
+    outs = run_phase(1)
+    for pid in range(2):
+        assert f"REPLICATED {pid}" in outs[pid], outs[pid][-4000:]
+        assert f"FIRED {pid}" in outs[pid], outs[pid][-4000:]
+    outs = run_phase(2)
+    for pid in range(2):
+        assert f"RESTORED {pid}" in outs[pid], outs[pid][-4000:]
+        assert f"FIRED {pid}" in outs[pid], outs[pid][-4000:]
