@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import logging
 import os
+import threading
 from typing import Callable, Dict, Optional
 
 from sitewhere_tpu.model.tenant import Tenant
@@ -157,6 +158,12 @@ class SiteWhereInstance(LifecycleComponent):
         # tenant engines re-install from it at boot (_make_engine)
         from sitewhere_tpu.rules.store import ScriptedRuleStore
         self.scripted_rules = ScriptedRuleStore(data_dir=self.data_dir)
+        # serializes scripted-rule check+attach+commit sequences: a gossip
+        # apply that passed its LWW pre-check must not interleave with a
+        # local install, or the loser's attach could replace the winner's
+        # live processor while the store keeps the winner (silent
+        # live/durable divergence on this host)
+        self._scripted_rule_lock = threading.Lock()
 
         # centralized logging over the bus (reference:
         # MicroserviceLogProducer -> instance-logging topic). The handler
@@ -243,6 +250,15 @@ class SiteWhereInstance(LifecycleComponent):
                 if getattr(existing, "script_id", None) == script_id:
                     return
                 engine.rule_processors.remove_processor(token)
+        else:
+            # duplicate BEFORE resolve: a conflicting token must 409 even
+            # when its script id is unresolvable (and skip the wasted
+            # resolve). Race-free: every mutation path holds
+            # _scripted_rule_lock; add_processor's atomic check remains
+            # the backstop.
+            if engine.rule_processors.get_processor(token) is not None:
+                from sitewhere_tpu.errors import DuplicateTokenError
+                raise DuplicateTokenError(f"rule '{token}' already exists")
         try:
             try:
                 handler = self.script_manager.resolve(
@@ -271,16 +287,28 @@ class SiteWhereInstance(LifecycleComponent):
             from sitewhere_tpu.errors import ErrorCode, NotFoundError
             raise NotFoundError(f"unknown tenant '{tenant}'",
                                 ErrorCode.INVALID_TENANT_TOKEN)
-        self._install_scripted_processor(engine, tenant, token, script_id,
-                                         replace=replace)
-        self.scripted_rules.record(tenant, token, script_id)
+        with self._scripted_rule_lock:
+            self._install_scripted_processor(engine, tenant, token,
+                                             script_id, replace=replace)
+            # notify deferred: the listener publishes to peer bus edges,
+            # which must not run inside the critical section (one slow
+            # peer socket would stall every install AND the gossip
+            # applier blocked on this lock)
+            payload = self.scripted_rules.record(tenant, token, script_id,
+                                                 notify=False)
+        self.scripted_rules.emit("add", tenant, token, payload)
 
     def remove_scripted_rule(self, tenant: str, token: str) -> bool:
         """Live detach + durable tombstone (+ gossip). True if removed."""
         engine = self.get_tenant_engine(tenant)
-        removed = bool(engine is not None
-                       and engine.rule_processors.remove_processor(token))
-        return bool(self.scripted_rules.erase(tenant, token)) or removed
+        with self._scripted_rule_lock:
+            removed = bool(
+                engine is not None
+                and engine.rule_processors.remove_processor(token))
+            stamp = self.scripted_rules.erase(tenant, token, notify=False)
+        if stamp is not None:
+            self.scripted_rules.emit("remove", tenant, token, stamp)
+        return stamp is not None or removed
 
     def apply_replicated_scripted_rule(self, op: str, tenant: str,
                                        token: str, payload) -> bool:
@@ -291,25 +319,29 @@ class SiteWhereInstance(LifecycleComponent):
         local state actually changed (the caller's applied counter)."""
         if op == "add":
             script_id, stamp = payload["script"], payload["stamp"]
-            if not self.scripted_rules.would_apply_add(tenant, token,
-                                                       script_id, stamp):
-                return False  # older than local state: idempotent no-op
-            # live attach FIRST: if the backing script has not replicated
-            # yet this raises NotFoundError and the store stays unchanged,
-            # so the redelivered record retries the whole apply
-            engine = self.get_tenant_engine(tenant)
-            if engine is not None:
-                self._install_scripted_processor(engine, tenant, token,
-                                                 script_id)
-            return self.scripted_rules.apply_add(tenant, token, script_id,
-                                                 stamp)
-        if op == "remove":
-            if self.scripted_rules.apply_remove(tenant, token,
-                                                int(payload)):
-                engine = self.engine_manager.get_engine(tenant)
+            with self._scripted_rule_lock:
+                if not self.scripted_rules.would_apply_add(
+                        tenant, token, script_id, stamp):
+                    return False  # older than local state: no-op
+                # live attach FIRST: if the backing script has not
+                # replicated yet this raises NotFoundError and the store
+                # stays unchanged, so the redelivered record retries the
+                # whole apply. The lock keeps check+attach+commit atomic
+                # vs local installs (see _scripted_rule_lock).
+                engine = self.get_tenant_engine(tenant)
                 if engine is not None:
-                    engine.rule_processors.remove_processor(token)
-                return True
+                    self._install_scripted_processor(engine, tenant, token,
+                                                     script_id)
+                return self.scripted_rules.apply_add(tenant, token,
+                                                     script_id, stamp)
+        if op == "remove":
+            with self._scripted_rule_lock:
+                if self.scripted_rules.apply_remove(tenant, token,
+                                                    int(payload)):
+                    engine = self.engine_manager.get_engine(tenant)
+                    if engine is not None:
+                        engine.rule_processors.remove_processor(token)
+                    return True
         return False
 
     # -- lifecycle ---------------------------------------------------------

@@ -19,7 +19,12 @@ def new_id() -> str:
     return str(uuid.uuid4())
 
 
+_now_ms_override = None  # test hook: deterministic replication-algebra clocks
+
+
 def now_ms() -> int:
+    if _now_ms_override is not None:
+        return _now_ms_override()
     return int(time.time() * 1000)
 
 

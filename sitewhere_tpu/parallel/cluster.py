@@ -788,12 +788,20 @@ def _gossip_content_key(kind: str, data: Dict,
     over the entity's HOST-INDEPENDENT content — the per-host UUID id
     fields are dropped and the replicated references appear by token, so
     every host hashing its local copy and the incoming copy computes the
-    same pair of keys and therefore picks the same winner."""
+    same pair of keys and therefore picks the same winner.
+
+    created_date is a per-host observation (a host that content-merged a
+    peer's create keeps its own creation stamp), so it is dropped too, and
+    updated_date is normalized to the LWW stamp: an origin copy that never
+    replicated its implicit create stamp (updated_date=None, stamp rides
+    created_date) must hash identically to the replicas that carry the
+    stamp explicitly."""
     import hashlib
 
     ref_fields = {field for field, _ in _GOSSIP_REFS.get(kind, ())}
     content = {k: v for k, v in data.items()
-               if k != "id" and k not in ref_fields}
+               if k not in ("id", "created_date") and k not in ref_fields}
+    content["updated_date"] = _gossip_stamp(data)
     content["_refs"] = dict(sorted(ref_tokens.items()))
     blob = json.dumps(content, sort_keys=True, default=str)
     return hashlib.sha1(blob.encode()).hexdigest()
@@ -871,6 +879,38 @@ class RegistryGossip:
             return
         from sitewhere_tpu.web.marshal import to_jsonable
 
+        if op != "delete":
+            # A write to a token this host knows a tombstone for is a
+            # RESURRECTION: its stamp must outrank the delete, or the
+            # same-millisecond case diverges — receiving hosts keep the
+            # token dead (ties favor the delete) while this host keeps
+            # its local copy alive. Stamp the live entity past the
+            # tombstone so every replica compares the same winning pair.
+            key = (tenant, kind, getattr(entity, "token", ""))
+            tomb = self._tombstones.get(key)
+            if tomb is not None and \
+                    _gossip_stamp(to_jsonable(entity)) <= tomb:
+                entity.updated_date = tomb + 1
+                # the row was already saved before this listener fired:
+                # persist the bumped stamp too, or a restart rehydrates
+                # the weaker one and a redelivered delete (same stamp)
+                # kills the entity on this host alone
+                try:
+                    registry.collection_of(kind).persist_quietly(entity)
+                except Exception:
+                    LOGGER.exception("could not persist resurrection "
+                                     "stamp for %s %r", kind, key[2])
+            # A create's LWW stamp implicitly rides created_date — which
+            # deliberately does NOT converge (a host that content-merges
+            # this create keeps its own creation stamp). Make the stamp
+            # EXPLICIT on the live entity so the payload replicates it and
+            # every copy — origin included — compares the same stamp:
+            # without this, a host that adopted the winning create's
+            # content keeps a LOWER stamp (its own created_date) and an
+            # in-flight older create re-wins there alone (observed
+            # divergence in the 3-host storm test).
+            if entity.updated_date is None:
+                entity.updated_date = entity.created_date
         try:
             if op == "delete":
                 # the delete is a write AFTER the entity's last one: stamp

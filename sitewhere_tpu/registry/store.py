@@ -362,6 +362,18 @@ class _Collection(Generic[T]):
             self._discard_replica_locked(token)  # mutation ends the claim
         self._emit("update", entity)
 
+    def persist_quietly(self, entity: T) -> None:
+        """Persist WITHOUT firing listeners or ending a claim window —
+        for metadata-only normalization (the gossip publish side stamps a
+        resurrecting create past its tombstone AFTER create() already
+        saved; the durable row must carry the same stamp or a restart
+        rehydrates a weaker one and a redelivered delete wins here
+        alone)."""
+        with self._lock:
+            self.store.save(self.kind, entity.id,
+                            getattr(entity, "token", ""),
+                            _entity_to_json(entity))
+
     def list(self, criteria: Optional[SearchCriteria] = None,
              where: Optional[Callable[[T], bool]] = None) -> SearchResults[T]:
         with self._lock:

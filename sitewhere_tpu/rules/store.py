@@ -21,8 +21,9 @@ import json
 import logging
 import os
 import threading
-import time
 from typing import Callable, Dict, List, Optional
+
+from sitewhere_tpu.model.common import now_ms
 
 LOGGER = logging.getLogger("sitewhere.rules.store")
 
@@ -88,10 +89,14 @@ class ScriptedRuleStore:
                                  op, tenant, token)
 
     # -- mutations ---------------------------------------------------------
-    def record(self, tenant: str, token: str, script_id: str) -> Dict:
-        """Local install; returns the payload the gossip side publishes."""
+    def record(self, tenant: str, token: str, script_id: str,
+               notify: bool = True) -> Dict:
+        """Local install; returns the payload the gossip side publishes.
+        ``notify=False`` defers the listener fire to the caller (via
+        `emit`) — for callers holding an outer lock who must not publish
+        to peers inside their critical section."""
         with self._lock:
-            stamp = max(int(time.time() * 1000),
+            stamp = max(now_ms(),
                         self._tombstones.get((tenant, token), -1) + 1,
                         self._installs.get((tenant, token),
                                            {"stamp": -1})["stamp"] + 1)
@@ -99,20 +104,30 @@ class ScriptedRuleStore:
             self._installs[(tenant, token)] = payload
             self._tombstones.pop((tenant, token), None)
             self._sync()
-        self._notify("add", tenant, token, payload)
+        if notify:
+            self._notify("add", tenant, token, payload)
         return payload
 
-    def erase(self, tenant: str, token: str) -> Optional[int]:
+    def erase(self, tenant: str, token: str,
+              notify: bool = True) -> Optional[int]:
         """Local removal; returns the tombstone stamp (None if unknown)."""
         with self._lock:
             existing = self._installs.pop((tenant, token), None)
             if existing is None:
                 return None
-            stamp = max(int(time.time() * 1000), existing["stamp"] + 1)
+            stamp = max(now_ms(), existing["stamp"] + 1)
             self._tombstones[(tenant, token)] = stamp
             self._sync()
-        self._notify("remove", tenant, token, stamp)
+        if notify:
+            self._notify("remove", tenant, token, stamp)
         return stamp
+
+    def emit(self, op: str, tenant: str, token: str, payload) -> None:
+        """Fire the deferred listener notification for a record/erase done
+        with ``notify=False`` — call OUTSIDE any lock (listeners publish
+        to peer bus edges). Arrival order across concurrent emitters is
+        unordered; the stamp in the payload is what peers order by."""
+        self._notify(op, tenant, token, payload)
 
     def _add_wins_locked(self, key: tuple, script_id: str,
                          stamp: int) -> bool:
@@ -153,6 +168,11 @@ class ScriptedRuleStore:
             self._tombstones[key] = max(stamp,
                                         self._tombstones.get(key, -1))
             if local is None:
+                # no install to remove, but the tombstone must still be
+                # DURABLE: a remove that arrives before its add (cross-host
+                # reorder) otherwise vanishes on restart and the
+                # redelivered older add resurrects the rule on this host
+                self._sync()
                 return False
             del self._installs[key]
             self._sync()

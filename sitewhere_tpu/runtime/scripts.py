@@ -27,11 +27,11 @@ import logging
 import os
 import re
 import threading
-import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
 
 from sitewhere_tpu.errors import ErrorCode, SiteWhereError
+from sitewhere_tpu.model.common import now_ms
 from sitewhere_tpu.runtime.lifecycle import LifecycleComponent
 
 GLOBAL_SCOPE = "global"
@@ -100,7 +100,10 @@ class ScriptManager(LifecycleComponent):
         self._namespaces: Dict[tuple, Dict[str, Any]] = {}
         # (scope, script_id) -> deletion stamp: an upsert older than the
         # tombstone stays dead; a NEWER one resurrects (same contract as
-        # the registry gossip tombstones, parallel/cluster.py)
+        # the registry gossip tombstones, parallel/cluster.py). DURABLE
+        # (tombstones.json): a checkpoint restore or a post-restart gossip
+        # redelivery replays stale upserts, and without the persisted
+        # stamp a deleted script would come back on this host alone.
         self._tombstones: Dict[tuple, int] = {}
         # mutation listeners: fn(op: "upsert"|"delete", scope, script_id,
         # state_or_stamp) — called AFTER the mutation, outside the lock
@@ -111,7 +114,34 @@ class ScriptManager(LifecycleComponent):
 
     def on_start(self, monitor) -> None:
         if self._data_dir:
+            self._load_tombstones()
             self._load_from_disk()
+
+    def _tombstones_path(self) -> str:
+        return os.path.join(self._data_dir, "scripts", "tombstones.json")
+
+    def _load_tombstones(self) -> None:
+        path = self._tombstones_path()
+        if not os.path.exists(path):
+            return
+        try:
+            with open(path, encoding="utf-8") as fh:
+                rows = json.load(fh)
+            for row in rows:
+                self._tombstones[(row["scope"], row["scriptId"])] = int(
+                    row.get("stamp", 0))
+        except (OSError, ValueError, TypeError, KeyError):
+            # corrupt tombstones must not block startup (same contract as
+            # _load_from_disk for corrupt script dirs)
+            LOGGER.exception("unreadable script tombstones %s", path)
+
+    def _sync_tombstones_locked(self) -> None:
+        if not self._data_dir:
+            return
+        rows = [{"scope": s, "scriptId": sid, "stamp": stamp}
+                for (s, sid), stamp in sorted(self._tombstones.items())]
+        os.makedirs(os.path.join(self._data_dir, "scripts"), exist_ok=True)
+        self._atomic_write(self._tombstones_path(), json.dumps(rows))
 
     def _scope_dir(self, scope: str) -> str:
         # Percent-encode: collision-free for arbitrary scopes ("a/b" vs
@@ -167,6 +197,8 @@ class ScriptManager(LifecycleComponent):
         entries = []
         for scope_name in os.listdir(root):
             scope_dir = os.path.join(root, scope_name)
+            if not os.path.isdir(scope_dir):
+                continue  # tombstones.json lives beside the scope dirs
             for script_id in os.listdir(scope_dir):
                 entries.append((scope_name, scope_dir, script_id))
         loaded = []
@@ -221,6 +253,14 @@ class ScriptManager(LifecycleComponent):
         with open(meta_path) as fh:
             meta = json.load(fh)
         scope = meta.get("scope", scope_name)
+        # a crash between tombstone persist and file removal leaves both:
+        # the tombstone outranks the stale files, finish the delete here
+        tomb = self._tombstones.get((scope, meta["scriptId"]), -1)
+        if int(meta.get("updatedMs", 0)) <= tomb:
+            import shutil
+            shutil.rmtree(os.path.join(scope_dir, script_id),
+                          ignore_errors=True)
+            return None
         info = ScriptInfo(
             script_id=meta["scriptId"], name=meta.get("name", ""),
             description=meta.get("description", ""),
@@ -256,10 +296,6 @@ class ScriptManager(LifecycleComponent):
             except Exception:
                 LOGGER.exception("script listener failed for %s %s/%s",
                                  op, scope, script_id)
-
-    @staticmethod
-    def _now_ms() -> int:
-        return int(time.time() * 1000)
 
     def export_script(self, scope: str, script_id: str) -> Dict[str, Any]:
         """Full replicable state of one script: metadata + every version's
@@ -301,6 +337,14 @@ class ScriptManager(LifecycleComponent):
         compares the same keys and picks the same winner). Idempotent;
         never fires listeners. Returns True when applied."""
         scope, script_id = state["scope"], state["scriptId"]
+        # same path-safety contract as create_script: the id becomes a
+        # filesystem component in _sync_to_disk, and a replicated payload
+        # must not be able to write (or later rmtree) outside the scope
+        # directory
+        if not _ID_RE.match(script_id):
+            raise SiteWhereError(
+                f"replicated script id {script_id!r} invalid: must match "
+                f"{_ID_RE.pattern}", http_status=400)
         incoming = (int(state.get("updatedMs", 0)),
                     self._state_digest(state))
         with self._lock:
@@ -347,7 +391,8 @@ class ScriptManager(LifecycleComponent):
                 self._content.update(old_content)
                 raise
             self._scripts[key] = info
-            self._tombstones.pop(key, None)
+            if self._tombstones.pop(key, None) is not None:
+                self._sync_tombstones_locked()
             self._sync_to_disk(scope, info)
             return True
 
@@ -361,6 +406,7 @@ class ScriptManager(LifecycleComponent):
                 return False  # local write is newer: delete loses
             self._tombstones[key] = max(stamp,
                                         self._tombstones.get(key, -1))
+            self._sync_tombstones_locked()
             if info is None:
                 return False
             self._delete_locked(scope, script_id)
@@ -386,10 +432,11 @@ class ScriptManager(LifecycleComponent):
             # same millisecond must still replicate) and clear it
             info = ScriptInfo(script_id=script_id, name=name or script_id,
                               description=description,
-                              updated_ms=max(self._now_ms(),
+                              updated_ms=max(now_ms(),
                                              self._tombstones.get(key, -1)
                                              + 1))
-            self._tombstones.pop(key, None)
+            if self._tombstones.pop(key, None) is not None:
+                self._sync_tombstones_locked()
             self._scripts[key] = info
             version = self._add_version_locked(key, content, "initial")
             if activate:
@@ -417,8 +464,11 @@ class ScriptManager(LifecycleComponent):
             key = (scope, script_id)
             # stamp past the script's last write so a concurrent remote
             # update with an older stamp cannot resurrect it
-            stamp = max(self._now_ms(), info.updated_ms + 1)
+            stamp = max(now_ms(), info.updated_ms + 1)
             self._tombstones[key] = stamp
+            # tombstone durable BEFORE the files go: a crash in between
+            # leaves dir + tombstone, which _load_one reconciles at boot
+            self._sync_tombstones_locked()
             self._delete_locked(scope, script_id)
         self._notify("delete", scope, script_id, stamp)
 
@@ -442,7 +492,7 @@ class ScriptManager(LifecycleComponent):
         info = self._scripts[key]
         version = ScriptVersion(
             version_id=f"v{len(info.versions) + 1}", comment=comment,
-            created_ms=int(time.time() * 1000))
+            created_ms=now_ms())
         info.versions.append(version)
         self._content[key + (version.version_id,)] = content
         return version
@@ -458,7 +508,7 @@ class ScriptManager(LifecycleComponent):
                 self._activate_locked(key, version.version_id)
             # monotonic past the previous write: same-millisecond
             # mutations must still order under last-writer-wins
-            info.updated_ms = max(self._now_ms(), info.updated_ms + 1)
+            info.updated_ms = max(now_ms(), info.updated_ms + 1)
             self._sync_to_disk(scope, info)
         self._notify("upsert", scope, script_id,
                      self.export_script(scope, script_id))
@@ -522,7 +572,7 @@ class ScriptManager(LifecycleComponent):
         with self._lock:
             info = self.get_script(scope, script_id)
             self._activate_locked((scope, script_id), version_id)
-            info.updated_ms = max(self._now_ms(), info.updated_ms + 1)
+            info.updated_ms = max(now_ms(), info.updated_ms + 1)
             self._sync_to_disk(scope, info)
         self._notify("upsert", scope, script_id,
                      self.export_script(scope, script_id))

@@ -273,11 +273,11 @@ def register_all(router: Router, instance, server) -> None:
             raise SiteWhereError(
                 "scripted rules require 'token' and 'script'",
                 http_status=400)
-        # one token namespace across fused AND scripted rules
+        # one token namespace across fused AND scripted rules (the
+        # scripted side's duplicate check is add_processor's atomic one,
+        # inside install_scripted_rule)
         if instance.pipeline_engine is not None \
                 and instance.pipeline_engine.get_rule(token)[0] is not None:
-            raise DuplicateTokenError(f"rule '{token}' already exists")
-        if _scripted_rules(request).get_processor(token) is not None:
             raise DuplicateTokenError(f"rule '{token}' already exists")
         instance.install_scripted_rule(request.tenant or "default", token,
                                        script_id)

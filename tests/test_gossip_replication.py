@@ -442,3 +442,243 @@ class TestCreateCreateRace:
         with pytest.raises(DuplicateTokenError):
             reg_b.create_device(Device(token="cl",
                                        device_type_id=dt_b.id))
+
+
+# ---------------------------------------------------------------------------
+# N = 3: arrival orders that cannot exist with two hosts.
+# ---------------------------------------------------------------------------
+
+def _mesh3(prefix="tri"):
+    """Three hosts, one Capture per DIRECTED peer link. Returns
+    (registries, gossips, links) where links[i][j] is the stream host i
+    published toward host j (RegistryGossip sends every payload to every
+    peer; per-link captures let a test deliver them asymmetrically —
+    exactly the degree of freedom a 2-host mesh lacks)."""
+    registries, gossips, links = [], [], {}
+    for pid in range(3):
+        instance = SiteWhereInstance(instance_id=f"{prefix}-{pid}")
+        instance.start()
+        peers = {other: _Capture() for other in range(3) if other != pid}
+        gossip = RegistryGossip(pid, peers, instance, instance.naming)
+        registry = instance.get_tenant_engine("default").registry
+        gossip.register_tenant_registry("default", registry)
+        registries.append(registry)
+        gossips.append(gossip)
+        for other, cap in peers.items():
+            links[(pid, other)] = cap
+    return registries, gossips, links
+
+
+def _deliver_all(gossips, links, rounds=4):
+    """Drain every directed link into its destination until quiescent."""
+    for _ in range(rounds):
+        moved = False
+        for (src, dst), cap in links.items():
+            payloads = cap.drain()
+            if payloads:
+                moved = True
+                _apply(gossips[dst], payloads)
+        if not moved:
+            return
+    raise AssertionError("gossip mesh did not quiesce")
+
+
+class TestThreeHostDependencies:
+    """Transitive dependency arrival orders only possible at N>=3: the
+    dependency and the dependent originate on DIFFERENT hosts, so a third
+    host can receive the dependent first (two hosts can only reorder one
+    producer's stream, which the token-partitioned transport forbids)."""
+
+    def test_dependent_from_b_arrives_before_dependency_from_a(self):
+        registries, gossips, links = _mesh3("dep3")
+        reg_a, reg_b, reg_c = registries
+
+        atype = reg_a.create_area_type(AreaType(token="at3", name="site"))
+        type_to_b = links[(0, 1)].drain()
+        type_to_c = links[(0, 2)].drain()
+        _apply(gossips[1], type_to_b)  # B learns the type; C does NOT yet
+        area = reg_b.create_area(Area(
+            token="ar3", area_type_id=reg_b.area_types.get_by_token("at3").id))
+        area_to_c = links[(1, 2)].drain()
+
+        # C sees B's dependent BEFORE A's dependency: the apply raises (the
+        # consumer's at-least-once redelivery is the retry path)
+        with pytest.raises(Exception):
+            _apply(gossips[2], area_to_c)
+        assert reg_c.areas.get_by_token("ar3") is None
+
+        # the dependency lands, then the redelivered dependent applies
+        _apply(gossips[2], type_to_c)
+        _apply(gossips[2], area_to_c)
+        c_area = reg_c.areas.get_by_token("ar3")
+        assert c_area is not None
+        # the token-carried reference resolved against C's own collection
+        assert c_area.area_type_id == reg_c.area_types.get_by_token("at3").id
+
+    def test_three_origin_chain_resolves_in_one_reversed_batch(self):
+        """area_type from A, area from B, zone from C's OWN peer stream —
+        all three arrive at the remaining host in ONE batch, worst-case
+        (dependents first). The multi-pass applier must resolve the full
+        chain without redelivery."""
+        registries, gossips, links = _mesh3("chain3")
+        reg_a, reg_b, reg_c = registries
+
+        reg_a.create_area_type(AreaType(token="atc"))
+        type_payloads = links[(0, 1)].drain()
+        links[(0, 2)].drain()
+        _apply(gossips[1], type_payloads)
+        reg_b.create_area(Area(
+            token="arc", area_type_id=reg_b.area_types.get_by_token("atc").id))
+        area_payloads = links[(1, 2)].drain()
+        links[(1, 0)].drain()
+        _apply(gossips[2], type_payloads)
+        _apply(gossips[2], area_payloads)
+        reg_c.create_zone(Zone(
+            token="znc", area_id=reg_c.areas.get_by_token("arc").id))
+        zone_payloads = links[(2, 0)].drain()
+
+        # host A has ONLY its own area_type; zone + area + type arrive as
+        # one batch, dependents first
+        batch = zone_payloads + area_payloads + type_payloads
+        _apply(gossips[0], batch)
+        a_zone = reg_a.zones.get_by_token("znc")
+        a_area = reg_a.areas.get_by_token("arc")
+        assert a_zone is not None and a_area is not None
+        assert a_zone.area_id == a_area.id
+        assert a_area.area_type_id == reg_a.area_types.get_by_token("atc").id
+
+
+class TestThreeHostLww:
+    def _provisioned_trio(self):
+        registries, gossips, links = _mesh3("lww3")
+        registries[0].create_device_type(DeviceType(token="dt"))
+        registries[0].create_device(Device(
+            token="dv",
+            device_type_id=registries[0].device_types.get_by_token("dt").id))
+        _deliver_all(gossips, links)
+        return registries, gossips, links
+
+    def test_concurrent_triple_update_converges_identically(self):
+        """Three hosts update the same device concurrently; every host
+        receives the other two streams in a DIFFERENT interleaving. All
+        three must pick the same winner (stamp, then host-independent
+        digest tiebreak)."""
+        registries, gossips, links = self._provisioned_trio()
+        for pid, reg in enumerate(registries):
+            reg.update_device("dv", {"comments": f"from-{pid}"})
+        streams = {pid: links[(pid, (pid + 1) % 3)].drain() for pid in range(3)}
+        for pid in range(3):
+            links[(pid, (pid + 2) % 3)].drain()  # same payloads, other link
+        # asymmetric delivery orders per destination
+        _apply(gossips[0], streams[1] + streams[2])
+        _apply(gossips[1], streams[2] + streams[0])
+        _apply(gossips[2], streams[0] + streams[1])
+        _deliver_all(gossips, links)  # claim echoes etc.
+        comments = {reg.get_device_by_token("dv").comments
+                    for reg in registries}
+        assert len(comments) == 1, comments
+
+    def test_delete_update_race_at_three_hosts(self):
+        """A deletes while B updates with a LATER stamp; C hears the
+        delete first, then the update — and in the opposite order on A.
+        Everyone must converge on the resurrected update."""
+        registries, gossips, links = self._provisioned_trio()
+        reg_a, reg_b, reg_c = registries
+        reg_a.delete_device("dv")
+        delete_b = links[(0, 1)].drain()
+        delete_c = links[(0, 2)].drain()
+        # B updates concurrently (it has not heard the delete yet), with a
+        # stamp past the delete's
+        import time as _time
+        _time.sleep(0.002)
+        reg_b.update_device("dv", {"comments": "survivor"})
+        update_a = links[(1, 0)].drain()
+        update_c = links[(1, 2)].drain()
+
+        _apply(gossips[2], delete_c)          # C: delete first...
+        assert reg_c.devices.get_by_token("dv") is None
+        _apply(gossips[2], update_c)          # ...then the later update
+        _apply(gossips[0], update_a)          # A: update after its own delete
+        _apply(gossips[1], delete_b)          # B: delete after its update
+        _deliver_all(gossips, links)
+        for name, reg in (("a", reg_a), ("b", reg_b), ("c", reg_c)):
+            device = reg.devices.get_by_token("dv")
+            assert device is not None, f"host {name} lost the resurrection"
+            assert device.comments == "survivor", (name, device.comments)
+
+
+class TestThreeHostStorm:
+    """Randomized three-host mutation storm with asymmetric chunked
+    delivery between all six directed links: content must converge to
+    IDENTICAL host-independent registries on all three. Seeded."""
+
+    def _content(self, reg):
+        from sitewhere_tpu.web.marshal import to_jsonable
+
+        out = {}
+        for device in reg.devices.all():
+            data = to_jsonable(device)
+            dtype = reg.device_types.get(device.device_type_id)
+            out[device.token] = {
+                k: v for k, v in data.items()
+                if k not in ("id", "device_type_id", "created_date")}
+            out[device.token]["_type"] = dtype.token if dtype else None
+        return out
+
+    @pytest.mark.parametrize("seed", [90210, 7, 4321])
+    def test_randomized_three_host_storm_converges(self, seed,
+                                                   monkeypatch):
+        import random as _random
+
+        from sitewhere_tpu.errors import SiteWhereError
+        from sitewhere_tpu.model import common as _common
+
+        rng = _random.Random(seed)
+        # Deterministic clock with HEAVY same-millisecond collision
+        # density: wall time made the outcome depend on machine load
+        # (ties only form when ops land in the same real ms). Advancing
+        # 1 ms every ~5 stamps reproduces the worst tie storms on every
+        # run, on any machine.
+        ticks = {"n": 0}
+
+        def fake_now():
+            ticks["n"] += 1
+            return 1_700_000_000_000 + ticks["n"] // 5
+
+        monkeypatch.setattr(_common, "_now_ms_override", fake_now)
+        registries, gossips, links = _mesh3("storm3")
+        registries[0].create_device_type(DeviceType(token="st"))
+        _deliver_all(gossips, links)
+        dts = [reg.device_types.get_by_token("st") for reg in registries]
+
+        tokens = [f"sd{i}" for i in range(10)]
+        for _round in range(5):
+            for reg, dt in zip(registries, dts):
+                for _ in range(6):
+                    token = rng.choice(tokens)
+                    op = rng.random()
+                    try:
+                        if op < 0.45:
+                            reg.create_device(Device(
+                                token=token, device_type_id=dt.id,
+                                comments=f"c{rng.randrange(1000)}"))
+                        elif op < 0.8:
+                            reg.update_device(token, {
+                                "comments": f"u{rng.randrange(1000)}"})
+                        else:
+                            reg.delete_device(token)
+                    except SiteWhereError:
+                        pass
+            # asymmetric chunked delivery: each directed link drains in
+            # random chunk sizes, links visited in random order
+            streams = {edge: cap.drain() for edge, cap in links.items()}
+            while any(streams.values()):
+                edges = [e for e, s in streams.items() if s]
+                rng.shuffle(edges)
+                for src, dst in edges:
+                    n = rng.randrange(1, 4)
+                    _apply(gossips[dst], streams[(src, dst)][:n])
+                    streams[(src, dst)] = streams[(src, dst)][n:]
+        _deliver_all(gossips, links, rounds=6)
+        contents = [self._content(reg) for reg in registries]
+        assert contents[0] == contents[1] == contents[2]

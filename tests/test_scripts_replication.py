@@ -185,6 +185,17 @@ class TestScriptedRuleStore:
         assert not store.apply_add("t", "r", "s3", 250)  # behind tombstone
         assert store.apply_add("t", "r", "s3", 400)      # resurrect
 
+    def test_remove_before_add_tombstone_survives_restart(self, tmp_path):
+        """Cross-host reorder: the remove reaches this host before the
+        add it removes. The tombstone must be durable even though there
+        was no local install to delete — otherwise a restart forgets it
+        and the redelivered (older) add resurrects the rule here alone."""
+        store = ScriptedRuleStore(data_dir=str(tmp_path))
+        assert not store.apply_remove("t", "r", 500)  # nothing local yet
+        reloaded = ScriptedRuleStore(data_dir=str(tmp_path))
+        assert not reloaded.apply_add("t", "r", "s1", 400)  # stays dead
+        assert reloaded.apply_add("t", "r", "s1", 600)      # newer wins
+
 
 def _gossip_host(instance_id):
     class _Capture:
@@ -323,4 +334,35 @@ class TestDurableRestarts:
             "default", "counter") == COUNTER_SCRIPT
         eng = revived.get_tenant_engine("default")
         assert eng.rule_processors.get_processor("count-rule") is not None
+        revived.stop()
+
+    def test_deleted_script_does_not_resurrect_from_checkpoint(
+            self, tmp_path):
+        """A periodic checkpoint captures script S; the operator then
+        deletes S. The boot restore replays the (stale) checkpointed
+        script state — the DURABLE script tombstone must keep S dead."""
+        data_dir = str(tmp_path / "host")
+        kwargs = dict(enable_pipeline=True, max_devices=64, max_zones=4,
+                      max_zone_vertices=4, batch_size=16)
+        inst = SiteWhereInstance(instance_id="tomb", data_dir=data_dir,
+                                 **kwargs)
+        inst.start()
+        inst.script_manager.create_script("default", "doomed",
+                                          COUNTER_SCRIPT)
+        inst.checkpoint_manager.save()  # S is in the checkpoint
+        inst.script_manager.delete_script("default", "doomed")
+        inst.stop()
+
+        revived = SiteWhereInstance(instance_id="tomb", data_dir=data_dir,
+                                    **kwargs)
+        revived.start()
+        with pytest.raises(SiteWhereError):
+            revived.script_manager.get_script("default", "doomed")
+        # and a post-restart gossip redelivery of the stale upsert (older
+        # stamp than the tombstone) must stay dead too
+        assert not revived.script_manager.apply_replicated({
+            "scope": "default", "scriptId": "doomed", "updatedMs": 1,
+            "activeVersion": "v1",
+            "versions": [{"versionId": "v1"}],
+            "contents": {"v1": COUNTER_SCRIPT}})
         revived.stop()
