@@ -129,21 +129,37 @@ class CommandDeliveryService(LifecycleComponent):
 
     def deliver(self, invocation: DeviceCommandInvocation) -> None:
         """Synchronous delivery path, also callable directly (tests, REST)."""
+        from sitewhere_tpu.commands.encoding import calculate_nesting
+
         execution = self.strategy.create_execution(invocation)
         for device, assignment in self.targets.resolve(invocation):
-            for destination in self._route(execution, device, assignment):
-                destination.deliver_command(execution, device, assignment)
+            # composite targets deliver THROUGH their gateway
+            # (DefaultCommandProcessingStrategy.java:74); routing selects
+            # the destination by the GATEWAY's device type — the transport
+            # that physically carries the frame
+            # (DeviceTypeMappingCommandRouter routes on the gateway)
+            nesting = calculate_nesting(self.registry, device)
+            for destination in self._route(execution, nesting.gateway,
+                                           assignment):
+                destination.deliver_command(execution, device, assignment,
+                                            nesting=nesting)
                 self.delivered_meter.mark(1)
 
     def send_system_command(self, device_token: str,
                             command: SystemCommand) -> None:
         """Deliver a system message (e.g. registration ack) to one device
         (CommandRoutingLogic.routeSystemCommand)."""
+        from sitewhere_tpu.commands.encoding import calculate_nesting
+
         device = self.registry.get_device_by_token(device_token)
         if device is None:
             raise SiteWhereError(f"unknown device '{device_token}'")
-        for destination in self._route(None, device, None):
-            destination.deliver_system_command(command, device)
+        # composite children receive system traffic (registration acks)
+        # through their gateway's transport, like regular commands
+        nesting = calculate_nesting(self.registry, device)
+        for destination in self._route(None, nesting.gateway, None):
+            destination.deliver_system_command(command, device,
+                                               nesting=nesting)
 
     def _route(self, execution: Optional[CommandExecution], device: Device,
                assignment: Optional[DeviceAssignment]

@@ -264,17 +264,53 @@ class CommandDestination(LifecycleComponent):
         self.encoder = encoder or WireCommandEncoder()
         self.extractor = extractor or MqttParameterExtractor()
         self.provider = provider
+        self._encoder_accepts_nesting: Optional[bool] = None
         if isinstance(provider, LifecycleComponent):
             self.add_nested(provider)
 
     def deliver_command(self, execution: CommandExecution, device: Device,
-                        assignment: Optional[DeviceAssignment]) -> None:
-        encoded = self.encoder.encode(execution, device, assignment)
-        parameters = self.extractor.extract(device, assignment)
-        self.provider.deliver(device, encoded, parameters)
+                        assignment: Optional[DeviceAssignment],
+                        nesting=None) -> None:
+        """Encode + extract + deliver. With a nesting context the
+        TRANSPORT addresses the gateway (its MQTT topic / CoAP endpoint /
+        phone number) while the payload addresses the nested target —
+        CommandDestination.deliverCommand:60 passing nesting to both the
+        encoder and the parameter extractor."""
+        encoded = self._encode(execution, device, assignment, nesting)
+        transport_device = (nesting.gateway if nesting is not None
+                            else device)
+        parameters = self.extractor.extract(transport_device, assignment)
+        self.provider.deliver(transport_device, encoded, parameters)
+
+    def _encode(self, execution, device, assignment, nesting) -> bytes:
+        if nesting is None:
+            return self.encoder.encode(execution, device, assignment)
+        accepts = self._encoder_accepts_nesting
+        if accepts is None:
+            # resolved once per destination: third-party encoders may
+            # predate the nesting-aware CommandEncoder protocol
+            import inspect
+            try:
+                accepts = "nesting" in inspect.signature(
+                    self.encoder.encode).parameters
+            except (TypeError, ValueError):
+                accepts = False
+            self._encoder_accepts_nesting = accepts
+        if accepts:
+            return self.encoder.encode(execution, device, assignment,
+                                       nesting=nesting)
+        # encoder predates the nesting-aware protocol: deliver without
+        # payload-level nesting (gateway addressing still applies)
+        return self.encoder.encode(execution, device, assignment)
 
     def deliver_system_command(self, command: SystemCommand,
-                               device: Device) -> None:
+                               device: Device, nesting=None) -> None:
+        """System payloads always name the TARGET device; with a nesting
+        context the transport (topic/endpoint/phone) addresses the
+        gateway that physically carries it — same split as
+        deliver_command."""
         encoded = self.encoder.encode_system(command, device)
-        parameters = self.extractor.extract(device, None)
-        self.provider.deliver_system(device, encoded, parameters)
+        transport_device = (nesting.gateway if nesting is not None
+                            else device)
+        parameters = self.extractor.extract(transport_device, None)
+        self.provider.deliver_system(transport_device, encoded, parameters)

@@ -39,9 +39,64 @@ class SystemCommand:
     payload: bytes
 
 
+@dataclass
+class DeviceNestingContext:
+    """How to address the target through its gateway
+    (IDeviceNestingContext; commands/NestedDeviceSupport.java:69). For a
+    standalone device the gateway IS the target and `nested` is None;
+    for a composite-mapped device the transport delivers to `gateway`
+    and the payload addresses `nested` at the schema `path`."""
+
+    gateway: Device
+    nested: Optional[Device] = None
+    path: str = ""
+
+    @property
+    def target(self) -> Device:
+        return self.nested if self.nested is not None else self.gateway
+
+
+def calculate_nesting(registry, target: Device) -> DeviceNestingContext:
+    """NestedDeviceSupport.calculateNestedDeviceInformation:32 — resolve
+    the gateway whose transport physically carries the target's traffic;
+    fall back to the target as its own gateway when unparented (or
+    unmapped, which the reference treats the same way).
+
+    Multi-level composites (A hosts B hosts C) resolve to the ROOT
+    unparented ancestor — only the root has a physical connection — with
+    the schema paths of every hop joined into one address
+    ("busA/slotB/busB/slotC")."""
+    path_segments = []
+    node = target
+    seen = {target.id}
+    while node.parent_device_id:
+        if node.parent_device_id in seen:
+            break  # corrupt cycle (replication race): stop at this node
+        seen.add(node.parent_device_id)
+        parent = registry.devices.get(node.parent_device_id)
+        if parent is None:
+            # dangling backreference (parent deleted out-of-band, e.g. a
+            # replicated tombstone landing before the child update):
+            # deliver to the highest resolvable ancestor rather than
+            # failing the command
+            break
+        mapping = next((m for m in parent.device_element_mappings
+                        if m.device_token == node.token), None)
+        if mapping is None:
+            break
+        path_segments.append(mapping.device_element_schema_path)
+        node = parent
+    if node is target:
+        return DeviceNestingContext(gateway=target)
+    return DeviceNestingContext(
+        gateway=node, nested=target,
+        path="/".join(reversed(path_segments)))
+
+
 class CommandEncoder(Protocol):
     def encode(self, execution: CommandExecution, device: Device,
-               assignment: Optional[DeviceAssignment]) -> bytes: ...
+               assignment: Optional[DeviceAssignment],
+               nesting: Optional[DeviceNestingContext] = None) -> bytes: ...
 
     def encode_system(self, command: SystemCommand, device: Device) -> bytes: ...
 
@@ -51,10 +106,17 @@ class WireCommandEncoder:
     (counterpart of ProtobufExecutionEncoder)."""
 
     def encode(self, execution: CommandExecution, device: Device,
-               assignment: Optional[DeviceAssignment]) -> bytes:
+               assignment: Optional[DeviceAssignment],
+               nesting: Optional[DeviceNestingContext] = None) -> bytes:
+        parameters = dict(execution.parameters)
+        if nesting is not None and nesting.nested is not None:
+            # gateway-addressed frame carrying the nested target: the
+            # device-side dispatcher routes on these reserved keys
+            parameters["_nestedPath"] = nesting.path
+            parameters["_nestedToken"] = nesting.nested.token
         payload = WireCodec.encode_command(
             token=device.token, command=execution.command.name,
-            parameters=execution.parameters,
+            parameters=parameters,
             invocation_id=execution.invocation.id)
         return encode_frame(MessageType.COMMAND, payload)
 
@@ -66,14 +128,20 @@ class JsonCommandEncoder:
     """Encode as a JSON document (JsonCommandExecutionEncoder)."""
 
     def encode(self, execution: CommandExecution, device: Device,
-               assignment: Optional[DeviceAssignment]) -> bytes:
-        return json.dumps({
+               assignment: Optional[DeviceAssignment],
+               nesting: Optional[DeviceNestingContext] = None) -> bytes:
+        doc = {
             "deviceToken": device.token,
             "command": execution.command.name,
             "namespace": execution.command.namespace,
             "invocationId": execution.invocation.id,
             "parameters": execution.parameters,
-        }).encode("utf-8")
+        }
+        if nesting is not None and nesting.nested is not None:
+            doc["nesting"] = {"gateway": nesting.gateway.token,
+                              "nested": nesting.nested.token,
+                              "path": nesting.path}
+        return json.dumps(doc).encode("utf-8")
 
     def encode_system(self, command: SystemCommand, device: Device) -> bytes:
         return json.dumps({
@@ -85,15 +153,27 @@ class JsonCommandEncoder:
 
 class ScriptedCommandEncoder:
     """User-supplied callable `(execution, device, assignment) -> bytes`
-    (GroovyCommandExecutionEncoder's extension point)."""
+    (GroovyCommandExecutionEncoder's extension point). Scripts that
+    declare a `nesting` keyword receive the composite-delivery context;
+    legacy three-argument scripts keep working."""
 
     def __init__(self, script: Callable[..., bytes],
                  system_script: Optional[Callable[..., bytes]] = None):
         self.script = script
         self.system_script = system_script
+        import inspect
+        try:
+            self._script_accepts_nesting = "nesting" in \
+                inspect.signature(script).parameters
+        except (TypeError, ValueError):
+            self._script_accepts_nesting = False
 
     def encode(self, execution: CommandExecution, device: Device,
-               assignment: Optional[DeviceAssignment]) -> bytes:
+               assignment: Optional[DeviceAssignment],
+               nesting: Optional[DeviceNestingContext] = None) -> bytes:
+        if self._script_accepts_nesting:
+            return self.script(execution, device, assignment,
+                               nesting=nesting)
         return self.script(execution, device, assignment)
 
     def encode_system(self, command: SystemCommand, device: Device) -> bytes:

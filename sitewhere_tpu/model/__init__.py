@@ -29,6 +29,10 @@ from sitewhere_tpu.model.device import (
     DeviceAlarm,
     DeviceAlarmState,
     DeviceElementMapping,
+    DeviceElementSchema,
+    DeviceSlot,
+    DeviceUnit,
+    find_device_slot,
     DeviceStream,
 )
 from sitewhere_tpu.model.area import (

@@ -20,12 +20,64 @@ class DeviceContainerPolicy(enum.Enum):
 
 
 @dataclass
+class DeviceSlot:
+    """Position where a child device may insert into a composite parent
+    (spi/device/element/IDeviceSlot.java). `path` is the slot's segment
+    within its containing unit."""
+
+    name: str = ""
+    path: str = ""
+
+
+@dataclass
+class DeviceUnit:
+    """Logical group of related slots and subordinate units
+    (spi/device/element/IDeviceUnit.java). `path` is this unit's segment
+    within its parent."""
+
+    name: str = ""
+    path: str = ""
+    device_slots: List[DeviceSlot] = field(default_factory=list)
+    device_units: List["DeviceUnit"] = field(default_factory=list)
+
+
+@dataclass
+class DeviceElementSchema(DeviceUnit):
+    """Root unit of a composite type's nesting schema
+    (spi/device/element/IDeviceElementSchema.java — an IDeviceUnit whose
+    own path is empty; slot paths address through nested unit segments,
+    e.g. "bus/slot1")."""
+
+
+def find_device_slot(schema: Optional[DeviceElementSchema],
+                     path: str) -> Optional[DeviceSlot]:
+    """Walk a '/'-separated schema path to its DeviceSlot, or None when
+    any segment is missing (DeviceTypeUtils.getDeviceSlotByPath:62-90:
+    every segment but the last names a nested unit; the last names a
+    slot of the unit reached)."""
+    if schema is None:
+        return None
+    segments = [s for s in path.split("/") if s]
+    if not segments:
+        return None
+    unit: DeviceUnit = schema
+    for segment in segments[:-1]:
+        unit = next((u for u in unit.device_units if u.path == segment),
+                    None)
+        if unit is None:
+            return None
+    return next((s for s in unit.device_slots
+                 if s.path == segments[-1]), None)
+
+
+@dataclass
 class DeviceType(BrandedEntity):
     """Hardware/firmware class of devices (IDeviceType)."""
 
     container_policy: DeviceContainerPolicy = DeviceContainerPolicy.STANDALONE
-    # For COMPOSITE types: named slots/units a child device can map into.
-    device_element_schema: Dict[str, str] = field(default_factory=dict)
+    # For COMPOSITE types: the unit/slot tree child devices map into
+    # (None for standalone types).
+    device_element_schema: Optional[DeviceElementSchema] = None
 
 
 class ParameterType(enum.Enum):

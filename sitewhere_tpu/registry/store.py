@@ -48,10 +48,28 @@ def _entity_to_json(entity: Any) -> str:
     return json.dumps(dataclasses.asdict(entity), default=default)
 
 
+def _element_schema_from_dict(data: dict):
+    """Recursive unit/slot tree decode (IDeviceElementSchema)."""
+    from sitewhere_tpu.model.device import (
+        DeviceElementSchema, DeviceSlot, DeviceUnit)
+
+    def unit(d: dict, cls):
+        return cls(
+            name=d.get("name", ""), path=d.get("path", ""),
+            device_slots=[DeviceSlot(name=s.get("name", ""),
+                                     path=s.get("path", ""))
+                          for s in d.get("device_slots", [])],
+            device_units=[unit(u, DeviceUnit)
+                          for u in d.get("device_units", [])])
+
+    return unit(data, DeviceElementSchema)
+
+
 _NESTED_FIELDS: Dict[Type, Dict[str, Callable[[dict], Any]]] = {
     Device: {"device_element_mappings": lambda d: DeviceElementMapping(**d)},
     DeviceCommand: {"parameters": lambda d: CommandParameter(
         name=d["name"], type=ParameterType(d["type"]), required=d["required"])},
+    DeviceType: {"device_element_schema": _element_schema_from_dict},
 }
 
 
@@ -66,6 +84,8 @@ def _entity_from_json(cls: Type[T], payload: str) -> T:
         ftype = fields[key].type
         if key in nested and isinstance(val, list):
             val = [nested[key](v) for v in val]
+        elif key in nested and isinstance(val, dict):
+            val = nested[key](val)
         elif isinstance(ftype, str):
             # enum-typed fields are stored by value
             resolved = _ENUM_TYPES.get(ftype)
@@ -316,7 +336,18 @@ class _Collection(Generic[T]):
             for key in updates:
                 if not hasattr(entity, key):
                     raise SiteWhereError(f"unknown field '{key}' on {self.kind}")
+            nested = _NESTED_FIELDS.get(self.cls, {})
             for key, val in updates.items():
+                # REST updates carry nested structures as plain dicts:
+                # coerce through the same decoders the load path uses so
+                # in-memory state always holds typed objects (internal
+                # callers pass dataclasses and skip this)
+                if key in nested:
+                    if isinstance(val, dict):
+                        val = nested[key](val)
+                    elif isinstance(val, list):
+                        val = [nested[key](v) if isinstance(v, dict) else v
+                               for v in val]
                 setattr(entity, key, val)
             if not self._is_replicating():
                 entity.touch(username)
@@ -647,6 +678,25 @@ class DeviceManagement:
         if active is not None:
             raise SiteWhereError("device has an active assignment",
                                  ErrorCode.DEVICE_ALREADY_ASSIGNED)
+        # deleting a composite gateway releases its children (clear the
+        # parent backreferences so nesting lookups can't dangle); a
+        # mapped CHILD must be unmapped first (the parent still lists
+        # it). A DANGLING backreference — live parent gone or no longer
+        # listing the mapping (replicated tombstone orderings) — must
+        # not block deletion forever.
+        if entity.parent_device_id:
+            parent = self.devices.get(entity.parent_device_id)
+            if parent is not None and any(
+                    m.device_token == token
+                    for m in parent.device_element_mappings):
+                raise SiteWhereError(
+                    f"device '{token}' is mapped into a composite "
+                    f"parent; delete the mapping first", ErrorCode.GENERIC,
+                    http_status=409)
+        for mapping in entity.device_element_mappings:
+            child = self.devices.get_by_token(mapping.device_token)
+            if child is not None and child.parent_device_id == entity.id:
+                self.update_device(child.token, {"parent_device_id": ""})
         result = self.devices.delete(entity.id)
         self._notify("device", result)
         return result
@@ -666,6 +716,81 @@ class DeviceManagement:
             return True
 
         return self.devices.list(criteria, where)
+
+    # -- composite-device element mappings -------------------------------------
+
+    def create_device_element_mapping(self, device_token: str,
+                                      mapping: "DeviceElementMapping"
+                                      ) -> Device:
+        """Map a child device into a slot of a composite parent
+        (DeviceManagementPersistence.deviceElementMappingCreateLogic:657):
+        the child must exist and be unparented, the path must resolve to a
+        DeviceSlot in the parent TYPE's element schema, and the path must
+        be unmapped. Sets the child's parent backreference; both updates
+        ride the normal mutation feed (replicated, durable)."""
+        from sitewhere_tpu.model.device import find_device_slot
+
+        device = self.devices.require_by_token(device_token)
+        mapped = self.devices.get_by_token(mapping.device_token)
+        if mapped is None:
+            raise NotFoundError(
+                f"mapping references unknown device "
+                f"'{mapping.device_token}'", ErrorCode.INVALID_DEVICE_TOKEN)
+        if mapped.parent_device_id:
+            raise SiteWhereError(
+                f"device '{mapped.token}' is already mapped into another "
+                f"composite device", ErrorCode.GENERIC, http_status=409)
+        # no self-mapping and no cycles: the child may not appear on the
+        # gateway's own parent chain (A->A, or A->B when B is already an
+        # ancestor of A, would make nesting resolution circular)
+        ancestor = device
+        while ancestor is not None:
+            if ancestor.id == mapped.id:
+                raise SiteWhereError(
+                    f"mapping '{mapped.token}' into '{device.token}' "
+                    f"would create a composite cycle", ErrorCode.GENERIC,
+                    http_status=409)
+            ancestor = (self.devices.get(ancestor.parent_device_id)
+                        if ancestor.parent_device_id else None)
+        dtype = self.device_types.get(device.device_type_id)
+        slot = find_device_slot(
+            dtype.device_element_schema if dtype else None,
+            mapping.device_element_schema_path)
+        if slot is None:
+            raise SiteWhereError(
+                f"path '{mapping.device_element_schema_path}' does not "
+                f"name a device slot in type "
+                f"'{dtype.token if dtype else '?'}'s element schema",
+                ErrorCode.GENERIC, http_status=400)
+        existing = device.device_element_mappings
+        if any(m.device_element_schema_path ==
+               mapping.device_element_schema_path for m in existing):
+            raise SiteWhereError(
+                f"path '{mapping.device_element_schema_path}' already has "
+                f"a device mapped", ErrorCode.DUPLICATE_TOKEN,
+                http_status=409)
+        # parent backreference first (the reference's order, :688-694)
+        self.update_device(mapped.token, {"parent_device_id": device.id})
+        return self.update_device(device_token, {
+            "device_element_mappings": existing + [mapping]})
+
+    def delete_device_element_mapping(self, device_token: str,
+                                      path: str) -> Device:
+        """Remove the mapping at `path` and clear the child's parent
+        backreference (deviceElementMappingDeleteLogic:709)."""
+        device = self.devices.require_by_token(device_token)
+        match = next((m for m in device.device_element_mappings
+                      if m.device_element_schema_path == path), None)
+        if match is None:
+            raise NotFoundError(
+                f"no device mapping at path '{path}'", ErrorCode.GENERIC)
+        mapped = self.devices.get_by_token(match.device_token)
+        if mapped is not None and mapped.parent_device_id == device.id:
+            self.update_device(mapped.token, {"parent_device_id": ""})
+        remaining = [m for m in device.device_element_mappings
+                     if m.device_element_schema_path != path]
+        return self.update_device(device_token, {
+            "device_element_mappings": remaining})
 
     # -- assignments -----------------------------------------------------------
 

@@ -606,10 +606,22 @@ class ProtobufSpecCommandEncoder:
         raise ValueError(
             f"command {command.name} not declared on type {dtype.token}")
 
-    def encode(self, execution, device, assignment) -> bytes:
+    def encode(self, execution, device, assignment, nesting=None) -> bytes:
         number = self._command_number(device, execution.command)
+        nested_path = nested_spec = None
+        if nesting is not None and nesting.nested is not None:
+            # gateway-framed message addressing a composite child: the
+            # header carries the element-schema path and the nested
+            # device's TYPE token (ProtobufMessageBuilder.java:76-82
+            # setting nestedPath + nestedSpec from the mapping)
+            nested_path = nesting.path
+            nested_type = self.registry.device_types.get(
+                nesting.nested.device_type_id)
+            nested_spec = nested_type.token if nested_type else None
         header = _device_header(number,
-                                originator=execution.invocation.id or None)
+                                originator=execution.invocation.id or None,
+                                nested_path=nested_path,
+                                nested_spec=nested_spec)
         body = _Writer()
         for num, parameter in enumerate(execution.command.parameters,
                                         start=1):

@@ -738,10 +738,34 @@ def register_all(router: Router, instance, server) -> None:
     router.delete("/api/devices/{token}", delete_device, authority=REST)
     router.get("/api/devices/{token}/assignments", list_device_assignments,
                authority=REST)
+    def create_device_mapping(request: Request):
+        """Map a child device into a composite parent's schema slot
+        (Devices.java:268 addDeviceElementMapping)."""
+        from sitewhere_tpu.model.device import DeviceElementMapping
+        body = _body(request)
+        mapping = DeviceElementMapping(
+            device_element_schema_path=body.get(
+                "deviceElementSchemaPath", body.get(
+                    "device_element_schema_path", "")),
+            device_token=body.get("deviceToken",
+                                  body.get("device_token", "")))
+        return _registry(request).create_device_element_mapping(
+            request.params["token"], mapping)
+
+    def delete_device_mapping(request: Request):
+        """Remove the mapping at ?path= (Devices.java:281)."""
+        path = request.query_one("path") or ""
+        return _registry(request).delete_device_element_mapping(
+            request.params["token"], path)
+
     router.post("/api/devices/{token}/events", add_device_event_batch,
                 authority=REST)
     router.get("/api/devices/{token}/events", list_device_events,
                authority=REST)
+    router.post("/api/devices/{token}/mappings", create_device_mapping,
+                authority=REST)
+    router.delete("/api/devices/{token}/mappings", delete_device_mapping,
+                  authority=REST)
 
     # ------------------------------------------------------------------
     # Device alarms (reference: device-management alarm rpcs exposed
@@ -1330,8 +1354,23 @@ def register_all(router: Router, instance, server) -> None:
         return results_to_jsonable(_engine(request).search_providers.search(
             request.params["provider_id"], spec))
 
+    def search_raw(request: Request):
+        """Engine-native query passthrough for EXTERNAL providers
+        (Search.java searchDeviceEvents raw mode /
+        executeQueryWithRawResponse)."""
+        provider = _engine(request).search_providers.get_provider(
+            request.params["provider_id"])
+        raw = getattr(provider, "raw_query", None)
+        if raw is None:
+            raise SiteWhereError(
+                f"provider '{provider.provider_id}' does not support raw "
+                f"queries", http_status=400)
+        return raw(request.query_one("q") or "")
+
     router.get("/api/search", list_search_providers, authority=REST)
     router.get("/api/search/{provider_id}/events", search_events,
+               authority=REST)
+    router.get("/api/search/{provider_id}/raw", search_raw,
                authority=REST)
 
     # ------------------------------------------------------------------

@@ -354,3 +354,197 @@ class TestReplicationMerge:
         dm.create_device_type(DeviceType(token="rt", name="fresh"))
         with pytest.raises(DuplicateTokenError):
             dm.create_device_type(DeviceType(token="rt"))
+
+
+class TestDeviceElementMappings:
+    """Composite-device slot mappings with reference validation
+    (DeviceManagementPersistence.deviceElementMappingCreateLogic:657;
+    VERDICT r4 item 8)."""
+
+    def _world(self):
+        from sitewhere_tpu.model.device import (
+            DeviceElementSchema, DeviceSlot, DeviceUnit)
+
+        dm = DeviceManagement()
+        schema = DeviceElementSchema(
+            device_slots=[DeviceSlot(name="Top", path="top")],
+            device_units=[DeviceUnit(path="bus", device_slots=[
+                DeviceSlot(name="S1", path="slot1"),
+                DeviceSlot(name="S2", path="slot2")])])
+        gw_type = dm.create_device_type(DeviceType(
+            token="gw-type", device_element_schema=schema))
+        child_type = dm.create_device_type(DeviceType(token="child-type"))
+        dm.create_device(Device(token="gw", device_type_id=gw_type.id))
+        dm.create_device(Device(token="c1", device_type_id=child_type.id))
+        dm.create_device(Device(token="c2", device_type_id=child_type.id))
+        return dm
+
+    def test_create_sets_mapping_and_parent(self):
+        from sitewhere_tpu.model.device import DeviceElementMapping
+
+        dm = self._world()
+        updated = dm.create_device_element_mapping(
+            "gw", DeviceElementMapping(
+                device_element_schema_path="bus/slot1", device_token="c1"))
+        assert [m.device_token for m in updated.device_element_mappings] \
+            == ["c1"]
+        child = dm.get_device_by_token("c1")
+        assert child.parent_device_id == updated.id
+
+    def test_invalid_path_rejected(self):
+        from sitewhere_tpu.model.device import DeviceElementMapping
+
+        dm = self._world()
+        with pytest.raises(SiteWhereError):
+            dm.create_device_element_mapping(
+                "gw", DeviceElementMapping(
+                    device_element_schema_path="bus/slotX",
+                    device_token="c1"))
+        with pytest.raises(SiteWhereError):
+            dm.create_device_element_mapping(
+                "gw", DeviceElementMapping(
+                    device_element_schema_path="slot1",  # missing unit seg
+                    device_token="c1"))
+        assert dm.get_device_by_token("c1").parent_device_id == ""
+
+    def test_occupied_path_and_reparent_rejected(self):
+        from sitewhere_tpu.model.device import DeviceElementMapping
+
+        dm = self._world()
+        dm.create_device_element_mapping(
+            "gw", DeviceElementMapping(
+                device_element_schema_path="bus/slot1", device_token="c1"))
+        # same path again -> refused
+        with pytest.raises(SiteWhereError):
+            dm.create_device_element_mapping(
+                "gw", DeviceElementMapping(
+                    device_element_schema_path="bus/slot1",
+                    device_token="c2"))
+        # already-parented child into a second slot -> refused
+        with pytest.raises(SiteWhereError):
+            dm.create_device_element_mapping(
+                "gw", DeviceElementMapping(
+                    device_element_schema_path="bus/slot2",
+                    device_token="c1"))
+
+    def test_delete_clears_parent(self):
+        from sitewhere_tpu.model.device import DeviceElementMapping
+
+        dm = self._world()
+        dm.create_device_element_mapping(
+            "gw", DeviceElementMapping(
+                device_element_schema_path="bus/slot1", device_token="c1"))
+        updated = dm.delete_device_element_mapping("gw", "bus/slot1")
+        assert updated.device_element_mappings == []
+        assert dm.get_device_by_token("c1").parent_device_id == ""
+        with pytest.raises(SiteWhereError):
+            dm.delete_device_element_mapping("gw", "bus/slot1")
+
+    def test_update_coerces_schema_dict(self):
+        """A REST-shaped update (plain dicts) must store typed schema
+        objects, not raw dicts — mapping validation runs against the
+        LIVE entity, not a reload."""
+        from sitewhere_tpu.model.device import (
+            DeviceElementMapping, DeviceElementSchema)
+
+        dm = self._world()
+        dm.update_device_type("child-type", {"device_element_schema": {
+            "device_units": [{"path": "rack", "device_slots": [
+                {"name": "R1", "path": "r1"}]}]}})
+        dtype = dm.device_types.get_by_token("child-type")
+        assert isinstance(dtype.device_element_schema, DeviceElementSchema)
+        # the updated schema immediately validates mappings
+        dm.create_device(Device(token="c3", device_type_id=dtype.id))
+        dm.create_device_element_mapping(
+            "c1", DeviceElementMapping(
+                device_element_schema_path="rack/r1", device_token="c3"))
+        assert dm.get_device_by_token("c3").parent_device_id \
+            == dm.get_device_by_token("c1").id
+
+    def test_self_and_cycle_mapping_rejected(self):
+        from sitewhere_tpu.model.device import (
+            DeviceElementMapping, DeviceElementSchema, DeviceSlot,
+            DeviceUnit)
+
+        dm = self._world()
+        # self-mapping: gw into its own slot
+        with pytest.raises(SiteWhereError):
+            dm.create_device_element_mapping(
+                "gw", DeviceElementMapping(
+                    device_element_schema_path="bus/slot1",
+                    device_token="gw"))
+        # cycle: gw -> c1, then c1 -> gw (c1's type gets a schema first)
+        dm.create_device_element_mapping(
+            "gw", DeviceElementMapping(
+                device_element_schema_path="bus/slot1", device_token="c1"))
+        dm.update_device_type("child-type", {
+            "device_element_schema": DeviceElementSchema(
+                device_units=[DeviceUnit(path="sub", device_slots=[
+                    DeviceSlot(name="S", path="s1")])])})
+        with pytest.raises(SiteWhereError):
+            dm.create_device_element_mapping(
+                "c1", DeviceElementMapping(
+                    device_element_schema_path="sub/s1",
+                    device_token="gw"))
+
+    def test_delete_gateway_releases_children(self):
+        """Deleting a composite gateway clears its children's parent
+        backreferences (no dangling ids for command nesting); a mapped
+        CHILD refuses deletion until unmapped."""
+        from sitewhere_tpu.model.device import DeviceElementMapping
+
+        dm = self._world()
+        dm.create_device_element_mapping(
+            "gw", DeviceElementMapping(
+                device_element_schema_path="bus/slot1", device_token="c1"))
+        with pytest.raises(SiteWhereError):
+            dm.delete_device("c1")  # still mapped into gw
+        dm.delete_device("gw")
+        assert dm.get_device_by_token("c1").parent_device_id == ""
+        dm.delete_device("c1")  # released child now deletes cleanly
+        assert dm.get_device_by_token("c1") is None
+
+    def test_dangling_parent_does_not_block_delete(self):
+        """A child whose parent vanished out-of-band (replicated
+        tombstone ordering) must still delete — the 409 guard applies
+        only while a live parent actually lists the mapping."""
+        from sitewhere_tpu.model.device import DeviceElementMapping
+
+        dm = self._world()
+        dm.create_device_element_mapping(
+            "gw", DeviceElementMapping(
+                device_element_schema_path="bus/slot1", device_token="c1"))
+        gw = dm.get_device_by_token("gw")
+        dm.devices.delete(gw.id)  # bypass the guarded path: dangling ref
+        assert dm.get_device_by_token("c1").parent_device_id == gw.id
+        dm.delete_device("c1")
+        assert dm.get_device_by_token("c1") is None
+
+    def test_schema_survives_sqlite_reopen(self, tmp_path):
+        from sitewhere_tpu.model.device import (
+            DeviceElementMapping, find_device_slot)
+        from sitewhere_tpu.registry.store import SqliteStore
+
+        path = str(tmp_path / "reg.db")
+        dm = DeviceManagement(store=SqliteStore(path))
+        # same world, durable
+        from sitewhere_tpu.model.device import (
+            DeviceElementSchema, DeviceSlot, DeviceUnit)
+        gw_type = dm.create_device_type(DeviceType(
+            token="gw-type", device_element_schema=DeviceElementSchema(
+                device_units=[DeviceUnit(path="bus", device_slots=[
+                    DeviceSlot(name="S1", path="slot1")])])))
+        dm.create_device(Device(token="gw", device_type_id=gw_type.id))
+        dm.create_device(Device(token="c1", device_type_id=gw_type.id))
+        dm.create_device_element_mapping(
+            "gw", DeviceElementMapping(
+                device_element_schema_path="bus/slot1", device_token="c1"))
+        dm.store.close()
+
+        dm2 = DeviceManagement(store=SqliteStore(path))
+        dtype = dm2.device_types.get_by_token("gw-type")
+        assert find_device_slot(dtype.device_element_schema,
+                                "bus/slot1").name == "S1"
+        gw = dm2.get_device_by_token("gw")
+        assert gw.device_element_mappings[0].device_token == "c1"
+        assert dm2.get_device_by_token("c1").parent_device_id == gw.id

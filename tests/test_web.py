@@ -444,3 +444,44 @@ class TestApiExplorer:
         assert "/authapi/jwt" in page
         assert "http://" not in page.replace("http://'+", "")
         assert "https://" not in page
+
+
+def test_device_element_mappings_over_rest(client):
+    """Composite-device mappings REST surface (Devices.java:268/281):
+    schema-tree-validated create, child parent backreference, delete."""
+    client.create_device_type({
+        "token": "dt-composite", "name": "Gateway",
+        "device_element_schema": {
+            "device_units": [{"path": "bus", "device_slots": [
+                {"name": "S1", "path": "slot1"}]}]}})
+    client.create_device({"token": "comp-gw",
+                          "device_type_token": "dt-composite"})
+    client.create_device({"token": "comp-child",
+                          "device_type_token": "dt-composite"})
+
+    updated = client.post("/api/devices/comp-gw/mappings", {
+        "device_element_schema_path": "bus/slot1",
+        "device_token": "comp-child"})
+    assert updated["device_element_mappings"][0]["device_token"] \
+        == "comp-child"
+    assert client.get_device("comp-child")["parent_device_id"] \
+        == updated["id"]
+
+    # invalid path -> 400 (fresh child: the parent check runs first and
+    # would 409 for the already-mapped one); occupied path -> 409
+    client.create_device({"token": "comp-child2",
+                          "device_type_token": "dt-composite"})
+    with pytest.raises(SiteWhereClientError) as err:
+        client.post("/api/devices/comp-gw/mappings", {
+            "device_element_schema_path": "bus/nope",
+            "device_token": "comp-child2"})
+    assert err.value.status == 400
+    with pytest.raises(SiteWhereClientError) as err:
+        client.post("/api/devices/comp-gw/mappings", {
+            "device_element_schema_path": "bus/slot1",
+            "device_token": "comp-gw"})
+    assert err.value.status == 409
+
+    cleared = client.delete("/api/devices/comp-gw/mappings?path=bus/slot1")
+    assert cleared["device_element_mappings"] == []
+    assert client.get_device("comp-child")["parent_device_id"] == ""
