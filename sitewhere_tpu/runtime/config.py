@@ -192,6 +192,9 @@ DEFAULTS: Dict = {
     # `rules` config-model element — runtime/config_model.py
     # rule_processing_model; same shape as POST /api/rules bodies)
     "rules": [],
+    # federated external search providers (runtime/config_model.py
+    # event_search_model; search/external.py HttpSearchProvider)
+    "search_providers": [],
     "persist": {"data_dir": "./swtpu-data",
                 # seconds between automatic device-state checkpoints
                 # (None = manual/REST-triggered only)

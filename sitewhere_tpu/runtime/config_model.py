@@ -157,6 +157,16 @@ def event_sources_model() -> ElementModel:
         ElementModel(
             name="websocket", role="event-source-receiver", multiple=True,
             attributes=[_attr("url", required=True)]),
+        ElementModel(
+            name="stomp_broker", role="event-source-receiver",
+            multiple=True,
+            description="EMBEDDED STOMP broker (the "
+                        "ActiveMQBrokerEventReceiver slot): hosts the "
+                        "broker in-process and consumes a destination",
+            attributes=[_attr("port", _I, default=0),
+                        _attr("host", default="127.0.0.1"),
+                        _attr("destination",
+                              default="/queue/sitewhere")]),
     ]
     decoder = ElementModel(
         name="decoder", role="event-source-decoder", optional=False,
@@ -324,6 +334,22 @@ def analytics_model() -> ElementModel:
                     _attr("slide_ms", _I, default=10_000)])
 
 
+def event_search_model() -> ElementModel:
+    return ElementModel(
+        name="search_providers", role="event-search", multiple=True,
+        description="Federated event-search providers (the in-process "
+                    "columnar provider is always registered; type=http "
+                    "adds an external engine, the SolrSearchProvider "
+                    "role)",
+        attributes=[_attr("provider_id", required=True),
+                    _attr("type", required=True,
+                          choices=["http"]),
+                    _attr("base_url", required=True),
+                    _attr("name"),
+                    _attr("timeout_s", _D, default=10.0),
+                    _attr("tenant")])
+
+
 def _all_elements() -> List[ElementModel]:
     """Every subsystem's element model — the single source both the UI model
     and the validator consume."""
@@ -333,6 +359,7 @@ def _all_elements() -> List[ElementModel]:
         outbound_connectors_model(), command_delivery_model(),
         registration_model(), batch_operations_model(), schedule_model(),
         label_generation_model(), web_rest_model(), analytics_model(),
+        event_search_model(),
     ]
 
 

@@ -1,8 +1,9 @@
 """Federated event search (reference: service-event-search)."""
 
+from sitewhere_tpu.search.external import HttpSearchProvider
 from sitewhere_tpu.search.providers import (
     ColumnarSearchProvider, SearchCriteriaSpec, SearchProvider,
     SearchProvidersManager)
 
-__all__ = ["ColumnarSearchProvider", "SearchCriteriaSpec", "SearchProvider",
-           "SearchProvidersManager"]
+__all__ = ["ColumnarSearchProvider", "HttpSearchProvider",
+           "SearchCriteriaSpec", "SearchProvider", "SearchProvidersManager"]

@@ -121,3 +121,184 @@ class TestEventSearch:
         with pytest.raises(SiteWhereError) as err:
             SearchCriteriaSpec.from_query(request)
         assert err.value.http_status == 400
+
+
+class _StubSearchServer:
+    """Minimal external search engine: canned events, raw echo, geo."""
+
+    def __init__(self):
+        import json as _json
+        import threading
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+        from urllib.parse import parse_qs, urlparse
+
+        stub = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):
+                pass
+
+            def do_GET(self):
+                parsed = urlparse(self.path)
+                params = {k: v[0] for k, v in
+                          parse_qs(parsed.query).items()}
+                stub.requests.append((parsed.path, params))
+                if stub.fail_status is not None:
+                    self.send_response(stub.fail_status)
+                    self.end_headers()
+                    return
+                if parsed.path == "/engine/events":
+                    docs = [d for d in stub.docs
+                            if not params.get("measurement")
+                            or d.get("name") == params["measurement"]]
+                    body = {"results": docs, "total": len(docs)}
+                elif parsed.path == "/engine/raw":
+                    body = {"echo": params.get("q", ""),
+                            "engine": "stub"}
+                elif parsed.path == "/engine/locations":
+                    body = {"results": stub.locations,
+                            "total": len(stub.locations)}
+                else:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                blob = _json.dumps(body).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(blob)))
+                self.end_headers()
+                self.wfile.write(blob)
+
+        self.requests = []
+        self.fail_status = None
+        self.docs = [
+            {"eventType": "MEASUREMENT", "name": "temp", "value": 21.5,
+             "device_token": "ext-d1", "event_date": 1000},
+            {"eventType": "MEASUREMENT", "name": "hum", "value": 60.0,
+             "device_token": "ext-d2", "event_date": 2000},
+            {"eventType": "ALERT", "type": "hot", "message": "too hot",
+             "device_token": "ext-d1", "event_date": 3000},
+        ]
+        self.locations = [
+            {"latitude": 33.75, "longitude": -84.39, "elevation": 10.0,
+             "device_token": "ext-d1", "event_date": 4000},
+        ]
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self.httpd.server_address[1]
+        self.thread = threading.Thread(target=self.httpd.serve_forever,
+                                       daemon=True)
+        self.thread.start()
+
+    @property
+    def base_url(self):
+        return f"http://127.0.0.1:{self.port}/engine"
+
+    def close(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+class TestExternalSearchProvider:
+    """VERDICT r4 item 7: the external federated slot
+    (SolrSearchProvider.java parity) against a stub HTTP engine."""
+
+    @pytest.fixture()
+    def stub(self):
+        server = _StubSearchServer()
+        yield server
+        server.close()
+
+    def test_search_maps_documents_and_criteria(self, stub):
+        from sitewhere_tpu.model.event import DeviceAlert, DeviceMeasurement
+        from sitewhere_tpu.search import HttpSearchProvider
+
+        provider = HttpSearchProvider("ext", stub.base_url)
+        res = provider.search(SearchCriteriaSpec())
+        assert res.num_results == 3
+        assert isinstance(res.results[0], DeviceMeasurement)
+        assert res.results[0].value == 21.5
+        assert isinstance(res.results[2], DeviceAlert)
+        assert res.results[2].message == "too hot"
+
+        # criteria travel as query params and filter server-side
+        res = provider.search(SearchCriteriaSpec(measurement_name="temp",
+                                                 page_size=5))
+        assert [e.name for e in res.results] == ["temp"]
+        path, params = stub.requests[-1]
+        assert path == "/engine/events"
+        assert params["measurement"] == "temp"
+        assert params["pageSize"] == "5"
+
+    def test_raw_query_passthrough(self, stub):
+        from sitewhere_tpu.search import HttpSearchProvider
+
+        provider = HttpSearchProvider("ext", stub.base_url)
+        out = provider.raw_query("name:temp AND value:[20 TO 30]")
+        assert out == {"echo": "name:temp AND value:[20 TO 30]",
+                       "engine": "stub"}
+
+    def test_locations_near(self, stub):
+        from sitewhere_tpu.search import HttpSearchProvider
+
+        provider = HttpSearchProvider("ext", stub.base_url)
+        locs = provider.locations_near(33.7, -84.4, 5000.0)
+        assert len(locs) == 1 and locs[0].latitude == 33.75
+        path, params = stub.requests[-1]
+        assert path == "/engine/locations"
+        assert params["distance"] == "5000.0"
+
+    def test_engine_failure_maps_to_502(self, stub):
+        from sitewhere_tpu.search import HttpSearchProvider
+
+        provider = HttpSearchProvider("ext", stub.base_url)
+        stub.fail_status = 500
+        with pytest.raises(SiteWhereError) as err:
+            provider.search(SearchCriteriaSpec())
+        assert err.value.http_status == 502
+
+    def test_unreachable_engine_maps_to_502(self):
+        from sitewhere_tpu.search import HttpSearchProvider
+
+        provider = HttpSearchProvider(
+            "down", "http://127.0.0.1:1/engine", timeout_s=0.5)
+        with pytest.raises(SiteWhereError) as err:
+            provider.search(SearchCriteriaSpec())
+        assert err.value.http_status == 502
+
+    def test_federation_through_manager_and_rest(self, stub):
+        """Registered beside the columnar provider; listed and queried
+        through the real REST gateway (/api/search)."""
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        from sitewhere_tpu.client.rest import SiteWhereClient
+        from sitewhere_tpu.instance import SiteWhereInstance
+        from sitewhere_tpu.search import HttpSearchProvider
+        from sitewhere_tpu.web.server import RestServer
+
+        instance = SiteWhereInstance(instance_id="ext-search")
+        instance.start()
+        engine = instance.get_tenant_engine("default")
+        engine.search_providers.register(
+            HttpSearchProvider("solr-like", stub.base_url,
+                               name="Stub engine"))
+        rest = RestServer(instance, port=0)
+        rest.start()
+        try:
+            client = SiteWhereClient(rest.base_url)
+            client.authenticate("admin", "password")
+            listed = client.get("/api/search")["results"]
+            assert {p["id"] for p in listed} == {"columnar", "solr-like"}
+            out = client.get("/api/search/solr-like/events",
+                             measurement="temp")
+            assert out["numResults"] == 1
+            assert out["results"][0]["value"] == 21.5
+            raw = client.get("/api/search/solr-like/raw", q="probe")
+            assert raw == {"echo": "probe", "engine": "stub"}
+            # the in-proc provider has no raw passthrough -> 400
+            from sitewhere_tpu.client.rest import SiteWhereClientError
+            with pytest.raises(SiteWhereClientError) as err:
+                client.get("/api/search/columnar/raw", q="x")
+            assert err.value.status == 400
+        finally:
+            rest.stop()
+            instance.stop()
