@@ -18,6 +18,7 @@ from sitewhere_tpu.sources.manager import (
     EventSourcesManager, InboundEventSource)
 from sitewhere_tpu.sources.receivers import (
     CoapEventReceiver, HttpEventReceiver, MqttEventReceiver,
+    StompBrokerEventReceiver,
     SocketEventReceiver, WebSocketEventReceiver)
 
 __all__ = [
@@ -27,5 +28,6 @@ __all__ = [
     "AlternateIdDeduplicator", "ScriptedDeduplicator",
     "EventSourcesManager", "InboundEventSource",
     "CoapEventReceiver", "HttpEventReceiver", "MqttEventReceiver",
+    "StompBrokerEventReceiver",
     "SocketEventReceiver", "WebSocketEventReceiver",
 ]

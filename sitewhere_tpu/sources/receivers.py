@@ -103,6 +103,64 @@ class MqttEventReceiver(_ReceiverBase):
             self._client = None
 
 
+class StompBrokerEventReceiver(_ReceiverBase):
+    """EMBEDDED-broker STOMP receiver: hosts an in-process STOMP broker
+    (transport/stomp.py) and consumes device events from one of its
+    destinations — the ActiveMQBrokerEventReceiver role
+    (service-event-sources activemq/ActiveMQBrokerEventReceiver.java:42
+    hosts an in-JVM ActiveMQ broker the devices connect TO). The
+    client-side adapters (receivers_ext.StompEventReceiver, AMQP) cover
+    the EXTERNAL-broker slot; this closes the embedded one with no
+    middleware dependency."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 destination: str = "/queue/sitewhere",
+                 loop_thread: Optional[EventLoopThread] = None):
+        super().__init__(loop_thread)
+        self.host = host
+        self.port = port
+        self.destination = destination
+        self._broker = None
+        self._consumer = None
+
+    def start(self) -> None:
+        from sitewhere_tpu.transport.stomp import StompBroker, StompClient
+
+        async def go():
+            self._broker = StompBroker(self.host, self.port)
+            await self._broker.start()
+            self.port = self._broker.port
+            # in-proc consumer rides the same public protocol the
+            # devices use — nothing broker-internal to maintain. Connect
+            # on the broker's bind address (loopback only when bound to
+            # the wildcard, where 127.0.0.1 is always reachable).
+            connect_host = (self.host if self.host not in ("", "0.0.0.0",
+                                                           "::")
+                            else "127.0.0.1")
+            self._consumer = StompClient(connect_host, self.port)
+            await self._consumer.connect()
+
+            async def on_message(headers, body: bytes):
+                await self._forward(body, {
+                    "stomp.destination": headers.get("destination",
+                                                     self.destination)})
+
+            await self._consumer.subscribe(self.destination, on_message)
+
+        self.loop_thread.run(go())
+
+    def stop(self) -> None:
+        async def go():
+            if self._consumer is not None:
+                await self._consumer.disconnect()
+            if self._broker is not None:
+                await self._broker.stop()
+
+        self.loop_thread.run(go())
+        self._consumer = None
+        self._broker = None
+
+
 class SocketEventReceiver(_ReceiverBase):
     """TCP wire-frame listener (SocketInboundEventReceiver)."""
 

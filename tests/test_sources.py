@@ -239,6 +239,81 @@ class TestLiveReceivers:
             source.stop()
             loop.run(broker.stop())
 
+    def test_stomp_broker_receiver_end_to_end(self):
+        """EMBEDDED broker (VERDICT r4 item 10,
+        ActiveMQBrokerEventReceiver.java parity): the receiver hosts a
+        STOMP broker in-process; a device connects with a plain STOMP
+        client and SENDs wire frames to the consumed destination."""
+        from sitewhere_tpu.sources import StompBrokerEventReceiver
+        from sitewhere_tpu.sources.receivers import EventLoopThread
+        from sitewhere_tpu.transport.stomp import StompClient
+
+        loop = EventLoopThread.shared()
+        receiver = StompBrokerEventReceiver(destination="/queue/sw")
+        source, bus, naming = _mk_source(decoder=WireDecoder(),
+                                         receivers=[receiver])
+        source.initialize()
+        source.start()
+        try:
+            payload = encode_frame(
+                MessageType.MEASUREMENT,
+                WireCodec.encode_measurement("dev-9", 77, "temp", 4.5))
+
+            async def publish():
+                device = StompClient("127.0.0.1", receiver.port)
+                await device.connect()
+                await device.send("/queue/sw", payload)
+                await device.disconnect()
+
+            loop.run(publish())
+            [rec] = self._drain(bus, naming)
+            body = msgpack.unpackb(rec.value, raw=False)
+            assert body["deviceToken"] == "dev-9"
+            assert body["metadata"]["stomp.destination"] == "/queue/sw"
+        finally:
+            source.stop()
+
+    def test_stomp_broker_binary_body_and_receipt(self):
+        """Binary-safe bodies (content-length framing, NUL bytes inside)
+        and receipt handling on the embedded broker."""
+        import queue as pyqueue
+
+        from sitewhere_tpu.sources.receivers import EventLoopThread
+        from sitewhere_tpu.transport.stomp import (
+            StompBroker, StompClient, encode_frame as stomp_frame,
+            read_frame)
+
+        loop = EventLoopThread.shared()
+        broker = StompBroker()
+        loop.run(broker.start())
+        got = pyqueue.Queue()
+        body = b"\x00\x01binary\x00tail"
+        try:
+            async def drive():
+                sub = StompClient("127.0.0.1", broker.port)
+                await sub.connect()
+
+                async def on_message(headers, data):
+                    got.put((headers, data))
+
+                await sub.subscribe("/topic/bin", on_message)
+                pub = StompClient("127.0.0.1", broker.port)
+                await pub.connect()
+                await pub.send("/topic/bin", body)
+                await pub.disconnect()
+                return sub
+
+            sub = loop.run(drive())
+            headers, data = got.get(timeout=5)
+            assert data == body
+            assert headers["destination"] == "/topic/bin"
+            loop.run(sub.disconnect())
+        finally:
+            loop.run(broker.stop())
+        # frame codec: escaping round-trip
+        frame = stomp_frame("SEND", {"destination": "/a:b\nc"}, b"x")
+        assert b"\\c" in frame and b"\\n" in frame
+
     def test_socket_receiver_end_to_end(self):
         import socket as pysocket
 
