@@ -132,6 +132,33 @@ def _apply_scripted_rule(instance, data: dict) -> None:
     instance.install_scripted_rule(tenant, token, script_id, replace=True)
 
 
+def _apply_search_config(instance, cfg) -> None:
+    """Register config-declared EXTERNAL search providers on tenant
+    engines (the reference's Spring-wired SolrSearchProvider slot;
+    metamodel element: runtime/config_model.py event_search_model)."""
+    providers = cfg.get("search_providers") or []
+    if not providers:
+        return
+    from sitewhere_tpu.search import HttpSearchProvider
+
+    for data in providers:
+        if data.get("type") != "http":
+            print(f"warning: unknown search provider type "
+                  f"{data.get('type')!r}; skipping", file=sys.stderr)
+            continue
+        tenant = data.get("tenant") or instance._default_tenant or "default"
+        engine = instance.get_tenant_engine(tenant)
+        if engine is None:
+            print(f"warning: search provider "
+                  f"{data.get('provider_id')!r} names unknown tenant "
+                  f"{tenant!r}; skipping", file=sys.stderr)
+            continue
+        engine.search_providers.register(HttpSearchProvider(
+            data["provider_id"], data["base_url"],
+            name=data.get("name", ""),
+            timeout_s=float(data.get("timeout_s", 10.0))))
+
+
 def cmd_assemble_checkpoint(args) -> int:
     """Merge one per-host shard checkpoint from every cluster host into a
     canonical checkpoint that restores onto any topology (other host
@@ -216,6 +243,7 @@ def cmd_serve(args) -> int:
     instance = _build_instance(cfg)
     instance.start()
     _apply_rule_config(instance, cfg)
+    _apply_search_config(instance, cfg)
     rest = RestServer(instance, host=cfg.get("api.host"),
                       port=int(cfg.get("api.port")),
                       token_expiration_minutes=int(
@@ -290,6 +318,7 @@ def _serve_cluster(cfg) -> int:
     # but every host boots the same config, so applies are idempotent
     # replace-on-add at the peers)
     _apply_rule_config(instance, cfg)
+    _apply_search_config(instance, cfg)
     rest = RestServer(instance, host=cfg.get("api.host"),
                       port=int(cfg.get("api.port")),
                       token_expiration_minutes=int(
@@ -420,6 +449,12 @@ def main(argv=None) -> int:
     sub = parser.add_subparsers(dest="command", required=True)
 
     serve = sub.add_parser("serve", help="boot instance + REST gateway")
+    serve.add_argument("--supervise", action="store_true",
+                       help="wrap serve in a gang-restart supervisor: an "
+                            "abnormal exit (peer loss, crash) restarts "
+                            "the process; exit 0 ends supervision")
+    serve.add_argument("--supervise-backoff", type=float, default=1.0,
+                       help="seconds between restarts (default 1.0)")
     serve.add_argument("--config", help="JSON config file (layered)")
     serve.add_argument("--data-dir", help="durable state directory")
     serve.add_argument("--host", help="bind host (default 127.0.0.1)")
@@ -479,6 +514,29 @@ def main(argv=None) -> int:
     dl.set_defaults(fn=cmd_deadletters)
 
     args = parser.parse_args(argv)
+    if getattr(args, "supervise", False):
+        # re-exec serve (without --supervise) under the gang-restart
+        # supervisor (runtime/supervisor.py; the reference's zero-operator
+        # recovery analog, MicroserviceKafkaConsumer.java:88 rebalance)
+        from sitewhere_tpu.runtime.supervisor import supervise_serve
+
+        raw = list(sys.argv[1:] if argv is None else argv)
+        child_argv = []
+        skip = False
+        for item in raw:
+            if skip:
+                skip = False
+                continue
+            if item == "--supervise":
+                continue
+            if item == "--supervise-backoff":
+                skip = True
+                continue
+            if item.startswith("--supervise-backoff="):
+                continue
+            child_argv.append(item)
+        return supervise_serve(child_argv,
+                               backoff_s=args.supervise_backoff)
     return args.fn(args)
 
 

@@ -1,0 +1,319 @@
+"""Kill-1-of-3 gang-restart drill through the OPERATOR surface.
+
+Three OS processes run `python -m sitewhere_tpu serve --supervise
+--cluster-...` — the full deployable stack: jax.distributed 6-way mesh
+(2 virtual CPU devices x 3 hosts), REST gateways, busnet edges, registry
+gossip, foreign-row forwarding, peer watchdog, and the gang-restart
+supervisor (runtime/supervisor.py).
+
+The drill: provision over host 0's REST only (gossip must carry it to
+hosts 1 and 2 — N=3 over the REAL transport), ingest events through ONE
+host's bus edge for devices owned by ALL hosts (foreign-row forwarding
+at N=3), checkpoint over REST, hard-kill one child mid-serve, and
+observe ZERO-OPERATOR-ACTION recovery: the survivors' watchdogs exit for
+gang restart, every supervisor restarts its child, the gang re-forms on
+the same ports, and device state (checkpoint + committed-offset replay)
+plus the replicated registry are intact. Then a post-recovery event must
+still fold, and SIGTERM must end all three supervisors with exit 0.
+
+Reference parity: the zero-operator recovery the reference gets from
+consumer-group rebalance (MicroserviceKafkaConsumer.java:88) and
+topology-reactive channels (ApiDemux.java:183-227), delivered the
+SPMD-honest way (VERDICT r4 item 5).
+"""
+
+import json
+import os
+import re
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import msgpack
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+N = 3
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class _HostLog:
+    """Continuously drains one supervisor's stdout; tracks child pids,
+    serve banners, and restart lines."""
+
+    def __init__(self, proc):
+        self.proc = proc
+        self.lines = []
+        self._lock = threading.Lock()
+        self.thread = threading.Thread(target=self._run, daemon=True)
+        self.thread.start()
+
+    def _run(self):
+        for line in self.proc.stdout:
+            with self._lock:
+                self.lines.append(line)
+
+    def text(self) -> str:
+        with self._lock:
+            return "".join(self.lines)
+
+    def child_pids(self):
+        return [int(m) for m in
+                re.findall(r"child pid=(\d+)", self.text())]
+
+    def banners(self) -> int:
+        return self.text().count("REST gateway")
+
+    def restarts(self) -> int:
+        return self.text().count("restarting in")
+
+
+def _wait(predicate, timeout_s, what, logs=None):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(0.25)
+    detail = ""
+    if logs:
+        detail = "\n".join(f"--- host {i} ---\n{log.text()[-3000:]}"
+                           for i, log in enumerate(logs))
+    raise AssertionError(f"timed out waiting for {what}\n{detail}")
+
+
+def _client(port):
+    from sitewhere_tpu.client.rest import SiteWhereClient
+
+    c = SiteWhereClient(f"http://127.0.0.1:{port}")
+    c.authenticate("admin", "password")
+    return c
+
+
+def _try_client(port):
+    try:
+        return _client(port)
+    except Exception:
+        return None
+
+
+def _publish_event(bus_port, instance_id, token, name, value):
+    from sitewhere_tpu.model.common import _asdict
+    from sitewhere_tpu.model.event import DeviceEventBatch, DeviceMeasurement
+    from sitewhere_tpu.runtime.bus import TopicNaming
+    from sitewhere_tpu.runtime.busnet import BusClient
+
+    naming = TopicNaming(instance=instance_id)
+    payload = msgpack.packb({
+        "sourceId": "drill", "deviceToken": token,
+        "kind": "DeviceEventBatch",
+        "request": _asdict(DeviceEventBatch(
+            device_token=token,
+            measurements=[DeviceMeasurement(
+                name=name, value=value,
+                event_date=int(time.time() * 1000))])),
+        "metadata": {},
+    }, use_bin_type=True)
+    client = BusClient("127.0.0.1", bus_port)
+    try:
+        client.publish(naming.event_source_decoded_events("default"),
+                       token.encode(), payload)
+    finally:
+        client.close()
+
+
+def _state_value(rest_ports, token, name):
+    """(host, value) for the owner host exposing device state, else None."""
+    for i, port in enumerate(rest_ports):
+        c = _try_client(port)
+        if c is None:
+            continue
+        try:
+            state = c.get(f"/api/devicestates/{token}")
+        except Exception:
+            continue
+        meas = state.get("lastMeasurements") or state.get(
+            "last_measurements") or {}
+        if name in meas:
+            # value is [event_date, value] or scalar depending on marshal
+            val = meas[name]
+            return i, (val[1] if isinstance(val, (list, tuple)) else val)
+    return None
+
+
+def test_kill_one_of_three_supervised_hosts_recovers(tmp_path):
+    instance_id = "supdrill"
+    coord = _free_port()
+    bus_ports = [_free_port() for _ in range(N)]
+    rest_ports = [_free_port() for _ in range(N)]
+    peers = ",".join(f"{i}=127.0.0.1:{bus_ports[i]}" for i in range(N))
+    cfg_path = tmp_path / "cfg.json"
+    cfg_path.write_text(json.dumps({
+        "instance": {"id": instance_id},
+        # shapes divisible by the 6-way mesh (3 hosts x 2 virtual devices)
+        "pipeline": {"enabled": True, "batch_size": 24, "max_devices": 96,
+                     "max_zones": 4, "max_zone_vertices": 4,
+                     "measurement_slots": 4, "max_tenants": 4},
+        # fast failure detection so the drill's watchdog exits are quick;
+        # checkpoints manual (REST) only
+        "cluster": {"heartbeat_s": 0.4, "stale_after_s": 4.0,
+                    "fail_after_s": 8.0},
+        "persist": {"checkpoint_interval_s": None},
+    }))
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    env["PYTHONUNBUFFERED"] = "1"
+    sups, logs = [], []
+    for i in range(N):
+        sups.append(subprocess.Popen(
+            [sys.executable, "-u", "-m", "sitewhere_tpu", "serve",
+             "--supervise", "--supervise-backoff", "1",
+             "--config", str(cfg_path),
+             "--cluster-coordinator", f"127.0.0.1:{coord}",
+             "--cluster-num-processes", str(N),
+             "--cluster-process-id", str(i),
+             "--cluster-peers", peers,
+             "--bus-port", str(bus_ports[i]),
+             "--port", str(rest_ports[i]),
+             "--data-dir", str(tmp_path / f"h{i}")],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env, cwd=str(tmp_path)))
+        logs.append(_HostLog(sups[-1]))
+
+    try:
+        # ---- phase 1: full gang serving -----------------------------------
+        _wait(lambda: all(log.banners() >= 1 for log in logs), 900,
+              "all three hosts serving", logs)
+
+        # provision over host 0 ONLY: the registry must gossip to 1 and 2
+        c0 = _client(rest_ports[0])
+        c0.post("/api/devicetypes", {"token": "dt", "name": "drill-type"})
+        tokens = [f"sd{i}" for i in range(6)]
+        for tok in tokens:
+            c0.post("/api/devices", {"token": tok,
+                                     "device_type_token": "dt"})
+            c0.post("/api/assignments", {"token": f"as-{tok}",
+                                         "device_token": tok})
+
+        def replicated_everywhere():
+            for port in rest_ports[1:]:
+                c = _try_client(port)
+                if c is None:
+                    return False
+                try:
+                    listed = c.get("/api/devices", pageSize=100)
+                except Exception:
+                    return False
+                got = {d["token"] for d in listed.get("results", [])}
+                if not set(tokens) <= got:
+                    return False
+            return True
+
+        _wait(replicated_everywhere, 300,
+              "registry gossip to hosts 1 and 2", logs)
+
+        # ingest through host 1's bus edge for ALL devices: rows owned by
+        # hosts 0 and 2 must forward (foreign-row forwarding at N=3)
+        for k, tok in enumerate(tokens):
+            _publish_event(bus_ports[1], instance_id, tok, "temp",
+                           100.0 + k)
+
+        owners = {}
+
+        def all_folded():
+            for k, tok in enumerate(tokens):
+                got = _state_value(rest_ports, tok, "temp")
+                if got is None or got[1] != 100.0 + k:
+                    return False
+                owners[tok] = got[0]
+            return True
+
+        _wait(all_folded, 300, "all six events folded pre-kill", logs)
+        assert len(set(owners.values())) > 1, (
+            f"drill needs devices on >1 host, owners={owners}")
+
+        # checkpoint every host, then land GAP events (after the
+        # checkpoint — recovery must replay them from committed offsets)
+        for port in rest_ports:
+            _client(port).post("/api/instance/checkpoint", {})
+        for k, tok in enumerate(tokens[:3]):
+            _publish_event(bus_ports[2], instance_id, tok, "gap",
+                           200.0 + k)
+        _wait(lambda: all(
+            (_state_value(rest_ports, tok, "gap") or (None, None))[1]
+            == 200.0 + k for k, tok in enumerate(tokens[:3])),
+            300, "gap events folded", logs)
+
+        # ---- phase 2: hard-kill host 1's CHILD ----------------------------
+        victim_pid = logs[1].child_pids()[-1]
+        restarts_before = [log.restarts() for log in logs]
+        banners_before = [log.banners() for log in logs]
+        os.kill(victim_pid, signal.SIGKILL)
+
+        # zero operator action from here on. Survivors' watchdogs exit
+        # (distinct code) -> every supervisor restarts its child -> the
+        # gang re-forms on the same ports.
+        _wait(lambda: all(log.restarts() > restarts_before[i]
+                          for i, log in enumerate(logs)), 600,
+              "all three supervisors restarted their children", logs)
+        _wait(lambda: all(log.banners() > banners_before[i]
+                          for i, log in enumerate(logs)), 900,
+              "all three hosts serving again", logs)
+
+        # ---- phase 3: recovery assertions ---------------------------------
+        def state_recovered():
+            for k, tok in enumerate(tokens):
+                got = _state_value(rest_ports, tok, "temp")
+                if got is None or got[1] != 100.0 + k:
+                    return False
+            for k, tok in enumerate(tokens[:3]):
+                got = _state_value(rest_ports, tok, "gap")
+                if got is None or got[1] != 200.0 + k:
+                    return False
+            return True
+
+        _wait(state_recovered, 600,
+              "device state (checkpoint + replay) after gang restart",
+              logs)
+        _wait(replicated_everywhere, 300,
+              "replicated registry after gang restart", logs)
+
+        # the recovered gang still ingests: a NEW event through the
+        # restarted host's own edge folds end-to-end
+        _publish_event(bus_ports[1], instance_id, tokens[0], "post",
+                       300.0)
+        _wait(lambda: (_state_value(rest_ports, tokens[0], "post")
+                       or (None, None))[1] == 300.0, 300,
+              "post-recovery event folded", logs)
+
+        # ---- graceful shutdown: supervisors exit 0 ------------------------
+        for p in sups:
+            p.send_signal(signal.SIGTERM)
+        for i, p in enumerate(sups):
+            rc = p.wait(timeout=300)
+            assert rc == 0, (i, rc, logs[i].text()[-3000:])
+    finally:
+        for p in sups:
+            if p.poll() is None:
+                p.kill()
+                p.wait(timeout=30)
+        # reap any orphaned serve children the supervisors left (only on
+        # abnormal test exit; normal path has none)
+        for log in logs:
+            for pid in log.child_pids():
+                try:
+                    os.kill(pid, signal.SIGKILL)
+                except OSError:
+                    pass
