@@ -12,6 +12,10 @@ buffers, and whether it persists at all:
   spill dir / segment size / linger (isolation, per-tenant retention).
 - kind "memory": dedicated in-memory log, never touches disk (dev/test or
   data-residency-restricted tenants).
+- kind "widerow": the SECOND interchangeable historical backend
+  (`persist/widerow.py` — the sitewhere-hbase/cassandra wide-column
+  store role): ACID sqlite rows in time buckets, indexed on the
+  reference's query axes, whole-bucket retention pruning.
 - no override: the tenant shares the instance's default log (the default
   single-store deployment).
 
@@ -30,18 +34,19 @@ from typing import Dict, Optional
 
 from sitewhere_tpu.persist.eventlog import ColumnarEventLog
 
-_KINDS = ("columnar", "memory")
+_KINDS = ("columnar", "memory", "widerow")
 
 
 @dataclass
 class DatastoreConfig:
     """One tenant's event-store choice."""
 
-    kind: str = "columnar"           # "columnar" | "memory"
+    kind: str = "columnar"           # "columnar" | "memory" | "widerow"
     data_dir: Optional[str] = None   # spill dir; relative = under base dir
     segment_rows: int = 65536
     linger_ms: int = 250
     spill: bool = True
+    bucket_ms: int = 3_600_000       # widerow time-bucket width
 
     def __post_init__(self) -> None:
         if self.kind not in _KINDS:
@@ -63,7 +68,8 @@ class DatastoreConfig:
             segment_rows=int(keys.get("datastore.segment_rows", 65536)),
             linger_ms=int(keys.get("datastore.linger_ms", 250)),
             spill=keys.get("datastore.spill", "true").lower()
-            in ("1", "true", "yes", "on"))
+            in ("1", "true", "yes", "on"),
+            bucket_ms=int(keys.get("datastore.bucket_ms", 3_600_000)))
 
 
 class TenantDatastoreManager:
@@ -80,7 +86,8 @@ class TenantDatastoreManager:
         self.default_log = default_log
         self.base_dir = base_dir
         self.overrides: Dict[str, DatastoreConfig] = dict(overrides or {})
-        self._dedicated: Dict[str, ColumnarEventLog] = {}
+        # ColumnarEventLog or WideRowEventStore (duck-compatible surface)
+        self._dedicated: Dict[str, object] = {}
         self._lock = threading.Lock()
         self._started = False
 
@@ -100,7 +107,9 @@ class TenantDatastoreManager:
         return DatastoreConfig.from_metadata(
             getattr(tenant, "metadata", None) or {})
 
-    def event_log_for(self, tenant) -> ColumnarEventLog:
+    def event_log_for(self, tenant):
+        """The tenant's event store: the shared default ColumnarEventLog,
+        or a dedicated columnar/memory/widerow store (duck-compatible)."""
         token = getattr(tenant, "token", tenant)
         config = self.config_for(tenant)
         if config is None:
@@ -114,9 +123,22 @@ class TenantDatastoreManager:
                     log.start()
             return log
 
-    def _build(self, token: str, config: DatastoreConfig) -> ColumnarEventLog:
+    def _build(self, token: str, config: DatastoreConfig):
         from urllib.parse import quote
 
+        if config.kind == "widerow":
+            from sitewhere_tpu.persist.widerow import WideRowEventStore
+
+            db_path = config.data_dir
+            if db_path is None and self.base_dir:
+                stores = os.path.join(self.base_dir, "tenant-stores")
+                db_path = os.path.join(
+                    stores, quote(token, safe="") + ".widerow.db")
+            elif db_path is not None and not os.path.isabs(db_path) \
+                    and self.base_dir:
+                db_path = os.path.join(self.base_dir, db_path)
+            return WideRowEventStore(db_path=db_path,
+                                     bucket_ms=config.bucket_ms)
         data_dir = None
         if config.kind == "columnar":
             data_dir = config.data_dir
@@ -144,8 +166,14 @@ class TenantDatastoreManager:
 
     def dedicated_tenants(self) -> Dict[str, str]:
         """token -> kind, for topology/observability."""
+        def kind(log) -> str:
+            explicit = getattr(log, "kind", None)
+            if explicit:
+                return explicit
+            return "columnar" if log._data_dir else "memory"
+
         with self._lock:
-            return {tok: ("columnar" if log._data_dir else "memory")
+            return {tok: kind(log)
                     for tok, log in self._dedicated.items()}
 
     # -- lifecycle (instance calls these around its own) -------------------

@@ -199,13 +199,20 @@ def event_management_model() -> ElementModel:
         description="Dedicated event store for one tenant",
         attributes=[
             _attr("tenant", required=True),
-            _attr("kind", choices=["columnar", "memory"],
-                  default="columnar"),
+            _attr("kind", choices=["columnar", "memory", "widerow"],
+                  default="columnar",
+                  description="columnar scan log, in-memory log, or the "
+                              "wide-row ACID store (the HBase/Cassandra "
+                              "historical-store role)"),
             _attr("data_dir",
-                  description="spill dir (relative = under instance dir)"),
+                  description="spill dir / db path (relative = under "
+                              "instance dir)"),
             _attr("segment_rows", _I, default=65536),
             _attr("linger_ms", _I, default=250),
             _attr("spill", _B, default=True),
+            _attr("bucket_ms", _I, default=3_600_000,
+                  description="widerow time-bucket width (retention "
+                              "prunes whole buckets)"),
         ])
     return ElementModel(
         name="event_management", role="event-management",
