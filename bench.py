@@ -65,6 +65,9 @@ def main() -> None:
     small = os.environ.get("BENCH_SCALE") == "small"
     trials_n = max(1, int(os.environ.get("BENCH_TRIALS",
                                          "2" if small else "3")))
+    # link fingerprint BEFORE the build/warmup drains the tunnel's burst
+    # allowance, and again after all sections (the drained steady state)
+    link_pre = _link_probe(jax)
     ctx = _build(jax, small)
 
     sections = [
@@ -89,6 +92,8 @@ def main() -> None:
             trials[name].append(fn(jax, ctx))
 
     result = _aggregate(jax, ctx, trials, trials_n)
+    result["link_probe_pre"] = link_pre
+    result["link_probe_post"] = _link_probe(jax)
 
     from perf_gate import gate_against_recorded
     gate = gate_against_recorded(
@@ -111,6 +116,33 @@ def main() -> None:
 # context build: every engine/world/pool constructed + warmed ONCE, so the
 # interleaved trials measure steady state back-to-back
 # ---------------------------------------------------------------------------
+
+def _link_probe(jax) -> Dict:
+    """Raw link-state fingerprint: dispatch RTT + h2d bandwidth measured
+    OUTSIDE the framework. The tunneled runtime's sustained floor swings
+    orders of magnitude between runs (observed 9 MB/s to 1.4 GB/s on the
+    same day); recording the link state inside the SAME result line is
+    what lets a reader adjudicate absolute-number swings as weather vs
+    regression (VERDICT r4 weak #1)."""
+    f = jax.jit(lambda a: a * 2 + 1)
+    x = jax.device_put(np.ones((8, 128), np.float32))
+    f(x).block_until_ready()  # compile outside the timings
+    rtts = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        f(x).block_until_ready()
+        rtts.append((time.perf_counter() - t0) * 1e3)
+    buf = np.ones((1 << 20,), np.float32)  # 4 MiB = 4.194 MB
+    mb = buf.nbytes / 1e6
+    bw = []
+    for _ in range(4):
+        t0 = time.perf_counter()
+        jax.device_put(buf).block_until_ready()
+        bw.append(mb / (time.perf_counter() - t0))
+    return {"dispatch_rtt_ms_p50": round(_median(rtts), 3),
+            "h2d_4mb_mbps_best": round(max(bw), 1),
+            "h2d_4mb_mbps_last": round(bw[-1], 1)}
+
 
 def _build(jax, small: bool) -> Dict:
     from sitewhere_tpu.model import AlertLevel
