@@ -244,6 +244,12 @@ def cmd_serve(args) -> int:
     instance.start()
     _apply_rule_config(instance, cfg)
     _apply_search_config(instance, cfg)
+    # opt-in usage telemetry (the MicroserviceAnalytics role; OFF unless
+    # telemetry.enabled + telemetry.endpoint are configured)
+    from sitewhere_tpu.runtime.telemetry import build_from_config
+    telemetry = build_from_config(cfg, instance.instance_id)
+    if telemetry is not None:
+        telemetry.start()
     rest = RestServer(instance, host=cfg.get("api.host"),
                       port=int(cfg.get("api.port")),
                       token_expiration_minutes=int(
@@ -272,6 +278,8 @@ def cmd_serve(args) -> int:
             bus_server.stop()
         rest.stop()
         instance.stop()
+        if telemetry is not None:
+            telemetry.stop()
     return 0
 
 
@@ -319,6 +327,10 @@ def _serve_cluster(cfg) -> int:
     # replace-on-add at the peers)
     _apply_rule_config(instance, cfg)
     _apply_search_config(instance, cfg)
+    from sitewhere_tpu.runtime.telemetry import build_from_config
+    telemetry = build_from_config(cfg, instance.instance_id)
+    if telemetry is not None:
+        telemetry.start()
     rest = RestServer(instance, host=cfg.get("api.host"),
                       port=int(cfg.get("api.port")),
                       token_expiration_minutes=int(
@@ -343,6 +355,8 @@ def _serve_cluster(cfg) -> int:
     finally:
         rest.stop()
         cluster.stop()
+        if telemetry is not None:
+            telemetry.stop()
     return 0
 
 

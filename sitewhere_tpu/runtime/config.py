@@ -195,6 +195,10 @@ DEFAULTS: Dict = {
     # federated external search providers (runtime/config_model.py
     # event_search_model; search/external.py HttpSearchProvider)
     "search_providers": [],
+    # opt-in usage telemetry (runtime/telemetry.py — the
+    # MicroserviceAnalytics role, inverted to off-by-default and
+    # operator-owned endpoint)
+    "telemetry": {"enabled": False, "endpoint": None, "interval_s": 3600},
     "persist": {"data_dir": "./swtpu-data",
                 # seconds between automatic device-state checkpoints
                 # (None = manual/REST-triggered only)

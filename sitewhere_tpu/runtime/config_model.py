@@ -357,6 +357,20 @@ def event_search_model() -> ElementModel:
                     _attr("tenant")])
 
 
+def telemetry_model() -> ElementModel:
+    return ElementModel(
+        name="telemetry", role="instance-telemetry",
+        description="Opt-in usage telemetry (the MicroserviceAnalytics "
+                    "role): lifecycle Started/Uptime/Stopped events "
+                    "POSTed to the OPERATOR'S endpoint; off by default, "
+                    "no third-party service, lifecycle metadata only",
+        attributes=[_attr("enabled", _B, default=False),
+                    _attr("endpoint",
+                          description="HTTP(S) URL receiving the JSON "
+                                      "events (required when enabled)"),
+                    _attr("interval_s", _D, default=3600.0)])
+
+
 def _all_elements() -> List[ElementModel]:
     """Every subsystem's element model — the single source both the UI model
     and the validator consume."""
@@ -366,7 +380,7 @@ def _all_elements() -> List[ElementModel]:
         outbound_connectors_model(), command_delivery_model(),
         registration_model(), batch_operations_model(), schedule_model(),
         label_generation_model(), web_rest_model(), analytics_model(),
-        event_search_model(),
+        event_search_model(), telemetry_model(),
     ]
 
 

@@ -1,0 +1,95 @@
+"""Opt-in usage telemetry (runtime/telemetry.py — the
+MicroserviceAnalytics role with privacy-correct defaults)."""
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from sitewhere_tpu.runtime.config import DEFAULTS, Configuration
+from sitewhere_tpu.runtime.telemetry import (
+    UsageTelemetry, build_from_config)
+
+
+class _Collector:
+    def __init__(self):
+        self.events = []
+        self.lock = threading.Lock()
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_POST(self):
+                body = self.rfile.read(
+                    int(self.headers.get("Content-Length", 0)))
+                with outer.lock:
+                    outer.events.append(json.loads(body))
+                self.send_response(204)
+                self.end_headers()
+
+            def log_message(self, *a):
+                pass
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+
+    @property
+    def endpoint(self):
+        return f"http://127.0.0.1:{self.httpd.server_address[1]}/usage"
+
+    def snapshot(self):
+        with self.lock:
+            return list(self.events)
+
+    def close(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def test_started_uptime_stopped_events():
+    collector = _Collector()
+    try:
+        telemetry = UsageTelemetry(collector.endpoint, "inst-1", "9.9.9",
+                                   interval_s=0.2)
+        telemetry.start()
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            kinds = [e["event"] for e in collector.snapshot()]
+            if "uptime" in kinds:
+                break
+            time.sleep(0.05)
+        telemetry.stop()
+        events = collector.snapshot()
+        kinds = [e["event"] for e in events]
+        assert kinds[0] == "started"
+        assert "uptime" in kinds
+        assert kinds[-1] == "stopped"
+        assert all(e["instance"] == "inst-1" and e["version"] == "9.9.9"
+                   for e in events)
+        # uptime monotonically grows across events
+        assert events[-1]["uptime_s"] >= events[0]["uptime_s"]
+        # lifecycle metadata ONLY — the privacy contract
+        assert set(events[0]) == {"instance", "version", "event",
+                                  "uptime_s"}
+    finally:
+        collector.close()
+
+
+def test_dead_endpoint_is_harmless():
+    telemetry = UsageTelemetry("http://127.0.0.1:9/nothing", "i", "v",
+                               interval_s=60, timeout_s=0.2)
+    telemetry.start()   # must not raise
+    telemetry.stop()
+
+
+def test_off_by_default_and_requires_endpoint():
+    assert build_from_config(Configuration(DEFAULTS), "i") is None
+    enabled_no_endpoint = Configuration(DEFAULTS)
+    enabled_no_endpoint.set("telemetry.enabled", True)
+    assert build_from_config(enabled_no_endpoint, "i") is None
+    full = Configuration(DEFAULTS)
+    full.set("telemetry.enabled", True)
+    full.set("telemetry.endpoint", "http://127.0.0.1:1/x")
+    built = build_from_config(full, "i")
+    assert built is not None
+    assert built.interval_s == 3600.0
