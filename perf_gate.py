@@ -7,9 +7,11 @@ within one run: RATIOS between sections that share the same dominant
 resource (telemetry/headline, sharded/headline, multitenant/sharded — all
 tunnel-transfer-bound, so the link state cancels), and ABSOLUTES for
 host-CPU-only sections that never touch the tunnel (persist, router cost,
-narrow-window query — the host is the same machine across rounds). Either
-kind drifting past tolerance between rounds means the WORKLOAD changed
-shape (a real regression or a real win), not the weather.
+narrow-window query). Ratio drift past tolerance is a hard failure.
+Absolute drift hard-fails only between runs whose host-CPU fingerprints
+(`link_probe_pre.host_argsort_1m_ms`) are comparable — VM CPU steal moves
+host absolutes 4x on unchanged code (docs/PERF.md) — and is otherwise
+reported as advisory with the reason in the verdict.
 
 One anomalous round must not poison the gate forever, so a current run
 passes if its ratios are within tolerance of EITHER of the two most recent
@@ -69,6 +71,21 @@ ABS_KEYS: List[str] = [
 DEFAULT_TOL = float(os.environ.get("BENCH_GATE_TOL", "0.25"))
 DEFAULT_ABS_TOL = float(os.environ.get("BENCH_GATE_ABS_TOL", "0.35"))
 
+# "Same machine across rounds" (the ABS_KEYS premise) is only true when
+# the VM's effective CPU is comparable: round 5 measured the UNCHANGED
+# router code at 1.9 ms and 7.9 ms on different days (CPU steal). The
+# bench's link_probe carries a fixed-workload host fingerprint
+# (host_argsort_1m_ms); absolute drift HARD-fails only between runs whose
+# fingerprints are within this factor — otherwise the drift is still
+# reported, marked advisory, with the reason in the verdict. Rounds
+# recorded before the fingerprint existed can never prove comparability,
+# so vs those the absolutes are advisory too (the ratio family plus
+# self-consistency remain the hard gate). The bound must sit INSIDE
+# abs_tol in the unfavorable direction — time-based keys scale linearly
+# with host slowdown, so an admitted factor f inflates them by (f-1):
+# 1.25 keeps +25% of pure CPU steal below the 35% hard-fail line.
+HOST_STATE_RATIO_BOUND = 1.25
+
 # intra-run self-consistency: the step_breakdown's parts must explain the
 # synchronous step total (VERDICT r4: 16.7 ms total vs 3.1 ms of parts)
 MAX_UNACCOUNTED_PCT = 25.0
@@ -120,7 +137,12 @@ def compare(prev_bench: Dict, cur_bench: Dict, tol: float = DEFAULT_TOL,
 
     Returns {"ok", "tol", "abs_tol", "ratios": {name: {prev, cur,
     drift_pct}}, "absolutes": {...}, "failures": [name...]} — drift is
-    cur/prev - 1; |drift| past tolerance is a failure.
+    cur/prev - 1. |ratio drift| past tolerance is always a failure.
+    |absolute drift| past tolerance is a failure only when both runs
+    carry comparable host fingerprints (link_probe_pre.host_argsort_1m_ms
+    within HOST_STATE_RATIO_BOUND); otherwise the entry is annotated
+    "advisory_exceeded": true, the reason lands in top-level
+    "absolutes_advisory", and ok stays unaffected by it.
     """
     # Comparisons only hold when both runs measured the SAME workload
     # config; the metric string embeds devices/batch, so a
@@ -133,7 +155,7 @@ def compare(prev_bench: Dict, cur_bench: Dict, tol: float = DEFAULT_TOL,
     failures: List[str] = []
 
     def drifts(prev_vals: Dict[str, float], cur_vals: Dict[str, float],
-               bound: float) -> Dict[str, Dict]:
+               bound: float, gated: bool = True) -> Dict[str, Dict]:
         out: Dict[str, Dict] = {}
         for name in sorted(set(prev_vals) & set(cur_vals)):
             if not prev_vals[name]:
@@ -143,18 +165,44 @@ def compare(prev_bench: Dict, cur_bench: Dict, tol: float = DEFAULT_TOL,
                          "cur": round(cur_vals[name], 4),
                          "drift_pct": round(drift * 100, 1)}
             if abs(drift) > bound:
-                failures.append(name)
+                if gated:
+                    failures.append(name)
+                else:
+                    out[name]["advisory_exceeded"] = True
         return out
+
+    def host_fp(bench: Dict):
+        probe = bench.get("link_probe_pre") or {}
+        v = probe.get("host_argsort_1m_ms")
+        return v if isinstance(v, (int, float)) and v > 0 else None
+
+    prev_fp, cur_fp = host_fp(prev_bench), host_fp(cur_bench)
+    if prev_fp is None or cur_fp is None:
+        host_comparable = False
+        host_note = ("no host fingerprint in "
+                     + ("baseline" if prev_fp is None else "current")
+                     + " run; host-absolute drift is advisory")
+    else:
+        factor = cur_fp / prev_fp
+        host_comparable = (1.0 / HOST_STATE_RATIO_BOUND <= factor
+                           <= HOST_STATE_RATIO_BOUND)
+        host_note = (None if host_comparable else
+                     f"host CPU state mismatch (argsort {prev_fp} -> "
+                     f"{cur_fp} ms); host-absolute drift is advisory")
 
     ratios = drifts(ratios_of(prev_bench), ratios_of(cur_bench), tol)
     absolutes = drifts(
         {k: prev_bench[k] for k in ABS_KEYS
          if isinstance(prev_bench.get(k), (int, float))},
         {k: cur_bench[k] for k in ABS_KEYS
-         if isinstance(cur_bench.get(k), (int, float))}, abs_tol)
-    return {"ok": not failures, "tol": tol, "abs_tol": abs_tol,
-            "ratios": ratios, "absolutes": absolutes,
-            "failures": failures}
+         if isinstance(cur_bench.get(k), (int, float))}, abs_tol,
+        gated=host_comparable)
+    out = {"ok": not failures, "tol": tol, "abs_tol": abs_tol,
+           "ratios": ratios, "absolutes": absolutes,
+           "failures": failures}
+    if host_note:
+        out["absolutes_advisory"] = host_note
+    return out
 
 
 def self_consistency(bench: Dict) -> Dict:

@@ -15,8 +15,8 @@ from perf_gate import (
 
 def _bench(headline=40e6, telemetry=44e6, sharded=36e6, persist=8e6,
            multitenant=34e6, analytics=10e6, compute=600e6,
-           unaccounted_pct=5.0, spreads=None):
-    return {
+           unaccounted_pct=5.0, spreads=None, host_ms=5.0):
+    out = {
         "metric": "events/sec ...", "value": headline,
         "telemetry_packed_events_per_sec": telemetry,
         "sharded_1chip_events_per_sec": sharded,
@@ -27,6 +27,9 @@ def _bench(headline=40e6, telemetry=44e6, sharded=36e6, persist=8e6,
         "step_breakdown": {"unaccounted_pct": unaccounted_pct},
         "spread_pct": spreads or {"headline": 8.0},
     }
+    if host_ms is not None:
+        out["link_probe_pre"] = {"host_argsort_1m_ms": host_ms}
+    return out
 
 
 def test_extract_bench_raw_parsed_and_tail_layouts():
@@ -67,7 +70,8 @@ def test_compare_flags_shape_change():
 
 
 def test_compare_absolute_host_sections():
-    # persist never touches the tunnel: judged absolutely, not vs headline
+    # persist never touches the tunnel: judged absolutely (both runs
+    # carry comparable host fingerprints), not vs headline
     prev = _bench()
     out = compare(prev, _bench(persist=8e6 * 0.5))
     assert not out["ok"]
@@ -79,6 +83,29 @@ def test_compare_absolute_host_sections():
     assert compare(prev, slow, tol=0.05)["ok"]
     # compute_only mixes resource domains: never part of the gate
     assert compare(prev, _bench(compute=600e6 * 3.0))["ok"]
+
+
+def test_host_state_mismatch_makes_absolutes_advisory():
+    """Host-absolute drift hard-fails ONLY between host-comparable runs:
+    VM CPU steal moves host absolutes 4x on unchanged code."""
+    prev = _bench()
+    # 4x slower host fingerprint: the same persist regression is now
+    # unattributable -> advisory, not a failure (but still reported)
+    cur = _bench(persist=8e6 * 0.5, host_ms=20.0)
+    out = compare(prev, cur)
+    assert out["ok"]
+    assert out["failures"] == []
+    assert out["absolutes"]["persist_events_per_sec"][
+        "advisory_exceeded"] is True
+    assert "host CPU state mismatch" in out["absolutes_advisory"]
+    # ratio drift still hard-fails regardless of host state
+    out = compare(prev, _bench(sharded=36e6 * 0.6, host_ms=20.0))
+    assert not out["ok"]
+    # a baseline recorded before the fingerprint existed can never prove
+    # comparability -> advisory there too
+    out = compare(_bench(host_ms=None), _bench(persist=8e6 * 0.5))
+    assert out["ok"]
+    assert "no host fingerprint" in out["absolutes_advisory"]
 
 
 def test_self_consistency_breakdown_and_spread():
@@ -107,7 +134,7 @@ def test_gate_accepts_either_of_last_two_rounds(tmp_path):
     assert gate["ok"]
     assert not gate["vs_recorded"]["r04"]["ok"]
     assert gate["vs_recorded"]["r03"]["ok"]
-    # drifted from BOTH -> fail
+    # drifted from BOTH (host-comparable fingerprints) -> fail
     gate = gate_against_recorded(_bench(persist=8e6 * 3.0),
                                  root=str(tmp_path))
     assert not gate["ok"]
