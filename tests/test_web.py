@@ -446,6 +446,53 @@ class TestApiExplorer:
         assert "https://" not in page
 
 
+def test_multithreaded_rest_smoke(server):
+    """Concurrent REST mutation + read smoke (the reference's
+    MultithreadedRestTest.java role): N authenticated clients hammer the
+    gateway simultaneously — every request must succeed (no 5xx, no
+    lost writes, no store-lock deadlocks) and every created entity must
+    be durably listed afterwards."""
+    import threading
+
+    workers, ops = 6, 12
+    failures = []
+    setup = SiteWhereClient(server.base_url)
+    setup.authenticate("admin", "password")
+    setup.create_device_type({"token": "mt-type", "name": "MT"})
+
+    def worker(wid: int):
+        try:
+            c = SiteWhereClient(server.base_url)
+            c.authenticate("admin", "password")
+            for i in range(ops):
+                token = f"mt-{wid}-{i}"
+                c.create_device({"token": token,
+                                 "device_type_token": "mt-type"})
+                c.create_assignment({"token": f"as-{token}",
+                                     "device_token": token})
+                c.add_measurements(f"as-{token}",
+                                   {"name": "m", "value": float(i)})
+                got = c.get_device(token)
+                assert got["token"] == token
+                c.list_devices(pageSize=5)
+        except Exception as exc:  # noqa: BLE001 — collected for assert
+            failures.append((wid, repr(exc)))
+
+    threads = [threading.Thread(target=worker, args=(w,))
+               for w in range(workers)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300)
+    hung = [t.name for t in threads if t.is_alive()]
+    assert not hung, f"deadlocked/hung REST workers: {hung}"
+    assert not failures, failures
+    listed = setup.list_devices(pageSize=500)
+    created = {d["token"] for d in listed["results"]
+               if d["token"].startswith("mt-")}
+    assert len(created) == workers * ops
+
+
 def test_device_element_mappings_over_rest(client):
     """Composite-device mappings REST surface (Devices.java:268/281):
     schema-tree-validated create, child parent backreference, delete."""
