@@ -311,3 +311,115 @@ class TestPackedWireProperties:
             np.testing.assert_array_equal(
                 np.asarray(getattr(decoded, name))[valid],
                 np.asarray(getattr(batch, name))[valid], err_msg=name)
+
+
+class TestStompFrameProperties:
+    """The embedded broker's frame codec (transport/stomp.py) is a
+    from-scratch STOMP 1.2 implementation: encode->read must be the
+    identity for every header (escaping covers \\, CR, LF, colon) and
+    every binary body (content-length framing, NUL bytes inside)."""
+
+    header_text = st.text(
+        alphabet=st.characters(blacklist_categories=("Cs",)),
+        min_size=1, max_size=40)
+
+    @given(st.dictionaries(header_text, header_text, max_size=8),
+           st.binary(max_size=512))
+    @settings(max_examples=60, deadline=None)
+    def test_encode_read_roundtrip(self, headers, body):
+        import asyncio
+
+        from sitewhere_tpu.transport.stomp import encode_frame, read_frame
+
+        wire = encode_frame("SEND", headers, body)
+
+        async def parse():
+            reader = asyncio.StreamReader()
+            reader.feed_data(wire)
+            reader.feed_eof()
+            return await read_frame(reader)
+
+        command, got_headers, got_body = asyncio.run(parse())
+        assert command == "SEND"
+        assert got_body == body
+        for key, value in headers.items():
+            assert got_headers[key] == value
+
+    @given(st.lists(st.binary(min_size=0, max_size=64), min_size=1,
+                    max_size=6))
+    @settings(max_examples=30, deadline=None)
+    def test_back_to_back_frames_parse_in_order(self, bodies):
+        import asyncio
+
+        from sitewhere_tpu.transport.stomp import encode_frame, read_frame
+
+        wire = b"".join(encode_frame("SEND", {"destination": "/q"}, b)
+                        for b in bodies)
+
+        async def parse_all():
+            reader = asyncio.StreamReader()
+            reader.feed_data(wire)
+            reader.feed_eof()
+            out = []
+            while True:
+                frame = await read_frame(reader)
+                if frame is None:
+                    return out
+                out.append(frame[2])
+
+        assert asyncio.run(parse_all()) == bodies
+
+
+class TestDeviceSlotPathProperties:
+    """find_device_slot (model/device.py) must resolve exactly the paths
+    the schema tree contains — every generated slot resolves to itself,
+    and no fabricated path outside the tree resolves."""
+
+    @given(st.integers(0, 2 ** 31 - 1), st.integers(1, 4),
+           st.integers(1, 3))
+    @settings(max_examples=40, deadline=None)
+    def test_every_real_path_resolves_and_fakes_do_not(self, seed, width,
+                                                       depth):
+        from sitewhere_tpu.model.device import (
+            DeviceElementSchema, DeviceSlot, DeviceUnit, find_device_slot)
+
+        rng = np.random.default_rng(seed)
+        counter = [0]
+
+        def build_unit(level, cls=DeviceUnit, path=""):
+            counter[0] += 1
+            slots = [DeviceSlot(name=f"S{counter[0]}-{i}",
+                                path=f"s{counter[0]}_{i}")
+                     for i in range(int(rng.integers(0, width + 1)))]
+            units = []
+            if level < depth:
+                units = [build_unit(level + 1, path=f"u{counter[0]}_{i}")
+                         for i in range(int(rng.integers(0, width + 1)))]
+            return cls(name=f"U{counter[0]}", path=path,
+                       device_slots=slots, device_units=units)
+
+        schema = build_unit(0, cls=DeviceElementSchema)
+
+        def walk(unit, prefix):
+            for slot in unit.device_slots:
+                yield (prefix + [slot.path], slot)
+            for child in unit.device_units:
+                yield from walk(child, prefix + [child.path])
+
+        real = list(walk(schema, []))
+        for segments, slot in real:
+            assert find_device_slot(schema, "/".join(segments)) is slot
+        # fabricated leaf names never resolve; nor do empty paths
+        for segments, _ in real[:5]:
+            assert find_device_slot(
+                schema, "/".join(segments[:-1] + ["nope"])) is None
+        # the UNIT prefix is load-bearing: a real leaf segment under a
+        # fabricated prefix must not resolve (a resolver that matched
+        # leaf names tree-wide, ignoring unit structure, would)
+        for segments, _ in real[:5]:
+            assert find_device_slot(
+                schema, "/".join(["nope"] + segments)) is None
+            if len(segments) > 1:
+                assert find_device_slot(schema, segments[-1]) is None
+        assert find_device_slot(schema, "") is None
+        assert find_device_slot(None, "a/b") is None
