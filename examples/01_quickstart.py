@@ -1,8 +1,15 @@
 """Quickstart: registry -> fused TPU pipeline -> rule alerts -> device state.
 
 Run: python examples/01_quickstart.py
-(CPU works: JAX_PLATFORMS=cpu; first compile takes ~30 s on one core.)
+(runs on CPU by default — see the preamble; first compile takes ~30 s on one core)
 """
+
+# Demos run on CPU regardless of ambient JAX_PLATFORMS: deterministic and
+# tunnel-independent. On real TPU hardware, delete these two lines.
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
 
 import numpy as np
 

@@ -13,6 +13,13 @@ Then explore (default credentials admin/password):
 Ctrl-C stops it.
 """
 
+# Demos run on CPU regardless of ambient JAX_PLATFORMS: deterministic and
+# tunnel-independent. On real TPU hardware, delete these two lines.
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+
 import time
 
 from sitewhere_tpu.instance import SiteWhereInstance

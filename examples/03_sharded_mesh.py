@@ -3,9 +3,21 @@
 On real hardware this runs over the pod's chips; to try it on a laptop use
 a virtual mesh:
 
-    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
-    JAX_PLATFORMS=cpu python examples/03_sharded_mesh.py
+    python examples/03_sharded_mesh.py   # virtual 8-way CPU mesh by default
 """
+
+# Demos run on CPU regardless of ambient JAX_PLATFORMS: deterministic and
+# tunnel-independent. On real TPU hardware, delete this preamble.
+import os
+
+if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                                + " --xla_force_host_platform"
+                                  "_device_count=8").strip()
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
 
 import numpy as np
 

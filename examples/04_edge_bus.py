@@ -5,8 +5,15 @@ process — here a spawned subprocess standing in for a gateway box —
 publishes device events with BusClient; the host consumes them with
 committed-offset at-least-once semantics and feeds the inbound pipeline.
 
-Run: python examples/04_edge_bus.py   (JAX_PLATFORMS=cpu works)
+Run: python examples/04_edge_bus.py   (CPU by default — see preamble)
 """
+
+# Demos run on CPU regardless of ambient JAX_PLATFORMS: deterministic and
+# tunnel-independent. On real TPU hardware, delete these two lines.
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
 
 import subprocess
 import sys

@@ -5,8 +5,15 @@ The device speaks the reference's protobuf wire format
 protobuf RegistrationAck, streams measurements, and receives a custom
 command encoded against its device type's dynamic schema.
 
-Run: python examples/05_protobuf_device.py   (JAX_PLATFORMS=cpu works)
+Run: python examples/05_protobuf_device.py   (CPU by default — see preamble)
 """
+
+# Demos run on CPU regardless of ambient JAX_PLATFORMS: deterministic and
+# tunnel-independent. On real TPU hardware, delete these two lines.
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
 
 import time
 

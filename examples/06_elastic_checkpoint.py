@@ -7,9 +7,22 @@ checkpoints store a canonical flat device-major layout
 (persist/checkpoint.py; parallel/engine.py canonical_state).
 
 Run (CPU, virtual devices):
-    XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+    # runs on a virtual 8-way CPU mesh by default (see the preamble):
         python examples/06_elastic_checkpoint.py
 """
+
+# Demos run on CPU regardless of ambient JAX_PLATFORMS: deterministic and
+# tunnel-independent. On real TPU hardware, delete this preamble.
+import os
+
+if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                                + " --xla_force_host_platform"
+                                  "_device_count=8").strip()
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
 
 import tempfile
 

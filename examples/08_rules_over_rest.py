@@ -10,9 +10,15 @@ spring config; here live CRUD at /api/rules).
 Also shows the observability surface: Prometheus /metrics and the rule
 panel data the /admin console renders.
 
-Run (CPU):
-    JAX_PLATFORMS=cpu python examples/08_rules_over_rest.py
+Run: python examples/08_rules_over_rest.py   (CPU by default — see preamble)
 """
+
+# Demos run on CPU regardless of ambient JAX_PLATFORMS: deterministic and
+# tunnel-independent. On real TPU hardware, delete these two lines.
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
 
 import time
 import urllib.request
