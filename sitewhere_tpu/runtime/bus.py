@@ -234,6 +234,10 @@ class _Partition:
 
 
 class Topic:
+    # sweep cadence: retention is evaluated per partition once per this
+    # many appends (amortizes the group-floor scan off the hot path)
+    RETENTION_CHECK_EVERY = 2048
+
     def __init__(self, name: str, partitions: int, data_dir: Optional[str] = None):
         self.name = name
         paths = [None] * partitions
@@ -243,6 +247,51 @@ class Topic:
             os.makedirs(topic_dir, exist_ok=True)
             paths = [os.path.join(topic_dir, f"p{i:04d}.log") for i in range(partitions)]
         self.partitions = [_Partition(p) for p in paths]
+        # in-memory retention (Kafka's log.retention role, bounded RAM):
+        # installed by EventBus.enable_retention() AFTER boot replay —
+        # None = unlimited (standalone topics, pre-restore boot window)
+        self._retention_records: Optional[int] = None
+        self._floor_fn = None           # partition idx -> min committed
+        self._since_check = [0] * partitions
+        self.retention_dropped = 0
+
+    def enable_retention(self, max_records: int, floor_fn) -> None:
+        self._retention_records = int(max_records)
+        self._floor_fn = floor_fn
+        for idx in range(len(self.partitions)):
+            self._apply_retention(idx)
+
+    def _apply_retention(self, idx: int) -> None:
+        """Truncate partition `idx`'s in-memory window. Keeps, from
+        newest to oldest: the cap window (future/new consumers can read
+        that far back, like Kafka's retention window); anything an
+        EXISTING group has not committed yet (crash-replay stays intact
+        for live laggards); but never more than 8x the cap — a dead
+        group must not pin unbounded memory (Kafka answers the same way:
+        retention wins over a too-slow consumer; the busnet consumer
+        path already handles truncated extents)."""
+        cap = self._retention_records
+        if cap is None:
+            return
+        p = self.partitions[idx]
+        end = p.end_offset()
+        cutoff = end - cap
+        if cutoff <= p.start_offset():
+            return
+        floor = self._floor_fn(idx) if self._floor_fn is not None else end
+        cutoff = min(cutoff, floor)
+        cutoff = max(cutoff, end - 8 * cap)
+        if cutoff > p.start_offset():
+            self.retention_dropped += cutoff - p.start_offset()
+            p.truncate_before(cutoff)
+
+    def _maybe_retain(self, idx: int, appended: int) -> None:
+        if self._retention_records is None:
+            return
+        self._since_check[idx] += appended
+        if self._since_check[idx] >= self.RETENTION_CHECK_EVERY:
+            self._since_check[idx] = 0
+            self._apply_retention(idx)
 
     def partition_for(self, key: bytes) -> int:
         # Stable across processes/restarts (unlike Python hash()).
@@ -250,7 +299,9 @@ class Topic:
 
     def publish(self, key: bytes, value: bytes) -> Tuple[int, int]:
         part = self.partition_for(key)
-        return part, self.partitions[part].append(key, value)
+        offset = self.partitions[part].append(key, value)
+        self._maybe_retain(part, 1)
+        return part, offset
 
     def publish_many(self, records: List[Tuple[bytes, bytes]]
                      ) -> Tuple[int, int]:
@@ -268,6 +319,7 @@ class Topic:
         last: Tuple[int, int] = (last_part, -1)
         for part, recs in by_part.items():
             offset = self.partitions[part].append_many(recs)
+            self._maybe_retain(part, len(recs))
             if part == last_part:
                 last = (part, offset)
         return last
@@ -422,14 +474,49 @@ class EventBus:
         self._topics: Dict[str, Topic] = {}
         self._groups: Dict[Tuple[str, str], ConsumerGroup] = {}
         self._lock = threading.RLock()  # consumer() -> topic() re-enters
+        self._retention_records: Optional[int] = None
         if data_dir:
             os.makedirs(os.path.join(data_dir, "_offsets"), exist_ok=True)
+
+    def enable_retention(self, max_records: int = 65536) -> None:
+        """Bound every partition's IN-MEMORY window (Kafka's
+        log.retention role). Must be called AFTER boot replay / any
+        checkpoint cursor rewind: from then on, a partition keeps its
+        newest `max_records` plus whatever live consumer groups have not
+        committed (hard-bounded at 8x — see Topic._apply_retention).
+        Durable log files are unaffected; in-memory reads below the
+        window report a truncated extent, which consumers already
+        handle. Applies to existing topics immediately and to topics
+        created later."""
+        with self._lock:
+            self._retention_records = int(max_records)
+            topics = list(self._topics.values())
+        for topic in topics:
+            topic.enable_retention(self._retention_records,
+                                   self._floor_fn(topic.name))
+
+    def _floor_fn(self, topic_name: str):
+        def floor(idx: int) -> int:
+            with self._lock:
+                groups = [g for (t, _gid), g in self._groups.items()
+                          if t == topic_name]
+            floors = []
+            for group in groups:
+                with group._lock:
+                    if idx < len(group.committed):
+                        floors.append(group.committed[idx])
+            return min(floors) if floors else (1 << 62)
+        return floor
 
     def topic(self, name: str, partitions: Optional[int] = None) -> Topic:
         with self._lock:
             if name not in self._topics:
-                self._topics[name] = Topic(name, partitions or self._partitions,
-                                           self._data_dir)
+                topic = Topic(name, partitions or self._partitions,
+                              self._data_dir)
+                if self._retention_records is not None:
+                    topic.enable_retention(self._retention_records,
+                                           self._floor_fn(name))
+                self._topics[name] = topic
             return self._topics[name]
 
     def publish(self, topic_name: str, key: bytes, value: bytes) -> Tuple[int, int]:
