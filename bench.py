@@ -52,6 +52,59 @@ def _spread_pct(xs: List[float]) -> float:
     return round((max(xs) - min(xs)) / med * 100, 1) if med else 0.0
 
 
+# The driver records the last 2000 bytes of output; the result line must
+# fit WITH margin (a partial leading fragment still leaves a parseable
+# whole line when the line is short enough).
+MAX_RESULT_LINE_BYTES = 1900
+
+# Scalar result keys that survive into the compact stdout line. Everything
+# else (per-trial raws, interleaved pairs, post probes, full gate
+# comparisons) lives in the BENCH_DETAIL.json sidecar.
+_COMPACT_KEYS = (
+    "metric", "value", "unit", "vs_baseline", "scale", "trials",
+    "p50_step_ms", "p99_step_ms", "p99_rule_eval_ms",
+    "compute_only_events_per_sec", "system_sustained_events_per_sec",
+    "latency_mode_p50_ms", "latency_mode_p99_ms",
+    "latency_mode_trial_p99_ms", "latency_mode",
+    "telemetry_packed_events_per_sec", "telemetry_wire_bytes_per_event",
+    "persist_events_per_sec", "analytics_replay_events_per_sec",
+    "sharded_1chip_events_per_sec", "sharded_from_bytes_events_per_sec",
+    "sharded_1chip_router_ms_per_step",
+    "multitenant_sharded_events_per_sec", "query_10m_narrow_window_ms",
+    "spread_pct", "device")
+
+
+def _compact_result(result: Dict, detail_path) -> Dict:
+    """The compact result line: every number the perf gate (this round or
+    a future one comparing against this round) needs — gate ratio/absolute
+    keys, the host fingerprint, the steady-state latency evidence, the
+    self-consistency inputs — plus a pointer to the full sidecar."""
+    out = {k: result[k] for k in _COMPACT_KEYS if k in result}
+    bd = result.get("step_breakdown") or {}
+    out["step_breakdown"] = {k: bd[k] for k in (
+        "pack_ms", "h2d_ms", "device_ms", "sync_total_ms",
+        "unaccounted_pct", "wire_bytes_per_event") if k in bd}
+    probe = result.get("link_probe_pre") or {}
+    out["link_probe_pre"] = {k: probe[k] for k in (
+        "dispatch_rtt_ms_p50", "h2d_4mb_mbps_last", "host_argsort_1m_ms")
+        if k in probe}
+    gate = result.get("perf_gate") or {}
+    consistency = gate.get("self_consistency") or {}
+    out["perf_gate"] = {
+        "ok": gate.get("ok"), "compared": gate.get("compared"),
+        "self_consistency_ok": consistency.get("ok"),
+        "failed_checks": sorted(
+            name for name, c in (consistency.get("checks") or {}).items()
+            if not c.get("ok")),
+        "drift_failures": sorted({
+            name for cmp in (gate.get("vs_recorded") or {}).values()
+            for name in cmp.get("failures", [])}),
+    }
+    if detail_path:
+        out["detail"] = os.path.basename(detail_path)
+    return out
+
+
 def main() -> None:
     # The sharded aux bench needs an 8-way virtual CPU mesh alongside the
     # real accelerator; the flag only affects the cpu backend and must be
@@ -83,6 +136,7 @@ def main() -> None:
         ("persist", _t_persist),
         ("analytics", _t_analytics),
         ("sharded", _t_sharded),
+        ("sharded_bytes", _t_sharded_bytes),
         ("multitenant", _t_multitenant),
         ("query", _t_query),
     ]
@@ -95,21 +149,45 @@ def main() -> None:
     result["link_probe_pre"] = link_pre
     result["link_probe_post"] = _link_probe(jax)
 
+    root = os.path.dirname(os.path.abspath(__file__))
     from perf_gate import gate_against_recorded
-    gate = gate_against_recorded(
-        result, root=os.path.dirname(os.path.abspath(__file__)))
+    gate = gate_against_recorded(result, root=root)
     result["perf_gate"] = gate
-    print(json.dumps(result))
+
+    # The FULL result (every trial, spread, breakdown, gate comparison)
+    # goes to a sidecar file; stdout gets ONE compact line, printed LAST,
+    # under the driver's 2000-byte tail capture — BENCH_r05.json recorded
+    # `parsed: null` because the fat line outgrew the tail (VERDICT r5
+    # weak #1). Warnings go to stderr BEFORE the line so nothing trails
+    # it on interleaved capture.
+    detail_path = os.environ.get(
+        "BENCH_DETAIL_PATH", os.path.join(root, "BENCH_DETAIL.json"))
+    try:
+        with open(detail_path, "w") as fh:
+            json.dump(result, fh, indent=2)
+            fh.write("\n")
+    except OSError as exc:
+        print(f"bench: could not write detail sidecar {detail_path}: {exc}",
+              file=sys.stderr)
+        detail_path = None
     if not gate["ok"]:
         print("bench: PERF GATE FAILED — see perf_gate in the result line",
               file=sys.stderr)
-        if os.environ.get("BENCH_GATE_STRICT") == "1":
-            raise SystemExit(1)
     elif not gate["compared"] and not small:
         # fail-open is visible, never silent: no recorded round was
         # comparable (first round, metric/config change, unreadable files)
         print("bench: perf gate had no comparable recorded round — drift "
               "was NOT checked this run", file=sys.stderr)
+    sys.stderr.flush()
+    compact = _compact_result(result, detail_path)
+    line = json.dumps(compact, separators=(",", ":"))
+    assert len(line) <= MAX_RESULT_LINE_BYTES, (
+        f"result line {len(line)} bytes > {MAX_RESULT_LINE_BYTES}: trim "
+        f"_compact_result, the driver tail capture would truncate it")
+    print(line)
+    sys.stdout.flush()
+    if not gate["ok"] and os.environ.get("BENCH_GATE_STRICT") == "1":
+        raise SystemExit(1)
 
 
 # ---------------------------------------------------------------------------
@@ -255,14 +333,19 @@ def _build(jax, small: bool) -> Dict:
                   for i in range(64)]
     lat_tokens = [f"dev-{i % N_REGISTERED}" for i in range(64)]
     batcher = AdaptiveBatcher(lat_engine, linger_ms=LAT_LINGER_MS)
-    warm_fut = batcher.offer(lat_events, lat_tokens)  # compile the shape
-    for wbatch, wout in warm_fut.result(timeout=600.0):
-        jax.block_until_ready(wout.processed)
-        lat_engine.materialize_alerts(wbatch, wout)
+    # steady-state warm path: pre-jit the shape + wire variant, fill the
+    # interners, ramp the flush thread — all excluded from measurement
+    batcher.warm(lat_events, lat_tokens, repeats=3)
     ctx["lat_batcher"], ctx["lat_engine"] = batcher, lat_engine
     ctx["lat_events"], ctx["lat_tokens"] = lat_events, lat_tokens
+    # per-trial warm offers: each trial re-enters steady state before its
+    # measured window (the interleaved sections between trials evict
+    # caches and refill the tunnel's burst bucket)
+    ctx["lat_trial_warmup"] = 2
     ctx["lat_config"] = {"batch_size": LAT_BATCH,
-                         "linger_ms": LAT_LINGER_MS}
+                         "linger_ms": LAT_LINGER_MS,
+                         "warm_flushes": batcher.warm_flushes,
+                         "trial_warmup_offers": ctx["lat_trial_warmup"]}
 
     # analytics replay log (BASELINE config 4), built + warmed once
     from sitewhere_tpu.analytics.engine import WindowedAnalyticsEngine
@@ -322,11 +405,18 @@ def _t_latency(jax, ctx) -> Dict:
     burst to clear ingest -> pack -> H2D -> fused step -> materialized
     alerts, INCLUDING the adaptive batcher's linger wait — the end-to-end
     number BASELINE's p99 < 10 ms budget is about, measured through the
-    deployed path rather than device-only."""
+    deployed path rather than device-only.
+
+    Steady-state window: each trial runs `lat_trial_warmup` UNMEASURED
+    offers first (the interleaved sections between trials evict host/
+    device caches), so the recorded samples — and the per-trial p99 the
+    perf gate's latency_budget_met judges — describe the warm path only.
+    Compiles never count against the budget; they happen once per shape
+    per process, not per event (AdaptiveBatcher.warm at build)."""
     batcher, engine = ctx["lat_batcher"], ctx["lat_engine"]
     events, tokens = ctx["lat_events"], ctx["lat_tokens"]
-    samples: List[float] = []
-    for _ in range(ctx["SYNC_STEPS"] * 2):
+
+    def one_offer() -> float:
         t0 = time.perf_counter()
         fut = batcher.offer(events, tokens)
         alerts = []
@@ -334,8 +424,12 @@ def _t_latency(jax, ctx) -> Dict:
             # materialize_alerts' single batched device_get blocks on the
             # step's outputs — no separate block_until_ready round trip
             alerts.extend(engine.materialize_alerts(batch, outputs))
-        samples.append(time.perf_counter() - t0)
         assert alerts  # half the burst crosses the threshold
+        return time.perf_counter() - t0
+
+    for _ in range(ctx["lat_trial_warmup"]):
+        one_offer()  # re-enter steady state; excluded from samples
+    samples = [one_offer() for _ in range(ctx["SYNC_STEPS"] * 2)]
     return {"lat_s": samples}
 
 
@@ -573,14 +667,68 @@ def _build_sharded_engine(tensors, mesh, per_shard, zone_token):
     return eng
 
 
+def _encode_batch_wire(packer, batch) -> bytes:
+    """Re-encode a packed EventBatch as the concatenated wire frames a
+    device fleet would deliver (transport/wire.py layout) — the input of
+    the from-encoded-bytes sections. Build-time only; the timed loop
+    starts from these bytes."""
+    from sitewhere_tpu.model.event import DeviceEventType
+    from sitewhere_tpu.transport.wire import MessageType, WireCodec, encode_frame
+
+    valid = np.asarray(batch.valid)
+    device_idx = np.asarray(batch.device_idx)
+    event_type = np.asarray(batch.event_type)
+    ts = np.asarray(batch.ts)
+    mm_idx = np.asarray(batch.mm_idx)
+    value = np.asarray(batch.value)
+    lat = np.asarray(batch.lat)
+    lon = np.asarray(batch.lon)
+    elevation = np.asarray(batch.elevation)
+    alert_type_idx = np.asarray(batch.alert_type_idx)
+    alert_level = np.asarray(batch.alert_level)
+    frames: List[bytes] = []
+    for i in np.nonzero(valid)[0]:
+        token = packer.devices.token_of(int(device_idx[i])) or ""
+        ts_ms = packer.abs_ts(int(ts[i]))
+        et = int(event_type[i])
+        if et == int(DeviceEventType.MEASUREMENT):
+            name = packer.measurements.token_of(int(mm_idx[i])) or "m1"
+            frames.append(encode_frame(
+                MessageType.MEASUREMENT,
+                WireCodec.encode_measurement(token, ts_ms, name,
+                                             float(value[i]))))
+        elif et == int(DeviceEventType.LOCATION):
+            frames.append(encode_frame(
+                MessageType.LOCATION,
+                WireCodec.encode_location(token, ts_ms, float(lat[i]),
+                                          float(lon[i]),
+                                          float(elevation[i]))))
+        else:
+            atype = packer.alert_types.token_of(
+                int(alert_type_idx[i])) or "alert"
+            frames.append(encode_frame(
+                MessageType.ALERT,
+                WireCodec.encode_alert(token, ts_ms, atype,
+                                       int(alert_level[i]))))
+    return b"".join(frames)
+
+
 def _build_sharded(jax, ctx) -> None:
     """VERDICT r1 item 3: perf-number the ShardedPipelineEngine itself —
     1-chip accelerator mesh (the real-hardware rate) + an 8-way virtual CPU
     mesh (exercises routing/psum; its rate is NOT a hardware claim) +
     route_columns host cost per step. The CPU-mesh/scaling sweep runs ONCE
     at build (its slope, not its absolute, is the signal); the 1-chip rate
-    is a trial section."""
+    is a trial section.
+
+    Two 1-chip headline flavors ride as trial sections: the pre-interned
+    pipelined rate (ShardedPipelinedSubmitter staging ahead of the
+    collective step) and the FROM-ENCODED-BYTES rate (VERDICT r5 missing
+    #2) — native wire decode + vectorized interning (sources/fastlane.py)
+    composed INTO the routed path, so the sharded number starts where the
+    reference's hot path starts: at encoded payload bytes."""
     from sitewhere_tpu.parallel import make_mesh
+    from sitewhere_tpu.sources.fastlane import FastWireIngest
     from __graft_entry__ import _synthetic_batch
 
     small, BATCH = ctx["small"], ctx["BATCH"]
@@ -594,6 +742,15 @@ def _build_sharded(jax, ctx) -> None:
     jax.block_until_ready(out.processed)
     ctx["sharded_eng"], ctx["sharded_pool"] = eng1, pool
     ctx["sharded_nreg"] = n_reg
+    # encoded wire bytes of the same pool + a warm decode lane
+    ctx["sharded_bytes_pool"] = [
+        _encode_batch_wire(eng1.packer, b) for b in pool]
+    lane = FastWireIngest(eng1.packer)
+    res = lane.ingest(ctx["sharded_bytes_pool"][0])
+    for b in res.batches:
+        _, out = eng1.submit(b)
+    jax.block_until_ready(out.processed)
+    ctx["sharded_lane"] = lane
 
     aux: Dict = {}
     cpus = jax.devices("cpu")
@@ -653,9 +810,26 @@ def _build_sharded(jax, ctx) -> None:
 
 
 def _t_sharded(jax, ctx) -> Dict:
+    """Sharded 1-chip rate through the PIPELINED feeder (the deployed
+    shape since the stager extension: routing + H2D staging of batch N+1
+    overlap the collective step of batch N), plus the host routing cost
+    alone."""
+    from sitewhere_tpu.pipeline.feed import ShardedPipelinedSubmitter
+
     eng, pool = ctx["sharded_eng"], ctx["sharded_pool"]
     STEPS, BATCH = ctx["STEPS"], ctx["BATCH"]
-    rate = _measure_rate(jax, eng, pool, STEPS, BATCH)
+    sub = ShardedPipelinedSubmitter(eng, depth=3, stagers=2)
+    warm = None
+    for i in range(3):  # refill the pipeline after thread start
+        warm = sub.submit(pool[i % len(pool)])
+    sub.flush()
+    jax.block_until_ready(warm.result()[1].processed)
+    t0 = time.perf_counter()
+    futs = [sub.submit(pool[i % len(pool)]) for i in range(STEPS)]
+    sub.flush()
+    jax.block_until_ready(futs[-1].result()[1].processed)
+    rate = STEPS * BATCH / (time.perf_counter() - t0)
+    sub.close()
     # host routing cost alone (the path submit uses: fused native
     # pack+route into the pooled staging buffers when the C++ runtime is
     # available, two-pass numpy otherwise). Loaned blobs are released per
@@ -667,6 +841,26 @@ def _t_sharded(jax, ctx) -> Dict:
         eng.router.release_staging_buffer(blob)
     router_ms = (time.perf_counter() - r0) / STEPS * 1000
     return {"events_per_sec": rate, "router_ms": router_ms}
+
+
+def _t_sharded_bytes(jax, ctx) -> Dict:
+    """From-encoded-bytes sharded headline (VERDICT r5 missing #2): the
+    timed loop starts at concatenated wire frames — native single-pass
+    decode, vectorized token interning, column pack, shard route, fused
+    collective step. The whole ingest edge, not just the post-interning
+    tail."""
+    eng, lane = ctx["sharded_eng"], ctx["sharded_lane"]
+    datas = ctx["sharded_bytes_pool"]
+    STEPS = ctx["STEPS"]
+    n = 0
+    t0 = time.perf_counter()
+    for i in range(STEPS):
+        res = lane.ingest(datas[i % len(datas)])
+        for b in res.batches:
+            _, out = eng.submit(b)
+        n += res.n_events
+    jax.block_until_ready(out.processed)
+    return {"events_per_sec": n / (time.perf_counter() - t0)}
 
 
 def _build_multitenant(jax, ctx) -> None:
@@ -803,6 +997,7 @@ def _aggregate(jax, ctx, trials: Dict[str, List[Dict]],
     persist = rates("persist")
     analytics = rates("analytics")
     sharded = rates("sharded")
+    sharded_bytes = rates("sharded_bytes")
     mt = rates("multitenant")
 
     plain = sorted(x for t in trials["sync"] for x in t["plain_s"])
@@ -848,6 +1043,7 @@ def _aggregate(jax, ctx, trials: Dict[str, List[Dict]],
         "persist": _spread_pct(persist),
         "analytics": _spread_pct(analytics),
         "sharded_1chip": _spread_pct(sharded),
+        "sharded_from_bytes": _spread_pct(sharded_bytes),
         "multitenant": _spread_pct(mt),
         "sync_total": _spread_pct(plain),
         # note: latency spread is deliberately NOT in this dict — the
@@ -863,6 +1059,7 @@ def _aggregate(jax, ctx, trials: Dict[str, List[Dict]],
         "persist": [round(x, 1) for x in persist],
         "analytics": [round(x, 1) for x in analytics],
         "sharded_1chip": [round(x, 1) for x in sharded],
+        "sharded_from_bytes": [round(x, 1) for x in sharded_bytes],
         "multitenant": [round(x, 1) for x in mt],
         "sync_total_ms": [round(_median(t["plain_s"]) * 1000, 3)
                           for t in trials["sync"]],
@@ -908,6 +1105,9 @@ def _aggregate(jax, ctx, trials: Dict[str, List[Dict]],
         "persist_events_per_sec": round(_median(persist), 1),
         "analytics_replay_events_per_sec": round(_median(analytics), 1),
         "sharded_1chip_events_per_sec": round(_median(sharded), 1),
+        # from-encoded-bytes sharded headline: decode + intern + pack +
+        # route + step, timed from wire bytes (VERDICT r5 missing #2)
+        "sharded_from_bytes_events_per_sec": round(_median(sharded_bytes), 1),
         "sharded_1chip_router_ms_per_step": round(
             _median([t["router_ms"] for t in trials["sharded"]]), 3),
         **ctx["sharded_aux"],

@@ -50,6 +50,12 @@ RATIO_KEYS: List[Tuple[str, str, str]] = [
     ("sharded_vs_headline", "sharded_1chip_events_per_sec", "value"),
     ("multitenant_vs_sharded", "multitenant_sharded_events_per_sec",
      "sharded_1chip_events_per_sec"),
+    # from-encoded-bytes over pre-interned: both are the same sharded
+    # submit loop on the same engine; the quotient isolates the host
+    # decode+intern edge (absent from rounds before r06 — the drift set
+    # is the key intersection, so old comparisons are unaffected)
+    ("sharded_bytes_vs_sharded", "sharded_from_bytes_events_per_sec",
+     "sharded_1chip_events_per_sec"),
 ]
 
 # Host-CPU-only sections never touch the tunnel, and the host is the same
@@ -93,6 +99,14 @@ MAX_UNACCOUNTED_PCT = 25.0
 # BASELINE.json's end-to-end latency budget, checked against the latency
 # tier's measured p99 (offer -> linger -> pack -> H2D -> step -> alerts)
 LATENCY_BUDGET_MS = 10.0
+
+# Trial-spread bounds: full scale judges the accelerator-scale claim; the
+# BENCH_SCALE=small smoke still EVALUATES the check (bench's sections now
+# measure steady-state windows with explicit warmup exclusion, so the
+# smoke must stay bounded too) but against a wider bound — its sub-ms
+# section timings are scheduler-noise-dominated on shared CI hosts.
+MAX_SPREAD_PCT = 60.0
+MAX_SPREAD_PCT_SMALL = 150.0
 
 
 def extract_bench(doc: Dict) -> Optional[Dict]:
@@ -220,12 +234,15 @@ def self_consistency(bench: Dict) -> Dict:
             "ok": abs(unacc) <= MAX_UNACCOUNTED_PCT,
             "unaccounted_pct": unacc, "max_pct": MAX_UNACCOUNTED_PCT}
     # Budget semantics: the best TRIAL's p99 must meet the budget — one
-    # trial is a full run of back-to-back offers, so a passing trial
-    # demonstrates the system meets the budget end-to-end whenever the
-    # tunnel isn't in its degraded regime (which poisons every round trip
-    # in a trial at once, ~100 ms each; see docs/PERF.md). The pooled p99
-    # rides along in the artifact for the honest worst case.
-    trial_p99 = None if small else bench.get("latency_mode_trial_p99_ms")
+    # trial is a full run of back-to-back STEADY-STATE offers (bench's
+    # latency section excludes its per-trial warmup from the samples), so
+    # a passing trial demonstrates the system meets the budget end-to-end
+    # whenever the tunnel isn't in its degraded regime (which poisons
+    # every round trip in a trial at once, ~100 ms each; see
+    # docs/PERF.md). The pooled p99 rides along in the artifact for the
+    # honest worst case. Evaluated at EVERY scale: the cpu smoke's warm
+    # path must meet the budget too, or CI cannot vouch for the tier.
+    trial_p99 = bench.get("latency_mode_trial_p99_ms")
     if isinstance(trial_p99, list):
         numeric = [v for v in trial_p99 if isinstance(v, (int, float))]
         if numeric:
@@ -234,14 +251,16 @@ def self_consistency(bench: Dict) -> Dict:
                 "ok": best <= LATENCY_BUDGET_MS,
                 "best_trial_p99_ms": best,
                 "trial_p99_ms": trial_p99, "budget_ms": LATENCY_BUDGET_MS}
-    # sub-millisecond CPU smoke timings (BENCH_SCALE=small) are inherently
-    # noisy — the spread bound is a claim about accelerator-scale runs
-    spreads = {} if small else bench.get("spread_pct") or {}
+    # Spread judged against the steady-state windows at every scale; the
+    # BENCH_SCALE=small smoke gets the wider bound (sub-millisecond CPU
+    # section timings ride scheduler noise on shared CI hosts).
+    spreads = bench.get("spread_pct") or {}
+    bound = MAX_SPREAD_PCT_SMALL if small else MAX_SPREAD_PCT
     wild = {k: v for k, v in spreads.items()
-            if isinstance(v, (int, float)) and v > 60.0}
+            if isinstance(v, (int, float)) and v > bound}
     if spreads:
         checks["trial_spread_bounded"] = {"ok": not wild, "wild": wild,
-                                          "max_pct": 60.0}
+                                          "max_pct": bound}
     return {"ok": all(c["ok"] for c in checks.values()) if checks else True,
             "checks": checks}
 

@@ -89,12 +89,15 @@ def process_shard_indices(mesh: Mesh) -> np.ndarray:
 
 # -- window-sharded analytics -------------------------------------------------
 
-def _combine_ring(stats: WindowedStats, axis: str) -> WindowedStats:
+def _combine_ring(stats: WindowedStats, axis: str,
+                  size: Optional[int] = None) -> WindowedStats:
     """Ring all-reduce of partial stat grids via ppermute: S-1 steps, each
     passing the accumulated grid to the right neighbor. Communication
     pattern of ring attention (neighbor-only ICI hops), applied to the
-    stream-window analog."""
-    size = jax.lax.axis_size(axis)
+    stream-window analog. `size` is the static mesh axis size (callers
+    under shard_map pass it; jax.lax.axis_size only exists on jax >= 0.6)."""
+    if size is None:
+        size = jax.lax.axis_size(axis)
     perm = [(i, (i + 1) % size) for i in range(size)]
 
     def step(_, carry):
@@ -175,7 +178,10 @@ def _compiled_sharded_stats(mesh: Mesh, combine: str, num_keys: int,
     """One jitted executable per (mesh, combine, grid shape) — same static-
     shape bucketing contract as analytics.windows._compiled_stats, so
     repeated replays reuse the compiled program instead of retracing."""
-    combiner = _combine_psum if combine == "psum" else _combine_ring
+    from functools import partial as _partial
+
+    combiner = (_combine_psum if combine == "psum" else
+                _partial(_combine_ring, size=mesh.shape[SHARD_AXIS]))
 
     def shard_fn(k, t, v, m, w):
         local = _windowed_stats_impl(k[0], t[0], v[0], m[0], w,

@@ -122,6 +122,21 @@ class RoutedBlobView:
                 pass
 
 
+class _StagedStep:
+    """In-flight staged blob between stage_routed_blob and
+    dispatch_staged: the (possibly still transferring) global device
+    array, the lazy materialization view, the host blob the events meter
+    counts from, and the loaned routed blob to release after dispatch."""
+
+    __slots__ = ("blob", "view", "counted", "routed_blob")
+
+    def __init__(self, blob, view: RoutedBlobView, counted, routed_blob):
+        self.blob = blob
+        self.view = view
+        self.counted = counted
+        self.routed_blob = routed_blob
+
+
 class ShardedPipelineEngine(PipelineEngine):
     """Drop-in engine whose state/params/batches carry a leading shard axis.
 
@@ -164,6 +179,10 @@ class ShardedPipelineEngine(PipelineEngine):
         # (backpressure) instead of dropping rows
         self._overflow: Optional[EventBatch] = None
         self.max_overflow_events = per_shard_batch * self.n_shards * 4
+        # reusable flat staging for the overflow+batch merge: the requeue
+        # path used to pay 12 fresh column allocations per carrying step
+        from sitewhere_tpu.parallel.router import FlatBatchArena
+        self._merge_arena = FlatBatchArena()
         self.total_dropped = 0  # kept for the stats contract; stays 0
         self.drain_steps = 0
         # alerts fired during drain steps, delivered on the next
@@ -275,9 +294,17 @@ class ShardedPipelineEngine(PipelineEngine):
                 alerts=jax.lax.psum(out.alerts, SHARD_AXIS))
             return new_state, out
 
-        mapped = _shard_map(sharded, mesh=self.mesh,
-                            in_specs=(params_specs, state_specs, blob_specs),
-                            out_specs=(state_specs, out_specs))
+        specs = dict(mesh=self.mesh,
+                     in_specs=(params_specs, state_specs, blob_specs),
+                     out_specs=(state_specs, out_specs))
+        try:
+            # the geofence containment scan's carry is replicated only
+            # through the psum at the end of the step — a loop invariant
+            # the replication checker cannot infer statically (same
+            # workaround as parallel/distributed.py's ring combine)
+            mapped = _shard_map(sharded, check_vma=False, **specs)
+        except TypeError:  # older jax spells it check_rep
+            mapped = _shard_map(sharded, check_rep=False, **specs)
         self._sharded_step = jax.jit(mapped, donate_argnums=(1,))
 
     # -- params ---------------------------------------------------------------
@@ -323,12 +350,8 @@ class ShardedPipelineEngine(PipelineEngine):
         only, no new events) until the backlog fits. The call gets slower —
         which is the signal the caller needs — and `total_dropped` stays 0;
         `drain_steps` counts the extra steps for observability."""
-        from sitewhere_tpu.parallel.router import concat_flat_batches
-
         params = self._ensure_params()
-        if self._overflow is not None:
-            batch = concat_flat_batches([self._overflow, batch])
-            self._overflow = None
+        batch = self.merge_pending_overflow(batch)
         # Fused pack+route: one native pass from flat columns straight into
         # the routed [S, WIRE_ROWS, B] staging blob (reused ring buffer, no
         # per-step allocation) — the routed blob IS the staging format, and
@@ -347,7 +370,7 @@ class ShardedPipelineEngine(PipelineEngine):
                 # under-count the pool.
                 self.router.discard_staging_buffer(routed_blob)
             raise
-        self._overflow = self._slice_flat(batch, over_rows)
+        self.park_overflow(batch, over_rows)
         # Multi-process lockstep: every host must launch the SAME number of
         # collective programs per submit — extra drain steps on one host
         # would pair its psums with a peer's NEXT step (undefined). The
@@ -367,7 +390,7 @@ class ShardedPipelineEngine(PipelineEngine):
             self._metrics.counter("overflow.drain_steps").inc()
             routed_blob, over_rows = self.router.route_batch(backlog)
             routed_batch, outputs = self._one_step(params, routed_blob)
-            self._overflow = self._slice_flat(backlog, over_rows)
+            self.park_overflow(backlog, over_rows)
         return routed_batch, outputs
 
     @staticmethod
@@ -377,10 +400,39 @@ class ShardedPipelineEngine(PipelineEngine):
             return None
         return jax.tree_util.tree_map(lambda a: np.asarray(a)[rows], batch)
 
+    # -- overflow backlog (shared by submit and the pipelined feeder) ------
+
+    def merge_pending_overflow(self, batch: EventBatch) -> EventBatch:
+        """Fold the parked overflow backlog AHEAD of `batch` (per-device
+        order: requeued rows predate the new batch's rows) and clear it.
+        The merge is an arena concat — the returned batch is a set of
+        views into reused buffers, valid until the next merge; route it
+        immediately."""
+        if self._overflow is None:
+            return batch
+        merged = self._merge_arena.concat([self._overflow, batch])
+        self._overflow = None
+        return merged
+
+    def park_overflow(self, batch: EventBatch, over_rows: np.ndarray) -> None:
+        """Park `batch`'s capacity-overflow rows (flat indices from
+        route_batch) for the next merge. Fancy-index copies — safe even
+        when `batch` is an arena view about to be overwritten."""
+        self._overflow = self._slice_flat(batch, over_rows)
+
     def _one_step(self, params, routed_blob: np.ndarray
                   ) -> Tuple["RoutedBlobView", ProcessOutputs]:
-        from sitewhere_tpu.ops.pack import _VALID_SHIFT
+        return self.dispatch_staged(params, self.stage_routed_blob(routed_blob))
 
+    def stage_routed_blob(self, routed_blob: np.ndarray) -> "_StagedStep":
+        """Start the host->mesh transfer of a routed [S, WIRE_ROWS, B]
+        blob WITHOUT dispatching the step. device_put is async on
+        accelerator runtimes, so a pipelined feeder can overlap this
+        staging (and the routing that produced the blob) with the
+        previous step's device execution — the sharded half of
+        pipeline/feed.py's double-buffered contract. Returns a staged
+        handle for dispatch_staged; the loaned routed blob's release is
+        wired there (its H2D guard is the dispatched step's output)."""
         shard0 = NamedSharding(self.mesh, P(SHARD_AXIS))
         if self.is_multiprocess:
             # Per-host feeding (the multi-host jax data contract): this
@@ -404,15 +456,24 @@ class ShardedPipelineEngine(PipelineEngine):
             # as the transfer-completion guard
             view = RoutedBlobView(routed_blob)
             counted = routed_blob
+        return _StagedStep(blob, view, counted, routed_blob)
+
+    def dispatch_staged(self, params, staged: "_StagedStep"
+                        ) -> Tuple["RoutedBlobView", ProcessOutputs]:
+        """Dispatch the fused collective step on a staged blob (state
+        donation preserved — the jitted program is unchanged)."""
+        from sitewhere_tpu.ops.pack import _VALID_SHIFT
+
+        view = staged.view
         with self._metrics.timer("step").time():
             with self._state_lock:  # vs concurrent readers (base __init__)
                 self._state, outputs = self._sharded_step(
-                    params, self._state, blob)
+                    params, self._state, staged.blob)
         if not self.is_multiprocess:
             # pooled-blob loan: returns on view GC; outputs.processed is
             # the transfer-completion guard (step executed => input read)
             view._release = partial(self.router.release_staging_buffer,
-                                    routed_blob, outputs.processed)
+                                    staged.routed_blob, outputs.processed)
         self.batches_processed += 1
         # rows actually stepped BY THIS PROCESS this call: overflow rows
         # are counted by the step that eventually carries them, so each
@@ -421,7 +482,7 @@ class ShardedPipelineEngine(PipelineEngine):
         # actually needs it (most steps don't), which was ~25% of sharded
         # submit host time.
         self._metrics.meter("events").mark(int(
-            ((counted[..., 0, :] >> _VALID_SHIFT) & 1).sum()))
+            ((staged.counted[..., 0, :] >> _VALID_SHIFT) & 1).sum()))
         return view, outputs
 
     def _stash_foreign(self, routed_blob: np.ndarray) -> None:

@@ -55,6 +55,61 @@ def concat_flat_batches(batches: List[EventBatch]) -> EventBatch:
     return EventBatch(valid=np.ones(n, bool), **cols)
 
 
+class FlatBatchArena:
+    """Reusable flat-column staging for the overflow-requeue merge.
+
+    The sharded submit path used to pay `concat_flat_batches` — 12 fresh
+    per-column allocations — on EVERY step that carried a requeued
+    overflow tail. This arena keeps one set of flat column buffers
+    (grown geometrically, never shrunk) and writes the merged valid rows
+    into them in place; `concat` returns an EventBatch of views into the
+    arena, valid until the next `concat` on the same arena. Callers that
+    need rows to outlive the next merge must copy them out (fancy-index
+    slices of the views already do)."""
+
+    def __init__(self):
+        self._cols: Optional[Dict[str, np.ndarray]] = None
+        self._ones: Optional[np.ndarray] = None
+        self._cap = 0
+
+    def _ensure(self, n: int) -> None:
+        if n <= self._cap:
+            return
+        cap = max(n, 2 * self._cap, 1024)
+        self._cols = {name: np.empty(cap, np.int32) for name in _I32_COLS}
+        self._cols.update(
+            {name: np.empty(cap, np.float32) for name in _F32_COLS})
+        self._ones = np.ones(cap, bool)
+        self._cap = cap
+
+    def concat(self, batches: List[EventBatch]) -> EventBatch:
+        """Valid rows of `batches`, in order, as views into the arena."""
+        keeps = []
+        n = 0
+        for b in batches:
+            valid = np.asarray(b.valid)
+            rows = None if valid.all() else np.nonzero(valid)[0]
+            k = valid.shape[0] if rows is None else len(rows)
+            keeps.append((rows, k))
+            n += k
+        self._ensure(n)
+        for name in _I32_COLS + _F32_COLS:
+            dst = self._cols[name]
+            pos = 0
+            for b, (rows, k) in zip(batches, keeps):
+                col = np.asarray(getattr(b, name))
+                if rows is None:
+                    dst[pos:pos + k] = col
+                elif col.dtype == dst.dtype:
+                    np.take(col, rows, out=dst[pos:pos + k])
+                else:  # odd caller-supplied dtype: cast through a gather
+                    dst[pos:pos + k] = col[rows]
+                pos += k
+        return EventBatch(
+            valid=self._ones[:n],
+            **{name: self._cols[name][:n] for name in _I32_COLS + _F32_COLS})
+
+
 class ShardRouter:
     def __init__(self, n_shards: int, per_shard_batch: int,
                  staging_ring: int = 0):
@@ -89,6 +144,17 @@ class ShardRouter:
         self._pool_lock = None
         # multi-host lockstep pins the wire variant (see route_batch)
         self.fixed_wire_rows: Optional[int] = None
+        # Column-routing arenas (route_columns): a ring of 2 preallocated
+        # [S, B] column sets reused across steps, plus flat gather
+        # scratch. A fresh 12-column zero allocation per step was most of
+        # the column router's time at production shapes (mmap-backed ->
+        # page faults); the ring of 2 keeps the PREVIOUS returned batch
+        # intact while the next one routes (callers that hold a routed
+        # batch across 2+ route_columns calls must copy it out).
+        self._col_arenas: Optional[List[Dict[str, np.ndarray]]] = None
+        self._col_arena_pos = 0
+        self._scratch_i: Optional[np.ndarray] = None
+        self._scratch_f: Optional[np.ndarray] = None
 
     def _buf_rows(self, buf: np.ndarray) -> Optional[int]:
         if (buf.ndim == 3 and buf.shape[0] == self.n_shards
@@ -274,69 +340,153 @@ class ShardRouter:
         # routed head (zero on 4/5-row blobs), re-embed per shard after
         packed = wire_rows == WIRE_ROWS_PACKED
         base = int(_extract_ts_base_np(head)) if packed else 0
-        rows = np.nonzero((head & (1 << _VALID_SHIFT)) != 0)[0]
-        dev = head[rows] & (WIRE_DEV_MAX - 1)
-        shard = dev % S
-        order = np.argsort(shard, kind="stable")
-        srows = rows[order]
-        sshard = shard[order]
-        counts = np.bincount(sshard, minlength=S)
-        starts = np.concatenate([[0], np.cumsum(counts)[:-1]])
-        pos = np.arange(len(srows), dtype=np.int64) - starts[sshard]
-        keep = pos < B
-        out = np.zeros((S, wire_rows, B), np.int32)
-        ks, kp, krows = sshard[keep], pos[keep], srows[keep]
-        kdev = head[krows] & (WIRE_DEV_MAX - 1)
+        valid = (head & (1 << _VALID_SHIFT)) != 0
+        rows = None if valid.all() else np.nonzero(valid)[0]
+        dev = (head if rows is None else head[rows]) & (WIRE_DEV_MAX - 1)
+        ksorted, kept, over_rows = self._shard_sort(dev, rows)
+        kstarts = np.zeros(S + 1, np.int64)
+        np.cumsum(kept, out=kstarts[1:])
+        # pooled staging buffer when enabled (the loaned-blob contract of
+        # route_batch); tails past each shard's kept count are zeroed by
+        # the per-shard placement, so no pre-zeroing is needed
+        out = self._staging_buffer(wire_rows)
+        if out is None:
+            out = np.empty((S, wire_rows, B), np.int32)
+        ghead = head[ksorted]
+        gdev = ghead & (WIRE_DEV_MAX - 1)
         spare_clear = np.int32((1 << _BASE_SHIFT) - 1)
-        out[ks, 0, kp] = (head[krows] & ~np.int32(WIRE_DEV_MAX - 1)
-                          & spare_clear) | (kdev // S)
+        ghead = (ghead & ~np.int32(WIRE_DEV_MAX - 1)
+                 & spare_clear) | (gdev // S)
+        self._place_sorted(out[:, 0, :], ghead, kept, kstarts)
         for r in range(1, wire_rows):
-            out[ks, r, kp] = blob[r, krows]
+            self._place_sorted(out[:, r, :], blob[r][ksorted], kept, kstarts)
         if packed:
             _embed_ts_base(out[:, 0, :], base)
-        return out, np.sort(srows[~keep])  # arrival order, like the native
+        return out, over_rows  # overflow in arrival order, like the native
+
+    # -- shared shard-bucketing core (route_blob fallback + route_columns) --
+
+    def _shard_sort(self, dev: np.ndarray, rows: Optional[np.ndarray]
+                    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Stable shard bucketing of the valid flat rows.
+
+        `dev` holds the (global) device index of each valid row; `rows`
+        maps them back to flat batch positions (None = all rows valid, in
+        place). Returns (ksorted, kept, over_rows): `ksorted` indexes the
+        flat batch in shard-major arrival order truncated to per-shard
+        capacity, `kept[s]` is the row count shard s keeps, `over_rows`
+        are the flat indices of capacity overflow in arrival order.
+
+        The stable argsort runs on the narrowest dtype the shard count
+        fits (a uint8 radix sort is ~5x faster than int64 at 64k rows),
+        and the no-overflow fast path skips the per-row position
+        arithmetic entirely — the common production case."""
+        S, B = self.n_shards, self.per_shard_batch
+        shard = dev % S
+        if S <= (1 << 8):
+            shard = shard.astype(np.uint8)
+        elif S <= (1 << 16):
+            shard = shard.astype(np.uint16)
+        order = np.argsort(shard, kind="stable")
+        counts = np.bincount(shard, minlength=S).astype(np.int64)
+        kept = np.minimum(counts, B)
+        base = order if rows is None else rows[order]
+        if int(counts.max(initial=0)) <= B:
+            return base, kept, np.empty(0, np.int64)
+        starts = np.zeros(S + 1, np.int64)
+        np.cumsum(counts, out=starts[1:])
+        pos = (np.arange(len(order), dtype=np.int64)
+               - np.repeat(starts[:-1], counts))
+        keep = pos < B
+        return base[keep], kept, np.sort(base[~keep])
+
+    @staticmethod
+    def _place_sorted(dst: np.ndarray, gathered: np.ndarray,
+                      kept: np.ndarray, kstarts: np.ndarray) -> None:
+        """Fill [S, B] `dst` from shard-major-sorted `gathered` rows: one
+        contiguous copy per shard plus a zeroed tail — replaces the fancy
+        2-D scatter (and the full pre-zeroing) of the old router."""
+        for s in range(dst.shape[0]):
+            c = kept[s]
+            row = dst[s]
+            row[:c] = gathered[kstarts[s]:kstarts[s] + c]
+            row[c:] = 0
+
+    def _next_column_arena(self) -> Dict[str, np.ndarray]:
+        if self._col_arenas is None:
+            S, B = self.n_shards, self.per_shard_batch
+
+            def alloc():
+                cols = {name: np.empty((S, B), np.int32)
+                        for name in _I32_COLS}
+                cols.update({name: np.empty((S, B), np.float32)
+                             for name in _F32_COLS})
+                cols["valid"] = np.empty((S, B), bool)
+                return cols
+
+            self._col_arenas = [alloc(), alloc()]
+        arena = self._col_arenas[self._col_arena_pos]
+        self._col_arena_pos = (self._col_arena_pos + 1) % len(self._col_arenas)
+        return arena
+
+    def _gather_scratch(self, n: int) -> Tuple[np.ndarray, np.ndarray]:
+        if self._scratch_i is None or self._scratch_i.shape[0] < n:
+            cap = max(n, 4096)
+            self._scratch_i = np.empty(cap, np.int32)
+            self._scratch_f = np.empty(cap, np.float32)
+        return self._scratch_i, self._scratch_f
 
     def route_columns(self, batch: EventBatch) -> RoutedBatches:
         """Scatter a flat host batch into per-shard sub-batches with local
-        device indices — fully vectorized (no per-event Python on the ingest
-        path). A stable argsort by shard preserves arrival order per device.
-        Rows beyond a shard's fixed capacity come back as `overflow` (flat,
-        global indices) for the caller to requeue; fixed shapes are
+        device indices — one stable bucketing pass, then one contiguous
+        per-shard copy per column into a REUSED arena (no per-step
+        per-column allocations; see _next_column_arena — the returned
+        batch stays intact until the second-next route_columns on this
+        router; copy it out to hold it longer). Arrival order per device
+        is preserved. Rows beyond a shard's fixed capacity come back as
+        `overflow` (flat, global indices, arrival order — matching the
+        blob router) for the caller to requeue; fixed shapes are
         non-negotiable under jit."""
         S, B = self.n_shards, self.per_shard_batch
         valid = np.asarray(batch.valid)
-        rows = np.nonzero(valid)[0]
-        dev = np.asarray(batch.device_idx)[rows]
-        shard = dev % S
-        local = dev // S
+        if valid.all():
+            rows = None
+            dev = np.asarray(batch.device_idx)
+        else:
+            rows = np.nonzero(valid)[0]
+            dev = np.asarray(batch.device_idx)[rows]
+        ksorted, kept, over_rows = self._shard_sort(dev, rows)
+        k = len(ksorted)
+        kstarts = np.zeros(S + 1, np.int64)
+        np.cumsum(kept, out=kstarts[1:])
+        arena = self._next_column_arena()
+        scratch_i, scratch_f = self._gather_scratch(k)
 
-        order = np.argsort(shard, kind="stable")
-        srows = rows[order]
-        sshard = shard[order]
-        counts = np.bincount(sshard, minlength=S)
-        starts = np.concatenate([[0], np.cumsum(counts)[:-1]])
-        pos = np.arange(len(srows), dtype=np.int64) - starts[sshard]
-        keep = pos < B
-        ks = sshard[keep]
-        kp = pos[keep]
-        krows = srows[keep]
+        def gathered(name: str, scratch: np.ndarray) -> np.ndarray:
+            col = np.asarray(getattr(batch, name))
+            if col.dtype == scratch.dtype:
+                return np.take(col, ksorted, out=scratch[:k])
+            return col[ksorted]  # odd caller-supplied dtype: plain gather
 
-        out_cols: Dict[str, np.ndarray] = {}
-        for name in _I32_COLS:
-            out_cols[name] = np.zeros((S, B), np.int32)
+        gdev = gathered("device_idx", scratch_i)
+        np.floor_divide(gdev, S, out=gdev)          # global -> local rows
+        self._place_sorted(arena["device_idx"], gdev, kept, kstarts)
+        for name in _I32_COLS[1:]:
+            self._place_sorted(arena[name], gathered(name, scratch_i),
+                               kept, kstarts)
         for name in _F32_COLS:
-            out_cols[name] = np.zeros((S, B), np.float32)
-        out_valid = np.zeros((S, B), bool)
-        out_valid[ks, kp] = True
-        out_cols["device_idx"][ks, kp] = local[order][keep]
-        for name in _I32_COLS[1:] + _F32_COLS:
-            out_cols[name][ks, kp] = np.asarray(getattr(batch, name))[krows]
-        routed = EventBatch(valid=out_valid, **out_cols)
+            self._place_sorted(arena[name], gathered(name, scratch_f),
+                               kept, kstarts)
+        out_valid = arena["valid"]
+        for s in range(S):
+            out_valid[s, :kept[s]] = True
+            out_valid[s, kept[s]:] = False
+        routed = EventBatch(**arena)
 
         overflow = None
-        if not keep.all():
-            orows = srows[~keep]
-            ocols = {name: np.asarray(getattr(batch, name))[orows]
+        if len(over_rows):
+            ocols = {name: np.asarray(getattr(batch, name))[over_rows]
                      for name in _I32_COLS + _F32_COLS}
-            overflow = EventBatch(valid=np.ones(len(orows), bool), **ocols)
+            overflow = EventBatch(valid=np.ones(len(over_rows), bool),
+                                  **ocols)
         return RoutedBatches(batch=routed, overflow=overflow)

@@ -166,7 +166,12 @@ class WideRowEventStore:
             # its segments across stop/start the same way)
 
     def flush(self) -> None:
+        # shutdown ordering: lifecycle teardown may flush components in
+        # any order — a flush after stop() is a no-op, not an
+        # AttributeError (same for the other post-stop guards below)
         with self._lock:
+            if self._conn is None:
+                return
             self._conn.commit()
 
     def flush_tenant(self, tenant: str) -> None:
@@ -227,6 +232,8 @@ class WideRowEventStore:
                 json.dumps(doc),
             ))
         with self._lock:
+            if self._conn is None:
+                return  # stopped: late append no-ops (shutdown ordering)
             self._conn.executemany(_INSERT_SQL, rows)
             self._conn.commit()
 
@@ -301,6 +308,8 @@ class WideRowEventStore:
                 None, None, 0, None, None,
             ))
         with self._lock:
+            if self._conn is None:
+                return 0  # stopped: late append no-ops (shutdown ordering)
             self._conn.executemany(_INSERT_SQL, rows)
             self._conn.commit()
         return n
@@ -342,6 +351,8 @@ class WideRowEventStore:
                  if order_by == "sequence_asc"
                  else "event_date DESC, seq DESC")
         with self._lock:
+            if self._conn is None:
+                return SearchResults(results=[], num_results=0)
             total = self._conn.execute(
                 f"SELECT COUNT(*) FROM events WHERE {where}",
                 params).fetchone()[0]
@@ -359,9 +370,9 @@ class WideRowEventStore:
         where, params = self._where(tenant, flt)
         cols = ", ".join(names)
         with self._lock:
-            rows = self._conn.execute(
+            rows = ([] if self._conn is None else self._conn.execute(
                 f"SELECT {cols} FROM events WHERE {where}",
-                params).fetchall()
+                params).fetchall())
 
         def column(i: int, name: str) -> np.ndarray:
             vals = [r[i] for r in rows]
@@ -377,6 +388,8 @@ class WideRowEventStore:
 
     def count(self, tenant: str) -> int:
         with self._lock:
+            if self._conn is None:
+                return 0
             return self._conn.execute(
                 "SELECT COUNT(*) FROM events WHERE tenant = ?",
                 (tenant,)).fetchone()[0]
@@ -385,6 +398,8 @@ class WideRowEventStore:
     def buckets(self, tenant: str) -> List[Tuple[int, int]]:
         """(bucket, rows) pairs, oldest first."""
         with self._lock:
+            if self._conn is None:
+                return []
             return list(self._conn.execute(
                 "SELECT bucket, COUNT(*) FROM events WHERE tenant = ? "
                 "GROUP BY bucket ORDER BY bucket", (tenant,)))
@@ -395,6 +410,8 @@ class WideRowEventStore:
         never row-by-row scans)."""
         cutoff_bucket = int(before_ms) // self.bucket_ms
         with self._lock:
+            if self._conn is None:
+                return 0
             cur = self._conn.execute(
                 "DELETE FROM events WHERE tenant = ? AND bucket < ?",
                 (tenant, cutoff_bucket))

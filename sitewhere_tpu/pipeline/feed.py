@@ -250,6 +250,225 @@ class PipelinedSubmitter:
                 fut._resolve(error=RuntimeError("submitter closed"))
 
 
+class ShardedPipelinedSubmitter:
+    """Stage-ahead feeder for the ShardedPipelineEngine.
+
+    The sharded submit() serializes route -> device_put -> dispatch on
+    the caller thread; under a tunneled runtime the H2D staging alone can
+    dwarf the device step, leaving the mesh idle between submits. This
+    feeder applies the same double-buffered discipline PipelinedSubmitter
+    gives the single-chip engine, adapted to the sharded path's extra
+    invariant — ROUTING IS STATEFUL (it consumes and produces the
+    engine's overflow backlog, and per-device order requires requeued
+    rows to ride the next routed batch):
+
+      stagers:   take batch N; ROUTE it in strict submission order (a
+                 routing turnstile — vectorized routing is the cheap
+                 part, see parallel/router.py); then start the mesh
+                 transfer (engine.stage_routed_blob, async device_put)
+                 concurrently with other stagers' routing/transfers
+      step thread: dispatch staged steps in submission order (state
+                 donation serializes device execution anyway)
+
+    Backpressure parity with submit(): when the backlog exceeds
+    `engine.max_overflow_events` at routing time, drain blobs (backlog
+    only, no new rows) are staged as extra steps under the same routing
+    turn; their alerts stash on the engine's pending-alert buffer exactly
+    like submit()'s internal drain.
+
+    Single-controller only: a multi-host cluster feeds through
+    parallel/cluster.py's lockstep loop (drain steps here would desync
+    the collective count across hosts).
+
+    `submit(batch)` returns a StepFuture resolving to (routed view,
+    outputs) — the same pair engine.submit returns.
+    """
+
+    def __init__(self, engine, depth: int = 3, stagers: int = 2):
+        if engine.is_multiprocess:
+            raise RuntimeError(
+                "ShardedPipelinedSubmitter is single-controller only; "
+                "multi-host clusters feed through the lockstep step loop "
+                "(parallel/cluster.py)")
+        self.engine = engine
+        self.depth = max(1, depth)
+        self._in: "queue.Queue" = queue.Queue(maxsize=self.depth)
+        self._ready_lock = threading.Condition()
+        self._ready: List = []      # heap of (seq, staged_list, fut, exc)
+        self._next_seq = 0
+        self._next_route = 0        # routing turnstile position
+        self._next_step = 0
+        self._dispatched = 0
+        self._stop = threading.Event()
+        self._close_lock = threading.Lock()
+        self._stagers = [
+            threading.Thread(target=self._stage_loop,
+                             name=f"shard-feed-stage-{i}", daemon=True)
+            for i in range(max(1, stagers))]
+        self._step_thread = threading.Thread(target=self._step_loop,
+                                             name="shard-feed-step",
+                                             daemon=True)
+        for t in self._stagers:
+            t.start()
+        self._step_thread.start()
+
+    # -- producer ----------------------------------------------------------
+    def submit(self, batch: EventBatch) -> StepFuture:
+        fut = StepFuture()
+        item = (self._alloc_seq(), batch, fut)
+        while True:
+            with self._close_lock:
+                if self._stop.is_set():
+                    raise RuntimeError("submitter closed")
+                try:
+                    self._in.put_nowait(item)
+                    return fut
+                except queue.Full:
+                    pass
+            time.sleep(0.005)
+
+    def _alloc_seq(self) -> int:
+        with self._ready_lock:
+            seq = self._next_seq
+            self._next_seq += 1
+            return seq
+
+    # -- stager ------------------------------------------------------------
+    def _stage_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                seq, batch, fut = self._in.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            # bound the staged-ahead window (see PipelinedSubmitter)
+            with self._ready_lock:
+                while (not self._stop.is_set()
+                       and seq - self._next_step > self.depth):
+                    self._ready_lock.wait(timeout=0.1)
+            # routing turnstile: strict submission order — routing folds
+            # in (and re-parks) the engine overflow backlog, so two
+            # batches must never route concurrently or out of order
+            with self._ready_lock:
+                while (not self._stop.is_set()
+                       and self._next_route != seq):
+                    self._ready_lock.wait(timeout=0.1)
+            if self._stop.is_set():
+                fut._resolve(error=RuntimeError("submitter closed"))
+                continue
+            eng = self.engine
+            staged = None
+            exc: Optional[BaseException] = None
+            try:
+                try:
+                    merged = eng.merge_pending_overflow(batch)
+                    blob, over = eng.router.route_batch(merged)
+                    eng.park_overflow(merged, over)
+                    blobs = [blob]
+                    # backpressure: route drain blobs (backlog only) as
+                    # extra steps under the same turn, like submit()
+                    while eng.pending_overflow > eng.max_overflow_events:
+                        backlog = eng.pending_overflow_batch()
+                        eng.set_pending_overflow_batch(None)
+                        dblob, dover = eng.router.route_batch(backlog)
+                        eng.park_overflow(backlog, dover)
+                        blobs.append(dblob)
+                finally:
+                    with self._ready_lock:
+                        self._next_route += 1
+                        self._ready_lock.notify_all()
+                # mesh transfers start here, OUTSIDE the turnstile: they
+                # overlap other stagers' routing and the device compute
+                staged = [eng.stage_routed_blob(b) for b in blobs]
+            except BaseException as stage_exc:
+                exc = stage_exc
+            with self._ready_lock:
+                heapq.heappush(self._ready, (seq, staged, fut, exc))
+                self._ready_lock.notify_all()
+
+    # -- step dispatcher ---------------------------------------------------
+    def _step_loop(self) -> None:
+        from collections import deque
+
+        executing: deque = deque()
+        while not self._stop.is_set():
+            with self._ready_lock:
+                while not (self._ready
+                           and self._ready[0][0] == self._next_step):
+                    if self._stop.is_set():
+                        return
+                    self._ready_lock.wait(timeout=0.1)
+                seq, staged, fut, exc = heapq.heappop(self._ready)
+                self._next_step += 1
+            result = None
+            try:
+                if exc is None:
+                    eng = self.engine
+                    params = eng._ensure_params()
+                    for s in staged[:-1]:
+                        # drained steps' alerts stash exactly like
+                        # submit()'s internal drain (the caller only
+                        # sees the LAST step's outputs)
+                        view, outputs = eng.dispatch_staged(params, s)
+                        eng._stash_pending_alerts(
+                            eng._materialize_routed(view, outputs))
+                        eng.drain_steps += 1
+                    result = eng.dispatch_staged(params, staged[-1])
+            except BaseException as step_exc:
+                exc = step_exc
+            finally:
+                with self._ready_lock:
+                    self._dispatched += 1
+                    self._ready_lock.notify_all()
+            if result is None:
+                fut._resolve(error=exc)
+                continue
+            fut._resolve(result)
+            # bound the device-side queue to `depth` in-flight steps
+            executing.append(result[1].processed)
+            if len(executing) > self.depth:
+                try:
+                    executing.popleft().block_until_ready()
+                except Exception:
+                    pass  # a failed earlier step already surfaced there
+
+    # -- draining ----------------------------------------------------------
+    def flush(self, timeout: Optional[float] = 60.0) -> None:
+        """Wait until every submitted batch's dispatch has RETURNED (a
+        direct engine.submit() afterwards cannot overtake)."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._ready_lock:
+            target = self._next_seq
+            while self._dispatched < target:
+                remaining = (None if deadline is None
+                             else deadline - time.monotonic())
+                if remaining is not None and remaining <= 0:
+                    raise TimeoutError("pipelined flush timed out")
+                self._ready_lock.wait(timeout=0.05 if remaining is None
+                                      else min(0.05, remaining))
+
+    def close(self) -> None:
+        with self._close_lock:
+            self._stop.set()
+        with self._ready_lock:
+            self._ready_lock.notify_all()
+        for t in self._stagers:
+            t.join(timeout=5.0)
+        self._step_thread.join(timeout=5.0)
+        leftovers = []
+        while True:
+            try:
+                leftovers.append(self._in.get_nowait())
+            except queue.Empty:
+                break
+        with self._ready_lock:
+            while self._ready:
+                leftovers.append(heapq.heappop(self._ready))
+        for item in leftovers:
+            fut = item[2]
+            if not fut.done():
+                fut._resolve(error=RuntimeError("submitter closed"))
+
+
 class AdaptiveBatcher:
     """Latency-tier submitter: flush on fill OR linger deadline.
 
@@ -286,9 +505,42 @@ class AdaptiveBatcher:
         self._futures: List[StepFuture] = []
         self._oldest: Optional[float] = None
         self._stop = threading.Event()
+        # steady-state accounting: flushes counts every engine flush this
+        # batcher ran; warm() moves the cold-path work (jit compiles,
+        # interner fills, thread ramp-up) BEFORE measurement and records
+        # how many flushes were warmup, so a latency harness can report
+        # percentiles over the steady-state window only
+        self.flushes = 0
+        self.warm_flushes = 0
         self._thread = threading.Thread(target=self._flush_loop,
                                         name="feed-latency", daemon=True)
         self._thread.start()
+
+    @property
+    def steady_flushes(self) -> int:
+        """Flushes run after the last warm() — the steady-state window."""
+        return max(0, self.flushes - self.warm_flushes)
+
+    def warm(self, events, tokens, repeats: int = 2,
+             timeout: float = 600.0) -> int:
+        """Bring the latency tier to steady state for this traffic shape:
+        run `repeats` full offer -> linger -> pack -> step -> materialized
+        alerts cycles and mark them as warmup. The first cycle pays the
+        jit compile of the engine's program for this batch shape and wire
+        variant plus the interner fills; p99 percentiles measured AFTER
+        warm() describe the steady-state path BASELINE's latency budget
+        is about (a compile must never count against a 10 ms budget — it
+        happens once per shape per process, not per event)."""
+        import jax
+
+        for _ in range(max(1, repeats)):
+            fut = self.offer(events, tokens)
+            for batch, outputs in fut.result(timeout=timeout):
+                jax.block_until_ready(outputs.processed)
+                self.engine.materialize_alerts(batch, outputs)
+        with self._lock:
+            self.warm_flushes = self.flushes
+        return self.warm_flushes
 
     def offer(self, events, tokens) -> StepFuture:
         """Buffer events (parallel `tokens` list, one per event); the
@@ -338,6 +590,8 @@ class AdaptiveBatcher:
             results = [self.engine.submit_routed(batch)
                        for batch in self.engine.packer.pack_events(events,
                                                                    tokens)]
+            with self._lock:
+                self.flushes += 1
             for fut in futures:
                 fut._resolve(results)
         except BaseException as exc:

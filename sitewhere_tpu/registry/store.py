@@ -472,6 +472,11 @@ class DeviceManagement:
             "alarm", DeviceAlarm, E.INVALID_DEVICE_TOKEN)
         self._listeners: List[Callable[[str, Any], None]] = []
         self._mutation_listeners: List[Callable[[str, str, Any], None]] = []
+        # serializes composite-mapping create/delete: the validate + two-
+        # update sequence must not interleave across threads (two
+        # concurrent creates could both pass the unmapped/unparented
+        # checks and double-map a child or a slot path)
+        self._mapping_lock = threading.Lock()
         # device_id -> active assignment (the hot lookup of
         # InboundPayloadProcessingLogic.validateAssignment:179)
         self._active_assignment: Dict[str, DeviceAssignment] = {}
@@ -727,9 +732,22 @@ class DeviceManagement:
         the child must exist and be unparented, the path must resolve to a
         DeviceSlot in the parent TYPE's element schema, and the path must
         be unmapped. Sets the child's parent backreference; both updates
-        ride the normal mutation feed (replicated, durable)."""
+        ride the normal mutation feed (replicated, durable).
+
+        The whole validate + two-update sequence runs under the registry
+        mapping mutex (two concurrent creates must not both pass the
+        unmapped checks), and a failure of the parent-list update rolls
+        the child's parent backreference back — no half-applied mapping
+        survives."""
         from sitewhere_tpu.model.device import find_device_slot
 
+        with self._mapping_lock:
+            return self._create_device_element_mapping_locked(
+                device_token, mapping, find_device_slot)
+
+    def _create_device_element_mapping_locked(self, device_token: str,
+                                              mapping, find_device_slot
+                                              ) -> Device:
         device = self.devices.require_by_token(device_token)
         mapped = self.devices.get_by_token(mapping.device_token)
         if mapped is None:
@@ -771,26 +789,40 @@ class DeviceManagement:
                 http_status=409)
         # parent backreference first (the reference's order, :688-694)
         self.update_device(mapped.token, {"parent_device_id": device.id})
-        return self.update_device(device_token, {
-            "device_element_mappings": existing + [mapping]})
+        try:
+            return self.update_device(device_token, {
+                "device_element_mappings": existing + [mapping]})
+        except BaseException:
+            # second update failed (listener raise, replicated-tombstone
+            # race, ...): un-parent the child so the failed mapping
+            # leaves no dangling backreference
+            try:
+                self.update_device(mapped.token, {"parent_device_id": ""})
+            except Exception:
+                pass  # child row vanished mid-rollback: nothing dangles
+            raise
 
     def delete_device_element_mapping(self, device_token: str,
                                       path: str) -> Device:
         """Remove the mapping at `path` and clear the child's parent
-        backreference (deviceElementMappingDeleteLogic:709)."""
-        device = self.devices.require_by_token(device_token)
-        match = next((m for m in device.device_element_mappings
-                      if m.device_element_schema_path == path), None)
-        if match is None:
-            raise NotFoundError(
-                f"no device mapping at path '{path}'", ErrorCode.GENERIC)
-        mapped = self.devices.get_by_token(match.device_token)
-        if mapped is not None and mapped.parent_device_id == device.id:
-            self.update_device(mapped.token, {"parent_device_id": ""})
-        remaining = [m for m in device.device_element_mappings
-                     if m.device_element_schema_path != path]
-        return self.update_device(device_token, {
-            "device_element_mappings": remaining})
+        backreference (deviceElementMappingDeleteLogic:709). Serialized
+        under the same mapping mutex as create — a delete interleaving
+        with a concurrent create's validate window could otherwise free a
+        slot both see as mapped/unmapped at once."""
+        with self._mapping_lock:
+            device = self.devices.require_by_token(device_token)
+            match = next((m for m in device.device_element_mappings
+                          if m.device_element_schema_path == path), None)
+            if match is None:
+                raise NotFoundError(
+                    f"no device mapping at path '{path}'", ErrorCode.GENERIC)
+            mapped = self.devices.get_by_token(match.device_token)
+            if mapped is not None and mapped.parent_device_id == device.id:
+                self.update_device(mapped.token, {"parent_device_id": ""})
+            remaining = [m for m in device.device_element_mappings
+                         if m.device_element_schema_path != path]
+            return self.update_device(device_token, {
+                "device_element_mappings": remaining})
 
     # -- assignments -----------------------------------------------------------
 

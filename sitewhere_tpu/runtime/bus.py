@@ -355,6 +355,12 @@ class ConsumerGroup:
             self.committed = (self.committed + [0] * n)[:n]
         self.position = list(self.committed)
         self._lock = threading.Lock()
+        # records retention truncated AWAY FROM THIS GROUP before it
+        # polled them (position < partition base): poll() counts them
+        # here instead of silently clamping — a lagging consumer can see
+        # exactly how many records it lost, per partition
+        self.retention_skipped = 0
+        self.retention_skipped_by_partition: Dict[int, int] = {}
 
     def poll(self, max_records: int = 4096, timeout_s: float = 0.0,
              partitions: Optional[List[int]] = None,
@@ -377,6 +383,20 @@ class ConsumerGroup:
                 if budget <= 0:
                     break
                 part = self.topic.partitions[idx]
+                base = part.start_offset()
+                if self.position[idx] < base:
+                    # retention truncated records this group never saw:
+                    # surface the skip instead of silently reading from
+                    # the new base. Committed advances with the clamp —
+                    # the records are gone, a later seek_to_committed
+                    # must not re-count (or appear to re-deliver) them.
+                    lost = base - self.position[idx]
+                    self.retention_skipped += lost
+                    self.retention_skipped_by_partition[idx] = (
+                        self.retention_skipped_by_partition.get(idx, 0)
+                        + lost)
+                    self.position[idx] = base
+                    self.committed[idx] = max(self.committed[idx], base)
                 rows = part.read(self.position[idx], budget)
                 if until is not None:
                     rows = [r for r in rows if r[0] < until[idx]]

@@ -91,6 +91,7 @@ async def read_frame(reader: asyncio.StreamReader
             break
     command = stripped.decode("utf-8")
     headers: Dict[str, str] = {}
+    header_lines = 0
     while True:
         line = await reader.readline()
         if not line:
@@ -98,11 +99,16 @@ async def read_frame(reader: asyncio.StreamReader
         stripped = line.rstrip(b"\r\n")
         if not stripped:
             break
+        # bound RAW header lines, not the deduplicated dict size: a
+        # stream repeating one header forever would otherwise never trip
+        # the cap (setdefault keeps len(headers) at 1) and spin this loop
+        # unbounded
+        header_lines += 1
+        if header_lines > MAX_HEADERS:
+            raise StompProtocolError("too many headers")
         key, sep, value = stripped.decode("utf-8").partition(":")
         if not sep:
             raise StompProtocolError(f"malformed header line {line!r}")
-        if len(headers) >= MAX_HEADERS:
-            raise StompProtocolError("too many headers")
         # STOMP 1.2: repeated headers keep the FIRST occurrence
         headers.setdefault(_unescape(key), _unescape(value))
     length = headers.get("content-length")

@@ -132,6 +132,34 @@ def test_retention_truncate():
     assert values == [6, 7, 8, 9]
 
 
+def test_retention_truncation_is_surfaced_not_silent():
+    """A group whose position fell behind a retention truncation must see
+    HOW MANY records it lost (per-group counter), not a silent clamp to
+    the new base offset."""
+    bus = EventBus(partitions=1)
+    topic = bus.topic("t")
+    for i in range(10):
+        topic.publish(b"k", str(i).encode())
+    consumer = bus.consumer("t", "g")
+    # the group consumed (and committed) the first 2 records only
+    got = [int(r.value) for r in consumer.poll(max_records=2)]
+    assert got == [0, 1]
+    consumer.commit()
+    topic.partitions[0].truncate_before(6)
+    values = [int(r.value) for r in consumer.poll()]
+    assert values == [6, 7, 8, 9]
+    assert consumer.retention_skipped == 4           # records 2..5
+    assert consumer.retention_skipped_by_partition == {0: 4}
+    # committed advanced with the clamp: a seek_to_committed replay must
+    # neither re-count the loss nor pretend the records are pending
+    consumer.seek_to_committed()  # committed was bumped to the base (6)
+    again = [int(r.value) for r in consumer.poll()]
+    assert again == [6, 7, 8, 9]
+    assert consumer.retention_skipped == 4  # not re-counted
+    consumer.commit()
+    assert consumer.lag() == 0
+
+
 def test_poison_batch_parks_on_dead_letter_topic():
     """VERDICT r1 weak #6: a deterministically-failing batch must stop
     redelivering after the retry budget and park on the dead-letter topic

@@ -113,6 +113,127 @@ class TestPipelinedSubmitter:
         sub.close()
 
 
+def _sharded_engine(tensors, per_shard=24, n_shards=4, **kw):
+    from sitewhere_tpu.parallel import ShardedPipelineEngine, make_mesh
+
+    eng = ShardedPipelineEngine(tensors, mesh=make_mesh(n_shards),
+                                per_shard_batch=per_shard, **kw)
+    eng.start()
+    eng.add_threshold_rule(ThresholdRule(
+        token="r", measurement_name="m", operator=">", threshold=100.0))
+    return eng
+
+
+class TestShardedPipelinedSubmitter:
+    """The sharded stage-ahead feeder must be step-equivalent to
+    sequential submit() — same outputs, same final state, per-device
+    order preserved — even with concurrent stagers and overflow requeue
+    (routing is turnstiled in submission order)."""
+
+    def test_matches_sequential_submit(self):
+        from sitewhere_tpu.pipeline.feed import ShardedPipelinedSubmitter
+
+        _, t1 = _world()
+        _, t2 = _world()
+        ref = _sharded_engine(t1)
+        eng = _sharded_engine(t2)
+        batches = _batches(ref, 12)
+
+        ref_outs = [ref.submit(b)[1] for b in batches]
+        sub = ShardedPipelinedSubmitter(eng, depth=3, stagers=2)
+        futs = [sub.submit(b) for b in batches]
+        sub.flush()
+        outs = [f.result()[1] for f in futs]
+        sub.close()
+
+        for got, want in zip(outs, ref_outs):
+            assert int(got.processed) == int(want.processed)
+            assert int(got.alerts) == int(want.alerts)
+        ref_state = ref.canonical_state()
+        got_state = eng.canonical_state()
+        import dataclasses
+        for f in dataclasses.fields(ref_state):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(ref_state, f.name)),
+                np.asarray(getattr(got_state, f.name)), err_msg=f.name)
+
+    def test_overflow_requeue_order_under_concurrent_stagers(self):
+        """Skewed batches overflow a shard every step; the requeued rows
+        must ride the NEXT routed batch in arrival order, so last-value
+        state matches the sequential engine exactly."""
+        from sitewhere_tpu.pipeline.feed import ShardedPipelinedSubmitter
+
+        _, t1 = _world()
+        _, t2 = _world()
+        # per-shard capacity 8 < the 16 rows/batch all hitting one shard
+        ref = _sharded_engine(t1, per_shard=8)
+        eng = _sharded_engine(t2, per_shard=8)
+        # every event for ONE device -> one shard; values strictly
+        # increasing across batches so last-value exposes any reordering
+        batches = []
+        for k in range(10):
+            events = [DeviceMeasurement(name="m", value=float(k * 100 + i),
+                                        event_date=1000 + k * 50 + i)
+                      for i in range(16)]
+            batches.append(ref.packer.pack_events(events, ["d5"] * 16)[0])
+        for b in batches:
+            ref.submit(b)
+        while ref.pending_overflow:
+            from sitewhere_tpu.ops.pack import empty_batch
+            ref.submit(empty_batch(4))
+
+        sub = ShardedPipelinedSubmitter(eng, depth=4, stagers=3)
+        last = None
+        for b in batches:
+            last = sub.submit(b)
+        sub.flush()
+        last.result(timeout=60)
+        sub.close()
+        from sitewhere_tpu.ops.pack import empty_batch
+        while eng.pending_overflow:
+            eng.submit(empty_batch(4))
+        assert (eng.get_device_state("d5").last_measurements["m"][1]
+                == ref.get_device_state("d5").last_measurements["m"][1]
+                == 915.0)  # batch k=9, row i=15: the true last value
+
+    def test_drain_backpressure_no_loss(self):
+        """Backlog past max_overflow_events triggers drain steps inside
+        the feeder (parity with submit()); every event still lands."""
+        from sitewhere_tpu.ops.pack import empty_batch
+        from sitewhere_tpu.pipeline.feed import ShardedPipelinedSubmitter
+
+        _, tensors = _world()
+        eng = _sharded_engine(tensors, per_shard=4)
+        eng.max_overflow_events = 16  # force drains early
+        n_batches, rows = 6, 16
+        batches = []
+        for k in range(n_batches):
+            events = [DeviceMeasurement(name="m", value=float(k * 100 + i),
+                                        event_date=1000 + k * 50 + i)
+                      for i in range(rows)]
+            batches.append(eng.packer.pack_events(events, ["d1"] * rows)[0])
+        sub = ShardedPipelinedSubmitter(eng, depth=3, stagers=2)
+        futs = [sub.submit(b) for b in batches]
+        sub.flush()
+        futs[-1].result(timeout=60)
+        sub.close()
+        assert eng.drain_steps > 0
+        assert eng.total_dropped == 0
+        while eng.pending_overflow:
+            eng.submit(empty_batch(4))
+        assert eng.get_device_state("d1").last_measurements["m"][1] == 515.0
+
+    def test_multiprocess_refused(self, monkeypatch):
+        from sitewhere_tpu.pipeline.feed import ShardedPipelinedSubmitter
+
+        _, tensors = _world()
+        eng = _sharded_engine(tensors)
+        monkeypatch.setattr(type(eng), "is_multiprocess", property(
+            lambda self: True))
+        with pytest.raises(RuntimeError, match="single-controller"):
+            ShardedPipelinedSubmitter(eng)
+
+
 def _semantically_equal(a, b):
     """Routed-blob equality modulo unfilled payload lanes (never read)."""
     if not np.array_equal(a[:, 0, :], b[:, 0, :]):

@@ -171,10 +171,17 @@ def test_gate_compared_flag_reflects_real_comparisons(tmp_path):
     assert gate["ok"] and not gate["compared"]
 
 
-def test_small_scale_spread_and_breakdown_not_judged():
+def test_small_scale_spread_judged_against_wider_bound():
     noisy = _bench(spreads={"sync_total": 110.0}, unaccounted_pct=40.0)
+    # the small smoke's steady-state windows are still judged, but
+    # against the wider scheduler-noise bound (150% vs 60%)
     noisy["scale"] = "small"
-    assert self_consistency(noisy)["ok"]
+    out = self_consistency(noisy)
+    assert out["ok"]
+    assert out["checks"]["trial_spread_bounded"]["max_pct"] == 150.0
+    wild = _bench(spreads={"sync_total": 180.0})
+    wild["scale"] = "small"
+    assert not self_consistency(wild)["ok"]
     noisy["scale"] = "full"
     out = self_consistency(noisy)
     assert not out["ok"]
@@ -193,9 +200,15 @@ def test_latency_budget_check():
     out = self_consistency(bad)
     assert not out["ok"]
     assert out["checks"]["latency_budget_met"]["best_trial_p99_ms"] == 12.5
-    # CPU smoke latencies are not the claim
+    # the budget is judged at EVERY scale since the steady-state window:
+    # the CPU smoke's warm path must meet it too, or CI cannot vouch for
+    # the latency tier
     bad["scale"] = "small"
-    assert self_consistency(bad)["ok"]
+    assert not self_consistency(bad)["ok"]
+    small_ok = _bench()
+    small_ok["latency_mode_trial_p99_ms"] = [112.4, 4.2, 97.0]
+    small_ok["scale"] = "small"
+    assert self_consistency(small_ok)["ok"]
 
 
 def test_cli_exit_codes(tmp_path, capsys):

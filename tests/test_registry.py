@@ -440,6 +440,72 @@ class TestDeviceElementMappings:
         with pytest.raises(SiteWhereError):
             dm.delete_device_element_mapping("gw", "bus/slot1")
 
+    def test_failed_parent_update_rolls_back_child(self, monkeypatch):
+        """The two-update sequence is atomic to observers: if the parent
+        mapping-list update raises, the child's parent backreference must
+        roll back — no dangling half-mapping (ADVICE r5)."""
+        from sitewhere_tpu.model.device import DeviceElementMapping
+
+        dm = self._world()
+        real_update = dm.update_device
+
+        def failing_update(token, updates):
+            if "device_element_mappings" in updates:
+                raise RuntimeError("injected parent-update failure")
+            return real_update(token, updates)
+
+        monkeypatch.setattr(dm, "update_device", failing_update)
+        with pytest.raises(RuntimeError, match="injected"):
+            dm.create_device_element_mapping(
+                "gw", DeviceElementMapping(
+                    device_element_schema_path="bus/slot1",
+                    device_token="c1"))
+        monkeypatch.undo()
+        assert dm.get_device_by_token("c1").parent_device_id == ""
+        assert dm.get_device_by_token("gw").device_element_mappings == []
+        # the slot is genuinely free: a retry succeeds cleanly
+        dm.create_device_element_mapping(
+            "gw", DeviceElementMapping(
+                device_element_schema_path="bus/slot1", device_token="c1"))
+        assert dm.get_device_by_token("c1").parent_device_id \
+            == dm.get_device_by_token("gw").id
+
+    def test_concurrent_creates_serialize_under_mutex(self):
+        """Two threads racing distinct children into the SAME slot path:
+        exactly one mapping wins, the loser's child stays unparented."""
+        import threading
+
+        from sitewhere_tpu.model.device import DeviceElementMapping
+
+        dm = self._world()
+        barrier = threading.Barrier(2)
+        errors = []
+
+        def attempt(token):
+            barrier.wait()
+            try:
+                dm.create_device_element_mapping(
+                    "gw", DeviceElementMapping(
+                        device_element_schema_path="bus/slot1",
+                        device_token=token))
+            except SiteWhereError as exc:
+                errors.append((token, exc))
+
+        threads = [threading.Thread(target=attempt, args=(t,))
+                   for t in ("c1", "c2")]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
+        assert len(errors) == 1  # exactly one loser
+        mappings = dm.get_device_by_token("gw").device_element_mappings
+        assert len(mappings) == 1
+        winner = mappings[0].device_token
+        loser = "c2" if winner == "c1" else "c1"
+        gw_id = dm.get_device_by_token("gw").id
+        assert dm.get_device_by_token(winner).parent_device_id == gw_id
+        assert dm.get_device_by_token(loser).parent_device_id == ""
+
     def test_update_coerces_schema_dict(self):
         """A REST-shaped update (plain dicts) must store typed schema
         objects, not raw dicts — mapping validation runs against the

@@ -1,0 +1,241 @@
+"""Arena-based vectorized shard routing (parallel/router.py rewrite):
+
+1. Routing equivalence — the arena router's output must be IDENTICAL to
+   the pre-arena per-column reference implementation (kept verbatim
+   below) on routed columns and overflow content, across valid-mask
+   shapes, shard counts, and overflow pressure.
+2. Allocation discipline — repeated route_columns calls reuse the same
+   arena buffers (ring of 2), and the previous call's batch stays intact
+   until the ring cycles.
+3. Host-cost floor — the CPU micro-bench: the rewrite must be >= 3x
+   faster than the reference per step at the acceptance shape
+   (n=65536 flat rows, S=8).
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from sitewhere_tpu.ops.pack import EventBatch
+from sitewhere_tpu.parallel.router import (
+    _F32_COLS, _I32_COLS, FlatBatchArena, RoutedBatches, ShardRouter,
+    concat_flat_batches)
+
+
+def _reference_route_columns(router: ShardRouter,
+                             batch: EventBatch) -> RoutedBatches:
+    """The pre-arena route_columns, verbatim: fresh per-column zero
+    allocations + stable argsort + 2-D fancy scatter. The differential
+    oracle and the micro-bench baseline."""
+    S, B = router.n_shards, router.per_shard_batch
+    valid = np.asarray(batch.valid)
+    rows = np.nonzero(valid)[0]
+    dev = np.asarray(batch.device_idx)[rows]
+    shard = dev % S
+    local = dev // S
+
+    order = np.argsort(shard, kind="stable")
+    srows = rows[order]
+    sshard = shard[order]
+    counts = np.bincount(sshard, minlength=S)
+    starts = np.concatenate([[0], np.cumsum(counts)[:-1]])
+    pos = np.arange(len(srows), dtype=np.int64) - starts[sshard]
+    keep = pos < B
+    ks = sshard[keep]
+    kp = pos[keep]
+    krows = srows[keep]
+
+    out_cols = {}
+    for name in _I32_COLS:
+        out_cols[name] = np.zeros((S, B), np.int32)
+    for name in _F32_COLS:
+        out_cols[name] = np.zeros((S, B), np.float32)
+    out_valid = np.zeros((S, B), bool)
+    out_valid[ks, kp] = True
+    out_cols["device_idx"][ks, kp] = local[order][keep]
+    for name in _I32_COLS[1:] + _F32_COLS:
+        out_cols[name][ks, kp] = np.asarray(getattr(batch, name))[krows]
+    routed = EventBatch(valid=out_valid, **out_cols)
+
+    overflow = None
+    if not keep.all():
+        orows = srows[~keep]
+        ocols = {name: np.asarray(getattr(batch, name))[orows]
+                 for name in _I32_COLS + _F32_COLS}
+        overflow = EventBatch(valid=np.ones(len(orows), bool), **ocols)
+    return RoutedBatches(batch=routed, overflow=overflow)
+
+
+def _random_batch(rng, n, n_dev, p_valid=0.9):
+    et = rng.integers(0, 3, n).astype(np.int32)
+    return EventBatch(
+        device_idx=rng.integers(0, n_dev, n).astype(np.int32),
+        tenant_idx=rng.integers(0, 4, n).astype(np.int32),
+        event_type=et,
+        ts=rng.integers(0, 100_000, n).astype(np.int32),
+        mm_idx=rng.integers(0, 8, n).astype(np.int32),
+        value=rng.uniform(-50, 50, n).astype(np.float32),
+        lat=rng.uniform(-90, 90, n).astype(np.float32),
+        lon=rng.uniform(-180, 180, n).astype(np.float32),
+        elevation=rng.uniform(0, 100, n).astype(np.float32),
+        alert_type_idx=rng.integers(0, 8, n).astype(np.int32),
+        alert_level=rng.integers(0, 5, n).astype(np.int32),
+        valid=rng.random(n) < p_valid)
+
+
+class TestRouteColumnsEquivalence:
+    @pytest.mark.parametrize("n,n_dev,S,B,p_valid,seed", [
+        (500, 37, 4, 32, 0.9, 3),       # light overflow
+        (500, 37, 4, 256, 0.9, 4),      # no overflow
+        (300, 5, 8, 8, 0.95, 5),        # heavy overflow, skewed devices
+        (64, 200, 2, 64, 1.0, 6),       # all valid
+        (64, 200, 2, 64, 0.0, 7),       # none valid
+        (1, 1, 1, 1, 1.0, 8),           # degenerate single row
+        (4096, 1000, 8, 512, 0.7, 9),   # production-shaped slice
+    ])
+    def test_matches_reference(self, n, n_dev, S, B, p_valid, seed):
+        rng = np.random.default_rng(seed)
+        batch = _random_batch(rng, n, n_dev, p_valid)
+        router = ShardRouter(S, B)
+        got = router.route_columns(batch)
+        want = _reference_route_columns(ShardRouter(S, B), batch)
+        for name in _I32_COLS + _F32_COLS + ("valid",):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(got.batch, name)),
+                np.asarray(getattr(want.batch, name)), err_msg=name)
+        # overflow: same EVENTS; the arena router returns them in arrival
+        # order (matching the blob router), the reference shard-major —
+        # per-device relative order is preserved by both (stable sorts)
+        assert got.overflow_count == want.overflow_count
+        if want.overflow is not None:
+            got_rows = sorted(zip(got.overflow.device_idx.tolist(),
+                                  got.overflow.ts.tolist(),
+                                  got.overflow.value.tolist()))
+            want_rows = sorted(zip(want.overflow.device_idx.tolist(),
+                                   want.overflow.ts.tolist(),
+                                   want.overflow.value.tolist()))
+            assert got_rows == want_rows
+            # arrival order == sorted flat-row order: ts was generated
+            # per-row, so per-device ts subsequences must stay in the
+            # order the flat batch carried them
+            for dev in set(got.overflow.device_idx.tolist()):
+                sel = got.overflow.device_idx == dev
+                flat_sel = (np.asarray(batch.device_idx) == dev) \
+                    & np.asarray(batch.valid)
+                flat_ts = np.asarray(batch.ts)[flat_sel].tolist()
+                got_ts = got.overflow.ts[sel].tolist()
+                # overflow rows are a suffix-per-shard subset; their
+                # relative order must embed in the flat arrival order
+                it = iter(flat_ts)
+                assert all(t in it for t in got_ts)
+
+    def test_overflow_requeue_order_is_arrival_order(self):
+        """The engine requeues overflow ahead of the next batch; arrival
+        order of the overflow batch is what preserves per-device order
+        across the requeue boundary."""
+        router = ShardRouter(n_shards=2, per_shard_batch=1)
+        n = 6
+        batch = EventBatch(
+            device_idx=np.array([2, 5, 2, 5, 2, 5], np.int32),  # shards 0/1
+            tenant_idx=np.zeros(n, np.int32),
+            event_type=np.zeros(n, np.int32),
+            ts=np.arange(n, dtype=np.int32),
+            mm_idx=np.zeros(n, np.int32),
+            value=np.arange(n, dtype=np.float32),
+            lat=np.zeros(n, np.float32), lon=np.zeros(n, np.float32),
+            elevation=np.zeros(n, np.float32),
+            alert_type_idx=np.zeros(n, np.int32),
+            alert_level=np.zeros(n, np.int32),
+            valid=np.ones(n, bool))
+        routed = router.route_columns(batch)
+        assert routed.overflow_count == 4
+        # rows 2..5 overflowed (rows 0 and 1 took the two capacities);
+        # arrival order keeps the cross-shard interleaving 2,5,2,5 intact
+        assert routed.overflow.ts.tolist() == [2, 3, 4, 5]
+        assert routed.overflow.device_idx.tolist() == [2, 5, 2, 5]
+
+    def test_arena_ring_keeps_previous_batch_intact(self):
+        rng = np.random.default_rng(11)
+        router = ShardRouter(4, 64)
+        b1 = _random_batch(rng, 200, 50)
+        b2 = _random_batch(rng, 200, 50)
+        r1 = router.route_columns(b1)
+        snapshot = np.asarray(r1.batch.value).copy()
+        r2 = router.route_columns(b2)  # ring slot 2: r1 must be intact
+        np.testing.assert_array_equal(np.asarray(r1.batch.value), snapshot)
+        # third call cycles the ring back onto r1's arena
+        assert np.asarray(router.route_columns(b1).batch.valid) is not None
+
+    def test_arena_buffers_reused_across_steps(self):
+        """No per-step per-column allocations: the SAME arrays come back
+        every other call (ring of 2)."""
+        rng = np.random.default_rng(12)
+        router = ShardRouter(4, 64)
+        batches = [_random_batch(rng, 200, 50) for _ in range(4)]
+        ids = [id(np.asarray(router.route_columns(b).batch.device_idx))
+               for b in batches]
+        assert ids[0] == ids[2] and ids[1] == ids[3]
+        assert ids[0] != ids[1]
+
+
+class TestRouterMicroBench:
+    def test_3x_faster_than_reference_at_acceptance_shape(self):
+        """Acceptance: >= 3x reduction in host routing time per step vs
+        the pre-arena implementation at n=65536 flat rows, S=8 (B=8192
+        per shard). Medians of repeated runs on both sides; the 4.4x
+        measured margin absorbs CI scheduler noise."""
+        rng = np.random.default_rng(0)
+        n, S, B = 65536, 8, 8192
+        batch = _random_batch(rng, n, 1_000_000, p_valid=1.0)
+        new_router = ShardRouter(S, B)
+        ref_router = ShardRouter(S, B)
+
+        def timed(fn, reps=7):
+            fn()  # warm (allocates arenas / faults pages)
+            samples = []
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                fn()
+                samples.append(time.perf_counter() - t0)
+            return float(np.median(samples))
+
+        new_t = timed(lambda: new_router.route_columns(batch))
+        ref_t = timed(lambda: _reference_route_columns(ref_router, batch))
+        speedup = ref_t / new_t
+        assert speedup >= 3.0, (
+            f"route_columns speedup {speedup:.2f}x < 3x "
+            f"(ref {ref_t * 1e3:.2f} ms, arena {new_t * 1e3:.2f} ms)")
+
+
+class TestFlatBatchArena:
+    def test_matches_concat_flat_batches(self):
+        rng = np.random.default_rng(21)
+        arena = FlatBatchArena()
+        for trial in range(3):
+            parts = [_random_batch(rng, rng.integers(1, 300), 40,
+                                   p_valid=float(rng.random()))
+                     for _ in range(3)]
+            got = arena.concat(parts)
+            want = concat_flat_batches(parts)
+            for name in _I32_COLS + _F32_COLS + ("valid",):
+                np.testing.assert_array_equal(
+                    np.asarray(getattr(got, name)),
+                    np.asarray(getattr(want, name)), err_msg=name)
+
+    def test_buffers_reused_and_views_invalidated_on_next_concat(self):
+        rng = np.random.default_rng(22)
+        arena = FlatBatchArena()
+        a = arena.concat([_random_batch(rng, 100, 20, p_valid=1.0)])
+        base_a = np.asarray(a.device_idx).base
+        b = arena.concat([_random_batch(rng, 80, 20, p_valid=1.0)])
+        # same backing buffer: the merge is in-place, not a fresh concat
+        assert np.asarray(b.device_idx).base is base_a
+
+    def test_grows_for_larger_merges(self):
+        rng = np.random.default_rng(23)
+        arena = FlatBatchArena()
+        small = arena.concat([_random_batch(rng, 10, 5, p_valid=1.0)])
+        assert small.device_idx.shape[0] == 10
+        big = arena.concat([_random_batch(rng, 5000, 5, p_valid=1.0)])
+        assert big.device_idx.shape[0] == 5000

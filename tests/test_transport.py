@@ -99,6 +99,44 @@ class TestTopicMatching:
         assert not topic_matches("a/b/c", "a/b")
 
 
+class TestStompHeaderCap:
+    def test_duplicate_headers_trip_the_cap(self):
+        """MAX_HEADERS bounds RAW header lines, not the deduplicated dict
+        size: a stream repeating one header forever kept len(headers) at
+        1 (setdefault) and never tripped the cap."""
+        from sitewhere_tpu.transport.stomp import (
+            MAX_HEADERS, StompProtocolError, read_frame)
+
+        wire = (b"SEND\n"
+                + b"dup:v\n" * (MAX_HEADERS + 1)
+                + b"\n\x00")
+
+        async def parse():
+            reader = asyncio.StreamReader()
+            reader.feed_data(wire)
+            reader.feed_eof()
+            return await read_frame(reader)
+
+        with pytest.raises(StompProtocolError, match="too many headers"):
+            run(parse())
+
+    def test_distinct_headers_at_cap_still_parse(self):
+        from sitewhere_tpu.transport.stomp import MAX_HEADERS, read_frame
+
+        wire = (b"SEND\n"
+                + b"".join(b"h%d:v\n" % i for i in range(MAX_HEADERS))
+                + b"\n\x00")
+
+        async def parse():
+            reader = asyncio.StreamReader()
+            reader.feed_data(wire)
+            reader.feed_eof()
+            return await read_frame(reader)
+
+        command, headers, _ = run(parse())
+        assert command == "SEND" and len(headers) == MAX_HEADERS
+
+
 class TestMqtt:
     def test_pub_sub_qos0_and_qos1(self):
         async def scenario():

@@ -345,3 +345,47 @@ class TestDatastoreWiring:
         assert res.results[0].value == 7.0
         alerts = mgmt.list_alerts(EventIndex.ASSIGNMENT, "a1")
         assert alerts.num_results == 1
+
+
+class TestShutdownOrderingGuards:
+    """Lifecycle teardown may flush/query components in any order: calls
+    landing AFTER stop() closed the file-backed connection must no-op
+    (or return empty) instead of raising AttributeError."""
+
+    def _stopped_store(self, tmp_path):
+        store = WideRowEventStore(db_path=str(tmp_path / "events.db"))
+        store.append_events("acme", [DeviceMeasurement(
+            name="m", value=1.0, event_date=1000)])
+        store.stop()
+        return store
+
+    def test_late_calls_noop_after_stop(self, tmp_path):
+        store = self._stopped_store(tmp_path)
+        store.flush()                       # no-op, no raise
+        store.flush_tenant("acme")
+        assert store.count("acme") == 0
+        res = store.query("acme", EventFilter())
+        assert res.num_results == 0 and res.results == []
+        cols = store.query_columns("acme", EventFilter(), ["event_date"])
+        assert len(cols["event_date"]) == 0
+        assert store.buckets("acme") == []
+        assert store.prune("acme", before_ms=10 ** 15) == 0
+        store.append_events("acme", [DeviceMeasurement(
+            name="m", value=2.0, event_date=2000)])  # dropped, no raise
+
+    def test_late_batch_append_noops(self, tmp_path):
+        from sitewhere_tpu.ops.pack import EventPacker
+        from sitewhere_tpu.registry.interning import TokenInterner
+
+        store = self._stopped_store(tmp_path)
+        packer = EventPacker(8, TokenInterner(8), epoch_base_ms=0)
+        batch = packer.pack_events(
+            [DeviceMeasurement(name="m", value=3.0, event_date=1)], ["d"])[0]
+        assert store.append_batch("acme", batch, packer) == 0
+
+    def test_start_reopens_and_data_survives(self, tmp_path):
+        store = self._stopped_store(tmp_path)
+        store.start()
+        assert store.count("acme") == 1  # the pre-stop append persisted
+        res = store.query("acme", EventFilter())
+        assert res.results[0].value == 1.0
