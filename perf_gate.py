@@ -8,10 +8,11 @@ resource (telemetry/headline, sharded/headline, multitenant/sharded — all
 tunnel-transfer-bound, so the link state cancels), and ABSOLUTES for
 host-CPU-only sections that never touch the tunnel (persist, router cost,
 narrow-window query). Ratio drift past tolerance is a hard failure.
-Absolute drift hard-fails only between runs whose host-CPU fingerprints
-(`link_probe_pre.host_argsort_1m_ms`) are comparable — VM CPU steal moves
-host absolutes 4x on unchanged code (docs/PERF.md) — and is otherwise
-reported as advisory with the reason in the verdict.
+Absolute drift hard-fails only between runs on the SAME hardware
+(`link_probe_pre.host_cpu_model`/`host_cpu_cores` identity) whose
+host-CPU timing fingerprints (`host_argsort_1m_ms`) are also comparable —
+VM CPU steal moves host absolutes 4x on unchanged code (docs/PERF.md) —
+and is otherwise reported as advisory with the reason in the verdict.
 
 One anomalous round must not poison the gate forever, so a current run
 passes if its ratios are within tolerance of EITHER of the two most recent
@@ -190,12 +191,31 @@ def compare(prev_bench: Dict, cur_bench: Dict, tol: float = DEFAULT_TOL,
         v = probe.get("host_argsort_1m_ms")
         return v if isinstance(v, (int, float)) and v > 0 else None
 
+    def host_identity(bench: Dict):
+        """(cpu model, core count) hardware identity, None when the run
+        predates the fingerprint. Unlike the argsort timing (CPU-steal
+        sensitive), this is stable — two runs with DIFFERENT identities
+        are different machines and can never hard-fail each other's
+        host-CPU absolutes."""
+        probe = bench.get("link_probe_pre") or {}
+        model, cores = probe.get("host_cpu_model"), probe.get(
+            "host_cpu_cores")
+        if not model or not isinstance(cores, int) or cores <= 0:
+            return None
+        return (str(model), cores)
+
     prev_fp, cur_fp = host_fp(prev_bench), host_fp(cur_bench)
+    prev_id, cur_id = host_identity(prev_bench), host_identity(cur_bench)
     if prev_fp is None or cur_fp is None:
         host_comparable = False
         host_note = ("no host fingerprint in "
                      + ("baseline" if prev_fp is None else "current")
                      + " run; host-absolute drift is advisory")
+    elif prev_id is not None and cur_id is not None and prev_id != cur_id:
+        host_comparable = False
+        host_note = (f"different host hardware ({prev_id[0]!r} x{prev_id[1]}"
+                     f" -> {cur_id[0]!r} x{cur_id[1]}); host-absolute "
+                     f"drift is advisory")
     else:
         factor = cur_fp / prev_fp
         host_comparable = (1.0 / HOST_STATE_RATIO_BOUND <= factor

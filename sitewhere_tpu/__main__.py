@@ -234,6 +234,12 @@ def cmd_serve(args) -> int:
     coordinator = cfg.get("cluster.coordinator")
     if coordinator:
         return _serve_cluster(cfg)
+    if cfg.get("cluster.peers") and cfg.get("cluster.process_id") is not None:
+        # peers without a coordinator: control-plane-only cluster — N
+        # independent single-host instances whose registries + tenant/
+        # user provisioning converge over busnet (no jax.distributed
+        # gang; parallel/cluster.py ControlPlaneCluster)
+        return _serve_control_plane(cfg)
 
     # graceful-shutdown handlers BEFORE the (slow) boot: a SIGTERM that
     # lands mid-boot or in the window right after the serving banner must
@@ -278,6 +284,62 @@ def cmd_serve(args) -> int:
             bus_server.stop()
         rest.stop()
         instance.stop()
+        if telemetry is not None:
+            telemetry.stop()
+    return 0
+
+
+def _serve_control_plane(cfg) -> int:
+    """Boot one host of a control-plane-replicated deployment: a plain
+    single-host instance (own local pipeline) plus the busnet edge and
+    the replication stack — registry gossip, tenant/user provisioning
+    with reactive engine lifecycle, script replication, heartbeats.
+    REST mutations on any host converge everywhere without restarts; a
+    killed host restarts alone (wrap with --supervise) and rebuilds its
+    tenant set from checkpoint + durable stores, not templates."""
+    from sitewhere_tpu.parallel.cluster import ControlPlaneCluster
+    from sitewhere_tpu.web.server import RestServer
+
+    stop = _install_stop_handlers()
+    process_id = int(cfg.get("cluster.process_id"))
+    num_processes = int(cfg.get("cluster.num_processes") or 0) or \
+        (len(_parse_peers(cfg.get("cluster.peers"))) or 1)
+    instance = _build_instance(cfg)
+    peers = _parse_peers(cfg.get("cluster.peers"))
+    edge_port = cfg.get("bus.edge_port")
+    cluster = ControlPlaneCluster(
+        instance, process_id, num_processes,
+        peer_bus_addrs=peers,
+        bus_host=cfg.get("api.host"),
+        bus_port=int(edge_port) if edge_port is not None else 0,
+        heartbeat_s=float(cfg.get("cluster.heartbeat_s")),
+        stale_after_s=float(cfg.get("cluster.stale_after_s")))
+    cluster.start()
+    _apply_rule_config(instance, cfg)
+    _apply_search_config(instance, cfg)
+    from sitewhere_tpu.runtime.telemetry import build_from_config
+    telemetry = build_from_config(cfg, instance.instance_id)
+    if telemetry is not None:
+        telemetry.start()
+    rest = RestServer(instance, host=cfg.get("api.host"),
+                      port=int(cfg.get("api.port")),
+                      token_expiration_minutes=int(
+                          cfg.get("api.jwt_expiration_min")))
+    rest.start()
+
+    print(f"sitewhere-tpu control-plane host {process_id}/{num_processes} "
+          f"instance '{instance.instance_id}' serving", flush=True)
+    print(f"  REST gateway : {rest.base_url}", flush=True)
+    print(f"  bus edge     : tcp://{cfg.get('api.host')}:"
+          f"{cluster.bus_port}", flush=True)
+
+    _install_stop_handlers(stop)
+    try:
+        while not stop.wait(1.0):
+            pass
+    finally:
+        rest.stop()
+        cluster.stop()
         if telemetry is not None:
             telemetry.stop()
     return 0

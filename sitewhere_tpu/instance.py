@@ -134,6 +134,11 @@ class SiteWhereInstance(LifecycleComponent):
         self.tenant_management = TenantManagement(
             self._make_store("tenants"), bus=self.bus, naming=self.naming)
         self.token_management = TokenManagement()
+        # user mutations (local REST or cluster-replicated applies —
+        # multitenant/replication.py) invalidate cached JWT auth state:
+        # an update drops the claims cache, a delete revokes every token
+        # the user already holds
+        self.user_management.add_mutation_listener(self._on_user_mutation)
         self.bootstrap = InstanceBootstrap(
             self.user_management, self.tenant_management,
             admin_username=admin_username, admin_password=admin_password)
@@ -200,6 +205,14 @@ class SiteWhereInstance(LifecycleComponent):
         self.add_nested(self.label_generators)
 
     # -- wiring ------------------------------------------------------------
+    def _on_user_mutation(self, kind: str, op: str, entity) -> None:
+        if kind != "user" or op == "create":
+            return
+        username = getattr(entity, "username", "") or getattr(
+            entity, "token", "")
+        self.token_management.invalidate_user(username,
+                                              revoke=(op == "delete"))
+
     def _make_store(self, kind: str):
         if self.data_dir is None:
             return None

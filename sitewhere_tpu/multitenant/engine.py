@@ -88,11 +88,18 @@ class TenantEngine(LifecycleComponent):
                                  tenant.token).attach(self.event_management)
 
         # pipeline services (cluster hooks route foreign-owned records to
-        # their owner host and feed the lockstep step loop — cluster.py)
+        # their owner host and feed the lockstep step loop — cluster.py).
+        # A control-plane-only cluster (data_plane=False: registry +
+        # provisioning replicate, but each host runs its own engine and
+        # owns every device locally) does not participate in ownership
+        # routing, so inbound keeps the direct single-host submit path.
+        inbound_cluster = (cluster if cluster is not None
+                           and getattr(cluster, "data_plane", True)
+                           else None)
         self.inbound = InboundProcessingService(
             bus, self.registry, events=self.event_management,
             engine=pipeline_engine, tenant=tenant.token, naming=self.naming,
-            cluster=cluster, batcher=batcher)
+            cluster=inbound_cluster, batcher=batcher)
         self.enrichment = PayloadEnrichment(bus, self.registry, tenant.token,
                                             self.naming)
         self.command_delivery = CommandDeliveryService(
@@ -247,6 +254,16 @@ class TenantEngineManager(LifecycleComponent):
         if engine is not None:
             engine.stop()
 
+    def retire_engine(self, tenant_token: str) -> None:
+        """Stop the engine for a DELETED tenant without leaving the
+        admin-stop flag behind: an admin stop must survive stale async
+        model-update records, but a deletion must not block a future
+        tenant that legitimately reuses the token (tombstone resurrection
+        semantics, multitenant/replication.py)."""
+        self.stop_engine(tenant_token)
+        with self._lock:
+            self._stopped.discard(tenant_token)
+
     def restart_engine(self, tenant_token: str) -> Optional[TenantEngine]:
         self.stop_engine(tenant_token)
         return self.start_engine(tenant_token, force=True)
@@ -263,6 +280,6 @@ class TenantEngineManager(LifecycleComponent):
             if operation == "create":
                 self.start_engine(token)
             elif operation == "delete":
-                self.stop_engine(token)
+                self.retire_engine(token)
             elif operation == "update":
                 self.restart_engine(token)

@@ -127,8 +127,14 @@ class InstanceBootstrap:
                                  template_id: str = "empty") -> Tenant:
         tenant = self.tenants.get_tenant_by_token(token)
         if tenant is None:
+            # deterministic authentication token: every cluster host
+            # bootstraps this tenant independently, and identical content
+            # means the replicated creates converge as no-ops instead of
+            # LWW-merging a random per-host token (which would restart
+            # the engine on every losing host at boot)
             tenant = self.tenants.create_tenant(Tenant(
                 token=token, name=token.title(),
+                authentication_token=f"{token}-auth",
                 tenant_template_id=template_id))
         return tenant
 

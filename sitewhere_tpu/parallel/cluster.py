@@ -55,11 +55,22 @@ travel by token; a multi-pass applier plus at-least-once redelivery
 absorbs cross-entity reordering. User scripts and scripted-rule installs
 replicate the same way (whole-state script payloads + stamped installs,
 `register_scripts`) and persist in the scripted-rule store + instance
-checkpoint. Residual limits: tenant/user provisioning still rides
-identical boot templates (mutations of those kinds are not gossiped),
-and events for devices whose gossip has not
-yet arrived intern to UNKNOWN and surface on the unregistered path
+checkpoint. Tenant/user/authority provisioning replicates too
+(`multitenant/replication.py` ProvisioningReplicator, wired below): a
+tenant created over REST on any host boots its engine — and registers
+its registry with this gossip — on every peer mid-flight; deletes drain
+and retire engines cluster-wide, park in-flight rows on the dead-letter
+topic, and tombstone the token; user mutations invalidate cached JWT
+auth state. The provisioning set persists in the instance checkpoint, so
+a gang restart rebuilds the same tenant world from durable state rather
+than boot templates. Residual limit: events for devices whose gossip has
+not yet arrived intern to UNKNOWN and surface on the unregistered path
 during the convergence window rather than corrupting anything.
+
+`ControlPlaneCluster` (below) is the mesh-free sibling composition: the
+same replication stack over busnet edges for N INDEPENDENT single-host
+instances — deployments (and CI environments) without multi-controller
+collectives still converge their control plane.
 """
 
 from __future__ import annotations
@@ -81,9 +92,15 @@ try:  # jax >= 0.6
 except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map as _shard_map
 
+from sitewhere_tpu.model.common import now_ms
+# the LWW stamp + host-independent content digest are the shared
+# replication core — ONE implementation (multitenant/replication.py)
+# serves both the registry gossip and the provisioning replicator
+from sitewhere_tpu.multitenant.replication import (
+    ProvisioningReplicator, content_digest as _content_digest,
+    lww_stamp as _gossip_stamp)
 from sitewhere_tpu.ops.pack import EventBatch, empty_batch
 from sitewhere_tpu.parallel.engine import ShardedPipelineEngine
-from sitewhere_tpu.model.common import now_ms
 from sitewhere_tpu.parallel.mesh import SHARD_AXIS
 from sitewhere_tpu.runtime.bus import ConsumerHost, Record, TopicNaming
 from sitewhere_tpu.runtime.busnet import BusClient, BusNetError
@@ -777,34 +794,17 @@ def _gossip_class(kind: str):
     return _GOSSIP_CLASSES.get(kind)
 
 
-def _gossip_stamp(data: Dict) -> int:
-    """Last-writer-wins timestamp of a serialized entity."""
-    return int(data.get("updated_date") or data.get("created_date") or 0)
-
-
 def _gossip_content_key(kind: str, data: Dict,
                         ref_tokens: Dict[str, str]) -> str:
-    """Deterministic tiebreak for equal-stamp concurrent writes: a digest
-    over the entity's HOST-INDEPENDENT content — the per-host UUID id
-    fields are dropped and the replicated references appear by token, so
-    every host hashing its local copy and the incoming copy computes the
-    same pair of keys and therefore picks the same winner.
-
-    created_date is a per-host observation (a host that content-merged a
-    peer's create keeps its own creation stamp), so it is dropped too, and
-    updated_date is normalized to the LWW stamp: an origin copy that never
-    replicated its implicit create stamp (updated_date=None, stamp rides
-    created_date) must hash identically to the replicas that carry the
-    stamp explicitly."""
-    import hashlib
-
-    ref_fields = {field for field, _ in _GOSSIP_REFS.get(kind, ())}
-    content = {k: v for k, v in data.items()
-               if k not in ("id", "created_date") and k not in ref_fields}
-    content["updated_date"] = _gossip_stamp(data)
-    content["_refs"] = dict(sorted(ref_tokens.items()))
-    blob = json.dumps(content, sort_keys=True, default=str)
-    return hashlib.sha1(blob.encode()).hexdigest()
+    """Deterministic tiebreak for equal-stamp concurrent writes: the
+    shared content digest with this kind's replicated-reference fields
+    dropped (they appear by token in `_refs` instead — ids are per-host
+    UUIDs). created_date is a per-host observation and updated_date
+    normalizes to the LWW stamp, so an origin copy whose stamp rides
+    created_date hashes identically to replicas carrying it explicitly."""
+    ref_fields = tuple(field for field, _ in _GOSSIP_REFS.get(kind, ()))
+    return _content_digest(data, ref_tokens=ref_tokens,
+                           drop_fields=ref_fields)
 
 
 def registry_gossip_topic(naming: TopicNaming) -> str:
@@ -1340,6 +1340,12 @@ class ClusterService:
         if self.gossip is not None:
             self.gossip.register_rules_engine(engine)
             self.gossip.register_scripts(instance)
+        # tenant/user/authority provisioning replication with reactive
+        # engine lifecycle (multitenant/replication.py) — same flag as
+        # the registry gossip: both are the control plane
+        self.provisioning = (ProvisioningReplicator(
+            process_id, self.peers, instance, naming)
+            if registry_gossip else None)
         self.aggregator = TopologyAggregator(
             instance.bus, naming, stale_after_s=stale_after_s)
         expected_peers = [p for p in range(num_processes)
@@ -1448,6 +1454,9 @@ class ClusterService:
         if self.gossip is not None:
             state["gossip_published"] = self.gossip.published
             state["gossip_applied"] = self.gossip.applied
+        if self.provisioning is not None:
+            state["provisioning_published"] = self.provisioning.published
+            state["provisioning_applied"] = self.provisioning.applied
         return state
 
     def _on_fatal(self, exc: BaseException) -> None:
@@ -1492,12 +1501,16 @@ class ClusterService:
         self.foreign_consumer.start()
         if self.gossip is not None:
             self.gossip.start()
+        if self.provisioning is not None:
+            self.provisioning.start()
         self.reporter.start()
         self.watchdog.start()
 
     def stop(self) -> None:
         self.watchdog.stop()
         self.reporter.stop()
+        if self.provisioning is not None:
+            self.provisioning.stop()
         if self.gossip is not None:
             self.gossip.stop()
         self.instance.stop()
@@ -1511,6 +1524,108 @@ class ClusterService:
     def processes(self) -> Dict[str, Dict]:
         """Cluster process map for instance topology (/admin): every
         heartbeat-known process plus self, with liveness."""
+        out = self.aggregator.snapshot()
+        me = str(self.process_id)
+        if me not in out:
+            state = self._build_state()
+            state["process_id"] = self.process_id
+            state["age_s"] = 0.0
+            state["stale"] = False
+            out[me] = state
+        return out
+
+
+# ---------------------------------------------------------------------------
+# control-plane-only cluster (no SPMD mesh)
+# ---------------------------------------------------------------------------
+
+class ControlPlaneCluster:
+    """N INDEPENDENT single-host instances joined by busnet edges: the
+    control plane — registry gossip, tenant/user/authority provisioning
+    with reactive engine lifecycle, script + scripted-rule replication,
+    heartbeats/topology — converges cluster-wide while each host runs its
+    OWN pipeline engine and owns every device it ingests locally.
+
+    This is the deployable shape for environments without
+    multi-controller collectives (and the composition the provisioning
+    drill runs at N=3): no jax.distributed gang, no lockstep loop, no
+    foreign-row forwarding — `data_plane = False` tells TenantEngine to
+    keep the direct single-host submit path. A killed host restarts alone
+    (its supervisor) and rebuilds from its durable state; survivors keep
+    serving — there are no collectives to hang.
+
+    Install on a SiteWhereInstance BEFORE `instance.start()` (the
+    constructor sets `instance.cluster_hooks`, which tenant engines read
+    to register their registries with the gossip), then `start()`.
+    """
+
+    data_plane = False
+
+    def __init__(self, instance, process_id: int, num_processes: int,
+                 peer_bus_addrs: Optional[Dict[int, tuple]] = None,
+                 bus_host: str = "127.0.0.1", bus_port: int = 0,
+                 heartbeat_s: float = 1.0, stale_after_s: float = 5.0):
+        from sitewhere_tpu.runtime.busnet import BusServer
+
+        self.instance = instance
+        self.process_id = process_id
+        self.num_processes = num_processes
+        self.degraded: List[str] = []
+        naming = instance.naming
+        self.bus_server = BusServer(instance.bus, host=bus_host,
+                                    port=bus_port)
+        self.peers: Dict[int, BusClient] = {}
+        for pid, addr in (peer_bus_addrs or {}).items():
+            if int(pid) != process_id:
+                self.peers[int(pid)] = BusClient(addr[0], int(addr[1]))
+        self.gossip = RegistryGossip(process_id, self.peers, instance,
+                                     naming)
+        self.gossip.register_scripts(instance)
+        if instance.pipeline_engine is not None:
+            self.gossip.register_rules_engine(instance.pipeline_engine)
+        self.provisioning = ProvisioningReplicator(
+            process_id, self.peers, instance, naming)
+        self.reporter = ProcessStateReporter(
+            process_id, instance.bus, naming, self.peers,
+            build_state=self._build_state, interval_s=heartbeat_s)
+        self.aggregator = TopologyAggregator(
+            instance.bus, naming, stale_after_s=stale_after_s)
+        instance.cluster_hooks = self
+
+    def _build_state(self) -> Dict:
+        return {
+            "instance_id": self.instance.instance_id,
+            "status": self.instance.status.name,
+            "mode": "control-plane",
+            "gossip_published": self.gossip.published,
+            "gossip_applied": self.gossip.applied,
+            "provisioning_published": self.provisioning.published,
+            "provisioning_applied": self.provisioning.applied,
+        }
+
+    @property
+    def bus_port(self) -> int:
+        return self.bus_server.port
+
+    def start(self) -> None:
+        self.bus_server.start()
+        self.aggregator.start()
+        self.instance.start()
+        self.gossip.start()
+        self.provisioning.start()
+        self.reporter.start()
+
+    def stop(self) -> None:
+        self.reporter.stop()
+        self.provisioning.stop()
+        self.gossip.stop()
+        self.instance.stop()
+        self.aggregator.stop()
+        for client in self.peers.values():
+            client.close()
+        self.bus_server.stop()
+
+    def processes(self) -> Dict[str, Dict]:
         out = self.aggregator.snapshot()
         me = str(self.process_id)
         if me not in out:

@@ -657,14 +657,23 @@ class ShardedPipelineEngine(PipelineEngine):
         import jax.numpy as jnp
 
         if self.is_multiprocess:
-            raise NotImplementedError(
-                "multi-host canonical gather would need a collective "
-                "inside the lockstep protocol; each host saves its own "
-                "shard blocks instead (local_state_shards — no collective, "
-                "any host any time), and persist/checkpoint.py "
-                "assemble_canonical merges every host's checkpoint into "
-                "the canonical any-topology snapshot offline "
-                "(`python -m sitewhere_tpu assemble-checkpoint`)")
+            # graceful degradation, not a 500 traceback: a live multi-host
+            # canonical gather would need a collective inside the lockstep
+            # protocol. SiteWhereError carries a structured code +
+            # http_status, so the REST layer surfaces the offline recipe
+            # as a 409 with the command the operator actually needs.
+            from sitewhere_tpu.errors import ErrorCode, SiteWhereError
+
+            raise SiteWhereError(
+                "multi-host canonical gather is not available on a live "
+                "cluster (it would need a collective inside the lockstep "
+                "protocol); each host saves its own shard blocks "
+                "(local_state_shards — no collective, any host any time). "
+                "Merge every host's checkpoint into the canonical "
+                "any-topology snapshot offline with the assemble-checkpoint "
+                "recipe: `python -m sitewhere_tpu assemble-checkpoint "
+                "<host0-ckpt> <host1-ckpt> ... --out <dir>`",
+                ErrorCode.GENERIC, http_status=409)
         # device-side copy under the lock only (see base canonical_state);
         # the D2H gather + host re-layout run outside it
         with self._state_lock:

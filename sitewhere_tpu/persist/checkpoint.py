@@ -705,10 +705,17 @@ class InstanceCheckpointManager:
         # instance-level payloads (VERDICT r4 item 3): user scripts +
         # scripted-rule installs travel with the checkpoint so an
         # assembled/cross-topology restore carries the scripting state,
-        # not just the tensors
+        # not just the tensors. Provisioning (tenants/users/authorities +
+        # tombstones) travels too: a gang restart rebuilds the same
+        # tenant set from durable state, not boot templates
+        # (multitenant/replication.py).
+        from sitewhere_tpu.multitenant.replication import (
+            export_provisioning)
+
         extra = {
             "scripts": self.instance.script_manager.export_state(),
             "scripted_rules": self.instance.scripted_rules.export_state(),
+            "provisioning": export_provisioning(self.instance),
         }
         return self.checkpointer.save(
             engine, consumer_groups=self._inbound_groups(),
@@ -757,17 +764,30 @@ class InstanceCheckpointManager:
         return True
 
     def _restore_scripting(self, path: str) -> None:
-        """Merge checkpointed scripts + scripted-rule installs into the
-        local stores (last-writer-wins: whatever the script manager
-        already loaded from its own data_dir stays if newer). Runs before
-        tenant engines exist — installs take effect when each engine
-        boots and reads the store."""
+        """Merge checkpointed instance-level payloads — provisioning
+        (tenants/users/authorities), scripts, scripted-rule installs —
+        into the local stores (last-writer-wins: whatever the local
+        durable stores already hold stays if newer). Runs before tenant
+        engines exist — the restored tenant set decides which engines
+        boot, and installs take effect when each engine boots and reads
+        the store."""
         try:
             with open(os.path.join(path, "manifest.json"),
                       encoding="utf-8") as fh:
                 manifest = json.load(fh)
         except (OSError, ValueError):
             return
+        from sitewhere_tpu.multitenant.replication import apply_provisioning
+
+        try:
+            # BEFORE the engine manager boots: the restored tenant set —
+            # not the boot templates — decides which engines come up
+            apply_provisioning(self.instance, manifest.get("provisioning"))
+        except Exception:
+            import logging
+
+            logging.getLogger("sitewhere.checkpoint").exception(
+                "checkpointed provisioning state did not restore")
         scripts = self.instance.script_manager
         for state in manifest.get("scripts", []):
             try:

@@ -79,6 +79,12 @@ class TopicNaming:
     def tenant_model_updates(self) -> str:
         return self._global("tenant-model-updates")
 
+    def provisioning_model_updates(self) -> str:
+        """Cross-host control-plane provisioning stream (tenant/user/
+        authority mutations, multitenant/replication.py) — the cluster
+        analog of the per-host tenant-model-updates topic."""
+        return self._global("provisioning-model-updates")
+
     def instance_logging(self) -> str:
         return self._global("instance-logging")
 

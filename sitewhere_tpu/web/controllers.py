@@ -352,10 +352,22 @@ def register_all(router: Router, instance, server) -> None:
                     "cluster.gossip.conflicts": gossip.conflicts,
                     "cluster.gossip.publish_errors": gossip.publish_errors,
                 })
-            extra["cluster.forwarded_rows"] = hooks.forwarder.forwarded
-            extra["cluster.forward_dead_lettered"] = \
-                hooks.forwarder.dead_lettered
-            extra["cluster.step_ticks"] = hooks.loop.tick_count
+            provisioning = getattr(hooks, "provisioning", None)
+            if provisioning is not None:
+                extra.update({
+                    "cluster.provisioning.published":
+                        provisioning.published,
+                    "cluster.provisioning.applied": provisioning.applied,
+                    "cluster.provisioning.publish_errors":
+                        provisioning.publish_errors,
+                    "cluster.provisioning.parked_rows":
+                        provisioning.parked_rows,
+                })
+            if getattr(hooks, "data_plane", True):
+                extra["cluster.forwarded_rows"] = hooks.forwarder.forwarded
+                extra["cluster.forward_dead_lettered"] = \
+                    hooks.forwarder.dead_lettered
+                extra["cluster.step_ticks"] = hooks.loop.tick_count
             extra["cluster.degraded_peers"] = len(hooks.degraded)
         text = instance.metrics.prometheus_text(extra)
         return 200, text.encode("utf-8"), "text/plain; version=0.0.4"
@@ -508,11 +520,37 @@ def register_all(router: Router, instance, server) -> None:
     # ------------------------------------------------------------------
     # Users + authorities (reference: Users.java, Authorities.java)
     # ------------------------------------------------------------------
+    def _replication_status():
+        """Cluster replication status of a provisioning mutation
+        (multitenant/replication.py): did it broadcast, to how many
+        peers, with how many publish failures parked for replay. Local
+        (non-clustered) instances report mode "local"."""
+        from sitewhere_tpu.multitenant.replication import replicator_of
+
+        replicator = replicator_of(instance)
+        if replicator is None:
+            return {"mode": "local", "peers": 0}
+        return replicator.status()
+
+    def _with_replication(entity):
+        payload = to_jsonable(entity)
+        payload["replication"] = _replication_status()
+        return payload
+
+    def get_provisioning_status(request: Request):
+        """GET /api/instance/provisioning — replication counters +
+        tombstone count for the control-plane provisioning stream."""
+        return _replication_status()
+
+    router.get("/api/instance/provisioning", get_provisioning_status,
+               authority=SiteWhereRoles.VIEW_SERVER_INFO)
+
     def create_user(request: Request):
         body = _body(request)
         password = body.pop("password", "")
         user = entity_from_payload(User, body)
-        return 201, instance.user_management.create_user(user, password)
+        return 201, _with_replication(
+            instance.user_management.create_user(user, password))
 
     def list_users(request: Request):
         return results_to_jsonable(
@@ -530,10 +568,11 @@ def register_all(router: Router, instance, server) -> None:
         password = body.pop("password", None)
         user = instance.user_management.update_user(
             request.params["username"], body, password=password)
-        return user
+        return _with_replication(user)
 
     def delete_user(request: Request):
-        return instance.user_management.delete_user(request.params["username"])
+        return _with_replication(instance.user_management.delete_user(
+            request.params["username"]))
 
     def get_user_authorities(request: Request):
         return {"authorities": instance.user_management.get_user_authorities(
@@ -564,7 +603,8 @@ def register_all(router: Router, instance, server) -> None:
 
     def create_tenant(request: Request):
         tenant = entity_from_payload(Tenant, _body(request))
-        return 201, instance.tenant_management.create_tenant(tenant)
+        return 201, _with_replication(
+            instance.tenant_management.create_tenant(tenant))
 
     def list_tenants(request: Request):
         return results_to_jsonable(
@@ -579,12 +619,15 @@ def register_all(router: Router, instance, server) -> None:
         return tenant
 
     def update_tenant(request: Request):
-        return instance.tenant_management.update_tenant(
-            request.params["token"], _body(request))
+        return _with_replication(instance.tenant_management.update_tenant(
+            request.params["token"], _body(request)))
 
     def delete_tenant(request: Request):
-        instance.engine_manager.stop_engine(request.params["token"])
-        return instance.tenant_management.delete_tenant(request.params["token"])
+        # retire (not admin-stop): deletion must not block a future
+        # tenant that legitimately reuses the token after resurrection
+        instance.engine_manager.retire_engine(request.params["token"])
+        return _with_replication(instance.tenant_management.delete_tenant(
+            request.params["token"]))
 
     def start_tenant_engine(request: Request):
         engine = instance.engine_manager.start_engine(request.params["token"],

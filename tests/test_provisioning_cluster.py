@@ -1,0 +1,339 @@
+"""N=3 OS-process provisioning drill over the REAL transport (busnet):
+control-plane-replicated `serve` hosts under gang-restart supervision.
+
+The drill (ISSUE 2 acceptance): create a tenant + user over REST on host
+A; WITHOUT any restart the tenant must ingest an event through host B's
+bus edge (its reactively-booted engine + gossip-replicated registry) and
+the user must mint a JWT against host C; delete the tenant on C and
+every host's engine stops; hard-kill one host mid-serve and its
+supervisor restarts it with the tenant set rebuilt from durable state
+(checkpoint + stores), not boot templates.
+
+Runs the `ControlPlaneCluster` composition (`serve --cluster-peers`
+without a coordinator): N independent single-host instances whose
+control plane converges over busnet — no jax.distributed collectives, so
+the drill runs on any CPU backend. Marked slow: tier-1 excludes it
+(the suite already rides the driver's timeout ceiling); run it directly
+with `pytest tests/test_provisioning_cluster.py -m slow`.
+"""
+
+import json
+import os
+import re
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import msgpack
+import pytest
+
+pytestmark = pytest.mark.slow
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+N = 3
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class _HostLog:
+    def __init__(self, proc):
+        self.proc = proc
+        self.lines = []
+        self._lock = threading.Lock()
+        self.thread = threading.Thread(target=self._run, daemon=True)
+        self.thread.start()
+
+    def _run(self):
+        for line in self.proc.stdout:
+            with self._lock:
+                self.lines.append(line)
+
+    def text(self) -> str:
+        with self._lock:
+            return "".join(self.lines)
+
+    def child_pids(self):
+        return [int(m) for m in re.findall(r"child pid=(\d+)", self.text())]
+
+    def banners(self) -> int:
+        return self.text().count("REST gateway")
+
+    def restarts(self) -> int:
+        return self.text().count("restarting in")
+
+
+def _wait(predicate, timeout_s, what, logs=None):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(0.25)
+    detail = ""
+    if logs:
+        detail = "\n".join(f"--- host {i} ---\n{log.text()[-3000:]}"
+                           for i, log in enumerate(logs))
+    raise AssertionError(f"timed out waiting for {what}\n{detail}")
+
+
+def _client(port, username="admin", password="password", tenant="default"):
+    from sitewhere_tpu.client.rest import SiteWhereClient
+
+    c = SiteWhereClient(f"http://127.0.0.1:{port}", tenant=tenant)
+    c.authenticate(username, password)
+    return c
+
+
+def _try(fn):
+    try:
+        return fn()
+    except Exception:
+        return None
+
+
+def _publish_event(bus_port, instance_id, tenant, token, name, value):
+    from sitewhere_tpu.model.common import _asdict
+    from sitewhere_tpu.model.event import (
+        DeviceEventBatch, DeviceMeasurement)
+    from sitewhere_tpu.runtime.bus import TopicNaming
+    from sitewhere_tpu.runtime.busnet import BusClient
+
+    naming = TopicNaming(instance=instance_id)
+    payload = msgpack.packb({
+        "sourceId": "drill", "deviceToken": token,
+        "kind": "DeviceEventBatch",
+        "request": _asdict(DeviceEventBatch(
+            device_token=token,
+            measurements=[DeviceMeasurement(
+                name=name, value=value,
+                event_date=int(time.time() * 1000))])),
+        "metadata": {},
+    }, use_bin_type=True)
+    client = BusClient("127.0.0.1", bus_port)
+    try:
+        client.publish(naming.event_source_decoded_events(tenant),
+                       token.encode(), payload)
+    finally:
+        client.close()
+
+
+def test_three_host_provisioning_replication_drill(tmp_path):
+    instance_id = "provdrill"
+    bus_ports = [_free_port() for _ in range(N)]
+    rest_ports = [_free_port() for _ in range(N)]
+    peers = ",".join(f"{i}=127.0.0.1:{bus_ports[i]}" for i in range(N))
+    cfg_path = tmp_path / "cfg.json"
+    cfg_path.write_text(json.dumps({
+        "instance": {"id": instance_id},
+        "pipeline": {"enabled": True, "batch_size": 16, "max_devices": 64,
+                     "max_zones": 4, "max_zone_vertices": 4,
+                     "measurement_slots": 4, "max_tenants": 4},
+        "cluster": {"heartbeat_s": 0.5, "stale_after_s": 5.0},
+        "persist": {"checkpoint_interval_s": None},
+    }))
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONUNBUFFERED"] = "1"
+    sups, logs = [], []
+    for i in range(N):
+        sups.append(subprocess.Popen(
+            [sys.executable, "-u", "-m", "sitewhere_tpu", "serve",
+             "--supervise", "--supervise-backoff", "1",
+             "--config", str(cfg_path),
+             "--cluster-num-processes", str(N),
+             "--cluster-process-id", str(i),
+             "--cluster-peers", peers,
+             "--bus-port", str(bus_ports[i]),
+             "--port", str(rest_ports[i]),
+             "--data-dir", str(tmp_path / f"h{i}")],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env, cwd=str(tmp_path)))
+        logs.append(_HostLog(sups[-1]))
+
+    try:
+        # ---- all three hosts serving --------------------------------------
+        _wait(lambda: all(log.banners() >= 1 for log in logs), 300,
+              "all three hosts serving", logs)
+
+        # ---- provision on host A ONLY -------------------------------------
+        c0 = _client(rest_ports[0])
+        created = c0.post("/api/tenants", {
+            "token": "acme", "name": "Acme",
+            "tenant_template_id": "empty"})
+        assert created["replication"]["mode"] == "replicated"
+        assert created["replication"]["peers"] == N - 1
+        c0.post("/api/users", {
+            "username": "drill-user", "password": "drill-pw",
+            "authorities": ["REST", "VIEW_SERVER_INFO",
+                            "ADMINISTER_TENANTS"]})
+        # registry content for the NEW tenant, still via host A
+        c0t = _client(rest_ports[0], tenant="acme")
+        c0t.post("/api/devicetypes", {"token": "adt", "name": "drill"})
+        c0t.post("/api/devices", {"token": "adev",
+                                  "device_type_token": "adt"})
+        c0t.post("/api/assignments", {"token": "aas",
+                                      "device_token": "adev"})
+
+        # ---- tenant + engines live on B and C without restart -------------
+        def engines_live_everywhere():
+            for port in rest_ports:
+                c = _try(lambda p=port: _client(p))
+                if c is None:
+                    return False
+                topo = _try(c.get_topology)
+                if not topo or "acme" not in topo.get("tenant_engines", {}):
+                    return False
+            return True
+
+        def replicated_everywhere():
+            for port in rest_ports[1:]:
+                c = _try(lambda p=port: _client(p, tenant="acme"))
+                if c is None:
+                    return False
+                listed = _try(lambda cc=c: cc.get("/api/devices",
+                                                  pageSize=100))
+                if not listed:
+                    return False
+                if "adev" not in {d["token"]
+                                  for d in listed.get("results", [])}:
+                    return False
+            return True
+
+        _wait(engines_live_everywhere, 240,
+              "acme engines live on all three hosts", logs)
+        _wait(replicated_everywhere, 240,
+              "acme registry replicated to B and C", logs)
+
+        # ---- ingest for the new tenant through host B's bus edge ----------
+        _publish_event(bus_ports[1], instance_id, "acme", "adev",
+                       "temp", 42.5)
+
+        def folded_on_b():
+            c = _try(lambda: _client(rest_ports[1], tenant="acme"))
+            if c is None:
+                return False
+            state = _try(lambda: c.get("/api/devicestates/adev"))
+            if not state:
+                return False
+            meas = state.get("lastMeasurements") or state.get(
+                "last_measurements") or {}
+            val = meas.get("temp")
+            return (val[1] if isinstance(val, (list, tuple)) else val) \
+                == 42.5
+
+        _wait(folded_on_b, 240, "acme event folded on host B", logs)
+
+        # ---- the new user authenticates against host C --------------------
+        c2u = _client(rest_ports[2], username="drill-user",
+                      password="drill-pw")
+        assert c2u.get("/api/system/version")["edition"] == "sitewhere-tpu"
+
+        # ---- checkpoint everywhere, then kill host 1 hard -----------------
+        for port in rest_ports:
+            _client(port).post("/api/instance/checkpoint", {})
+        victim_pid = logs[1].child_pids()[-1]
+        restarts_before = logs[1].restarts()
+        banners_before = logs[1].banners()
+        os.kill(victim_pid, signal.SIGKILL)
+        _wait(lambda: logs[1].restarts() > restarts_before, 120,
+              "host 1 supervisor restart", logs)
+        _wait(lambda: logs[1].banners() > banners_before, 240,
+              "host 1 serving again", logs)
+
+        # the restarted host rebuilt acme from DURABLE state (checkpoint +
+        # stores), not templates: tenant, engine, registry, event state
+        def host1_recovered():
+            c = _try(lambda: _client(rest_ports[1]))
+            if c is None:
+                return False
+            topo = _try(c.get_topology)
+            if not topo or "acme" not in topo.get("tenant_engines", {}):
+                return False
+            ct = _try(lambda: _client(rest_ports[1], tenant="acme"))
+            if ct is None:
+                return False
+            listed = _try(lambda: ct.get("/api/devices", pageSize=100))
+            if not listed or "adev" not in {
+                    d["token"] for d in listed.get("results", [])}:
+                return False
+            return True
+
+        _wait(host1_recovered, 240,
+              "host 1 rebuilt acme from durable state", logs)
+        # the replicated user survives the restart too (host 1's store)
+        _client(rest_ports[1], username="drill-user", password="drill-pw")
+        # and the recovered host still ingests for the tenant
+        _publish_event(bus_ports[1], instance_id, "acme", "adev",
+                       "post", 77.0)
+
+        def post_folded():
+            c = _try(lambda: _client(rest_ports[1], tenant="acme"))
+            state = c and _try(lambda: c.get("/api/devicestates/adev"))
+            if not state:
+                return False
+            meas = state.get("lastMeasurements") or state.get(
+                "last_measurements") or {}
+            val = meas.get("post")
+            return (val[1] if isinstance(val, (list, tuple)) else val) \
+                == 77.0
+
+        _wait(post_folded, 240, "post-recovery acme event folded", logs)
+
+        # ---- delete on host C stops engines cluster-wide ------------------
+        deleted = _client(rest_ports[2]).delete("/api/tenants/acme")
+        assert deleted["replication"]["tombstones"] >= 1
+
+        def engines_stopped_everywhere():
+            for port in rest_ports:
+                c = _try(lambda p=port: _client(p))
+                if c is None:
+                    return False
+                topo = _try(c.get_topology)
+                if topo is None \
+                        or "acme" in topo.get("tenant_engines", {}):
+                    return False
+            return True
+
+        _wait(engines_stopped_everywhere, 240,
+              "acme engines stopped on all hosts after delete", logs)
+
+        def record_gone_everywhere():
+            for port in rest_ports:
+                c = _try(lambda p=port: _client(p))
+                listed = c and _try(lambda: c.get("/api/tenants",
+                                                  pageSize=100))
+                if not listed or "acme" in {
+                        t["token"] for t in listed.get("results", [])}:
+                    return False
+            return True
+
+        _wait(record_gone_everywhere, 120,
+              "acme tenant record deleted on all hosts", logs)
+
+        # ---- graceful shutdown: supervisors exit 0 ------------------------
+        for p in sups:
+            p.send_signal(signal.SIGTERM)
+        for i, p in enumerate(sups):
+            rc = p.wait(timeout=120)
+            assert rc == 0, (i, rc, logs[i].text()[-3000:])
+    finally:
+        for p in sups:
+            if p.poll() is None:
+                p.kill()
+                p.wait(timeout=30)
+        for log in logs:
+            for pid in log.child_pids():
+                try:
+                    os.kill(pid, signal.SIGKILL)
+                except OSError:
+                    pass

@@ -1,0 +1,457 @@
+"""Provisioning replication semantics (multitenant/replication.py), in
+process: instances exchange captured provisioning payloads directly,
+pinning the replication ALGEBRA — duplicate and out-of-order redelivery
+applied idempotently (LWW stamp wins, tombstone beats stale create),
+reactive tenant-engine lifecycle, in-flight row parking on delete, JWT
+auth-state invalidation, and checkpoint durability.
+
+The real multi-process transport path is covered by
+tests/test_provisioning_cluster.py (N=3 OS-process drill, marked slow).
+
+Reference analogue: the tenant-model-updates topic + shared user store
+every microservice reacts to (MultitenantMicroservice.java:64-70,:238).
+"""
+
+import time
+
+import msgpack
+import pytest
+
+from sitewhere_tpu.instance import SiteWhereInstance
+from sitewhere_tpu.model.tenant import Tenant
+from sitewhere_tpu.model.user import GrantedAuthority, SiteWhereRoles, User
+from sitewhere_tpu.multitenant.replication import (
+    ProvisioningReplicator, apply_provisioning, export_provisioning,
+    lww_stamp)
+from sitewhere_tpu.runtime.bus import Record
+from sitewhere_tpu.security.tokens import InvalidTokenError
+
+
+class _Capture:
+    """BusClient stand-in collecting published provisioning payloads."""
+
+    def __init__(self):
+        self.sent = []
+
+    def publish(self, topic, key, value):
+        self.sent.append(value)
+
+    def drain(self):
+        out, self.sent = self.sent, []
+        return out
+
+
+def _host(instance_id="prov-algebra", **kwargs):
+    instance = SiteWhereInstance(instance_id=instance_id, **kwargs)
+    capture = _Capture()
+    replicator = ProvisioningReplicator(0, {1: capture}, instance,
+                                        instance.naming)
+    instance.start()
+    capture.drain()  # drop this host's own bootstrap mutations
+    return instance, replicator, capture
+
+
+def _apply(replicator, payloads):
+    replicator._handle([Record("t", 0, i, b"", p, 0)
+                        for i, p in enumerate(payloads)])
+
+
+def _wait(predicate, timeout_s=15.0, what="condition"):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+class TestReactiveLifecycle:
+    def test_create_boots_engine_and_delete_retires_it(self):
+        a, _rep_a, cap_a = _host("rx-a")
+        b, rep_b, _cap_b = _host("rx-b")
+        a.tenant_management.create_tenant(Tenant(token="acme", name="Acme"))
+        _apply(rep_b, cap_a.drain())
+        assert b.tenant_management.get_tenant_by_token("acme") is not None
+        # reactive boot rides the LOCAL tenant-model-updates record the
+        # replicated apply published (async watcher)
+        _wait(lambda: b.engine_manager.get_engine("acme") is not None,
+              what="replicated create to boot the engine")
+        # delete on A retires the engine on B and tombstones the token
+        a.engine_manager.retire_engine("acme")
+        a.tenant_management.delete_tenant("acme")
+        _apply(rep_b, cap_a.drain())
+        assert b.tenant_management.get_tenant_by_token("acme") is None
+        _wait(lambda: b.engine_manager.get_engine("acme") is None,
+              what="replicated delete to retire the engine")
+        # retirement (deletion) must not admin-stop the token: a future
+        # resurrected create boots again
+        assert not b.engine_manager.is_stopped("acme")
+
+    def test_replicated_registry_registers_with_gossip_midflight(self):
+        """A tenant engine booted by a replicated create registers its
+        registry with the cluster gossip (the mid-flight half of the
+        tentpole): mutations in the NEW tenant replicate too."""
+        from sitewhere_tpu.model import DeviceType
+        from sitewhere_tpu.parallel.cluster import RegistryGossip
+
+        b, rep_b, _ = _host("rx-gossip")
+        gossip_cap = _Capture()
+        gossip = RegistryGossip(0, {1: gossip_cap}, b, b.naming)
+
+        class _Hooks:
+            data_plane = False
+
+        hooks = _Hooks()
+        hooks.gossip = gossip
+        hooks.provisioning = rep_b
+        b.cluster_hooks = hooks
+
+        a, _rep_a, cap_a = _host("rx-gossip-src")
+        a.tenant_management.create_tenant(Tenant(token="late", name="L"))
+        _apply(rep_b, cap_a.drain())
+        _wait(lambda: b.engine_manager.get_engine("late") is not None,
+              what="late tenant engine")
+        gossip_cap.drain()  # template/boot noise
+        engine = b.get_tenant_engine("late")
+        engine.registry.create_device_type(DeviceType(token="ldt"))
+        assert any(
+            msgpack.unpackb(p, raw=False).get("tenant") == "late"
+            for p in gossip_cap.drain()), \
+            "new tenant's registry mutations must gossip"
+
+
+class TestRedeliveryIdempotence:
+    """Satellite: duplicate and out-of-order create/update/delete records
+    applied idempotently — LWW stamp wins, tombstone beats stale create."""
+
+    def test_duplicate_create_and_update_records_are_idempotent(self):
+        a, _ra, cap_a = _host("dup-a")
+        b, rep_b, _ = _host("dup-b")
+        a.tenant_management.create_tenant(Tenant(token="t1", name="one"))
+        create = cap_a.drain()
+        a.tenant_management.update_tenant("t1", {"name": "two"})
+        update = cap_a.drain()
+        # at-least-once storm: duplicates, interleaved, multiple rounds
+        for _ in range(3):
+            _apply(rep_b, create + update + create)
+            _apply(rep_b, update + update)
+        got = b.tenant_management.get_tenant_by_token("t1")
+        assert got is not None and got.name == "two"
+        a_copy = a.tenant_management.get_tenant_by_token("t1")
+        assert got.updated_date == a_copy.updated_date
+
+    def test_out_of_order_update_before_create_still_converges(self):
+        a, _ra, cap_a = _host("ooo-a")
+        b, rep_b, _ = _host("ooo-b")
+        a.tenant_management.create_tenant(Tenant(token="t2", name="v1"))
+        create = cap_a.drain()
+        a.tenant_management.update_tenant("t2", {"name": "v2"})
+        update = cap_a.drain()
+        # the update arrives FIRST: applied as a create-equivalent (the
+        # entity payload is whole-state), then the older create must NOT
+        # regress the name (LWW stamp wins)
+        _apply(rep_b, update)
+        got = b.tenant_management.get_tenant_by_token("t2")
+        assert got is not None and got.name == "v2"
+        _apply(rep_b, create)
+        assert b.tenant_management.get_tenant_by_token("t2").name == "v2"
+
+    def test_tombstone_beats_stale_create(self):
+        a, _ra, cap_a = _host("tomb-a")
+        b, rep_b, _ = _host("tomb-b")
+        a.tenant_management.create_tenant(Tenant(token="t3", name="dead"))
+        create = cap_a.drain()
+        a.tenant_management.delete_tenant("t3")
+        delete = cap_a.drain()
+        # delete arrives BEFORE the create it deletes (different
+        # partitions can reorder across records): the tombstone must make
+        # the late create a no-op, and redelivery must not resurrect
+        _apply(rep_b, delete)
+        for _ in range(3):
+            _apply(rep_b, create)
+            assert b.tenant_management.get_tenant_by_token("t3") is None
+        _apply(rep_b, delete + create)
+        assert b.tenant_management.get_tenant_by_token("t3") is None
+
+    def test_newer_create_resurrects_past_tombstone(self):
+        a, rep_a, cap_a = _host("res-a")
+        b, rep_b, _ = _host("res-b")
+        a.tenant_management.create_tenant(Tenant(token="t4", name="v1"))
+        _apply(rep_b, cap_a.drain())
+        a.tenant_management.delete_tenant("t4")
+        delete = cap_a.drain()
+        _apply(rep_b, delete)
+        assert b.tenant_management.get_tenant_by_token("t4") is None
+        # A recreates the token: the publish-side resurrection stamp must
+        # outrank A's own tombstone, so B applies it
+        a.tenant_management.create_tenant(Tenant(token="t4", name="back"))
+        recreate = cap_a.drain()
+        stamp = msgpack.unpackb(recreate[-1], raw=False)["entity"][
+            "updated_date"]
+        assert stamp > msgpack.unpackb(delete[-1], raw=False)["stamp"]
+        _apply(rep_b, recreate)
+        got = b.tenant_management.get_tenant_by_token("t4")
+        assert got is not None and got.name == "back"
+        # the stale delete redelivers AFTER the resurrection: no-op
+        _apply(rep_b, delete)
+        assert b.tenant_management.get_tenant_by_token("t4") is not None
+
+    def test_user_redelivery_and_lww(self):
+        a, _ra, cap_a = _host("ured-a")
+        b, rep_b, _ = _host("ured-b")
+        a.user_management.create_user(
+            User(username="u1", authorities=[SiteWhereRoles.REST]),
+            password="first")
+        create = cap_a.drain()
+        a.user_management.update_user("u1", {}, password="second")
+        update = cap_a.drain()
+        for _ in range(3):
+            _apply(rep_b, update + create + update)
+        # the password change (the LWW winner) holds under redelivery
+        assert b.user_management.authenticate("u1", "second",
+                                              update_last_login=False)
+        with pytest.raises(Exception):
+            b.user_management.authenticate("u1", "first",
+                                           update_last_login=False)
+
+    def test_concurrent_updates_converge_identically(self):
+        a, rep_a, cap_a = _host("lww-a")
+        b, rep_b, cap_b = _host("lww-b")
+        a.tenant_management.create_tenant(Tenant(token="t5", name="base"))
+        _apply(rep_b, cap_a.drain())
+        cap_b.drain()
+        a.tenant_management.update_tenant("t5", {"name": "from-A"})
+        b.tenant_management.update_tenant("t5", {"name": "from-B"})
+        from_a, from_b = cap_a.drain(), cap_b.drain()
+        _apply(rep_b, from_a)
+        _apply(rep_a, from_b)
+        got_a = a.tenant_management.get_tenant_by_token("t5")
+        got_b = b.tenant_management.get_tenant_by_token("t5")
+        assert got_a.name == got_b.name
+        assert got_a.updated_date == got_b.updated_date
+
+    def test_authority_create_replicates_once(self):
+        a, _ra, cap_a = _host("auth-a")
+        b, rep_b, _ = _host("auth-b")
+        a.user_management.create_granted_authority(GrantedAuthority(
+            authority="CUSTOM_ROLE", description="custom"))
+        payloads = cap_a.drain()
+        for _ in range(3):
+            _apply(rep_b, payloads)
+        got = b.user_management.get_granted_authority("CUSTOM_ROLE")
+        assert got is not None and got.description == "custom"
+
+
+class TestAuthStateInvalidation:
+    def test_replicated_user_delete_revokes_tokens(self):
+        a, _ra, cap_a = _host("rev-a")
+        b, rep_b, _ = _host("rev-b")
+        a.user_management.create_user(User(username="victim"),
+                                      password="pw")
+        _apply(rep_b, cap_a.drain())
+        token = b.token_management.generate_token("victim", ["REST"])
+        assert b.token_management.get_claims(token)["sub"] == "victim"
+        a.user_management.delete_user("victim")
+        time.sleep(0.01)  # revocation cut strictly past iat*1000 rounding
+        _apply(rep_b, cap_a.drain())
+        assert b.user_management.get_user_by_username("victim") is None
+        with pytest.raises(InvalidTokenError):
+            b.token_management.get_claims(token)
+
+    def test_update_invalidates_cache_but_keeps_token_valid(self):
+        b, rep_b, _ = _host("cache-b")
+        a, _ra, cap_a = _host("cache-a")
+        a.user_management.create_user(User(username="kept"), password="pw")
+        _apply(rep_b, cap_a.drain())
+        token = b.token_management.generate_token("kept", ["REST"])
+        b.token_management.get_claims(token)  # warm the cache
+        assert token in b.token_management._cache
+        a.user_management.update_user("kept", {"first_name": "K"})
+        _apply(rep_b, cap_a.drain())
+        assert token not in b.token_management._cache  # cache invalidated
+        # but the token itself survives an update (not a revocation)
+        assert b.token_management.get_claims(token)["sub"] == "kept"
+
+
+class TestDeleteParksInflight:
+    def test_inflight_rows_park_on_dead_letter(self):
+        a, _ra, cap_a = _host("park-a")
+        b, rep_b, _ = _host("park-b")
+        a.tenant_management.create_tenant(Tenant(token="parked"))
+        _apply(rep_b, cap_a.drain())
+        _wait(lambda: b.engine_manager.get_engine("parked") is not None,
+              what="parked tenant engine")
+        # stop B's engine so its consumer leaves rows in-flight, then
+        # land rows on the decoded topic that nobody will consume
+        b.engine_manager.stop_engine("parked")
+        topic = b.naming.event_source_decoded_events("parked")
+        consumed = b.bus.consumer(topic, "inbound-processing-parked")
+        consumed.poll(100)
+        b.bus.commit(consumed)  # cursor at current end
+        for i in range(5):
+            b.bus.publish(topic, b"k", f"row-{i}".encode())
+        a.engine_manager.retire_engine("parked")
+        a.tenant_management.delete_tenant("parked")
+        _apply(rep_b, cap_a.drain())
+        assert rep_b.parked_rows == 5
+        dlq = b.bus.topic(f"{topic}.dead-letter")
+        assert sum(int(e) for e in dlq.end_offsets()) == 5
+
+
+class TestNotifyDeadLetter:
+    """Satellite: a tenant-model-update publish failure after the store
+    mutation committed parks the notification instead of raising."""
+
+    def test_publish_failure_parks_and_counts(self):
+        instance = SiteWhereInstance(instance_id="notify-dlq")
+        instance.start()
+        mgmt = instance.tenant_management
+        before = mgmt.notify_dead_lettered.value
+        real_publish = instance.bus.publish
+        topic = instance.naming.tenant_model_updates()
+
+        def failing_publish(name, key, value):
+            if name == topic:
+                raise RuntimeError("broker down")
+            return real_publish(name, key, value)
+
+        mgmt.bus = type("B", (), {"publish": staticmethod(failing_publish)})()
+        # the mutation itself must SUCCEED (store committed) even though
+        # the notification publish fails
+        created = mgmt.create_tenant(Tenant(token="dlq-t"))
+        assert created is not None
+        assert mgmt.get_tenant_by_token("dlq-t") is not None
+        assert mgmt.notify_dead_lettered.value == before + 1
+        parked = instance.bus.topic(f"{topic}.dead-letter")
+        assert sum(int(e) for e in parked.end_offsets()) >= 1
+
+
+class TestCheckpointDurability:
+    def test_export_apply_rebuilds_tenant_set(self):
+        a, _ra, cap_a = _host("ck-a")
+        a.tenant_management.create_tenant(Tenant(token="ck-t", name="C"))
+        a.user_management.create_user(
+            User(username="ck-u", authorities=[SiteWhereRoles.REST]),
+            password="pw")
+        state = export_provisioning(a)
+        assert any(t["token"] == "ck-t" for t in state["tenants"])
+        fresh = SiteWhereInstance(instance_id="ck-fresh")
+        fresh.start()
+        applied = apply_provisioning(fresh, state)
+        assert applied >= 2
+        assert fresh.tenant_management.get_tenant_by_token(
+            "ck-t") is not None
+        assert fresh.user_management.authenticate(
+            "ck-u", "pw", update_last_login=False).username == "ck-u"
+
+    def test_tombstones_survive_export_and_block_stale_creates(self):
+        a, rep_a, cap_a = _host("ck-tomb-a")
+        a.tenant_management.create_tenant(Tenant(token="gone"))
+        create = cap_a.drain()
+        a.tenant_management.delete_tenant("gone")
+        state = export_provisioning(a)
+        assert ["tenant", "gone", rep_a._tombstones[("tenant", "gone")]] \
+            in state["tombstones"]
+        # a fresh host restores the checkpoint, then the STALE create
+        # replays (parked dead-letter replay after a gang restart): dead
+        fresh = SiteWhereInstance(instance_id="ck-tomb-b")
+        fresh_rep = ProvisioningReplicator(1, {0: _Capture()}, fresh,
+                                           fresh.naming)
+        fresh.start()
+        apply_provisioning(fresh, state)
+        _apply(fresh_rep, create)
+        assert fresh.tenant_management.get_tenant_by_token("gone") is None
+
+    def test_instance_checkpoint_carries_provisioning(self, tmp_path):
+        data_dir = str(tmp_path / "ckpt-host")
+        inst = SiteWhereInstance(
+            instance_id="ck-full", data_dir=data_dir, enable_pipeline=True,
+            max_devices=32, batch_size=8, max_zones=4, max_zone_vertices=4,
+            measurement_slots=4, max_tenants=4)
+        inst.start()
+        inst.tenant_management.create_tenant(Tenant(token="durable"))
+        path = inst.checkpoint_manager.save()
+        import json as _json
+        import os as _os
+
+        with open(_os.path.join(path, "manifest.json")) as fh:
+            manifest = _json.load(fh)
+        tokens = [t["token"] for t in manifest["provisioning"]["tenants"]]
+        assert "durable" in tokens
+        inst.stop()
+        # a SECOND data dir (fresh host adopting the checkpoint — the
+        # assembled-restore story): provisioning comes from the manifest
+        other_dir = str(tmp_path / "adopt-host")
+        import shutil
+
+        _os.makedirs(_os.path.join(other_dir, "checkpoints"))
+        shutil.copytree(path, _os.path.join(other_dir, "checkpoints",
+                                            _os.path.basename(path)))
+        adopted = SiteWhereInstance(
+            instance_id="ck-adopt", data_dir=other_dir,
+            enable_pipeline=True, max_devices=32, batch_size=8,
+            max_zones=4, max_zone_vertices=4, measurement_slots=4,
+            max_tenants=4)
+        adopted.start()
+        try:
+            assert adopted.tenant_management.get_tenant_by_token(
+                "durable") is not None
+            # the restored tenant set boots engines: not a template tenant
+            assert adopted.engine_manager.get_engine("durable") is not None
+        finally:
+            adopted.stop()
+
+
+class TestRestReplicationStatus:
+    def test_mutation_responses_carry_replication_fields(self):
+        from sitewhere_tpu.client.rest import SiteWhereClient
+        from sitewhere_tpu.web.server import RestServer
+
+        instance = SiteWhereInstance(instance_id="rest-repl")
+        replicator = ProvisioningReplicator(0, {1: _Capture()}, instance,
+                                            instance.naming)
+
+        class _Hooks:
+            data_plane = False
+            gossip = None
+
+        hooks = _Hooks()
+        hooks.provisioning = replicator
+        instance.cluster_hooks = hooks
+        instance.start()
+        rest = RestServer(instance, port=0)
+        rest.start()
+        try:
+            client = SiteWhereClient(rest.base_url)
+            client.authenticate("admin", "password")
+            created = client.post("/api/tenants", {"token": "rp-t"})
+            assert created["replication"]["mode"] == "replicated"
+            assert created["replication"]["peers"] == 1
+            assert created["replication"]["published"] >= 1
+            user = client.post("/api/users", {"username": "rp-u",
+                                              "password": "pw"})
+            assert user["replication"]["mode"] == "replicated"
+            status = client.get("/api/instance/provisioning")
+            assert status["published"] >= 2
+            deleted = client.delete("/api/tenants/rp-t")
+            assert deleted["replication"]["tombstones"] >= 1
+        finally:
+            rest.stop()
+            instance.stop()
+
+    def test_local_mode_without_cluster(self):
+        from sitewhere_tpu.client.rest import SiteWhereClient
+        from sitewhere_tpu.web.server import RestServer
+
+        instance = SiteWhereInstance(instance_id="rest-local")
+        instance.start()
+        rest = RestServer(instance, port=0)
+        rest.start()
+        try:
+            client = SiteWhereClient(rest.base_url)
+            client.authenticate("admin", "password")
+            created = client.post("/api/tenants", {"token": "lp-t"})
+            assert created["replication"] == {"mode": "local", "peers": 0}
+        finally:
+            rest.stop()
+            instance.stop()
