@@ -66,6 +66,7 @@ _COMPACT_KEYS = (
     "compute_only_events_per_sec", "system_sustained_events_per_sec",
     "latency_mode_p50_ms", "latency_mode_p99_ms",
     "latency_mode_trial_p99_ms", "latency_mode",
+    "latency_fetch", "materialize_lane_speedup_x",
     "telemetry_packed_events_per_sec", "telemetry_wire_bytes_per_event",
     "persist_events_per_sec", "analytics_replay_events_per_sec",
     "sharded_1chip_events_per_sec", "sharded_from_bytes_events_per_sec",
@@ -361,7 +362,11 @@ def _build(jax, small: bool) -> Dict:
                                     value=200.0 if i % 2 else 10.0)
                   for i in range(64)]
     lat_tokens = [f"dev-{i % N_REGISTERED}" for i in range(64)]
-    batcher = AdaptiveBatcher(lat_engine, linger_ms=LAT_LINGER_MS)
+    # adaptive linger: a complete offered burst dispatches immediately —
+    # the linger sleep was the second-largest constant in the end-to-end
+    # number after D2H fetches (docs/ALERT_LANES.md)
+    batcher = AdaptiveBatcher(lat_engine, linger_ms=LAT_LINGER_MS,
+                              adaptive=True)
     # steady-state warm path: pre-jit the shape + wire variant, fill the
     # interners, ramp the flush thread — all excluded from measurement
     batcher.warm(lat_events, lat_tokens, repeats=3)
@@ -373,8 +378,31 @@ def _build(jax, small: bool) -> Dict:
     ctx["lat_trial_warmup"] = 2
     ctx["lat_config"] = {"batch_size": LAT_BATCH,
                          "linger_ms": LAT_LINGER_MS,
+                         "adaptive_linger": True,
                          "warm_flushes": batcher.warm_flushes,
                          "trial_warmup_offers": ctx["lat_trial_warmup"]}
+
+    # pinned materialize-path micro-bench at the latency tier's batch
+    # size: the device-compacted lane path (one lane-sized fetch +
+    # vectorized token resolution) vs the pre-lane mask-scan reference
+    # (six per-row arrays + per-row token_of walk) on the SAME flush —
+    # the >=3x speedup acceptance rides this number on this host
+    from sitewhere_tpu.pipeline.engine import materialize_alerts_maskscan
+    [(mbatch, mout)] = batcher.offer(lat_events,
+                                     lat_tokens).result(timeout=600.0)
+    jax.block_until_ready(mout.processed)
+    materialize_alerts_maskscan(lat_engine, mbatch, mout)  # warm both
+    lat_engine.materialize_alerts(mbatch, mout)
+    reps = 5 if small else 20
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        materialize_alerts_maskscan(lat_engine, mbatch, mout)
+    ref_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        lat_engine.materialize_alerts(mbatch, mout)
+    lane_s = time.perf_counter() - t0
+    ctx["materialize_speedup"] = ref_s / lane_s if lane_s else 0.0
 
     # analytics replay log (BASELINE config 4), built + warmed once
     from sitewhere_tpu.analytics.engine import WindowedAnalyticsEngine
@@ -458,8 +486,15 @@ def _t_latency(jax, ctx) -> Dict:
 
     for _ in range(ctx["lat_trial_warmup"]):
         one_offer()  # re-enter steady state; excluded from samples
+    # fetch-budget evidence over the measured window only: the lane path
+    # must ship exactly ONE fixed-shape D2H fetch per offer (perf_gate
+    # latency_fetch_budget pins it)
+    f0, b0 = engine.d2h_fetches, engine.d2h_bytes
     samples = [one_offer() for _ in range(ctx["SYNC_STEPS"] * 2)]
-    return {"lat_s": samples}
+    return {"lat_s": samples,
+            "d2h_fetches": engine.d2h_fetches - f0,
+            "d2h_bytes": engine.d2h_bytes - b0,
+            "offers": len(samples)}
 
 
 def _t_sustained(jax, ctx) -> Dict:
@@ -613,10 +648,18 @@ def _t_compute(jax, ctx) -> Dict:
 
 def _t_persist(jax, ctx) -> Dict:
     """BASELINE config 1 — persist rate (columnar event log bulk append),
-    fresh log per trial so every trial appends into identical state."""
+    fresh log per trial so every trial appends into identical state.
+
+    Steady-state window (same unmeasured warmup discipline the latency
+    tier got): an unmeasured append into a throwaway log re-warms the
+    allocator/page caches the interleaved sections evicted, so trial 1
+    no longer pays the cold path and `trial_spread_bounded` judges warm
+    trials only."""
     from sitewhere_tpu.persist.eventlog import ColumnarEventLog
 
     engine, pool = ctx["engine"], ctx["pool"]
+    warm_log = ColumnarEventLog()
+    warm_log.append_batch("bench", pool[0], engine.packer)  # unmeasured
     log = ColumnarEventLog()
     steps = 2 if ctx["small"] else 3
     appended = 0
@@ -629,7 +672,13 @@ def _t_persist(jax, ctx) -> Dict:
 
 
 def _t_analytics(jax, ctx) -> Dict:
+    """Replay analytics over the prebuilt log. Steady-state window: one
+    unmeasured replay first (the interleaved sections between trials
+    evict the device program + host caches), so the measured run — and
+    the spread bound judging it — sees the warm path only."""
     aeng = ctx["aeng"]
+    warm = aeng.measurement_windows("bench", window_ms=60_000)
+    jax.block_until_ready(warm.stats)  # unmeasured warmup
     a0 = time.perf_counter()
     report = aeng.measurement_windows("bench", window_ms=60_000)
     jax.block_until_ready(report.stats)
@@ -1012,6 +1061,18 @@ def _t_query(jax, ctx) -> Dict:
 # aggregation: medians + per-trial raw values + spreads
 # ---------------------------------------------------------------------------
 
+def _latency_fetch(ctx, lat_trials: List[Dict]) -> Dict:
+    """Per-offer D2H accounting over every measured latency offer."""
+    offers = sum(t["offers"] for t in lat_trials)
+    fetches = sum(t["d2h_fetches"] for t in lat_trials)
+    nbytes = sum(t["d2h_bytes"] for t in lat_trials)
+    return {
+        "d2h_fetches_per_offer": round(fetches / offers, 4) if offers else 0,
+        "d2h_bytes_per_offer": round(nbytes / offers, 1) if offers else 0,
+        "lane_capacity": int(ctx["lat_engine"].alert_lane_capacity),
+    }
+
+
 def _aggregate(jax, ctx, trials: Dict[str, List[Dict]],
                trials_n: int) -> Dict:
     BATCH, N_REGISTERED = ctx["BATCH"], ctx["N_REGISTERED"]
@@ -1128,6 +1189,13 @@ def _aggregate(jax, ctx, trials: Dict[str, List[Dict]],
             round(sorted(t["lat_s"])[int(len(t["lat_s"]) * 0.99)] * 1000, 3)
             for t in trials["latency"]],
         "latency_mode": ctx["lat_config"],
+        # fetch-budget evidence: the lane materializer must ship exactly
+        # ONE fixed-shape D2H fetch per offer, bytes bounded by the lane
+        # capacity (perf_gate latency_fetch_budget pins both)
+        "latency_fetch": _latency_fetch(ctx, trials["latency"]),
+        # lane path vs pre-lane mask-scan reference, same flush, this
+        # host (built once at _build; the >= 3x acceptance number)
+        "materialize_lane_speedup_x": round(ctx["materialize_speedup"], 2),
         "telemetry_packed_events_per_sec": round(_median(telemetry), 1),
         "telemetry_wire_rows": ctx["telemetry_rows"],
         "telemetry_wire_bytes_per_event": ctx["telemetry_rows"] * 4,

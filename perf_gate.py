@@ -101,6 +101,13 @@ MAX_UNACCOUNTED_PCT = 25.0
 # tier's measured p99 (offer -> linger -> pack -> H2D -> step -> alerts)
 LATENCY_BUDGET_MS = 10.0
 
+# Device-compacted alert lanes pin the latency tier's materialize path to
+# ONE fixed-shape D2H fetch per offer, sized lane_capacity slots of
+# ALERT_LANE_ROWS int32 rows (ops/compact.py). A regression back to
+# per-array fetches (or a fatter lane layout) fails this on ANY host —
+# fetch count and bytes are workload facts, not link weather.
+ALERT_LANE_BYTES_PER_SLOT = 16
+
 # Trial-spread bounds: full scale judges the accelerator-scale claim; the
 # BENCH_SCALE=small smoke still EVALUATES the check (bench's sections now
 # measure steady-state windows with explicit warmup exclusion, so the
@@ -271,6 +278,22 @@ def self_consistency(bench: Dict) -> Dict:
                 "ok": best <= LATENCY_BUDGET_MS,
                 "best_trial_p99_ms": best,
                 "trial_p99_ms": trial_p99, "budget_ms": LATENCY_BUDGET_MS}
+    # Fetch budget: the latency tier's materialize path must perform
+    # exactly 1 fixed-shape D2H fetch per offer, bytes bounded by the
+    # lane capacity — self-consistent on every host, fast or slow link
+    # alike (absent from rounds before the lanes existed: no check).
+    fetch = bench.get("latency_fetch")
+    if isinstance(fetch, dict):
+        fpo = fetch.get("d2h_fetches_per_offer")
+        bpo = fetch.get("d2h_bytes_per_offer")
+        cap = fetch.get("lane_capacity")
+        if all(isinstance(v, (int, float)) for v in (fpo, bpo, cap)):
+            max_bytes = cap * ALERT_LANE_BYTES_PER_SLOT
+            checks["latency_fetch_budget"] = {
+                "ok": fpo == 1 and bpo <= max_bytes,
+                "d2h_fetches_per_offer": fpo,
+                "d2h_bytes_per_offer": bpo,
+                "max_bytes_per_offer": max_bytes}
     # Spread judged against the steady-state windows at every scale; the
     # BENCH_SCALE=small smoke gets the wider bound (sub-millisecond CPU
     # section timings ride scheduler noise on shared CI hosts).

@@ -78,7 +78,8 @@ def _build_instance(cfg, mesh=None):
             if cfg.get("persist.checkpoint_interval_s") is not None
             else None),
         latency_linger_ms=(float(cfg.get("pipeline.linger_ms"))
-                           if mode == "latency" else None))
+                           if mode == "latency" else None),
+        latency_adaptive=bool(cfg.get("pipeline.adaptive_linger")))
 
 
 def _apply_rule_config(instance, cfg) -> None:

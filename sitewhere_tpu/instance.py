@@ -56,7 +56,8 @@ class SiteWhereInstance(LifecycleComponent):
                  mesh=None,
                  tenant_datastores: Optional[Dict] = None,
                  checkpoint_interval_s: Optional[float] = None,
-                 latency_linger_ms: Optional[float] = None):
+                 latency_linger_ms: Optional[float] = None,
+                 latency_adaptive: bool = True):
         super().__init__(f"instance:{instance_id}")
         self.instance_id = instance_id
         self.data_dir = data_dir
@@ -121,12 +122,16 @@ class SiteWhereInstance(LifecycleComponent):
         # latency tier (pipeline.mode="latency"): one shared adaptive
         # batcher coalesces every tenant's hot events and flushes on fill
         # or linger (pipeline/feed.py) — inbound consumers offer to it
-        # instead of packing per-poll batches
+        # instead of packing per-poll batches. Adaptive linger (default)
+        # dispatches a complete offered burst immediately; linger_ms then
+        # only bounds coalescing behind an in-flight flush
+        # (pipeline.adaptive_linger turns the classic fixed linger back on)
         self.latency_batcher = None
         if latency_linger_ms is not None and self.pipeline_engine is not None:
             from sitewhere_tpu.pipeline.feed import AdaptiveBatcher
             self.latency_batcher = AdaptiveBatcher(
-                self.pipeline_engine, linger_ms=latency_linger_ms)
+                self.pipeline_engine, linger_ms=latency_linger_ms,
+                adaptive=latency_adaptive)
 
         # global (non-multitenant) managements — reference:
         # service-user-management / service-tenant-management

@@ -1,0 +1,149 @@
+"""On-device alert-lane compaction: prefix-sum pack of fired rows.
+
+The latency tier's floor is set by D2H round trips, not compute: on a
+tunneled runtime every separate fetch is its own ~100 ms round trip when
+the link's burst bucket is drained (docs/PERF.md), and the pre-lane
+materializer shipped six per-row arrays (two phases on big batches) to
+find the handful of rows that actually fired. The tf.data / pipelined-
+execution principle (arXiv:2101.12127, arXiv:1908.09291) — move the data
+reduction to where the data lives — applied to the *output* side of the
+fused step: a prefix-sum over the fired mask packs fired rows into
+fixed-capacity lanes INSIDE the jit, so alert materialization ships one
+fixed-shape, lane-capacity-sized int32 array per step regardless of
+batch size.
+
+Lane layout ([ALERT_LANE_ROWS, K] int32; slot i = i-th fired row in
+batch-row order, so materialization order matches a mask scan exactly):
+
+  row 0 (idx):   batch-row index of the fired row; -1 in unused slots
+  row 1 (rules): threshold first_rule in bits 0-15, geofence first_rule
+                 in bits 16-31 (int16 two's complement; -1 = none)
+  row 2 (meta):  threshold alert_level bits 0-7 | geofence alert_level
+                 bits 8-15 | threshold_fired bit 16 | geofence_fired
+                 bit 17 (levels are only meaningful under their fired bit)
+  row 3 (counts): [0] = fired rows this step (INCLUDING rows beyond
+                 capacity), [1] = alerts dropped by lane overflow (each
+                 fired rule family on a row beyond capacity counts one),
+                 [2] = total alerts fired (mirrors ProcessOutputs.alerts)
+
+Overflow contract: rows beyond the K capacity are counted on device
+(counts[1]) and surface on the engine's `alerts_dropped` — an alert
+storm degrades to bounded delivery with loud accounting, never silent
+loss of the count. Capacity is a compile-time constant (one cached jit
+program per capacity, like every other static shape here).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict
+
+import numpy as np
+
+ALERT_LANE_ROWS = 4
+# bytes each lane slot costs on the wire (ALERT_LANE_ROWS int32 rows) —
+# the perf gate's fetch-size budget is capacity * this
+ALERT_LANE_BYTES_PER_SLOT = ALERT_LANE_ROWS * 4
+DEFAULT_ALERT_LANE_CAPACITY = 128
+# counts ride slots 0..2 of the counts row
+MIN_ALERT_LANE_CAPACITY = 4
+
+_THR_FIRED_BIT = 16
+_GEO_FIRED_BIT = 17
+
+
+def compact_alert_lanes(thr: Dict, geo: Dict, capacity: int):
+    """Pack the step's fired rows into alert lanes (jax, call under jit).
+
+    `thr`/`geo` are the eval_threshold_rules / eval_geofence_rules output
+    dicts (fired/first_rule/alert_level, all [B]). Returns the
+    [ALERT_LANE_ROWS, capacity] int32 lane array described above. Works
+    per shard under shard_map (row indices are shard-local).
+    """
+    import jax.numpy as jnp
+
+    if capacity < MIN_ALERT_LANE_CAPACITY:
+        raise ValueError(
+            f"alert lane capacity {capacity} < {MIN_ALERT_LANE_CAPACITY}")
+    fired = thr["fired"] | geo["fired"]                       # bool [B]
+    B = fired.shape[0]
+    fired_i = fired.astype(jnp.int32)
+    rank = jnp.cumsum(fired_i) - 1                            # 0-based
+    keep = fired & (rank < capacity)
+    # out-of-capacity rows scatter to index `capacity` -> dropped by the
+    # OOB mode; kept ranks are unique by construction
+    slot = jnp.where(keep, rank, capacity)
+    idx_lane = jnp.full((capacity,), -1, jnp.int32).at[slot].set(
+        jnp.arange(B, dtype=jnp.int32), mode="drop")
+    rules = ((thr["first_rule"] & 0xFFFF)
+             | ((geo["first_rule"] & 0xFFFF) << 16))
+    rules_lane = jnp.zeros((capacity,), jnp.int32).at[slot].set(
+        rules, mode="drop")
+    meta = ((thr["alert_level"] & 0xFF)
+            | ((geo["alert_level"] & 0xFF) << 8)
+            | (thr["fired"].astype(jnp.int32) << _THR_FIRED_BIT)
+            | (geo["fired"].astype(jnp.int32) << _GEO_FIRED_BIT))
+    meta_lane = jnp.zeros((capacity,), jnp.int32).at[slot].set(
+        meta, mode="drop")
+    alerts_of = thr["fired"].astype(jnp.int32) + geo["fired"].astype(
+        jnp.int32)                                            # 0..2 per row
+    total_alerts = jnp.sum(alerts_of)
+    kept_alerts = jnp.sum(jnp.where(keep, alerts_of, 0))
+    counts_lane = (jnp.zeros((capacity,), jnp.int32)
+                   .at[0].set(jnp.sum(fired_i))
+                   .at[1].set(total_alerts - kept_alerts)
+                   .at[2].set(total_alerts))
+    return jnp.stack([idx_lane, rules_lane, meta_lane, counts_lane])
+
+
+@dataclass
+class DecodedAlertLanes:
+    """Host-side view of one lane array's used slots (all arrays [n])."""
+
+    rows: np.ndarray        # int32 batch-row indices, ascending
+    thr_fired: np.ndarray   # bool
+    geo_fired: np.ndarray   # bool
+    thr_rule: np.ndarray    # int32 (sign-extended; -1 = none)
+    geo_rule: np.ndarray    # int32
+    thr_level: np.ndarray   # int32 (meaningful only where thr_fired)
+    geo_level: np.ndarray   # int32
+    fired_rows: int         # total fired rows incl. overflow
+    dropped_alerts: int     # alerts lost to lane overflow
+    total_alerts: int
+
+    @property
+    def n(self) -> int:
+        return int(self.rows.shape[0])
+
+    def head(self, n: int) -> "DecodedAlertLanes":
+        """First `n` slots (max_alerts bounding; counts untouched)."""
+        return DecodedAlertLanes(
+            rows=self.rows[:n], thr_fired=self.thr_fired[:n],
+            geo_fired=self.geo_fired[:n], thr_rule=self.thr_rule[:n],
+            geo_rule=self.geo_rule[:n], thr_level=self.thr_level[:n],
+            geo_level=self.geo_level[:n], fired_rows=self.fired_rows,
+            dropped_alerts=self.dropped_alerts,
+            total_alerts=self.total_alerts)
+
+
+def decode_alert_lanes(lanes: np.ndarray) -> DecodedAlertLanes:
+    """Inverse of compact_alert_lanes on the fetched host copy (numpy)."""
+    lanes = np.asarray(lanes)
+    capacity = lanes.shape[-1]
+    counts = lanes[3]
+    fired_rows = int(counts[0])
+    n = min(fired_rows, capacity)
+    rules = lanes[1, :n]
+    meta = lanes[2, :n]
+    return DecodedAlertLanes(
+        rows=lanes[0, :n],
+        thr_fired=((meta >> _THR_FIRED_BIT) & 1).astype(bool),
+        geo_fired=((meta >> _GEO_FIRED_BIT) & 1).astype(bool),
+        # int32 arithmetic shifts sign-extend the int16 halves exactly
+        thr_rule=(rules << 16) >> 16,
+        geo_rule=rules >> 16,
+        thr_level=meta & 0xFF,
+        geo_level=(meta >> 8) & 0xFF,
+        fired_rows=fired_rows,
+        dropped_alerts=int(counts[1]),
+        total_alerts=int(counts[2]))

@@ -260,7 +260,10 @@ class ShardedPipelineEngine(PipelineEngine):
             threshold_first_rule=dev, threshold_alert_level=dev,
             geofence_fired=dev, geofence_first_rule=dev,
             geofence_alert_level=dev, tenant_counts=rep, processed=rep,
-            alerts=rep)
+            alerts=rep,
+            # per-shard compacted alert lanes ride the shard axis with
+            # the other outputs — no extra collective, one host fetch
+            alert_lanes=dev)
 
         def sq(a):
             # shard_map hands blocks with the mapped axis kept (size 1); the
@@ -279,7 +282,8 @@ class ShardedPipelineEngine(PipelineEngine):
             state = jax.tree_util.tree_map(sq, state)
             batch = blob_to_batch(sq(blob))          # [12, B] -> columns
             new_state, out = process_batch(
-                params, state, batch, geofence_impl=self.geofence_impl)
+                params, state, batch, geofence_impl=self.geofence_impl,
+                alert_lane_capacity=self.alert_lane_capacity)
             new_state = jax.tree_util.tree_map(unsq, new_state)
             out = out.replace(
                 valid=unsq(out.valid), unregistered=unsq(out.unregistered),
@@ -289,6 +293,7 @@ class ShardedPipelineEngine(PipelineEngine):
                 geofence_fired=unsq(out.geofence_fired),
                 geofence_first_rule=unsq(out.geofence_first_rule),
                 geofence_alert_level=unsq(out.geofence_alert_level),
+                alert_lanes=unsq(out.alert_lanes),
                 tenant_counts=jax.lax.psum(out.tenant_counts, SHARD_AXIS),
                 processed=jax.lax.psum(out.processed, SHARD_AXIS),
                 alerts=jax.lax.psum(out.alerts, SHARD_AXIS))
@@ -537,45 +542,61 @@ class ShardedPipelineEngine(PipelineEngine):
                             outputs: ProcessOutputs,
                             max_alerts: Optional[int] = None
                             ) -> List[DeviceAlert]:
-        """Flatten [S, B] rows back to a flat batch with GLOBAL device indices
-        and reuse the base materializer. Accepts the lazy RoutedBlobView
-        (sharded submit's return) or a plain routed EventBatch; nothing
-        unpacks when no rule fired. Under multi-process feeding the view
-        holds only local shard blocks — each host materializes the alerts
-        of its own devices."""
+        """Materialize from the per-shard compacted alert lanes: ONE
+        fixed-shape [S, ALERT_LANE_ROWS, K] fetch for the whole mesh
+        (the lanes travel shard-axis-sharded with the existing outputs —
+        no extra collective). Shards decode shard-major, rows ascending
+        within a shard, so the alert order matches the flattened mask
+        scan exactly. Accepts the lazy RoutedBlobView (sharded submit's
+        return) or a plain routed EventBatch; the wire blob only unpacks
+        when something actually fired. Under multi-process feeding the
+        lanes gather local shard blocks only — each host materializes the
+        alerts of its own devices."""
+        from sitewhere_tpu.ops.compact import (
+            DecodedAlertLanes, decode_alert_lanes)
+
         shard_ids = None
         if isinstance(routed_batch, RoutedBlobView):
             shard_ids = routed_batch.shard_ids
-        per_row = ("valid", "unregistered", "threshold_fired",
-                   "threshold_first_rule", "threshold_alert_level",
-                   "geofence_fired", "geofence_first_rule",
-                   "geofence_alert_level")
         if self.is_multiprocess:
-            out_np = {name: self._gather_local(getattr(outputs, name))
-                      for name in per_row}
+            lanes = self._gather_local(outputs.alert_lanes)
         else:
-            out_np = {name: np.asarray(getattr(outputs, name))
-                      for name in per_row}
-        if (not out_np["threshold_fired"].any()
-                and not out_np["geofence_fired"].any()):
+            lanes = jax.device_get(outputs.alert_lanes)  # [S, ROWS, K]
+        self.d2h_fetches += 1
+        self.d2h_bytes += lanes.nbytes
+        decs = [decode_alert_lanes(lanes[s]) for s in range(lanes.shape[0])]
+        self._account_lane_overflow(sum(d.dropped_alerts for d in decs))
+        if not any(d.n for d in decs):
             return []
         if isinstance(routed_batch, RoutedBlobView):
             routed_batch = routed_batch.batch
-        S_rows, B = routed_batch.valid.shape
+        dev = np.asarray(routed_batch.device_idx)        # [S_rows, B]
+        ts = np.asarray(routed_batch.ts)
+        S_rows, B = dev.shape
         ids = (np.arange(S_rows, dtype=np.int32) if shard_ids is None
                else np.array(shard_ids, np.int32))
-        shard_of_row = np.repeat(ids, B)
-
-        def flat(a):
-            a = np.asarray(a)
-            return a.reshape((S_rows * B,) + a.shape[2:])
-
-        flat_batch = jax.tree_util.tree_map(flat, routed_batch)
-        flat_batch = flat_batch.replace(
-            device_idx=flat_batch.device_idx * self.n_shards + shard_of_row)
-        flat_out = outputs.replace(
-            **{name: flat(out_np[name]) for name in per_row})
-        return super().materialize_alerts(flat_batch, flat_out, max_alerts)
+        # shard-major flat rows + the per-row GLOBAL device remap
+        # (local index l on shard s is global l * S + s)
+        rows_flat = np.concatenate(
+            [s * B + d.rows for s, d in enumerate(decs)])
+        shard_of = np.concatenate(
+            [np.full(d.n, ids[s], np.int32) for s, d in enumerate(decs)])
+        combined = DecodedAlertLanes(
+            rows=rows_flat,
+            thr_fired=np.concatenate([d.thr_fired for d in decs]),
+            geo_fired=np.concatenate([d.geo_fired for d in decs]),
+            thr_rule=np.concatenate([d.thr_rule for d in decs]),
+            geo_rule=np.concatenate([d.geo_rule for d in decs]),
+            thr_level=np.concatenate([d.thr_level for d in decs]),
+            geo_level=np.concatenate([d.geo_level for d in decs]),
+            fired_rows=sum(d.fired_rows for d in decs),
+            dropped_alerts=sum(d.dropped_alerts for d in decs),
+            total_alerts=sum(d.total_alerts for d in decs))
+        dev_rows = (dev.reshape(-1)[rows_flat] * self.n_shards + shard_of)
+        ts_rows = ts.reshape(-1)[rows_flat]
+        bounded = self._bound_alert_rows(combined, max_alerts)
+        n = bounded.n
+        return self._emit_alerts(bounded, dev_rows[:n], ts_rows[:n])
 
     # -- reads ----------------------------------------------------------------
 
@@ -633,13 +654,13 @@ class ShardedPipelineEngine(PipelineEngine):
             missing_np = np.asarray(newly_missing)
             shard_ids = np.arange(self.n_shards, dtype=np.int32)
         rows, locals_ = np.nonzero(missing_np)
-        tokens = []
-        for r, l in zip(rows, locals_):
-            token = self.registry.devices.token_of(
-                int(l) * self.n_shards + int(shard_ids[r]))
-            if token is not None:
-                tokens.append(token)
-        return tokens
+        if rows.size == 0:
+            return []
+        # vectorized: global index = local * S + shard, one fancy index
+        # into the cached token array (no per-row token_of loop)
+        global_idx = locals_ * self.n_shards + shard_ids[rows]
+        tokens = self.registry.devices.token_array()[global_idx].tolist()
+        return [t for t in tokens if t]
 
     # -- elastic checkpoint layout ----------------------------------------
 

@@ -36,6 +36,63 @@ from sitewhere_tpu.runtime.metrics import GLOBAL_METRICS
 
 _NEG = -(2 ** 31)
 
+# int -> AlertLevel member (enum __call__ costs ~1 us/row in a storm;
+# the materialize hot loop indexes this instead)
+_ALERT_LEVELS = {int(level): level for level in AlertLevel}
+
+
+def materialize_alerts_maskscan(engine, batch, outputs,
+                                ) -> List[DeviceAlert]:
+    """The pre-lane mask-scan materializer, kept verbatim as the
+    differential-test oracle and micro-bench reference for the
+    device-compacted alert lanes (docs/ALERT_LANES.md): fetch the six
+    per-row mask/level/rule arrays (two phases on big batches), nonzero
+    the fired mask on the host, and walk fired rows with per-row
+    `token_of` lookups. Flat batches/outputs only (the sharded engine
+    flattens before delegating — tests do the same); returns ALL fired
+    rows' alerts and never touches engine counters or pending stashes."""
+    small_batch = outputs.threshold_fired.size <= 16384
+    if small_batch:
+        (thr_fired, geo_fired, thr_level, geo_level, thr_rule,
+         geo_rule) = jax.device_get(
+            (outputs.threshold_fired, outputs.geofence_fired,
+             outputs.threshold_alert_level, outputs.geofence_alert_level,
+             outputs.threshold_first_rule, outputs.geofence_first_rule))
+    else:
+        thr_fired, geo_fired = jax.device_get(
+            (outputs.threshold_fired, outputs.geofence_fired))
+    fired_rows = np.nonzero(thr_fired | geo_fired)[0]
+    if fired_rows.size == 0:
+        return []
+    if not small_batch:
+        thr_level, geo_level, thr_rule, geo_rule = jax.device_get(
+            (outputs.threshold_alert_level, outputs.geofence_alert_level,
+             outputs.threshold_first_rule, outputs.geofence_first_rule))
+    device_idx = np.asarray(batch.device_idx)
+    ts = np.asarray(batch.ts)
+    rules = engine.list_rules()
+    thr_rules, geo_rules = rules["threshold"], rules["geofence"]
+    alerts: List[DeviceAlert] = []
+    for row in fired_rows:
+        token = engine.registry.devices.token_of(int(device_idx[row])) or ""
+        if thr_fired[row] and 0 <= thr_rule[row] < len(thr_rules):
+            rule = thr_rules[int(thr_rule[row])]
+            alerts.append(DeviceAlert(
+                device_id=token, source=AlertSource.SYSTEM,
+                level=AlertLevel(int(thr_level[row])), type=rule.alert_type,
+                message=rule.alert_message
+                or f"threshold rule {rule.token} fired",
+                event_date=engine.packer.abs_ts(int(ts[row]))))
+        if geo_fired[row] and 0 <= geo_rule[row] < len(geo_rules):
+            rule = geo_rules[int(geo_rule[row])]
+            alerts.append(DeviceAlert(
+                device_id=token, source=AlertSource.SYSTEM,
+                level=AlertLevel(int(geo_level[row])), type=rule.alert_type,
+                message=rule.alert_message
+                or f"geofence rule {rule.token} fired",
+                event_date=engine.packer.abs_ts(int(ts[row]))))
+    return alerts
+
 
 @dataclass
 class ThresholdRule:
@@ -149,7 +206,11 @@ class PipelineEngine(LifecycleComponent):
                  measurement_slots: int = 32, max_tenants: int = 16,
                  max_threshold_rules: int = 256, max_geofence_rules: int = 256,
                  presence_missing_interval_ms: int = 8 * 60 * 60 * 1000,
-                 name: str = "pipeline-engine", geofence_impl: str = "auto"):
+                 name: str = "pipeline-engine", geofence_impl: str = "auto",
+                 alert_lane_capacity: Optional[int] = None):
+        from sitewhere_tpu.ops.compact import (
+            DEFAULT_ALERT_LANE_CAPACITY, MIN_ALERT_LANE_CAPACITY)
+
         super().__init__(name)
         self.registry = registry_tensors
         self.batch_size = batch_size
@@ -157,6 +218,16 @@ class PipelineEngine(LifecycleComponent):
         self.measurement_slots = measurement_slots
         self.max_threshold_rules = max_threshold_rules
         self.max_geofence_rules = max_geofence_rules
+        # rule ids travel in int16 halves of the alert-lane rules row
+        if max(max_threshold_rules, max_geofence_rules) >= (1 << 15):
+            raise ValueError("rule table capacity must be < 32768 "
+                             "(alert-lane rule-id field width)")
+        self.alert_lane_capacity = (alert_lane_capacity
+                                    if alert_lane_capacity is not None
+                                    else DEFAULT_ALERT_LANE_CAPACITY)
+        if self.alert_lane_capacity < MIN_ALERT_LANE_CAPACITY:
+            raise ValueError(
+                f"alert_lane_capacity must be >= {MIN_ALERT_LANE_CAPACITY}")
         self.presence_missing_interval_ms = presence_missing_interval_ms
         self.packer = EventPacker(batch_size, registry_tensors.devices)
 
@@ -186,12 +257,20 @@ class PipelineEngine(LifecycleComponent):
             geofence_impl, self._target_platform())
         def step_blob(params, state, blob):
             return process_batch(params, state, blob_to_batch(blob),
-                                 geofence_impl=self.geofence_impl)
+                                 geofence_impl=self.geofence_impl,
+                                 alert_lane_capacity=self.alert_lane_capacity)
 
         self._step_blob = jax.jit(step_blob, donate_argnums=(1,))
         self._presence = jax.jit(check_presence, donate_argnums=(0,))
         self.batches_processed = 0
-        self.alerts_dropped = 0  # only when a caller bounds materialization
+        # bounded materialization (max_alerts) AND alert-lane overflow
+        # (> capacity fired rows in one step) both count here
+        self.alerts_dropped = 0
+        # D2H materialization accounting: how many fetches / bytes the
+        # alert path ships per step — the latency tier's fetch budget
+        # (perf_gate latency_fetch_budget) reads the per-offer deltas
+        self.d2h_fetches = 0
+        self.d2h_bytes = 0
         # alerts stashed outside the submit->materialize cycle (overflow
         # restored from a checkpoint, restored manifests): drained by the
         # next materialize_alerts, persisted by checkpoint save
@@ -497,76 +576,108 @@ class PipelineEngine(LifecycleComponent):
     def materialize_alerts(self, batch: EventBatch, outputs: ProcessOutputs,
                            max_alerts: Optional[int] = None
                            ) -> List[DeviceAlert]:
-        """Turn fired-rule masks back into API-level DeviceAlert events
-        (host-side; only fired rows cross the host boundary).
+        """Turn the step's device-compacted alert lanes back into
+        API-level DeviceAlert events.
 
-        All fired rows materialize by default. A `max_alerts` bound no
-        longer drops the tail silently (an alert storm is exactly when
-        alerts matter): overflow is counted on `alerts_dropped`, surfaced
-        as a metric, and logged."""
+        On a tunneled runtime fetch count and fetch bytes — not compute —
+        set the latency floor (~100 ms per round trip when the link's
+        burst bucket is drained; docs/PERF.md), so the step packs fired
+        rows into fixed-capacity lanes ON DEVICE (ops/compact.py) and
+        this ships exactly ONE fixed-shape, lane-sized fetch per step
+        regardless of batch size — replacing the six-array / two-phase
+        fetch. Device tokens resolve through the interner's cached token
+        array (one fancy-index, no per-row Python lookups).
+
+        A `max_alerts` bound and lane overflow (> capacity fired rows)
+        both count on `alerts_dropped`, surface as a metric, and log —
+        never a silent drop. Differential contract: the returned list is
+        exactly what the mask-scan reference (materialize_alerts_maskscan)
+        produces for the first `alert_lane_capacity` fired rows, order
+        included (tests/test_alert_lanes.py)."""
+        from sitewhere_tpu.ops.compact import decode_alert_lanes
+
         pending, self._pending_alerts = self._pending_alerts, []
-        # Batched D2H fetches: on a tunneled runtime each separate
-        # np.asarray is its own round trip (~100 ms each when the link's
-        # burst bucket is drained — measured round 5), so fetch count is
-        # the latency lever. Small batches (the latency tier) ship all six
-        # arrays in ONE RPC; large throughput batches fetch the two bool
-        # masks first (~B bytes each) and ship the four int32 level/rule
-        # arrays (~16B bytes total) only when something actually fired —
-        # the common no-alert step pays one small fetch, not ~2 MB.
-        small_batch = outputs.threshold_fired.size <= 16384
-        if small_batch:
-            (thr_fired, geo_fired, thr_level, geo_level, thr_rule,
-             geo_rule) = jax.device_get(
-                (outputs.threshold_fired, outputs.geofence_fired,
-                 outputs.threshold_alert_level,
-                 outputs.geofence_alert_level,
-                 outputs.threshold_first_rule,
-                 outputs.geofence_first_rule))
-        else:
-            thr_fired, geo_fired = jax.device_get(
-                (outputs.threshold_fired, outputs.geofence_fired))
-        fired_rows = np.nonzero(thr_fired | geo_fired)[0]
-        if max_alerts is not None and fired_rows.size > max_alerts:
-            dropped = int(fired_rows.size) - max_alerts
-            self.alerts_dropped += dropped
-            self._metrics.counter("alerts.dropped").inc(dropped)
-            import logging
-            logging.getLogger("sitewhere.pipeline").warning(
-                "alert storm: %d fired rows exceed max_alerts=%d; "
-                "dropping %d (alerts_dropped=%d total)",
-                fired_rows.size, max_alerts, dropped, self.alerts_dropped)
-            fired_rows = fired_rows[:max_alerts]
-        if fired_rows.size == 0:
+        lanes = jax.device_get(outputs.alert_lanes)  # THE one fetch
+        self.d2h_fetches += 1
+        self.d2h_bytes += lanes.nbytes
+        dec = decode_alert_lanes(lanes)
+        self._account_lane_overflow(dec.dropped_alerts)
+        dec = self._bound_alert_rows(dec, max_alerts)
+        if dec.n == 0:
             return pending
-        if not small_batch:
-            thr_level, geo_level, thr_rule, geo_rule = jax.device_get(
-                (outputs.threshold_alert_level,
-                 outputs.geofence_alert_level,
-                 outputs.threshold_first_rule,
-                 outputs.geofence_first_rule))
-        device_idx = np.asarray(batch.device_idx)
-        ts = np.asarray(batch.ts)
-        alerts: List[DeviceAlert] = []
+        rows = dec.rows
+        dev_rows = np.asarray(batch.device_idx)[rows]
+        ts_rows = np.asarray(batch.ts)[rows]
+        return pending + self._emit_alerts(dec, dev_rows, ts_rows)
+
+    def _account_lane_overflow(self, dropped: int) -> None:
+        if not dropped:
+            return
+        self.alerts_dropped += dropped
+        self._metrics.counter("alerts.dropped").inc(dropped)
+        import logging
+        logging.getLogger("sitewhere.pipeline").warning(
+            "alert-lane overflow: %d alerts beyond the %d-row lane "
+            "capacity dropped on device (alerts_dropped=%d total)",
+            dropped, self.alert_lane_capacity, self.alerts_dropped)
+
+    def _bound_alert_rows(self, dec, max_alerts: Optional[int]):
+        """Apply a caller's max_alerts bound to decoded lanes (row count,
+        matching the pre-lane contract) with the same loud accounting."""
+        if max_alerts is None or dec.n <= max_alerts:
+            return dec
+        dropped = dec.n - max_alerts
+        self.alerts_dropped += dropped
+        self._metrics.counter("alerts.dropped").inc(dropped)
+        import logging
+        logging.getLogger("sitewhere.pipeline").warning(
+            "alert storm: %d fired rows exceed max_alerts=%d; "
+            "dropping %d (alerts_dropped=%d total)",
+            dec.n, max_alerts, dropped, self.alerts_dropped)
+        return dec.head(max_alerts)
+
+    def _emit_alerts(self, dec, dev_rows: np.ndarray,
+                     ts_rows: np.ndarray) -> List[DeviceAlert]:
+        """DeviceAlert list for decoded lane slots. `dev_rows`/`ts_rows`
+        are the fired rows' device indices and relative timestamps;
+        everything vectorizable (tokens, dates, level enums) is resolved
+        by array ops before the per-alert object loop."""
         with self._lock:
             thr_rules = list(self._threshold_rules)
             geo_rules = list(self._geofence_rules)
-        for row in fired_rows:
-            token = self.registry.devices.token_of(int(device_idx[row])) or ""
-            if thr_fired[row] and 0 <= thr_rule[row] < len(thr_rules):
-                rule = thr_rules[int(thr_rule[row])]
+        tokens = self.registry.devices.token_array()[dev_rows].tolist()
+        dates = (ts_rows.astype(np.int64)
+                 + self.packer.epoch_base_ms).tolist()
+        thr_f = dec.thr_fired.tolist()
+        geo_f = dec.geo_fired.tolist()
+        thr_r = dec.thr_rule.tolist()
+        geo_r = dec.geo_rule.tolist()
+        thr_l = dec.thr_level.tolist()
+        geo_l = dec.geo_level.tolist()
+        n_thr, n_geo = len(thr_rules), len(geo_rules)
+        levels = _ALERT_LEVELS
+        alerts: List[DeviceAlert] = []
+        for i in range(dec.n):
+            token = tokens[i]
+            if thr_f[i] and 0 <= thr_r[i] < n_thr:
+                rule = thr_rules[thr_r[i]]
                 alerts.append(DeviceAlert(
                     device_id=token, source=AlertSource.SYSTEM,
-                    level=AlertLevel(int(thr_level[row])), type=rule.alert_type,
-                    message=rule.alert_message or f"threshold rule {rule.token} fired",
-                    event_date=self.packer.abs_ts(int(ts[row]))))
-            if geo_fired[row] and 0 <= geo_rule[row] < len(geo_rules):
-                rule = geo_rules[int(geo_rule[row])]
+                    level=levels.get(thr_l[i]) or AlertLevel(thr_l[i]),
+                    type=rule.alert_type,
+                    message=rule.alert_message
+                    or f"threshold rule {rule.token} fired",
+                    event_date=dates[i]))
+            if geo_f[i] and 0 <= geo_r[i] < n_geo:
+                rule = geo_rules[geo_r[i]]
                 alerts.append(DeviceAlert(
                     device_id=token, source=AlertSource.SYSTEM,
-                    level=AlertLevel(int(geo_level[row])), type=rule.alert_type,
-                    message=rule.alert_message or f"geofence rule {rule.token} fired",
-                    event_date=self.packer.abs_ts(int(ts[row]))))
-        return pending + alerts
+                    level=levels.get(geo_l[i]) or AlertLevel(geo_l[i]),
+                    type=rule.alert_type,
+                    message=rule.alert_message
+                    or f"geofence rule {rule.token} fired",
+                    event_date=dates[i]))
+        return alerts
 
     # -- presence -------------------------------------------------------------
 
@@ -580,8 +691,12 @@ class PipelineEngine(LifecycleComponent):
                 self._state, registered, now_rel,
                 np.int32(min(self.presence_missing_interval_ms, 2 ** 31 - 1)))
         rows = np.nonzero(np.asarray(newly_missing))[0]
-        return [t for t in (self.registry.devices.token_of(int(r)) for r in rows)
-                if t is not None]
+        if rows.size == 0:
+            return []
+        # vectorized token resolution (cached dense array, one fancy
+        # index) — "" marks unknown/gap slots
+        tokens = self.registry.devices.token_array()[rows].tolist()
+        return [t for t in tokens if t]
 
     # -- state reads ----------------------------------------------------------
 
@@ -676,10 +791,13 @@ class PipelineEngine(LifecycleComponent):
             lat, lon, elev = (float(x) for x in row.last_location)
             state.last_location = (self.packer.abs_ts(int(row.last_location_ts)),
                                    lat, lon, elev)
+        # cached dense slot -> name array instead of a token_of call per
+        # measurement slot (this runs per REST device-state read)
+        names = self.packer.measurements.token_array()
         for slot in range(self.measurement_slots):
             ts_slot = int(row.last_measurement_ts[slot])
             if ts_slot > _NEG:
-                name = self.packer.measurements.token_of(slot) or f"slot{slot}"
+                name = names[slot] or f"slot{slot}"
                 state.last_measurements[name] = (self.packer.abs_ts(ts_slot),
                                                  float(row.last_measurement[slot]))
         if int(row.last_alert_ts) > _NEG:

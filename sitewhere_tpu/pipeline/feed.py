@@ -489,15 +489,26 @@ class AdaptiveBatcher:
     ``pipeline.latency_batch_size``); an engine-per-mode is the TPU
     reality — batch size is a compiled shape, not a runtime knob.
 
+    ``adaptive=True`` turns on ADAPTIVE LINGER: an ``offer()`` delivers a
+    complete burst, so the flusher dispatches as soon as anything is
+    pending instead of always sleeping out the full linger window — the
+    window only coalesces offers that arrive while a flush is already in
+    flight (and ``linger_ms`` stays the fill-wait upper bound). On the
+    latency tier the linger sleep was the second-largest constant in the
+    end-to-end number after D2H fetches (docs/ALERT_LANES.md). Default
+    off: the classic fixed linger maximizes coalescing for bursty
+    multi-producer ingest.
+
     Kafka analog: linger.ms + batch.size on the reference's producers
     (the reference never surfaces an end-to-end latency tier; this
     exceeds it).
     """
 
     def __init__(self, engine, linger_ms: float = 2.0,
-                 max_rows: Optional[int] = None):
+                 max_rows: Optional[int] = None, adaptive: bool = False):
         self.engine = engine
         self.linger_s = max(0.0, linger_ms) / 1000.0
+        self.adaptive = adaptive
         self.max_rows = max_rows or engine.batch_size
         self._lock = threading.Condition()
         self._events: List = []
@@ -569,6 +580,11 @@ class AdaptiveBatcher:
             with self._lock:
                 while not self._stop.is_set():
                     if self._oldest is not None:
+                        # adaptive linger: pending offers are complete
+                        # bursts — dispatch now; coalescing happens
+                        # naturally while a flush is in flight
+                        if self.adaptive:
+                            break
                         wait = self._oldest + self.linger_s - time.monotonic()
                         if wait <= 0 or len(self._events) >= self.max_rows:
                             break

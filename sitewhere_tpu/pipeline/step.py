@@ -27,6 +27,9 @@ import jax.numpy as jnp
 from flax import struct
 
 from sitewhere_tpu.model.event import DeviceEventType
+from sitewhere_tpu.ops.compact import (
+    DEFAULT_ALERT_LANE_CAPACITY, compact_alert_lanes,
+)
 from sitewhere_tpu.ops.geofence import (
     GeofenceRuleTable, ZoneTable, eval_geofence_rules,
 )
@@ -72,16 +75,25 @@ class ProcessOutputs:
     tenant_counts: jnp.ndarray      # int32 [T] events this batch per tenant
     processed: jnp.ndarray          # int32 scalar, valid events
     alerts: jnp.ndarray             # int32 scalar, alerts fired
+    # device-compacted alert lanes (ops/compact.py): fired rows packed by
+    # prefix sum into a fixed [ALERT_LANE_ROWS, K] int32 array so alert
+    # materialization is ONE tiny fixed-shape D2H fetch per step — the
+    # per-row masks above stay for device-side consumers and tests; the
+    # host fast path never fetches them
+    alert_lanes: jnp.ndarray        # int32 [ALERT_LANE_ROWS, K]
 
 
 def process_batch(params: PipelineParams, state: DeviceStateTensors,
-                  batch: EventBatch, *, geofence_impl: str = "xla"
+                  batch: EventBatch, *, geofence_impl: str = "xla",
+                  alert_lane_capacity: int = DEFAULT_ALERT_LANE_CAPACITY
                   ) -> Tuple[DeviceStateTensors, ProcessOutputs]:
     """One fused step. Shapes static; jit/shard_map safe; donate `state`.
 
     `geofence_impl` selects the containment kernel ("xla" scan,
     "pallas" TPU kernel, "pallas_interpret" for CPU tests) — resolved by the
     engines via ops.geofence.resolve_geofence_impl.
+    `alert_lane_capacity` is the K of the compacted alert lanes (static;
+    one cached program per capacity like any other shape).
     """
     D = state.num_devices
     M = state.num_measurement_slots
@@ -146,6 +158,7 @@ def process_batch(params: PipelineParams, state: DeviceStateTensors,
     tenant_counts = count_by_key(tenant, valid, T)
     alerts = (jnp.sum(thr["fired"], dtype=jnp.int32)
               + jnp.sum(geo["fired"], dtype=jnp.int32))
+    alert_lanes = compact_alert_lanes(thr, geo, alert_lane_capacity)
 
     new_state = DeviceStateTensors(
         last_interaction=last_interaction,
@@ -175,6 +188,7 @@ def process_batch(params: PipelineParams, state: DeviceStateTensors,
         tenant_counts=tenant_counts,
         processed=jnp.sum(valid, dtype=jnp.int32),
         alerts=alerts,
+        alert_lanes=alert_lanes,
     )
     return new_state, outputs
 

@@ -66,6 +66,11 @@ class TokenInterner:
         # a valid cache key for snapshot consumers — a checkpoint restore
         # can swap same-length contents.
         self.version = 0
+        # cached dense index -> token array (token_array); rebuilt lazily
+        # when version moves — hot-path materialization fancy-indexes it
+        # instead of calling token_of per row
+        self._token_array: Optional[np.ndarray] = None
+        self._token_array_version = -1
         nat = _native()
         self._nat = nat.NativeInterner(capacity) if nat else None
 
@@ -170,6 +175,27 @@ class TokenInterner:
         if 0 < index < len(self._to_token):
             return self._to_token[index]
         return None
+
+    def token_array(self) -> np.ndarray:
+        """Dense [capacity] object array: index -> token, "" for UNKNOWN,
+        gaps, and never-assigned slots. Cached and rebuilt only when the
+        interner version moves, so hot paths (alert materialization,
+        presence sweeps) resolve many indices with one fancy-index
+        instead of a per-row Python `token_of` loop. The returned array
+        is shared — callers must not mutate it."""
+        with self._lock:
+            if (self._token_array is not None
+                    and self._token_array_version == self.version):
+                return self._token_array
+            arr = np.empty(self.capacity, object)
+            arr[:] = ""
+            for i in range(1, len(self._to_token)):
+                token = self._to_token[i]
+                if token is not None:
+                    arr[i] = token
+            self._token_array = arr
+            self._token_array_version = self.version
+            return arr
 
     def lookup_batch(self, tokens: Sequence[str]) -> np.ndarray:
         """Vectorized lookup of many tokens -> int32 array (no allocation)."""

@@ -177,6 +177,11 @@ DEFAULTS: Dict = {
         "mode": "throughput",
         "latency_batch_size": 4096,
         "linger_ms": 2.0,
+        # adaptive linger (pipeline/feed.py AdaptiveBatcher): dispatch a
+        # complete offered burst immediately; linger_ms only bounds
+        # coalescing behind an in-flight flush. False = classic fixed
+        # linger (maximize coalescing for bursty multi-producer ingest)
+        "adaptive_linger": True,
         "max_devices": 131072,
         "max_zones": 256,
         "max_zone_vertices": 32,

@@ -506,3 +506,44 @@ class TestAdaptiveBatcher:
         assert outputs is not None
         with pytest.raises(RuntimeError):
             batcher.offer([DeviceMeasurement(name="m", value=1.0)], ["d0"])
+
+
+class TestAdaptiveLinger:
+    """adaptive=True: a complete offered burst dispatches immediately —
+    the linger window never adds latency to an idle batcher; coalescing
+    still happens behind an in-flight flush."""
+
+    def _mk(self, **kw):
+        from sitewhere_tpu.pipeline.feed import AdaptiveBatcher
+        _, tensors = _world()
+        engine = _engine(tensors, batch_size=32)
+        return engine, AdaptiveBatcher(engine, adaptive=True, **kw)
+
+    def test_burst_dispatches_without_sleeping_out_linger(self):
+        import time
+        engine, batcher = self._mk(linger_ms=5_000.0)
+        # warm: first flush pays the jit compile, not the linger
+        batcher.warm([DeviceMeasurement(name="m", value=150.0)], ["d0"])
+        events = [DeviceMeasurement(name="m", value=150.0 + i)
+                  for i in range(4)]
+        t0 = time.perf_counter()
+        fut = batcher.offer(events, [f"d{i}" for i in range(4)])
+        pairs = fut.result(timeout=120.0)
+        waited = time.perf_counter() - t0
+        # a 5 s linger must NOT be slept out (generous CI bound)
+        assert waited < 4.0
+        [(batch, outputs)] = pairs
+        outputs.processed.block_until_ready()
+        assert len(engine.materialize_alerts(batch, outputs)) == 4
+        batcher.close()
+
+    def test_alerts_and_close_semantics_unchanged(self):
+        engine, batcher = self._mk(linger_ms=10_000.0)
+        fut = batcher.offer([DeviceMeasurement(name="m", value=150.0)],
+                            ["d0"])
+        [(batch, outputs)] = fut.result(timeout=120.0)
+        outputs.processed.block_until_ready()
+        assert len(engine.materialize_alerts(batch, outputs)) == 1
+        batcher.close()
+        with pytest.raises(RuntimeError):
+            batcher.offer([DeviceMeasurement(name="m", value=1.0)], ["d0"])

@@ -158,7 +158,13 @@ def test_compact_result_line_parses_and_fits_tail_capture():
         "system_sustained_events_per_sec": 1.23e6,
         "latency_mode_p50_ms": 3.2, "latency_mode_p99_ms": 8.9,
         "latency_mode_trial_p99_ms": [112.4, 4.2, 97.0],
-        "latency_mode": "adaptive",
+        "latency_mode": {"batch_size": 4096, "linger_ms": 1.0,
+                         "adaptive_linger": True, "warm_flushes": 4,
+                         "trial_warmup_offers": 2},
+        "latency_fetch": {"d2h_fetches_per_offer": 1.0,
+                          "d2h_bytes_per_offer": 2048.0,
+                          "lane_capacity": 128},
+        "materialize_lane_speedup_x": 12.34,
         "telemetry_wire_bytes_per_event": 13.7,
         "analytics_replay_events_per_sec": 1.0e7,
         "sharded_from_bytes_events_per_sec": 2.1e7,
@@ -298,6 +304,35 @@ def test_latency_budget_check():
     small_ok["latency_mode_trial_p99_ms"] = [112.4, 4.2, 97.0]
     small_ok["scale"] = "small"
     assert self_consistency(small_ok)["ok"]
+
+
+def test_latency_fetch_budget_check():
+    """The latency tier must ship exactly ONE fixed-shape D2H fetch per
+    offer, bytes bounded by lane capacity x lane bytes — a regression to
+    per-array fetches fails loudly on any host, any link state."""
+    ok = _bench()
+    ok["latency_fetch"] = {"d2h_fetches_per_offer": 1.0,
+                           "d2h_bytes_per_offer": 2048.0,
+                           "lane_capacity": 128}
+    out = self_consistency(ok)
+    assert out["ok"]
+    assert out["checks"]["latency_fetch_budget"]["ok"]
+    assert out["checks"]["latency_fetch_budget"][
+        "max_bytes_per_offer"] == 128 * 16
+    # a second fetch per offer (regression to per-array fetching) fails
+    bad = _bench()
+    bad["latency_fetch"] = {"d2h_fetches_per_offer": 2.0,
+                            "d2h_bytes_per_offer": 2048.0,
+                            "lane_capacity": 128}
+    assert not self_consistency(bad)["ok"]
+    # fatter-than-budget bytes fail even at one fetch
+    fat = _bench()
+    fat["latency_fetch"] = {"d2h_fetches_per_offer": 1.0,
+                            "d2h_bytes_per_offer": 128 * 16 + 4,
+                            "lane_capacity": 128}
+    assert not self_consistency(fat)["ok"]
+    # rounds recorded before the lanes existed have nothing to check
+    assert self_consistency(_bench())["ok"]
 
 
 def test_cli_exit_codes(tmp_path, capsys):
