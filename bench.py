@@ -81,6 +81,12 @@ def _compact_result(result: Dict, detail_path) -> Dict:
     keys, the host fingerprint, the steady-state latency evidence, the
     self-consistency inputs — plus a pointer to the full sidecar."""
     out = {k: result[k] for k in _COMPACT_KEYS if k in result}
+    rp = result.get("rule_programs") or {}
+    # only the gate-relevant fields ride the compact line (the byte
+    # budget); full rates live in the sidecar
+    out["rule_programs"] = {k: rp[k] for k in (
+        "compiled_vs_host_speedup_x", "marginal_us_per_event",
+        "host_us_per_event", "d2h_fetches_per_offer") if k in rp}
     bd = result.get("step_breakdown") or {}
     out["step_breakdown"] = {k: bd[k] for k in (
         "pack_ms", "h2d_ms", "device_ms", "sync_total_ms",
@@ -136,6 +142,7 @@ def main() -> None:
         ("sync", _t_sync),
         ("compute", _t_compute),
         ("persist", _t_persist),
+        ("rule_programs", _t_rule_programs),
         ("analytics", _t_analytics),
         ("sharded", _t_sharded),
         ("sharded_bytes", _t_sharded_bytes),
@@ -329,10 +336,11 @@ def _build(jax, small: bool) -> Dict:
     params = engine._ensure_params()
     host_blob = batch_to_blob(pool[0])
     dblob = jax.device_put(host_blob)
-    state = engine._state
-    state, cout = engine._step_blob(params, state, dblob)  # warm compile
+    state, rstate = engine._state, engine._rule_state
+    state, rstate, cout = engine._step_blob(params, state, rstate,
+                                            dblob)  # warm compile
     jax.block_until_ready(cout.processed)
-    engine._state = state
+    engine._state, engine._rule_state = state, rstate
     ctx["dblob"], ctx["params"] = dblob, params
     ctx["blob_bytes_per_event"] = host_blob.shape[0] * 4
 
@@ -403,6 +411,73 @@ def _build(jax, small: bool) -> Dict:
         lat_engine.materialize_alerts(mbatch, mout)
     lane_s = time.perf_counter() - t0
     ctx["materialize_speedup"] = ref_s / lane_s if lane_s else 0.0
+
+    # rule-program tier (CEP-lite compiler, rules/compiler.py): a third
+    # engine at the latency batch shape with composite/temporal programs
+    # COMPILED into the fused step, vs the same rules evaluated per-event
+    # by a host-side RuleProcessor-style Python loop — the reference's
+    # extension-point path the compiler replaces. A small program bucket
+    # keeps the [D, P, S] state tensors modest at full device scale.
+    rp_engine = PipelineEngine(tensors, batch_size=LAT_BATCH,
+                               measurement_slots=8 if small else 32,
+                               max_tenants=16, max_rule_programs=4,
+                               rule_program_state_slots=4)
+    rp_engine.packer.measurements.intern("m1")
+    # thresholds tuned for OCCASIONAL fires over the uniform synthetic
+    # values: realistic alert rates, and no per-step lane-overflow log
+    # spam polluting the timing
+    rp_engine.upsert_rule_program({
+        "token": "bench-composite", "alert_level": "WARNING",
+        "when": {"all": [
+            {"pred": "value", "measurement": "m1", "op": ">",
+             "value": 98.0},
+            {"debounce": {"pred": "value", "measurement": "m1",
+                          "op": ">", "value": 60.0}, "count": 3}]}})
+    rp_engine.upsert_rule_program({
+        "token": "bench-hyst", "alert_level": "ERROR",
+        "when": {"hysteresis": {
+            "arm": {"pred": "value", "measurement": "m1", "op": ">",
+                    "value": 99.5},
+            "disarm": {"pred": "value", "measurement": "m1", "op": "<",
+                       "value": 5.0}}}})
+    rp_engine.start()
+    # the marginal-cost baseline: the IDENTICAL engine with no programs
+    # (the step compiles without the program stage at all)
+    rp_base = PipelineEngine(tensors, batch_size=LAT_BATCH,
+                             measurement_slots=8 if small else 32,
+                             max_tenants=16, max_rule_programs=4,
+                             rule_program_state_slots=4)
+    rp_base.packer.measurements.intern("m1")
+    rp_base.start()
+    rp_pool = [_synthetic_batch(rp_engine.packer, N_REGISTERED, LAT_BATCH,
+                                seed=900 + s, p_types=(1.0, 0.0, 0.0))
+               for s in range(4)]
+    for i in range(3):  # warm both jits + interners
+        rb, ro = rp_engine.submit_routed(rp_pool[i % len(rp_pool)])
+        rp_engine.materialize_alerts(rb, ro)
+        ob = rp_base.submit(rp_pool[i % len(rp_pool)])
+    jax.block_until_ready((ro.processed, ob.processed))
+    ctx["rp_engine"], ctx["rp_base"] = rp_engine, rp_base
+    ctx["rp_pool"] = rp_pool
+    # host-side comparison input: the SAME traffic as API-level event
+    # objects, prebuilt so the host loop times the RuleProcessor dispatch
+    # path (rules/processor.py), not object construction
+    from sitewhere_tpu.model.event import (
+        DeviceEventContext, DeviceMeasurement)
+    host_events = []
+    for b in rp_pool:
+        valid = np.asarray(b.valid)
+        for dev, val, ts in zip(np.asarray(b.device_idx)[valid].tolist(),
+                                np.asarray(b.value)[valid].tolist(),
+                                np.asarray(b.ts)[valid].tolist()):
+            host_events.append(DeviceMeasurement(name="m1", value=val,
+                                                 event_date=ts))
+            if len(host_events) >= 20_000:
+                break
+        if len(host_events) >= 20_000:
+            break
+    ctx["rp_host_events"] = host_events
+    ctx["rp_host_ctx"] = DeviceEventContext(device_token="bench-dev")
 
     # analytics replay log (BASELINE config 4), built + warmed once
     from sitewhere_tpu.analytics.engine import WindowedAnalyticsEngine
@@ -628,22 +703,114 @@ def _t_compute(jax, ctx) -> Dict:
     without host->device staging)."""
     engine, dblob, params = ctx["engine"], ctx["dblob"], ctx["params"]
     STEPS = ctx["STEPS"]
-    state = engine._state
+    state, rstate = engine._state, engine._rule_state
     c0 = time.perf_counter()
     for _ in range(STEPS):
-        state, cout = engine._step_blob(params, state, dblob)
+        state, rstate, cout = engine._step_blob(params, state, rstate,
+                                                dblob)
     jax.block_until_ready(cout.processed)
     rate = STEPS * ctx["BATCH"] / (time.perf_counter() - c0)
     rule_lat: List[float] = []
     for _ in range(STEPS):
         s0 = time.perf_counter()
-        state, cout = engine._step_blob(params, state, dblob)
+        state, rstate, cout = engine._step_blob(params, state, rstate,
+                                                dblob)
         cout.processed.block_until_ready()
         rule_lat.append(time.perf_counter() - s0)
-    # the step donates its state argument: hand the final buffers back so
-    # the engine is not left referencing deleted arrays
-    engine._state = state
+    # the step donates its state arguments: hand the final buffers back
+    # so the engine is not left referencing deleted arrays
+    engine._state, engine._rule_state = state, rstate
     return {"events_per_sec": rate, "rule_lat_s": rule_lat}
+
+
+def _host_rule_processor_rate(ctx) -> float:
+    """The host-side equivalent of the benched rule programs: the SAME
+    composite/temporal logic evaluated per event through the real
+    RuleProcessor dispatch path (rules/processor.py — the reference's
+    ZoneTest/Groovy extension point this PR's compiler replaces), with
+    per-device Python state. Events are prebuilt; the loop times
+    dispatch + evaluation only."""
+    from sitewhere_tpu.rules import RuleProcessor
+
+    class _BenchRules(RuleProcessor):
+        def __init__(self):
+            super().__init__("bench-host-rules")
+            self.deb: Dict[str, int] = {}
+            self.latch: Dict[str, bool] = {}
+            self.prev1: Dict[str, bool] = {}
+            self.prev2: Dict[str, bool] = {}
+            self.fires = 0
+
+        def on_measurement(self, context, event) -> None:
+            dev, val = event.name, event.value
+            c = self.deb.get(dev, 0) + 1 if val > 60.0 else 0
+            self.deb[dev] = c
+            out1 = val > 98.0 and c >= 3
+            if out1 and not self.prev1.get(dev, False):
+                self.fires += 1
+            self.prev1[dev] = out1
+            lat = ((self.latch.get(dev, False) or val > 99.5)
+                   and not val < 5.0)
+            self.latch[dev] = lat
+            if lat and not self.prev2.get(dev, False):
+                self.fires += 1
+            self.prev2[dev] = lat
+
+    proc = _BenchRules()
+    context = ctx["rp_host_ctx"]
+    events = ctx["rp_host_events"]
+    t0 = time.perf_counter()
+    for event in events:
+        proc.process(context, event)
+    dt = time.perf_counter() - t0
+    return len(events) / dt if dt else 0.0
+
+
+def _t_rule_programs(jax, ctx) -> Dict:
+    """Rule-program tier, three measurements on the same traffic:
+
+    1. fused-step throughput with compiled programs active,
+       materialization included (the deployed path — one lane fetch per
+       step; perf_gate pins d2h_fetches_per_offer == 1, the alert-lane
+       budget unchanged by programs);
+    2. the MARGINAL per-event cost of the compiled program stage (step
+       with programs minus the identical engine's step without — the
+       operator's actual decision: run composite rules in-step or on the
+       host);
+    3. the host RuleProcessor dispatch path evaluating the same logic
+       per event. speedup = host per-event cost / marginal in-step cost.
+    """
+    engine, base, pool = ctx["rp_engine"], ctx["rp_base"], ctx["rp_pool"]
+    steps = ctx["STEPS"]
+    rb, ro = engine.submit_routed(pool[0])   # unmeasured re-warm
+    engine.materialize_alerts(rb, ro)
+    f0 = engine.d2h_fetches
+    t0 = time.perf_counter()
+    for i in range(steps):
+        rb, ro = engine.submit_routed(pool[i % len(pool)])
+        engine.materialize_alerts(rb, ro)    # lane fetch syncs the step
+    with_s = time.perf_counter() - t0
+    compiled = steps * engine.batch_size / with_s
+    # baseline: identical engine, no programs, same batches and the same
+    # materialize leg (adjacent in the same trial so both loops see the
+    # same host/link state — the difference isolates the program stage)
+    rb2, ob = base.submit_routed(pool[0])
+    base.materialize_alerts(rb2, ob)
+    t0 = time.perf_counter()
+    for i in range(steps):
+        rb2, ob = base.submit_routed(pool[i % len(pool)])
+        base.materialize_alerts(rb2, ob)
+    base_s = time.perf_counter() - t0
+    events = steps * engine.batch_size
+    marginal_us = max(with_s - base_s, 1e-9) / events * 1e6
+    host_rate = _host_rule_processor_rate(ctx)
+    host_us = 1e6 / host_rate if host_rate else 0.0
+    return {"events_per_sec": compiled,
+            "host_events_per_sec": host_rate,
+            "marginal_us_per_event": marginal_us,
+            "host_us_per_event": host_us,
+            "d2h_fetches": engine.d2h_fetches - f0,
+            "offers": steps}
 
 
 def _t_persist(jax, ctx) -> Dict:
@@ -1090,6 +1257,28 @@ def _aggregate(jax, ctx, trials: Dict[str, List[Dict]],
     sharded_bytes = rates("sharded_bytes")
     mt = rates("multitenant")
 
+    rp_trials = trials["rule_programs"]
+    rp_rate = _median([t["events_per_sec"] for t in rp_trials])
+    rp_host = _median([t["host_events_per_sec"] for t in rp_trials])
+    # the speedup is per-event cost vs per-event cost: the host
+    # RuleProcessor dispatch path against the MARGINAL in-step cost of
+    # the compiled program stage (best trial — the marginal is a small
+    # difference of two loop timings, so scheduler noise inflates it)
+    rp_marginal = min(t["marginal_us_per_event"] for t in rp_trials)
+    rp_host_us = _median([t["host_us_per_event"] for t in rp_trials])
+    rp_offers = sum(t["offers"] for t in rp_trials)
+    rule_programs = {
+        "events_per_sec": round(rp_rate, 1),
+        "host_rule_processor_events_per_sec": round(rp_host, 1),
+        "marginal_us_per_event": round(rp_marginal, 4),
+        "host_us_per_event": round(rp_host_us, 4),
+        "compiled_vs_host_speedup_x": round(rp_host_us / rp_marginal, 2)
+        if rp_marginal else 0.0,
+        "d2h_fetches_per_offer": round(
+            sum(t["d2h_fetches"] for t in rp_trials) / rp_offers, 4)
+        if rp_offers else 0,
+    }
+
     plain = sorted(x for t in trials["sync"] for x in t["plain_s"])
     packs = [x for t in trials["sync"] for x in t["pack_s"]]
     h2ds = [x for t in trials["sync"] for x in t["h2d_s"]]
@@ -1131,6 +1320,8 @@ def _aggregate(jax, ctx, trials: Dict[str, List[Dict]],
         "telemetry": _spread_pct(telemetry),
         "compute_only": _spread_pct(compute),
         "persist": _spread_pct(persist),
+        "rule_programs": _spread_pct(
+            [t["events_per_sec"] for t in rp_trials]),
         "analytics": _spread_pct(analytics),
         "sharded_1chip": _spread_pct(sharded),
         "sharded_from_bytes": _spread_pct(sharded_bytes),
@@ -1200,6 +1391,9 @@ def _aggregate(jax, ctx, trials: Dict[str, List[Dict]],
         "telemetry_wire_rows": ctx["telemetry_rows"],
         "telemetry_wire_bytes_per_event": ctx["telemetry_rows"] * 4,
         "persist_events_per_sec": round(_median(persist), 1),
+        # compiled rule programs vs the host RuleProcessor loop (the
+        # perf_gate rule_programs check pins fetches==1 and speedup>=1)
+        "rule_programs": rule_programs,
         "analytics_replay_events_per_sec": round(_median(analytics), 1),
         "sharded_1chip_events_per_sec": round(_median(sharded), 1),
         # from-encoded-bytes sharded headline: decode + intern + pack +

@@ -166,8 +166,24 @@ class SiteWhereInstance(LifecycleComponent):
         # config, ScriptSynchronizer.java:32): survives restarts, rides
         # the instance checkpoint, and replicates via cluster gossip —
         # tenant engines re-install from it at boot (_make_engine)
-        from sitewhere_tpu.rules.store import ScriptedRuleStore
+        from sitewhere_tpu.rules.store import (
+            RuleProgramStore, ScriptedRuleStore)
         self.scripted_rules = ScriptedRuleStore(data_dir=self.data_dir)
+        # durable rule-program installs (the CEP-lite compiler's control
+        # plane — rules/compiler.py): tenant-scoped CRUD persisted with
+        # the ScriptedRuleStore pattern, replicated cluster-wide with the
+        # LWW/tombstone algebra, re-installed into the pipeline engine at
+        # boot below
+        self.rule_programs = RuleProgramStore(data_dir=self.data_dir)
+        self._rule_program_lock = threading.Lock()
+        if self.pipeline_engine is not None:
+            for row in self.rule_programs.all_installs():
+                try:
+                    self.pipeline_engine.upsert_rule_program(row["spec"])
+                except Exception:
+                    logging.getLogger("sitewhere.instance").exception(
+                        "could not restore rule program %r for tenant %s",
+                        row["token"], row["tenant"])
         # serializes scripted-rule check+attach+commit sequences: a gossip
         # apply that passed its LWW pre-check must not interleave with a
         # local install, or the loser's attach could replace the winner's
@@ -359,6 +375,76 @@ class SiteWhereInstance(LifecycleComponent):
                     engine = self.engine_manager.get_engine(tenant)
                     if engine is not None:
                         engine.rule_processors.remove_processor(token)
+                    return True
+        return False
+
+    # -- rule programs (durable + replicated; the CEP-lite fused rules) ----
+    def install_rule_program(self, tenant: str, spec: Dict,
+                             replace: bool = False) -> Dict:
+        """Validate + install a rule program on the fused pipeline: live
+        engine install (the dry-run compile 409s with the offending node
+        BEFORE any mutation), durable record, gossip via the store's
+        listeners. Program tokens are instance-global (the engine is);
+        the store scopes listing and removal by tenant."""
+        from sitewhere_tpu.errors import ErrorCode, SiteWhereError
+
+        engine = self.pipeline_engine
+        if engine is None:
+            raise SiteWhereError(
+                "rule programs require a pipeline engine (pipeline.enabled)",
+                ErrorCode.GENERIC, http_status=409)
+        spec = dict(spec or {})
+        spec["tenant_token"] = tenant  # force the request tenant's scope
+        with self._rule_program_lock:
+            if replace:
+                entry = engine.upsert_rule_program(spec)
+            else:
+                entry = engine.create_rule_program(spec)
+            payload = self.rule_programs.record(
+                tenant, entry["spec"]["token"], entry["spec"], notify=False)
+        self.rule_programs.emit("add", tenant, entry["spec"]["token"],
+                                payload)
+        return dict(entry["spec"])
+
+    def remove_rule_program(self, tenant: str, token: str) -> bool:
+        engine = self.pipeline_engine
+        with self._rule_program_lock:
+            removed = bool(engine is not None
+                           and self.rule_programs.get(tenant, token)
+                           is not None
+                           and engine.remove_rule_program(token))
+            stamp = self.rule_programs.erase(tenant, token, notify=False)
+        if stamp is not None:
+            self.rule_programs.emit("remove", tenant, token, stamp)
+        return stamp is not None or removed
+
+    def apply_replicated_rule_program(self, op: str, tenant: str,
+                                      token: str, payload) -> bool:
+        """Gossip receive side: converge the durable store, then mirror
+        the live engine. An invalid spec raises RuleProgramError — the
+        structured 409 naming the offending node — BEFORE any store
+        mutation, so the gossip handler surfaces it as a conflict, not a
+        stack trace, and the loser's state stays untouched."""
+        engine = self.pipeline_engine
+        if op == "add":
+            spec, stamp = dict(payload["spec"]), int(payload["stamp"])
+            with self._rule_program_lock:
+                if not self.rule_programs.would_apply_add(
+                        tenant, token, spec, stamp):
+                    return False
+                if engine is not None:
+                    # validate + live install FIRST: a spec this engine's
+                    # static buckets cannot hold must leave the store
+                    # unchanged (RuleProgramError propagates, structured)
+                    engine.upsert_rule_program(spec)
+                return self.rule_programs.apply_add(tenant, token, spec,
+                                                    stamp)
+        if op == "remove":
+            with self._rule_program_lock:
+                if self.rule_programs.apply_remove(tenant, token,
+                                                   int(payload)):
+                    if engine is not None:
+                        engine.remove_rule_program(token)
                     return True
         return False
 

@@ -20,7 +20,11 @@ batch-row order, so materialization order matches a mask scan exactly):
                  in bits 16-31 (int16 two's complement; -1 = none)
   row 2 (meta):  threshold alert_level bits 0-7 | geofence alert_level
                  bits 8-15 | threshold_fired bit 16 | geofence_fired
-                 bit 17 (levels are only meaningful under their fired bit)
+                 bit 17 | program_fired bit 18 | program slot id bits
+                 19-26 | program alert_level bits 27-30 (levels/ids are
+                 only meaningful under their fired bit; rule-program
+                 fires ride the spare meta bits so the lane layout and
+                 the perf gate's bytes budget are unchanged)
   row 3 (counts): [0] = fired rows this step (INCLUDING rows beyond
                  capacity), [1] = alerts dropped by lane overflow (each
                  fired rule family on a row beyond capacity counts one),
@@ -50,23 +54,37 @@ MIN_ALERT_LANE_CAPACITY = 4
 
 _THR_FIRED_BIT = 16
 _GEO_FIRED_BIT = 17
+# rule-program fire fields ride the SPARE meta bits (18-30) so the lane
+# layout — and the perf gate's bytes-per-slot budget — stays unchanged:
+# bit 18 = program fired, bits 19-26 = program slot id (table bucket is
+# capped at 256 programs), bits 27-30 = program alert level (<= 15)
+_PROG_FIRED_BIT = 18
+_PROG_RULE_SHIFT = 19
+_PROG_LEVEL_SHIFT = 27
 
 
-def compact_alert_lanes(thr: Dict, geo: Dict, capacity: int):
+def compact_alert_lanes(thr: Dict, geo: Dict, capacity: int,
+                        prog: Dict = None):
     """Pack the step's fired rows into alert lanes (jax, call under jit).
 
     `thr`/`geo` are the eval_threshold_rules / eval_geofence_rules output
-    dicts (fired/first_rule/alert_level, all [B]). Returns the
-    [ALERT_LANE_ROWS, capacity] int32 lane array described above. Works
-    per shard under shard_map (row indices are shard-local).
+    dicts (fired/first_rule/alert_level, all [B]); `prog` is the optional
+    rule-program row dict of the same shape (ops/stateful.py fires mapped
+    to attach rows). Returns the [ALERT_LANE_ROWS, capacity] int32 lane
+    array described above. Works per shard under shard_map (row indices
+    are shard-local).
     """
     import jax.numpy as jnp
 
     if capacity < MIN_ALERT_LANE_CAPACITY:
         raise ValueError(
             f"alert lane capacity {capacity} < {MIN_ALERT_LANE_CAPACITY}")
-    fired = thr["fired"] | geo["fired"]                       # bool [B]
-    B = fired.shape[0]
+    B = thr["fired"].shape[0]
+    if prog is None:
+        zero = jnp.zeros((B,), jnp.int32)
+        prog = {"fired": jnp.zeros((B,), bool), "first_rule": zero,
+                "alert_level": zero}
+    fired = thr["fired"] | geo["fired"] | prog["fired"]       # bool [B]
     fired_i = fired.astype(jnp.int32)
     rank = jnp.cumsum(fired_i) - 1                            # 0-based
     keep = fired & (rank < capacity)
@@ -79,14 +97,21 @@ def compact_alert_lanes(thr: Dict, geo: Dict, capacity: int):
              | ((geo["first_rule"] & 0xFFFF) << 16))
     rules_lane = jnp.zeros((capacity,), jnp.int32).at[slot].set(
         rules, mode="drop")
+    prog_fired_i = prog["fired"].astype(jnp.int32)
     meta = ((thr["alert_level"] & 0xFF)
             | ((geo["alert_level"] & 0xFF) << 8)
             | (thr["fired"].astype(jnp.int32) << _THR_FIRED_BIT)
-            | (geo["fired"].astype(jnp.int32) << _GEO_FIRED_BIT))
+            | (geo["fired"].astype(jnp.int32) << _GEO_FIRED_BIT)
+            | (prog_fired_i << _PROG_FIRED_BIT)
+            | (jnp.where(prog["fired"], prog["first_rule"] & 0xFF, 0)
+               << _PROG_RULE_SHIFT)
+            | (jnp.where(prog["fired"], prog["alert_level"] & 0xF, 0)
+               << _PROG_LEVEL_SHIFT))
     meta_lane = jnp.zeros((capacity,), jnp.int32).at[slot].set(
         meta, mode="drop")
-    alerts_of = thr["fired"].astype(jnp.int32) + geo["fired"].astype(
-        jnp.int32)                                            # 0..2 per row
+    alerts_of = (thr["fired"].astype(jnp.int32)
+                 + geo["fired"].astype(jnp.int32)
+                 + prog_fired_i)                              # 0..3 per row
     total_alerts = jnp.sum(alerts_of)
     kept_alerts = jnp.sum(jnp.where(keep, alerts_of, 0))
     counts_lane = (jnp.zeros((capacity,), jnp.int32)
@@ -110,6 +135,16 @@ class DecodedAlertLanes:
     fired_rows: int         # total fired rows incl. overflow
     dropped_alerts: int     # alerts lost to lane overflow
     total_alerts: int
+    prog_fired: np.ndarray = None  # bool (rule-program composite fires)
+    prog_rule: np.ndarray = None   # int32 program slot (-1 = none)
+    prog_level: np.ndarray = None  # int32 (meaningful under prog_fired)
+
+    def __post_init__(self):
+        if self.prog_fired is None:
+            n = self.rows.shape[0]
+            self.prog_fired = np.zeros(n, bool)
+            self.prog_rule = np.full(n, -1, np.int32)
+            self.prog_level = np.zeros(n, np.int32)
 
     @property
     def n(self) -> int:
@@ -123,7 +158,9 @@ class DecodedAlertLanes:
             geo_rule=self.geo_rule[:n], thr_level=self.thr_level[:n],
             geo_level=self.geo_level[:n], fired_rows=self.fired_rows,
             dropped_alerts=self.dropped_alerts,
-            total_alerts=self.total_alerts)
+            total_alerts=self.total_alerts,
+            prog_fired=self.prog_fired[:n], prog_rule=self.prog_rule[:n],
+            prog_level=self.prog_level[:n])
 
 
 def decode_alert_lanes(lanes: np.ndarray) -> DecodedAlertLanes:
@@ -135,6 +172,7 @@ def decode_alert_lanes(lanes: np.ndarray) -> DecodedAlertLanes:
     n = min(fired_rows, capacity)
     rules = lanes[1, :n]
     meta = lanes[2, :n]
+    prog_fired = ((meta >> _PROG_FIRED_BIT) & 1).astype(bool)
     return DecodedAlertLanes(
         rows=lanes[0, :n],
         thr_fired=((meta >> _THR_FIRED_BIT) & 1).astype(bool),
@@ -146,4 +184,9 @@ def decode_alert_lanes(lanes: np.ndarray) -> DecodedAlertLanes:
         geo_level=(meta >> 8) & 0xFF,
         fired_rows=fired_rows,
         dropped_alerts=int(counts[1]),
-        total_alerts=int(counts[2]))
+        total_alerts=int(counts[2]),
+        prog_fired=prog_fired,
+        prog_rule=np.where(prog_fired,
+                           (meta >> _PROG_RULE_SHIFT) & 0xFF,
+                           -1).astype(np.int32),
+        prog_level=((meta >> _PROG_LEVEL_SHIFT) & 0xF).astype(np.int32))

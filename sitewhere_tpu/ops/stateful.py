@@ -1,0 +1,363 @@
+"""Stateful rule-program evaluation inside the fused step.
+
+Evaluates the compiled rule-program tables (rules/compiler.py) with
+per-(device, program, state-slot) temporal state carried in HBM across
+steps: EWMA accumulators, last-value/last-ts pairs for rate-of-change,
+consecutive-hit counters for debounce, armed/latched bits for
+hysteresis, and satisfied-since timestamps for `for_duration`.
+
+Work scales with the BATCH, not the device capacity: the step first
+reduces the batch to per-device observations with the same keyed
+reductions the device-state fold uses (ops/segments.py), then evaluates
+the [B, P] program matrix only on the batch's rows — state rows gather
+per row from the [D, P, S] HBM tensors and scatter back from each
+device's ATTACH row (its last tracked-measurement row this step, a
+unique writer, so the scatter is deterministic like every other fold
+here). A device with no event this step costs nothing, exactly like the
+rest of the pipeline.
+
+Step semantics (the NumPy oracle in tests/test_rule_programs.py pins
+them exactly):
+  * a device's observation TICK is a step in which it had >= 1 valid
+    measurement event on a tracked slot (mm_idx < M);
+  * predicates read the POST-FOLD last-measurement state, so composite
+    conditions over measurements arriving in different events hold
+    between observations;
+  * temporal operators advance only on ticks; `for_duration` measures
+    against the device's newest event timestamp this step;
+  * a program FIRES on the rising edge of its root expression at a tick;
+    a tick where the root stays true counts one suppression instead
+    (per-program fire/suppress counters ride the state tensors);
+  * fires attach to the device's last tracked measurement row — the row
+    that completed the condition — so composite fires feed the existing
+    alert-lane compaction (ops/compact.py) and delivery stays one
+    fixed-shape D2H fetch per step.
+
+Generation reset: `row_gen [D, P]` vs the table's per-slot `epoch` —
+a gathered row whose generation lags its program's epoch reads as
+freshly-initialized state (and writes back the current epoch), so
+installing a new program into a recycled slot resets temporal state
+lazily INSIDE the jit: lockstep-safe on multi-host meshes, no
+out-of-band device mutation, no full-capacity sweep.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from sitewhere_tpu.rules.compiler import ProgramOp, RuleProgramTable
+
+_NEG = -(2 ** 31)
+
+
+@struct.dataclass
+class RuleStateTensors:
+    """Per-(device, program) temporal state, HBM-resident like
+    DeviceStateTensors (sharded engines carry a leading shard axis on
+    every field, exactly like the device-state group).
+
+    The (value, aux, ts, counter) quad is one uniform state record per
+    stateful node (compiler-assigned state_slot):
+      EWMA          value = accumulator, counter = observation count
+      RATE          value = prev observation, aux = last computed rate,
+                    ts = prev observation ts, counter = observation count
+      DEBOUNCE      counter = consecutive satisfied ticks
+      FOR_DURATION  ts = satisfied-since timestamp (NEG = not satisfied)
+      HYSTERESIS    counter = latch bit
+    """
+
+    value: jnp.ndarray     # f32 [D, P, S]
+    aux: jnp.ndarray       # f32 [D, P, S]
+    ts: jnp.ndarray        # i32 [D, P, S]
+    counter: jnp.ndarray   # i32 [D, P, S]
+    root_prev: jnp.ndarray  # bool [D, P] root output at the last tick
+    row_gen: jnp.ndarray   # i32 [D, P] per-row state generation
+    gen: jnp.ndarray       # i32 [P] counter-row generation
+    fire_count: jnp.ndarray      # i32 [P] cumulative fires
+    suppress_count: jnp.ndarray  # i32 [P] cumulative suppressions
+
+    @property
+    def num_programs(self) -> int:
+        return self.gen.shape[-1]
+
+    @property
+    def num_state_slots(self) -> int:
+        return self.value.shape[-1]
+
+
+def init_rule_state_np(max_devices: int,
+                       max_programs: int,
+                       state_slots: int) -> RuleStateTensors:
+    """Numpy-leaved initial state (same contract as init_device_state_np:
+    no device buffers, so sharded engines place the tree with ONE
+    device_put on their mesh)."""
+    D, P, S = max_devices, max_programs, state_slots
+    return RuleStateTensors(
+        value=np.zeros((D, P, S), np.float32),
+        aux=np.zeros((D, P, S), np.float32),
+        ts=np.full((D, P, S), _NEG, np.int32),
+        counter=np.zeros((D, P, S), np.int32),
+        root_prev=np.zeros((D, P), bool),
+        row_gen=np.zeros((D, P), np.int32),
+        gen=np.zeros((P,), np.int32),
+        fire_count=np.zeros((P,), np.int32),
+        suppress_count=np.zeros((P,), np.int32),
+    )
+
+
+def init_rule_state(max_devices: int, max_programs: int,
+                    state_slots: int) -> RuleStateTensors:
+    import jax
+
+    return jax.tree_util.tree_map(
+        jnp.asarray,
+        init_rule_state_np(max_devices, max_programs, state_slots))
+
+
+def _slot_onehot(slots: jnp.ndarray, size: int) -> jnp.ndarray:
+    """[P] slot ids -> bool [P, size] one-hot. The lane axes here are
+    tiny static buckets (state slots, node slots), so dense one-hot
+    select/merge beats per-element scatter/gather by orders of magnitude
+    on every backend (XLA scatters with full index arrays serialize on
+    CPU and tile poorly on the VPU)."""
+    return slots[:, None] == jnp.arange(size, dtype=slots.dtype)[None, :]
+
+
+def _gather_slot(arr: jnp.ndarray, slots: jnp.ndarray) -> jnp.ndarray:
+    """arr [B, P, S], slots [P] -> [B, P] (each program's assigned lane)."""
+    onehot = _slot_onehot(slots, arr.shape[2])[None]      # [1, P, S]
+    if arr.dtype == jnp.bool_:
+        return jnp.any(arr & onehot, axis=2)
+    return jnp.sum(jnp.where(onehot, arr, 0), axis=2).astype(arr.dtype)
+
+
+def _scatter_slot(arr: jnp.ndarray, slots: jnp.ndarray,
+                  values: jnp.ndarray, write: jnp.ndarray) -> jnp.ndarray:
+    """Write `values` [B, P] into arr[b, p, slots[p]] where `write` [P];
+    programs outside `write` keep their lane untouched."""
+    onehot = _slot_onehot(slots, arr.shape[2])[None]      # [1, P, S]
+    mask = onehot & write[None, :, None]
+    return jnp.where(mask, values[:, :, None], arr)
+
+
+def eval_rule_programs(
+        table: RuleProgramTable,
+        state: RuleStateTensors,
+        *,
+        dev: jnp.ndarray,             # i32 [B] row device index
+        attach: jnp.ndarray,          # bool [B] device's last tracked row
+        obs_row: jnp.ndarray,         # bool [B, M] device observed slot m
+        now_row: jnp.ndarray,         # i32 [B] device's newest ts this step
+        lm_row: jnp.ndarray,          # f32 [B, M] POST-fold last values
+        lmts_row: jnp.ndarray,        # i32 [B, M] POST-fold last ts
+        tenant_row: jnp.ndarray,      # i32 [B] registry mirror per row
+        dtype_row: jnp.ndarray,       # i32 [B] registry mirror per row
+        node_limit: int = 0,          # static: node slots actually in use
+) -> Tuple[RuleStateTensors, Dict[str, jnp.ndarray]]:
+    """One fused-step advance, evaluated on the batch's rows.
+
+    Only ATTACH rows advance state and may fire (one per ticked device);
+    the returned per-row outputs feed the alert-lane compaction:
+      fired:       bool [B]
+      first_rule:  i32 [B] lowest fired program slot (-1 = none)
+      alert_level: i32 [B] max level among fired programs (-1 = none)
+    """
+    from sitewhere_tpu.ops.threshold import _compare
+
+    B = dev.shape[0]
+    D = state.value.shape[0]
+    P, N = table.num_programs, table.num_nodes
+    # trim the unrolled node pass to the slots the COMPILED table
+    # actually populates (trace-time static, threaded from the engine's
+    # table compile): the bucket is a capacity, and an all-NOP tail slot
+    # still costs a full op-group per unroll step — pure dispatch
+    # overhead on CPU, pure pipeline bubbles on the VPU
+    if node_limit:
+        N = min(N, node_limit)
+    S = state.num_state_slots
+
+    eligible = (
+        table.active[None, :]
+        & ((table.tenant_idx[None, :] == 0)
+           | (table.tenant_idx[None, :] == tenant_row[:, None]))
+        & ((table.device_type_idx[None, :] == 0)
+           | (table.device_type_idx[None, :] == dtype_row[:, None]))
+    )                                                     # [B, P]
+    tick = eligible & attach[:, None]                     # [B, P]
+
+    # gather this batch's state rows; rows whose generation lags their
+    # program's epoch read as fresh (lazy per-row reset)
+    stale = state.row_gen[dev] != table.epoch[None, :]    # [B, P]
+    stale_s = stale[:, :, None]
+    value_s = jnp.where(stale_s, 0.0, state.value[dev])   # [B, P, S]
+    aux_s = jnp.where(stale_s, 0.0, state.aux[dev])
+    ts_s = jnp.where(stale_s, _NEG, state.ts[dev])
+    ctr_s = jnp.where(stale_s, 0, state.counter[dev])
+    prev_row = jnp.where(stale, False, state.root_prev[dev])  # [B, P]
+
+    outs = jnp.zeros((B, P, N), bool)
+
+    for j in range(N):  # static unroll; children sit at lower slots
+        op = table.opcode[:, j]                           # [P]
+        mm = jnp.clip(table.mm_idx[:, j], 0, lm_row.shape[1] - 1)
+        slot = table.state_slot[:, j]                     # [P]
+        cmp_op = table.cmp_op[None, :, j]                 # [1, P]
+        fconst = table.fconst[None, :, j]                 # [1, P]
+
+        v = lm_row[:, mm]                                 # [B, P]
+        known = lmts_row[:, mm] > _NEG                    # [B, P]
+        observed = obs_row[:, mm] & eligible              # [B, P]
+
+        sv = _gather_slot(value_s, slot)                  # [B, P]
+        sa = _gather_slot(aux_s, slot)
+        st = _gather_slot(ts_s, slot)
+        sc = _gather_slot(ctr_s, slot)
+
+        is_value = op == ProgramOp.VALUE
+        is_ewma = op == ProgramOp.EWMA
+        is_rate = op == ProgramOp.RATE
+        is_not = op == ProgramOp.NOT
+        is_and = op == ProgramOp.AND
+        is_or = op == ProgramOp.OR
+        is_deb = op == ProgramOp.DEBOUNCE
+        is_dur = op == ProgramOp.FOR_DURATION
+        is_hys = op == ProgramOp.HYSTERESIS
+
+        lhs = _gather_slot(outs, jnp.clip(table.lhs[:, j], 0, N - 1))
+        rhs = _gather_slot(outs, jnp.clip(table.rhs[:, j], 0, N - 1))
+
+        # ---- predicates ------------------------------------------------
+        out_value = known & _compare(v, cmp_op, fconst)
+
+        alpha = table.falpha[None, :, j]
+        ewma = jnp.where(sc > 0, alpha * v + (1.0 - alpha) * sv, v)
+        new_sv_ewma = jnp.where(observed, ewma, sv)
+        out_ewma = ((sc + observed.astype(jnp.int32)) > 0) \
+            & _compare(new_sv_ewma, cmp_op, fconst)
+
+        cur_ts = lmts_row[:, mm]
+        dt = jnp.maximum(cur_ts - st, 1).astype(jnp.float32)
+        rate = (v - sv) * 1000.0 / dt
+        upd_rate = observed & (sc > 0)
+        new_sa_rate = jnp.where(upd_rate, rate, sa)
+        out_rate = ((sc + observed.astype(jnp.int32)) > 1) \
+            & _compare(new_sa_rate, cmp_op, fconst)
+
+        # ---- temporal operators (advance on ticks only) ---------------
+        iparam = table.iparam[None, :, j]
+        new_sc_deb = jnp.where(
+            tick, jnp.where(lhs, jnp.minimum(sc + 1, 2 ** 30), 0), sc)
+        out_deb = new_sc_deb >= iparam
+
+        since = jnp.where(st == _NEG, now_row[:, None], st)
+        new_st_dur = jnp.where(tick, jnp.where(lhs, since, _NEG), st)
+        out_dur = lhs & (new_st_dur != _NEG) \
+            & (now_row[:, None] - new_st_dur >= iparam)
+
+        latch = sc > 0
+        new_latch = jnp.where(tick, (latch | lhs) & ~rhs, latch)
+        out_hys = new_latch
+
+        # ---- merge by opcode (data-independent select) ----------------
+        out_j = (
+            (is_value & out_value) | (is_ewma & out_ewma)
+            | (is_rate & out_rate) | (is_not & ~lhs)
+            | (is_and & (lhs & rhs)) | (is_or & (lhs | rhs))
+            | (is_deb & out_deb) | (is_dur & out_dur)
+            | (is_hys & out_hys))
+        outs = outs.at[:, :, j].set(out_j)
+
+        # ---- state writes (one lane per stateful node) ----------------
+        obs_inc = observed.astype(jnp.int32)
+        new_value = jnp.where(is_ewma, new_sv_ewma,
+                              jnp.where(is_rate & observed, v, sv))
+        new_aux = jnp.where(is_rate, new_sa_rate, sa)
+        new_ts = jnp.where(is_rate & observed, cur_ts,
+                           jnp.where(is_dur, new_st_dur, st))
+        new_ctr = jnp.where(is_ewma | is_rate, sc + obs_inc,
+                            jnp.where(is_deb, new_sc_deb,
+                                      jnp.where(is_hys,
+                                                new_latch.astype(jnp.int32),
+                                                sc)))
+        stateful = (is_ewma | is_rate | is_deb | is_dur | is_hys)
+        value_s = _scatter_slot(value_s, slot, new_value, stateful)
+        aux_s = _scatter_slot(aux_s, slot, new_aux, stateful)
+        ts_s = _scatter_slot(ts_s, slot, new_ts, stateful)
+        ctr_s = _scatter_slot(ctr_s, slot, new_ctr, stateful)
+
+    root = _gather_slot(outs, jnp.clip(table.root, 0, N - 1)) & eligible
+    fired = tick & root & ~prev_row                       # [B, P]
+    suppressed = tick & root & prev_row
+    new_prev_row = jnp.where(tick, root, prev_row)
+
+    # scatter updated rows back from attach rows only (unique writer per
+    # device; other rows route to the dropped pad index)
+    target = jnp.where(attach, dev, D)
+    def put(arr, rows):
+        return arr.at[target].set(rows, mode="drop")
+    new_state = state.replace(
+        value=put(state.value, value_s),
+        aux=put(state.aux, aux_s),
+        ts=put(state.ts, ts_s),
+        counter=put(state.counter, ctr_s),
+        root_prev=put(state.root_prev, new_prev_row),
+        row_gen=put(state.row_gen,
+                    jnp.broadcast_to(table.epoch[None, :], (B, P))),
+        # per-program counters reset when their slot's epoch moved
+        gen=table.epoch,
+        fire_count=jnp.where(state.gen != table.epoch, 0,
+                             state.fire_count)
+        + jnp.sum(fired, axis=0, dtype=jnp.int32),
+        suppress_count=jnp.where(state.gen != table.epoch, 0,
+                                 state.suppress_count)
+        + jnp.sum(suppressed, axis=0, dtype=jnp.int32),
+    )
+
+    any_fired = jnp.any(fired, axis=1)                    # [B]
+    slot_ids = jnp.arange(P, dtype=jnp.int32)[None, :]
+    first_prog = jnp.min(jnp.where(fired, slot_ids, P), axis=1)
+    first_prog = jnp.where(any_fired, first_prog, -1).astype(jnp.int32)
+    level = jnp.max(
+        jnp.where(fired, table.alert_level[None, :], -1), axis=1
+    ).astype(jnp.int32)
+    return new_state, {
+        "fired": any_fired,
+        "first_rule": first_prog,
+        "alert_level": level,
+    }
+
+
+def observations_of_batch(batch, measurement_slots: int, num_devices: int
+                          ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray,
+                                     jnp.ndarray]:
+    """Reduce a packed batch to the per-device observation view the
+    program evaluator consumes: (obs_mm [D, M], touched [D], now_d [D],
+    attach_row [B]).
+
+    `attach_row` marks, per batch row, whether it is its device's LAST
+    valid tracked-measurement row — the row a composite fire attaches to
+    so it rides the alert lanes. Built from the same scatter reductions
+    as the device-state fold (deterministic under XLA)."""
+    from sitewhere_tpu.model.event import DeviceEventType
+    from sitewhere_tpu.ops.segments import count_by_key, scatter_max_by_key
+
+    D, M = num_devices, measurement_slots
+    dev = batch.device_idx
+    is_obs = (batch.valid
+              & (batch.event_type == DeviceEventType.MEASUREMENT)
+              & (batch.mm_idx > 0) & (batch.mm_idx < M))      # bool [B]
+    mm_key = dev * M + batch.mm_idx
+    obs_mm = (count_by_key(mm_key, is_obs, D * M) > 0).reshape(D, M)
+    touched = jnp.any(obs_mm, axis=1)
+    neg = jnp.full((D,), _NEG, jnp.int32)
+    now_d = scatter_max_by_key(dev, batch.ts, is_obs, D, neg)
+    B = dev.shape[0]
+    row_ids = jnp.arange(B, dtype=jnp.int32)
+    last_row = scatter_max_by_key(dev, row_ids, is_obs, D,
+                                  jnp.full((D,), -1, jnp.int32))
+    attach_row = is_obs & (last_row[dev] == row_ids)
+    return obs_mm, touched, now_d, attach_row

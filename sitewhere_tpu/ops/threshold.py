@@ -64,15 +64,21 @@ def empty_threshold_table(max_rules: int) -> ThresholdRuleTable:
 def _compare(value: jnp.ndarray, op: jnp.ndarray, threshold: jnp.ndarray
              ) -> jnp.ndarray:
     """value [B,1] vs op/threshold [R] -> [B,R]; selects among all six compares
-    (cheap on VPU; avoids data-dependent branching)."""
+    (cheap on VPU; avoids data-dependent branching).
+
+    NaN guard: a NaN measurement value satisfies NO comparison. IEEE
+    semantics already make the ordered compares false, but `!=` is TRUE
+    for NaN — a corrupt/unparseable reading must never fire an alert, so
+    non-firing is explicit rather than inherited per-op."""
     gt = value > threshold
     lt = value < threshold
     eq = value == threshold
-    return jnp.select(
+    result = jnp.select(
         [op == ThresholdOp.GT, op == ThresholdOp.GTE, op == ThresholdOp.LT,
          op == ThresholdOp.LTE, op == ThresholdOp.EQ],
         [gt, gt | eq, lt, lt | eq, eq],
         default=~eq)
+    return result & ~jnp.isnan(value)
 
 
 def eval_threshold_rules(batch: EventBatch, table: ThresholdRuleTable,

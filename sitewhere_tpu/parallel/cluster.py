@@ -1009,6 +1009,11 @@ class RegistryGossip:
         instance.script_manager.add_listener(self._on_script_mutation)
         instance.scripted_rules.add_listener(
             self._on_scripted_rule_mutation)
+        rule_programs = getattr(instance, "rule_programs", None)
+        if rule_programs is not None:
+            # rule-program installs replicate the same way: LWW payloads
+            # (the spec IS the identity) with tombstoned removals
+            rule_programs.add_listener(self._on_rule_program_mutation)
 
     def _on_script_mutation(self, op: str, scope: str, script_id: str,
                             payload) -> None:
@@ -1041,6 +1046,26 @@ class RegistryGossip:
 
     def _apply_scripted_rule(self, data: Dict) -> None:
         if self.instance.apply_replicated_scripted_rule(
+                data.get("op", ""), data.get("tenant", ""),
+                data.get("token", ""), data.get("payload")):
+            self.applied += 1
+
+    def _on_rule_program_mutation(self, op: str, tenant: str, token: str,
+                                  payload) -> None:
+        if getattr(self._applying, "active", False) or not self.peers:
+            return
+        data = {"kind": "_rule_program", "op": op, "tenant": tenant,
+                "token": token, "payload": payload}
+        self._publish(token.encode(),
+                      msgpack.packb(data, use_bin_type=True))
+
+    def _apply_rule_program(self, data: Dict) -> None:
+        # an invalid spec raises the structured RuleProgramError (409,
+        # names the offending node) out of apply_replicated_rule_program
+        # BEFORE any local mutation — _handle treats it as a
+        # non-retryable conflict toward the retry budget / dead letter,
+        # never a stack-trace crash of the applier
+        if self.instance.apply_replicated_rule_program(
                 data.get("op", ""), data.get("tenant", ""),
                 data.get("token", ""), data.get("payload")):
             self.applied += 1
@@ -1117,6 +1142,9 @@ class RegistryGossip:
             return
         if kind == "_scripted_rule":
             self._apply_scripted_rule(data)
+            return
+        if kind == "_rule_program":
+            self._apply_rule_program(data)
             return
         cls = _gossip_class(kind)
         if cls is None:

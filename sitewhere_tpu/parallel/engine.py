@@ -240,8 +240,43 @@ class ShardedPipelineEngine(PipelineEngine):
                 np.broadcast_to(a, (S,) + a.shape)), local)
         shard0 = NamedSharding(self.mesh, P(SHARD_AXIS))
         self._state = _put_global_tree(stacked, _tree_specs(stacked, shard0))
+        if self._rule_state is None:
+            self._rule_state = self._init_rule_state()
         self._refresh_params()
         self._build_step()
+
+    def _init_rule_state(self):
+        # rule-program state rides the shard axis with the other state
+        # tensors: per-shard [S, D/S, P, slots] device lanes plus
+        # per-shard [S, P] generation/counter rows (counters are additive
+        # partials, summed on read like the tenant counters). Sized by
+        # _rule_state_dims: a [.., 1, 1] placeholder while no programs
+        # are installed (the stage is dropped at trace time).
+        from sitewhere_tpu.ops.stateful import init_rule_state_np
+
+        dims = self._rule_state_dims()
+        self._rule_state_built_dims = dims
+        S = self.n_shards
+        local = init_rule_state_np(
+            self.registry.devices.capacity // S, *dims)
+        stacked = jax.tree_util.tree_map(
+            lambda a: np.ascontiguousarray(
+                np.broadcast_to(a, (S,) + a.shape)), local)
+        shard0 = NamedSharding(self.mesh, P(SHARD_AXIS))
+        return _put_global_tree(stacked, _tree_specs(stacked, shard0))
+
+    def _build_step_blob(self) -> None:
+        # the single-chip jit is never used by the sharded engine; the
+        # collective program is built by _build_step instead
+        self._step_blob = None
+        self._step_built_config = self._step_static_config()
+
+    def _ensure_step_current(self) -> None:
+        if (self._sharded_step is not None
+                and getattr(self, "_sharded_built_config", None)
+                != self._step_static_config()):
+            self._ensure_rule_state_sized()
+            self._build_step()
 
     def _build_step(self) -> None:
         params_template = self._params
@@ -252,18 +287,23 @@ class ShardedPipelineEngine(PipelineEngine):
             device_type_idx=dev,
             threshold=_tree_specs(params_template.threshold, rep),
             zones=_tree_specs(params_template.zones, rep),
-            geofence=_tree_specs(params_template.geofence, rep))
+            geofence=_tree_specs(params_template.geofence, rep),
+            programs=_tree_specs(params_template.programs, rep))
         state_specs = _tree_specs(self._state, dev)
+        rule_state_specs = _tree_specs(self._rule_state, dev)
         blob_specs = dev  # [S, WIRE_ROWS, B] single staging blob, sharded on S
         out_specs = ProcessOutputs(
             valid=dev, unregistered=dev, threshold_fired=dev,
             threshold_first_rule=dev, threshold_alert_level=dev,
             geofence_fired=dev, geofence_first_rule=dev,
-            geofence_alert_level=dev, tenant_counts=rep, processed=rep,
+            geofence_alert_level=dev, program_fired=dev,
+            program_first_rule=dev, program_alert_level=dev,
+            tenant_counts=rep, processed=rep,
             alerts=rep,
             # per-shard compacted alert lanes ride the shard axis with
             # the other outputs — no extra collective, one host fetch
             alert_lanes=dev)
+        programs_enabled, node_limit = self._step_static_config()
 
         def sq(a):
             # shard_map hands blocks with the mapped axis kept (size 1); the
@@ -273,18 +313,23 @@ class ShardedPipelineEngine(PipelineEngine):
         def unsq(a):
             return a[None]
 
-        def sharded(params, state, blob):
+        def sharded(params, state, rule_state, blob):
             params = params.replace(
                 assignment_status=sq(params.assignment_status),
                 tenant_idx=sq(params.tenant_idx),
                 area_idx=sq(params.area_idx),
                 device_type_idx=sq(params.device_type_idx))
             state = jax.tree_util.tree_map(sq, state)
+            rule_state = jax.tree_util.tree_map(sq, rule_state)
             batch = blob_to_batch(sq(blob))          # [12, B] -> columns
-            new_state, out = process_batch(
-                params, state, batch, geofence_impl=self.geofence_impl,
-                alert_lane_capacity=self.alert_lane_capacity)
+            new_state, new_rule_state, out = process_batch(
+                params, state, rule_state, batch,
+                geofence_impl=self.geofence_impl,
+                alert_lane_capacity=self.alert_lane_capacity,
+                programs_enabled=programs_enabled,
+                program_node_limit=node_limit)
             new_state = jax.tree_util.tree_map(unsq, new_state)
+            new_rule_state = jax.tree_util.tree_map(unsq, new_rule_state)
             out = out.replace(
                 valid=unsq(out.valid), unregistered=unsq(out.unregistered),
                 threshold_fired=unsq(out.threshold_fired),
@@ -293,15 +338,19 @@ class ShardedPipelineEngine(PipelineEngine):
                 geofence_fired=unsq(out.geofence_fired),
                 geofence_first_rule=unsq(out.geofence_first_rule),
                 geofence_alert_level=unsq(out.geofence_alert_level),
+                program_fired=unsq(out.program_fired),
+                program_first_rule=unsq(out.program_first_rule),
+                program_alert_level=unsq(out.program_alert_level),
                 alert_lanes=unsq(out.alert_lanes),
                 tenant_counts=jax.lax.psum(out.tenant_counts, SHARD_AXIS),
                 processed=jax.lax.psum(out.processed, SHARD_AXIS),
                 alerts=jax.lax.psum(out.alerts, SHARD_AXIS))
-            return new_state, out
+            return new_state, new_rule_state, out
 
         specs = dict(mesh=self.mesh,
-                     in_specs=(params_specs, state_specs, blob_specs),
-                     out_specs=(state_specs, out_specs))
+                     in_specs=(params_specs, state_specs, rule_state_specs,
+                               blob_specs),
+                     out_specs=(state_specs, rule_state_specs, out_specs))
         try:
             # the geofence containment scan's carry is replicated only
             # through the psum at the end of the step — a loop invariant
@@ -310,7 +359,8 @@ class ShardedPipelineEngine(PipelineEngine):
             mapped = _shard_map(sharded, check_vma=False, **specs)
         except TypeError:  # older jax spells it check_rep
             mapped = _shard_map(sharded, check_rep=False, **specs)
-        self._sharded_step = jax.jit(mapped, donate_argnums=(1,))
+        self._sharded_step = jax.jit(mapped, donate_argnums=(1, 2))
+        self._sharded_built_config = (programs_enabled, node_limit)
 
     # -- params ---------------------------------------------------------------
 
@@ -318,6 +368,7 @@ class ShardedPipelineEngine(PipelineEngine):
         snap = self.registry.snapshot()
         threshold = self._compile_threshold_table()
         geofence = self._compile_geofence_table()
+        programs = self._compile_program_table()
         from sitewhere_tpu.ops.geofence import ZoneTable
         zones = ZoneTable(vertices=snap.zone_vertices, nvert=snap.zone_nvert,
                           tenant_idx=snap.zone_tenant, active=snap.zone_active)
@@ -330,13 +381,15 @@ class ShardedPipelineEngine(PipelineEngine):
             tenant_idx=router.shard_param(snap.tenant_idx),
             area_idx=router.shard_param(snap.area_idx),
             device_type_idx=router.shard_param(snap.device_type_idx),
-            threshold=threshold, zones=zones, geofence=geofence)
+            threshold=threshold, zones=zones, geofence=geofence,
+            programs=programs)
         shardings = PipelineParams(
             assignment_status=shard0, tenant_idx=shard0, area_idx=shard0,
             device_type_idx=shard0,
             threshold=_tree_specs(threshold, rep),
             zones=_tree_specs(zones, rep),
-            geofence=_tree_specs(geofence, rep))
+            geofence=_tree_specs(geofence, rep),
+            programs=_tree_specs(programs, rep))
         self._params = _put_global_tree(params, shardings)
         self._params_built_for = (snap.version, self._rules_version)
 
@@ -472,8 +525,8 @@ class ShardedPipelineEngine(PipelineEngine):
         view = staged.view
         with self._metrics.timer("step").time():
             with self._state_lock:  # vs concurrent readers (base __init__)
-                self._state, outputs = self._sharded_step(
-                    params, self._state, staged.blob)
+                self._state, self._rule_state, outputs = self._sharded_step(
+                    params, self._state, self._rule_state, staged.blob)
         if not self.is_multiprocess:
             # pooled-blob loan: returns on view GC; outputs.processed is
             # the transfer-completion guard (step executed => input read)
@@ -591,7 +644,10 @@ class ShardedPipelineEngine(PipelineEngine):
             geo_level=np.concatenate([d.geo_level for d in decs]),
             fired_rows=sum(d.fired_rows for d in decs),
             dropped_alerts=sum(d.dropped_alerts for d in decs),
-            total_alerts=sum(d.total_alerts for d in decs))
+            total_alerts=sum(d.total_alerts for d in decs),
+            prog_fired=np.concatenate([d.prog_fired for d in decs]),
+            prog_rule=np.concatenate([d.prog_rule for d in decs]),
+            prog_level=np.concatenate([d.prog_level for d in decs]))
         dev_rows = (dev.reshape(-1)[rows_flat] * self.n_shards + shard_of)
         ts_rows = ts.reshape(-1)[rows_flat]
         bounded = self._bound_alert_rows(combined, max_alerts)
@@ -800,6 +856,119 @@ class ShardedPipelineEngine(PipelineEngine):
                 out[f.name] = jax.device_put(local, shard0)
         with self._state_lock:
             self._state = DeviceStateTensors(**out)
+
+    # -- rule-program state layouts ----------------------------------------
+
+    _RULE_STATE_DEVICE_FIELDS = ("value", "aux", "ts", "counter",
+                                 "root_prev", "row_gen")
+    _RULE_STATE_PROGRAM_FIELDS = ("gen", "fire_count", "suppress_count")
+
+    def canonical_rule_state(self):
+        """Flat device-major rule-program state snapshot, mirroring
+        canonical_state: device-indexed lanes un-shard via the router
+        layout; per-shard fire/suppress counters (additive partials) sum;
+        `gen` takes the per-slot max (every shard steps in lockstep, so
+        they agree whenever a step has run since the last install)."""
+        import dataclasses as _dc
+
+        import jax.numpy as jnp
+
+        if self._rule_state is None:
+            return None
+        if self.is_multiprocess:
+            from sitewhere_tpu.errors import ErrorCode, SiteWhereError
+
+            raise SiteWhereError(
+                "multi-host canonical gather is not available on a live "
+                "cluster; merge per-host checkpoints offline with "
+                "assemble-checkpoint", ErrorCode.GENERIC, http_status=409)
+        with self._state_lock:
+            snap = jax.tree_util.tree_map(jnp.copy, self._rule_state)
+        out = {}
+        for f in _dc.fields(snap):
+            a = np.asarray(getattr(snap, f.name))
+            if f.name in ("fire_count", "suppress_count"):
+                out[f.name] = a.sum(0, dtype=a.dtype)
+            elif f.name == "gen":
+                out[f.name] = a.max(0)
+            else:
+                out[f.name] = self.router.unshard_param(a)
+        from sitewhere_tpu.ops.stateful import RuleStateTensors
+        return RuleStateTensors(**out)
+
+    def load_canonical_rule_state(self, rule_state) -> None:
+        import dataclasses as _dc
+
+        from sitewhere_tpu.ops.stateful import RuleStateTensors
+
+        self._validate_canonical_rule_state(rule_state)
+        S = self.n_shards
+        out = {}
+        for f in _dc.fields(RuleStateTensors):
+            a = np.asarray(getattr(rule_state, f.name))
+            if f.name in self._RULE_STATE_PROGRAM_FIELDS:
+                stacked = np.zeros((S,) + a.shape, a.dtype)
+                if f.name == "gen":
+                    # generations must match on EVERY shard or the next
+                    # step's stale check would wipe the restored state
+                    stacked[:] = a
+                else:
+                    stacked[0] = a  # additive counters land on shard 0
+                out[f.name] = stacked
+            else:
+                out[f.name] = self.router.shard_param(a)
+        stacked_state = RuleStateTensors(**out)
+        shard0 = NamedSharding(self.mesh, P(SHARD_AXIS))
+        with self._state_lock:
+            self._rule_state = _put_global_tree(
+                stacked_state, _tree_specs(stacked_state, shard0))
+            self._rule_state_built_dims = self._rule_state_dims()
+
+    def local_rule_state_blocks(self):
+        """THIS host's shard blocks of the rule-program state (the
+        per-host complement of canonical_rule_state; same contract as
+        local_state_shards — pure local D2H, no collective)."""
+        import dataclasses as _dc
+
+        if self._rule_state is None:
+            return None
+        with self._state_lock:
+            blocks = {}
+            for f in _dc.fields(self._rule_state):
+                arr = getattr(self._rule_state, f.name)
+                blocks[f.name] = (self._gather_local(arr)
+                                  if self.is_multiprocess
+                                  else np.asarray(arr))
+        return blocks
+
+    def load_local_rule_state_blocks(self, blocks) -> None:
+        import dataclasses as _dc
+
+        from sitewhere_tpu.ops.stateful import RuleStateTensors
+
+        shard0 = NamedSharding(self.mesh, P(SHARD_AXIS))
+        S = self.n_shards
+        canonical = self._expected_rule_state_shapes()
+        out = {}
+        for f in _dc.fields(RuleStateTensors):
+            local = np.ascontiguousarray(blocks[f.name])
+            flat = canonical[f.name]
+            expect = ((S, flat[0] // S) + flat[1:]
+                      if f.name not in self._RULE_STATE_PROGRAM_FIELDS
+                      else (S,) + flat)
+            global_shape = (S,) + tuple(local.shape[1:])
+            if tuple(global_shape) != tuple(expect):
+                raise ValueError(
+                    f"host-shard rule-state field {f.name}: global shape "
+                    f"{global_shape} != engine {tuple(expect)}")
+            if self.is_multiprocess:
+                out[f.name] = jax.make_array_from_process_local_data(
+                    shard0, local, global_shape)
+            else:
+                out[f.name] = jax.device_put(local, shard0)
+        with self._state_lock:
+            self._rule_state = RuleStateTensors(**out)
+            self._rule_state_built_dims = self._rule_state_dims()
 
     def pending_overflow_batch(self) -> Optional[EventBatch]:
         """The parked overflow rows as a flat host batch (checkpoint saves

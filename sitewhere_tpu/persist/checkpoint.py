@@ -90,6 +90,35 @@ def _shift_ts(array: np.ndarray, delta_ms: int) -> np.ndarray:
                     array + np.int32(delta_ms)).astype(array.dtype)
 
 
+# rule-program state fields with a device-major leading axis (the rest —
+# gen/fire_count/suppress_count — are program-indexed and move verbatim)
+_RULE_STATE_DEVICE_FIELDS = ("value", "aux", "ts", "counter", "root_prev",
+                             "row_gen")
+
+
+def _permute_rule_state_rows(kwargs: Dict[str, np.ndarray],
+                             perm: np.ndarray) -> Dict[str, np.ndarray]:
+    """Re-index the rule state's device-major rows old -> perm[old]
+    (elastic restore, mirrors _permute_device_rows): untouched rows keep
+    init sentinels so unmapped devices start temporal windows fresh."""
+    from sitewhere_tpu.ops.stateful import init_rule_state_np
+
+    sample = kwargs["value"]
+    init = init_rule_state_np(sample.shape[0], sample.shape[1],
+                              sample.shape[2])
+    out = {}
+    old_idx = np.nonzero(perm)[0]
+    new_idx = perm[old_idx]
+    for name, array in kwargs.items():
+        if name not in _RULE_STATE_DEVICE_FIELDS:
+            out[name] = array
+            continue
+        fresh = np.array(getattr(init, name))
+        fresh[new_idx] = array[old_idx]
+        out[name] = fresh
+    return out
+
+
 def _install_overflow(engine, overflow_cols: Dict[str, np.ndarray]) -> None:
     """Hand a restored overflow backlog to the engine: engines with a
     pending-overflow slot park it (drained before the next checkpoint);
@@ -319,6 +348,18 @@ def assemble_canonical(paths: List[str]):
             if rule.get("token") not in seen_rules:
                 seen_rules.add(rule.get("token"))
                 rules.append(rule)
+    # rule programs union by token with slot/epoch STRIPPED: per-host
+    # slot assignment is host-local, so assembled restores re-install
+    # fresh (temporal windows restart; the per-host rulestate arrays are
+    # intentionally not merged — cross-host slot spaces don't line up)
+    rule_programs: List[Dict] = []
+    seen_programs = set()
+    for manifest, _ in loads:
+        for row in manifest.get("rule_programs", []):
+            token = (row.get("spec") or {}).get("token")
+            if token and token not in seen_programs:
+                seen_programs.add(token)
+                rule_programs.append({"spec": dict(row["spec"])})
     out_manifest: Dict[str, Any] = {
         "epoch_base_ms": base,
         "interners": {"devices": device_tokens,
@@ -328,6 +369,7 @@ def assemble_canonical(paths: List[str]):
         "offsets": {},
         "pending_alerts": pending_alerts,
         "rules": rules,
+        "rule_programs": rule_programs,
         "assembled_from": [os.path.basename(p) for p in paths],
     }
     return out_manifest, canonical, overflow_cols
@@ -398,6 +440,12 @@ class PipelineCheckpointer:
             shard_ids, blocks = engine.local_state_shards()
             arrays = {f"state.{name}": np.asarray(block)
                       for name, block in blocks.items()}
+            rule_blocks = (engine.local_rule_state_blocks()
+                           if hasattr(engine, "local_rule_state_blocks")
+                           else None)
+            if rule_blocks:
+                arrays.update({f"rulestate.{name}": np.asarray(block)
+                               for name, block in rule_blocks.items()})
             overflow = engine.pending_overflow_batch()
             if overflow is not None:
                 for f in dataclasses.fields(overflow):
@@ -421,6 +469,19 @@ class PipelineCheckpointer:
                 f"state.{f.name}": np.asarray(getattr(state, f.name))
                 for f in dataclasses.fields(state)
             }
+            # rule-program temporal state travels with the device state
+            # (AFTER the drain above — drained rows advance it) so a
+            # restart resumes debounce/for-duration/hysteresis windows
+            # mid-flight, re-joined to its programs by the manifest's
+            # pinned slot/epoch assignment
+            rule_state = (engine.canonical_rule_state()
+                          if hasattr(engine, "canonical_rule_state")
+                          else None)
+            if rule_state is not None:
+                arrays.update({
+                    f"rulestate.{f.name}": np.asarray(
+                        getattr(rule_state, f.name))
+                    for f in dataclasses.fields(rule_state)})
         packer = engine.packer
         manifest: Dict[str, Any] = {
             "epoch_base_ms": packer.epoch_base_ms,
@@ -446,6 +507,11 @@ class PipelineCheckpointer:
             # engine — a restart must not silently drop the operator's
             # alerting (pipeline/engine.py rule management surface)
             "rules": self._rules_manifest(engine),
+            # rule programs with their runtime (slot, epoch) assignment:
+            # restore re-pins temporal state to its program mid-window
+            "rule_programs": (engine.rule_program_manifest()
+                              if hasattr(engine, "rule_program_manifest")
+                              else []),
             **(extra_manifest or {}),
             **layout,
         }
@@ -483,11 +549,23 @@ class PipelineCheckpointer:
                 key[len("overflow."):]: np.asarray(data[key])
                 for key in data.files if key.startswith("overflow.")
             }
+            rule_state_cols = {
+                key[len("rulestate."):]: np.asarray(data[key])
+                for key in data.files if key.startswith("rulestate.")
+            }
         packer = engine.packer
+        # rule programs re-install FIRST (they only mutate host lists):
+        # the restored rule state's per-slot generations must meet their
+        # matching table epochs on the next compile, or the stale-slot
+        # check would wipe the mid-window temporal state it pins
+        self._restore_rule_programs(engine, manifest.get("rule_programs"))
         if manifest.get("layout") == "host-shards":
             # per-host gang-restart checkpoint: same-topology restore of
             # this host's shard blocks + the verbatim overflow batch
             engine.load_local_state_shards(manifest["shard_ids"], kwargs)
+            if rule_state_cols and hasattr(engine,
+                                           "load_local_rule_state_blocks"):
+                engine.load_local_rule_state_blocks(rule_state_cols)
             if overflow_cols:
                 from sitewhere_tpu.ops.pack import EventBatch
 
@@ -502,6 +580,9 @@ class PipelineCheckpointer:
                 engine, manifest["interners"]["devices"])
             if perm is not None:
                 kwargs = _permute_device_rows(kwargs, perm)
+                if rule_state_cols:
+                    rule_state_cols = _permute_rule_state_rows(
+                        rule_state_cols, perm)
                 if overflow_cols:
                     valid_rows = overflow_cols["device_idx"] < len(perm)
                     overflow_cols["device_idx"] = np.where(
@@ -510,6 +591,19 @@ class PipelineCheckpointer:
                                      len(perm) - 1)],
                         0).astype(np.int32)
             engine.load_canonical_state(DeviceStateTensors(**kwargs))
+            if rule_state_cols and hasattr(engine,
+                                           "load_canonical_rule_state"):
+                from sitewhere_tpu.ops.stateful import RuleStateTensors
+
+                try:
+                    engine.load_canonical_rule_state(
+                        RuleStateTensors(**rule_state_cols))
+                except (TypeError, ValueError):
+                    import logging
+
+                    logging.getLogger("sitewhere.checkpoint").exception(
+                        "rule-program state did not restore (bucket "
+                        "mismatch); temporal windows restart fresh")
         packer.epoch_base_ms = manifest["epoch_base_ms"]
         packer.measurements.restore(manifest["interners"]["measurements"])
         packer.alert_types.restore(manifest["interners"]["alert_types"])
@@ -599,6 +693,27 @@ class PipelineCheckpointer:
         for data in rules:
             kind, rule = rule_from_dict(dict(data))
             engine.upsert_rule(kind, rule)
+
+    @staticmethod
+    def _restore_rule_programs(engine, rows: Optional[List[Dict]]) -> None:
+        """Re-install checkpointed rule programs, pinning each to its
+        saved (slot, epoch) so the restored RuleStateTensors generations
+        line up and temporal operators resume mid-window. A program the
+        engine's static buckets cannot hold logs and skips (its slot's
+        state resets) rather than failing the whole restore."""
+        if not rows or not hasattr(engine, "upsert_rule_program"):
+            return
+        for row in rows:
+            try:
+                engine.upsert_rule_program(dict(row.get("spec") or {}),
+                                           slot=row.get("slot"),
+                                           epoch=row.get("epoch"))
+            except Exception:
+                import logging
+
+                logging.getLogger("sitewhere.checkpoint").exception(
+                    "checkpointed rule program %r did not restore",
+                    (row.get("spec") or {}).get("token"))
 
     # -- recovery ----------------------------------------------------------
     def recover(self, engine, bus, topic: str, group_id: str,
@@ -715,6 +830,10 @@ class InstanceCheckpointManager:
         extra = {
             "scripts": self.instance.script_manager.export_state(),
             "scripted_rules": self.instance.scripted_rules.export_state(),
+            # the durable LWW store state (tenant scoping + stamps) rides
+            # alongside the engine's slot/epoch manifest ("rule_programs")
+            "rule_program_installs":
+                self.instance.rule_programs.export_state(),
             "provisioning": export_provisioning(self.instance),
         }
         return self.checkpointer.save(
@@ -803,6 +922,19 @@ class InstanceCheckpointManager:
             self.instance.scripted_rules.apply_add(
                 row["tenant"], row["token"], row["script"],
                 int(row.get("stamp", 0)))
+        for row in (manifest.get("rule_program_installs") or {}).get(
+                "installs", []):
+            try:
+                self.instance.apply_replicated_rule_program(
+                    "add", row["tenant"], row["token"],
+                    {"spec": row["spec"],
+                     "stamp": int(row.get("stamp", 0))})
+            except Exception:
+                import logging
+
+                logging.getLogger("sitewhere.checkpoint").exception(
+                    "checkpointed rule program %s/%s did not restore",
+                    row.get("tenant"), row.get("token"))
 
     # -- lifecycle ---------------------------------------------------------
     def _on_start(self) -> None:

@@ -45,33 +45,44 @@ def materialize_alerts_maskscan(engine, batch, outputs,
                                 ) -> List[DeviceAlert]:
     """The pre-lane mask-scan materializer, kept verbatim as the
     differential-test oracle and micro-bench reference for the
-    device-compacted alert lanes (docs/ALERT_LANES.md): fetch the six
+    device-compacted alert lanes (docs/ALERT_LANES.md): fetch the
     per-row mask/level/rule arrays (two phases on big batches), nonzero
     the fired mask on the host, and walk fired rows with per-row
     `token_of` lookups. Flat batches/outputs only (the sharded engine
     flattens before delegating — tests do the same); returns ALL fired
-    rows' alerts and never touches engine counters or pending stashes."""
+    rows' alerts and never touches engine counters or pending stashes.
+    Rule-program fires (outputs.program_*) emit after the per-row
+    threshold/geofence alerts — the same within-row order the lane
+    materializer uses."""
     small_batch = outputs.threshold_fired.size <= 16384
     if small_batch:
-        (thr_fired, geo_fired, thr_level, geo_level, thr_rule,
-         geo_rule) = jax.device_get(
+        (thr_fired, geo_fired, prog_fired, thr_level, geo_level, prog_level,
+         thr_rule, geo_rule, prog_rule) = jax.device_get(
             (outputs.threshold_fired, outputs.geofence_fired,
+             outputs.program_fired,
              outputs.threshold_alert_level, outputs.geofence_alert_level,
-             outputs.threshold_first_rule, outputs.geofence_first_rule))
+             outputs.program_alert_level,
+             outputs.threshold_first_rule, outputs.geofence_first_rule,
+             outputs.program_first_rule))
     else:
-        thr_fired, geo_fired = jax.device_get(
-            (outputs.threshold_fired, outputs.geofence_fired))
-    fired_rows = np.nonzero(thr_fired | geo_fired)[0]
+        thr_fired, geo_fired, prog_fired = jax.device_get(
+            (outputs.threshold_fired, outputs.geofence_fired,
+             outputs.program_fired))
+    fired_rows = np.nonzero(thr_fired | geo_fired | prog_fired)[0]
     if fired_rows.size == 0:
         return []
     if not small_batch:
-        thr_level, geo_level, thr_rule, geo_rule = jax.device_get(
+        (thr_level, geo_level, prog_level, thr_rule, geo_rule,
+         prog_rule) = jax.device_get(
             (outputs.threshold_alert_level, outputs.geofence_alert_level,
-             outputs.threshold_first_rule, outputs.geofence_first_rule))
+             outputs.program_alert_level,
+             outputs.threshold_first_rule, outputs.geofence_first_rule,
+             outputs.program_first_rule))
     device_idx = np.asarray(batch.device_idx)
     ts = np.asarray(batch.ts)
     rules = engine.list_rules()
     thr_rules, geo_rules = rules["threshold"], rules["geofence"]
+    programs = engine.rule_programs_by_slot()
     alerts: List[DeviceAlert] = []
     for row in fired_rows:
         token = engine.registry.devices.token_of(int(device_idx[row])) or ""
@@ -90,6 +101,15 @@ def materialize_alerts_maskscan(engine, batch, outputs,
                 level=AlertLevel(int(geo_level[row])), type=rule.alert_type,
                 message=rule.alert_message
                 or f"geofence rule {rule.token} fired",
+                event_date=engine.packer.abs_ts(int(ts[row]))))
+        if prog_fired[row] and int(prog_rule[row]) in programs:
+            spec = programs[int(prog_rule[row])]
+            alerts.append(DeviceAlert(
+                device_id=token, source=AlertSource.SYSTEM,
+                level=AlertLevel(int(prog_level[row])),
+                type=spec["alert_type"],
+                message=spec["alert_message"]
+                or f"rule program {spec['token']} fired",
                 event_date=engine.packer.abs_ts(int(ts[row]))))
     return alerts
 
@@ -207,9 +227,13 @@ class PipelineEngine(LifecycleComponent):
                  max_threshold_rules: int = 256, max_geofence_rules: int = 256,
                  presence_missing_interval_ms: int = 8 * 60 * 60 * 1000,
                  name: str = "pipeline-engine", geofence_impl: str = "auto",
-                 alert_lane_capacity: Optional[int] = None):
+                 alert_lane_capacity: Optional[int] = None,
+                 max_rule_programs: int = 32,
+                 rule_program_nodes: int = 16,
+                 rule_program_state_slots: int = 8):
         from sitewhere_tpu.ops.compact import (
             DEFAULT_ALERT_LANE_CAPACITY, MIN_ALERT_LANE_CAPACITY)
+        from sitewhere_tpu.rules.compiler import MAX_PROGRAM_BUCKET
 
         super().__init__(name)
         self.registry = registry_tensors
@@ -222,6 +246,14 @@ class PipelineEngine(LifecycleComponent):
         if max(max_threshold_rules, max_geofence_rules) >= (1 << 15):
             raise ValueError("rule table capacity must be < 32768 "
                              "(alert-lane rule-id field width)")
+        # rule-program slot ids travel in 8 alert-lane meta bits
+        if not (0 < max_rule_programs <= MAX_PROGRAM_BUCKET):
+            raise ValueError(
+                f"max_rule_programs must be in 1..{MAX_PROGRAM_BUCKET} "
+                f"(alert-lane program-id field width)")
+        self.max_rule_programs = max_rule_programs
+        self.rule_program_nodes = rule_program_nodes
+        self.rule_program_state_slots = rule_program_state_slots
         self.alert_lane_capacity = (alert_lane_capacity
                                     if alert_lane_capacity is not None
                                     else DEFAULT_ALERT_LANE_CAPACITY)
@@ -233,6 +265,15 @@ class PipelineEngine(LifecycleComponent):
 
         self._threshold_rules: List[ThresholdRule] = []
         self._geofence_rules: List[GeofenceRule] = []
+        # rule programs: token -> {"slot", "epoch", "spec"} with STABLE
+        # slot assignment (lowest free slot on install) — per-(device,
+        # program) temporal state is keyed by slot, and the epoch
+        # generation makes a recycled slot reset its state inside the
+        # fused step (rules/compiler.py RuleProgramTable.epoch)
+        self._rule_programs: Dict[str, Dict] = {}
+        self._program_epoch = 0
+        self._programs_enabled = False
+        self._rule_state = None
         self._rules_version = 0
         # (op, kind, rule-or-token) feed over rule mutations — the rule
         # management surface rides it (REST audit, cluster replication)
@@ -255,12 +296,7 @@ class PipelineEngine(LifecycleComponent):
         from sitewhere_tpu.ops.geofence import resolve_geofence_impl
         self.geofence_impl = resolve_geofence_impl(
             geofence_impl, self._target_platform())
-        def step_blob(params, state, blob):
-            return process_batch(params, state, blob_to_batch(blob),
-                                 geofence_impl=self.geofence_impl,
-                                 alert_lane_capacity=self.alert_lane_capacity)
-
-        self._step_blob = jax.jit(step_blob, donate_argnums=(1,))
+        self._build_step_blob()
         self._presence = jax.jit(check_presence, donate_argnums=(0,))
         self.batches_processed = 0
         # bounded materialization (max_alerts) AND alert-lane overflow
@@ -291,11 +327,70 @@ class PipelineEngine(LifecycleComponent):
         their mesh devices)."""
         return jax.default_backend()
 
+    def _step_static_config(self):
+        """Trace-time statics of the program stage: (enabled, node trim).
+        A change — programs going empty<->non-empty, or a program using
+        more node slots than any before — rebuilds the jit (rare; a
+        normal table edit reuses the compiled program like any other
+        params refresh)."""
+        return (self._programs_enabled,
+                getattr(self, "_program_nodes_in_use", 0))
+
+    def _build_step_blob(self) -> None:
+        """(Re)build the jitted fused step. Called at construction and on
+        the rare program-stage static transitions: the stage is dropped
+        at TRACE time when no programs are installed, so the common case
+        pays nothing — one recompile per transition, like any other
+        static-shape change."""
+        programs_enabled, node_limit = self._step_static_config()
+
+        def step_blob(params, state, rule_state, blob):
+            return process_batch(params, state, rule_state,
+                                 blob_to_batch(blob),
+                                 geofence_impl=self.geofence_impl,
+                                 alert_lane_capacity=self.alert_lane_capacity,
+                                 programs_enabled=programs_enabled,
+                                 program_node_limit=node_limit)
+
+        self._step_blob = jax.jit(step_blob, donate_argnums=(1, 2))
+        self._step_built_config = (programs_enabled, node_limit)
+
+    def _ensure_step_current(self) -> None:
+        if self._step_built_config != self._step_static_config():
+            self._ensure_rule_state_sized()
+            self._build_step_blob()
+
+    def _rule_state_dims(self):
+        """(P, S) the resident RuleStateTensors are sized for. With NO
+        programs installed the stage is dropped at trace time and the
+        state is a pass-through, so a [D, 1, 1] placeholder keeps the
+        empty case free — the full [D, P, S] group allocates on the
+        empty->non-empty transition, alongside the step rebuild."""
+        if self._programs_enabled:
+            return (self.max_rule_programs, self.rule_program_state_slots)
+        return (1, 1)
+
+    def _init_rule_state(self):
+        from sitewhere_tpu.ops.stateful import init_rule_state
+
+        dims = self._rule_state_dims()
+        self._rule_state_built_dims = dims
+        return init_rule_state(self.registry.devices.capacity, *dims)
+
+    def _ensure_rule_state_sized(self) -> None:
+        if (self._rule_state is not None
+                and getattr(self, "_rule_state_built_dims", None)
+                != self._rule_state_dims()):
+            with self._state_lock:
+                self._rule_state = self._init_rule_state()
+
     # -- lifecycle ------------------------------------------------------------
 
     def on_initialize(self, monitor) -> None:
         self._state = init_device_state(self.registry.devices.capacity,
                                         self.measurement_slots, self.max_tenants)
+        if self._rule_state is None:
+            self._rule_state = self._init_rule_state()
         self._refresh_params()
 
     def on_start(self, monitor) -> None:
@@ -451,6 +546,195 @@ class PipelineEngine(LifecycleComponent):
             table.alert_type_idx[i] = self.packer.alert_types.intern(rule.alert_type)
         return table
 
+    # -- rule programs (CEP-lite compiler; rules/compiler.py) ---------------
+
+    def _compile_program_table(self):
+        from sitewhere_tpu.rules.compiler import (
+            compile_program_into, empty_program_table)
+
+        table = empty_program_table(self.max_rule_programs,
+                                    self.rule_program_nodes)
+        for entry in self._rule_programs.values():
+            compile_program_into(
+                table, entry["slot"], entry["spec"], entry["epoch"],
+                intern_measurement=self.packer.measurements.intern,
+                intern_alert_type=self.packer.alert_types.intern,
+                lookup_tenant=self.registry.tenants.lookup,
+                lookup_device_type=self.registry.device_types.lookup,
+                measurement_slots=self.measurement_slots,
+                max_state_slots=self.rule_program_state_slots)
+        # node slots actually populated, for the static unroll trim (the
+        # NOP opcode is 0, and node 0 of a used program is never NOP)
+        used = np.nonzero((table.opcode != 0).any(axis=0))[0]
+        self._program_nodes_in_use = int(used.max()) + 1 if used.size else 0
+        return table
+
+    def _validate_program_spec(self, spec: Dict) -> Dict:
+        """Full dry-run compile against THIS engine's static buckets and
+        interners: a spec that passes here turns into table rows without
+        crashing the hot path. Raises RuleProgramError (409, names the
+        offending node) otherwise — the structured-validation contract
+        shared by the REST and replicated-apply paths."""
+        from sitewhere_tpu.rules.compiler import dry_run_compile
+
+        return dry_run_compile(
+            spec, measurement_slots=self.measurement_slots,
+            max_nodes=self.rule_program_nodes,
+            max_state_slots=self.rule_program_state_slots,
+            intern_measurement=self.packer.measurements.intern)
+
+    def upsert_rule_program(self, spec: Dict, *, slot: Optional[int] = None,
+                            epoch: Optional[int] = None) -> Dict:
+        """Install or replace a rule program (idempotent — boot config,
+        checkpoint restore, cluster replication). A replace bumps the
+        slot's epoch so its temporal state resets inside the fused step.
+        `slot`/`epoch` pin the assignment on checkpoint restore so
+        mid-window temporal state lines back up with its program."""
+        from sitewhere_tpu.errors import ErrorCode, SiteWhereError
+
+        spec = self._validate_program_spec(spec)
+        token = spec["token"]
+        with self._rules_io_lock:
+            with self._lock:
+                existing = self._rule_programs.get(token)
+                if slot is None:
+                    if existing is not None:
+                        slot = existing["slot"]
+                    else:
+                        used = {e["slot"]
+                                for e in self._rule_programs.values()}
+                        free = [s for s in range(self.max_rule_programs)
+                                if s not in used]
+                        if not free:
+                            raise SiteWhereError(
+                                "rule program capacity exceeded "
+                                f"({self.max_rule_programs} slots)",
+                                ErrorCode.CAPACITY_EXCEEDED,
+                                http_status=409)
+                        slot = free[0]
+                if epoch is None:
+                    self._program_epoch += 1
+                    epoch = self._program_epoch
+                else:
+                    self._program_epoch = max(self._program_epoch, epoch)
+                entry = {"slot": int(slot), "epoch": int(epoch),
+                         "spec": spec}
+                self._rule_programs[token] = entry
+                self._programs_enabled = True
+                self._rules_version += 1
+            self._fire_rules("add", "program", dict(spec))
+        return entry
+
+    def create_rule_program(self, spec: Dict) -> Dict:
+        """REST create semantics: duplicate token 409s atomically."""
+        from sitewhere_tpu.errors import DuplicateTokenError
+
+        with self._lock:
+            token = (spec or {}).get("token")
+            if token in self._rule_programs:
+                raise DuplicateTokenError(
+                    f"rule program '{token}' already exists")
+        return self.upsert_rule_program(spec)
+
+    def remove_rule_program(self, token: str) -> bool:
+        with self._rules_io_lock:
+            with self._lock:
+                entry = self._rule_programs.pop(token, None)
+                if entry is None:
+                    return False
+                self._programs_enabled = bool(self._rule_programs)
+                self._rules_version += 1
+            self._fire_rules("remove", "program", token)
+        return True
+
+    def get_rule_program(self, token: str) -> Optional[Dict]:
+        with self._lock:
+            entry = self._rule_programs.get(token)
+            return dict(entry["spec"]) if entry else None
+
+    def list_rule_programs(self) -> List[Dict]:
+        """Program specs in slot order (the order fires resolve in)."""
+        with self._lock:
+            entries = sorted(self._rule_programs.values(),
+                             key=lambda e: e["slot"])
+            return [dict(e["spec"]) for e in entries]
+
+    def rule_programs_by_slot(self) -> Dict[int, Dict]:
+        with self._lock:
+            return {e["slot"]: dict(e["spec"])
+                    for e in self._rule_programs.values()}
+
+    def rule_program_manifest(self) -> List[Dict]:
+        """Checkpoint form: spec + the runtime (slot, epoch) assignment,
+        so a restore re-pins temporal state to its program mid-window."""
+        with self._lock:
+            return [{"slot": e["slot"], "epoch": e["epoch"],
+                     "spec": dict(e["spec"])}
+                    for e in sorted(self._rule_programs.values(),
+                                    key=lambda e: e["slot"])]
+
+    def rule_program_counters(self) -> Dict[str, Dict[str, int]]:
+        """Per-program cumulative fire/suppress counters (one on-demand
+        D2H fetch of two [P] vectors — never on the hot path). Counters
+        live in the rule state so they survive checkpoints; sharded
+        engines hold per-shard partials summed here."""
+        if self._rule_state is None:
+            return {}
+        with self._state_lock:
+            fires = np.asarray(self._rule_state.fire_count)
+            supp = np.asarray(self._rule_state.suppress_count)
+        if fires.ndim == 2:  # sharded [S, P] partials
+            fires, supp = fires.sum(0), supp.sum(0)
+        with self._lock:
+            # a slot past the resident counter row means the full-size
+            # state hasn't stepped yet (program installed, no submit) —
+            # its counters are zero by definition
+            return {token: {"fires": int(fires[e["slot"]])
+                            if e["slot"] < fires.shape[0] else 0,
+                            "suppressed": int(supp[e["slot"]])
+                            if e["slot"] < supp.shape[0] else 0}
+                    for token, e in self._rule_programs.items()}
+
+    # -- rule-program state (checkpointing) ---------------------------------
+
+    def canonical_rule_state(self):
+        """Host snapshot of the rule-program temporal state, flat
+        device-major like canonical_state (sharded engine overrides)."""
+        import jax.numpy as jnp
+
+        if self._rule_state is None:
+            return None
+        with self._state_lock:
+            snap = jax.tree_util.tree_map(jnp.copy, self._rule_state)
+        return jax.tree_util.tree_map(lambda a: np.asarray(a), snap)
+
+    def _expected_rule_state_shapes(self):
+        """Canonical (flat device-major) shape per rule-state field for
+        THIS engine's current program dims — what checkpoints must match
+        (computed, not allocated: the resident state may still be the
+        no-programs placeholder when a restore re-installs programs)."""
+        D = self.registry.devices.capacity
+        P, S = self._rule_state_dims()
+        return {"value": (D, P, S), "aux": (D, P, S), "ts": (D, P, S),
+                "counter": (D, P, S), "root_prev": (D, P),
+                "row_gen": (D, P), "gen": (P,), "fire_count": (P,),
+                "suppress_count": (P,)}
+
+    def _validate_canonical_rule_state(self, rule_state) -> None:
+        for name, want in self._expected_rule_state_shapes().items():
+            got = tuple(np.asarray(getattr(rule_state, name)).shape)
+            if got != want:
+                raise ValueError(
+                    f"rule-state checkpoint shape mismatch for {name}: "
+                    f"got {got}, engine expects {want} (program bucket/"
+                    f"state slots/device capacity must match)")
+
+    def load_canonical_rule_state(self, rule_state) -> None:
+        self._validate_canonical_rule_state(rule_state)
+        with self._state_lock:
+            self._rule_state = jax.device_put(rule_state)
+            self._rule_state_built_dims = self._rule_state_dims()
+
     # -- params refresh -------------------------------------------------------
 
     def _refresh_params(self) -> None:
@@ -458,6 +742,7 @@ class PipelineEngine(LifecycleComponent):
             snap = self.registry.snapshot()
             threshold = self._compile_threshold_table()
             geofence = self._compile_geofence_table()
+            programs = self._compile_program_table()
             zones = ZoneTable(vertices=snap.zone_vertices, nvert=snap.zone_nvert,
                               tenant_idx=snap.zone_tenant, active=snap.zone_active)
             self._params = jax.device_put(PipelineParams(
@@ -465,12 +750,14 @@ class PipelineEngine(LifecycleComponent):
                 tenant_idx=snap.tenant_idx,
                 area_idx=snap.area_idx,
                 device_type_idx=snap.device_type_idx,
-                threshold=threshold, zones=zones, geofence=geofence))
+                threshold=threshold, zones=zones, geofence=geofence,
+                programs=programs))
             self._params_built_for = (snap.version, self._rules_version)
 
     def _ensure_params(self) -> PipelineParams:
         if self._params_built_for != (self.registry.version, self._rules_version):
             self._refresh_params()
+        self._ensure_step_current()
         assert self._params is not None
         return self._params
 
@@ -550,11 +837,13 @@ class PipelineEngine(LifecycleComponent):
         here would force a D2H sync on the hot path)."""
         if self._state is None:  # lazy init for direct (un-started) use
             self.initialize()  # full lifecycle init so a later start() won't re-init
+        if self._rule_state is None:  # set_state() without lifecycle init
+            self._rule_state = self._init_rule_state()
         params = self._ensure_params()
         with self._metrics.timer("step").time():
             with self._state_lock:
-                self._state, outputs = self._step_blob(params, self._state,
-                                                       blob)
+                self._state, self._rule_state, outputs = self._step_blob(
+                    params, self._state, self._rule_state, blob)
         if isinstance(blob, np.ndarray):
             # ring-slot transfer guard: the implicit jit transfer of a
             # numpy blob completes no later than the step's outputs
@@ -645,15 +934,19 @@ class PipelineEngine(LifecycleComponent):
         with self._lock:
             thr_rules = list(self._threshold_rules)
             geo_rules = list(self._geofence_rules)
+        programs = self.rule_programs_by_slot()
         tokens = self.registry.devices.token_array()[dev_rows].tolist()
         dates = (ts_rows.astype(np.int64)
                  + self.packer.epoch_base_ms).tolist()
         thr_f = dec.thr_fired.tolist()
         geo_f = dec.geo_fired.tolist()
+        prog_f = dec.prog_fired.tolist()
         thr_r = dec.thr_rule.tolist()
         geo_r = dec.geo_rule.tolist()
+        prog_r = dec.prog_rule.tolist()
         thr_l = dec.thr_level.tolist()
         geo_l = dec.geo_level.tolist()
+        prog_l = dec.prog_level.tolist()
         n_thr, n_geo = len(thr_rules), len(geo_rules)
         levels = _ALERT_LEVELS
         alerts: List[DeviceAlert] = []
@@ -676,6 +969,15 @@ class PipelineEngine(LifecycleComponent):
                     type=rule.alert_type,
                     message=rule.alert_message
                     or f"geofence rule {rule.token} fired",
+                    event_date=dates[i]))
+            if prog_f[i] and prog_r[i] in programs:
+                spec = programs[prog_r[i]]
+                alerts.append(DeviceAlert(
+                    device_id=token, source=AlertSource.SYSTEM,
+                    level=levels.get(prog_l[i]) or AlertLevel(prog_l[i]),
+                    type=spec["alert_type"],
+                    message=spec["alert_message"]
+                    or f"rule program {spec['token']} fired",
                     event_date=dates[i]))
         return alerts
 

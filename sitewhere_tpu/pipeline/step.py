@@ -37,8 +37,12 @@ from sitewhere_tpu.ops.pack import EventBatch
 from sitewhere_tpu.ops.segments import (
     count_by_key, last_by_key, scatter_max_by_key,
 )
+from sitewhere_tpu.ops.stateful import (
+    RuleStateTensors, eval_rule_programs, observations_of_batch,
+)
 from sitewhere_tpu.ops.threshold import ThresholdRuleTable, eval_threshold_rules
 from sitewhere_tpu.pipeline.state_tensors import DeviceStateTensors
+from sitewhere_tpu.rules.compiler import RuleProgramTable
 
 _NEG = -(2 ** 31)
 
@@ -57,6 +61,9 @@ class PipelineParams:
     threshold: ThresholdRuleTable
     zones: ZoneTable
     geofence: GeofenceRuleTable
+    # compiled rule programs (rules/compiler.py); replicated like the
+    # other rule tables on sharded meshes
+    programs: RuleProgramTable
 
 
 @struct.dataclass
@@ -72,6 +79,11 @@ class ProcessOutputs:
     geofence_fired: jnp.ndarray     # bool [B]
     geofence_first_rule: jnp.ndarray   # int32 [B]
     geofence_alert_level: jnp.ndarray  # int32 [B]
+    # composite rule-program fires mapped to their attach rows (the
+    # device's last tracked-measurement row this step — ops/stateful.py)
+    program_fired: jnp.ndarray      # bool [B]
+    program_first_rule: jnp.ndarray    # int32 [B] program slot, -1 = none
+    program_alert_level: jnp.ndarray   # int32 [B]
     tenant_counts: jnp.ndarray      # int32 [T] events this batch per tenant
     processed: jnp.ndarray          # int32 scalar, valid events
     alerts: jnp.ndarray             # int32 scalar, alerts fired
@@ -84,16 +96,27 @@ class ProcessOutputs:
 
 
 def process_batch(params: PipelineParams, state: DeviceStateTensors,
-                  batch: EventBatch, *, geofence_impl: str = "xla",
-                  alert_lane_capacity: int = DEFAULT_ALERT_LANE_CAPACITY
-                  ) -> Tuple[DeviceStateTensors, ProcessOutputs]:
-    """One fused step. Shapes static; jit/shard_map safe; donate `state`.
+                  rule_state: RuleStateTensors, batch: EventBatch, *,
+                  geofence_impl: str = "xla",
+                  alert_lane_capacity: int = DEFAULT_ALERT_LANE_CAPACITY,
+                  programs_enabled: bool = True,
+                  program_node_limit: int = 0
+                  ) -> Tuple[DeviceStateTensors, RuleStateTensors,
+                             ProcessOutputs]:
+    """One fused step. Shapes static; jit/shard_map safe; donate `state`
+    and `rule_state`.
 
     `geofence_impl` selects the containment kernel ("xla" scan,
     "pallas" TPU kernel, "pallas_interpret" for CPU tests) — resolved by the
     engines via ops.geofence.resolve_geofence_impl.
     `alert_lane_capacity` is the K of the compacted alert lanes (static;
     one cached program per capacity like any other shape).
+    `programs_enabled` (trace-time static) drops the whole rule-program
+    stage when no programs are installed, so the empty-table common case
+    costs nothing on the hot path (the engines rebuild the jit on the
+    rare empty<->non-empty transition, like any other shape change).
+    `program_node_limit` (also static) trims the unrolled node pass to
+    the slots the compiled table populates.
     """
     D = state.num_devices
     M = state.num_measurement_slots
@@ -154,11 +177,39 @@ def process_batch(params: PipelineParams, state: DeviceStateTensors,
         (state.last_alert_type, state.last_alert_level),
         (batch.alert_type_idx, batch.alert_level))
 
+    # ---- stage 3b: stateful rule programs (CEP-lite; ops/stateful.py) ------
+    # Runs BETWEEN the built-in rules and the stats so composite fires
+    # feed the same alert-lane compaction; reads the POST-fold
+    # measurement state so conditions across measurements that arrived in
+    # different events compose. Dropped at trace time when no programs
+    # are installed.
+    B = batch.device_idx.shape[0]
+    if programs_enabled:
+        obs_mm, _touched, now_d, attach_row = observations_of_batch(
+            batch, M, D)
+        # per-ROW evaluation: state gathers/scatters ride the batch's
+        # device rows (attach rows are the unique writers), so program
+        # evaluation costs O(batch), not O(device capacity)
+        rule_state, prog = eval_rule_programs(
+            params.programs, rule_state,
+            dev=dev, attach=attach_row,
+            obs_row=obs_mm[dev], now_row=now_d[dev],
+            lm_row=last_measurement[dev],
+            lmts_row=last_measurement_ts[dev],
+            tenant_row=params.tenant_idx[dev],
+            dtype_row=params.device_type_idx[dev],
+            node_limit=program_node_limit)
+    else:
+        prog = {"fired": jnp.zeros((B,), bool),
+                "first_rule": jnp.full((B,), -1, jnp.int32),
+                "alert_level": jnp.full((B,), -1, jnp.int32)}
+
     # ---- stage 4: stats (replaces Dropwizard meters / Kafka state topics) --
     tenant_counts = count_by_key(tenant, valid, T)
     alerts = (jnp.sum(thr["fired"], dtype=jnp.int32)
-              + jnp.sum(geo["fired"], dtype=jnp.int32))
-    alert_lanes = compact_alert_lanes(thr, geo, alert_lane_capacity)
+              + jnp.sum(geo["fired"], dtype=jnp.int32)
+              + jnp.sum(prog["fired"], dtype=jnp.int32))
+    alert_lanes = compact_alert_lanes(thr, geo, alert_lane_capacity, prog)
 
     new_state = DeviceStateTensors(
         last_interaction=last_interaction,
@@ -174,7 +225,8 @@ def process_batch(params: PipelineParams, state: DeviceStateTensors,
         last_alert_ts=alert_ts,
         tenant_event_count=state.tenant_event_count + tenant_counts,
         tenant_alert_count=state.tenant_alert_count + count_by_key(
-            tenant, valid & (thr["fired"] | geo["fired"]), T),
+            tenant, valid & (thr["fired"] | geo["fired"] | prog["fired"]),
+            T),
     )
     outputs = ProcessOutputs(
         valid=valid,
@@ -185,12 +237,15 @@ def process_batch(params: PipelineParams, state: DeviceStateTensors,
         geofence_fired=geo["fired"],
         geofence_first_rule=geo["first_rule"],
         geofence_alert_level=geo["alert_level"],
+        program_fired=prog["fired"],
+        program_first_rule=prog["first_rule"],
+        program_alert_level=prog["alert_level"],
         tenant_counts=tenant_counts,
         processed=jnp.sum(valid, dtype=jnp.int32),
         alerts=alerts,
         alert_lanes=alert_lanes,
     )
-    return new_state, outputs
+    return new_state, rule_state, outputs
 
 
 def check_presence(state: DeviceStateTensors, registered: jnp.ndarray,

@@ -1,8 +1,13 @@
-"""Streaming rule processing (reference: service-rule-processing)."""
+"""Streaming rule processing (reference: service-rule-processing) plus
+the CEP-lite rule-program compiler (docs/RULE_PROGRAMS.md)."""
 
+from sitewhere_tpu.rules.compiler import (
+    ProgramOp, RuleProgramError, RuleProgramTable, program_from_dict)
 from sitewhere_tpu.rules.processor import (
     RuleProcessor, RuleProcessorHost, RuleProcessorsManager,
     ScriptedRuleProcessor, ZoneTestRuleProcessor)
 
 __all__ = ["RuleProcessor", "RuleProcessorHost", "RuleProcessorsManager",
-           "ScriptedRuleProcessor", "ZoneTestRuleProcessor"]
+           "ScriptedRuleProcessor", "ZoneTestRuleProcessor",
+           "ProgramOp", "RuleProgramError", "RuleProgramTable",
+           "program_from_dict"]

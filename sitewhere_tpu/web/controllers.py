@@ -330,6 +330,69 @@ def register_all(router: Router, instance, server) -> None:
                   authority=SiteWhereRoles.ADMINISTER_TENANTS)
 
     # ------------------------------------------------------------------
+    # Stateful rule programs — the CEP-lite compiler's tenant-scoped
+    # control plane (rules/compiler.py, ops/stateful.py): composite,
+    # temporal rules compiled to fixed-shape tables evaluated inside the
+    # fused step. Installs are durable (RuleProgramStore), replicated
+    # cluster-wide with the LWW/tombstone algebra, and carry per-program
+    # fire/suppress counters read on demand from the rule state.
+    # ------------------------------------------------------------------
+    def _program_tenant(request: Request) -> str:
+        # the path names the tenant; _engine() enforces existence + the
+        # caller's tenant access like every other tenant-scoped route
+        _engine(request)
+        return request.params["token"]
+
+    def list_rule_programs(request: Request):
+        tenant = _program_tenant(request)
+        engine = instance.pipeline_engine
+        counters = (engine.rule_program_counters()
+                    if engine is not None else {})
+        out = []
+        for row in instance.rule_programs.installs_for(tenant):
+            spec = row["spec"]
+            out.append({**spec,
+                        **counters.get(spec.get("token", ""),
+                                       {"fires": 0, "suppressed": 0})})
+        return {"programs": out}
+
+    def create_rule_program(request: Request):
+        tenant = _program_tenant(request)
+        return instance.install_rule_program(tenant, _body(request))
+
+    def get_rule_program(request: Request):
+        tenant = _program_tenant(request)
+        token = request.params["program"]
+        row = instance.rule_programs.get(tenant, token)
+        if row is None:
+            raise NotFoundError(f"rule program '{token}' not found",
+                                ErrorCode.GENERIC)
+        engine = instance.pipeline_engine
+        counters = (engine.rule_program_counters()
+                    if engine is not None else {})
+        return {**row["spec"],
+                **counters.get(token, {"fires": 0, "suppressed": 0})}
+
+    def delete_rule_program(request: Request):
+        tenant = _program_tenant(request)
+        token = request.params["program"]
+        if not instance.remove_rule_program(tenant, token):
+            raise NotFoundError(f"rule program '{token}' not found",
+                                ErrorCode.GENERIC)
+        return {"token": token, "removed": True}
+
+    router.get("/api/tenants/{token}/ruleprograms", list_rule_programs,
+               authority=SiteWhereRoles.VIEW_SERVER_INFO)
+    router.post("/api/tenants/{token}/ruleprograms", create_rule_program,
+                authority=SiteWhereRoles.ADMINISTER_TENANTS)
+    router.get("/api/tenants/{token}/ruleprograms/{program}",
+               get_rule_program,
+               authority=SiteWhereRoles.VIEW_SERVER_INFO)
+    router.delete("/api/tenants/{token}/ruleprograms/{program}",
+                  delete_rule_program,
+                  authority=SiteWhereRoles.ADMINISTER_TENANTS)
+
+    # ------------------------------------------------------------------
     # Prometheus exposition + on-demand device profiling (reference:
     # Dropwizard reporters, Microservice.java:146,244-246; Jaeger spans)
     # ------------------------------------------------------------------
@@ -342,6 +405,12 @@ def register_all(router: Router, instance, server) -> None:
         if engine is not None:
             extra["pipeline.batches_processed"] = engine.batches_processed
             extra["pipeline.alerts_dropped"] = engine.alerts_dropped
+            # per-program fire/suppress counters (one on-demand D2H fetch
+            # of two [P] vectors; cumulative, checkpoint-durable)
+            for ptoken, c in engine.rule_program_counters().items():
+                extra[f"pipeline.rule_program.fires.{ptoken}"] = c["fires"]
+                extra[f"pipeline.rule_program.suppressed.{ptoken}"] = \
+                    c["suppressed"]
         hooks = getattr(instance, "cluster_hooks", None)
         if hooks is not None:
             gossip = hooks.gossip

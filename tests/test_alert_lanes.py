@@ -316,7 +316,8 @@ class TestDifferentialSharded:
         per_row = ("valid", "unregistered", "threshold_fired",
                    "threshold_first_rule", "threshold_alert_level",
                    "geofence_fired", "geofence_first_rule",
-                   "geofence_alert_level")
+                   "geofence_alert_level", "program_fired",
+                   "program_first_rule", "program_alert_level")
         flat_out = out.replace(
             **{name: flat(np.asarray(getattr(out, name)))
                for name in per_row})

@@ -1,0 +1,763 @@
+"""Stateful rule programs (rules/compiler.py + ops/stateful.py).
+
+Differential contract: compiled program evaluation — fires, suppressions
+and state evolution — must match a pure-NumPy step-by-step oracle
+exactly, on the single-chip AND sharded engines, across debounce /
+hysteresis / for-duration / rate-of-change / ewma traces, including
+checkpoint/restore parity mid-temporal-window. Plus: structured 409
+validation naming the offending node on REST and replicated-apply
+paths, the alert-lane fetch budget with programs active, and the
+threshold NaN-guard regression.
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+from sitewhere_tpu.model import (
+    AlertLevel, Area, Device, DeviceAssignment, DeviceMeasurement,
+    DeviceType,
+)
+from sitewhere_tpu.pipeline.engine import (
+    PipelineEngine, ThresholdRule, materialize_alerts_maskscan,
+)
+from sitewhere_tpu.registry import DeviceManagement, RegistryTensors
+from sitewhere_tpu.rules.compiler import RuleProgramError
+
+_NEG = -(2 ** 31)
+_ENGINE_SEQ = iter(range(10_000))
+
+
+def _unique_name() -> str:
+    return f"progs-test-{next(_ENGINE_SEQ)}"
+
+
+def _world(n_devices=12):
+    dm = DeviceManagement()
+    dtype = dm.create_device_type(DeviceType(token="t"))
+    area = dm.create_area(Area(token="area"))
+    tensors = RegistryTensors(max_devices=64, max_zones=8,
+                              max_zone_vertices=8)
+    for i in range(n_devices):
+        device = dm.create_device(Device(token=f"d{i}",
+                                         device_type_id=dtype.id))
+        dm.create_device_assignment(DeviceAssignment(
+            token=f"a{i}", device_id=device.id, area_id=area.id))
+    tensors.attach(dm, "tenant")
+    return dm, tensors
+
+
+def _engine(tensors, **kw):
+    kw.setdefault("batch_size", 32)
+    kw.setdefault("measurement_slots", 8)
+    kw.setdefault("max_tenants", 4)
+    kw.setdefault("name", _unique_name())
+    engine = PipelineEngine(tensors, **kw)
+    engine.start()
+    return engine
+
+
+# ---------------------------------------------------------------------------
+# the pure-NumPy step-by-step oracle (independent of the compiler/kernel)
+# ---------------------------------------------------------------------------
+
+class ProgramOracle:
+    """Reference semantics, evaluated event-list by event-list exactly as
+    docs/RULE_PROGRAMS.md specifies — no tensor code shared with the
+    device path. float32 arithmetic where the kernel uses it."""
+
+    def __init__(self, programs):
+        # programs: [(slot, spec)] in slot order
+        self.programs = list(programs)
+        self.mm = {}          # (dev, name) -> (value f32, ts)
+        self.state = {}       # (dev, slot, path) -> dict
+        self.root_prev = {}   # (dev, slot) -> bool
+        self.fires = {}       # slot -> int
+        self.suppress = {}    # slot -> int
+
+    @staticmethod
+    def _cmp(value, op, const):
+        value = float(np.float32(value))
+        if math.isnan(value):
+            return False
+        return {">": value > const, ">=": value >= const,
+                "<": value < const, "<=": value <= const,
+                "==": value == const, "!=": value != const}[op]
+
+    def step(self, events, tokens):
+        """Returns {dev_token: [fired slots]} for this step (rising-edge
+        fires of ticked devices, slot-ascending)."""
+        per_dev = {}
+        for ev, tok in zip(events, tokens):
+            if isinstance(ev, DeviceMeasurement):
+                per_dev.setdefault(tok, []).append(
+                    (ev.name, np.float32(ev.value), ev.event_date))
+        fires = {}
+        for dev, rows in per_dev.items():
+            by_name = {}
+            for name, value, ts in rows:  # later position wins ts ties
+                cur = by_name.get(name)
+                if cur is None or ts >= cur[1]:
+                    by_name[name] = (value, ts)
+            observed = set(by_name)
+            now_d = max(ts for _, _, ts in rows)
+            for name, (value, ts) in by_name.items():
+                stored = self.mm.get((dev, name))
+                if stored is None or ts >= stored[1]:
+                    self.mm[(dev, name)] = (value, ts)
+            for slot, spec in self.programs:
+                out = self._eval(spec["when"], dev, slot, "when",
+                                 observed, now_d)
+                prev = self.root_prev.get((dev, slot), False)
+                if out and not prev:
+                    fires.setdefault(dev, []).append(slot)
+                    self.fires[slot] = self.fires.get(slot, 0) + 1
+                elif out and prev:
+                    self.suppress[slot] = self.suppress.get(slot, 0) + 1
+                self.root_prev[(dev, slot)] = out
+        return fires
+
+    def _eval(self, node, dev, slot, path, observed, now_d):
+        st = self.state.setdefault((dev, slot, path), {})
+        if "pred" in node:
+            name = node["measurement"]
+            op = node.get("op", ">")
+            const = float(node["value"])
+            cur = self.mm.get((dev, name))
+            kind = node["pred"]
+            if kind == "value":
+                return cur is not None and self._cmp(cur[0], op, const)
+            if kind == "ewma":
+                if name in observed:
+                    v = np.float32(cur[0])
+                    if st.get("cnt", 0) == 0:
+                        st["e"] = v
+                    else:
+                        a = np.float32(node.get("alpha", 0.2))
+                        st["e"] = np.float32(
+                            a * v + (np.float32(1.0) - a) * st["e"])
+                    st["cnt"] = st.get("cnt", 0) + 1
+                return st.get("cnt", 0) > 0 and self._cmp(st["e"], op,
+                                                          const)
+            # rate of change per second between consecutive observations
+            if name in observed:
+                v, ts = np.float32(cur[0]), cur[1]
+                if st.get("cnt", 0) > 0:
+                    dt = np.float32(max(ts - st["ts"], 1))
+                    st["rate"] = np.float32(
+                        (v - st["v"]) * np.float32(1000.0) / dt)
+                st["v"], st["ts"] = v, ts
+                st["cnt"] = st.get("cnt", 0) + 1
+            return st.get("cnt", 0) > 1 and self._cmp(
+                st.get("rate", 0.0), op, const)
+        if "all" in node or "any" in node:
+            kind = "all" if "all" in node else "any"
+            # every child evaluates (state must advance) — no short-circuit
+            outs = [self._eval(child, dev, slot, f"{path}.{kind}[{i}]",
+                               observed, now_d)
+                    for i, child in enumerate(node[kind])]
+            return all(outs) if kind == "all" else any(outs)
+        if "not" in node:
+            return not self._eval(node["not"], dev, slot, f"{path}.not",
+                                  observed, now_d)
+        if "hysteresis" in node:
+            arm = self._eval(node["hysteresis"]["arm"], dev, slot,
+                             f"{path}.hysteresis.arm", observed, now_d)
+            disarm = self._eval(node["hysteresis"]["disarm"], dev, slot,
+                                f"{path}.hysteresis.disarm", observed,
+                                now_d)
+            st["latch"] = (st.get("latch", False) or arm) and not disarm
+            return st["latch"]
+        if "debounce" in node:
+            child = self._eval(node["debounce"], dev, slot,
+                               f"{path}.debounce", observed, now_d)
+            st["ctr"] = st.get("ctr", 0) + 1 if child else 0
+            return st["ctr"] >= node["count"]
+        child = self._eval(node["for_duration"], dev, slot,
+                           f"{path}.for_duration", observed, now_d)
+        if child:
+            if st.get("since", _NEG) == _NEG:
+                st["since"] = now_d
+        else:
+            st["since"] = _NEG
+        return (child and st.get("since", _NEG) != _NEG
+                and now_d - st["since"] >= node["ms"])
+
+
+# the trace exercised by every differential test: four programs covering
+# each temporal operator + composite boolean structure
+def _programs():
+    return [
+        {"token": "p-composite", "alert_level": "CRITICAL",
+         "alert_type": "prog.composite",
+         "when": {"all": [
+             {"pred": "value", "measurement": "temp", "op": ">",
+              "value": 90.0},
+             {"pred": "value", "measurement": "hum", "op": "<",
+              "value": 20.0}]}},
+        {"token": "p-debounce", "alert_level": "WARNING",
+         "alert_type": "prog.debounce",
+         "when": {"debounce": {"pred": "value", "measurement": "temp",
+                               "op": ">", "value": 50.0}, "count": 3}},
+        {"token": "p-duration", "alert_level": "ERROR",
+         "alert_type": "prog.duration",
+         "when": {"for_duration": {"pred": "value", "measurement": "temp",
+                                   "op": ">", "value": 70.0},
+                  "ms": 2500}},
+        {"token": "p-hyst", "alert_level": "INFO",
+         "alert_type": "prog.hyst",
+         "when": {"hysteresis": {
+             "arm": {"pred": "value", "measurement": "temp", "op": ">",
+                     "value": 80.0},
+             "disarm": {"pred": "value", "measurement": "temp", "op": "<",
+                        "value": 60.0}}}},
+        {"token": "p-rate", "alert_level": "WARNING",
+         "alert_type": "prog.rate",
+         "when": {"pred": "rate", "measurement": "temp", "op": ">",
+                  "value": 5.0}},
+        {"token": "p-ewma", "alert_level": "WARNING",
+         "alert_type": "prog.ewma",
+         "when": {"pred": "ewma", "measurement": "temp", "op": ">",
+                  "value": 75.0, "alpha": 0.5}},
+    ]
+
+
+def _trace(t0):
+    """[(events, tokens)] per step: two devices with deliberately
+    different trajectories (d1 ramps hot+dry, d2 oscillates). `t0` must
+    sit near the packer's epoch_base_ms — rebased int32 timestamps clamp
+    otherwise and for-duration/rate deltas would be meaningless."""
+    def m(name, value, ts):
+        return DeviceMeasurement(name=name, value=value, event_date=ts)
+
+    steps = []
+    # step ts spacing 1000 ms; temp trajectory drives every operator
+    d1_temp = [55.0, 72.0, 95.0, 96.0, 97.0, 40.0, 98.0, 99.0]
+    d2_temp = [85.0, 30.0, 86.0, 87.0, 55.0, 88.0, 89.0, 20.0]
+    for i, (a, b) in enumerate(zip(d1_temp, d2_temp)):
+        ts = t0 + i * 1000
+        events = [m("temp", a, ts), m("temp", b, ts + 1)]
+        tokens = ["d1", "d2"]
+        if i == 2:
+            events.append(m("hum", 10.0, ts + 2))   # d1 goes dry
+            tokens.append("d1")
+        if i == 5:
+            events.append(m("hum", 50.0, ts + 2))   # d1 re-humidifies
+            tokens.append("d1")
+        steps.append((events, tokens))
+    return steps
+
+
+def _install(engine, specs):
+    for spec in specs:
+        engine.upsert_rule_program(dict(spec))
+
+
+def _oracle_for(engine):
+    by_slot = sorted(((e["slot"], e["spec"])
+                      for e in engine._rule_programs.values()))
+    return ProgramOracle(by_slot)
+
+
+def _fired_rows_from_outputs(outputs):
+    """(program_fired rows, first slot, level) from flat step outputs."""
+    fired = np.asarray(outputs.program_fired).reshape(-1)
+    first = np.asarray(outputs.program_first_rule).reshape(-1)
+    level = np.asarray(outputs.program_alert_level).reshape(-1)
+    return fired, first, level
+
+
+class TestDifferentialSingleChip:
+    def test_trace_matches_oracle(self):
+        _, tensors = _world()
+        engine = _engine(tensors)
+        _install(engine, _programs())
+        oracle = _oracle_for(engine)
+        slot_of = {e["spec"]["token"]: e["slot"]
+                   for e in engine._rule_programs.values()}
+        level_of = {e["slot"]: e["spec"]["alert_level"]
+                    for e in engine._rule_programs.values()}
+        for events, tokens in _trace(engine.packer.epoch_base_ms + 10_000):
+            expect = oracle.step(events, tokens)
+            batch = engine.packer.pack_events(events, tokens)[0]
+            out = engine.submit(batch)
+            fired, first, level = _fired_rows_from_outputs(out)
+            dev_col = np.asarray(batch.device_idx)
+            got = {}
+            for row in np.nonzero(fired)[0]:
+                token = engine.registry.devices.token_of(int(dev_col[row]))
+                got[token] = (int(first[row]), int(level[row]))
+            assert set(got) == set(expect)
+            for token, slots in expect.items():
+                assert got[token][0] == min(slots)
+                assert got[token][1] == max(level_of[s] for s in slots)
+        counters = engine.rule_program_counters()
+        for token, slot in slot_of.items():
+            assert counters[token]["fires"] == oracle.fires.get(slot, 0), \
+                token
+            assert counters[token]["suppressed"] == \
+                oracle.suppress.get(slot, 0), token
+        # the trace must actually exercise every operator at least once
+        assert all(counters[t]["fires"] > 0 for t in slot_of), counters
+
+    def test_lane_materialization_matches_maskscan(self):
+        _, tensors = _world()
+        engine = _engine(tensors)
+        _install(engine, _programs())
+        engine.add_threshold_rule(ThresholdRule(
+            token="thr-hot", measurement_name="temp", operator=">",
+            threshold=94.0, alert_level=AlertLevel.WARNING))
+
+        def key(a):
+            return (a.device_id, a.source, a.level, a.type, a.message,
+                    a.event_date)
+
+        any_fired = False
+        for events, tokens in _trace(engine.packer.epoch_base_ms + 10_000):
+            batch = engine.packer.pack_events(events, tokens)[0]
+            out = engine.submit(batch)
+            ref = materialize_alerts_maskscan(engine, batch, out)
+            f0 = engine.d2h_fetches
+            got = engine.materialize_alerts(batch, out)
+            assert engine.d2h_fetches - f0 == 1  # fetch budget holds
+            assert [key(a) for a in got] == [key(a) for a in ref]
+            any_fired = any_fired or bool(ref)
+        assert any_fired
+
+    def test_program_state_survives_checkpoint_mid_window(self, tmp_path):
+        """Mid-window parity: debounce counters, for-duration windows and
+        hysteresis latches checkpointed after step k resume on a FRESH
+        engine and produce the exact same fires as the uninterrupted
+        run."""
+        from sitewhere_tpu.persist.checkpoint import PipelineCheckpointer
+
+        cut = 4  # p-debounce is 2/3 through its window; p-duration armed
+
+        _, tensors_a = _world()
+        engine_a = _engine(tensors_a)
+        _install(engine_a, _programs())
+        steps = _trace(engine_a.packer.epoch_base_ms + 10_000)
+        for events, tokens in steps[:cut]:
+            engine_a.submit(engine_a.packer.pack_events(events, tokens)[0])
+        ckpt = PipelineCheckpointer(str(tmp_path))
+        ckpt.save(engine_a)
+
+        _, tensors_b = _world()
+        engine_b = _engine(tensors_b)
+        ckpt.restore(engine_b)
+        assert {e["spec"]["token"]
+                for e in engine_b._rule_programs.values()} \
+            == {s["token"] for s in _programs()}
+
+        for events, tokens in steps[cut:]:
+            out_a = engine_a.submit(
+                engine_a.packer.pack_events(events, tokens)[0])
+            out_b = engine_b.submit(
+                engine_b.packer.pack_events(events, tokens)[0])
+            for field in ("program_fired", "program_first_rule",
+                          "program_alert_level"):
+                np.testing.assert_array_equal(
+                    np.asarray(getattr(out_a, field)),
+                    np.asarray(getattr(out_b, field)), err_msg=field)
+        ca, cb = (engine_a.rule_program_counters(),
+                  engine_b.rule_program_counters())
+        assert ca == cb
+        assert any(c["fires"] > 0 for c in ca.values())
+
+    def test_program_replace_resets_temporal_state(self):
+        """Reinstalling a program (new epoch, same slot) restarts its
+        windows inside the step — no stale debounce credit."""
+        _, tensors = _world()
+        engine = _engine(tensors)
+        deb = {"token": "deb", "when": {
+            "debounce": {"pred": "value", "measurement": "temp",
+                         "op": ">", "value": 50.0}, "count": 2}}
+        engine.upsert_rule_program(deb)
+
+        def step(value, ts):
+            batch = engine.packer.pack_events(
+                [DeviceMeasurement(name="temp", value=value,
+                                   event_date=ts)], ["d1"])[0]
+            return engine.submit(batch)
+
+        step(60.0, 1000)           # counter 1/2
+        engine.upsert_rule_program(deb)  # replace -> epoch bump
+        out = step(61.0, 2000)     # counter restarted: 1/2 again
+        assert not np.asarray(out.program_fired).any()
+        out = step(62.0, 3000)     # 2/2 -> fires
+        assert np.asarray(out.program_fired).any()
+
+
+class TestDifferentialSharded:
+    def _engine(self, tensors, shards=4, **kw):
+        from sitewhere_tpu.parallel import ShardedPipelineEngine, make_mesh
+
+        kw.setdefault("measurement_slots", 8)
+        kw.setdefault("max_tenants", 4)
+        kw.setdefault("name", _unique_name())
+        engine = ShardedPipelineEngine(tensors, mesh=make_mesh(shards),
+                                       per_shard_batch=16, **kw)
+        engine.start()
+        return engine
+
+    def test_trace_matches_oracle(self):
+        _, tensors = _world()
+        engine = self._engine(tensors)
+        _install(engine, _programs())
+        oracle = _oracle_for(engine)
+        slot_of = {e["spec"]["token"]: e["slot"]
+                   for e in engine._rule_programs.values()}
+        for events, tokens in _trace(engine.packer.epoch_base_ms + 10_000):
+            expect = oracle.step(events, tokens)
+            batch = engine.packer.pack_events(events, tokens)[0]
+            routed, out = engine.submit(batch)
+            fired = np.asarray(out.program_fired)        # [S, B]
+            first = np.asarray(out.program_first_rule)
+            S, B = fired.shape
+            dev_local = np.asarray(routed.device_idx)
+            got = {}
+            for s, row in zip(*np.nonzero(fired)):
+                gidx = int(dev_local[s, row]) * engine.n_shards + int(s)
+                token = engine.registry.devices.token_of(gidx)
+                got[token] = int(first[s, row])
+            assert set(got) == set(expect)
+            for token, slots in expect.items():
+                assert got[token] == min(slots)
+        counters = engine.rule_program_counters()
+        for token, slot in slot_of.items():
+            assert counters[token]["fires"] == oracle.fires.get(slot, 0)
+            assert counters[token]["suppressed"] == \
+                oracle.suppress.get(slot, 0)
+        assert any(c["fires"] > 0 for c in counters.values())
+
+    def test_fetch_budget_with_programs_active(self):
+        from sitewhere_tpu.ops.compact import ALERT_LANE_ROWS
+
+        _, tensors = _world()
+        engine = self._engine(tensors)
+        _install(engine, _programs())
+        for events, tokens in _trace(engine.packer.epoch_base_ms + 10_000):
+            batch = engine.packer.pack_events(events, tokens)[0]
+            routed, out = engine.submit(batch)
+            f0, b0 = engine.d2h_fetches, engine.d2h_bytes
+            alerts = engine.materialize_alerts(routed, out)
+            assert engine.d2h_fetches - f0 == 1
+            assert (engine.d2h_bytes - b0
+                    == engine.n_shards * ALERT_LANE_ROWS
+                    * engine.alert_lane_capacity * 4)
+
+    def test_checkpoint_roundtrip_sharded_to_single(self, tmp_path):
+        """Canonical checkpoints with rule state restore across engine
+        kinds (4-shard save -> single-chip resume, mid-window)."""
+        from sitewhere_tpu.persist.checkpoint import PipelineCheckpointer
+
+        cut = 4
+        _, tensors_a = _world()
+        sharded = self._engine(tensors_a)
+        _install(sharded, _programs())
+        steps = _trace(sharded.packer.epoch_base_ms + 10_000)
+        for events, tokens in steps[:cut]:
+            sharded.submit(sharded.packer.pack_events(events, tokens)[0])
+        ckpt = PipelineCheckpointer(str(tmp_path))
+        ckpt.save(sharded)
+
+        _, tensors_b = _world()
+        single = _engine(tensors_b)
+        ckpt.restore(single)
+
+        for events, tokens in steps[cut:]:
+            routed, out_a = sharded.submit(
+                sharded.packer.pack_events(events, tokens)[0])
+            out_b = single.submit(
+                single.packer.pack_events(events, tokens)[0])
+            # compare per-device fire sets (layouts differ)
+            fired_a = np.asarray(out_a.program_fired)
+            dev_a = np.asarray(routed.device_idx)
+            set_a = set()
+            for s, row in zip(*np.nonzero(fired_a)):
+                set_a.add(sharded.registry.devices.token_of(
+                    int(dev_a[s, row]) * sharded.n_shards + int(s)))
+            fired_b = np.asarray(out_b.program_fired)
+            dev_b = np.asarray(
+                single.packer.pack_events(events, tokens)[0].device_idx)
+            set_b = {single.registry.devices.token_of(int(d))
+                     for d in dev_b[np.nonzero(fired_b)[0]]}
+            assert set_a == set_b
+        assert (sharded.rule_program_counters()
+                == single.rule_program_counters())
+
+
+class TestValidation:
+    """Structured 409s naming the offending node — never a stack trace."""
+
+    def setup_method(self):
+        _, tensors = _world(4)
+        self.engine = _engine(tensors)
+
+    def _err(self, spec):
+        with pytest.raises(RuleProgramError) as err:
+            self.engine.upsert_rule_program(spec)
+        assert err.value.http_status == 409
+        return str(err.value)
+
+    def test_unknown_opcode_names_node(self):
+        msg = self._err({"token": "x", "when": {"any": [
+            {"pred": "value", "measurement": "m", "op": ">", "value": 1},
+            {"pred": "median", "measurement": "m", "op": ">", "value": 1},
+        ]}})
+        assert "when.any[1]" in msg and "unknown opcode" in msg
+
+    def test_operand_slot_out_of_range_names_node(self):
+        # flood the measurement interner past the tracked-slot window
+        for i in range(16):
+            self.engine.packer.measurements.intern(f"pad-{i}")
+        msg = self._err({"token": "x", "when": {
+            "pred": "value", "measurement": "beyond-slots", "op": ">",
+            "value": 1}})
+        assert "operand slot out of range" in msg and "when" in msg
+
+    def test_over_node_bucket_names_node(self):
+        leaf = {"pred": "value", "measurement": "m", "op": ">", "value": 1}
+        msg = self._err({"token": "x",
+                         "when": {"all": [dict(leaf) for _ in range(40)]}})
+        assert "over the static bucket" in msg
+
+    def test_over_state_bucket(self):
+        # wide node bucket so the STATE bucket is the binding constraint
+        _, tensors = _world(4)
+        engine = _engine(tensors, rule_program_nodes=64,
+                         rule_program_state_slots=4)
+        deb = {"debounce": {"pred": "value", "measurement": "m",
+                            "op": ">", "value": 1}, "count": 2}
+        with pytest.raises(RuleProgramError) as err:
+            engine.upsert_rule_program(
+                {"token": "x", "when": {"all": [dict(deb)
+                                                for _ in range(6)]}})
+        msg = str(err.value)
+        assert "over the static bucket" in msg and "stateful" in msg
+
+    def test_bad_operator_and_arity(self):
+        assert "unknown operator" in self._err(
+            {"token": "x", "when": {"pred": "value", "measurement": "m",
+                                    "op": "~", "value": 1}})
+        assert "hysteresis" in self._err(
+            {"token": "x", "when": {"hysteresis": {"arm": {
+                "pred": "value", "measurement": "m", "op": ">",
+                "value": 1}}}})
+        assert "debounce" in self._err(
+            {"token": "x", "when": {"debounce": {
+                "pred": "value", "measurement": "m", "op": ">",
+                "value": 1}, "count": 0}})
+
+    def test_capacity_exceeded_is_structured(self):
+        from sitewhere_tpu.errors import SiteWhereError
+
+        _, tensors = _world(4)
+        engine = _engine(tensors, max_rule_programs=2)
+        leaf = {"pred": "value", "measurement": "m", "op": ">", "value": 1}
+        engine.upsert_rule_program({"token": "a", "when": dict(leaf)})
+        engine.upsert_rule_program({"token": "b", "when": dict(leaf)})
+        with pytest.raises(SiteWhereError) as err:
+            engine.upsert_rule_program({"token": "c", "when": dict(leaf)})
+        assert err.value.http_status == 409
+
+
+class TestReplicatedApply:
+    def _instance(self, tmp_path, name):
+        from sitewhere_tpu.instance import SiteWhereInstance
+
+        inst = SiteWhereInstance(
+            instance_id=name, data_dir=str(tmp_path / name),
+            enable_pipeline=True, max_devices=64, batch_size=32,
+            measurement_slots=8)
+        inst.start()
+        return inst
+
+    def test_lww_and_tombstone_convergence(self, tmp_path):
+        inst = self._instance(tmp_path, "rp-lww")
+        try:
+            spec = {"token": "p1", "when": {
+                "pred": "value", "measurement": "m", "op": ">",
+                "value": 5.0}}
+            norm = inst.install_rule_program("default", dict(spec))
+            stamp = inst.rule_programs.get("default", "p1")["stamp"]
+            # older replicated add loses
+            older = dict(norm)
+            older["alert_message"] = "stale"
+            assert not inst.apply_replicated_rule_program(
+                "add", "default", "p1",
+                {"spec": older, "stamp": stamp - 10})
+            assert inst.rule_programs.get(
+                "default", "p1")["spec"].get("alert_message") != "stale"
+            # newer replicated add wins and reaches the engine
+            newer = dict(norm)
+            newer["alert_message"] = "fresh"
+            assert inst.apply_replicated_rule_program(
+                "add", "default", "p1",
+                {"spec": newer, "stamp": stamp + 10})
+            assert inst.pipeline_engine.get_rule_program(
+                "p1")["alert_message"] == "fresh"
+            # replicated remove tombstones + detaches
+            assert inst.apply_replicated_rule_program(
+                "remove", "default", "p1", stamp + 20)
+            assert inst.pipeline_engine.get_rule_program("p1") is None
+            # the tombstoned add cannot resurrect
+            assert not inst.apply_replicated_rule_program(
+                "add", "default", "p1",
+                {"spec": newer, "stamp": stamp + 15})
+        finally:
+            inst.stop()
+
+    def test_invalid_replicated_spec_is_structured_409(self, tmp_path):
+        inst = self._instance(tmp_path, "rp-bad")
+        try:
+            with pytest.raises(RuleProgramError) as err:
+                inst.apply_replicated_rule_program(
+                    "add", "default", "bad",
+                    {"spec": {"token": "bad", "when": {
+                        "pred": "nope", "measurement": "m", "op": ">",
+                        "value": 1}}, "stamp": 10})
+            assert err.value.http_status == 409
+            assert "unknown opcode" in str(err.value)
+            # the loser left no store state behind
+            assert inst.rule_programs.get("default", "bad") is None
+        finally:
+            inst.stop()
+
+    def test_durable_across_restart(self, tmp_path):
+        inst = self._instance(tmp_path, "rp-dur")
+        spec = {"token": "pdur", "when": {
+            "pred": "value", "measurement": "m", "op": ">", "value": 5.0}}
+        inst.install_rule_program("default", spec)
+        inst.stop()
+        from sitewhere_tpu.instance import SiteWhereInstance
+
+        inst2 = SiteWhereInstance(
+            instance_id="rp-dur", data_dir=str(tmp_path / "rp-dur"),
+            enable_pipeline=True, max_devices=64, batch_size=32,
+            measurement_slots=8)
+        inst2.start()
+        try:
+            assert inst2.pipeline_engine.get_rule_program(
+                "pdur") is not None
+        finally:
+            inst2.stop()
+
+
+class TestRest:
+    @pytest.fixture()
+    def server(self, tmp_path):
+        from sitewhere_tpu.instance import SiteWhereInstance
+        from sitewhere_tpu.web import RestServer
+
+        instance = SiteWhereInstance(
+            instance_id="rp-web", enable_pipeline=True, max_devices=64,
+            batch_size=32, measurement_slots=8)
+        instance.start()
+        rest = RestServer(instance, port=0)
+        rest.start()
+        yield rest
+        rest.stop()
+        instance.stop()
+
+    @pytest.fixture()
+    def client(self, server):
+        from sitewhere_tpu.client import SiteWhereClient
+
+        c = SiteWhereClient(server.base_url)
+        c.authenticate("admin", "password")
+        return c
+
+    def test_crud_round_trip(self, client):
+        created = client.post("/api/tenants/default/ruleprograms", {
+            "token": "web-prog", "alert_level": "ERROR",
+            "when": {"all": [
+                {"pred": "value", "measurement": "temp", "op": ">",
+                 "value": 90},
+                {"debounce": {"pred": "value", "measurement": "hum",
+                              "op": "<", "value": 20}, "count": 2}]}})
+        assert created["token"] == "web-prog"
+        assert created["tenant_token"] == "default"
+        listed = client.get("/api/tenants/default/ruleprograms")
+        assert [p["token"] for p in listed["programs"]] == ["web-prog"]
+        assert listed["programs"][0]["fires"] == 0
+        got = client.get("/api/tenants/default/ruleprograms/web-prog")
+        assert got["alert_level"] == int(AlertLevel.ERROR)
+        assert client.delete(
+            "/api/tenants/default/ruleprograms/web-prog")["removed"]
+        from sitewhere_tpu.client import SiteWhereClientError
+
+        with pytest.raises(SiteWhereClientError) as err:
+            client.get("/api/tenants/default/ruleprograms/web-prog")
+        assert err.value.status == 404
+
+    def test_invalid_spec_is_409_naming_node(self, client):
+        from sitewhere_tpu.client import SiteWhereClientError
+
+        with pytest.raises(SiteWhereClientError) as err:
+            client.post("/api/tenants/default/ruleprograms", {
+                "token": "bad", "when": {"any": [
+                    {"pred": "value", "measurement": "m", "op": ">",
+                     "value": 1},
+                    {"pred": "zigzag", "measurement": "m", "op": ">",
+                     "value": 1}]}})
+        assert err.value.status == 409
+        assert "when.any[1]" in str(err.value)
+
+    def test_duplicate_token_409(self, client):
+        from sitewhere_tpu.client import SiteWhereClientError
+
+        spec = {"token": "dup-prog", "when": {
+            "pred": "value", "measurement": "m", "op": ">", "value": 1}}
+        client.post("/api/tenants/default/ruleprograms", dict(spec))
+        with pytest.raises(SiteWhereClientError) as err:
+            client.post("/api/tenants/default/ruleprograms", dict(spec))
+        assert err.value.status == 409
+        client.delete("/api/tenants/default/ruleprograms/dup-prog")
+
+
+class TestThresholdNaNGuard:
+    """Satellite regression: a NaN measurement value must never satisfy
+    a threshold comparison — including `!=`, which IEEE would make TRUE
+    for NaN."""
+
+    @pytest.mark.parametrize("operator", [">", ">=", "<", "<=", "==",
+                                          "!="])
+    def test_nan_never_fires(self, operator):
+        _, tensors = _world(4)
+        engine = _engine(tensors)
+        engine.add_threshold_rule(ThresholdRule(
+            token=f"nan-{operator.replace('=', 'e').replace('<', 'l').replace('>', 'g').replace('!', 'n')}",
+            measurement_name="m", operator=operator, threshold=10.0))
+        batch = engine.packer.pack_events(
+            [DeviceMeasurement(name="m", value=float("nan"),
+                               event_date=1000)], ["d1"])[0]
+        out = engine.submit(batch)
+        assert not np.asarray(out.threshold_fired).any()
+        assert engine.materialize_alerts(batch, out) == []
+
+    def test_compare_op_nan_guard_unit(self):
+        import jax.numpy as jnp
+
+        from sitewhere_tpu.ops.threshold import ThresholdOp, _compare
+
+        value = jnp.asarray([[float("nan")], [5.0]])
+        ops = jnp.asarray([ThresholdOp.NEQ, ThresholdOp.GT])
+        thresholds = jnp.asarray([10.0, 1.0])
+        result = np.asarray(_compare(value, ops[None, :],
+                                     thresholds[None, :]))
+        assert not result[0].any()          # NaN row: nothing fires
+        assert result[1].all()              # 5.0 != 10 and 5.0 > 1
+
+    def test_nan_never_fires_rule_program_predicate(self):
+        _, tensors = _world(4)
+        engine = _engine(tensors)
+        engine.upsert_rule_program({"token": "nan-prog", "when": {
+            "pred": "value", "measurement": "m", "op": "!=",
+            "value": 10.0}})
+        batch = engine.packer.pack_events(
+            [DeviceMeasurement(name="m", value=float("nan"),
+                               event_date=1000)], ["d1"])[0]
+        out = engine.submit(batch)
+        assert not np.asarray(out.program_fired).any()
