@@ -67,7 +67,7 @@ _COMPACT_KEYS = (
     "latency_mode_p50_ms", "latency_mode_p99_ms",
     "latency_mode_trial_p99_ms", "latency_mode",
     "latency_fetch", "materialize_lane_speedup_x",
-    "telemetry_packed_events_per_sec", "telemetry_wire_bytes_per_event",
+    "telemetry_packed_events_per_sec",
     "persist_events_per_sec", "analytics_replay_events_per_sec",
     "sharded_1chip_events_per_sec", "sharded_from_bytes_events_per_sec",
     "sharded_1chip_router_ms_per_step",
@@ -83,10 +83,14 @@ def _compact_result(result: Dict, detail_path) -> Dict:
     out = {k: result[k] for k in _COMPACT_KEYS if k in result}
     rp = result.get("rule_programs") or {}
     # only the gate-relevant fields ride the compact line (the byte
-    # budget); full rates live in the sidecar
+    # budget); full rates + per-event costs live in the sidecar
     out["rule_programs"] = {k: rp[k] for k in (
-        "compiled_vs_host_speedup_x", "marginal_us_per_event",
-        "host_us_per_event", "d2h_fetches_per_offer") if k in rp}
+        "compiled_vs_host_speedup_x", "d2h_fetches_per_offer") if k in rp}
+    # only the gate-checked fields ride the line (the byte budget);
+    # device_route_ms_per_step etc. live in the sidecar
+    dr = result.get("device_routing") or {}
+    out["device_routing"] = {k: dr[k] for k in (
+        "router_offload_speedup_x", "parity_ok") if k in dr}
     bd = result.get("step_breakdown") or {}
     out["step_breakdown"] = {k: bd[k] for k in (
         "pack_ms", "h2d_ms", "device_ms", "sync_total_ms",
@@ -668,6 +672,11 @@ def _t_sync(jax, ctx) -> Dict:
 
     engine, pool, n = ctx["engine"], ctx["pool"], ctx["SYNC_STEPS"]
     pool_n = ctx["pool_n"]
+    # settling pass after the section switch (unmeasured): the adjacent
+    # sections evicted host caches and may have left the tunnel bucket
+    # mid-refill; sync samples should describe the steady state
+    out = engine.submit(pool[0])
+    out.processed.block_until_ready()
     plain: List[float] = []
     for i in range(n):
         s0 = time.perf_counter()
@@ -820,22 +829,26 @@ def _t_persist(jax, ctx) -> Dict:
     Steady-state window (same unmeasured warmup discipline the latency
     tier got): an unmeasured append into a throwaway log re-warms the
     allocator/page caches the interleaved sections evicted, so trial 1
-    no longer pays the cold path and `trial_spread_bounded` judges warm
-    trials only."""
+    no longer pays the cold path. The trial value is the MEDIAN of five
+    per-append rates (host-CPU sections ride VM CPU steal — r05 saw 68%
+    trial spread on unchanged code; the median of repeats within a trial
+    absorbs a steal spike instead of reporting it as drift) and
+    `trial_spread_bounded` judges those medians only."""
     from sitewhere_tpu.persist.eventlog import ColumnarEventLog
 
     engine, pool = ctx["engine"], ctx["pool"]
     warm_log = ColumnarEventLog()
     warm_log.append_batch("bench", pool[0], engine.packer)  # unmeasured
     log = ColumnarEventLog()
-    steps = 2 if ctx["small"] else 3
-    appended = 0
-    p0 = time.perf_counter()
-    for i in range(steps):
-        appended += log.append_batch("bench", pool[i % len(pool)],
-                                     engine.packer)
-    rate = appended / (time.perf_counter() - p0)
-    return {"events_per_sec": rate}
+    log.append_batch("bench", pool[0], engine.packer)  # settling pass
+    reps = 3 if ctx["small"] else 5
+    rates: List[float] = []
+    for i in range(reps):
+        p0 = time.perf_counter()
+        appended = log.append_batch("bench", pool[i % len(pool)],
+                                    engine.packer)
+        rates.append(appended / (time.perf_counter() - p0))
+    return {"events_per_sec": _median(rates)}
 
 
 def _t_analytics(jax, ctx) -> Dict:
@@ -845,12 +858,19 @@ def _t_analytics(jax, ctx) -> Dict:
     the spread bound judging it — sees the warm path only."""
     aeng = ctx["aeng"]
     warm = aeng.measurement_windows("bench", window_ms=60_000)
-    jax.block_until_ready(warm.stats)  # unmeasured warmup
-    a0 = time.perf_counter()
-    report = aeng.measurement_windows("bench", window_ms=60_000)
-    jax.block_until_ready(report.stats)
-    rate = ctx["analytics_events"] / (time.perf_counter() - a0)
-    return {"events_per_sec": rate}
+    jax.block_until_ready(warm.stats)  # unmeasured settling pass
+    # median of five replays per trial: host-CPU-bound sections swing
+    # with VM CPU steal (r05: 91% trial spread on unchanged code); the
+    # intra-trial median absorbs a steal spike, the trial spread then
+    # compares steady numbers
+    reps = 3 if ctx["small"] else 5
+    rates: List[float] = []
+    for _ in range(reps):
+        a0 = time.perf_counter()
+        report = aeng.measurement_windows("bench", window_ms=60_000)
+        jax.block_until_ready(report.stats)
+        rates.append(ctx["analytics_events"] / (time.perf_counter() - a0))
+    return {"events_per_sec": _median(rates)}
 
 
 # -- sharded / multitenant ---------------------------------------------------
@@ -892,7 +912,8 @@ def _measure_rate(jax, engine, pool, steps, global_batch):
     return steps * global_batch / (time.perf_counter() - t0)
 
 
-def _build_sharded_engine(tensors, mesh, per_shard, zone_token):
+def _build_sharded_engine(tensors, mesh, per_shard, zone_token,
+                          device_routing=None):
     from sitewhere_tpu.model import AlertLevel
     from sitewhere_tpu.parallel import ShardedPipelineEngine
     from sitewhere_tpu.pipeline.engine import GeofenceRule, ThresholdRule
@@ -900,7 +921,8 @@ def _build_sharded_engine(tensors, mesh, per_shard, zone_token):
     eng = ShardedPipelineEngine(
         tensors, mesh=mesh, per_shard_batch=per_shard,
         measurement_slots=8, max_tenants=16,
-        max_threshold_rules=64, max_geofence_rules=64)
+        max_threshold_rules=64, max_geofence_rules=64,
+        device_routing=device_routing)
     eng.packer.measurements.intern("m1")
     for i in range(16):
         eng.add_threshold_rule(ThresholdRule(
@@ -997,6 +1019,59 @@ def _build_sharded(jax, ctx) -> None:
     jax.block_until_ready(out.processed)
     ctx["sharded_lane"] = lane
 
+    # Pinned router-offload micro-bench (ISSUE 5): host arena route vs
+    # on-device route at the full production batch on this mesh, both
+    # timed to the same finish line — routed blob RESIDENT ON THE MESH.
+    # host = fused native pack+route + device_put of the routed blob;
+    # device = flat pack + device_put + the jitted routing program
+    # (ops/route.py — the same kernel the device-routing step runs as
+    # its prologue). Parity is asserted on the actual bits: the two
+    # paths must produce the identical routed blob.
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from sitewhere_tpu.ops.pack import batch_to_blob
+    from sitewhere_tpu.ops.route import build_device_route_program
+    from sitewhere_tpu.parallel.mesh import SHARD_AXIS
+
+    mesh1, S1 = eng1.mesh, eng1.n_shards
+    flat_spec = NamedSharding(mesh1, P(None, SHARD_AXIS))
+    shard_spec = NamedSharding(mesh1, P(SHARD_AXIS))
+    prog = build_device_route_program(mesh1, S1, BATCH,
+                                      eng1.route_lane_capacity)
+    dev_routed, _ = prog(jax.device_put(batch_to_blob(pool[0]), flat_spec))
+    host_routed, over = eng1.router.route_batch(pool[0])
+    parity = (len(over) == 0 and np.array_equal(
+        np.asarray(jax.device_get(dev_routed)), np.asarray(host_routed)))
+    eng1.router.release_staging_buffer(host_routed)
+    reps = 3 if small else 10
+    host_s: List[float] = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        hb, _ = eng1.router.route_batch(pool[0])
+        jax.device_put(hb, shard_spec).block_until_ready()
+        host_s.append(time.perf_counter() - t0)
+        eng1.router.release_staging_buffer(hb)
+    # reusable flat staging buffer (parity with the host side's pooled
+    # routed buffers): blocking on the routed result each rep proves the
+    # H2D consumed the buffer before the next pack overwrites it
+    from sitewhere_tpu.ops.pack import WIRE_ROWS
+    flat_buf = np.empty((WIRE_ROWS, BATCH), np.int32)
+    dev_s: List[float] = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        flat = batch_to_blob(pool[0], out=flat_buf)
+        routed, _ = prog(jax.device_put(flat, flat_spec))
+        jax.block_until_ready(routed)
+        dev_s.append(time.perf_counter() - t0)
+    host_ms, dev_ms = _median(host_s) * 1000, _median(dev_s) * 1000
+    ctx["device_routing"] = {
+        "device_route_ms_per_step": round(dev_ms, 3),
+        "host_route_ms_per_step": round(host_ms, 3),
+        "router_offload_speedup_x": round(host_ms / dev_ms, 2)
+        if dev_ms else 0.0,
+        "parity_ok": bool(parity),
+        "lane_capacity": int(eng1.route_lane_capacity),
+    }
+
     aux: Dict = {}
     cpus = jax.devices("cpu")
     if len(cpus) >= 8:
@@ -1075,17 +1150,28 @@ def _t_sharded(jax, ctx) -> Dict:
     jax.block_until_ready(futs[-1].result()[1].processed)
     rate = STEPS * BATCH / (time.perf_counter() - t0)
     sub.close()
-    # host routing cost alone (the path submit uses: fused native
-    # pack+route into the pooled staging buffers when the C++ runtime is
-    # available, two-pass numpy otherwise). Loaned blobs are released per
-    # iteration so the loop measures the pooled path production submit
-    # pays, not pool-exhausted fresh allocation.
-    r0 = time.perf_counter()
+    # Host routing cost alone (the r05 6.6 ms regression lived HERE, not
+    # in the router: the pipelined futures above still held every pooled
+    # staging buffer on loan, so each timed route paid a fresh 2.6 MB
+    # mmap-backed allocation — page faults — on top of whatever CPU
+    # steal the adjacent rule_programs section left behind, and the
+    # mean-of-20 charged all of it to the router). Three fixes: drop the
+    # feeder's views so the loaned buffers return to the pool, run one
+    # unmeasured settling route after the section switch, and report the
+    # median of per-iteration timings instead of the mean so a single
+    # steal spike cannot multiply the number.
+    import gc
+    del futs, warm
+    gc.collect()
+    blob, _ = eng.router.route_batch(pool[0])   # settling pass, unmeasured
+    eng.router.release_staging_buffer(blob)
+    samples: List[float] = []
     for i in range(STEPS):
+        r0 = time.perf_counter()
         blob, _ = eng.router.route_batch(pool[i % len(pool)])
+        samples.append(time.perf_counter() - r0)
         eng.router.release_staging_buffer(blob)
-    router_ms = (time.perf_counter() - r0) / STEPS * 1000
-    return {"events_per_sec": rate, "router_ms": router_ms}
+    return {"events_per_sec": rate, "router_ms": _median(samples) * 1000}
 
 
 def _t_sharded_bytes(jax, ctx) -> Dict:
@@ -1161,11 +1247,17 @@ def _t_multitenant(jax, ctx) -> Dict:
     multi_rate = _measure_rate(jax, eng, mpool, STEPS, batch)
     single_rate = _measure_rate(jax, ctx["sharded_eng"],
                                 ctx["mt_single_pool"], STEPS, batch)
-    r0 = time.perf_counter()
+    # same discipline as _t_sharded's router loop: settle once after the
+    # section switch, report the median of per-iteration timings
+    blob, _ = eng.router.route_batch(mpool[0])
+    eng.router.release_staging_buffer(blob)
+    route_samples: List[float] = []
     for i in range(STEPS):
+        r0 = time.perf_counter()
         blob, _ = eng.router.route_batch(mpool[i % len(mpool)])
+        route_samples.append(time.perf_counter() - r0)
         eng.router.release_staging_buffer(blob)
-    route_ms = (time.perf_counter() - r0) / STEPS * 1000
+    route_ms = _median(route_samples) * 1000
     # decomposition (VERDICT r2 item 7): synchronous per-step wall time vs
     # host routing alone; the remainder is dispatch + device execution —
     # with T per-tenant zone geofences the containment kernel does T x the
@@ -1326,7 +1418,11 @@ def _aggregate(jax, ctx, trials: Dict[str, List[Dict]],
         "sharded_1chip": _spread_pct(sharded),
         "sharded_from_bytes": _spread_pct(sharded_bytes),
         "multitenant": _spread_pct(mt),
-        "sync_total": _spread_pct(plain),
+        # spread over PER-TRIAL MEDIANS, not pooled raw samples: one
+        # steal-spiked step in one trial used to read as 90% "spread"
+        # (r05) even though every trial's median agreed within noise
+        "sync_total": _spread_pct(
+            [_median(t["plain_s"]) for t in trials["sync"]]),
         # note: latency spread is deliberately NOT in this dict — the
         # gate's spread bound would contradict the best-trial budget
         # semantics (a degraded-link trial is expected and tolerated);
@@ -1401,6 +1497,10 @@ def _aggregate(jax, ctx, trials: Dict[str, List[Dict]],
         "sharded_from_bytes_events_per_sec": round(_median(sharded_bytes), 1),
         "sharded_1chip_router_ms_per_step": round(
             _median([t["router_ms"] for t in trials["sharded"]]), 3),
+        # pinned host-arena-route vs on-device-route micro-bench at the
+        # full production batch (ops/route.py; perf_gate device_routing
+        # check pins parity + speedup at full scale)
+        "device_routing": ctx["device_routing"],
         **ctx["sharded_aux"],
         "multitenant_sharded_events_per_sec": round(_median(mt), 1),
         "multitenant_active_tenants": int(sum(

@@ -73,6 +73,9 @@ def _build_instance(cfg, mesh=None):
         admin_username=cfg.get("instance.admin_username"),
         admin_password=cfg.get("instance.admin_password"),
         shards=int(cfg.get("mesh.shards")),
+        # "auto" -> None: the engine decides by mesh shape/topology
+        device_routing={"on": True, "off": False}.get(
+            str(cfg.get("pipeline.device_routing") or "auto").lower()),
         checkpoint_interval_s=(
             float(cfg.get("persist.checkpoint_interval_s"))
             if cfg.get("persist.checkpoint_interval_s") is not None

@@ -54,6 +54,7 @@ class SiteWhereInstance(LifecycleComponent):
                  admin_password: str = "password",
                  shards: int = 1,
                  mesh=None,
+                 device_routing: Optional[bool] = None,
                  tenant_datastores: Optional[Dict] = None,
                  checkpoint_interval_s: Optional[float] = None,
                  latency_linger_ms: Optional[float] = None,
@@ -112,7 +113,8 @@ class SiteWhereInstance(LifecycleComponent):
                     mesh=mesh if mesh is not None else make_mesh(shards),
                     per_shard_batch=batch_size,
                     measurement_slots=measurement_slots,
-                    max_tenants=max_tenants)
+                    max_tenants=max_tenants,
+                    device_routing=device_routing)
             else:
                 from sitewhere_tpu.pipeline.engine import PipelineEngine
                 self.pipeline_engine = PipelineEngine(

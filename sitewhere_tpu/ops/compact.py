@@ -28,7 +28,10 @@ batch-row order, so materialization order matches a mask scan exactly):
   row 3 (counts): [0] = fired rows this step (INCLUDING rows beyond
                  capacity), [1] = alerts dropped by lane overflow (each
                  fired rule family on a row beyond capacity counts one),
-                 [2] = total alerts fired (mirrors ProcessOutputs.alerts)
+                 [2] = total alerts fired (mirrors ProcessOutputs.alerts),
+                 [3] = rows the on-device shard route had to drop
+                 (ops/route.py ROUTE_DROPPED_SLOT; zero on host-routed
+                 steps and whenever the host lane-fit guard ran)
 
 Overflow contract: rows beyond the K capacity are counted on device
 (counts[1]) and surface on the engine's `alerts_dropped` — an alert
@@ -138,6 +141,7 @@ class DecodedAlertLanes:
     prog_fired: np.ndarray = None  # bool (rule-program composite fires)
     prog_rule: np.ndarray = None   # int32 program slot (-1 = none)
     prog_level: np.ndarray = None  # int32 (meaningful under prog_fired)
+    route_dropped: int = 0         # rows dropped by the on-device route
 
     def __post_init__(self):
         if self.prog_fired is None:
@@ -160,7 +164,8 @@ class DecodedAlertLanes:
             dropped_alerts=self.dropped_alerts,
             total_alerts=self.total_alerts,
             prog_fired=self.prog_fired[:n], prog_rule=self.prog_rule[:n],
-            prog_level=self.prog_level[:n])
+            prog_level=self.prog_level[:n],
+            route_dropped=self.route_dropped)
 
 
 def decode_alert_lanes(lanes: np.ndarray) -> DecodedAlertLanes:
@@ -189,4 +194,5 @@ def decode_alert_lanes(lanes: np.ndarray) -> DecodedAlertLanes:
         prog_rule=np.where(prog_fired,
                            (meta >> _PROG_RULE_SHIFT) & 0xFF,
                            -1).astype(np.int32),
-        prog_level=((meta >> _PROG_LEVEL_SHIFT) & 0xF).astype(np.int32))
+        prog_level=((meta >> _PROG_LEVEL_SHIFT) & 0xF).astype(np.int32),
+        route_dropped=int(counts[3]))

@@ -3,10 +3,13 @@
 Scaling story (SURVEY.md §2.5): the reference adds replicas per microservice
 and lets Kafka split partitions; here ONE SPMD program runs on every chip.
 Each shard owns devices `d % S == s` (their state rows, their slice of the
-registry mirror); the host router sends each event to its owner shard; rule
-tables and zone geometry are replicated (small, read-only). The only
-cross-shard communication is the psum of per-batch stats — a few hundred
-bytes over ICI per step, vs. the reference's per-event gRPC fan-out.
+registry mirror); events reach their owner shard either via the on-device
+routing prologue (ops/route.py — bucketing + one all_to_all fused into the
+step; default on multi-shard single-controller meshes) or the host arena
+router (single-chip, multi-host, and skew spills); rule tables and zone
+geometry are replicated (small, read-only). Cross-shard communication is
+one row exchange (device routing) plus the psum of per-batch stats over
+ICI per step, vs. the reference's per-event gRPC fan-out.
 
 Multi-host note: the same program runs under `jax.distributed` across hosts —
 the mesh spans all processes' devices and each host routes/feeds the
@@ -122,19 +125,140 @@ class RoutedBlobView:
                 pass
 
 
+class DeviceRoutedView:
+    """Lazy materialization handle for a DEVICE-routed step: the host
+    never builds the routed [S, B] layout — the mesh does (ops/route.py)
+    — so this view reconstructs only what alert materialization needs
+    (the routed device_idx/ts columns), only when something actually
+    fired, from the flat wire blob it keeps. The reconstruction is the
+    same stable `_shard_sort` bucketing the host router uses, over TWO
+    columns instead of a 5-row blob scatter — and it runs on the cold
+    path, not per step.
+
+    When the flat blob is a pooled loan (router.flat_staging_buffer),
+    `release` hands it back on GC exactly like RoutedBlobView."""
+
+    __slots__ = ("blob", "shard_ids", "_router", "_cols", "_flat",
+                 "_full", "_release", "__weakref__")
+
+    def __init__(self, blob: np.ndarray, router: ShardRouter,
+                 release: Optional[Callable[[], None]] = None):
+        self.blob = blob                 # flat [wire_rows, S*B]
+        self.shard_ids = None
+        self._router = router
+        self._cols = None
+        self._flat = None
+        self._full = None
+        self._release = release
+
+    def _flat_batch(self) -> EventBatch:
+        if self._flat is None:
+            from sitewhere_tpu.ops.pack import blob_to_batch_np
+
+            self._flat = blob_to_batch_np(self.blob)
+        return self._flat
+
+    def _sort(self):
+        flat = self._flat_batch()
+        rt = self._router
+        valid = np.asarray(flat.valid)
+        rows = None if valid.all() else np.nonzero(valid)[0]
+        devcol = np.asarray(flat.device_idx)
+        dev = devcol if rows is None else devcol[rows]
+        ksorted, kept, _ = rt._shard_sort(dev, rows)
+        kstarts = np.zeros(rt.n_shards + 1, np.int64)
+        np.cumsum(kept, out=kstarts[1:])
+        return ksorted, kept, kstarts
+
+    def _routed_cols(self):
+        if self._cols is None:
+            flat = self._flat_batch()
+            rt = self._router
+            S, B = rt.n_shards, rt.per_shard_batch
+            ksorted, kept, kstarts = self._sort()
+            out_dev = np.zeros((S, B), np.int32)
+            out_ts = np.zeros((S, B), np.int32)
+            rt._place_sorted(out_dev,
+                             np.asarray(flat.device_idx)[ksorted] // S,
+                             kept, kstarts)
+            rt._place_sorted(out_ts, np.asarray(flat.ts)[ksorted],
+                             kept, kstarts)
+            self._cols = (out_dev, out_ts)
+        return self._cols
+
+    @property
+    def device_idx(self) -> np.ndarray:  # LOCAL indices, [S, B]
+        return self._routed_cols()[0]
+
+    @property
+    def ts(self) -> np.ndarray:          # [S, B]
+        return self._routed_cols()[1]
+
+    @property
+    def batch(self) -> EventBatch:
+        """Full routed [S, B] EventBatch (wire-faithful: reconstructed
+        from the flat blob, local device indices) — RoutedBlobView
+        compat for oracle/differential consumers. Cold path only."""
+        if self._full is None:
+            import dataclasses as _dc
+
+            flat = self._flat_batch()
+            rt = self._router
+            S, B = rt.n_shards, rt.per_shard_batch
+            ksorted, kept, kstarts = self._sort()
+            cols = {}
+            for f in _dc.fields(flat):
+                col = np.asarray(getattr(flat, f.name))
+                gathered = col[ksorted]
+                if f.name == "device_idx":
+                    gathered = gathered // S   # global -> local rows
+                out = np.zeros((S, B), col.dtype)
+                rt._place_sorted(out, gathered, kept, kstarts)
+                cols[f.name] = out
+            self._full = EventBatch(**cols)
+        return self._full
+
+    def __getattr__(self, name):
+        return getattr(self.batch, name)
+
+    def __del__(self):
+        release, self._release = self._release, None
+        if release is not None:
+            try:
+                release()
+            except Exception:
+                pass
+
+
+class _PreparedStep:
+    """Host-side routing decision for one step, between _prepare_step and
+    stage_prepared: `kind` is "host" (arena-routed [S, rows, B] blob,
+    possibly a pooled loan) or "device" (unrouted flat [rows, S*B] blob;
+    the mesh routes it in the step's prologue)."""
+
+    __slots__ = ("kind", "blob")
+
+    def __init__(self, kind: str, blob: np.ndarray):
+        self.kind = kind
+        self.blob = blob
+
+
 class _StagedStep:
-    """In-flight staged blob between stage_routed_blob and
-    dispatch_staged: the (possibly still transferring) global device
-    array, the lazy materialization view, the host blob the events meter
-    counts from, and the loaned routed blob to release after dispatch."""
+    """In-flight staged blob between stage_prepared and dispatch_staged:
+    the (possibly still transferring) global device array, the lazy
+    materialization view, the host blob the events meter counts from,
+    the loaned host blob to release after dispatch, and which compiled
+    program ("host" routed / "device" routing-prologue) consumes it."""
 
-    __slots__ = ("blob", "view", "counted", "routed_blob")
+    __slots__ = ("blob", "view", "counted", "routed_blob", "kind")
 
-    def __init__(self, blob, view: RoutedBlobView, counted, routed_blob):
+    def __init__(self, blob, view, counted, routed_blob,
+                 kind: str = "host"):
         self.blob = blob
         self.view = view
         self.counted = counted
         self.routed_blob = routed_blob
+        self.kind = kind
 
 
 class ShardedPipelineEngine(PipelineEngine):
@@ -146,6 +270,7 @@ class ShardedPipelineEngine(PipelineEngine):
 
     def __init__(self, registry_tensors: RegistryTensors,
                  mesh: Optional[Mesh] = None, per_shard_batch: int = 4096,
+                 device_routing: Optional[bool] = None,
                  **kwargs):
         self.mesh = mesh or make_mesh()
         self.n_shards = shard_axis_size(self.mesh)
@@ -173,7 +298,33 @@ class ShardedPipelineEngine(PipelineEngine):
         from sitewhere_tpu.ops.pack import EventPacker
         self.packer = EventPacker(per_shard_batch * self.n_shards,
                                   registry_tensors.devices)
+        # On-device shard routing (ops/route.py): the feeder ships the
+        # UNROUTED flat blob (pack + one H2D) and a fused routing
+        # prologue inside the step's shard_map buckets + all_to_all's
+        # rows to their owner shards — no per-row host bucketing. Auto:
+        # on for real multi-shard single-controller meshes; single-chip
+        # "sharded" meshes keep the host path (nothing to exchange, and
+        # the host-vs-device router micro-bench needs the host baseline);
+        # multi-host clusters keep the host path (per-host feeding +
+        # take_foreign owns cross-host rows there).
+        if device_routing is None:
+            device_routing = self.n_shards >= 2 and not self.is_multiprocess
+        elif device_routing and self.is_multiprocess:
+            raise ValueError(
+                "device_routing is single-controller only: multi-host "
+                "clusters feed per-host and forward foreign rows over "
+                "the bus edge (take_foreign)")
+        self.device_routing = bool(device_routing)
+        from sitewhere_tpu.ops.route import route_lane_capacity
+        self.route_lane_capacity = route_lane_capacity(
+            per_shard_batch, self.n_shards)
+        # loud accounting for the bounded host-spill fallback and the
+        # (defensive, normally zero) on-device drop counter
+        self.device_route_steps = 0
+        self.device_route_fallbacks = 0
+        self.device_route_dropped = 0
         self._sharded_step = None  # built lazily once specs are known
+        self._sharded_step_device = None
         # shard-overflow events requeued ahead of the next submit; when the
         # backlog exceeds the bound, submit() drains it with extra steps
         # (backpressure) instead of dropping rows
@@ -313,7 +464,12 @@ class ShardedPipelineEngine(PipelineEngine):
         def unsq(a):
             return a[None]
 
-        def sharded(params, state, rule_state, blob):
+        def local_step(params, state, rule_state, local_blob,
+                       route_dropped=None):
+            """Shared per-shard body: fused step over an already-LOCAL
+            [wire_rows, B] routed blob. `route_dropped` (device-routing
+            prologue only) rides out on the alert lanes' spare counts
+            slot — no extra output, no extra fetch."""
             params = params.replace(
                 assignment_status=sq(params.assignment_status),
                 tenant_idx=sq(params.tenant_idx),
@@ -321,13 +477,17 @@ class ShardedPipelineEngine(PipelineEngine):
                 device_type_idx=sq(params.device_type_idx))
             state = jax.tree_util.tree_map(sq, state)
             rule_state = jax.tree_util.tree_map(sq, rule_state)
-            batch = blob_to_batch(sq(blob))          # [12, B] -> columns
+            batch = blob_to_batch(local_blob)        # [12, B] -> columns
             new_state, new_rule_state, out = process_batch(
                 params, state, rule_state, batch,
                 geofence_impl=self.geofence_impl,
                 alert_lane_capacity=self.alert_lane_capacity,
                 programs_enabled=programs_enabled,
                 program_node_limit=node_limit)
+            lanes = out.alert_lanes
+            if route_dropped is not None:
+                from sitewhere_tpu.ops.route import ROUTE_DROPPED_SLOT
+                lanes = lanes.at[3, ROUTE_DROPPED_SLOT].set(route_dropped)
             new_state = jax.tree_util.tree_map(unsq, new_state)
             new_rule_state = jax.tree_util.tree_map(unsq, new_rule_state)
             out = out.replace(
@@ -341,25 +501,53 @@ class ShardedPipelineEngine(PipelineEngine):
                 program_fired=unsq(out.program_fired),
                 program_first_rule=unsq(out.program_first_rule),
                 program_alert_level=unsq(out.program_alert_level),
-                alert_lanes=unsq(out.alert_lanes),
+                alert_lanes=unsq(lanes),
                 tenant_counts=jax.lax.psum(out.tenant_counts, SHARD_AXIS),
                 processed=jax.lax.psum(out.processed, SHARD_AXIS),
                 alerts=jax.lax.psum(out.alerts, SHARD_AXIS))
             return new_state, new_rule_state, out
 
-        specs = dict(mesh=self.mesh,
-                     in_specs=(params_specs, state_specs, rule_state_specs,
-                               blob_specs),
-                     out_specs=(state_specs, rule_state_specs, out_specs))
-        try:
-            # the geofence containment scan's carry is replicated only
-            # through the psum at the end of the step — a loop invariant
-            # the replication checker cannot infer statically (same
-            # workaround as parallel/distributed.py's ring combine)
-            mapped = _shard_map(sharded, check_vma=False, **specs)
-        except TypeError:  # older jax spells it check_rep
-            mapped = _shard_map(sharded, check_rep=False, **specs)
-        self._sharded_step = jax.jit(mapped, donate_argnums=(1, 2))
+        def sharded(params, state, rule_state, blob):
+            return local_step(params, state, rule_state, sq(blob))
+
+        def build(fn, blob_spec):
+            specs = dict(mesh=self.mesh,
+                         in_specs=(params_specs, state_specs,
+                                   rule_state_specs, blob_spec),
+                         out_specs=(state_specs, rule_state_specs,
+                                    out_specs))
+            try:
+                # the geofence containment scan's carry is replicated
+                # only through the psum at the end of the step — a loop
+                # invariant the replication checker cannot infer
+                # statically (same workaround as
+                # parallel/distributed.py's ring combine)
+                mapped = _shard_map(fn, check_vma=False, **specs)
+            except TypeError:  # older jax spells it check_rep
+                mapped = _shard_map(fn, check_rep=False, **specs)
+            return jax.jit(mapped, donate_argnums=(1, 2))
+
+        self._sharded_step = build(sharded, blob_specs)
+        if self.device_routing:
+            from sitewhere_tpu.ops.route import device_route_chunk
+            n_shards = self.n_shards
+            per_shard = self.batch_size
+            lane_cap = self.route_lane_capacity
+
+            def sharded_device(params, state, rule_state, flat_blob):
+                # flat_blob block: [wire_rows, B] UNROUTED lane chunk
+                # (the flat blob split along lanes, P(None, shard)) —
+                # the routing prologue buckets + all_to_all's it to the
+                # owner shards inside the same program as the step
+                local_blob, dropped = device_route_chunk(
+                    flat_blob, n_shards, per_shard, lane_cap, SHARD_AXIS)
+                return local_step(params, state, rule_state, local_blob,
+                                  route_dropped=dropped)
+
+            self._sharded_step_device = build(
+                sharded_device, P(None, SHARD_AXIS))
+        else:
+            self._sharded_step_device = None
         self._sharded_built_config = (programs_enabled, node_limit)
 
     # -- params ---------------------------------------------------------------
@@ -410,14 +598,15 @@ class ShardedPipelineEngine(PipelineEngine):
         `drain_steps` counts the extra steps for observability."""
         params = self._ensure_params()
         batch = self.merge_pending_overflow(batch)
-        # Fused pack+route: one native pass from flat columns straight into
-        # the routed [S, WIRE_ROWS, B] staging blob (reused ring buffer, no
-        # per-step allocation) — the routed blob IS the staging format, and
-        # the routed EventBatch view is derived by cheap numpy bit-ops only
-        # for materialization.
-        routed_blob, over_rows = self.router.route_batch(batch)
+        # Device routing (default on real multi-shard meshes): pack the
+        # flat blob, one H2D, and let the mesh route it inside the step
+        # (ops/route.py). Host arena route (fused native pack+route into
+        # a pooled routed blob) remains the fallback for skewed batches
+        # that would overflow a device lane — and the only path on
+        # single-chip meshes and multi-host clusters.
+        prepared, over_rows = self._prepare_step(batch)
         try:
-            routed_batch, outputs = self._one_step(params, routed_blob)
+            routed_batch, outputs = self._one_step(params, prepared)
         except BaseException:
             if not self.is_multiprocess:
                 # transfer state unknown mid-failure: drop the loaned
@@ -426,7 +615,7 @@ class ShardedPipelineEngine(PipelineEngine):
                 # released it before the step (it never reaches jax there
                 # — only the local copy does), so discarding again would
                 # under-count the pool.
-                self.router.discard_staging_buffer(routed_blob)
+                self.router.discard_staging_buffer(prepared.blob)
             raise
         self.park_overflow(batch, over_rows)
         # Multi-process lockstep: every host must launch the SAME number of
@@ -446,10 +635,61 @@ class ShardedPipelineEngine(PipelineEngine):
             self._overflow = None
             self.drain_steps += 1
             self._metrics.counter("overflow.drain_steps").inc()
-            routed_blob, over_rows = self.router.route_batch(backlog)
-            routed_batch, outputs = self._one_step(params, routed_blob)
+            prepared, over_rows = self._prepare_step(backlog)
+            routed_batch, outputs = self._one_step(params, prepared)
             self.park_overflow(backlog, over_rows)
         return routed_batch, outputs
+
+    def _prepare_step(self, batch: EventBatch
+                      ) -> Tuple["_PreparedStep", np.ndarray]:
+        """Host half of one step's routing decision. Device-routing mode:
+        when the flat batch fits the mesh's fixed lanes (cheap bincount
+        guard, ops/route.py), pack it UNROUTED — the mesh routes it — and
+        no overflow is possible. Otherwise (skew past lane capacity, a
+        merged backlog longer than the global batch, host-routing mode):
+        the host arena route, whose overflow rows requeue as always —
+        the bounded, loudly-counted spill path."""
+        if self.device_routing and self._device_route_fits(batch):
+            self.device_route_steps += 1
+            self._metrics.counter("route.device_steps").inc()
+            return (_PreparedStep("device", self._pack_flat_blob(batch)),
+                    np.empty(0, np.int64))
+        if self.device_routing:
+            self.device_route_fallbacks += 1
+            self._metrics.counter("route.host_fallbacks").inc()
+        routed_blob, over_rows = self.router.route_batch(batch)
+        return _PreparedStep("host", routed_blob), over_rows
+
+    def _device_route_fits(self, batch: EventBatch) -> bool:
+        from sitewhere_tpu.ops.route import host_fits_device_route
+
+        n = batch.device_idx.shape[0]
+        if n > self.batch_size * self.n_shards:
+            return False  # longer than the global batch: host path requeues
+        return host_fits_device_route(
+            batch.device_idx, batch.valid, self.n_shards, self.batch_size,
+            self.route_lane_capacity)
+
+    def _pack_flat_blob(self, batch: EventBatch) -> np.ndarray:
+        """Pack a flat batch into the UNROUTED [wire_rows, S*B] staging
+        blob (pooled when the mesh is an accelerator), zero-padding short
+        batches to the global width. This—plus one device_put—is ALL the
+        host does per step in device-routing mode."""
+        from sitewhere_tpu.ops.pack import batch_to_blob, wire_variant_for
+
+        G = self.batch_size * self.n_shards
+        rows, ts_base = wire_variant_for(batch)
+        rows, ts_base = self.router._routable_variant(rows, ts_base)
+        buf = self.router.flat_staging_buffer(rows)
+        n = batch.device_idx.shape[0]
+        if n == G:
+            return batch_to_blob(batch, out=buf, wire_rows=rows)
+        small = batch_to_blob(batch, wire_rows=rows)
+        if buf is None:
+            buf = np.empty((small.shape[0], G), np.int32)
+        buf[:, :n] = small
+        buf[:, n:] = 0
+        return buf
 
     @staticmethod
     def _slice_flat(batch: EventBatch,
@@ -478,19 +718,33 @@ class ShardedPipelineEngine(PipelineEngine):
         when `batch` is an arena view about to be overwritten."""
         self._overflow = self._slice_flat(batch, over_rows)
 
-    def _one_step(self, params, routed_blob: np.ndarray
+    def _one_step(self, params, prepared: "_PreparedStep"
                   ) -> Tuple["RoutedBlobView", ProcessOutputs]:
-        return self.dispatch_staged(params, self.stage_routed_blob(routed_blob))
+        return self.dispatch_staged(params, self.stage_prepared(prepared))
+
+    def stage_prepared(self, prepared: "_PreparedStep") -> "_StagedStep":
+        """Start the host->mesh transfer of a prepared step WITHOUT
+        dispatching it. device_put is async on accelerator runtimes, so a
+        pipelined feeder can overlap this staging (and the host prep that
+        produced the blob) with the previous step's device execution —
+        the sharded half of pipeline/feed.py's double-buffered contract.
+        Returns a staged handle for dispatch_staged; a pooled blob's
+        release is wired there (its H2D guard is the step's output)."""
+        if prepared.kind == "device":
+            # UNROUTED flat blob, split along the LANE axis: shard i's
+            # chunk is flat lanes [i*B, (i+1)*B) — the routing prologue
+            # inside the step exchanges rows to their owners
+            flat = NamedSharding(self.mesh, P(None, SHARD_AXIS))
+            blob = jax.device_put(prepared.blob, flat)
+            view = DeviceRoutedView(prepared.blob, self.router)
+            return _StagedStep(blob, view, prepared.blob, prepared.blob,
+                               kind="device")
+        return self.stage_routed_blob(prepared.blob)
 
     def stage_routed_blob(self, routed_blob: np.ndarray) -> "_StagedStep":
-        """Start the host->mesh transfer of a routed [S, WIRE_ROWS, B]
-        blob WITHOUT dispatching the step. device_put is async on
-        accelerator runtimes, so a pipelined feeder can overlap this
-        staging (and the routing that produced the blob) with the
-        previous step's device execution — the sharded half of
-        pipeline/feed.py's double-buffered contract. Returns a staged
-        handle for dispatch_staged; the loaned routed blob's release is
-        wired there (its H2D guard is the dispatched step's output)."""
+        """Start the host->mesh transfer of a HOST-routed [S, WIRE_ROWS,
+        B] blob (see stage_prepared; this is the host-arena half, and the
+        only one multi-process feeding uses)."""
         shard0 = NamedSharding(self.mesh, P(SHARD_AXIS))
         if self.is_multiprocess:
             # Per-host feeding (the multi-host jax data contract): this
@@ -523,13 +777,16 @@ class ShardedPipelineEngine(PipelineEngine):
         from sitewhere_tpu.ops.pack import _VALID_SHIFT
 
         view = staged.view
+        step = (self._sharded_step_device if staged.kind == "device"
+                else self._sharded_step)
         with self._metrics.timer("step").time():
             with self._state_lock:  # vs concurrent readers (base __init__)
-                self._state, self._rule_state, outputs = self._sharded_step(
+                self._state, self._rule_state, outputs = step(
                     params, self._state, self._rule_state, staged.blob)
-        if not self.is_multiprocess:
-            # pooled-blob loan: returns on view GC; outputs.processed is
-            # the transfer-completion guard (step executed => input read)
+        if not self.is_multiprocess and staged.routed_blob is not None:
+            # pooled-blob loan (routed OR flat): returns on view GC;
+            # outputs.processed is the transfer-completion guard (step
+            # executed => input read)
             view._release = partial(self.router.release_staging_buffer,
                                     staged.routed_blob, outputs.processed)
         self.batches_processed += 1
@@ -619,6 +876,7 @@ class ShardedPipelineEngine(PipelineEngine):
         self.d2h_bytes += lanes.nbytes
         decs = [decode_alert_lanes(lanes[s]) for s in range(lanes.shape[0])]
         self._account_lane_overflow(sum(d.dropped_alerts for d in decs))
+        self._account_route_dropped(sum(d.route_dropped for d in decs))
         if not any(d.n for d in decs):
             return []
         if isinstance(routed_batch, RoutedBlobView):
@@ -653,6 +911,22 @@ class ShardedPipelineEngine(PipelineEngine):
         bounded = self._bound_alert_rows(combined, max_alerts)
         n = bounded.n
         return self._emit_alerts(bounded, dev_rows[:n], ts_rows[:n])
+
+    def _account_route_dropped(self, dropped: int) -> None:
+        """Defensive on-device route drop accounting (lane counts slot 3,
+        ops/route.py): the host lane-fit guard makes this zero on every
+        normal step, so any nonzero count is loud — it means a row was
+        lost between the guard and the exchange (a bug, not weather)."""
+        if not dropped:
+            return
+        self.device_route_dropped += dropped
+        self._metrics.counter("route.device_dropped").inc(dropped)
+        import logging
+        logging.getLogger("sitewhere.parallel").error(
+            "device route dropped %d rows past the %d-slot lanes despite "
+            "the host fit guard (device_route_dropped=%d total) — "
+            "investigate: the guard and the kernel disagree",
+            dropped, self.route_lane_capacity, self.device_route_dropped)
 
     # -- reads ----------------------------------------------------------------
 
@@ -1043,6 +1317,13 @@ class ShardedPipelineEngine(PipelineEngine):
             "dropped": self.total_dropped,
             "drain_steps": self.drain_steps,
             "pending_overflow": self.pending_overflow,
+            # on-device shard routing accounting (ops/route.py):
+            # fallbacks = steps the skew guard spilled to the host arena
+            # path; route_dropped stays 0 unless guard and kernel disagree
+            "device_routing": self.device_routing,
+            "device_route_steps": self.device_route_steps,
+            "device_route_fallbacks": self.device_route_fallbacks,
+            "device_route_dropped": self.device_route_dropped,
             "tenant_event_count": tenant_events,
             "tenant_alert_count": tenant_alerts,
             # multi-process: tenant totals above cover THIS host's shards

@@ -156,16 +156,33 @@ class ShardRouter:
         self._scratch_i: Optional[np.ndarray] = None
         self._scratch_f: Optional[np.ndarray] = None
 
-    def _buf_rows(self, buf: np.ndarray) -> Optional[int]:
+    def _buf_key(self, buf: np.ndarray):
+        """Pool key of a loaned buffer: wire-rows count for routed
+        [S, rows, B] blobs, ("flat", rows) for unrouted [rows, S*B]
+        flat blobs (the device-routing feeder's staging format)."""
         if (buf.ndim == 3 and buf.shape[0] == self.n_shards
                 and buf.shape[2] == self.per_shard_batch):
             return buf.shape[1]
+        if (buf.ndim == 2
+                and buf.shape[1] == self.n_shards * self.per_shard_batch):
+            return ("flat", buf.shape[0])
         return None
 
     def _free_count(self) -> int:
         return sum(len(p) for p in self._pools.values())
 
     def _staging_buffer(self, rows: int) -> Optional[np.ndarray]:
+        return self._pool_get(
+            rows, (self.n_shards, rows, self.per_shard_batch))
+
+    def flat_staging_buffer(self, rows: int) -> Optional[np.ndarray]:
+        """Pooled UNROUTED flat staging blob [rows, S*B] for the
+        device-routing path (same loan/guard/bound contract as the
+        routed buffers; release through release_staging_buffer)."""
+        return self._pool_get(
+            ("flat", rows), (rows, self.n_shards * self.per_shard_batch))
+
+    def _pool_get(self, key, shape) -> Optional[np.ndarray]:
         import threading
 
         if self.staging_ring <= 0:
@@ -173,10 +190,9 @@ class ShardRouter:
         if self._pool_lock is None:
             self._pool_lock = threading.Lock()
         with self._pool_lock:
-            pool = self._pools.setdefault(rows, [])
+            pool = self._pools.setdefault(key, [])
             if not pool:
-                return np.empty(
-                    (self.n_shards, rows, self.per_shard_batch), np.int32)
+                return np.empty(shape, np.int32)
             buf, guard = pool.pop(0)
         if guard is not None:
             # device_put's H2D DMA may still be reading the host buffer
@@ -203,18 +219,18 @@ class ShardRouter:
         step's output when the blob was device_put."""
         if self.staging_ring <= 0 or self._pool_lock is None:
             return
-        rows = self._buf_rows(buf)
-        if rows is None:
+        key = self._buf_key(buf)
+        if key is None:
             return
         with self._pool_lock:
             if self._free_count() >= self.staging_ring:
                 other = next(
                     (pool for variant, pool in self._pools.items()
-                     if variant != rows and pool), None)
+                     if variant != key and pool), None)
                 if other is None:
                     return  # bound reached by this variant: drop
                 other.pop(0)  # evict a stale variant, keep the active one
-            self._pools.setdefault(rows, []).append((buf, guard))
+            self._pools.setdefault(key, []).append((buf, guard))
 
     def discard_staging_buffer(self, buf: np.ndarray) -> None:
         """Error-path drop of a loaned blob whose transfer state is

@@ -262,11 +262,14 @@ class ShardedPipelinedSubmitter:
     engine's overflow backlog, and per-device order requires requeued
     rows to ride the next routed batch):
 
-      stagers:   take batch N; ROUTE it in strict submission order (a
-                 routing turnstile — vectorized routing is the cheap
-                 part, see parallel/router.py); then start the mesh
-                 transfer (engine.stage_routed_blob, async device_put)
-                 concurrently with other stagers' routing/transfers
+      stagers:   take batch N; PREPARE it in strict submission order (a
+                 routing turnstile). With device routing on (the default
+                 on real multi-shard meshes) preparing is pack + a cheap
+                 lane-fit guard — the mesh itself routes the rows inside
+                 the step (ops/route.py); otherwise the host arena route
+                 runs here. Then start the mesh transfer
+                 (engine.stage_prepared, async device_put) concurrently
+                 with other stagers' prep/transfers
       step thread: dispatch staged steps in submission order (state
                  donation serializes device execution anyway)
 
@@ -360,25 +363,31 @@ class ShardedPipelinedSubmitter:
             exc: Optional[BaseException] = None
             try:
                 try:
+                    # _prepare_step: with device routing on (the default
+                    # on real multi-shard meshes) this is pack + the
+                    # cheap lane-fit guard ONLY — the mesh does the
+                    # bucketing in the step's prologue (ops/route.py),
+                    # freeing stager CPU for persist/consumer work; the
+                    # host arena route runs just for skewed spills
                     merged = eng.merge_pending_overflow(batch)
-                    blob, over = eng.router.route_batch(merged)
+                    prepared, over = eng._prepare_step(merged)
                     eng.park_overflow(merged, over)
-                    blobs = [blob]
+                    prepped = [prepared]
                     # backpressure: route drain blobs (backlog only) as
                     # extra steps under the same turn, like submit()
                     while eng.pending_overflow > eng.max_overflow_events:
                         backlog = eng.pending_overflow_batch()
                         eng.set_pending_overflow_batch(None)
-                        dblob, dover = eng.router.route_batch(backlog)
+                        dprep, dover = eng._prepare_step(backlog)
                         eng.park_overflow(backlog, dover)
-                        blobs.append(dblob)
+                        prepped.append(dprep)
                 finally:
                     with self._ready_lock:
                         self._next_route += 1
                         self._ready_lock.notify_all()
                 # mesh transfers start here, OUTSIDE the turnstile: they
                 # overlap other stagers' routing and the device compute
-                staged = [eng.stage_routed_blob(b) for b in blobs]
+                staged = [eng.stage_prepared(p) for p in prepped]
             except BaseException as stage_exc:
                 exc = stage_exc
             with self._ready_lock:

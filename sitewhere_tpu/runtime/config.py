@@ -182,6 +182,10 @@ DEFAULTS: Dict = {
         # coalescing behind an in-flight flush. False = classic fixed
         # linger (maximize coalescing for bursty multi-producer ingest)
         "adaptive_linger": True,
+        # on-device shard routing (ops/route.py): "auto" turns it on for
+        # real multi-shard single-controller meshes (single-chip and
+        # multi-host keep the host arena route); "on"/"off" force it
+        "device_routing": "auto",
         "max_devices": 131072,
         "max_zones": 256,
         "max_zone_vertices": 32,

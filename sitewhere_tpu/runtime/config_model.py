@@ -133,6 +133,12 @@ def pipeline_model() -> ElementModel:
                   default="auto"),
             _attr("shards", _I, default=1,
                   description="mesh size for ShardedPipelineEngine"),
+            _attr("device_routing", choices=["auto", "on", "off"],
+                  default="auto",
+                  description="on-device shard routing (radix bucket + "
+                              "ICI all_to_all in the fused step) instead "
+                              "of the host arena router; auto = on for "
+                              "multi-shard single-controller meshes"),
         ])
 
 

@@ -1,0 +1,341 @@
+"""Differential tests: on-device shard routing vs the host arena router.
+
+The device route (ops/route.py: per-chunk radix bucketing + one
+all_to_all + prefix-sum compaction) must be BIT-IDENTICAL to
+ShardRouter's output for any batch the host lane-fit guard admits, and
+the device-routed engine must therefore match a host-routed oracle
+engine exactly — processed counts, device state, alert-lane contents
+AND order — including when skew spills steps to the host fallback and
+when per-shard capacity overflow requeues rows.
+"""
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from sitewhere_tpu.model import AlertLevel
+from sitewhere_tpu.model.event import DeviceEventType, DeviceMeasurement
+from sitewhere_tpu.ops.pack import (
+    EventPacker, WIRE_ROWS_COMPACT, WIRE_ROWS_PACKED, batch_to_blob)
+from sitewhere_tpu.ops.route import (
+    build_device_route_program, host_fits_device_route,
+    route_lane_capacity)
+from sitewhere_tpu.parallel import ShardedPipelineEngine, ShardRouter, make_mesh
+from sitewhere_tpu.parallel.mesh import SHARD_AXIS
+from sitewhere_tpu.pipeline.engine import GeofenceRule, ThresholdRule
+from sitewhere_tpu.registry.interning import TokenInterner
+
+_MEAS = int(DeviceEventType.MEASUREMENT)
+_LOC = int(DeviceEventType.LOCATION)
+_ALERT = int(DeviceEventType.ALERT)
+
+
+def _mixed_batch(packer, n, n_devices, rng, with_locations=True):
+    types = ([_MEAS, _LOC, _ALERT] if with_locations else [_MEAS, _ALERT])
+    return packer.pack_columns(
+        (np.arange(n) % n_devices + 1).astype(np.int32),
+        rng.choice(types, n).astype(np.int32),
+        (packer.epoch_base_ms + rng.integers(0, 1000, n)).astype(np.int64),
+        mm_idx=np.full(n, 1, np.int32),
+        value=rng.uniform(0, 100, n).astype(np.float32),
+        lat=rng.uniform(-5, 15, n).astype(np.float32),
+        lon=rng.uniform(-5, 15, n).astype(np.float32),
+        alert_type_idx=np.full(n, 1, np.int32),
+        alert_level=np.full(n, 2, np.int32))
+
+
+class TestRouteKernelParity:
+    """build_device_route_program output == ShardRouter.route_blob."""
+
+    def _flat_sharding(self, mesh):
+        return NamedSharding(mesh, P(None, SHARD_AXIS))
+
+    @pytest.mark.parametrize("n_shards", [1, 2, 4, 8])
+    def test_classic_blob_with_invalid_rows(self, n_shards, rng):
+        S, B = n_shards, 16
+        packer = EventPacker(S * B, TokenInterner(4096, "d"))
+        batch = _mixed_batch(packer, S * B - 3, S * B, rng)
+        valid = np.asarray(batch.valid).copy()
+        valid[::5] = False                       # interspersed padding
+        batch = batch.replace(valid=valid)
+        flat = batch_to_blob(batch)              # 5-row (locations)
+        expect, over = ShardRouter(S, B).route_blob(flat)
+        assert len(over) == 0
+        mesh = make_mesh(S)
+        prog = build_device_route_program(mesh, S, B)
+        got, dropped = prog(jax.device_put(flat, self._flat_sharding(mesh)))
+        assert int(np.asarray(dropped).sum()) == 0
+        np.testing.assert_array_equal(np.asarray(got), expect)
+
+    @pytest.mark.parametrize("base_offset", [0, -5_000_000])
+    def test_packed_blob_ts_base(self, base_offset, rng):
+        """The packed 3-row wire's lane-embedded ts base (chunk 0 only)
+        must broadcast and re-embed bit-identically — negative rebased
+        bases (replay traffic) included."""
+        S, B = 4, 16
+        packer = EventPacker(S * B, TokenInterner(4096, "d"))
+        n = S * B
+        batch = packer.pack_columns(
+            (np.arange(n) % n + 1).astype(np.int32),
+            np.where(np.arange(n) % 7 == 0, _ALERT, _MEAS).astype(np.int32),
+            (packer.epoch_base_ms + base_offset
+             + rng.integers(0, 1000, n)).astype(np.int64),
+            mm_idx=np.full(n, 1, np.int32),
+            value=rng.uniform(0, 100, n).astype(np.float32),
+            alert_type_idx=np.full(n, 1, np.int32),
+            alert_level=np.full(n, 2, np.int32))
+        flat = batch_to_blob(batch)
+        assert flat.shape[0] == WIRE_ROWS_PACKED
+        expect, over = ShardRouter(S, B).route_blob(flat)
+        assert len(over) == 0
+        mesh = make_mesh(S)
+        prog = build_device_route_program(mesh, S, B)
+        got, dropped = prog(jax.device_put(flat, self._flat_sharding(mesh)))
+        assert int(np.asarray(dropped).sum()) == 0
+        np.testing.assert_array_equal(np.asarray(got), expect)
+
+    def test_compact_blob(self, rng):
+        S, B = 2, 16
+        packer = EventPacker(S * B, TokenInterner(4096, "d"))
+        batch = _mixed_batch(packer, S * B, S * B, rng)
+        flat = batch_to_blob(batch, wire_rows=WIRE_ROWS_COMPACT)
+        expect, over = ShardRouter(S, B).route_blob(flat)
+        assert len(over) == 0
+        mesh = make_mesh(S)
+        prog = build_device_route_program(mesh, S, B)
+        got, dropped = prog(jax.device_put(flat, self._flat_sharding(mesh)))
+        assert int(np.asarray(dropped).sum()) == 0
+        np.testing.assert_array_equal(np.asarray(got), expect)
+
+    def test_lane_overflow_counted_on_device(self):
+        """Without the host guard, a bucket past lane capacity drops on
+        device and is COUNTED (the loud-accounting backstop the engine
+        never reaches because _prepare_step guards first)."""
+        S, B = 4, 16
+        packer = EventPacker(S * B, TokenInterner(4096, "d"))
+        n = S * B
+        batch = packer.pack_columns(
+            np.full(n, 4, np.int32),             # all rows -> shard 0
+            np.zeros(n, np.int32),
+            np.full(n, packer.epoch_base_ms, np.int64),
+            mm_idx=np.full(n, 1, np.int32),
+            value=np.full(n, 1.0, np.float32))
+        cap = route_lane_capacity(B, S)
+        assert not host_fits_device_route(
+            batch.device_idx, batch.valid, S, B, cap)
+        flat = batch_to_blob(batch, wire_rows=WIRE_ROWS_COMPACT)
+        mesh = make_mesh(S)
+        prog = build_device_route_program(mesh, S, B)
+        _, dropped = prog(
+            jax.device_put(flat, self._flat_sharding(mesh)))
+        # every chunk drops its bucket tail past the lane (n - S*cap),
+        # and the one target shard drops the received tail past its
+        # per-shard batch (S*cap - B): everything beyond B is counted
+        assert int(np.asarray(dropped).sum()) == n - B
+
+
+class TestHostFitGuard:
+    def test_lane_capacity_math(self):
+        assert route_lane_capacity(4096, 1) == 4096
+        assert route_lane_capacity(4096, 8) == 1024   # 2 * 4096/8
+        assert route_lane_capacity(8, 2) == 8          # capped at B
+        assert route_lane_capacity(10, 4) == 5         # ceil(2*10/4)
+
+    def test_fits_uniform(self):
+        dev = (np.arange(64) % 64).astype(np.int32)
+        valid = np.ones(64, bool)
+        assert host_fits_device_route(dev, valid, 4, 16,
+                                      route_lane_capacity(16, 4))
+
+    def test_rejects_bucket_overflow(self):
+        # one chunk sends 9 rows to one shard; lane capacity is 8
+        dev = (np.arange(64) % 64).astype(np.int32)
+        dev[:9] = 4
+        assert not host_fits_device_route(dev, np.ones(64, bool), 4, 16, 8)
+
+    def test_rejects_per_shard_total_overflow(self):
+        # spread across chunks so no lane overflows, but shard 0's total
+        # (20 rows) exceeds the per-shard batch of 16
+        dev = (np.arange(64) % 64).astype(np.int32)
+        for c in range(4):
+            dev[c * 16:c * 16 + 5] = 4 * np.arange(5) + 4  # 5 rows -> s0
+        assert not host_fits_device_route(dev, np.ones(64, bool), 4, 16, 8)
+
+    def test_invalid_rows_do_not_count(self):
+        dev = np.full(64, 4, np.int32)
+        valid = np.zeros(64, bool)
+        valid[:3] = True
+        assert host_fits_device_route(dev, valid, 4, 16, 8)
+
+
+@pytest.fixture(scope="module")
+def engine_pair():
+    """(device-routed engine, host-routed oracle) over identical worlds,
+    aligned epochs — S=4, per-shard batch 16."""
+    from sitewhere_tpu.model import (
+        Area, Device, DeviceAssignment, DeviceType, Zone)
+    from sitewhere_tpu.model.common import Location
+    from sitewhere_tpu.registry import DeviceManagement, RegistryTensors
+
+    def world():
+        dm = DeviceManagement()
+        dtype = dm.create_device_type(DeviceType(token="sensor"))
+        area = dm.create_area(Area(token="area-1"))
+        dm.create_zone(Zone(token="zone-1", area_id=area.id, bounds=[
+            Location(0.0, 0.0), Location(0.0, 10.0),
+            Location(10.0, 10.0), Location(10.0, 0.0)]))
+        tensors = RegistryTensors(max_devices=256, max_zones=8,
+                                  max_zone_vertices=8)
+        tensors.attach(dm, "tenant-1")
+        for i in range(48):
+            device = dm.create_device(Device(token=f"dev-{i}",
+                                             device_type_id=dtype.id))
+            dm.create_device_assignment(DeviceAssignment(
+                token=f"as-{i}", device_id=device.id, area_id=area.id))
+        return tensors
+
+    def build(device_routing, name, epoch=None):
+        eng = ShardedPipelineEngine(
+            world(), mesh=make_mesh(4), per_shard_batch=16,
+            measurement_slots=4, max_tenants=4, max_threshold_rules=8,
+            max_geofence_rules=8, device_routing=device_routing, name=name)
+        if epoch is not None:
+            eng.packer.epoch_base_ms = epoch
+        eng.packer.measurements.intern("m1")
+        eng.add_threshold_rule(ThresholdRule(
+            token="hot", measurement_name="m1", operator=">",
+            threshold=90.0, alert_level=AlertLevel.CRITICAL))
+        eng.add_geofence_rule(GeofenceRule(
+            token="fence", zone_token="zone-1", condition="outside"))
+        eng.start()
+        return eng
+
+    dev = build(True, "devroute-diff")
+    host = build(False, "hostroute-diff", epoch=dev.packer.epoch_base_ms)
+    assert dev.device_routing and not host.device_routing
+    yield dev, host
+
+
+def _alert_key(a):
+    return (a.device_id, a.type, int(a.level), a.event_date, a.message)
+
+
+def _assert_step_parity(dev_eng, host_eng, batch_dev, batch_host, tag=""):
+    rd, od = dev_eng.submit(batch_dev)
+    rh, oh = host_eng.submit(batch_host)
+    assert int(od.processed) == int(oh.processed), tag
+    np.testing.assert_array_equal(
+        np.asarray(jax.device_get(od.alert_lanes)),
+        np.asarray(jax.device_get(oh.alert_lanes)), err_msg=tag)
+    a_dev = dev_eng.materialize_alerts(rd, od)
+    a_host = host_eng.materialize_alerts(rh, oh)
+    assert [_alert_key(a) for a in a_dev] == [_alert_key(a) for a in a_host]
+    return a_dev
+
+
+def _assert_state_parity(dev_eng, host_eng):
+    sd, sh = dev_eng.canonical_state(), host_eng.canonical_state()
+    for f in dataclasses.fields(sd):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(sd, f.name)),
+            np.asarray(getattr(sh, f.name)), err_msg=f.name)
+
+
+class TestEngineDifferential:
+    def test_mixed_traffic_parity(self, engine_pair, rng):
+        dev_eng, host_eng = engine_pair
+        fetches_before = dev_eng.d2h_fetches
+        for seed in range(3):
+            r = np.random.default_rng(seed)
+            bd = _mixed_batch(dev_eng.packer, 50, 48, r)
+            bh = _mixed_batch(host_eng.packer, 50, 48,
+                              np.random.default_rng(seed))
+            _assert_step_parity(dev_eng, host_eng, bd, bh, f"seed{seed}")
+        _assert_state_parity(dev_eng, host_eng)
+        assert dev_eng.device_route_steps >= 3
+        assert dev_eng.device_route_dropped == 0
+        # fetch budget unchanged by device routing: exactly ONE
+        # fixed-shape lane fetch per materialized step
+        assert dev_eng.d2h_fetches == fetches_before + 3
+
+    def test_skew_all_rows_one_device_falls_back(self, engine_pair):
+        """All rows to ONE device: a lane bucket overflows, the guard
+        spills the step to the host arena path, results still match."""
+        dev_eng, host_eng = engine_pair
+        before = dev_eng.device_route_fallbacks
+        events = [DeviceMeasurement(
+            name="m1", value=95.0,
+            event_date=dev_eng.packer.epoch_base_ms + i) for i in range(14)]
+        tokens = ["dev-1"] * 14
+        bd = dev_eng.packer.pack_events(events, tokens)[0]
+        bh = host_eng.packer.pack_events(events, tokens)[0]
+        _assert_step_parity(dev_eng, host_eng, bd, bh, "skew")
+        assert dev_eng.device_route_fallbacks == before + 1
+        _assert_state_parity(dev_eng, host_eng)
+
+    def test_overflow_spill_requeues_identically(self, engine_pair):
+        """More rows for one shard than its per-shard batch: the host
+        fallback requeues the tail on BOTH engines, and the drained
+        result matches."""
+        dev_eng, host_eng = engine_pair
+        events = [DeviceMeasurement(
+            name="m1", value=10.0 + i % 5,
+            event_date=dev_eng.packer.epoch_base_ms + i) for i in range(24)]
+        tokens = ["dev-2"] * 24        # 24 > per-shard batch of 16
+        bd = dev_eng.packer.pack_events(events, tokens)[0]
+        bh = host_eng.packer.pack_events(events, tokens)[0]
+        _assert_step_parity(dev_eng, host_eng, bd, bh, "overflow")
+        assert dev_eng.pending_overflow == host_eng.pending_overflow > 0
+        # the next submit folds the requeued tail AHEAD of the new rows
+        r = np.random.default_rng(11)
+        bd2 = _mixed_batch(dev_eng.packer, 20, 48, r)
+        bh2 = _mixed_batch(host_eng.packer, 20, 48,
+                           np.random.default_rng(11))
+        _assert_step_parity(dev_eng, host_eng, bd2, bh2, "post-overflow")
+        assert dev_eng.pending_overflow == host_eng.pending_overflow == 0
+        _assert_state_parity(dev_eng, host_eng)
+
+    def test_pipelined_feeder_device_mode(self, engine_pair):
+        """ShardedPipelinedSubmitter over the device-routing engine:
+        prepare (pack + guard) rides the turnstile, the mesh routes; the
+        end state matches the oracle fed the same batches directly."""
+        from sitewhere_tpu.pipeline.feed import ShardedPipelinedSubmitter
+
+        dev_eng, host_eng = engine_pair
+        batches = [(
+            _mixed_batch(dev_eng.packer, 40, 48, np.random.default_rng(s)),
+            _mixed_batch(host_eng.packer, 40, 48, np.random.default_rng(s)))
+            for s in range(20, 25)]
+        sub = ShardedPipelinedSubmitter(dev_eng, depth=3, stagers=2)
+        try:
+            futs = [sub.submit(bd) for bd, _ in batches]
+            sub.flush()
+            view, outputs = futs[-1].result(timeout=120.0)
+            jax.block_until_ready(outputs.processed)
+        finally:
+            sub.close()
+        for _, bh in batches:
+            host_eng.submit(bh)
+        _assert_state_parity(dev_eng, host_eng)
+
+    def test_single_chip_mesh_keeps_host_path(self):
+        """Auto mode: a 1-device 'sharded' mesh keeps the host router
+        (the micro-bench baseline must survive)."""
+        from sitewhere_tpu.registry import RegistryTensors
+
+        eng = ShardedPipelineEngine(
+            RegistryTensors(max_devices=64, max_zones=4,
+                            max_zone_vertices=8),
+            mesh=make_mesh(1), per_shard_batch=16, measurement_slots=4,
+            max_tenants=4, name="devroute-1chip")
+        assert not eng.device_routing
+
+    def test_stats_surface_route_counters(self, engine_pair):
+        dev_eng, _ = engine_pair
+        s = dev_eng.stats()
+        assert s["device_routing"] is True
+        assert s["device_route_steps"] >= 1
+        assert s["device_route_dropped"] == 0
