@@ -236,11 +236,16 @@ class _PreparedStep:
     possibly a pooled loan) or "device" (unrouted flat [rows, S*B] blob;
     the mesh routes it in the step's prologue)."""
 
-    __slots__ = ("kind", "blob")
+    __slots__ = ("kind", "blob", "flight")
 
-    def __init__(self, kind: str, blob: np.ndarray):
+    def __init__(self, kind: str, blob: np.ndarray, flight=None):
         self.kind = kind
         self.blob = blob
+        # flight record opened by _prepare_step; rides the prepared ->
+        # staged -> dispatched handoff so a pipelined feeder's stage-ahead
+        # work lands on the SAME record its dispatch completes (explicit
+        # cross-thread trace handoff)
+        self.flight = flight
 
 
 class _StagedStep:
@@ -250,15 +255,17 @@ class _StagedStep:
     the loaned host blob to release after dispatch, and which compiled
     program ("host" routed / "device" routing-prologue) consumes it."""
 
-    __slots__ = ("blob", "view", "counted", "routed_blob", "kind")
+    __slots__ = ("blob", "view", "counted", "routed_blob", "kind",
+                 "flight")
 
     def __init__(self, blob, view, counted, routed_blob,
-                 kind: str = "host"):
+                 kind: str = "host", flight=None):
         self.blob = blob
         self.view = view
         self.counted = counted
         self.routed_blob = routed_blob
         self.kind = kind
+        self.flight = flight
 
 
 class ShardedPipelineEngine(PipelineEngine):
@@ -649,16 +656,27 @@ class ShardedPipelineEngine(PipelineEngine):
         merged backlog longer than the global batch, host-routing mode):
         the host arena route, whose overflow rows requeue as always —
         the bounded, loudly-counted spill path."""
+        rec = self.flight.begin_step(engine=self.name)
+        self._sample_tenant_mix(rec, batch)
         if self.device_routing and self._device_route_fits(batch):
             self.device_route_steps += 1
             self._metrics.counter("route.device_steps").inc()
-            return (_PreparedStep("device", self._pack_flat_blob(batch)),
+            rec.begin_stage("route_device")
+            blob = self._pack_flat_blob(batch)
+            rec.end_stage("route_device")
+            self._stage_hist.observe(rec.stage_s("route_device"),
+                                     engine=self.name, stage="route_device")
+            return (_PreparedStep("device", blob, flight=rec),
                     np.empty(0, np.int64))
         if self.device_routing:
             self.device_route_fallbacks += 1
             self._metrics.counter("route.host_fallbacks").inc()
+        rec.begin_stage("route_host")
         routed_blob, over_rows = self.router.route_batch(batch)
-        return _PreparedStep("host", routed_blob), over_rows
+        rec.end_stage("route_host")
+        self._stage_hist.observe(rec.stage_s("route_host"),
+                                 engine=self.name, stage="route_host")
+        return _PreparedStep("host", routed_blob, flight=rec), over_rows
 
     def _device_route_fits(self, batch: EventBatch) -> bool:
         from sitewhere_tpu.ops.route import host_fits_device_route
@@ -730,22 +748,30 @@ class ShardedPipelineEngine(PipelineEngine):
         the sharded half of pipeline/feed.py's double-buffered contract.
         Returns a staged handle for dispatch_staged; a pooled blob's
         release is wired there (its H2D guard is the step's output)."""
+        rec = prepared.flight
         if prepared.kind == "device":
             # UNROUTED flat blob, split along the LANE axis: shard i's
             # chunk is flat lanes [i*B, (i+1)*B) — the routing prologue
             # inside the step exchanges rows to their owners
             flat = NamedSharding(self.mesh, P(None, SHARD_AXIS))
+            if rec is not None:
+                rec.begin_stage("h2d")
             blob = jax.device_put(prepared.blob, flat)
+            if rec is not None:
+                rec.end_stage("h2d")
             view = DeviceRoutedView(prepared.blob, self.router)
             return _StagedStep(blob, view, prepared.blob, prepared.blob,
-                               kind="device")
-        return self.stage_routed_blob(prepared.blob)
+                               kind="device", flight=rec)
+        return self.stage_routed_blob(prepared.blob, flight_rec=rec)
 
-    def stage_routed_blob(self, routed_blob: np.ndarray) -> "_StagedStep":
+    def stage_routed_blob(self, routed_blob: np.ndarray,
+                          flight_rec=None) -> "_StagedStep":
         """Start the host->mesh transfer of a HOST-routed [S, WIRE_ROWS,
         B] blob (see stage_prepared; this is the host-arena half, and the
         only one multi-process feeding uses)."""
         shard0 = NamedSharding(self.mesh, P(SHARD_AXIS))
+        if flight_rec is not None:
+            flight_rec.begin_stage("h2d")
         if self.is_multiprocess:
             # Per-host feeding (the multi-host jax data contract): this
             # process stages ONLY its local shards' rows; rows routed to
@@ -768,7 +794,10 @@ class ShardedPipelineEngine(PipelineEngine):
             # as the transfer-completion guard
             view = RoutedBlobView(routed_blob)
             counted = routed_blob
-        return _StagedStep(blob, view, counted, routed_blob)
+        if flight_rec is not None:
+            flight_rec.end_stage("h2d")
+        return _StagedStep(blob, view, counted, routed_blob,
+                           flight=flight_rec)
 
     def dispatch_staged(self, params, staged: "_StagedStep"
                         ) -> Tuple["RoutedBlobView", ProcessOutputs]:
@@ -779,10 +808,17 @@ class ShardedPipelineEngine(PipelineEngine):
         view = staged.view
         step = (self._sharded_step_device if staged.kind == "device"
                 else self._sharded_step)
-        with self._metrics.timer("step").time():
-            with self._state_lock:  # vs concurrent readers (base __init__)
-                self._state, self._rule_state, outputs = step(
-                    params, self._state, self._rule_state, staged.blob)
+        rec = staged.flight
+        if rec is None:
+            rec = self.flight.begin_step(engine=self.name)
+        rec.begin_stage("dispatch")
+        with self._state_lock:  # vs concurrent readers (base __init__)
+            self._state, self._rule_state, outputs = step(
+                params, self._state, self._rule_state, staged.blob)
+        rec.end_stage("dispatch")
+        self._flight_last = rec
+        self._stage_hist.observe(rec.stage_s("dispatch"),
+                                 engine=self.name, stage="dispatch")
         if not self.is_multiprocess and staged.routed_blob is not None:
             # pooled-blob loan (routed OR flat): returns on view GC;
             # outputs.processed is the transfer-completion guard (step
@@ -796,8 +832,10 @@ class ShardedPipelineEngine(PipelineEngine):
         # full column unpack is deferred until alert materialization
         # actually needs it (most steps don't), which was ~25% of sharded
         # submit host time.
-        self._metrics.meter("events").mark(int(
-            ((staged.counted[..., 0, :] >> _VALID_SHIFT) & 1).sum()))
+        n_events = int(
+            ((staged.counted[..., 0, :] >> _VALID_SHIFT) & 1).sum())
+        rec.events = n_events
+        self._metrics.meter("events").mark(n_events)
         return view, outputs
 
     def _stash_foreign(self, routed_blob: np.ndarray) -> None:
@@ -868,49 +906,69 @@ class ShardedPipelineEngine(PipelineEngine):
         shard_ids = None
         if isinstance(routed_batch, RoutedBlobView):
             shard_ids = routed_batch.shard_ids
+        rec = self._flight_last
+        if rec is not None:
+            rec.begin_stage("lane_fetch")
         if self.is_multiprocess:
             lanes = self._gather_local(outputs.alert_lanes)
         else:
             lanes = jax.device_get(outputs.alert_lanes)  # [S, ROWS, K]
+        if rec is not None:
+            rec.end_stage("lane_fetch")
+            self._stage_hist.observe(rec.stage_s("lane_fetch"),
+                                     engine=self.name, stage="lane_fetch")
         self.d2h_fetches += 1
         self.d2h_bytes += lanes.nbytes
-        decs = [decode_alert_lanes(lanes[s]) for s in range(lanes.shape[0])]
-        self._account_lane_overflow(sum(d.dropped_alerts for d in decs))
-        self._account_route_dropped(sum(d.route_dropped for d in decs))
-        if not any(d.n for d in decs):
-            return []
-        if isinstance(routed_batch, RoutedBlobView):
-            routed_batch = routed_batch.batch
-        dev = np.asarray(routed_batch.device_idx)        # [S_rows, B]
-        ts = np.asarray(routed_batch.ts)
-        S_rows, B = dev.shape
-        ids = (np.arange(S_rows, dtype=np.int32) if shard_ids is None
-               else np.array(shard_ids, np.int32))
-        # shard-major flat rows + the per-row GLOBAL device remap
-        # (local index l on shard s is global l * S + s)
-        rows_flat = np.concatenate(
-            [s * B + d.rows for s, d in enumerate(decs)])
-        shard_of = np.concatenate(
-            [np.full(d.n, ids[s], np.int32) for s, d in enumerate(decs)])
-        combined = DecodedAlertLanes(
-            rows=rows_flat,
-            thr_fired=np.concatenate([d.thr_fired for d in decs]),
-            geo_fired=np.concatenate([d.geo_fired for d in decs]),
-            thr_rule=np.concatenate([d.thr_rule for d in decs]),
-            geo_rule=np.concatenate([d.geo_rule for d in decs]),
-            thr_level=np.concatenate([d.thr_level for d in decs]),
-            geo_level=np.concatenate([d.geo_level for d in decs]),
-            fired_rows=sum(d.fired_rows for d in decs),
-            dropped_alerts=sum(d.dropped_alerts for d in decs),
-            total_alerts=sum(d.total_alerts for d in decs),
-            prog_fired=np.concatenate([d.prog_fired for d in decs]),
-            prog_rule=np.concatenate([d.prog_rule for d in decs]),
-            prog_level=np.concatenate([d.prog_level for d in decs]))
-        dev_rows = (dev.reshape(-1)[rows_flat] * self.n_shards + shard_of)
-        ts_rows = ts.reshape(-1)[rows_flat]
-        bounded = self._bound_alert_rows(combined, max_alerts)
-        n = bounded.n
-        return self._emit_alerts(bounded, dev_rows[:n], ts_rows[:n])
+        if rec is not None:
+            rec.begin_stage("materialize")
+        try:
+            decs = [decode_alert_lanes(lanes[s])
+                    for s in range(lanes.shape[0])]
+            self._account_lane_overflow(
+                sum(d.dropped_alerts for d in decs))
+            self._account_route_dropped(
+                sum(d.route_dropped for d in decs))
+            if not any(d.n for d in decs):
+                return []
+            if isinstance(routed_batch, RoutedBlobView):
+                routed_batch = routed_batch.batch
+            dev = np.asarray(routed_batch.device_idx)        # [S_rows, B]
+            ts = np.asarray(routed_batch.ts)
+            S_rows, B = dev.shape
+            ids = (np.arange(S_rows, dtype=np.int32) if shard_ids is None
+                   else np.array(shard_ids, np.int32))
+            # shard-major flat rows + the per-row GLOBAL device remap
+            # (local index l on shard s is global l * S + s)
+            rows_flat = np.concatenate(
+                [s * B + d.rows for s, d in enumerate(decs)])
+            shard_of = np.concatenate(
+                [np.full(d.n, ids[s], np.int32) for s, d in enumerate(decs)])
+            combined = DecodedAlertLanes(
+                rows=rows_flat,
+                thr_fired=np.concatenate([d.thr_fired for d in decs]),
+                geo_fired=np.concatenate([d.geo_fired for d in decs]),
+                thr_rule=np.concatenate([d.thr_rule for d in decs]),
+                geo_rule=np.concatenate([d.geo_rule for d in decs]),
+                thr_level=np.concatenate([d.thr_level for d in decs]),
+                geo_level=np.concatenate([d.geo_level for d in decs]),
+                fired_rows=sum(d.fired_rows for d in decs),
+                dropped_alerts=sum(d.dropped_alerts for d in decs),
+                total_alerts=sum(d.total_alerts for d in decs),
+                prog_fired=np.concatenate([d.prog_fired for d in decs]),
+                prog_rule=np.concatenate([d.prog_rule for d in decs]),
+                prog_level=np.concatenate([d.prog_level for d in decs]))
+            dev_rows = (dev.reshape(-1)[rows_flat] * self.n_shards
+                        + shard_of)
+            ts_rows = ts.reshape(-1)[rows_flat]
+            bounded = self._bound_alert_rows(combined, max_alerts)
+            n = bounded.n
+            return self._emit_alerts(bounded, dev_rows[:n], ts_rows[:n])
+        finally:
+            if rec is not None:
+                rec.end_stage("materialize")
+                self._stage_hist.observe(
+                    rec.stage_s("materialize"),
+                    engine=self.name, stage="materialize")
 
     def _account_route_dropped(self, dropped: int) -> None:
         """Defensive on-device route drop accounting (lane counts slot 3,

@@ -32,6 +32,7 @@ from sitewhere_tpu.pipeline.state_tensors import DeviceStateTensors, init_device
 from sitewhere_tpu.pipeline.step import PipelineParams, ProcessOutputs, check_presence, process_batch
 from sitewhere_tpu.registry.tensors import RegistryTensors
 from sitewhere_tpu.runtime.lifecycle import LifecycleComponent
+from sitewhere_tpu.runtime.flight import GLOBAL_FLIGHT
 from sitewhere_tpu.runtime.metrics import GLOBAL_METRICS
 
 _NEG = -(2 ** 31)
@@ -293,6 +294,23 @@ class PipelineEngine(LifecycleComponent):
         # block_until_ready, so hot-path cost is nanoseconds.
         self._state_lock = threading.RLock()
         self._metrics = GLOBAL_METRICS.scoped(f"pipeline.{name}")
+        # step flight recorder: one fixed-shape record per step with the
+        # stage timeline (runtime/flight.py); feeders pass records they
+        # opened on stager threads via submit_blob(flight_rec=...)
+        self.flight = GLOBAL_FLIGHT
+        self._flight_last = None
+        self._flight_step_n = 0
+        # Prometheus bucketed histogram for step-path stage durations
+        # (labels: engine, stage) — replaces the reservoir summaries the
+        # step path used to feed via timer("pack")/timer("step")
+        self._stage_hist = GLOBAL_METRICS.histogram(
+            "pipeline.step_stage_seconds")
+        # per-tenant event volume, sampled every Nth step (a full-batch
+        # tenant bincount per step would not hold the <1% overhead pin)
+        self._tenant_hist = GLOBAL_METRICS.histogram(
+            "pipeline.step_tenant_events",
+            buckets=(1.0, 8.0, 64.0, 512.0, 4096.0, 32768.0))
+        self._flight_sample_every = 16
         from sitewhere_tpu.ops.geofence import resolve_geofence_impl
         self.geofence_impl = resolve_geofence_impl(
             geofence_impl, self._target_platform())
@@ -763,7 +781,8 @@ class PipelineEngine(LifecycleComponent):
 
     # -- processing -----------------------------------------------------------
 
-    def _staging_blob_buffer(self, batch: EventBatch) -> Optional[np.ndarray]:
+    def _staging_blob_buffer(self, batch: EventBatch,
+                             flight_rec=None) -> Optional[np.ndarray]:
         """Rotating reusable [WIRE_ROWS, B] staging buffer for full-size flat
         batches (ring of 6: blob contents stay stable through dispatch +
         async H2D even with pipelined staging depth 3 and two stager
@@ -797,10 +816,14 @@ class PipelineEngine(LifecycleComponent):
             # the guard (consuming step's output, or the transferred
             # array itself) is ready no earlier than the transfer. By the
             # time a 6-slot ring cycles back this is almost always ready.
+            if flight_rec is not None:
+                flight_rec.begin_stage("guard")
             try:
                 guard.block_until_ready()
             except Exception:
                 pass  # a failed step still implies the transfer finished
+            if flight_rec is not None:
+                flight_rec.end_stage("guard")
         return buf
 
     def _note_blob_guard(self, buf, guard) -> None:
@@ -820,30 +843,71 @@ class PipelineEngine(LifecycleComponent):
     def submit(self, batch: EventBatch) -> ProcessOutputs:
         """Run one fused step; state advances in place (donated)."""
         # single-transfer host->device staging (see ops.pack.batch_to_blob).
-        # timer("pack") keeps host staging visible now that timer("step")
-        # covers only the dispatch (pack used to be inside it).
-        with self._metrics.timer("pack").time():
-            blob = batch_to_blob(batch, out=self._staging_blob_buffer(batch))
+        # The flight record's "pack" segment keeps host staging visible
+        # now that "dispatch" covers only the jit call (pack used to be
+        # inside it); the staging-ring guard wait is marked separately.
+        rec = self.flight.begin_step(engine=self.name)
+        # buffer acquisition first: its ring-guard wait is the "guard"
+        # segment and must not nest inside (double-count with) "pack"
+        out_buf = self._staging_blob_buffer(batch, flight_rec=rec)
+        rec.begin_stage("pack")
+        blob = batch_to_blob(batch, out=out_buf)
+        rec.end_stage("pack")
+        self._stage_hist.observe(rec.stage_s("pack"),
+                                 engine=self.name, stage="pack")
+        self._sample_tenant_mix(rec, batch)
         return self.submit_blob(
-            blob, n_events=int(np.asarray(batch.valid).sum()))
+            blob, n_events=int(np.asarray(batch.valid).sum()),
+            flight_rec=rec)
 
-    def submit_blob(self, blob, n_events: Optional[int] = None
-                    ) -> ProcessOutputs:
+    def _sample_tenant_mix(self, rec, batch: EventBatch) -> None:
+        """Every Nth step, attach the batch's tenant mix (host bincount
+        over the registry's tenant mirror — never a device fetch) to the
+        flight record and the per-tenant event histogram."""
+        self._flight_step_n += 1
+        if self._flight_step_n % self._flight_sample_every:
+            return
+        try:
+            dev = np.asarray(batch.device_idx).ravel()
+            valid = np.asarray(batch.valid).ravel().astype(bool)
+            tenants = self.registry._tenant_idx[dev[valid]]
+            mix = np.bincount(tenants, minlength=1)
+        except Exception:
+            return
+        rec.tenant_mix = tuple(int(x) for x in mix[:self.max_tenants])
+        for tenant, count in enumerate(rec.tenant_mix):
+            if count:
+                self._tenant_hist.observe(
+                    float(count), engine=self.name, tenant=str(tenant))
+
+    def submit_blob(self, blob, n_events: Optional[int] = None,
+                    flight_rec=None) -> ProcessOutputs:
         """Run one fused step on an already-packed wire blob (numpy or
         device-resident). The pipelined feeder (pipeline/feed.py) stages
         blobs — pack + async device_put — on worker threads so host staging
         of batch N+1 overlaps device compute of step N. `n_events` feeds
         the events meter (counting valid bits of a device-resident blob
-        here would force a D2H sync on the hot path)."""
+        here would force a D2H sync on the hot path). `flight_rec` is a
+        flight record opened by the caller (submit(), or a feeder's
+        stager thread — the explicit cross-thread handoff); when None
+        this opens a dispatch-only record."""
         if self._state is None:  # lazy init for direct (un-started) use
             self.initialize()  # full lifecycle init so a later start() won't re-init
         if self._rule_state is None:  # set_state() without lifecycle init
             self._rule_state = self._init_rule_state()
         params = self._ensure_params()
-        with self._metrics.timer("step").time():
-            with self._state_lock:
-                self._state, self._rule_state, outputs = self._step_blob(
-                    params, self._state, self._rule_state, blob)
+        rec = flight_rec if flight_rec is not None else (
+            self.flight.begin_step(engine=self.name))
+        rec.begin_stage("dispatch")
+        with self._state_lock:
+            self._state, self._rule_state, outputs = self._step_blob(
+                params, self._state, self._rule_state, blob)
+        rec.end_stage("dispatch")
+        if n_events is not None:
+            rec.events = int(n_events)
+        self._flight_last = rec
+        self._stage_hist.observe(rec.stage_s("dispatch"),
+                                 engine=self.name, stage="dispatch")
         if isinstance(blob, np.ndarray):
             # ring-slot transfer guard: the implicit jit transfer of a
             # numpy blob completes no later than the step's outputs
@@ -886,18 +950,35 @@ class PipelineEngine(LifecycleComponent):
         from sitewhere_tpu.ops.compact import decode_alert_lanes
 
         pending, self._pending_alerts = self._pending_alerts, []
+        # amend the last-dispatched flight record: the fetch/materialize
+        # segments belong to the step whose outputs these are
+        rec = self._flight_last
+        if rec is not None:
+            rec.begin_stage("lane_fetch")
         lanes = jax.device_get(outputs.alert_lanes)  # THE one fetch
-        self.d2h_fetches += 1
-        self.d2h_bytes += lanes.nbytes
-        dec = decode_alert_lanes(lanes)
-        self._account_lane_overflow(dec.dropped_alerts)
-        dec = self._bound_alert_rows(dec, max_alerts)
-        if dec.n == 0:
-            return pending
-        rows = dec.rows
-        dev_rows = np.asarray(batch.device_idx)[rows]
-        ts_rows = np.asarray(batch.ts)[rows]
-        return pending + self._emit_alerts(dec, dev_rows, ts_rows)
+        if rec is not None:
+            rec.end_stage("lane_fetch")
+            rec.begin_stage("materialize")
+            self._stage_hist.observe(rec.stage_s("lane_fetch"),
+                                     engine=self.name, stage="lane_fetch")
+        try:
+            self.d2h_fetches += 1
+            self.d2h_bytes += lanes.nbytes
+            dec = decode_alert_lanes(lanes)
+            self._account_lane_overflow(dec.dropped_alerts)
+            dec = self._bound_alert_rows(dec, max_alerts)
+            if dec.n == 0:
+                return pending
+            rows = dec.rows
+            dev_rows = np.asarray(batch.device_idx)[rows]
+            ts_rows = np.asarray(batch.ts)[rows]
+            return pending + self._emit_alerts(dec, dev_rows, ts_rows)
+        finally:
+            if rec is not None:
+                rec.end_stage("materialize")
+                self._stage_hist.observe(
+                    rec.stage_s("materialize"),
+                    engine=self.name, stage="materialize")
 
     def _account_lane_overflow(self, dropped: int) -> None:
         if not dropped:

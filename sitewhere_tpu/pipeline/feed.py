@@ -150,18 +150,29 @@ class PipelinedSubmitter:
                 fut._resolve(error=RuntimeError("submitter closed"))
                 continue
             try:
-                blob = batch_to_blob(
-                    batch, out=self.engine._staging_blob_buffer(batch))
+                # flight record opened HERE on the stager thread and
+                # handed to the step thread inside the heap item — the
+                # explicit trace-context handoff that thread-local span
+                # stacks cannot express. pack/guard/h2d land on this
+                # thread; dispatch lands on the step thread; both sides
+                # share one monotonic clock so overlap is computable.
+                rec = self.engine.flight.begin_step(engine=self.engine.name)
+                buf = self.engine._staging_blob_buffer(batch, flight_rec=rec)
+                rec.begin_stage("pack")
+                blob = batch_to_blob(batch, out=buf)
+                rec.end_stage("pack")
                 n = int(np.asarray(batch.valid).sum())
                 # start the H2D transfer now; on async runtimes this
                 # overlaps both other stagers' packs and device compute
+                rec.begin_stage("h2d")
                 dev_blob = jax.device_put(blob)
+                rec.end_stage("h2d")
                 # ring-slot guard: the transferred array itself becomes
                 # ready exactly when the DMA stops reading `blob`
                 self.engine._note_blob_guard(blob, dev_blob)
-                item = (seq, dev_blob, n, fut, None)
+                item = (seq, dev_blob, n, fut, rec, None)
             except BaseException as exc:  # surface through the future
-                item = (seq, None, 0, fut, exc)
+                item = (seq, None, 0, fut, None, exc)
             with self._ready_lock:
                 heapq.heappush(self._ready, item)
                 self._ready_lock.notify_all()
@@ -178,12 +189,13 @@ class PipelinedSubmitter:
                     if self._stop.is_set():
                         return
                     self._ready_lock.wait(timeout=0.1)
-                seq, dev_blob, n, fut, exc = heapq.heappop(self._ready)
+                seq, dev_blob, n, fut, rec, exc = heapq.heappop(self._ready)
                 self._next_step += 1
             outputs = None
             try:
                 if exc is None:
-                    outputs = self.engine.submit_blob(dev_blob, n_events=n)
+                    outputs = self.engine.submit_blob(
+                        dev_blob, n_events=n, flight_rec=rec)
             except BaseException as step_exc:
                 exc = step_exc
             finally:

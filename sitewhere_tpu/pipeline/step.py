@@ -126,56 +126,61 @@ def process_batch(params: PipelineParams, state: DeviceStateTensors,
     # Unknown tokens intern to index 0 whose registry row always holds
     # status 0, so a single status gather covers both "unknown device" and
     # "no active assignment" (local index 0 is a real device on shards > 0).
-    status = params.assignment_status[batch.device_idx]          # gather [B]
-    registered = status == 1  # DeviceAssignmentStatus.ACTIVE
-    unregistered = batch.valid & ~registered
-    valid = batch.valid & registered
-    tenant = params.tenant_idx[batch.device_idx]
-    device_type = params.device_type_idx[batch.device_idx]
-    batch = batch.replace(tenant_idx=tenant, valid=valid)
+    # named_scope labels carry the flight recorder's stage vocabulary into
+    # device profiler traces (trace-time only, no runtime cost).
+    with jax.named_scope("step_validate"):
+        status = params.assignment_status[batch.device_idx]      # gather [B]
+        registered = status == 1  # DeviceAssignmentStatus.ACTIVE
+        unregistered = batch.valid & ~registered
+        valid = batch.valid & registered
+        tenant = params.tenant_idx[batch.device_idx]
+        device_type = params.device_type_idx[batch.device_idx]
+        batch = batch.replace(tenant_idx=tenant, valid=valid)
 
     # ---- stage 2: rule evaluation (replaces rule-processing service) -------
-    thr = eval_threshold_rules(batch, params.threshold, device_type)
-    geo = eval_geofence_rules(batch, params.zones, params.geofence,
-                              impl=geofence_impl)
+    with jax.named_scope("step_rules"):
+        thr = eval_threshold_rules(batch, params.threshold, device_type)
+        geo = eval_geofence_rules(batch, params.zones, params.geofence,
+                                  impl=geofence_impl)
 
     # ---- stage 3: device-state fold (replaces device-state service) --------
-    dev = batch.device_idx
-    ts = batch.ts
-    last_interaction = scatter_max_by_key(dev, ts, valid, D,
-                                          state.last_interaction)
-    event_count = state.event_count + count_by_key(dev, valid, D)
+    with jax.named_scope("step_state_fold"):
+        dev = batch.device_idx
+        ts = batch.ts
+        last_interaction = scatter_max_by_key(dev, ts, valid, D,
+                                              state.last_interaction)
+        event_count = state.event_count + count_by_key(dev, valid, D)
 
-    # presence restore: any device with a valid event is present again
-    touched = count_by_key(dev, valid, D) > 0
-    present = state.present | touched
-    presence_missing_since = jnp.where(touched, _NEG,
-                                       state.presence_missing_since)
+        # presence restore: any device with a valid event is present again
+        touched = count_by_key(dev, valid, D) > 0
+        present = state.present | touched
+        presence_missing_since = jnp.where(touched, _NEG,
+                                           state.presence_missing_since)
 
-    # last location (location events only)
-    is_loc = valid & (batch.event_type == DeviceEventType.LOCATION)
-    loc_vals = jnp.stack([batch.lat, batch.lon, batch.elevation], axis=1)
-    loc_ts, (last_location,) = last_by_key(
-        dev, ts, is_loc, D, state.last_location_ts, (state.last_location,),
-        (loc_vals,))
+        # last location (location events only)
+        is_loc = valid & (batch.event_type == DeviceEventType.LOCATION)
+        loc_vals = jnp.stack([batch.lat, batch.lon, batch.elevation], axis=1)
+        loc_ts, (last_location,) = last_by_key(
+            dev, ts, is_loc, D, state.last_location_ts,
+            (state.last_location,), (loc_vals,))
 
-    # last measurement per (device, slot<M)
-    is_mm = (valid & (batch.event_type == DeviceEventType.MEASUREMENT)
-             & (batch.mm_idx < M))
-    mm_key = dev * M + batch.mm_idx
-    mm_ts_flat, (mm_val_flat,) = last_by_key(
-        mm_key, ts, is_mm, D * M, state.last_measurement_ts.reshape(-1),
-        (state.last_measurement.reshape(-1),), (batch.value,))
-    last_measurement_ts = mm_ts_flat.reshape(D, M)
-    last_measurement = mm_val_flat.reshape(D, M)
+        # last measurement per (device, slot<M)
+        is_mm = (valid & (batch.event_type == DeviceEventType.MEASUREMENT)
+                 & (batch.mm_idx < M))
+        mm_key = dev * M + batch.mm_idx
+        mm_ts_flat, (mm_val_flat,) = last_by_key(
+            mm_key, ts, is_mm, D * M, state.last_measurement_ts.reshape(-1),
+            (state.last_measurement.reshape(-1),), (batch.value,))
+        last_measurement_ts = mm_ts_flat.reshape(D, M)
+        last_measurement = mm_val_flat.reshape(D, M)
 
-    # last alert per device (device-sent alerts; rule-fired alerts merge on
-    # the next batch once materialized as events)
-    is_alert = valid & (batch.event_type == DeviceEventType.ALERT)
-    alert_ts, (last_alert_type, last_alert_level) = last_by_key(
-        dev, ts, is_alert, D, state.last_alert_ts,
-        (state.last_alert_type, state.last_alert_level),
-        (batch.alert_type_idx, batch.alert_level))
+        # last alert per device (device-sent alerts; rule-fired alerts
+        # merge on the next batch once materialized as events)
+        is_alert = valid & (batch.event_type == DeviceEventType.ALERT)
+        alert_ts, (last_alert_type, last_alert_level) = last_by_key(
+            dev, ts, is_alert, D, state.last_alert_ts,
+            (state.last_alert_type, state.last_alert_level),
+            (batch.alert_type_idx, batch.alert_level))
 
     # ---- stage 3b: stateful rule programs (CEP-lite; ops/stateful.py) ------
     # Runs BETWEEN the built-in rules and the stats so composite fires
@@ -185,31 +190,33 @@ def process_batch(params: PipelineParams, state: DeviceStateTensors,
     # are installed.
     B = batch.device_idx.shape[0]
     if programs_enabled:
-        obs_mm, _touched, now_d, attach_row = observations_of_batch(
-            batch, M, D)
-        # per-ROW evaluation: state gathers/scatters ride the batch's
-        # device rows (attach rows are the unique writers), so program
-        # evaluation costs O(batch), not O(device capacity)
-        rule_state, prog = eval_rule_programs(
-            params.programs, rule_state,
-            dev=dev, attach=attach_row,
-            obs_row=obs_mm[dev], now_row=now_d[dev],
-            lm_row=last_measurement[dev],
-            lmts_row=last_measurement_ts[dev],
-            tenant_row=params.tenant_idx[dev],
-            dtype_row=params.device_type_idx[dev],
-            node_limit=program_node_limit)
+        with jax.named_scope("step_rule_programs"):
+            obs_mm, _touched, now_d, attach_row = observations_of_batch(
+                batch, M, D)
+            # per-ROW evaluation: state gathers/scatters ride the batch's
+            # device rows (attach rows are the unique writers), so program
+            # evaluation costs O(batch), not O(device capacity)
+            rule_state, prog = eval_rule_programs(
+                params.programs, rule_state,
+                dev=dev, attach=attach_row,
+                obs_row=obs_mm[dev], now_row=now_d[dev],
+                lm_row=last_measurement[dev],
+                lmts_row=last_measurement_ts[dev],
+                tenant_row=params.tenant_idx[dev],
+                dtype_row=params.device_type_idx[dev],
+                node_limit=program_node_limit)
     else:
         prog = {"fired": jnp.zeros((B,), bool),
                 "first_rule": jnp.full((B,), -1, jnp.int32),
                 "alert_level": jnp.full((B,), -1, jnp.int32)}
 
     # ---- stage 4: stats (replaces Dropwizard meters / Kafka state topics) --
-    tenant_counts = count_by_key(tenant, valid, T)
-    alerts = (jnp.sum(thr["fired"], dtype=jnp.int32)
-              + jnp.sum(geo["fired"], dtype=jnp.int32)
-              + jnp.sum(prog["fired"], dtype=jnp.int32))
-    alert_lanes = compact_alert_lanes(thr, geo, alert_lane_capacity, prog)
+    with jax.named_scope("step_stats_compact"):
+        tenant_counts = count_by_key(tenant, valid, T)
+        alerts = (jnp.sum(thr["fired"], dtype=jnp.int32)
+                  + jnp.sum(geo["fired"], dtype=jnp.int32)
+                  + jnp.sum(prog["fired"], dtype=jnp.int32))
+        alert_lanes = compact_alert_lanes(thr, geo, alert_lane_capacity, prog)
 
     new_state = DeviceStateTensors(
         last_interaction=last_interaction,

@@ -103,15 +103,93 @@ class Timer:
             return ordered[k]
 
     def snapshot(self) -> Dict[str, float]:
+        # One lock acquisition, one sorted copy — percentile() used to be
+        # called per quantile, re-locking and re-sorting the reservoir
+        # three times per snapshot.
         with self._lock:
             count, total = self.count, self.total
+            ordered = sorted(self._samples)
+
+        def pct(q: float) -> float:
+            if not ordered:
+                return 0.0
+            k = min(len(ordered) - 1,
+                    max(0, int(round(q * (len(ordered) - 1)))))
+            return ordered[k]
+
         return {
             "count": count,
+            "total_s": total,
             "mean_s": (total / count) if count else 0.0,
-            "p50_s": self.percentile(0.50),
-            "p95_s": self.percentile(0.95),
-            "p99_s": self.percentile(0.99),
+            "p50_s": pct(0.50),
+            "p95_s": pct(0.95),
+            "p99_s": pct(0.99),
         }
+
+
+# Default buckets for step-path latencies (seconds): sub-ms device steps
+# through multi-second stalls, roughly logarithmic.
+DEFAULT_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                   0.1, 0.25, 0.5, 1.0, 2.5)
+
+
+class Histogram:
+    """Prometheus-style bucketed histogram with optional labels.
+
+    Unlike :class:`Timer`'s sliding reservoir (whose p50/p95/p99 are
+    scrape-time approximations that cannot be aggregated across
+    instances), cumulative buckets survive aggregation and let the
+    scraper compute any quantile.  Labels (e.g. ``stage=``, ``tenant=``)
+    key independent child series: each distinct label set carries its
+    own bucket counts, ``_sum`` and ``_count``."""
+
+    class _Child:
+        __slots__ = ("counts", "total", "count")
+
+        def __init__(self, n_buckets: int) -> None:
+            self.counts = [0] * n_buckets  # cumulative at export, raw here
+            self.total = 0.0
+            self.count = 0
+
+    def __init__(self, buckets: Optional[tuple] = None) -> None:
+        self.buckets = tuple(buckets if buckets is not None
+                             else DEFAULT_BUCKETS)
+        self._children: Dict[tuple, "Histogram._Child"] = {}
+        self._lock = threading.Lock()
+
+    def child(self, **labels: str) -> "Histogram._Child":
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            ch = self._children.get(key)
+            if ch is None:
+                ch = Histogram._Child(len(self.buckets))
+                self._children[key] = ch
+            return ch
+
+    def observe(self, seconds: float, **labels: str) -> None:
+        ch = self.child(**labels)
+        with self._lock:
+            ch.total += seconds
+            ch.count += 1
+            # raw per-bucket counts; cumulated at export so observe is
+            # a single increment
+            for i, ub in enumerate(self.buckets):
+                if seconds <= ub:
+                    ch.counts[i] += 1
+                    break
+
+    def snapshot(self) -> Dict:
+        with self._lock:
+            out = {}
+            for key, ch in self._children.items():
+                cum = []
+                running = 0
+                for c in ch.counts:
+                    running += c
+                    cum.append(running)
+                out[key] = {"buckets": cum, "sum_s": ch.total,
+                            "count": ch.count}
+            return out
 
 
 class MetricsRegistry:
@@ -122,6 +200,7 @@ class MetricsRegistry:
         self._counters: Dict[str, Counter] = {}
         self._meters: Dict[str, Meter] = {}
         self._timers: Dict[str, Timer] = {}
+        self._histograms: Dict[str, Histogram] = {}
         self._lock = threading.Lock()
 
     def counter(self, name: str) -> Counter:
@@ -136,6 +215,15 @@ class MetricsRegistry:
         with self._lock:
             return self._timers.setdefault(name, Timer())
 
+    def histogram(self, name: str,
+                  buckets: Optional[tuple] = None) -> Histogram:
+        with self._lock:
+            hist = self._histograms.get(name)
+            if hist is None:
+                hist = Histogram(buckets)
+                self._histograms[name] = hist
+            return hist
+
     def scoped(self, prefix: str) -> "ScopedMetrics":
         return ScopedMetrics(self, prefix)
 
@@ -145,11 +233,16 @@ class MetricsRegistry:
             counters = dict(self._counters)
             meters = dict(self._meters)
             timers = dict(self._timers)
+            histograms = dict(self._histograms)
         return {
             "counters": {k: v.value for k, v in counters.items()},
             "meters": {k: {"count": v.count, "m1_rate": v.one_minute_rate}
                        for k, v in meters.items()},
             "timers": {k: v.snapshot() for k, v in timers.items()},
+            "histograms": {
+                k: {"&".join(f"{lk}={lv}" for lk, lv in key) or "_": snap
+                    for key, snap in v.snapshot().items()}
+                for k, v in histograms.items()},
         }
 
     def prometheus_text(self, extra_gauges: Optional[Dict[str, float]] = None
@@ -164,6 +257,7 @@ class MetricsRegistry:
             counters = dict(self._counters)
             meters = dict(self._meters)
             timers = dict(self._timers)
+            histograms = dict(self._histograms)
         lines: List[str] = []
 
         def emit(name: str, kind: str, value, labels: str = "") -> None:
@@ -189,8 +283,29 @@ class MetricsRegistry:
                     f'{base}{{quantile="0.{quantile[1:]}"}} '
                     f'{snap[f"{quantile}_s"]:.9f}')
             lines.append(f"{base}_count {snap['count']}")
-            lines.append(
-                f"{base}_sum {snap['mean_s'] * snap['count']:.9f}")
+            # true accumulated total, not the lossy mean*count round-trip
+            lines.append(f"{base}_sum {snap['total_s']:.9f}")
+        for key in sorted(histograms):
+            hist = histograms[key]
+            # histograms carry their unit in the registry name
+            # (step_stage_seconds, step_tenant_events) — no blanket
+            # _seconds suffix like the duration-only timers get
+            base = f"swtpu_{_prom_name(key)}"
+            lines.append(f"# TYPE {base} histogram")
+            for labelkey, snap in sorted(hist.snapshot().items()):
+                label_pairs = [
+                    f'{_prom_name(lk)}="{lv}"' for lk, lv in labelkey]
+                prefix = ",".join(label_pairs)
+                sep = "," if prefix else ""
+                for ub, cum in zip(hist.buckets, snap["buckets"]):
+                    lines.append(
+                        f'{base}_bucket{{{prefix}{sep}le="{ub:g}"}} {cum}')
+                lines.append(
+                    f'{base}_bucket{{{prefix}{sep}le="+Inf"}} '
+                    f'{snap["count"]}')
+                lbl = f"{{{prefix}}}" if prefix else ""
+                lines.append(f'{base}_sum{lbl} {snap["sum_s"]:.9f}')
+                lines.append(f'{base}_count{lbl} {snap["count"]}')
         for key in sorted(extra_gauges or {}):
             emit(f"swtpu_{_prom_name(key)}", "gauge", extra_gauges[key])
         return "\n".join(lines) + "\n"
@@ -209,6 +324,10 @@ class ScopedMetrics:
 
     def timer(self, name: str) -> Timer:
         return self._registry.timer(f"{self._prefix}.{name}")
+
+    def histogram(self, name: str,
+                  buckets: Optional[tuple] = None) -> Histogram:
+        return self._registry.histogram(f"{self._prefix}.{name}", buckets)
 
 
 GLOBAL_METRICS = MetricsRegistry()

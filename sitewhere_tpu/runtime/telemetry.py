@@ -72,11 +72,22 @@ class UsageTelemetry:
         self._thread = None
 
     def _run(self) -> None:
+        # Explicit next-heartbeat deadline: `get(timeout=interval_s)`
+        # alone restarts the countdown on every enqueued event, so a
+        # steady event stream silences the uptime heartbeat entirely.
+        deadline = time.monotonic() + self.interval_s
         while True:
+            wait = deadline - time.monotonic()
+            if wait <= 0.0:
+                self._send("uptime")
+                deadline = time.monotonic() + self.interval_s
+                continue
             try:
-                item = self._queue.get(timeout=self.interval_s)
+                item = self._queue.get(timeout=wait)
             except queue.Empty:
-                item = "uptime"  # heartbeat cadence = queue idle time
+                self._send("uptime")
+                deadline = time.monotonic() + self.interval_s
+                continue
             if item is self._STOP:
                 return
             self._send(item)

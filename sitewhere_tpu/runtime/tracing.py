@@ -5,17 +5,54 @@ Reference: OpenTracing + Jaeger spans around lifecycle ops and gRPC calls
 TracerUtils.java:17-37). Here: in-proc span tree with a ring-buffer exporter
 that the REST API can dump; `jax.profiler` traces cover the on-device side
 (pipeline exposes start_device_trace/stop_device_trace).
+
+Cross-thread parentage: the active-span stack is thread-local, so a span
+opened on a feeder thread cannot see its logical parent on the submit
+thread.  `TraceContext` carries (trace_id, span_id) explicitly across the
+hop — `Tracer.span(..., parent=ctx)` overrides the stack lookup, and
+`extract_traceparent`/`inject_traceparent` map the same context to the
+W3C `traceparent` header for REST ingress/egress.
 """
 
 from __future__ import annotations
 
 import contextlib
+import re
 import threading
 import time
 import uuid
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Deque, Dict, List, Optional
+
+
+@dataclass(frozen=True)
+class TraceContext:
+    """Explicit parent handoff across thread hops and the wire."""
+    trace_id: str
+    span_id: str
+
+
+_TRACEPARENT_RE = re.compile(
+    r"^00-([0-9a-f]{32})-([0-9a-f]{16})-[0-9a-f]{2}$")
+
+
+def extract_traceparent(header: Optional[str]) -> Optional[TraceContext]:
+    """W3C `traceparent` header -> TraceContext (None if absent/invalid)."""
+    if not header:
+        return None
+    m = _TRACEPARENT_RE.match(header.strip().lower())
+    if not m:
+        return None
+    trace_id, span_id = m.group(1), m.group(2)
+    if set(trace_id) == {"0"} or set(span_id) == {"0"}:
+        return None
+    return TraceContext(trace_id=trace_id, span_id=span_id)
+
+
+def inject_traceparent(span: "Span") -> str:
+    """Span -> W3C `traceparent` header value (ids zero-padded)."""
+    return f"00-{span.trace_id:0>32}-{span.span_id:0>16}-01"
 
 
 @dataclass
@@ -31,7 +68,16 @@ class Span:
 
     @property
     def duration_ms(self) -> float:
-        return ((self.end_ms or time.time() * 1000) - self.start_ms)
+        # snapshot the end once: `end_ms or time.time()` re-read the
+        # clock on every evaluation for unfinished spans, and the falsy
+        # `or` treated end_ms == 0.0 as unfinished
+        end = self.end_ms
+        if end is None:
+            end = time.time() * 1000
+        return end - self.start_ms
+
+    def context(self) -> TraceContext:
+        return TraceContext(trace_id=self.trace_id, span_id=self.span_id)
 
     def to_dict(self) -> Dict:
         return {
@@ -49,6 +95,8 @@ class Tracer:
         self._finished: Deque[Span] = deque(maxlen=capacity)
         self._local = threading.local()
         self._lock = threading.Lock()
+        self.error_count = 0
+        self.finished_count = 0
 
     def _stack(self) -> List[Span]:
         if not hasattr(self._local, "stack"):
@@ -56,21 +104,29 @@ class Tracer:
         return self._local.stack
 
     @contextlib.contextmanager
-    def span(self, operation: str, **tags: str):
+    def span(self, operation: str,
+             parent: Optional[TraceContext] = None, **tags: str):
         stack = self._stack()
-        parent = stack[-1] if stack else None
+        if parent is None:
+            active = stack[-1] if stack else None
+            if active is not None:
+                parent = active.context()
         span = Span(
             trace_id=parent.trace_id if parent else uuid.uuid4().hex[:16],
             span_id=uuid.uuid4().hex[:16],
             parent_id=parent.span_id if parent else None,
             operation=operation,
             start_ms=time.time() * 1000,
-            tags={k: str(v) for k, v in tags.items()},
+            # defensive copy: tag values are stringified here so later
+            # mutation of caller-held objects can't rewrite history
+            tags={str(k): str(v) for k, v in tags.items()},
         )
         stack.append(span)
+        errored = False
         try:
             yield span
         except BaseException as exc:
+            errored = True
             span.tags["error"] = "true"
             span.logs.append(repr(exc))
             raise
@@ -79,15 +135,33 @@ class Tracer:
             stack.pop()
             with self._lock:
                 self._finished.append(span)
+                self.finished_count += 1
+                if errored or span.tags.get("error") == "true":
+                    self.error_count += 1
+                    errored = True
+            if errored:
+                # error spans surface in the metrics registry so the
+                # scrape path sees them without dumping the span buffer
+                from .metrics import GLOBAL_METRICS
+                GLOBAL_METRICS.counter("tracing.span_errors").inc()
 
     def active(self) -> Optional[Span]:
         stack = self._stack()
         return stack[-1] if stack else None
 
+    def active_context(self) -> Optional[TraceContext]:
+        span = self.active()
+        return span.context() if span is not None else None
+
     def finished(self, limit: int = 100) -> List[Dict]:
         with self._lock:
             spans = list(self._finished)[-limit:]
         return [s.to_dict() for s in spans]
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {"finished": self.finished_count,
+                    "errors": self.error_count}
 
 
 GLOBAL_TRACER = Tracer()

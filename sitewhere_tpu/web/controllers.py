@@ -115,6 +115,16 @@ def register_all(router: Router, instance, server) -> None:
     def get_metrics(request: Request):
         return instance.metrics.report()
 
+    def get_flight(request: Request):
+        """GET /api/instance/flight — last-N step flight records (stage
+        segment timelines on one monotonic clock) + window rollups
+        (per-stage occupancy, sum-vs-max sync decomposition,
+        h2d_overlap_fraction, critical-stage counts). See
+        docs/OBSERVABILITY.md for the schema."""
+        from sitewhere_tpu.runtime.flight import GLOBAL_FLIGHT
+        last_n = request.query_int("last", 64)
+        return GLOBAL_FLIGHT.export(last_n=max(1, min(last_n, 256)))
+
     def get_logs(request: Request):
         return {"records": instance.log_aggregator.recent(
             limit=request.query_int("limit", 200),
@@ -174,6 +184,8 @@ def register_all(router: Router, instance, server) -> None:
     router.get("/api/instance/topology", get_topology,
                authority=SiteWhereRoles.VIEW_SERVER_INFO)
     router.get("/api/instance/metrics", get_metrics,
+               authority=SiteWhereRoles.VIEW_SERVER_INFO)
+    router.get("/api/instance/flight", get_flight,
                authority=SiteWhereRoles.VIEW_SERVER_INFO)
     router.get("/api/instance/logs", get_logs,
                authority=SiteWhereRoles.VIEW_SERVER_INFO)

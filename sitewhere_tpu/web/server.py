@@ -156,7 +156,20 @@ class RestServer(LifecycleComponent):
                 tenant=handler.headers.get(
                     "X-SiteWhere-Tenant",
                     handler.headers.get("X-SiteWhere-Tenant-Id")))
-            result = self.router.dispatch(request)
+            # W3C trace-context ingress: an incoming `traceparent` header
+            # parents the dispatch span (and, via the tracer's
+            # thread-local stack, every span the handler opens on this
+            # thread); the response echoes the server span's context so
+            # callers can stitch their traces to ours.
+            from sitewhere_tpu.runtime.tracing import (
+                GLOBAL_TRACER, extract_traceparent, inject_traceparent)
+            parent_ctx = extract_traceparent(
+                handler.headers.get("traceparent"))
+            with GLOBAL_TRACER.span(
+                    f"rest.{handler.command.lower()}",
+                    parent=parent_ctx, path=parsed.path) as span:
+                handler._sw_traceparent = inject_traceparent(span)
+                result = self.router.dispatch(request)
             if isinstance(result, SseStream):
                 self._stream_sse(handler, result)
                 return
@@ -216,6 +229,9 @@ class RestServer(LifecycleComponent):
             handler.send_response(status)
             handler.send_header("Content-Type", ctype)
             handler.send_header("Content-Length", str(len(data)))
+            traceparent = getattr(handler, "_sw_traceparent", None)
+            if traceparent:
+                handler.send_header("traceparent", traceparent)
             handler.end_headers()
             handler.wfile.write(data)
         except (BrokenPipeError, ConnectionResetError):

@@ -1,0 +1,71 @@
+"""Metric-name lint: the code and the docs cannot drift.
+
+Every metric name literal registered anywhere in ``sitewhere_tpu/``
+(counter/meter/timer/histogram calls, plus the extra gauges injected by
+the ``GET /metrics`` controller) must
+
+1. appear in the metric inventory of ``docs/OBSERVABILITY.md``, and
+2. sanitize (via ``_prom_name``) to a prometheus-legal metric name.
+"""
+
+import pathlib
+import re
+
+from sitewhere_tpu.runtime.metrics import _prom_name
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+PKG = REPO / "sitewhere_tpu"
+DOCS = REPO / "docs" / "OBSERVABILITY.md"
+
+# .counter("name") / .meter( "name" — tolerates a line break before the
+# literal; f-strings and computed names (containing "{") are skipped,
+# their *prefix* conventions are documented prose-side instead.
+_REG_CALL = re.compile(
+    r"\.(counter|meter|timer|histogram)\(\s*\"([^\"{]+)\"", re.S)
+# extra_gauges keys in web/controllers.py: extra["k"] = / "k": value
+_EXTRA_ITEM = re.compile(r"\"((?:cluster|pipeline)\.[a-z_.0-9]+)\"\s*[:\]]")
+
+_PROM_LEGAL = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+
+
+def _collect_names():
+    names = set()
+    for path in sorted(PKG.rglob("*.py")):
+        text = path.read_text()
+        for _, name in _REG_CALL.findall(text):
+            names.add(name)
+    controllers = (PKG / "web" / "controllers.py").read_text()
+    names.update(_EXTRA_ITEM.findall(controllers))
+    return names
+
+
+def test_found_a_plausible_inventory():
+    names = _collect_names()
+    # the lint is only meaningful if the scan actually sees the code
+    assert len(names) > 25, sorted(names)
+    assert "pipeline.step_stage_seconds" in names
+    assert "events" in names
+    assert "cluster.gossip.published" in names
+
+
+def test_every_metric_name_is_documented():
+    docs = DOCS.read_text()
+    missing = sorted(n for n in _collect_names() if f"`{n}`" not in docs)
+    assert not missing, (
+        f"metric names registered in code but absent from "
+        f"docs/OBSERVABILITY.md inventory: {missing}")
+
+
+def test_every_metric_name_is_prometheus_legal():
+    bad = sorted(n for n in _collect_names()
+                 if not _PROM_LEGAL.match(_prom_name(n)))
+    assert not bad, f"names that survive _prom_name illegally: {bad}"
+
+
+def test_documented_stage_labels_match_flight_stages():
+    from sitewhere_tpu.runtime.flight import STAGES
+
+    docs = DOCS.read_text()
+    missing = [s for s in STAGES if f"`{s}`" not in docs]
+    assert not missing, (
+        f"flight stages undocumented in OBSERVABILITY.md: {missing}")

@@ -1,0 +1,183 @@
+"""Concurrency hammering for MetricsRegistry primitives.
+
+Counters, meters, timers, and histograms are updated from the step
+thread, feeder stagers, the inbound dispatcher, and the REST scrape
+thread at once; these tests pin that no update is lost and that
+snapshots taken mid-storm never crash or tear.
+"""
+
+import threading
+
+from sitewhere_tpu.runtime.metrics import (
+    DEFAULT_BUCKETS, Histogram, MetricsRegistry, Timer)
+
+N_THREADS = 8
+N_OPS = 2000
+
+
+def _hammer(n_threads, fn):
+    barrier = threading.Barrier(n_threads)
+    errors = []
+
+    def run(tid):
+        barrier.wait()
+        try:
+            fn(tid)
+        except Exception as exc:  # pragma: no cover - failure path
+            errors.append(exc)
+
+    threads = [threading.Thread(target=run, args=(t,))
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not errors, errors
+
+
+class TestCounterMeter:
+    def test_no_lost_counter_increments(self):
+        reg = MetricsRegistry()
+
+        def work(tid):
+            c = reg.counter("storm.counter")
+            for _ in range(N_OPS):
+                c.inc()
+
+        _hammer(N_THREADS, work)
+        assert reg.counter("storm.counter").value == N_THREADS * N_OPS
+
+    def test_no_lost_meter_marks(self):
+        reg = MetricsRegistry()
+
+        def work(tid):
+            m = reg.meter("storm.meter")
+            for _ in range(N_OPS):
+                m.mark(2)
+
+        _hammer(N_THREADS, work)
+        assert reg.meter("storm.meter").count == N_THREADS * N_OPS * 2
+
+    def test_registry_getters_return_same_instance(self):
+        reg = MetricsRegistry()
+        seen = []
+        lock = threading.Lock()
+
+        def work(tid):
+            items = (reg.counter("one"), reg.timer("two"),
+                     reg.histogram("three"))
+            with lock:
+                seen.append(items)
+
+        _hammer(N_THREADS, work)
+        assert len({id(c) for c, _, _ in seen}) == 1
+        assert len({id(t) for _, t, _ in seen}) == 1
+        assert len({id(h) for _, _, h in seen}) == 1
+
+
+class TestTimer:
+    def test_exact_count_and_total(self):
+        timer = Timer(capacity=256)
+
+        def work(tid):
+            for _ in range(N_OPS):
+                timer.update(0.001)
+
+        _hammer(N_THREADS, work)
+        snap = timer.snapshot()
+        assert snap["count"] == N_THREADS * N_OPS
+        assert abs(snap["total_s"] - N_THREADS * N_OPS * 0.001) < 1e-6
+        # reservoir holds only `capacity` samples but quantiles stay sane
+        assert snap["p50_s"] == 0.001
+        assert snap["p99_s"] == 0.001
+
+    def test_snapshot_under_write_storm(self):
+        timer = Timer(capacity=64)
+        stop = threading.Event()
+        snaps = []
+
+        def reader():
+            while not stop.is_set():
+                snaps.append(timer.snapshot())
+
+        t = threading.Thread(target=reader)
+        t.start()
+        try:
+            _hammer(4, lambda tid: [timer.update(0.002)
+                                    for _ in range(N_OPS)])
+        finally:
+            stop.set()
+            t.join(timeout=30)
+        for snap in snaps:
+            # quantiles always come from a coherent sorted copy
+            assert snap["p50_s"] in (0.0, 0.002)
+            assert snap["count"] <= 4 * N_OPS
+
+
+class TestHistogram:
+    def test_exact_counts_per_label(self):
+        hist = Histogram()
+
+        def work(tid):
+            for _ in range(N_OPS):
+                hist.observe(0.003, stage=f"s{tid % 2}")
+
+        _hammer(N_THREADS, work)
+        snap = hist.snapshot()
+        per_label = N_THREADS // 2 * N_OPS
+        for key in ((("stage", "s0"),), (("stage", "s1"),)):
+            assert snap[key]["count"] == per_label
+            assert abs(snap[key]["sum_s"] - per_label * 0.003) < 1e-6
+            # cumulative buckets are monotone and end at the count
+            buckets = snap[key]["buckets"]
+            assert buckets == sorted(buckets)
+            assert buckets[-1] == per_label
+
+    def test_bucket_assignment(self):
+        hist = Histogram(buckets=(0.001, 0.01, 0.1))
+        hist.observe(0.0005)
+        hist.observe(0.005)
+        hist.observe(0.05)
+        hist.observe(5.0)  # overflows every bucket -> only +Inf at export
+        snap = hist.snapshot()[()]
+        assert snap["buckets"] == [1, 2, 3]
+        assert snap["count"] == 4
+
+    def test_default_buckets_cover_step_path(self):
+        assert DEFAULT_BUCKETS == tuple(sorted(DEFAULT_BUCKETS))
+        assert DEFAULT_BUCKETS[0] <= 0.0005
+        assert DEFAULT_BUCKETS[-1] >= 2.5
+
+
+class TestPrometheusUnderStorm:
+    def test_scrape_during_writes(self):
+        reg = MetricsRegistry()
+        stop = threading.Event()
+        texts = []
+
+        def reader():
+            while not stop.is_set():
+                texts.append(reg.prometheus_text())
+
+        t = threading.Thread(target=reader)
+        t.start()
+
+        def work(tid):
+            for i in range(N_OPS // 4):
+                reg.counter("scrape.counter").inc()
+                reg.timer("scrape.timer").update(0.001)
+                reg.histogram("scrape.hist").observe(
+                    0.002, stage=f"s{tid}")
+
+        try:
+            _hammer(N_THREADS, work)
+        finally:
+            stop.set()
+            t.join(timeout=30)
+        final = reg.prometheus_text()
+        assert (f"swtpu_scrape_counter_total "
+                f"{N_THREADS * (N_OPS // 4)}") in final
+        assert 'le="+Inf"' in final
+        for text in texts:
+            for line in text.splitlines():
+                assert not line.startswith("Traceback")
