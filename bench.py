@@ -100,6 +100,9 @@ def _compact_result(result: Dict, detail_path) -> Dict:
     fl = result.get("flight") or {}
     out["flight"] = {k: fl[k] for k in (
         "recorder_overhead_pct_of_step",) if k in fl}
+    fa = result.get("faults") or {}
+    out["faults"] = {k: fa[k] for k in (
+        "disarmed_overhead_pct_of_step",) if k in fa}
     probe = result.get("link_probe_pre") or {}
     out["link_probe_pre"] = {k: probe[k] for k in (
         "dispatch_rtt_ms_p50", "h2d_4mb_mbps_last", "host_argsort_1m_ms",
@@ -725,11 +728,29 @@ def _t_sync(jax, ctx) -> Dict:
             r.begin_stage(st)
             r.end_stage(st)
     recorder_overhead_s = (time.perf_counter() - o0) / K
+    # disarmed robustness-plane cost: the hot path crosses ~4 fault
+    # points per step plus one admission check per ingest request; probe
+    # both disarmed (runtime/faults.py compiles fault_point to a global
+    # load + identity test; the controller with no budgets is two
+    # attribute loads) for perf_gate's `fault_injection_overhead` pin
+    from sitewhere_tpu.runtime.faults import active_plan, fault_point
+    from sitewhere_tpu.sources.manager import AdmissionController
+    assert active_plan() is None, "bench must run with faults disarmed"
+    probe_admission = AdmissionController()
+    f0 = time.perf_counter()
+    for _ in range(K):
+        fault_point("pack_fail")
+        fault_point("h2d_error")
+        fault_point("dispatch_error")
+        fault_point("lane_fetch_error")
+        probe_admission.admit()
+    fault_overhead_s = (time.perf_counter() - f0) / K
     return {"plain_s": plain,
             "pack_s": [r.stage_s("pack") for r in recs],
             "h2d_s": [r.stage_s("h2d") for r in recs],
             "device_s": [r.stage_s("device_compute") for r in recs],
-            "recorder_overhead_s": [recorder_overhead_s]}
+            "recorder_overhead_s": [recorder_overhead_s],
+            "fault_overhead_s": [fault_overhead_s]}
 
 
 def _t_compute(jax, ctx) -> Dict:
@@ -1448,6 +1469,19 @@ def _aggregate(jax, ctx, trials: Dict[str, List[Dict]],
         "critical_stage": max(crit, key=crit.get) if crit else "",
     }
 
+    # robustness plane: disarmed fault points + a disabled admission
+    # check, per step crossing (perf_gate fault_injection_overhead pins
+    # the sum < 0.5% of step wall). Same min-of-trials policy as the
+    # recorder probe.
+    fault_overhead_s = min(
+        x for t in trials["sync"] for x in t["fault_overhead_s"])
+    faults = {
+        "disarmed_overhead_us_per_step": round(fault_overhead_s * 1e6, 3),
+        "disarmed_overhead_pct_of_step": round(
+            fault_overhead_s * 1000 / sync_total_ms * 100, 4)
+        if sync_total_ms else 0.0,
+    }
+
     interleaved = {}
     for i, t in enumerate(trials["multitenant"]):
         tag = chr(ord("a") + i)
@@ -1510,6 +1544,7 @@ def _aggregate(jax, ctx, trials: Dict[str, List[Dict]],
             rule_lat[int(len(rule_lat) * 0.99)] * 1000, 3),
         "step_breakdown": step_breakdown,
         "flight": flight,
+        "faults": faults,
         # ingest + durable persist + enriched consumer, concurrently (the
         # _t_sustained composition) — the number to compare against the
         # reference's always-persisting pipeline

@@ -141,6 +141,14 @@ MIN_RULE_PROGRAM_SPEEDUP = 1.0
 # accelerator-scale claims.
 MAX_OBSERVABILITY_OVERHEAD_PCT = 1.0
 
+# Fault points (runtime/faults.py) + the ingest admission check
+# (sources/manager.py) also ride every step/request. Disarmed, a fault
+# point is one module-global load + identity test and a disabled
+# admission controller is two attribute loads; bench probes the per-step
+# crossing set and the sum must stay under 0.5% of the synchronous step
+# wall. Same small-scale advisory policy as observability_overhead.
+MAX_FAULT_OVERHEAD_PCT = 0.5
+
 # Trial-spread bounds: full scale judges the accelerator-scale claim; the
 # BENCH_SCALE=small smoke still EVALUATES the check (bench's sections now
 # measure steady-state windows with explicit warmup exclusion, so the
@@ -397,6 +405,24 @@ def self_consistency(bench: Dict) -> Dict:
                     "steps make the ratio noise — the bound gates at "
                     "full scale)")
             checks["observability_overhead"] = entry
+    # Fault-injection overhead: disarmed fault points + the admission
+    # check must stay under 0.5% of the step wall (full scale; advisory
+    # on the cpu smoke for the same sub-ms-step reason).
+    fa = bench.get("faults")
+    if isinstance(fa, dict):
+        fa_pct = fa.get("disarmed_overhead_pct_of_step")
+        if isinstance(fa_pct, (int, float)):
+            fa_ok = fa_pct < MAX_FAULT_OVERHEAD_PCT
+            entry = {
+                "ok": fa_ok or small,
+                "disarmed_overhead_pct_of_step": fa_pct,
+                "max_pct": MAX_FAULT_OVERHEAD_PCT}
+            if small and not fa_ok:
+                entry["advisory"] = (
+                    "over bound on the cpu smoke host (advisory; sub-ms "
+                    "steps make the ratio noise — the bound gates at "
+                    "full scale)")
+            checks["fault_injection_overhead"] = entry
     # Spread judged against the steady-state windows at every scale; the
     # BENCH_SCALE=small smoke gets the wider bound (sub-millisecond CPU
     # section timings ride scheduler noise on shared CI hosts).

@@ -57,6 +57,12 @@ def _build_instance(cfg, mesh=None):
     batch_size = int(cfg.get("pipeline.latency_batch_size")
                      if mode == "latency"
                      else cfg.get("pipeline.batch_size"))
+    # boot-armed fault plan (runtime/faults.py): only built when rules
+    # are declared, so the default config boots with injection disarmed
+    fault_rules = cfg.get("faults.rules") or []
+    fault_plan = ({"seed": int(cfg.get("faults.seed") or 0),
+                   "rules": [dict(r) for r in fault_rules]}
+                  if fault_rules else None)
     return SiteWhereInstance(
         mesh=mesh,
         instance_id=cfg.get("instance.id"),
@@ -82,7 +88,17 @@ def _build_instance(cfg, mesh=None):
             else None),
         latency_linger_ms=(float(cfg.get("pipeline.linger_ms"))
                            if mode == "latency" else None),
-        latency_adaptive=bool(cfg.get("pipeline.adaptive_linger")))
+        latency_adaptive=bool(cfg.get("pipeline.adaptive_linger")),
+        allow_fault_drills=bool(cfg.get("faults.allow_drills")),
+        fault_plan=fault_plan,
+        admission_step_budget_ms=(
+            float(cfg.get("faults.admission_step_budget_ms"))
+            if cfg.get("faults.admission_step_budget_ms") is not None
+            else None),
+        admission_queue_depth_budget=(
+            int(cfg.get("faults.admission_queue_depth_budget"))
+            if cfg.get("faults.admission_queue_depth_budget") is not None
+            else None))
 
 
 def _apply_rule_config(instance, cfg) -> None:

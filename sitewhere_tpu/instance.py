@@ -58,7 +58,11 @@ class SiteWhereInstance(LifecycleComponent):
                  tenant_datastores: Optional[Dict] = None,
                  checkpoint_interval_s: Optional[float] = None,
                  latency_linger_ms: Optional[float] = None,
-                 latency_adaptive: bool = True):
+                 latency_adaptive: bool = True,
+                 allow_fault_drills: bool = False,
+                 fault_plan: Optional[Dict] = None,
+                 admission_step_budget_ms: Optional[float] = None,
+                 admission_queue_depth_budget: Optional[int] = None):
         super().__init__(f"instance:{instance_id}")
         self.instance_id = instance_id
         self.data_dir = data_dir
@@ -134,6 +138,24 @@ class SiteWhereInstance(LifecycleComponent):
             self.latency_batcher = AdaptiveBatcher(
                 self.pipeline_engine, linger_ms=latency_linger_ms,
                 adaptive=latency_adaptive)
+
+        # robustness plane (runtime/faults.py, sources/manager.py):
+        # `allow_fault_drills` gates the POST /api/instance/faults drill
+        # endpoint (403 otherwise — drills are an operator action, never
+        # ambient); `fault_plan` arms a seeded schedule at boot (config
+        # model faults.*); admission budgets turn on front-door overload
+        # shedding fed by the flight recorder + decoded-events backlog
+        self.allow_fault_drills = bool(allow_fault_drills)
+        if fault_plan:
+            from sitewhere_tpu.runtime.faults import FaultPlan, arm
+            arm(FaultPlan.from_json(fault_plan))
+        if (admission_step_budget_ms is not None
+                or admission_queue_depth_budget is not None):
+            from sitewhere_tpu.sources.manager import GLOBAL_ADMISSION
+            GLOBAL_ADMISSION.configure(
+                step_budget_ms=admission_step_budget_ms,
+                queue_depth_budget=admission_queue_depth_budget,
+                queue_depth=self._ingest_backlog)
 
         # global (non-multitenant) managements — reference:
         # service-user-management / service-tenant-management
@@ -481,6 +503,18 @@ class SiteWhereInstance(LifecycleComponent):
         self.event_log.stop()
         self.bus.flush()  # durable bus logs visible to a successor instance
 
+    def _ingest_backlog(self) -> int:
+        """Worst decoded-events consumer lag across tenants — the
+        admission controller's queue-depth signal (Kafka analog: max
+        consumer group lag on the decoded topics)."""
+        with self.bus._lock:
+            groups = list(self.bus._groups.items())
+        worst = 0
+        for (topic_name, _group_id), group in groups:
+            if topic_name.endswith("event-source-decoded-events"):
+                worst = max(worst, group.lag())
+        return worst
+
     # -- convenience accessors --------------------------------------------
     def get_tenant_engine(self, tenant_token: str) -> Optional[TenantEngine]:
         engine = self.engine_manager.get_engine(tenant_token)
@@ -504,6 +538,15 @@ class SiteWhereInstance(LifecycleComponent):
             "tenant_engines": engines,
             "failed_tenant_engines": failed,
         }
+        if self.pipeline_engine is not None:
+            health = getattr(self.pipeline_engine, "health", None)
+            if health is not None:
+                # degradation ladder (runtime/health.py):
+                # healthy -> degraded -> draining -> failed
+                out["pipeline_health"] = health.to_json()
+        from sitewhere_tpu.sources.manager import GLOBAL_ADMISSION
+        if GLOBAL_ADMISSION.enabled:
+            out["admission"] = GLOBAL_ADMISSION.report()
         if self.cluster_hooks is not None:
             # multi-host deployment: per-process heartbeat states with
             # liveness (reference: TopologyStateAggregator.java)

@@ -34,6 +34,8 @@ except ImportError:  # pragma: no cover
 
 from sitewhere_tpu.model import DeviceAlert
 from sitewhere_tpu.ops.pack import EventBatch, blob_to_batch
+from sitewhere_tpu.runtime.bus import jittered
+from sitewhere_tpu.runtime.faults import fault_point
 from sitewhere_tpu.parallel.mesh import SHARD_AXIS, make_mesh, shard_axis_size
 from sitewhere_tpu.parallel.router import ShardRouter
 from sitewhere_tpu.pipeline.engine import PipelineEngine
@@ -662,6 +664,7 @@ class ShardedPipelineEngine(PipelineEngine):
             self.device_route_steps += 1
             self._metrics.counter("route.device_steps").inc()
             rec.begin_stage("route_device")
+            fault_point("pack_fail")
             blob = self._pack_flat_blob(batch)
             rec.end_stage("route_device")
             self._stage_hist.observe(rec.stage_s("route_device"),
@@ -672,6 +675,7 @@ class ShardedPipelineEngine(PipelineEngine):
             self.device_route_fallbacks += 1
             self._metrics.counter("route.host_fallbacks").inc()
         rec.begin_stage("route_host")
+        fault_point("pack_fail")
         routed_blob, over_rows = self.router.route_batch(batch)
         rec.end_stage("route_host")
         self._stage_hist.observe(rec.stage_s("route_host"),
@@ -740,6 +744,23 @@ class ShardedPipelineEngine(PipelineEngine):
                   ) -> Tuple["RoutedBlobView", ProcessOutputs]:
         return self.dispatch_staged(params, self.stage_prepared(prepared))
 
+    def _h2d_with_retry(self, put):
+        """Bounded retry/backoff around a host->mesh transfer. The host
+        blob is intact regardless of how far a failed transfer got (no
+        donation on this edge), so re-issuing the put is always safe."""
+        attempt = 0
+        while True:
+            try:
+                fault_point("h2d_error")
+                return put()
+            except Exception:
+                attempt += 1
+                if attempt > self.step_retries:
+                    raise
+                self._retry_counter.inc()
+                self.health.note_retry()
+                time.sleep(jittered(0.01 * (2 ** (attempt - 1))))
+
     def stage_prepared(self, prepared: "_PreparedStep") -> "_StagedStep":
         """Start the host->mesh transfer of a prepared step WITHOUT
         dispatching it. device_put is async on accelerator runtimes, so a
@@ -756,7 +777,8 @@ class ShardedPipelineEngine(PipelineEngine):
             flat = NamedSharding(self.mesh, P(None, SHARD_AXIS))
             if rec is not None:
                 rec.begin_stage("h2d")
-            blob = jax.device_put(prepared.blob, flat)
+            blob = self._h2d_with_retry(
+                lambda: jax.device_put(prepared.blob, flat))
             if rec is not None:
                 rec.end_stage("h2d")
             view = DeviceRoutedView(prepared.blob, self.router)
@@ -784,12 +806,14 @@ class ShardedPipelineEngine(PipelineEngine):
             # the view holds the local copy; the pooled routed blob is
             # fully consumed at this point and can go back on the shelf
             self.router.release_staging_buffer(routed_blob)
-            blob = jax.make_array_from_process_local_data(
-                shard0, local_blob, routed_blob.shape)
+            blob = self._h2d_with_retry(
+                lambda: jax.make_array_from_process_local_data(
+                    shard0, local_blob, routed_blob.shape))
             view = RoutedBlobView(local_blob, shard_ids=local)
             counted = local_blob
         else:
-            blob = jax.device_put(routed_blob, shard0)
+            blob = self._h2d_with_retry(
+                lambda: jax.device_put(routed_blob, shard0))
             # release wired after the step runs, carrying the step output
             # as the transfer-completion guard
             view = RoutedBlobView(routed_blob)
@@ -812,9 +836,12 @@ class ShardedPipelineEngine(PipelineEngine):
         if rec is None:
             rec = self.flight.begin_step(engine=self.name)
         rec.begin_stage("dispatch")
-        with self._state_lock:  # vs concurrent readers (base __init__)
-            self._state, self._rule_state, outputs = step(
-                params, self._state, self._rule_state, staged.blob)
+        # h2d_error is staged separately here (stage_prepared /
+        # stage_routed_blob) — only the dispatch point arms on this edge
+        outputs = self._dispatch_with_retry(
+            lambda: step(params, self._state, self._rule_state,
+                         staged.blob),
+            points=("dispatch_error",))
         rec.end_stage("dispatch")
         self._flight_last = rec
         self._stage_hist.observe(rec.stage_s("dispatch"),
@@ -912,7 +939,7 @@ class ShardedPipelineEngine(PipelineEngine):
         if self.is_multiprocess:
             lanes = self._gather_local(outputs.alert_lanes)
         else:
-            lanes = jax.device_get(outputs.alert_lanes)  # [S, ROWS, K]
+            lanes = self._fetch_lanes_with_retry(outputs)  # [S, ROWS, K]
         if rec is not None:
             rec.end_stage("lane_fetch")
             self._stage_hist.observe(rec.stage_s("lane_fetch"),

@@ -151,11 +151,20 @@ def _install_overflow(engine, overflow_cols: Dict[str, np.ndarray]) -> None:
 
 def _write_checkpoint_dir(directory: str, arrays: Dict[str, np.ndarray],
                           manifest: Dict[str, Any]) -> str:
-    """Write one `ckpt-<seq>/` directory (state.npz + manifest.json) with
-    the next sequence number, atomically via tmp-dir rename — the single
-    writer behind PipelineCheckpointer.save and write_assembled."""
+    """Write one `ckpt-<seq>/` directory (state.npz + manifest.json +
+    digest.json) with the next sequence number, atomically via fsync +
+    tmp-dir rename — the single writer behind PipelineCheckpointer.save
+    and write_assembled. The digest lets restore verify completeness and
+    fall back to the last good checkpoint instead of trusting the rename
+    alone (a torn write inside a renamed dir is the failure the
+    `checkpoint_torn_write` drill injects)."""
+    from sitewhere_tpu.persist.atomic import (
+        fsync_dir, write_digest_manifest)
+    from sitewhere_tpu.runtime.faults import FaultError, fault_point
+
     existing = [int(n.split("-")[1]) for n in os.listdir(directory)
-                if n.startswith("ckpt-") and not n.endswith(".tmp")]
+                if n.startswith("ckpt-") and not n.endswith(".tmp")
+                and not n.endswith(".quarantine")]
     seq = (max(existing) + 1) if existing else 0
     final = os.path.join(directory, f"ckpt-{seq:08d}")
     tmp = final + ".tmp"
@@ -164,7 +173,20 @@ def _write_checkpoint_dir(directory: str, arrays: Dict[str, np.ndarray],
     with open(os.path.join(tmp, "manifest.json"), "w",
               encoding="utf-8") as fh:
         json.dump(manifest, fh)
+    write_digest_manifest(tmp)
+    try:
+        fault_point("checkpoint_torn_write")
+    except FaultError:
+        # simulate the dangerous case: the rename lands but the payload
+        # is torn — digest verification is what must catch this
+        state_path = os.path.join(tmp, "state.npz")
+        size = os.path.getsize(state_path)
+        with open(state_path, "r+b") as fh:
+            fh.truncate(max(1, size // 2))
+        os.replace(tmp, final)
+        return final
     os.replace(tmp, final)
+    fsync_dir(directory)
     return final
 
 
@@ -521,38 +543,80 @@ class PipelineCheckpointer:
 
     def _gc(self) -> None:
         ckpts = sorted(n for n in os.listdir(self.directory)
-                       if n.startswith("ckpt-") and not n.endswith(".tmp"))
+                       if n.startswith("ckpt-") and not n.endswith(".tmp")
+                       and not n.endswith(".quarantine"))
         for stale in ckpts[:-self.keep]:
             shutil.rmtree(os.path.join(self.directory, stale),
                           ignore_errors=True)
 
     # -- restore -----------------------------------------------------------
+    def _quarantine(self, path: str) -> None:
+        """Move a checkpoint that failed verification aside (never delete
+        forensic evidence) so the next latest() scan skips it."""
+        import logging
+
+        dest = path + ".quarantine"
+        try:
+            os.replace(path, dest)
+        except OSError:
+            dest = path  # couldn't move: the verify gate still skips it
+        logging.getLogger("sitewhere.checkpoint").error(
+            "checkpoint %s failed digest verification; quarantined at %s",
+            path, dest)
+
     def latest(self) -> Optional[str]:
+        """Newest checkpoint that passes digest verification. Corrupt
+        ones (torn writes that survived the rename) are quarantined and
+        the scan falls back to the previous good checkpoint — restore
+        degrades to older state instead of crashing. Pre-digest legacy
+        checkpoints (no digest.json) are trusted as before."""
+        from sitewhere_tpu.persist.atomic import verify_digest_manifest
+
         ckpts = sorted(n for n in os.listdir(self.directory)
-                       if n.startswith("ckpt-") and not n.endswith(".tmp"))
-        return os.path.join(self.directory, ckpts[-1]) if ckpts else None
+                       if n.startswith("ckpt-") and not n.endswith(".tmp")
+                       and not n.endswith(".quarantine"))
+        for name in reversed(ckpts):
+            path = os.path.join(self.directory, name)
+            if verify_digest_manifest(path) is False:
+                self._quarantine(path)
+                continue
+            return path
+        return None
 
     def restore(self, engine, path: Optional[str] = None) -> Dict[str, List[int]]:
         """Load a checkpoint into the engine; returns the saved bus offsets
         keyed `topic@group` so the caller can seed replay consumers."""
+        explicit = path is not None
         path = path or self.latest()
         if path is None:
             return {}
-        with open(os.path.join(path, "manifest.json"), encoding="utf-8") as fh:
-            manifest = json.load(fh)
-        with np.load(os.path.join(path, "state.npz")) as data:
-            kwargs = {
-                f.name: np.asarray(data[f"state.{f.name}"])
-                for f in dataclasses.fields(DeviceStateTensors)
-            }
-            overflow_cols = {
-                key[len("overflow."):]: np.asarray(data[key])
-                for key in data.files if key.startswith("overflow.")
-            }
-            rule_state_cols = {
-                key[len("rulestate."):]: np.asarray(data[key])
-                for key in data.files if key.startswith("rulestate.")
-            }
+        try:
+            with open(os.path.join(path, "manifest.json"),
+                      encoding="utf-8") as fh:
+                manifest = json.load(fh)
+            with np.load(os.path.join(path, "state.npz")) as data:
+                kwargs = {
+                    f.name: np.asarray(data[f"state.{f.name}"])
+                    for f in dataclasses.fields(DeviceStateTensors)
+                }
+                overflow_cols = {
+                    key[len("overflow."):]: np.asarray(data[key])
+                    for key in data.files if key.startswith("overflow.")
+                }
+                rule_state_cols = {
+                    key[len("rulestate."):]: np.asarray(data[key])
+                    for key in data.files if key.startswith("rulestate.")
+                }
+        except (OSError, ValueError, KeyError) as err:
+            # a pre-digest checkpoint torn some other way (np.load raises
+            # ValueError/BadZipFile subclasses): same treatment as a
+            # digest mismatch — quarantine, fall back to last-good.
+            # Explicit paths propagate: the operator asked for THAT one.
+            if explicit:
+                raise SiteWhereCheckpointError(
+                    f"checkpoint {path} is unreadable: {err}") from err
+            self._quarantine(path)
+            return self.restore(engine)
         packer = engine.packer
         # rule programs re-install FIRST (they only mutate host lists):
         # the restored rule state's per-slot generations must meet their
@@ -843,7 +907,8 @@ class InstanceCheckpointManager:
     def list_checkpoints(self) -> List[str]:
         return sorted(
             name for name in os.listdir(self.checkpointer.directory)
-            if name.startswith("ckpt-") and not name.endswith(".tmp"))
+            if name.startswith("ckpt-") and not name.endswith(".tmp")
+            and not name.endswith(".quarantine"))
 
     # -- boot restore ------------------------------------------------------
     def restore_on_boot(self) -> bool:

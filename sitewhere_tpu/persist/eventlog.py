@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import logging
 import os
 import re
 import threading
@@ -448,11 +449,33 @@ class TenantEventLog:
             self._load()
 
     def _load(self) -> None:
+        # sweep orphaned .tmp spills first: a crash mid-seal leaves a
+        # partial `events-N.parquet.tmp` that must never be read — and
+        # must not survive to confuse a later crash's triage either
+        for name in os.listdir(self._dir):
+            if name.endswith(".tmp"):
+                try:
+                    os.remove(os.path.join(self._dir, name))
+                except OSError:
+                    pass
         names = sorted(f for f in os.listdir(self._dir)
                        if f.endswith(".parquet"))
         for name in names:
             path = os.path.join(self._dir, name)
-            self._segments.append(_Segment.from_arrow(pq.read_table(path)))
+            try:
+                seg = _Segment.from_arrow(pq.read_table(path))
+            except Exception:
+                # a sealed segment that no longer parses (torn pre-fsync
+                # write, bit rot): quarantine instead of poisoning boot;
+                # its rows are rebuildable from the bus log (at-least-once)
+                logging.getLogger("sitewhere.eventlog").exception(
+                    "quarantining unreadable segment %s", path)
+                try:
+                    os.replace(path, path + ".quarantine")
+                except OSError:
+                    pass
+                continue
+            self._segments.append(seg)
             self._seg_paths.append(path)
             seq = int(name.split("-")[1].split(".")[0])
             self._next_seg = max(self._next_seg, seq + 1)
@@ -492,9 +515,15 @@ class TenantEventLog:
                 self._next_seg += 1
             self._seg_paths.append(path)
         if path is not None:
+            from sitewhere_tpu.persist.atomic import fsync_dir, fsync_file
+
             tmp = path + ".tmp"
             pq.write_table(seg.to_arrow(), tmp)
+            # fsync BEFORE the rename: without it a crash can leave a
+            # renamed-but-empty parquet that poisons the next boot
+            fsync_file(tmp)
             os.replace(tmp, path)
+            fsync_dir(self._dir)
 
     def scan(self, flt: EventFilter) -> Iterator[Tuple[Dict[str, np.ndarray], np.ndarray]]:
         """Yield (cols, selected_row_indices) per segment, newest segment

@@ -31,6 +31,8 @@ from sitewhere_tpu.ops.threshold import ThresholdOp, ThresholdRuleTable, empty_t
 from sitewhere_tpu.pipeline.state_tensors import DeviceStateTensors, init_device_state
 from sitewhere_tpu.pipeline.step import PipelineParams, ProcessOutputs, check_presence, process_batch
 from sitewhere_tpu.registry.tensors import RegistryTensors
+from sitewhere_tpu.runtime.bus import jittered
+from sitewhere_tpu.runtime.faults import fault_point
 from sitewhere_tpu.runtime.lifecycle import LifecycleComponent
 from sitewhere_tpu.runtime.flight import GLOBAL_FLIGHT
 from sitewhere_tpu.runtime.metrics import GLOBAL_METRICS
@@ -339,6 +341,16 @@ class PipelineEngine(LifecycleComponent):
         self._blob_ring_guards: Optional[list] = None
         self._blob_ring_pos = 0
         self._blob_ring_lock = threading.Lock()
+        # Degradation machinery (runtime/health.py, runtime/faults.py):
+        # transient H2D/dispatch failures retry with backoff + jitter
+        # (step_retries attempts past the first) instead of poisoning the
+        # submitter; the health state machine tracks the ladder
+        # healthy -> degraded -> draining -> failed and is surfaced on
+        # /api/instance/topology and the pipeline.health_state gauge.
+        from sitewhere_tpu.runtime.health import EngineHealth
+        self.step_retries = 2
+        self.health = EngineHealth(name, metrics=self._metrics)
+        self._retry_counter = self._metrics.counter("step_retries")
 
     def _target_platform(self) -> str:
         """Platform the step will compile for (sharded engines override from
@@ -851,6 +863,7 @@ class PipelineEngine(LifecycleComponent):
         # segment and must not nest inside (double-count with) "pack"
         out_buf = self._staging_blob_buffer(batch, flight_rec=rec)
         rec.begin_stage("pack")
+        fault_point("pack_fail")
         blob = batch_to_blob(batch, out=out_buf)
         rec.end_stage("pack")
         self._stage_hist.observe(rec.stage_s("pack"),
@@ -899,9 +912,9 @@ class PipelineEngine(LifecycleComponent):
         rec = flight_rec if flight_rec is not None else (
             self.flight.begin_step(engine=self.name))
         rec.begin_stage("dispatch")
-        with self._state_lock:
-            self._state, self._rule_state, outputs = self._step_blob(
-                params, self._state, self._rule_state, blob)
+        outputs = self._dispatch_with_retry(
+            lambda: self._step_blob(params, self._state, self._rule_state,
+                                    blob))
         rec.end_stage("dispatch")
         if n_events is not None:
             rec.events = int(n_events)
@@ -917,6 +930,37 @@ class PipelineEngine(LifecycleComponent):
             self._metrics.meter("events").mark(n_events)
         return outputs
 
+    def _dispatch_with_retry(self, step_call,
+                             points=("h2d_error", "dispatch_error")):
+        """Run one state-advancing step call with bounded retry around
+        transient H2D/dispatch failures: `step_retries` extra attempts
+        with exponential backoff + jitter, then the error propagates so
+        the consumer layer can park the batch on its dead-letter topic —
+        the submitter is never wedged. Injected faults (runtime/faults.py
+        `h2d_error`/`dispatch_error`) raise BEFORE the jitted call, so
+        drill retries are always state-safe; an organic failure inside
+        the call may have consumed the donated state buffers, in which
+        case the retries fail too and the error escalates through the
+        same path. `step_call` returns (state, rule_state, outputs).
+        `points` lists the fault points armed on this path — the sharded
+        engine stages H2D separately, so its dispatch drops h2d_error."""
+        attempt = 0
+        while True:
+            try:
+                for point in points:
+                    fault_point(point)
+                with self._state_lock:
+                    self._state, self._rule_state, outputs = step_call()
+                self.health.note_success()
+                return outputs
+            except Exception:
+                attempt += 1
+                if attempt > self.step_retries:
+                    raise
+                self._retry_counter.inc()
+                self.health.note_retry()
+                time.sleep(jittered(0.01 * (2 ** (attempt - 1))))
+
     def submit_routed(self, batch: EventBatch):
         """Engine-agnostic submit: returns (batch_for_materialization,
         outputs) on both engine kinds. The sharded engine's submit already
@@ -925,6 +969,25 @@ class PipelineEngine(LifecycleComponent):
         (pipeline/inbound.py, sources/fastlane.py) use this instead of
         type-sniffing submit()'s return."""
         return batch, self.submit(batch)
+
+    def _fetch_lanes_with_retry(self, outputs: ProcessOutputs):
+        """D2H lane fetch with the same bounded retry/backoff contract as
+        `_dispatch_with_retry`. Unlike dispatch, the fetch never donates
+        buffers, so retrying a genuinely failed device_get is always safe."""
+        attempt = 0
+        while True:
+            try:
+                fault_point("lane_fetch_error")
+                lanes = jax.device_get(outputs.alert_lanes)
+                self.health.note_success()
+                return lanes
+            except Exception:
+                attempt += 1
+                if attempt > self.step_retries:
+                    raise
+                self._retry_counter.inc()
+                self.health.note_retry()
+                time.sleep(jittered(0.01 * (2 ** (attempt - 1))))
 
     def materialize_alerts(self, batch: EventBatch, outputs: ProcessOutputs,
                            max_alerts: Optional[int] = None
@@ -955,7 +1018,7 @@ class PipelineEngine(LifecycleComponent):
         rec = self._flight_last
         if rec is not None:
             rec.begin_stage("lane_fetch")
-        lanes = jax.device_get(outputs.alert_lanes)  # THE one fetch
+        lanes = self._fetch_lanes_with_retry(outputs)  # THE one fetch
         if rec is not None:
             rec.end_stage("lane_fetch")
             rec.begin_stage("materialize")

@@ -35,6 +35,7 @@ import jax
 import numpy as np
 
 from sitewhere_tpu.ops.pack import EventBatch, batch_to_blob
+from sitewhere_tpu.runtime.faults import FaultError, fault_point
 
 
 class StepFuture:
@@ -150,6 +151,7 @@ class PipelinedSubmitter:
                 fut._resolve(error=RuntimeError("submitter closed"))
                 continue
             try:
+                fault_point("feeder_thread_death")
                 # flight record opened HERE on the stager thread and
                 # handed to the step thread inside the heap item — the
                 # explicit trace-context handoff that thread-local span
@@ -176,6 +178,13 @@ class PipelinedSubmitter:
             with self._ready_lock:
                 heapq.heappush(self._ready, item)
                 self._ready_lock.notify_all()
+            exc = item[5]
+            if (isinstance(exc, FaultError)
+                    and exc.point == "feeder_thread_death"):
+                # drill: the batch's error is already in the heap (the
+                # future resolves, the batch parks downstream) and THEN
+                # this stager dies for real — remaining stagers carry on
+                raise exc
 
     # -- step dispatcher ---------------------------------------------------
     def _step_loop(self) -> None:
@@ -375,6 +384,7 @@ class ShardedPipelinedSubmitter:
             exc: Optional[BaseException] = None
             try:
                 try:
+                    fault_point("feeder_thread_death")
                     # _prepare_step: with device routing on (the default
                     # on real multi-shard meshes) this is pack + the
                     # cheap lane-fit guard ONLY — the mesh does the
@@ -405,6 +415,12 @@ class ShardedPipelinedSubmitter:
             with self._ready_lock:
                 heapq.heappush(self._ready, (seq, staged, fut, exc))
                 self._ready_lock.notify_all()
+            if (isinstance(exc, FaultError)
+                    and exc.point == "feeder_thread_death"):
+                # drill: error item is in the heap (future resolves, the
+                # routing turnstile already advanced in the finally) and
+                # then this stager dies for real
+                raise exc
 
     # -- step dispatcher ---------------------------------------------------
     def _step_loop(self) -> None:

@@ -84,6 +84,7 @@ class InboundProcessingService(LifecycleComponent):
         self.processed_meter = m.meter("processed")
         self.unregistered_counter = m.counter("unregistered")
         self.failed_counter = m.counter("failed")
+        self.dead_letter_counter = m.counter("step_dead_lettered")
         self._host = ConsumerHost(
             bus, self.naming.event_source_decoded_events(tenant),
             group_id=f"inbound-processing-{tenant}", handler=self.process)
@@ -108,6 +109,7 @@ class InboundProcessingService(LifecycleComponent):
         """One consumer batch end-to-end. Public so replay/tests can drive
         it synchronously without the poll thread."""
         hot: List[Tuple[DeviceEvent, str]] = []
+        hot_records: List[Record] = []
         forward: Dict[int, List[Record]] = {}
         for record in records:
             try:
@@ -144,6 +146,8 @@ class InboundProcessingService(LifecycleComponent):
             if not self._validate(token, record):
                 continue
             persisted = self._persist(token, events)
+            if persisted:
+                hot_records.append(record)
             for event in persisted:
                 hot.append((event, token))
             self.processed_meter.mark(len(persisted))
@@ -164,12 +168,32 @@ class InboundProcessingService(LifecycleComponent):
         elif self.engine is not None and hot:
             # Never let the hot path poison the consumer: a raising handler
             # would redeliver the batch and re-persist duplicates forever.
+            # A batch that exhausts the engine's dispatch retries parks on
+            # the dead-letter topic instead (replayable via `deadletters
+            # replay` -> the reprocess loop; re-persist on replay is
+            # tolerated by the model's idempotent event ids) — every
+            # offered event either materializes, parks, or is counted
+            # shed, never silently lost.
             try:
                 self._submit_hot(hot)
             except Exception:
                 self.failed_counter.inc()
                 LOGGER.exception("fused step failed for batch of %d events",
                                  len(hot))
+                self._park_hot(hot_records)
+
+    def _park_hot(self, hot_records: List[Record]) -> None:
+        """Park the source records of a step-poisoned batch on the decoded
+        topic's dead-letter surface and mark the engine draining — the
+        no-silent-loss half of the swallow above."""
+        dlq = (self.naming.event_source_decoded_events(self.tenant)
+               + ".dead-letter")
+        for record in hot_records:
+            self.bus.publish(dlq, record.key, record.value)
+        self.dead_letter_counter.inc(len(hot_records))
+        health = getattr(self.engine, "health", None)
+        if health is not None:
+            health.note_poison()
 
     def _validate(self, token: str, record: Record) -> bool:
         """Device + active-assignment check

@@ -24,6 +24,7 @@ connectors / command delivery, plus control-plane topics.
 from __future__ import annotations
 
 import os
+import random
 import struct
 import threading
 import time
@@ -54,6 +55,16 @@ def batch_extent(records: List["Record"]) -> Dict[int, int]:
         extent[record.partition] = max(extent.get(record.partition, 0),
                                        record.offset + 1)
     return extent
+
+
+def jittered(backoff_s: float) -> float:
+    """Equal-jitter a retry backoff into [backoff/2, backoff]. Without
+    this, every consumer of a bounced bus computes the identical
+    0.05s-doubling schedule and retries in lockstep — a thundering herd
+    on exactly the component trying to come back. Equal (not full)
+    jitter keeps a floor of half the deterministic backoff, so retry
+    budgets still span roughly the documented total window."""
+    return backoff_s * (0.5 + 0.5 * random.random())
 
 
 class TopicNaming:
@@ -721,7 +732,7 @@ class ConsumerHost:
                     consumer.seek_to_committed()
                     backoff = min(0.05 * (2 ** (retries - 1)),
                                   self._max_backoff_s)
-                    self._stop.wait(backoff)
+                    self._stop.wait(jittered(backoff))
 
     def stop(self, timeout_s: float = 5.0) -> None:
         self._stop.set()

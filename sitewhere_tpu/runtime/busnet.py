@@ -26,7 +26,9 @@ from typing import Callable, List, Optional, Tuple
 
 import msgpack
 
-from sitewhere_tpu.runtime.bus import EventBus, Record, batch_extent
+from sitewhere_tpu.runtime.bus import (EventBus, Record, batch_extent,
+                                       jittered)
+from sitewhere_tpu.runtime.faults import fault_point
 
 _LEN = struct.Struct("<I")
 _MAX_FRAME = 64 * 1024 * 1024
@@ -121,9 +123,19 @@ class _Handler(socketserver.BaseRequestHandler):
                     req = _recv_frame(sock)
                 except (BusNetError, OSError):
                     return  # client went away (or stop() severed us)
+                # drill directives (runtime/faults.py; no-ops disarmed):
+                # a partition window severs every connection on arrival,
+                # a drop eats the RESPONSE after the op ran (the
+                # lost-reply case BusClient._rpc's pre_retry exists for),
+                # a delay stalls the reply in flight.
+                if fault_point("busnet_partition") is not None:
+                    return
                 try:
-                    _send_frame(sock,
-                                self._dispatch(bus, coordinator, member, req))
+                    resp = self._dispatch(bus, coordinator, member, req)
+                    fault_point("busnet_delay")
+                    if fault_point("busnet_drop") is not None:
+                        return
+                    _send_frame(sock, resp)
                 except (BusNetError, OSError):
                     return
                 except Exception as exc:  # report, keep the connection
@@ -327,6 +339,12 @@ class BusClient:
                             raise
                     last = exc
                     self.close()
+                    if attempt < self.retries:
+                        # capped exponential backoff with equal jitter:
+                        # immediate lockstep reconnects from every client
+                        # hammer exactly the server trying to come back
+                        time.sleep(jittered(min(0.05 * (2 ** attempt),
+                                                1.0)))
             raise BusNetError(f"bus rpc failed after retries: {last}")
 
     def publish(self, topic: str, key: bytes, value: bytes
@@ -462,7 +480,7 @@ class RemoteConsumerHost:
                                                 self._group_id)
                 except BusNetError:
                     pass
-                time.sleep(0.2)
+                time.sleep(jittered(0.3))  # desync reconnecting consumers
                 continue
             if not batch:
                 if self._failing:
@@ -506,8 +524,9 @@ class RemoteConsumerHost:
                     else:
                         self._client.seek_committed(self._topic_name,
                                                     self._group_id)
-                        self._stop.wait(min(0.05 * (2 ** (retries - 1)),
-                                            self._max_backoff_s))
+                        self._stop.wait(jittered(
+                            min(0.05 * (2 ** (retries - 1)),
+                                self._max_backoff_s)))
                 except BusNetError:
                     pass
 

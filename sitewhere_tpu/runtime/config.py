@@ -208,6 +208,14 @@ DEFAULTS: Dict = {
     # MicroserviceAnalytics role, inverted to off-by-default and
     # operator-owned endpoint)
     "telemetry": {"enabled": False, "endpoint": None, "interval_s": 3600},
+    # deterministic fault injection + ingest admission (runtime/faults.py,
+    # sources/manager.py AdmissionController; config_model faults_model;
+    # docs/OPERATIONS.md "Fault drills"). Everything off by default:
+    # fault_point() is a module-global load + identity test when disarmed
+    # and admit() is two attribute loads when no budget is set.
+    "faults": {"allow_drills": False, "seed": 0, "rules": [],
+               "admission_step_budget_ms": None,
+               "admission_queue_depth_budget": None},
     "persist": {"data_dir": "./swtpu-data",
                 # seconds between automatic device-state checkpoints
                 # (None = manual/REST-triggered only)

@@ -377,6 +377,48 @@ def telemetry_model() -> ElementModel:
                     _attr("interval_s", _D, default=3600.0)])
 
 
+def faults_model() -> ElementModel:
+    """Deterministic fault injection + ingest admission (runtime/faults.py,
+    sources/manager.py AdmissionController; docs/OPERATIONS.md
+    "Fault drills")."""
+    rule = ElementModel(
+        name="rules", role="fault-rule", multiple=True,
+        description="One fault-point schedule entry",
+        attributes=[
+            _attr("point", required=True,
+                  description="fault point name (runtime/faults.py "
+                              "FAULT_POINTS)"),
+            _attr("p", _D, default=1.0,
+                  description="per-hit firing probability (seeded RNG)"),
+            _attr("times", _I,
+                  description="stop after this many firings"),
+            _attr("after", _I, default=0,
+                  description="skip the first N hits"),
+            _attr("delay_s", _D, default=0.0,
+                  description="stall instead of raising (delay points)"),
+            _attr("duration_s", _D, default=0.0,
+                  description="window mode: keep firing for this long "
+                              "after the first firing"),
+        ])
+    return ElementModel(
+        name="faults", role="fault-injection",
+        description="Seeded fault drills + overload admission control",
+        attributes=[
+            _attr("allow_drills", _B, default=False,
+                  description="enable POST /api/instance/faults (403 "
+                              "otherwise)"),
+            _attr("seed", _I, default=0,
+                  description="seed for the boot-armed fault plan"),
+            _attr("admission_step_budget_ms", _D,
+                  description="shed ingest when mean step sync cost "
+                              "exceeds this (flight rollups)"),
+            _attr("admission_queue_depth_budget", _I,
+                  description="shed ingest when decoded-events backlog "
+                              "exceeds this"),
+        ],
+        children=[rule])
+
+
 def _all_elements() -> List[ElementModel]:
     """Every subsystem's element model — the single source both the UI model
     and the validator consume."""
@@ -386,7 +428,7 @@ def _all_elements() -> List[ElementModel]:
         outbound_connectors_model(), command_delivery_model(),
         registration_model(), batch_operations_model(), schedule_model(),
         label_generation_model(), web_rest_model(), analytics_model(),
-        event_search_model(), telemetry_model(),
+        event_search_model(), telemetry_model(), faults_model(),
     ]
 
 

@@ -417,6 +417,10 @@ def register_all(router: Router, instance, server) -> None:
         if engine is not None:
             extra["pipeline.batches_processed"] = engine.batches_processed
             extra["pipeline.alerts_dropped"] = engine.alerts_dropped
+            health = getattr(engine, "health", None)
+            if health is not None:
+                # 0=healthy 1=degraded 2=draining 3=failed
+                extra["pipeline.health_state"] = health.code
             # per-program fire/suppress counters (one on-demand D2H fetch
             # of two [P] vectors; cumulative, checkpoint-durable)
             for ptoken, c in engine.rule_program_counters().items():
@@ -483,6 +487,48 @@ def register_all(router: Router, instance, server) -> None:
                 authority=SiteWhereRoles.ADMINISTER_TENANTS)
     router.post("/api/instance/trace/stop", stop_device_trace,
                 authority=SiteWhereRoles.ADMINISTER_TENANTS)
+
+    # ------------------------------------------------------------------
+    # Fault drills (runtime/faults.py; docs/OPERATIONS.md "Fault drills").
+    # Arming is doubly guarded: admin authority AND the instance-level
+    # allow_fault_drills switch — injecting faults is an operator drill
+    # action, never something a stolen admin token should reach silently.
+    # ------------------------------------------------------------------
+    def _require_drills():
+        if not getattr(instance, "allow_fault_drills", False):
+            raise SiteWhereError(
+                "fault drills are disabled on this instance "
+                "(boot with allow_fault_drills=True)", http_status=403)
+
+    def get_faults(request: Request):
+        """GET /api/instance/faults — armed plan + per-point hit counts
+        (empty report when disarmed)."""
+        from sitewhere_tpu.runtime.faults import active_plan
+        plan = active_plan()
+        return {"armed": plan is not None,
+                "plan": plan.report() if plan is not None else None}
+
+    def arm_faults(request: Request):
+        """POST /api/instance/faults {seed, rules: [{point, p?, times?,
+        after?, delay_s?, duration_s?}]} — arm a seeded fault schedule."""
+        _require_drills()
+        from sitewhere_tpu.runtime.faults import FaultPlan, arm
+        plan = FaultPlan.from_json(_body(request))
+        arm(plan)
+        return {"armed": True, "plan": plan.report()}
+
+    def disarm_faults(request: Request):
+        _require_drills()
+        from sitewhere_tpu.runtime.faults import disarm
+        disarm()
+        return {"armed": False}
+
+    router.get("/api/instance/faults", get_faults,
+               authority=SiteWhereRoles.VIEW_SERVER_INFO)
+    router.post("/api/instance/faults", arm_faults,
+                authority=SiteWhereRoles.ADMINISTER_TENANTS)
+    router.delete("/api/instance/faults", disarm_faults,
+                  authority=SiteWhereRoles.ADMINISTER_TENANTS)
 
     # ------------------------------------------------------------------
     # Dead-letter operability (runtime/deadletter.py; reference: the

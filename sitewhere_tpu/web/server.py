@@ -161,8 +161,13 @@ class RestServer(LifecycleComponent):
             # thread-local stack, every span the handler opens on this
             # thread); the response echoes the server span's context so
             # callers can stitch their traces to ours.
+            from sitewhere_tpu.runtime.faults import fault_point
             from sitewhere_tpu.runtime.tracing import (
                 GLOBAL_TRACER, extract_traceparent, inject_traceparent)
+            # drill: a stalled REST worker (delay-mode rule) holds this
+            # thread mid-request — ThreadingHTTPServer keeps serving on
+            # the others, which is exactly what the drill verifies
+            fault_point("rest_worker_stall")
             parent_ctx = extract_traceparent(
                 handler.headers.get("traceparent"))
             with GLOBAL_TRACER.span(

@@ -1,0 +1,482 @@
+"""Deterministic fault injection + degradation machinery (tier-1 half).
+
+Covers the fast, single-process contracts of ISSUE 8: FaultPlan
+scheduling determinism, the fault_point disarmed no-op, the engine
+health ladder, admission shedding, crash-safe checkpoint/eventlog
+writes, and the engine retry/park paths on the single-chip engine.
+The multi-shard and wall-clock-heavy drills live in test_chaos.py
+(`-m chaos`).
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from sitewhere_tpu.runtime.faults import (
+    FAULT_POINTS, FaultError, FaultPlan, FaultRule, active_plan, arm,
+    disarm, fault_point)
+from sitewhere_tpu.runtime.health import (
+    DEGRADED, DRAINING, FAILED, HEALTHY, EngineHealth)
+
+
+@pytest.fixture(autouse=True)
+def _always_disarm():
+    """No test may leak an armed plan into the rest of the suite."""
+    disarm()
+    yield
+    disarm()
+
+
+class TestFaultPlan:
+    def test_unknown_point_rejected(self):
+        with pytest.raises(ValueError, match="unknown fault point"):
+            FaultRule("not_a_point")
+
+    def test_times_and_after_gate_fires(self):
+        plan = FaultPlan(seed=7, rules=[
+            FaultRule("dispatch_error", times=2, after=1)])
+        fired = [plan.check("dispatch_error") is not None
+                 for _ in range(6)]
+        # hit 1 skipped (after=1), hits 2-3 fire (times=2), rest exhausted
+        assert fired == [False, True, True, False, False, False]
+
+    def test_seeded_probability_is_deterministic(self):
+        def schedule(seed):
+            plan = FaultPlan(seed=seed, rules=[
+                FaultRule("h2d_error", p=0.5)])
+            return [plan.check("h2d_error") is not None
+                    for _ in range(64)]
+
+        a, b = schedule(42), schedule(42)
+        assert a == b                     # same seed -> same drill
+        assert any(a) and not all(a)      # p=0.5 actually gates
+        assert schedule(43) != a          # seed matters
+
+    def test_per_point_streams_are_independent(self):
+        """Draws at one point must not perturb another's schedule —
+        thread interleaving elsewhere cannot change a drill."""
+        solo = FaultPlan(seed=9, rules=[FaultRule("pack_fail", p=0.5)])
+        noisy = FaultPlan(seed=9, rules=[FaultRule("pack_fail", p=0.5),
+                                         FaultRule("h2d_error", p=0.5)])
+        a, b = [], []
+        for _ in range(32):
+            a.append(solo.check("pack_fail") is not None)
+            noisy.check("h2d_error")  # interleaved foreign draw
+            b.append(noisy.check("pack_fail") is not None)
+        assert a == b
+
+    def test_from_json_round_trip(self):
+        doc = {"seed": 11, "rules": [
+            {"point": "busnet_drop", "p": 0.25, "times": 3, "after": 2},
+            {"point": "rest_worker_stall", "delay_s": 0.5},
+        ]}
+        plan = FaultPlan.from_json(doc)
+        report = plan.report()
+        assert report["seed"] == 11
+        by_point = {r["point"]: r for r in report["rules"]}
+        assert by_point["busnet_drop"]["p"] == 0.25
+        assert by_point["busnet_drop"]["times"] == 3
+        assert by_point["busnet_drop"]["after"] == 2
+        assert by_point["rest_worker_stall"]["delay_s"] == 0.5
+
+    def test_window_mode_stays_open_for_duration(self):
+        plan = FaultPlan(seed=0, rules=[
+            FaultRule("busnet_partition", times=1, duration_s=0.2)])
+        assert plan.check("busnet_partition") is not None  # opens window
+        assert plan.check("busnet_partition") is not None  # still open
+        time.sleep(0.25)
+        # window elapsed and times=1 exhausted: closed for good
+        assert plan.check("busnet_partition") is None
+
+
+class TestFaultPoint:
+    def test_disarmed_is_none_for_every_point(self):
+        assert active_plan() is None
+        for point in FAULT_POINTS:
+            assert fault_point(point) is None
+
+    def test_raising_point_raises_fault_error(self):
+        from sitewhere_tpu.runtime.metrics import GLOBAL_METRICS
+        injected = GLOBAL_METRICS.counter("faults.injected")
+        per_point = GLOBAL_METRICS.counter("faults.point.h2d_error")
+        before, before_point = injected.value, per_point.value
+        arm(FaultPlan(seed=1, rules=[FaultRule("h2d_error", times=1)]))
+        with pytest.raises(FaultError) as err:
+            fault_point("h2d_error")
+        assert err.value.point == "h2d_error"
+        assert injected.value == before + 1
+        assert per_point.value == before_point + 1
+        # schedule exhausted: the same point is quiet again
+        assert fault_point("h2d_error") is None
+
+    def test_delay_point_sleeps_then_returns(self):
+        arm(FaultPlan(seed=1, rules=[
+            FaultRule("rest_worker_stall", times=1, delay_s=0.15)]))
+        t0 = time.monotonic()
+        rule = fault_point("rest_worker_stall")
+        assert rule is not None
+        assert time.monotonic() - t0 >= 0.14
+
+    def test_directive_point_returns_rule_without_raising(self):
+        arm(FaultPlan(seed=1, rules=[FaultRule("busnet_drop", times=1)]))
+        rule = fault_point("busnet_drop")
+        assert rule is not None and rule.point == "busnet_drop"
+
+
+class TestEngineHealth:
+    def test_ladder_and_recovery(self):
+        health = EngineHealth("eng", recover_after=3)
+        assert health.state == HEALTHY and health.code == 0
+        health.note_retry("induced")
+        assert health.state == DEGRADED and health.code == 1
+        # recovery needs recover_after CONSECUTIVE clean submits
+        health.note_success()
+        health.note_retry("again")  # streak resets
+        health.note_success()
+        health.note_success()
+        assert health.state == DEGRADED
+        health.note_success()
+        assert health.state == HEALTHY
+
+    def test_poison_drains_and_recovers(self):
+        health = EngineHealth("eng", recover_after=2)
+        health.note_poison()
+        assert health.state == DRAINING and health.code == 2
+        health.note_success()
+        health.note_success()
+        assert health.state == HEALTHY
+
+    def test_failed_is_sticky_until_reset(self):
+        health = EngineHealth("eng", recover_after=1)
+        health.note_fatal("donated buffers lost")
+        assert health.state == FAILED and health.code == 3
+        for _ in range(10):
+            health.note_success()
+        assert health.state == FAILED
+        health.note_poison()  # cannot regress out of failed either
+        assert health.state == FAILED
+        health.reset()
+        assert health.state == HEALTHY
+
+    def test_to_json_shape(self):
+        health = EngineHealth("eng")
+        health.note_shed()
+        doc = health.to_json()
+        assert doc["state"] == DEGRADED
+        assert doc["code"] == 1
+        assert doc["transitions"] == 1
+        assert doc["last_cause"] == "admission shedding"
+        assert isinstance(doc["last_transition_ms"], int)
+
+
+class TestJitteredBackoff:
+    def test_equal_jitter_bounds(self):
+        from sitewhere_tpu.runtime.bus import jittered
+        draws = [jittered(0.8) for _ in range(500)]
+        assert all(0.4 <= d <= 0.8 for d in draws)
+        assert len(set(draws)) > 1  # actually randomized
+
+
+class _FakeFlight:
+    """Stands in for GLOBAL_FLIGHT: reports a fixed mean step cost."""
+
+    def __init__(self, step_ms):
+        self.step_ms = step_ms
+
+    def export(self, last_n=None):
+        return {"rollups": {"steps": last_n or 8,
+                            "sync_total_ms": {"sum_of_stages": self.step_ms},
+                            "window_ms": 1000.0}}
+
+
+class TestAdmissionController:
+    def test_disabled_always_admits(self):
+        from sitewhere_tpu.sources.manager import AdmissionController
+        ctl = AdmissionController()
+        assert not ctl.enabled
+        assert all(ctl.admit() for _ in range(100))
+
+    def test_queue_depth_budget_sheds_and_recovers(self):
+        from sitewhere_tpu.sources.manager import AdmissionController
+        depth = {"n": 100}
+        ctl = AdmissionController(queue_depth_budget=10,
+                                  queue_depth=lambda: depth["n"],
+                                  check_every=1)
+        assert ctl.enabled
+        assert not ctl.admit()
+        report = ctl.report()
+        assert report["shedding"] and report["last_queue_depth"] == 100
+        depth["n"] = 3  # backlog drained: admissions resume
+        assert ctl.admit()
+        assert not ctl.report()["shedding"]
+
+    def test_step_budget_sheds_on_slow_pipeline(self):
+        from sitewhere_tpu.sources.manager import AdmissionController
+        ctl = AdmissionController(flight=_FakeFlight(step_ms=50.0),
+                                  step_budget_ms=10.0, check_every=1)
+        assert not ctl.admit()
+        assert ctl.report()["last_step_ms"] == 50.0
+        ctl._flight = _FakeFlight(step_ms=2.0)
+        assert ctl.admit()
+
+    def test_decision_cached_between_refreshes(self):
+        from sitewhere_tpu.sources.manager import AdmissionController
+        calls = {"n": 0}
+
+        def depth():
+            calls["n"] += 1
+            return 0
+
+        ctl = AdmissionController(queue_depth_budget=10, queue_depth=depth,
+                                  check_every=64)
+        for _ in range(64):
+            assert ctl.admit()
+        assert calls["n"] == 1
+
+    def test_source_sheds_event_traffic_with_429(self):
+        """The front door: over budget, event ingest raises a counted,
+        client-visible IngestShedError (HTTP 429); registrations — rare
+        control-plane traffic — always admit."""
+        from sitewhere_tpu.model.event import (
+            DeviceEventBatch, DeviceMeasurement, DeviceRegistrationRequest)
+        from sitewhere_tpu.runtime.bus import EventBus
+        from sitewhere_tpu.sources import DecodedRequest, InboundEventSource
+        from sitewhere_tpu.sources.manager import (
+            GLOBAL_ADMISSION, IngestShedError)
+
+        source = InboundEventSource("shed-src", decoder=None, receivers=[],
+                                    bus=EventBus())
+        event_req = DecodedRequest("d0", DeviceEventBatch(
+            device_token="d0",
+            measurements=[DeviceMeasurement(name="m", value=1.0)]))
+        reg_req = DecodedRequest("d0", DeviceRegistrationRequest(
+            device_token="d0", device_type_token="t"))
+        GLOBAL_ADMISSION.configure(queue_depth_budget=1,
+                                   queue_depth=lambda: 1000, check_every=1)
+        try:
+            with pytest.raises(IngestShedError) as err:
+                source.handle_decoded_request(event_req)
+            assert err.value.http_status == 429
+            assert source.shed_counter.value == 1
+            source.handle_decoded_request(reg_req)  # control plane admits
+        finally:
+            GLOBAL_ADMISSION.configure(step_budget_ms=0.0,
+                                       queue_depth_budget=0)
+        # budgets reset: event traffic flows again
+        source.handle_decoded_request(event_req)
+        assert source.shed_counter.value == 1
+
+
+class TestAtomicDigests:
+    def test_manifest_verifies_and_detects_corruption(self, tmp_path):
+        from sitewhere_tpu.persist.atomic import (
+            verify_digest_manifest, write_digest_manifest)
+        d = str(tmp_path)
+        for name, payload in (("a.bin", b"x" * 100), ("b.bin", b"y" * 50)):
+            with open(os.path.join(d, name), "wb") as fh:
+                fh.write(payload)
+        assert verify_digest_manifest(d) is None  # legacy: no digest yet
+        write_digest_manifest(d)
+        assert verify_digest_manifest(d) is True
+        with open(os.path.join(d, "a.bin"), "r+b") as fh:
+            fh.truncate(10)  # torn write
+        assert verify_digest_manifest(d) is False
+        os.remove(os.path.join(d, "a.bin"))  # missing payload
+        assert verify_digest_manifest(d) is False
+
+
+class TestCheckpointQuarantine:
+    def _fake_ckpt(self, directory, seq, torn=False):
+        from sitewhere_tpu.persist.atomic import write_digest_manifest
+        path = os.path.join(directory, f"ckpt-{seq:08d}")
+        os.makedirs(path)
+        with open(os.path.join(path, "state.npz"), "wb") as fh:
+            fh.write(b"payload" * 16)
+        with open(os.path.join(path, "manifest.json"), "w") as fh:
+            json.dump({"epoch_base_ms": 0}, fh)
+        write_digest_manifest(path)
+        if torn:
+            with open(os.path.join(path, "state.npz"), "r+b") as fh:
+                fh.truncate(8)
+        return path
+
+    def test_latest_skips_and_quarantines_corrupt(self, tmp_path):
+        from sitewhere_tpu.persist.checkpoint import PipelineCheckpointer
+        ckpt = PipelineCheckpointer(str(tmp_path))
+        good = self._fake_ckpt(str(tmp_path), 0)
+        bad = self._fake_ckpt(str(tmp_path), 1, torn=True)
+        assert ckpt.latest() == good      # degraded to older state
+        assert os.path.isdir(bad + ".quarantine")  # evidence kept
+        assert not os.path.exists(bad)
+        # the quarantined dir never reappears in later scans
+        assert ckpt.latest() == good
+
+    def test_all_corrupt_means_no_checkpoint(self, tmp_path):
+        from sitewhere_tpu.persist.checkpoint import PipelineCheckpointer
+        ckpt = PipelineCheckpointer(str(tmp_path))
+        self._fake_ckpt(str(tmp_path), 0, torn=True)
+        assert ckpt.latest() is None
+
+
+class TestEventlogCrashSafety:
+    def test_orphan_tmp_swept_and_corrupt_segment_quarantined(
+            self, tmp_path):
+        from sitewhere_tpu.model import (
+            Device, DeviceAssignment, DeviceMeasurement, DeviceType)
+        from sitewhere_tpu.persist import (
+            ColumnarEventLog, DeviceEventManagement)
+        from sitewhere_tpu.registry import DeviceManagement
+
+        dm = DeviceManagement()
+        dt = dm.create_device_type(DeviceType(token="t"))
+        dev = dm.create_device(Device(token="dev-0", device_type_id=dt.id))
+        dm.create_device_assignment(DeviceAssignment(token="as-0",
+                                                     device_id=dev.id))
+        data_dir = str(tmp_path)
+        log = ColumnarEventLog(data_dir=data_dir, segment_rows=2)
+        mgmt = DeviceEventManagement(log, registry=dm)
+        for i in range(4):
+            mgmt.add_measurements("as-0", DeviceMeasurement(
+                name="m", value=float(i), event_date=1000 + i))
+            if i % 2:
+                log.flush()  # two sealed two-row segments
+        tdir = os.path.join(data_dir, "default")
+        sealed = sorted(n for n in os.listdir(tdir)
+                        if n.endswith(".parquet"))
+        assert len(sealed) >= 2
+
+        # crash leftovers: a mid-seal .tmp and a torn sealed segment
+        orphan = os.path.join(tdir, "events-999999.parquet.tmp")
+        with open(orphan, "wb") as fh:
+            fh.write(b"partial")
+        torn = os.path.join(tdir, sealed[-1])
+        with open(torn, "r+b") as fh:
+            fh.truncate(10)
+
+        log2 = ColumnarEventLog(data_dir=data_dir, segment_rows=2)
+        mgmt2 = DeviceEventManagement(log2, registry=dm)
+        assert not os.path.exists(orphan)             # swept
+        assert os.path.exists(torn + ".quarantine")   # kept for triage
+        assert not os.path.exists(torn)
+        # the surviving segments still serve reads
+        from sitewhere_tpu.persist import EventIndex
+        res = mgmt2.list_measurements(EventIndex.DEVICE, "dev-0")
+        assert res.num_results == 2  # the un-torn sealed segment's rows
+
+
+def _engine_world(batch_size=16):
+    from sitewhere_tpu.model import Device, DeviceAssignment, DeviceType
+    from sitewhere_tpu.pipeline.engine import PipelineEngine
+    from sitewhere_tpu.registry import DeviceManagement, RegistryTensors
+
+    dm = DeviceManagement()
+    dt = dm.create_device_type(DeviceType(token="t"))
+    tensors = RegistryTensors(max_devices=64, max_zones=4,
+                              max_zone_vertices=4)
+    tensors.attach(dm, "tenant")
+    for i in range(8):
+        d = dm.create_device(Device(token=f"d{i}", device_type_id=dt.id))
+        dm.create_device_assignment(DeviceAssignment(token=f"a{i}",
+                                                     device_id=d.id))
+    engine = PipelineEngine(tensors, batch_size=batch_size)
+    engine.start()
+    return dm, engine
+
+
+def _one_batch(engine, value=1.0):
+    from sitewhere_tpu.model.event import DeviceEventType
+    engine.packer.measurements.intern("m")
+    idx = engine.packer.devices.lookup("d0")
+    now = engine.packer.epoch_base_ms
+    return engine.packer.pack_columns(
+        np.array([idx], np.int32),
+        np.array([int(DeviceEventType.MEASUREMENT)], np.int32),
+        np.array([now], np.int64),
+        mm_idx=np.array([1], np.int32),
+        value=np.array([value], np.float32))
+
+
+class TestEngineRetry:
+    def test_transient_h2d_fault_absorbed_by_retry(self):
+        """One injected H2D failure: the submit still lands (retry),
+        the retry counter ticks, and health walks degraded -> healthy."""
+        _, engine = _engine_world()
+        engine.health.recover_after = 3
+        retries0 = engine._retry_counter.value  # engines share the scoped
+        arm(FaultPlan(seed=5, rules=[FaultRule("h2d_error", times=1)]))
+        out = engine.submit(_one_batch(engine, value=7.0))
+        assert int(out.processed) == 1
+        assert engine._retry_counter.value == retries0 + 1
+        assert engine.health.state == DEGRADED
+        disarm()
+        for _ in range(3):
+            engine.submit(_one_batch(engine))
+        assert engine.health.state == HEALTHY
+        # injected failures raise BEFORE dispatch, so no state was lost
+        assert engine.get_device_state("d0") is not None
+
+    def test_retry_budget_exhaustion_escalates(self):
+        _, engine = _engine_world()
+        retries0 = engine._retry_counter.value
+        arm(FaultPlan(seed=5, rules=[
+            FaultRule("dispatch_error", times=engine.step_retries + 1)]))
+        with pytest.raises(FaultError):
+            engine.submit(_one_batch(engine))
+        assert engine._retry_counter.value == retries0 + engine.step_retries
+
+    def test_lane_fetch_retry(self):
+        _, engine = _engine_world()
+        routed, outputs = engine.submit_routed(_one_batch(engine))
+        retries0 = engine._retry_counter.value
+        arm(FaultPlan(seed=5, rules=[
+            FaultRule("lane_fetch_error", times=1)]))
+        engine.materialize_alerts(routed, outputs)  # retried, no raise
+        assert engine._retry_counter.value == retries0 + 1
+
+
+class TestInboundParksPoisonBatches:
+    def test_poison_batch_parks_on_dead_letter(self):
+        """A batch that exhausts every dispatch retry must park on the
+        decoded topic's dead-letter surface (replayable), mark the engine
+        draining, and leave the consumer alive — never silently lost,
+        never wedged."""
+        import msgpack
+        from sitewhere_tpu.model.common import _asdict
+        from sitewhere_tpu.model.event import (
+            DeviceEventBatch, DeviceMeasurement)
+        from sitewhere_tpu.pipeline.inbound import InboundProcessingService
+        from sitewhere_tpu.runtime.bus import EventBus, Record
+
+        dm, engine = _engine_world()
+        bus = EventBus()
+        svc = InboundProcessingService(bus, dm, events=None, engine=engine,
+                                       tenant="tenant")
+        payload = msgpack.packb({
+            "sourceId": "s", "deviceToken": "d0",
+            "kind": "DeviceEventBatch",
+            "request": _asdict(DeviceEventBatch(
+                device_token="d0",
+                measurements=[DeviceMeasurement(name="m", value=1.0)])),
+            "metadata": {}}, use_bin_type=True)
+        record = Record(topic="x", partition=0, offset=0, key=b"d0",
+                        value=payload, timestamp_ms=0)
+
+        arm(FaultPlan(seed=3, rules=[
+            FaultRule("dispatch_error", times=engine.step_retries + 1)]))
+        svc.process([record])  # must not raise
+        disarm()
+
+        assert svc.dead_letter_counter.value == 1
+        assert engine.health.state == DRAINING
+        dlq = svc.naming.event_source_decoded_events("tenant") \
+            + ".dead-letter"
+        consumer = bus.consumer(dlq, "drill")
+        parked = consumer.poll(16)
+        assert len(parked) == 1
+        assert parked[0].value == payload  # byte-identical: replayable
+        # the consumer keeps consuming clean traffic afterwards
+        svc.process([record])
+        assert engine.get_device_state("d0") is not None

@@ -1,0 +1,65 @@
+"""Supervisor give-up and graceful-exit paths (runtime/supervisor.py).
+
+test_supervised_cluster.py drills the happy path (kill-1-of-3 gang
+restart); these cover the loop's exits: a child that keeps dying faster
+than `min_uptime_s` makes the supervisor give up with the CHILD's exit
+code (a broken child — bad flags, unbindable port — must not restart
+forever), and a clean exit 0 ends supervision without a restart.
+"""
+
+import os
+import signal
+import sys
+
+import pytest
+
+from sitewhere_tpu.runtime.supervisor import Supervisor
+
+
+@pytest.fixture(autouse=True)
+def _restore_signal_handlers():
+    """Supervisor.run() installs SIGTERM/SIGINT handlers on the test
+    process; put the originals back so later tests (and pytest's own
+    KeyboardInterrupt handling) are unaffected."""
+    saved = {sig: signal.getsignal(sig)
+             for sig in (signal.SIGTERM, signal.SIGINT)}
+    yield
+    for sig, handler in saved.items():
+        signal.signal(sig, handler)
+
+
+def _child(code_snippet):
+    return [sys.executable, "-c", code_snippet]
+
+
+def test_gives_up_after_max_fast_fails_with_childs_code(tmp_path):
+    marker = tmp_path / "spawns"
+    sup = Supervisor(
+        _child("import pathlib, sys;"
+               f"p = pathlib.Path({str(marker)!r});"
+               "p.write_text(p.read_text() + 'x' if p.exists() else 'x');"
+               "sys.exit(7)"),
+        backoff_s=0.01, min_uptime_s=30.0, max_fast_fails=3)
+    rc = sup.run()
+    assert rc == 7                              # the CHILD's code, not 1
+    assert marker.read_text() == "xxx"          # exactly max_fast_fails
+
+
+def test_clean_exit_ends_supervision():
+    sup = Supervisor(_child("raise SystemExit(0)"),
+                     backoff_s=0.01, min_uptime_s=30.0, max_fast_fails=3)
+    assert sup.run() == 0
+
+
+def test_abnormal_exit_restarts_until_clean(tmp_path):
+    """First run crashes, second exits 0: supervision restarts through
+    the crash and then completes."""
+    flag = tmp_path / "crashed-once"
+    sup = Supervisor(
+        _child("import pathlib, sys;"
+               f"p = pathlib.Path({str(flag)!r});"
+               "sys.exit(0 if p.exists() else "
+               "(p.write_text('x'), sys.exit(3)))"),
+        backoff_s=0.01, min_uptime_s=30.0, max_fast_fails=5)
+    assert sup.run() == 0
+    assert flag.exists()
