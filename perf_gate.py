@@ -131,6 +131,18 @@ ALERT_LANE_BYTES_PER_SLOT = 16
 # and always gates the fetch budget.
 MIN_RULE_PROGRAM_SPEEDUP = 1.0
 
+# Compiled anomaly models (ml/compiler.py scoring inside the fused
+# step): model fires ride the spare alert-lane meta bits, so alert
+# delivery must stay exactly ONE fixed-shape D2H fetch per offer with
+# models scoring every tick — a workload fact, gated at every scale.
+# The scoring stage's marginal step cost must stay under 10% of the
+# model-free step, and its marginal per-event cost must at least match
+# the host-side per-event scoring loop it replaces — both judged at
+# FULL scale only (on a 1-core cpu smoke they measure XLA-vs-Python
+# dispatch, not the workload; same policy as rule_programs).
+MIN_ANOMALY_MODEL_SPEEDUP = 1.0
+MAX_ANOMALY_MODEL_MARGINAL_PCT = 10.0
+
 # The step flight recorder (runtime/flight.py) is ALWAYS ON, so its cost
 # rides every step: the recorder's per-step self-cost (slot claim + a
 # full set of stage marks, measured by bench's probe loop) must stay
@@ -366,6 +378,33 @@ def self_consistency(bench: Dict) -> Dict:
                     "below bound on the cpu smoke host (advisory; the "
                     "bound gates at full scale)")
             checks["rule_programs"] = entry
+    # Anomaly-model budget: with compiled models scoring every tick in
+    # the fused step, alert delivery must still be exactly 1 fixed-shape
+    # D2H fetch per offer (model fires ride the spare alert-lane meta
+    # bits); the scoring stage's marginal step cost and its per-event
+    # cost vs the host scorer gate at full scale (absent before the
+    # tier existed: no check).
+    am = bench.get("anomaly_models")
+    if isinstance(am, dict):
+        am_fpo = am.get("d2h_fetches_per_offer")
+        am_speedup = am.get("offload_speedup_x")
+        am_marginal = am.get("marginal_step_pct")
+        if all(isinstance(v, (int, float))
+               for v in (am_fpo, am_speedup, am_marginal)):
+            cost_ok = (am_speedup >= MIN_ANOMALY_MODEL_SPEEDUP
+                       and am_marginal < MAX_ANOMALY_MODEL_MARGINAL_PCT)
+            entry = {
+                "ok": am_fpo == 1 and (cost_ok or small),
+                "d2h_fetches_per_offer": am_fpo,
+                "offload_speedup_x": am_speedup,
+                "marginal_step_pct": am_marginal,
+                "min_speedup_x": MIN_ANOMALY_MODEL_SPEEDUP,
+                "max_marginal_step_pct": MAX_ANOMALY_MODEL_MARGINAL_PCT}
+            if small and not cost_ok:
+                entry["cost_advisory"] = (
+                    "below bound on the cpu smoke host (advisory; the "
+                    "cost bounds gate at full scale)")
+            checks["anomaly_models"] = entry
     # Device routing: the on-device route's output must be bit-identical
     # to the host arena router's (parity_ok — a workload fact on any
     # host), and the pinned full-batch micro-bench must show the device

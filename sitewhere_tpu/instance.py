@@ -208,6 +208,20 @@ class SiteWhereInstance(LifecycleComponent):
                     logging.getLogger("sitewhere.instance").exception(
                         "could not restore rule program %r for tenant %s",
                         row["token"], row["tenant"])
+        # durable anomaly-model installs (on-TPU inference — ml/): same
+        # store pattern as the rule programs, re-installed into the
+        # engine's weight tables at boot
+        from sitewhere_tpu.ml import ModelStore
+        self.anomaly_models = ModelStore(data_dir=self.data_dir)
+        self._anomaly_model_lock = threading.Lock()
+        if self.pipeline_engine is not None:
+            for row in self.anomaly_models.all_installs():
+                try:
+                    self.pipeline_engine.upsert_anomaly_model(row["spec"])
+                except Exception:
+                    logging.getLogger("sitewhere.instance").exception(
+                        "could not restore anomaly model %r for tenant %s",
+                        row["token"], row["tenant"])
         # serializes scripted-rule check+attach+commit sequences: a gossip
         # apply that passed its LWW pre-check must not interleave with a
         # local install, or the loser's attach could replace the winner's
@@ -469,6 +483,72 @@ class SiteWhereInstance(LifecycleComponent):
                                                    int(payload)):
                     if engine is not None:
                         engine.remove_rule_program(token)
+                    return True
+        return False
+
+    # -- anomaly models (durable + replicated; on-TPU inference) -----------
+    def install_anomaly_model(self, tenant: str, spec: Dict,
+                              replace: bool = False) -> Dict:
+        """Validate + install an anomaly model on the fused pipeline:
+        live engine install (the dry-run compile 409s naming the
+        offending field BEFORE any mutation), durable record, gossip via
+        the store's listeners. Model tokens are instance-global (the
+        engine is); the store scopes listing and removal by tenant."""
+        from sitewhere_tpu.errors import ErrorCode, SiteWhereError
+
+        engine = self.pipeline_engine
+        if engine is None:
+            raise SiteWhereError(
+                "anomaly models require a pipeline engine "
+                "(pipeline.enabled)", ErrorCode.GENERIC, http_status=409)
+        spec = dict(spec or {})
+        spec["tenant_token"] = tenant  # force the request tenant's scope
+        with self._anomaly_model_lock:
+            if replace:
+                entry = engine.upsert_anomaly_model(spec)
+            else:
+                entry = engine.create_anomaly_model(spec)
+            payload = self.anomaly_models.record(
+                tenant, entry["spec"]["token"], entry["spec"], notify=False)
+        self.anomaly_models.emit("add", tenant, entry["spec"]["token"],
+                                 payload)
+        return dict(entry["spec"])
+
+    def remove_anomaly_model(self, tenant: str, token: str) -> bool:
+        engine = self.pipeline_engine
+        with self._anomaly_model_lock:
+            removed = bool(engine is not None
+                           and self.anomaly_models.get(tenant, token)
+                           is not None
+                           and engine.remove_anomaly_model(token))
+            stamp = self.anomaly_models.erase(tenant, token, notify=False)
+        if stamp is not None:
+            self.anomaly_models.emit("remove", tenant, token, stamp)
+        return stamp is not None or removed
+
+    def apply_replicated_anomaly_model(self, op: str, tenant: str,
+                                       token: str, payload) -> bool:
+        """Gossip receive side: converge the durable store, then mirror
+        the live engine. An invalid spec raises AnomalyModelError — the
+        structured 409 naming the offending field — BEFORE any store
+        mutation (same contract as the rule programs)."""
+        engine = self.pipeline_engine
+        if op == "add":
+            spec, stamp = dict(payload["spec"]), int(payload["stamp"])
+            with self._anomaly_model_lock:
+                if not self.anomaly_models.would_apply_add(
+                        tenant, token, spec, stamp):
+                    return False
+                if engine is not None:
+                    engine.upsert_anomaly_model(spec)
+                return self.anomaly_models.apply_add(tenant, token, spec,
+                                                     stamp)
+        if op == "remove":
+            with self._anomaly_model_lock:
+                if self.anomaly_models.apply_remove(tenant, token,
+                                                    int(payload)):
+                    if engine is not None:
+                        engine.remove_anomaly_model(token)
                     return True
         return False
 

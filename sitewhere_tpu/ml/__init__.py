@@ -1,0 +1,12 @@
+"""On-TPU anomaly inference: tiny learned models compiled into fixed-
+shape weight tables (ml/compiler.py), scored inside the fused step
+(ops/anomaly.py), installed through a durable LWW store (ml/store.py).
+See docs/ANOMALY_MODELS.md."""
+
+from sitewhere_tpu.ml.compiler import (
+    AnomalyModelError, AnomalyModelTable, FeatureKind, ModelKind,
+    model_from_dict)
+from sitewhere_tpu.ml.store import ModelStore
+
+__all__ = ["AnomalyModelError", "AnomalyModelTable", "FeatureKind",
+           "ModelKind", "ModelStore", "model_from_dict"]

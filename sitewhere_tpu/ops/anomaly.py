@@ -1,0 +1,287 @@
+"""Anomaly-model scoring inside the fused step.
+
+Evaluates the compiled anomaly-model weight tables (ml/compiler.py)
+with per-(device, model, feature) state carried in HBM across steps:
+EWMA accumulators and last-value/last-ts pairs for rate features —
+the same feature semantics the rule-program predicates use
+(ops/stateful.py), pinned by the same kind of NumPy oracle
+(tests/test_anomaly_models.py).
+
+Work scales with the BATCH, not the device capacity: feature state rows
+gather per batch row from the [D, P, F] HBM tensors and scatter back
+from each device's ATTACH row (its last tracked-measurement row this
+step — a unique writer, so the scatter is deterministic like every
+other fold here). The model forward pass is a static unroll over the
+layer bucket: one [P, H, H] einsum per layer over every (row, model)
+pair — tiny matrices, batched wide, exactly the shape the MXU (or a
+CPU's SIMD GEMM) wants.
+
+Step semantics (the oracle pins them exactly):
+  * a device's observation TICK is a step with >= 1 valid tracked
+    measurement event (same definition as the rule programs);
+  * features read the POST-FOLD last-measurement state; EWMA and rate
+    features advance their state only when their measurement was
+    observed this step (same equations as ops/stateful.py);
+  * a model SCORES at a tick only when every used feature is ready
+    (value: ever observed; ewma: >= 1 observation; rate: >= 2) and
+    finite — a NaN feature never fires and never counts as scored;
+  * mlp score = sigmoid(out_w . h + out_b) over tanh hidden layers;
+    autoencoder score = mean squared reconstruction error of the
+    normalized features (final layer linear);
+  * a model FIRES on the RISING EDGE of (score > threshold) at a scored
+    tick; fires attach to the device's last tracked-measurement row so
+    they ride the alert-lane compaction (ops/compact.py) and delivery
+    stays one fixed-shape D2H fetch per step.
+
+Generation reset: `row_gen [D, P]` vs the table's per-slot `epoch` —
+a gathered row whose generation lags its model's epoch reads as fresh
+state, so installing a new model into a recycled slot resets feature
+state lazily INSIDE the jit (lockstep-safe, no out-of-band device
+mutation, no full-capacity sweep — rules/compiler.py's trick).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from sitewhere_tpu.ml.compiler import AnomalyModelTable, FeatureKind, \
+    ModelKind
+
+_NEG = -(2 ** 31)
+
+
+@struct.dataclass
+class ModelStateTensors:
+    """Per-(device, model, feature) scoring state, HBM-resident like
+    RuleStateTensors (sharded engines carry a leading shard axis on
+    every field).
+
+    The (value, aux, ts, counter) quad is one uniform record per
+    feature slot:
+      VALUE  unused (the post-fold last measurement IS the state)
+      EWMA   value = accumulator, counter = observation count
+      RATE   value = prev observation, aux = last computed rate,
+             ts = prev observation ts, counter = observation count
+    """
+
+    value: jnp.ndarray       # f32 [D, P, F]
+    aux: jnp.ndarray         # f32 [D, P, F]
+    ts: jnp.ndarray          # i32 [D, P, F]
+    counter: jnp.ndarray     # i32 [D, P, F]
+    score_prev: jnp.ndarray  # bool [D, P] above-threshold at last score
+    row_gen: jnp.ndarray     # i32 [D, P] per-row state generation
+    gen: jnp.ndarray         # i32 [P] counter-row generation
+    fire_count: jnp.ndarray  # i32 [P] cumulative fires
+    eval_count: jnp.ndarray  # i32 [P] cumulative scored ticks
+
+    @property
+    def num_models(self) -> int:
+        return self.gen.shape[-1]
+
+    @property
+    def num_features(self) -> int:
+        return self.value.shape[-1]
+
+
+def init_model_state_np(max_devices: int, max_models: int,
+                        max_features: int) -> ModelStateTensors:
+    """Numpy-leaved initial state (same contract as init_rule_state_np:
+    no device buffers, so sharded engines place the tree with ONE
+    device_put on their mesh)."""
+    D, P, F = max_devices, max_models, max_features
+    return ModelStateTensors(
+        value=np.zeros((D, P, F), np.float32),
+        aux=np.zeros((D, P, F), np.float32),
+        ts=np.full((D, P, F), _NEG, np.int32),
+        counter=np.zeros((D, P, F), np.int32),
+        score_prev=np.zeros((D, P), bool),
+        row_gen=np.zeros((D, P), np.int32),
+        gen=np.zeros((P,), np.int32),
+        fire_count=np.zeros((P,), np.int32),
+        eval_count=np.zeros((P,), np.int32),
+    )
+
+
+def init_model_state(max_devices: int, max_models: int,
+                     max_features: int) -> ModelStateTensors:
+    import jax
+
+    return jax.tree_util.tree_map(
+        jnp.asarray,
+        init_model_state_np(max_devices, max_models, max_features))
+
+
+def eval_anomaly_models(
+        table: AnomalyModelTable,
+        state: ModelStateTensors,
+        *,
+        dev: jnp.ndarray,             # i32 [B] row device index
+        attach: jnp.ndarray,          # bool [B] device's last tracked row
+        obs_row: jnp.ndarray,         # bool [B, M] device observed slot m
+        lm_row: jnp.ndarray,          # f32 [B, M] POST-fold last values
+        lmts_row: jnp.ndarray,        # i32 [B, M] POST-fold last ts
+        tenant_row: jnp.ndarray,      # i32 [B] registry mirror per row
+        dtype_row: jnp.ndarray,       # i32 [B] registry mirror per row
+) -> Tuple[ModelStateTensors, Dict[str, jnp.ndarray]]:
+    """One fused-step advance, evaluated on the batch's rows.
+
+    Only ATTACH rows advance state and may fire (one per ticked device);
+    the returned per-row outputs feed the alert-lane compaction:
+      fired:       bool [B]
+      first_model: i32 [B] lowest fired model slot (-1 = none)
+      alert_level: i32 [B] max level among fired models (-1 = none)
+      score:       f32 [B] lowest scored slot's score (0 = none scored)
+    """
+    B = dev.shape[0]
+    D = state.value.shape[0]
+    P, F = table.num_models, table.num_features
+    H = table.width
+
+    eligible = (
+        table.active[None, :]
+        & ((table.tenant_idx[None, :] == 0)
+           | (table.tenant_idx[None, :] == tenant_row[:, None]))
+        & ((table.device_type_idx[None, :] == 0)
+           | (table.device_type_idx[None, :] == dtype_row[:, None]))
+    )                                                     # [B, P]
+    tick = eligible & attach[:, None]                     # [B, P]
+
+    # gather this batch's state rows; rows whose generation lags their
+    # model's epoch read as fresh (lazy per-row reset)
+    stale = state.row_gen[dev] != table.epoch[None, :]    # [B, P]
+    stale_f = stale[:, :, None]
+    value_s = jnp.where(stale_f, 0.0, state.value[dev])   # [B, P, F]
+    aux_s = jnp.where(stale_f, 0.0, state.aux[dev])
+    ts_s = jnp.where(stale_f, _NEG, state.ts[dev])
+    ctr_s = jnp.where(stale_f, 0, state.counter[dev])
+    prev_row = jnp.where(stale, False, state.score_prev[dev])  # [B, P]
+
+    # ---- feature extraction + state advance ([B, P, F] vectorized) ----
+    mm = jnp.clip(table.feat_mm, 0, lm_row.shape[1] - 1)  # [P, F]
+    fk = table.feat_kind[None, :, :]                      # [1, P, F]
+    used = table.feat_kind > FeatureKind.UNUSED           # [P, F]
+
+    v = lm_row[:, mm]                                     # [B, P, F]
+    cur_ts = lmts_row[:, mm]                              # [B, P, F]
+    known = cur_ts > _NEG                                 # [B, P, F]
+    observed = obs_row[:, mm] & eligible[:, :, None]      # [B, P, F]
+    obs_inc = observed.astype(jnp.int32)
+
+    is_ewma = fk == FeatureKind.EWMA
+    is_rate = fk == FeatureKind.RATE
+
+    # EWMA advance (ops/stateful.py equations, per feature lane)
+    alpha = table.feat_alpha[None, :, :]
+    ewma = jnp.where(ctr_s > 0, alpha * v + (1.0 - alpha) * value_s, v)
+    new_sv_ewma = jnp.where(observed, ewma, value_s)
+
+    # rate advance: per-second delta between consecutive observations
+    dt = jnp.maximum(cur_ts - ts_s, 1).astype(jnp.float32)
+    rate = (v - value_s) * 1000.0 / dt
+    upd_rate = observed & (ctr_s > 0)
+    new_sa_rate = jnp.where(upd_rate, rate, aux_s)
+
+    # per-kind feature value + readiness
+    x = jnp.where(is_ewma, new_sv_ewma,
+                  jnp.where(is_rate, new_sa_rate, v))     # [B, P, F]
+    ready = jnp.where(
+        is_ewma, (ctr_s + obs_inc) > 0,
+        jnp.where(is_rate, (ctr_s + obs_inc) > 1, known))
+    ready = ready | ~used[None]                           # pads never block
+
+    xn = (x - table.feat_mean[None]) * table.feat_scale[None]
+    xn = jnp.where(used[None], xn, 0.0)                   # [B, P, F]
+    nan_any = jnp.any(jnp.isnan(xn) & used[None], axis=-1)   # [B, P]
+    ready_all = jnp.all(ready, axis=-1)                   # [B, P]
+
+    # state writes (gated per kind; scattered back from attach rows)
+    new_value = jnp.where(is_ewma, new_sv_ewma,
+                          jnp.where(is_rate & observed, v, value_s))
+    new_aux = jnp.where(is_rate, new_sa_rate, aux_s)
+    new_ts = jnp.where(is_rate & observed, cur_ts, ts_s)
+    new_ctr = jnp.where(is_ewma | is_rate, ctr_s + obs_inc, ctr_s)
+
+    # ---- forward pass: static unroll over the layer bucket ------------
+    # features embed in the first F lanes of a width-H activation vector
+    # (F <= H enforced by empty_model_table); rows/cols past a model's
+    # true dims are zero-padded, so tanh(0) = 0 keeps the padding inert.
+    if H > F:
+        h0 = jnp.concatenate(
+            [xn, jnp.zeros((B, P, H - F), xn.dtype)], axis=-1)
+    else:
+        h0 = xn
+    is_ae = (table.kind == ModelKind.AUTOENCODER)         # [P]
+    h = h0
+    for li in range(table.num_layers):
+        lin = jnp.einsum("pij,bpj->bpi", table.w[:, li], h) \
+            + table.b[None, :, li]
+        last = (table.n_layers - 1) == li                 # [P]
+        act = jnp.where((is_ae & last)[None, :, None], lin, jnp.tanh(lin))
+        live = (li < table.n_layers)[None, :, None]
+        h = jnp.where(live, act, h)
+
+    mlp_score = jnp.asarray(1.0, h.dtype) / (
+        1.0 + jnp.exp(-(jnp.einsum("ph,bph->bp", table.out_w, h)
+                        + table.out_b[None, :])))
+    lane_used = jnp.arange(H, dtype=jnp.int32)[None, :] \
+        < table.n_features[:, None]                       # [P, H]
+    err = jnp.where(lane_used[None], h - h0, 0.0)
+    ae_score = jnp.sum(err * err, axis=-1) \
+        / jnp.maximum(table.n_features[None, :], 1).astype(h.dtype)
+    score = jnp.where(is_ae[None, :], ae_score, mlp_score)   # [B, P]
+
+    # ---- fires: rising edge of (score > threshold) at scored ticks ----
+    scored = tick & ready_all & ~nan_any                  # [B, P]
+    above = scored & (score > table.threshold[None, :])
+    fired = above & ~prev_row
+    new_prev_row = jnp.where(scored, above, prev_row)
+
+    # scatter updated rows back from attach rows only (unique writer per
+    # device; other rows route to the dropped pad index)
+    target = jnp.where(attach, dev, D)
+
+    def put(arr, rows):
+        return arr.at[target].set(rows, mode="drop")
+
+    new_state = state.replace(
+        value=put(state.value, new_value),
+        aux=put(state.aux, new_aux),
+        ts=put(state.ts, new_ts),
+        counter=put(state.counter, new_ctr),
+        score_prev=put(state.score_prev, new_prev_row),
+        row_gen=put(state.row_gen,
+                    jnp.broadcast_to(table.epoch[None, :], (B, P))),
+        # per-model counters reset when their slot's epoch moved
+        gen=table.epoch,
+        fire_count=jnp.where(state.gen != table.epoch, 0,
+                             state.fire_count)
+        + jnp.sum(fired, axis=0, dtype=jnp.int32),
+        eval_count=jnp.where(state.gen != table.epoch, 0,
+                             state.eval_count)
+        + jnp.sum(scored, axis=0, dtype=jnp.int32),
+    )
+
+    any_fired = jnp.any(fired, axis=1)                    # [B]
+    slot_ids = jnp.arange(P, dtype=jnp.int32)[None, :]
+    first_model = jnp.min(jnp.where(fired, slot_ids, P), axis=1)
+    first_model = jnp.where(any_fired, first_model, -1).astype(jnp.int32)
+    level = jnp.max(
+        jnp.where(fired, table.alert_level[None, :], -1), axis=1
+    ).astype(jnp.int32)
+    # tolerance channel for the differential oracle: the lowest SCORED
+    # slot's score this row (well-defined regardless of fires)
+    any_scored = jnp.any(scored, axis=1)
+    first_scored = jnp.min(jnp.where(scored, slot_ids, P), axis=1)
+    score_row = jnp.take_along_axis(
+        score, jnp.clip(first_scored, 0, P - 1)[:, None], axis=1)[:, 0]
+    score_row = jnp.where(any_scored, score_row, 0.0).astype(jnp.float32)
+    return new_state, {
+        "fired": any_fired,
+        "first_model": first_model,
+        "alert_level": level,
+        "score": score_row,
+    }

@@ -18,13 +18,22 @@ batch-row order, so materialization order matches a mask scan exactly):
   row 0 (idx):   batch-row index of the fired row; -1 in unused slots
   row 1 (rules): threshold first_rule in bits 0-15, geofence first_rule
                  in bits 16-31 (int16 two's complement; -1 = none)
-  row 2 (meta):  threshold alert_level bits 0-7 | geofence alert_level
-                 bits 8-15 | threshold_fired bit 16 | geofence_fired
-                 bit 17 | program_fired bit 18 | program slot id bits
-                 19-26 | program alert_level bits 27-30 (levels/ids are
-                 only meaningful under their fired bit; rule-program
-                 fires ride the spare meta bits so the lane layout and
-                 the perf gate's bytes budget are unchanged)
+  row 2 (meta):  threshold alert_level bits 0-3 | anomaly-model slot
+                 low nibble bits 4-7 | geofence alert_level bits 8-11 |
+                 anomaly-model slot high nibble bits 12-15 |
+                 threshold_fired bit 16 | geofence_fired bit 17 |
+                 program_fired bit 18 | program slot id bits 19-26 |
+                 program alert_level bits 27-30 | model_fired bit 31
+                 (the sign bit: a negative meta word IS a model fire).
+                 Levels/ids are only meaningful under their fired bit.
+                 AlertLevel tops out at 3 (model/event.py), so the
+                 built-in level fields always fit a nibble — the upper
+                 nibbles of the old 8-bit level fields are the spare
+                 bits the anomaly-model slot id rides. Rule-program and
+                 anomaly-model fires both ride spare meta bits so the
+                 lane layout and the perf gate's bytes budget are
+                 unchanged; the model's alert LEVEL is resolved host
+                 side from its slot's spec (no bits needed)
   row 3 (counts): [0] = fired rows this step (INCLUDING rows beyond
                  capacity), [1] = alerts dropped by lane overflow (each
                  fired rule family on a row beyond capacity counts one),
@@ -64,18 +73,25 @@ _GEO_FIRED_BIT = 17
 _PROG_FIRED_BIT = 18
 _PROG_RULE_SHIFT = 19
 _PROG_LEVEL_SHIFT = 27
+# anomaly-model fires (ops/anomaly.py): fired rides the sign bit, the
+# 8-bit model slot id (table bucket capped at 64, so 8 bits is roomy)
+# splits across the two nibbles the 4-bit level fields never used
+_MODEL_FIRED_BIT = 31
+_MODEL_SLOT_LO_SHIFT = 4
+_MODEL_SLOT_HI_SHIFT = 12
 
 
 def compact_alert_lanes(thr: Dict, geo: Dict, capacity: int,
-                        prog: Dict = None):
+                        prog: Dict = None, model: Dict = None):
     """Pack the step's fired rows into alert lanes (jax, call under jit).
 
     `thr`/`geo` are the eval_threshold_rules / eval_geofence_rules output
     dicts (fired/first_rule/alert_level, all [B]); `prog` is the optional
     rule-program row dict of the same shape (ops/stateful.py fires mapped
-    to attach rows). Returns the [ALERT_LANE_ROWS, capacity] int32 lane
-    array described above. Works per shard under shard_map (row indices
-    are shard-local).
+    to attach rows); `model` is the optional anomaly-model row dict
+    (ops/anomaly.py: fired/first_model, also attach-row mapped). Returns
+    the [ALERT_LANE_ROWS, capacity] int32 lane array described above.
+    Works per shard under shard_map (row indices are shard-local).
     """
     import jax.numpy as jnp
 
@@ -87,7 +103,11 @@ def compact_alert_lanes(thr: Dict, geo: Dict, capacity: int,
         zero = jnp.zeros((B,), jnp.int32)
         prog = {"fired": jnp.zeros((B,), bool), "first_rule": zero,
                 "alert_level": zero}
-    fired = thr["fired"] | geo["fired"] | prog["fired"]       # bool [B]
+    if model is None:
+        model = {"fired": jnp.zeros((B,), bool),
+                 "first_model": jnp.full((B,), -1, jnp.int32)}
+    fired = (thr["fired"] | geo["fired"] | prog["fired"]
+             | model["fired"])                                # bool [B]
     fired_i = fired.astype(jnp.int32)
     rank = jnp.cumsum(fired_i) - 1                            # 0-based
     keep = fired & (rank < capacity)
@@ -101,8 +121,11 @@ def compact_alert_lanes(thr: Dict, geo: Dict, capacity: int,
     rules_lane = jnp.zeros((capacity,), jnp.int32).at[slot].set(
         rules, mode="drop")
     prog_fired_i = prog["fired"].astype(jnp.int32)
-    meta = ((thr["alert_level"] & 0xFF)
-            | ((geo["alert_level"] & 0xFF) << 8)
+    model_slot = jnp.where(model["fired"], model["first_model"] & 0xFF, 0)
+    meta = ((thr["alert_level"] & 0xF)
+            | ((model_slot & 0xF) << _MODEL_SLOT_LO_SHIFT)
+            | ((geo["alert_level"] & 0xF) << 8)
+            | (((model_slot >> 4) & 0xF) << _MODEL_SLOT_HI_SHIFT)
             | (thr["fired"].astype(jnp.int32) << _THR_FIRED_BIT)
             | (geo["fired"].astype(jnp.int32) << _GEO_FIRED_BIT)
             | (prog_fired_i << _PROG_FIRED_BIT)
@@ -110,11 +133,15 @@ def compact_alert_lanes(thr: Dict, geo: Dict, capacity: int,
                << _PROG_RULE_SHIFT)
             | (jnp.where(prog["fired"], prog["alert_level"] & 0xF, 0)
                << _PROG_LEVEL_SHIFT))
+    # bit 31 via the sign: `x << 31` on a positive int is undefined
+    # territory in some numpy paths, so set the sign bit with where
+    meta = jnp.where(model["fired"], meta | jnp.int32(-(2 ** 31)), meta)
     meta_lane = jnp.zeros((capacity,), jnp.int32).at[slot].set(
         meta, mode="drop")
     alerts_of = (thr["fired"].astype(jnp.int32)
                  + geo["fired"].astype(jnp.int32)
-                 + prog_fired_i)                              # 0..3 per row
+                 + prog_fired_i
+                 + model["fired"].astype(jnp.int32))          # 0..4 per row
     total_alerts = jnp.sum(alerts_of)
     kept_alerts = jnp.sum(jnp.where(keep, alerts_of, 0))
     counts_lane = (jnp.zeros((capacity,), jnp.int32)
@@ -142,13 +169,18 @@ class DecodedAlertLanes:
     prog_rule: np.ndarray = None   # int32 program slot (-1 = none)
     prog_level: np.ndarray = None  # int32 (meaningful under prog_fired)
     route_dropped: int = 0         # rows dropped by the on-device route
+    model_fired: np.ndarray = None  # bool (anomaly-model fires)
+    model_slot: np.ndarray = None   # int32 model slot (-1 = none)
 
     def __post_init__(self):
+        n = self.rows.shape[0]
         if self.prog_fired is None:
-            n = self.rows.shape[0]
             self.prog_fired = np.zeros(n, bool)
             self.prog_rule = np.full(n, -1, np.int32)
             self.prog_level = np.zeros(n, np.int32)
+        if self.model_fired is None:
+            self.model_fired = np.zeros(n, bool)
+            self.model_slot = np.full(n, -1, np.int32)
 
     @property
     def n(self) -> int:
@@ -165,7 +197,9 @@ class DecodedAlertLanes:
             total_alerts=self.total_alerts,
             prog_fired=self.prog_fired[:n], prog_rule=self.prog_rule[:n],
             prog_level=self.prog_level[:n],
-            route_dropped=self.route_dropped)
+            route_dropped=self.route_dropped,
+            model_fired=self.model_fired[:n],
+            model_slot=self.model_slot[:n])
 
 
 def decode_alert_lanes(lanes: np.ndarray) -> DecodedAlertLanes:
@@ -178,6 +212,7 @@ def decode_alert_lanes(lanes: np.ndarray) -> DecodedAlertLanes:
     rules = lanes[1, :n]
     meta = lanes[2, :n]
     prog_fired = ((meta >> _PROG_FIRED_BIT) & 1).astype(bool)
+    model_fired = meta < 0                     # sign bit IS the fire bit
     return DecodedAlertLanes(
         rows=lanes[0, :n],
         thr_fired=((meta >> _THR_FIRED_BIT) & 1).astype(bool),
@@ -185,8 +220,8 @@ def decode_alert_lanes(lanes: np.ndarray) -> DecodedAlertLanes:
         # int32 arithmetic shifts sign-extend the int16 halves exactly
         thr_rule=(rules << 16) >> 16,
         geo_rule=rules >> 16,
-        thr_level=meta & 0xFF,
-        geo_level=(meta >> 8) & 0xFF,
+        thr_level=meta & 0xF,
+        geo_level=(meta >> 8) & 0xF,
         fired_rows=fired_rows,
         dropped_alerts=int(counts[1]),
         total_alerts=int(counts[2]),
@@ -195,4 +230,10 @@ def decode_alert_lanes(lanes: np.ndarray) -> DecodedAlertLanes:
                            (meta >> _PROG_RULE_SHIFT) & 0xFF,
                            -1).astype(np.int32),
         prog_level=((meta >> _PROG_LEVEL_SHIFT) & 0xF).astype(np.int32),
-        route_dropped=int(counts[3]))
+        route_dropped=int(counts[3]),
+        model_fired=model_fired,
+        model_slot=np.where(
+            model_fired,
+            ((meta >> _MODEL_SLOT_LO_SHIFT) & 0xF)
+            | (((meta >> _MODEL_SLOT_HI_SHIFT) & 0xF) << 4),
+            -1).astype(np.int32))

@@ -1014,6 +1014,10 @@ class RegistryGossip:
             # rule-program installs replicate the same way: LWW payloads
             # (the spec IS the identity) with tombstoned removals
             rule_programs.add_listener(self._on_rule_program_mutation)
+        anomaly_models = getattr(instance, "anomaly_models", None)
+        if anomaly_models is not None:
+            # anomaly-model installs share the rule-program algebra
+            anomaly_models.add_listener(self._on_anomaly_model_mutation)
 
     def _on_script_mutation(self, op: str, scope: str, script_id: str,
                             payload) -> None:
@@ -1066,6 +1070,24 @@ class RegistryGossip:
         # non-retryable conflict toward the retry budget / dead letter,
         # never a stack-trace crash of the applier
         if self.instance.apply_replicated_rule_program(
+                data.get("op", ""), data.get("tenant", ""),
+                data.get("token", ""), data.get("payload")):
+            self.applied += 1
+
+    def _on_anomaly_model_mutation(self, op: str, tenant: str, token: str,
+                                   payload) -> None:
+        if getattr(self._applying, "active", False) or not self.peers:
+            return
+        data = {"kind": "_model", "op": op, "tenant": tenant,
+                "token": token, "payload": payload}
+        self._publish(token.encode(),
+                      msgpack.packb(data, use_bin_type=True))
+
+    def _apply_anomaly_model(self, data: Dict) -> None:
+        # invalid specs raise the structured AnomalyModelError (409,
+        # names the offending field) BEFORE any local mutation — a
+        # non-retryable conflict, same contract as _apply_rule_program
+        if self.instance.apply_replicated_anomaly_model(
                 data.get("op", ""), data.get("tenant", ""),
                 data.get("token", ""), data.get("payload")):
             self.applied += 1
@@ -1145,6 +1167,9 @@ class RegistryGossip:
             return
         if kind == "_rule_program":
             self._apply_rule_program(data)
+            return
+        if kind == "_model":
+            self._apply_anomaly_model(data)
             return
         cls = _gossip_class(kind)
         if cls is None:

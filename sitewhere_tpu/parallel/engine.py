@@ -402,6 +402,8 @@ class ShardedPipelineEngine(PipelineEngine):
         self._state = _put_global_tree(stacked, _tree_specs(stacked, shard0))
         if self._rule_state is None:
             self._rule_state = self._init_rule_state()
+        if self._model_state is None:
+            self._model_state = self._init_model_state()
         self._refresh_params()
         self._build_step()
 
@@ -425,6 +427,26 @@ class ShardedPipelineEngine(PipelineEngine):
         shard0 = NamedSharding(self.mesh, P(SHARD_AXIS))
         return _put_global_tree(stacked, _tree_specs(stacked, shard0))
 
+    def _init_model_state(self):
+        # anomaly-model state rides the shard axis exactly like the
+        # rule-program state: per-shard [S, D/S, P, F] feature lanes plus
+        # per-shard [S, P] generation/counter rows (fire/eval counters
+        # are additive partials, summed on read). Sized by
+        # _model_state_dims: a [.., 1, 1] placeholder while no models
+        # are installed (the stage is dropped at trace time).
+        from sitewhere_tpu.ops.anomaly import init_model_state_np
+
+        dims = self._model_state_dims()
+        self._model_state_built_dims = dims
+        S = self.n_shards
+        local = init_model_state_np(
+            self.registry.devices.capacity // S, *dims)
+        stacked = jax.tree_util.tree_map(
+            lambda a: np.ascontiguousarray(
+                np.broadcast_to(a, (S,) + a.shape)), local)
+        shard0 = NamedSharding(self.mesh, P(SHARD_AXIS))
+        return _put_global_tree(stacked, _tree_specs(stacked, shard0))
+
     def _build_step_blob(self) -> None:
         # the single-chip jit is never used by the sharded engine; the
         # collective program is built by _build_step instead
@@ -436,6 +458,7 @@ class ShardedPipelineEngine(PipelineEngine):
                 and getattr(self, "_sharded_built_config", None)
                 != self._step_static_config()):
             self._ensure_rule_state_sized()
+            self._ensure_model_state_sized()
             self._build_step()
 
     def _build_step(self) -> None:
@@ -448,9 +471,13 @@ class ShardedPipelineEngine(PipelineEngine):
             threshold=_tree_specs(params_template.threshold, rep),
             zones=_tree_specs(params_template.zones, rep),
             geofence=_tree_specs(params_template.geofence, rep),
-            programs=_tree_specs(params_template.programs, rep))
+            programs=_tree_specs(params_template.programs, rep),
+            # model weight tables replicate like the rule tables (small,
+            # read-only); only the feature STATE rides the shard axis
+            models=_tree_specs(params_template.models, rep))
         state_specs = _tree_specs(self._state, dev)
         rule_state_specs = _tree_specs(self._rule_state, dev)
+        model_state_specs = _tree_specs(self._model_state, dev)
         blob_specs = dev  # [S, WIRE_ROWS, B] single staging blob, sharded on S
         out_specs = ProcessOutputs(
             valid=dev, unregistered=dev, threshold_fired=dev,
@@ -458,12 +485,15 @@ class ShardedPipelineEngine(PipelineEngine):
             geofence_fired=dev, geofence_first_rule=dev,
             geofence_alert_level=dev, program_fired=dev,
             program_first_rule=dev, program_alert_level=dev,
+            model_fired=dev, model_first=dev, model_level=dev,
+            model_score=dev,
             tenant_counts=rep, processed=rep,
             alerts=rep,
             # per-shard compacted alert lanes ride the shard axis with
             # the other outputs — no extra collective, one host fetch
             alert_lanes=dev)
-        programs_enabled, node_limit = self._step_static_config()
+        programs_enabled, node_limit, models_enabled = (
+            self._step_static_config())
 
         def sq(a):
             # shard_map hands blocks with the mapped axis kept (size 1); the
@@ -473,7 +503,7 @@ class ShardedPipelineEngine(PipelineEngine):
         def unsq(a):
             return a[None]
 
-        def local_step(params, state, rule_state, local_blob,
+        def local_step(params, state, rule_state, model_state, local_blob,
                        route_dropped=None):
             """Shared per-shard body: fused step over an already-LOCAL
             [wire_rows, B] routed blob. `route_dropped` (device-routing
@@ -486,19 +516,22 @@ class ShardedPipelineEngine(PipelineEngine):
                 device_type_idx=sq(params.device_type_idx))
             state = jax.tree_util.tree_map(sq, state)
             rule_state = jax.tree_util.tree_map(sq, rule_state)
+            model_state = jax.tree_util.tree_map(sq, model_state)
             batch = blob_to_batch(local_blob)        # [12, B] -> columns
-            new_state, new_rule_state, out = process_batch(
-                params, state, rule_state, batch,
+            new_state, new_rule_state, new_model_state, out = process_batch(
+                params, state, rule_state, model_state, batch,
                 geofence_impl=self.geofence_impl,
                 alert_lane_capacity=self.alert_lane_capacity,
                 programs_enabled=programs_enabled,
-                program_node_limit=node_limit)
+                program_node_limit=node_limit,
+                models_enabled=models_enabled)
             lanes = out.alert_lanes
             if route_dropped is not None:
                 from sitewhere_tpu.ops.route import ROUTE_DROPPED_SLOT
                 lanes = lanes.at[3, ROUTE_DROPPED_SLOT].set(route_dropped)
             new_state = jax.tree_util.tree_map(unsq, new_state)
             new_rule_state = jax.tree_util.tree_map(unsq, new_rule_state)
+            new_model_state = jax.tree_util.tree_map(unsq, new_model_state)
             out = out.replace(
                 valid=unsq(out.valid), unregistered=unsq(out.unregistered),
                 threshold_fired=unsq(out.threshold_fired),
@@ -510,21 +543,27 @@ class ShardedPipelineEngine(PipelineEngine):
                 program_fired=unsq(out.program_fired),
                 program_first_rule=unsq(out.program_first_rule),
                 program_alert_level=unsq(out.program_alert_level),
+                model_fired=unsq(out.model_fired),
+                model_first=unsq(out.model_first),
+                model_level=unsq(out.model_level),
+                model_score=unsq(out.model_score),
                 alert_lanes=unsq(lanes),
                 tenant_counts=jax.lax.psum(out.tenant_counts, SHARD_AXIS),
                 processed=jax.lax.psum(out.processed, SHARD_AXIS),
                 alerts=jax.lax.psum(out.alerts, SHARD_AXIS))
-            return new_state, new_rule_state, out
+            return new_state, new_rule_state, new_model_state, out
 
-        def sharded(params, state, rule_state, blob):
-            return local_step(params, state, rule_state, sq(blob))
+        def sharded(params, state, rule_state, model_state, blob):
+            return local_step(params, state, rule_state, model_state,
+                              sq(blob))
 
         def build(fn, blob_spec):
             specs = dict(mesh=self.mesh,
                          in_specs=(params_specs, state_specs,
-                                   rule_state_specs, blob_spec),
+                                   rule_state_specs, model_state_specs,
+                                   blob_spec),
                          out_specs=(state_specs, rule_state_specs,
-                                    out_specs))
+                                    model_state_specs, out_specs))
             try:
                 # the geofence containment scan's carry is replicated
                 # only through the psum at the end of the step — a loop
@@ -534,7 +573,7 @@ class ShardedPipelineEngine(PipelineEngine):
                 mapped = _shard_map(fn, check_vma=False, **specs)
             except TypeError:  # older jax spells it check_rep
                 mapped = _shard_map(fn, check_rep=False, **specs)
-            return jax.jit(mapped, donate_argnums=(1, 2))
+            return jax.jit(mapped, donate_argnums=(1, 2, 3))
 
         self._sharded_step = build(sharded, blob_specs)
         if self.device_routing:
@@ -543,21 +582,23 @@ class ShardedPipelineEngine(PipelineEngine):
             per_shard = self.batch_size
             lane_cap = self.route_lane_capacity
 
-            def sharded_device(params, state, rule_state, flat_blob):
+            def sharded_device(params, state, rule_state, model_state,
+                               flat_blob):
                 # flat_blob block: [wire_rows, B] UNROUTED lane chunk
                 # (the flat blob split along lanes, P(None, shard)) —
                 # the routing prologue buckets + all_to_all's it to the
                 # owner shards inside the same program as the step
                 local_blob, dropped = device_route_chunk(
                     flat_blob, n_shards, per_shard, lane_cap, SHARD_AXIS)
-                return local_step(params, state, rule_state, local_blob,
-                                  route_dropped=dropped)
+                return local_step(params, state, rule_state, model_state,
+                                  local_blob, route_dropped=dropped)
 
             self._sharded_step_device = build(
                 sharded_device, P(None, SHARD_AXIS))
         else:
             self._sharded_step_device = None
-        self._sharded_built_config = (programs_enabled, node_limit)
+        self._sharded_built_config = (programs_enabled, node_limit,
+                                      models_enabled)
 
     # -- params ---------------------------------------------------------------
 
@@ -566,6 +607,7 @@ class ShardedPipelineEngine(PipelineEngine):
         threshold = self._compile_threshold_table()
         geofence = self._compile_geofence_table()
         programs = self._compile_program_table()
+        models = self._compile_model_table()
         from sitewhere_tpu.ops.geofence import ZoneTable
         zones = ZoneTable(vertices=snap.zone_vertices, nvert=snap.zone_nvert,
                           tenant_idx=snap.zone_tenant, active=snap.zone_active)
@@ -579,14 +621,15 @@ class ShardedPipelineEngine(PipelineEngine):
             area_idx=router.shard_param(snap.area_idx),
             device_type_idx=router.shard_param(snap.device_type_idx),
             threshold=threshold, zones=zones, geofence=geofence,
-            programs=programs)
+            programs=programs, models=models)
         shardings = PipelineParams(
             assignment_status=shard0, tenant_idx=shard0, area_idx=shard0,
             device_type_idx=shard0,
             threshold=_tree_specs(threshold, rep),
             zones=_tree_specs(zones, rep),
             geofence=_tree_specs(geofence, rep),
-            programs=_tree_specs(programs, rep))
+            programs=_tree_specs(programs, rep),
+            models=_tree_specs(models, rep))
         self._params = _put_global_tree(params, shardings)
         self._params_built_for = (snap.version, self._rules_version)
 
@@ -840,7 +883,7 @@ class ShardedPipelineEngine(PipelineEngine):
         # stage_routed_blob) — only the dispatch point arms on this edge
         outputs = self._dispatch_with_retry(
             lambda: step(params, self._state, self._rule_state,
-                         staged.blob),
+                         self._model_state, staged.blob),
             points=("dispatch_error",))
         rec.end_stage("dispatch")
         self._flight_last = rec
@@ -983,7 +1026,9 @@ class ShardedPipelineEngine(PipelineEngine):
                 total_alerts=sum(d.total_alerts for d in decs),
                 prog_fired=np.concatenate([d.prog_fired for d in decs]),
                 prog_rule=np.concatenate([d.prog_rule for d in decs]),
-                prog_level=np.concatenate([d.prog_level for d in decs]))
+                prog_level=np.concatenate([d.prog_level for d in decs]),
+                model_fired=np.concatenate([d.model_fired for d in decs]),
+                model_slot=np.concatenate([d.model_slot for d in decs]))
             dev_rows = (dev.reshape(-1)[rows_flat] * self.n_shards
                         + shard_of)
             ts_rows = ts.reshape(-1)[rows_flat]
@@ -1328,6 +1373,120 @@ class ShardedPipelineEngine(PipelineEngine):
         with self._state_lock:
             self._rule_state = RuleStateTensors(**out)
             self._rule_state_built_dims = self._rule_state_dims()
+
+    # -- anomaly-model state layouts ---------------------------------------
+
+    _MODEL_STATE_DEVICE_FIELDS = ("value", "aux", "ts", "counter",
+                                  "score_prev", "row_gen")
+    _MODEL_STATE_MODEL_FIELDS = ("gen", "fire_count", "eval_count")
+
+    def canonical_model_state(self):
+        """Flat device-major anomaly-model state snapshot, mirroring
+        canonical_rule_state: device-indexed feature lanes un-shard via
+        the router layout; per-shard fire/eval counters (additive
+        partials) sum; `gen` takes the per-slot max (shards step in
+        lockstep, so they agree whenever a step has run since the last
+        install)."""
+        import dataclasses as _dc
+
+        import jax.numpy as jnp
+
+        if self._model_state is None:
+            return None
+        if self.is_multiprocess:
+            from sitewhere_tpu.errors import ErrorCode, SiteWhereError
+
+            raise SiteWhereError(
+                "multi-host canonical gather is not available on a live "
+                "cluster; merge per-host checkpoints offline with "
+                "assemble-checkpoint", ErrorCode.GENERIC, http_status=409)
+        with self._state_lock:
+            snap = jax.tree_util.tree_map(jnp.copy, self._model_state)
+        out = {}
+        for f in _dc.fields(snap):
+            a = np.asarray(getattr(snap, f.name))
+            if f.name in ("fire_count", "eval_count"):
+                out[f.name] = a.sum(0, dtype=a.dtype)
+            elif f.name == "gen":
+                out[f.name] = a.max(0)
+            else:
+                out[f.name] = self.router.unshard_param(a)
+        from sitewhere_tpu.ops.anomaly import ModelStateTensors
+        return ModelStateTensors(**out)
+
+    def load_canonical_model_state(self, model_state) -> None:
+        import dataclasses as _dc
+
+        from sitewhere_tpu.ops.anomaly import ModelStateTensors
+
+        self._validate_canonical_model_state(model_state)
+        S = self.n_shards
+        out = {}
+        for f in _dc.fields(ModelStateTensors):
+            a = np.asarray(getattr(model_state, f.name))
+            if f.name in self._MODEL_STATE_MODEL_FIELDS:
+                stacked = np.zeros((S,) + a.shape, a.dtype)
+                if f.name == "gen":
+                    # generations must match on EVERY shard or the next
+                    # step's stale check would wipe the restored rows
+                    stacked[:] = a
+                else:
+                    stacked[0] = a  # additive counters land on shard 0
+                out[f.name] = stacked
+            else:
+                out[f.name] = self.router.shard_param(a)
+        stacked_state = ModelStateTensors(**out)
+        shard0 = NamedSharding(self.mesh, P(SHARD_AXIS))
+        with self._state_lock:
+            self._model_state = _put_global_tree(
+                stacked_state, _tree_specs(stacked_state, shard0))
+            self._model_state_built_dims = self._model_state_dims()
+
+    def local_model_state_blocks(self):
+        """THIS host's shard blocks of the anomaly-model state (the
+        per-host complement of canonical_model_state; same contract as
+        local_state_shards — pure local D2H, no collective)."""
+        import dataclasses as _dc
+
+        if self._model_state is None:
+            return None
+        with self._state_lock:
+            blocks = {}
+            for f in _dc.fields(self._model_state):
+                arr = getattr(self._model_state, f.name)
+                blocks[f.name] = (self._gather_local(arr)
+                                  if self.is_multiprocess
+                                  else np.asarray(arr))
+        return blocks
+
+    def load_local_model_state_blocks(self, blocks) -> None:
+        import dataclasses as _dc
+
+        from sitewhere_tpu.ops.anomaly import ModelStateTensors
+
+        shard0 = NamedSharding(self.mesh, P(SHARD_AXIS))
+        S = self.n_shards
+        canonical = self._expected_model_state_shapes()
+        out = {}
+        for f in _dc.fields(ModelStateTensors):
+            local = np.ascontiguousarray(blocks[f.name])
+            flat = canonical[f.name]
+            expect = ((S, flat[0] // S) + flat[1:]
+                      if f.name not in self._MODEL_STATE_MODEL_FIELDS
+                      else (S,) + flat)
+            global_shape = (S,) + tuple(local.shape[1:])
+            if tuple(global_shape) != tuple(expect):
+                raise ValueError(
+                    f"host-shard model-state field {f.name}: global shape "
+                    f"{global_shape} != engine {tuple(expect)}")
+            if self.is_multiprocess:
+                out[f.name] = jax.make_array_from_process_local_data(
+                    shard0, local, global_shape)
+            else:
+                out[f.name] = jax.device_put(local, shard0)
+        with self._state_lock:
+            self._model_state = ModelStateTensors(**out)
+            self._model_state_built_dims = self._model_state_dims()
 
     def pending_overflow_batch(self) -> Optional[EventBatch]:
         """The parked overflow rows as a flat host batch (checkpoint saves

@@ -119,6 +119,35 @@ def _permute_rule_state_rows(kwargs: Dict[str, np.ndarray],
     return out
 
 
+# anomaly-model state fields with a device-major leading axis (the rest —
+# gen/fire_count/eval_count — are model-indexed and move verbatim)
+_MODEL_STATE_DEVICE_FIELDS = ("value", "aux", "ts", "counter", "score_prev",
+                              "row_gen")
+
+
+def _permute_model_state_rows(kwargs: Dict[str, np.ndarray],
+                              perm: np.ndarray) -> Dict[str, np.ndarray]:
+    """Re-index the anomaly-model state's device-major rows old -> perm[old]
+    (elastic restore, mirrors _permute_rule_state_rows): untouched rows keep
+    init sentinels so unmapped devices start feature windows fresh."""
+    from sitewhere_tpu.ops.anomaly import init_model_state_np
+
+    sample = kwargs["value"]
+    init = init_model_state_np(sample.shape[0], sample.shape[1],
+                               sample.shape[2])
+    out = {}
+    old_idx = np.nonzero(perm)[0]
+    new_idx = perm[old_idx]
+    for name, array in kwargs.items():
+        if name not in _MODEL_STATE_DEVICE_FIELDS:
+            out[name] = array
+            continue
+        fresh = np.array(getattr(init, name))
+        fresh[new_idx] = array[old_idx]
+        out[name] = fresh
+    return out
+
+
 def _install_overflow(engine, overflow_cols: Dict[str, np.ndarray]) -> None:
     """Hand a restored overflow backlog to the engine: engines with a
     pending-overflow slot park it (drained before the next checkpoint);
@@ -382,6 +411,17 @@ def assemble_canonical(paths: List[str]):
             if token and token not in seen_programs:
                 seen_programs.add(token)
                 rule_programs.append({"spec": dict(row["spec"])})
+    # anomaly models union the same way (slot/epoch stripped): per-host
+    # slot assignment is host-local, so assembled restores re-install
+    # fresh and scoring state restarts (modelstate arrays don't merge)
+    anomaly_models: List[Dict] = []
+    seen_models = set()
+    for manifest, _ in loads:
+        for row in manifest.get("anomaly_models", []):
+            token = (row.get("spec") or {}).get("token")
+            if token and token not in seen_models:
+                seen_models.add(token)
+                anomaly_models.append({"spec": dict(row["spec"])})
     out_manifest: Dict[str, Any] = {
         "epoch_base_ms": base,
         "interners": {"devices": device_tokens,
@@ -392,6 +432,7 @@ def assemble_canonical(paths: List[str]):
         "pending_alerts": pending_alerts,
         "rules": rules,
         "rule_programs": rule_programs,
+        "anomaly_models": anomaly_models,
         "assembled_from": [os.path.basename(p) for p in paths],
     }
     return out_manifest, canonical, overflow_cols
@@ -468,6 +509,12 @@ class PipelineCheckpointer:
             if rule_blocks:
                 arrays.update({f"rulestate.{name}": np.asarray(block)
                                for name, block in rule_blocks.items()})
+            model_blocks = (engine.local_model_state_blocks()
+                            if hasattr(engine, "local_model_state_blocks")
+                            else None)
+            if model_blocks:
+                arrays.update({f"modelstate.{name}": np.asarray(block)
+                               for name, block in model_blocks.items()})
             overflow = engine.pending_overflow_batch()
             if overflow is not None:
                 for f in dataclasses.fields(overflow):
@@ -504,6 +551,17 @@ class PipelineCheckpointer:
                     f"rulestate.{f.name}": np.asarray(
                         getattr(rule_state, f.name))
                     for f in dataclasses.fields(rule_state)})
+            # anomaly-model scoring state travels the same way: feature
+            # accumulators + rising-edge latches resume mid-flight,
+            # re-joined to their models by the manifest's slot/epoch pins
+            model_state = (engine.canonical_model_state()
+                           if hasattr(engine, "canonical_model_state")
+                           else None)
+            if model_state is not None:
+                arrays.update({
+                    f"modelstate.{f.name}": np.asarray(
+                        getattr(model_state, f.name))
+                    for f in dataclasses.fields(model_state)})
         packer = engine.packer
         manifest: Dict[str, Any] = {
             "epoch_base_ms": packer.epoch_base_ms,
@@ -534,6 +592,11 @@ class PipelineCheckpointer:
             "rule_programs": (engine.rule_program_manifest()
                               if hasattr(engine, "rule_program_manifest")
                               else []),
+            # anomaly models with their runtime (slot, epoch) assignment:
+            # restore re-pins scoring state to its model mid-flight
+            "anomaly_models": (engine.anomaly_model_manifest()
+                               if hasattr(engine, "anomaly_model_manifest")
+                               else []),
             **(extra_manifest or {}),
             **layout,
         }
@@ -607,6 +670,10 @@ class PipelineCheckpointer:
                     key[len("rulestate."):]: np.asarray(data[key])
                     for key in data.files if key.startswith("rulestate.")
                 }
+                model_state_cols = {
+                    key[len("modelstate."):]: np.asarray(data[key])
+                    for key in data.files if key.startswith("modelstate.")
+                }
         except (OSError, ValueError, KeyError) as err:
             # a pre-digest checkpoint torn some other way (np.load raises
             # ValueError/BadZipFile subclasses): same treatment as a
@@ -623,6 +690,9 @@ class PipelineCheckpointer:
         # matching table epochs on the next compile, or the stale-slot
         # check would wipe the mid-window temporal state it pins
         self._restore_rule_programs(engine, manifest.get("rule_programs"))
+        # anomaly models likewise re-install before their state loads so
+        # the restored row generations meet matching table epochs
+        self._restore_anomaly_models(engine, manifest.get("anomaly_models"))
         if manifest.get("layout") == "host-shards":
             # per-host gang-restart checkpoint: same-topology restore of
             # this host's shard blocks + the verbatim overflow batch
@@ -630,6 +700,9 @@ class PipelineCheckpointer:
             if rule_state_cols and hasattr(engine,
                                            "load_local_rule_state_blocks"):
                 engine.load_local_rule_state_blocks(rule_state_cols)
+            if model_state_cols and hasattr(
+                    engine, "load_local_model_state_blocks"):
+                engine.load_local_model_state_blocks(model_state_cols)
             if overflow_cols:
                 from sitewhere_tpu.ops.pack import EventBatch
 
@@ -647,6 +720,9 @@ class PipelineCheckpointer:
                 if rule_state_cols:
                     rule_state_cols = _permute_rule_state_rows(
                         rule_state_cols, perm)
+                if model_state_cols:
+                    model_state_cols = _permute_model_state_rows(
+                        model_state_cols, perm)
                 if overflow_cols:
                     valid_rows = overflow_cols["device_idx"] < len(perm)
                     overflow_cols["device_idx"] = np.where(
@@ -668,6 +744,19 @@ class PipelineCheckpointer:
                     logging.getLogger("sitewhere.checkpoint").exception(
                         "rule-program state did not restore (bucket "
                         "mismatch); temporal windows restart fresh")
+            if model_state_cols and hasattr(engine,
+                                            "load_canonical_model_state"):
+                from sitewhere_tpu.ops.anomaly import ModelStateTensors
+
+                try:
+                    engine.load_canonical_model_state(
+                        ModelStateTensors(**model_state_cols))
+                except (TypeError, ValueError):
+                    import logging
+
+                    logging.getLogger("sitewhere.checkpoint").exception(
+                        "anomaly-model state did not restore (bucket "
+                        "mismatch); feature windows restart fresh")
         packer.epoch_base_ms = manifest["epoch_base_ms"]
         packer.measurements.restore(manifest["interners"]["measurements"])
         packer.alert_types.restore(manifest["interners"]["alert_types"])
@@ -777,6 +866,28 @@ class PipelineCheckpointer:
 
                 logging.getLogger("sitewhere.checkpoint").exception(
                     "checkpointed rule program %r did not restore",
+                    (row.get("spec") or {}).get("token"))
+
+    @staticmethod
+    def _restore_anomaly_models(engine, rows: Optional[List[Dict]]) -> None:
+        """Re-install checkpointed anomaly models, pinning each to its
+        saved (slot, epoch) so the restored ModelStateTensors generations
+        line up and feature accumulators / rising-edge latches resume
+        mid-flight. A model the engine's static buckets cannot hold logs
+        and skips (its slot's state resets) rather than failing the whole
+        restore."""
+        if not rows or not hasattr(engine, "upsert_anomaly_model"):
+            return
+        for row in rows:
+            try:
+                engine.upsert_anomaly_model(dict(row.get("spec") or {}),
+                                            slot=row.get("slot"),
+                                            epoch=row.get("epoch"))
+            except Exception:
+                import logging
+
+                logging.getLogger("sitewhere.checkpoint").exception(
+                    "checkpointed anomaly model %r did not restore",
                     (row.get("spec") or {}).get("token"))
 
     # -- recovery ----------------------------------------------------------
@@ -898,6 +1009,8 @@ class InstanceCheckpointManager:
             # alongside the engine's slot/epoch manifest ("rule_programs")
             "rule_program_installs":
                 self.instance.rule_programs.export_state(),
+            "anomaly_model_installs":
+                self.instance.anomaly_models.export_state(),
             "provisioning": export_provisioning(self.instance),
         }
         return self.checkpointer.save(
@@ -999,6 +1112,19 @@ class InstanceCheckpointManager:
 
                 logging.getLogger("sitewhere.checkpoint").exception(
                     "checkpointed rule program %s/%s did not restore",
+                    row.get("tenant"), row.get("token"))
+        for row in (manifest.get("anomaly_model_installs") or {}).get(
+                "installs", []):
+            try:
+                self.instance.apply_replicated_anomaly_model(
+                    "add", row["tenant"], row["token"],
+                    {"spec": row["spec"],
+                     "stamp": int(row.get("stamp", 0))})
+            except Exception:
+                import logging
+
+                logging.getLogger("sitewhere.checkpoint").exception(
+                    "checkpointed anomaly model %s/%s did not restore",
                     row.get("tenant"), row.get("token"))
 
     # -- lifecycle ---------------------------------------------------------

@@ -55,37 +55,41 @@ def materialize_alerts_maskscan(engine, batch, outputs,
     flattens before delegating — tests do the same); returns ALL fired
     rows' alerts and never touches engine counters or pending stashes.
     Rule-program fires (outputs.program_*) emit after the per-row
-    threshold/geofence alerts — the same within-row order the lane
-    materializer uses."""
+    threshold/geofence alerts, and anomaly-model fires (outputs.model_*)
+    after those — the same within-row order the lane materializer
+    uses."""
     small_batch = outputs.threshold_fired.size <= 16384
     if small_batch:
-        (thr_fired, geo_fired, prog_fired, thr_level, geo_level, prog_level,
-         thr_rule, geo_rule, prog_rule) = jax.device_get(
+        (thr_fired, geo_fired, prog_fired, model_fired,
+         thr_level, geo_level, prog_level,
+         thr_rule, geo_rule, prog_rule, model_first) = jax.device_get(
             (outputs.threshold_fired, outputs.geofence_fired,
-             outputs.program_fired,
+             outputs.program_fired, outputs.model_fired,
              outputs.threshold_alert_level, outputs.geofence_alert_level,
              outputs.program_alert_level,
              outputs.threshold_first_rule, outputs.geofence_first_rule,
-             outputs.program_first_rule))
+             outputs.program_first_rule, outputs.model_first))
     else:
-        thr_fired, geo_fired, prog_fired = jax.device_get(
+        thr_fired, geo_fired, prog_fired, model_fired = jax.device_get(
             (outputs.threshold_fired, outputs.geofence_fired,
-             outputs.program_fired))
-    fired_rows = np.nonzero(thr_fired | geo_fired | prog_fired)[0]
+             outputs.program_fired, outputs.model_fired))
+    fired_rows = np.nonzero(thr_fired | geo_fired | prog_fired
+                            | model_fired)[0]
     if fired_rows.size == 0:
         return []
     if not small_batch:
         (thr_level, geo_level, prog_level, thr_rule, geo_rule,
-         prog_rule) = jax.device_get(
+         prog_rule, model_first) = jax.device_get(
             (outputs.threshold_alert_level, outputs.geofence_alert_level,
              outputs.program_alert_level,
              outputs.threshold_first_rule, outputs.geofence_first_rule,
-             outputs.program_first_rule))
+             outputs.program_first_rule, outputs.model_first))
     device_idx = np.asarray(batch.device_idx)
     ts = np.asarray(batch.ts)
     rules = engine.list_rules()
     thr_rules, geo_rules = rules["threshold"], rules["geofence"]
     programs = engine.rule_programs_by_slot()
+    models = engine.anomaly_models_by_slot()
     alerts: List[DeviceAlert] = []
     for row in fired_rows:
         token = engine.registry.devices.token_of(int(device_idx[row])) or ""
@@ -113,6 +117,17 @@ def materialize_alerts_maskscan(engine, batch, outputs,
                 type=spec["alert_type"],
                 message=spec["alert_message"]
                 or f"rule program {spec['token']} fired",
+                event_date=engine.packer.abs_ts(int(ts[row]))))
+        if model_fired[row] and int(model_first[row]) in models:
+            # the lane path carries only the model SLOT; level/type come
+            # from the installed spec on both paths so they match exactly
+            spec = models[int(model_first[row])]
+            alerts.append(DeviceAlert(
+                device_id=token, source=AlertSource.SYSTEM,
+                level=AlertLevel(int(spec["alert_level"])),
+                type=spec["alert_type"],
+                message=spec["alert_message"]
+                or f"anomaly model {spec['token']} fired",
                 event_date=engine.packer.abs_ts(int(ts[row]))))
     return alerts
 
@@ -233,7 +248,12 @@ class PipelineEngine(LifecycleComponent):
                  alert_lane_capacity: Optional[int] = None,
                  max_rule_programs: int = 32,
                  rule_program_nodes: int = 16,
-                 rule_program_state_slots: int = 8):
+                 rule_program_state_slots: int = 8,
+                 max_anomaly_models: int = 8,
+                 anomaly_model_features: int = 4,
+                 anomaly_model_layers: int = 2,
+                 anomaly_model_width: int = 8):
+        from sitewhere_tpu.ml.compiler import MAX_MODEL_BUCKET
         from sitewhere_tpu.ops.compact import (
             DEFAULT_ALERT_LANE_CAPACITY, MIN_ALERT_LANE_CAPACITY)
         from sitewhere_tpu.rules.compiler import MAX_PROGRAM_BUCKET
@@ -257,6 +277,20 @@ class PipelineEngine(LifecycleComponent):
         self.max_rule_programs = max_rule_programs
         self.rule_program_nodes = rule_program_nodes
         self.rule_program_state_slots = rule_program_state_slots
+        # anomaly-model slot ids travel in 8 alert-lane meta bits
+        # (ops/compact.py: the two spare level nibbles)
+        if not (0 < max_anomaly_models <= MAX_MODEL_BUCKET):
+            raise ValueError(
+                f"max_anomaly_models must be in 1..{MAX_MODEL_BUCKET} "
+                f"(alert-lane model-id field width)")
+        if anomaly_model_features > anomaly_model_width:
+            raise ValueError(
+                "anomaly_model_features must be <= anomaly_model_width "
+                "(features embed in the activation vector)")
+        self.max_anomaly_models = max_anomaly_models
+        self.anomaly_model_features = anomaly_model_features
+        self.anomaly_model_layers = anomaly_model_layers
+        self.anomaly_model_width = anomaly_model_width
         self.alert_lane_capacity = (alert_lane_capacity
                                     if alert_lane_capacity is not None
                                     else DEFAULT_ALERT_LANE_CAPACITY)
@@ -277,6 +311,13 @@ class PipelineEngine(LifecycleComponent):
         self._program_epoch = 0
         self._programs_enabled = False
         self._rule_state = None
+        # anomaly models: same token -> {"slot", "epoch", "spec"} shape
+        # and stable-slot/epoch discipline as the rule programs
+        # (ml/compiler.py AnomalyModelTable.epoch)
+        self._anomaly_models: Dict[str, Dict] = {}
+        self._model_epoch = 0
+        self._models_enabled = False
+        self._model_state = None
         self._rules_version = 0
         # (op, kind, rule-or-token) feed over rule mutations — the rule
         # management surface rides it (REST audit, cluster replication)
@@ -358,13 +399,14 @@ class PipelineEngine(LifecycleComponent):
         return jax.default_backend()
 
     def _step_static_config(self):
-        """Trace-time statics of the program stage: (enabled, node trim).
-        A change — programs going empty<->non-empty, or a program using
-        more node slots than any before — rebuilds the jit (rare; a
-        normal table edit reuses the compiled program like any other
-        params refresh)."""
+        """Trace-time statics of the stateful stages: (programs enabled,
+        node trim, models enabled). A change — programs or models going
+        empty<->non-empty, or a program using more node slots than any
+        before — rebuilds the jit (rare; a normal table edit reuses the
+        compiled program like any other params refresh)."""
         return (self._programs_enabled,
-                getattr(self, "_program_nodes_in_use", 0))
+                getattr(self, "_program_nodes_in_use", 0),
+                self._models_enabled)
 
     def _build_step_blob(self) -> None:
         """(Re)build the jitted fused step. Called at construction and on
@@ -372,22 +414,26 @@ class PipelineEngine(LifecycleComponent):
         at TRACE time when no programs are installed, so the common case
         pays nothing — one recompile per transition, like any other
         static-shape change."""
-        programs_enabled, node_limit = self._step_static_config()
+        programs_enabled, node_limit, models_enabled = (
+            self._step_static_config())
 
-        def step_blob(params, state, rule_state, blob):
-            return process_batch(params, state, rule_state,
+        def step_blob(params, state, rule_state, model_state, blob):
+            return process_batch(params, state, rule_state, model_state,
                                  blob_to_batch(blob),
                                  geofence_impl=self.geofence_impl,
                                  alert_lane_capacity=self.alert_lane_capacity,
                                  programs_enabled=programs_enabled,
-                                 program_node_limit=node_limit)
+                                 program_node_limit=node_limit,
+                                 models_enabled=models_enabled)
 
-        self._step_blob = jax.jit(step_blob, donate_argnums=(1, 2))
-        self._step_built_config = (programs_enabled, node_limit)
+        self._step_blob = jax.jit(step_blob, donate_argnums=(1, 2, 3))
+        self._step_built_config = (programs_enabled, node_limit,
+                                   models_enabled)
 
     def _ensure_step_current(self) -> None:
         if self._step_built_config != self._step_static_config():
             self._ensure_rule_state_sized()
+            self._ensure_model_state_sized()
             self._build_step_blob()
 
     def _rule_state_dims(self):
@@ -414,6 +460,27 @@ class PipelineEngine(LifecycleComponent):
             with self._state_lock:
                 self._rule_state = self._init_rule_state()
 
+    def _model_state_dims(self):
+        """(P, F) the resident ModelStateTensors are sized for — the same
+        placeholder-when-empty discipline as _rule_state_dims."""
+        if self._models_enabled:
+            return (self.max_anomaly_models, self.anomaly_model_features)
+        return (1, 1)
+
+    def _init_model_state(self):
+        from sitewhere_tpu.ops.anomaly import init_model_state
+
+        dims = self._model_state_dims()
+        self._model_state_built_dims = dims
+        return init_model_state(self.registry.devices.capacity, *dims)
+
+    def _ensure_model_state_sized(self) -> None:
+        if (self._model_state is not None
+                and getattr(self, "_model_state_built_dims", None)
+                != self._model_state_dims()):
+            with self._state_lock:
+                self._model_state = self._init_model_state()
+
     # -- lifecycle ------------------------------------------------------------
 
     def on_initialize(self, monitor) -> None:
@@ -421,6 +488,8 @@ class PipelineEngine(LifecycleComponent):
                                         self.measurement_slots, self.max_tenants)
         if self._rule_state is None:
             self._rule_state = self._init_rule_state()
+        if self._model_state is None:
+            self._model_state = self._init_model_state()
         self._refresh_params()
 
     def on_start(self, monitor) -> None:
@@ -765,6 +834,183 @@ class PipelineEngine(LifecycleComponent):
             self._rule_state = jax.device_put(rule_state)
             self._rule_state_built_dims = self._rule_state_dims()
 
+    # -- anomaly models (on-TPU inference; ml/compiler.py) ------------------
+
+    def _compile_model_table(self):
+        from sitewhere_tpu.ml.compiler import (
+            compile_model_into, empty_model_table)
+
+        table = empty_model_table(
+            self.max_anomaly_models, self.anomaly_model_features,
+            self.anomaly_model_layers, self.anomaly_model_width)
+        for entry in self._anomaly_models.values():
+            compile_model_into(
+                table, entry["slot"], entry["spec"], entry["epoch"],
+                intern_measurement=self.packer.measurements.intern,
+                intern_alert_type=self.packer.alert_types.intern,
+                lookup_tenant=self.registry.tenants.lookup,
+                lookup_device_type=self.registry.device_types.lookup,
+                measurement_slots=self.measurement_slots)
+        return table
+
+    def _validate_model_spec(self, spec: Dict) -> Dict:
+        """Dry-run compile against THIS engine's static buckets: a spec
+        that passes turns into table rows without crashing the hot path.
+        Raises AnomalyModelError (409, names the field) otherwise — the
+        contract shared by the REST and replicated-apply paths."""
+        from sitewhere_tpu.ml.compiler import dry_run_compile
+
+        return dry_run_compile(
+            spec, measurement_slots=self.measurement_slots,
+            max_features=self.anomaly_model_features,
+            max_layers=self.anomaly_model_layers,
+            width=self.anomaly_model_width,
+            intern_measurement=self.packer.measurements.intern)
+
+    def upsert_anomaly_model(self, spec: Dict, *,
+                             slot: Optional[int] = None,
+                             epoch: Optional[int] = None) -> Dict:
+        """Install or replace an anomaly model (idempotent — boot config,
+        checkpoint restore, cluster replication). A replace bumps the
+        slot's epoch so its feature state resets inside the fused step;
+        `slot`/`epoch` pin the assignment on checkpoint restore so
+        mid-flight EWMA/rate state lines back up with its model."""
+        from sitewhere_tpu.errors import ErrorCode, SiteWhereError
+
+        spec = self._validate_model_spec(spec)
+        token = spec["token"]
+        with self._rules_io_lock:
+            with self._lock:
+                existing = self._anomaly_models.get(token)
+                if slot is None:
+                    if existing is not None:
+                        slot = existing["slot"]
+                    else:
+                        used = {e["slot"]
+                                for e in self._anomaly_models.values()}
+                        free = [s for s in range(self.max_anomaly_models)
+                                if s not in used]
+                        if not free:
+                            raise SiteWhereError(
+                                "anomaly model capacity exceeded "
+                                f"({self.max_anomaly_models} slots)",
+                                ErrorCode.CAPACITY_EXCEEDED,
+                                http_status=409)
+                        slot = free[0]
+                if epoch is None:
+                    self._model_epoch += 1
+                    epoch = self._model_epoch
+                else:
+                    self._model_epoch = max(self._model_epoch, epoch)
+                entry = {"slot": int(slot), "epoch": int(epoch),
+                         "spec": spec}
+                self._anomaly_models[token] = entry
+                self._models_enabled = True
+                self._rules_version += 1
+        return entry
+
+    def create_anomaly_model(self, spec: Dict) -> Dict:
+        """REST create semantics: duplicate token 409s atomically."""
+        from sitewhere_tpu.errors import DuplicateTokenError
+
+        with self._lock:
+            token = (spec or {}).get("token")
+            if token in self._anomaly_models:
+                raise DuplicateTokenError(
+                    f"anomaly model '{token}' already exists")
+        return self.upsert_anomaly_model(spec)
+
+    def remove_anomaly_model(self, token: str) -> bool:
+        with self._rules_io_lock:
+            with self._lock:
+                entry = self._anomaly_models.pop(token, None)
+                if entry is None:
+                    return False
+                self._models_enabled = bool(self._anomaly_models)
+                self._rules_version += 1
+        return True
+
+    def get_anomaly_model(self, token: str) -> Optional[Dict]:
+        with self._lock:
+            entry = self._anomaly_models.get(token)
+            return dict(entry["spec"]) if entry else None
+
+    def list_anomaly_models(self) -> List[Dict]:
+        """Model specs in slot order (the order fires resolve in)."""
+        with self._lock:
+            entries = sorted(self._anomaly_models.values(),
+                             key=lambda e: e["slot"])
+            return [dict(e["spec"]) for e in entries]
+
+    def anomaly_models_by_slot(self) -> Dict[int, Dict]:
+        with self._lock:
+            return {e["slot"]: dict(e["spec"])
+                    for e in self._anomaly_models.values()}
+
+    def anomaly_model_manifest(self) -> List[Dict]:
+        """Checkpoint form: spec + the runtime (slot, epoch) assignment,
+        so a restore re-pins feature state to its model mid-flight."""
+        with self._lock:
+            return [{"slot": e["slot"], "epoch": e["epoch"],
+                     "spec": dict(e["spec"])}
+                    for e in sorted(self._anomaly_models.values(),
+                                    key=lambda e: e["slot"])]
+
+    def anomaly_model_counters(self) -> Dict[str, Dict[str, int]]:
+        """Per-model cumulative fire/eval counters (one on-demand D2H
+        fetch of two [P] vectors — never on the hot path). Counters live
+        in the model state so they survive checkpoints; sharded engines
+        hold per-shard partials summed here."""
+        if self._model_state is None:
+            return {}
+        with self._state_lock:
+            fires = np.asarray(self._model_state.fire_count)
+            evals = np.asarray(self._model_state.eval_count)
+        if fires.ndim == 2:  # sharded [S, P] partials
+            fires, evals = fires.sum(0), evals.sum(0)
+        with self._lock:
+            return {token: {"fires": int(fires[e["slot"]])
+                            if e["slot"] < fires.shape[0] else 0,
+                            "evals": int(evals[e["slot"]])
+                            if e["slot"] < evals.shape[0] else 0}
+                    for token, e in self._anomaly_models.items()}
+
+    # -- anomaly-model state (checkpointing) --------------------------------
+
+    def canonical_model_state(self):
+        """Host snapshot of the model feature state, flat device-major
+        like canonical_state (sharded engine overrides)."""
+        import jax.numpy as jnp
+
+        if self._model_state is None:
+            return None
+        with self._state_lock:
+            snap = jax.tree_util.tree_map(jnp.copy, self._model_state)
+        return jax.tree_util.tree_map(lambda a: np.asarray(a), snap)
+
+    def _expected_model_state_shapes(self):
+        D = self.registry.devices.capacity
+        P, F = self._model_state_dims()
+        return {"value": (D, P, F), "aux": (D, P, F), "ts": (D, P, F),
+                "counter": (D, P, F), "score_prev": (D, P),
+                "row_gen": (D, P), "gen": (P,), "fire_count": (P,),
+                "eval_count": (P,)}
+
+    def _validate_canonical_model_state(self, model_state) -> None:
+        for name, want in self._expected_model_state_shapes().items():
+            got = tuple(np.asarray(getattr(model_state, name)).shape)
+            if got != want:
+                raise ValueError(
+                    f"model-state checkpoint shape mismatch for {name}: "
+                    f"got {got}, engine expects {want} (model bucket/"
+                    f"feature slots/device capacity must match)")
+
+    def load_canonical_model_state(self, model_state) -> None:
+        self._validate_canonical_model_state(model_state)
+        with self._state_lock:
+            self._model_state = jax.device_put(model_state)
+            self._model_state_built_dims = self._model_state_dims()
+
     # -- params refresh -------------------------------------------------------
 
     def _refresh_params(self) -> None:
@@ -773,6 +1019,7 @@ class PipelineEngine(LifecycleComponent):
             threshold = self._compile_threshold_table()
             geofence = self._compile_geofence_table()
             programs = self._compile_program_table()
+            models = self._compile_model_table()
             zones = ZoneTable(vertices=snap.zone_vertices, nvert=snap.zone_nvert,
                               tenant_idx=snap.zone_tenant, active=snap.zone_active)
             self._params = jax.device_put(PipelineParams(
@@ -781,7 +1028,7 @@ class PipelineEngine(LifecycleComponent):
                 area_idx=snap.area_idx,
                 device_type_idx=snap.device_type_idx,
                 threshold=threshold, zones=zones, geofence=geofence,
-                programs=programs))
+                programs=programs, models=models))
             self._params_built_for = (snap.version, self._rules_version)
 
     def _ensure_params(self) -> PipelineParams:
@@ -908,13 +1155,15 @@ class PipelineEngine(LifecycleComponent):
             self.initialize()  # full lifecycle init so a later start() won't re-init
         if self._rule_state is None:  # set_state() without lifecycle init
             self._rule_state = self._init_rule_state()
+        if self._model_state is None:
+            self._model_state = self._init_model_state()
         params = self._ensure_params()
         rec = flight_rec if flight_rec is not None else (
             self.flight.begin_step(engine=self.name))
         rec.begin_stage("dispatch")
         outputs = self._dispatch_with_retry(
             lambda: self._step_blob(params, self._state, self._rule_state,
-                                    blob))
+                                    self._model_state, blob))
         rec.end_stage("dispatch")
         if n_events is not None:
             rec.events = int(n_events)
@@ -941,16 +1190,18 @@ class PipelineEngine(LifecycleComponent):
         drill retries are always state-safe; an organic failure inside
         the call may have consumed the donated state buffers, in which
         case the retries fail too and the error escalates through the
-        same path. `step_call` returns (state, rule_state, outputs).
-        `points` lists the fault points armed on this path — the sharded
-        engine stages H2D separately, so its dispatch drops h2d_error."""
+        same path. `step_call` returns (state, rule_state, model_state,
+        outputs). `points` lists the fault points armed on this path —
+        the sharded engine stages H2D separately, so its dispatch drops
+        h2d_error."""
         attempt = 0
         while True:
             try:
                 for point in points:
                     fault_point(point)
                 with self._state_lock:
-                    self._state, self._rule_state, outputs = step_call()
+                    (self._state, self._rule_state, self._model_state,
+                     outputs) = step_call()
                 self.health.note_success()
                 return outputs
             except Exception:
@@ -1079,6 +1330,17 @@ class PipelineEngine(LifecycleComponent):
             thr_rules = list(self._threshold_rules)
             geo_rules = list(self._geofence_rules)
         programs = self.rule_programs_by_slot()
+        # model-fire resolution gets its own flight segment (nested inside
+        # materialize): the lane carries only slot ids, so the spec lookup
+        # + bit decode here is the host-side cost of on-device scoring
+        flight = self._flight_last
+        if flight is not None:
+            flight.begin_stage("model_eval")
+        models = self.anomaly_models_by_slot()
+        model_f = dec.model_fired.tolist()
+        model_s = dec.model_slot.tolist()
+        if flight is not None:
+            flight.end_stage("model_eval")
         tokens = self.registry.devices.token_array()[dev_rows].tolist()
         dates = (ts_rows.astype(np.int64)
                  + self.packer.epoch_base_ms).tolist()
@@ -1122,6 +1384,18 @@ class PipelineEngine(LifecycleComponent):
                     type=spec["alert_type"],
                     message=spec["alert_message"]
                     or f"rule program {spec['token']} fired",
+                    event_date=dates[i]))
+            if model_f[i] and model_s[i] in models:
+                # the lane carries only the 8-bit model slot; level and
+                # type resolve from the installed spec host-side
+                spec = models[model_s[i]]
+                alerts.append(DeviceAlert(
+                    device_id=token, source=AlertSource.SYSTEM,
+                    level=levels.get(int(spec["alert_level"]))
+                    or AlertLevel(int(spec["alert_level"])),
+                    type=spec["alert_type"],
+                    message=spec["alert_message"]
+                    or f"anomaly model {spec['token']} fired",
                     event_date=dates[i]))
         return alerts
 

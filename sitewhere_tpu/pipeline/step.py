@@ -37,11 +37,13 @@ from sitewhere_tpu.ops.pack import EventBatch
 from sitewhere_tpu.ops.segments import (
     count_by_key, last_by_key, scatter_max_by_key,
 )
+from sitewhere_tpu.ops.anomaly import ModelStateTensors, eval_anomaly_models
 from sitewhere_tpu.ops.stateful import (
     RuleStateTensors, eval_rule_programs, observations_of_batch,
 )
 from sitewhere_tpu.ops.threshold import ThresholdRuleTable, eval_threshold_rules
 from sitewhere_tpu.pipeline.state_tensors import DeviceStateTensors
+from sitewhere_tpu.ml.compiler import AnomalyModelTable
 from sitewhere_tpu.rules.compiler import RuleProgramTable
 
 _NEG = -(2 ** 31)
@@ -64,6 +66,9 @@ class PipelineParams:
     # compiled rule programs (rules/compiler.py); replicated like the
     # other rule tables on sharded meshes
     programs: RuleProgramTable
+    # compiled anomaly-model weight tables (ml/compiler.py); also
+    # replicated — features ride the shard axis, weights don't
+    models: AnomalyModelTable
 
 
 @struct.dataclass
@@ -84,6 +89,11 @@ class ProcessOutputs:
     program_fired: jnp.ndarray      # bool [B]
     program_first_rule: jnp.ndarray    # int32 [B] program slot, -1 = none
     program_alert_level: jnp.ndarray   # int32 [B]
+    # anomaly-model scoring fires, also attach-row mapped (ops/anomaly.py)
+    model_fired: jnp.ndarray        # bool [B]
+    model_first: jnp.ndarray        # int32 [B] model slot, -1 = none
+    model_level: jnp.ndarray        # int32 [B] max fired level, -1 = none
+    model_score: jnp.ndarray        # f32 [B] lowest scored slot's score
     tenant_counts: jnp.ndarray      # int32 [T] events this batch per tenant
     processed: jnp.ndarray          # int32 scalar, valid events
     alerts: jnp.ndarray             # int32 scalar, alerts fired
@@ -96,15 +106,17 @@ class ProcessOutputs:
 
 
 def process_batch(params: PipelineParams, state: DeviceStateTensors,
-                  rule_state: RuleStateTensors, batch: EventBatch, *,
+                  rule_state: RuleStateTensors,
+                  model_state: ModelStateTensors, batch: EventBatch, *,
                   geofence_impl: str = "xla",
                   alert_lane_capacity: int = DEFAULT_ALERT_LANE_CAPACITY,
                   programs_enabled: bool = True,
-                  program_node_limit: int = 0
+                  program_node_limit: int = 0,
+                  models_enabled: bool = True
                   ) -> Tuple[DeviceStateTensors, RuleStateTensors,
-                             ProcessOutputs]:
-    """One fused step. Shapes static; jit/shard_map safe; donate `state`
-    and `rule_state`.
+                             ModelStateTensors, ProcessOutputs]:
+    """One fused step. Shapes static; jit/shard_map safe; donate `state`,
+    `rule_state` and `model_state`.
 
     `geofence_impl` selects the containment kernel ("xla" scan,
     "pallas" TPU kernel, "pallas_interpret" for CPU tests) — resolved by the
@@ -117,6 +129,8 @@ def process_batch(params: PipelineParams, state: DeviceStateTensors,
     rare empty<->non-empty transition, like any other shape change).
     `program_node_limit` (also static) trims the unrolled node pass to
     the slots the compiled table populates.
+    `models_enabled` (trace-time static) likewise drops the anomaly-model
+    scoring stage when the model table is empty.
     """
     D = state.num_devices
     M = state.num_measurement_slots
@@ -189,10 +203,12 @@ def process_batch(params: PipelineParams, state: DeviceStateTensors,
     # different events compose. Dropped at trace time when no programs
     # are installed.
     B = batch.device_idx.shape[0]
+    if programs_enabled or models_enabled:
+        # the observation masks and attach rows feed BOTH stateful stages
+        obs_mm, _touched, now_d, attach_row = observations_of_batch(
+            batch, M, D)
     if programs_enabled:
         with jax.named_scope("step_rule_programs"):
-            obs_mm, _touched, now_d, attach_row = observations_of_batch(
-                batch, M, D)
             # per-ROW evaluation: state gathers/scatters ride the batch's
             # device rows (attach rows are the unique writers), so program
             # evaluation costs O(batch), not O(device capacity)
@@ -210,13 +226,36 @@ def process_batch(params: PipelineParams, state: DeviceStateTensors,
                 "first_rule": jnp.full((B,), -1, jnp.int32),
                 "alert_level": jnp.full((B,), -1, jnp.int32)}
 
+    # ---- stage 3c: anomaly-model scoring (ops/anomaly.py) ------------------
+    # After the rule programs so both stateful stages read the same
+    # post-fold measurement state; fires ride the spare alert-lane meta
+    # bits, so the one-fetch-per-step budget is untouched. Dropped at
+    # trace time when no models are installed, like the programs stage.
+    if models_enabled:
+        with jax.named_scope("step_model_eval"):
+            model_state, model = eval_anomaly_models(
+                params.models, model_state,
+                dev=dev, attach=attach_row,
+                obs_row=obs_mm[dev],
+                lm_row=last_measurement[dev],
+                lmts_row=last_measurement_ts[dev],
+                tenant_row=params.tenant_idx[dev],
+                dtype_row=params.device_type_idx[dev])
+    else:
+        model = {"fired": jnp.zeros((B,), bool),
+                 "first_model": jnp.full((B,), -1, jnp.int32),
+                 "alert_level": jnp.full((B,), -1, jnp.int32),
+                 "score": jnp.zeros((B,), jnp.float32)}
+
     # ---- stage 4: stats (replaces Dropwizard meters / Kafka state topics) --
     with jax.named_scope("step_stats_compact"):
         tenant_counts = count_by_key(tenant, valid, T)
         alerts = (jnp.sum(thr["fired"], dtype=jnp.int32)
                   + jnp.sum(geo["fired"], dtype=jnp.int32)
-                  + jnp.sum(prog["fired"], dtype=jnp.int32))
-        alert_lanes = compact_alert_lanes(thr, geo, alert_lane_capacity, prog)
+                  + jnp.sum(prog["fired"], dtype=jnp.int32)
+                  + jnp.sum(model["fired"], dtype=jnp.int32))
+        alert_lanes = compact_alert_lanes(thr, geo, alert_lane_capacity,
+                                          prog, model)
 
     new_state = DeviceStateTensors(
         last_interaction=last_interaction,
@@ -232,7 +271,9 @@ def process_batch(params: PipelineParams, state: DeviceStateTensors,
         last_alert_ts=alert_ts,
         tenant_event_count=state.tenant_event_count + tenant_counts,
         tenant_alert_count=state.tenant_alert_count + count_by_key(
-            tenant, valid & (thr["fired"] | geo["fired"] | prog["fired"]),
+            tenant,
+            valid & (thr["fired"] | geo["fired"] | prog["fired"]
+                     | model["fired"]),
             T),
     )
     outputs = ProcessOutputs(
@@ -247,12 +288,16 @@ def process_batch(params: PipelineParams, state: DeviceStateTensors,
         program_fired=prog["fired"],
         program_first_rule=prog["first_rule"],
         program_alert_level=prog["alert_level"],
+        model_fired=model["fired"],
+        model_first=model["first_model"],
+        model_level=model["alert_level"],
+        model_score=model["score"],
         tenant_counts=tenant_counts,
         processed=jnp.sum(valid, dtype=jnp.int32),
         alerts=alerts,
         alert_lanes=alert_lanes,
     )
-    return new_state, rule_state, outputs
+    return new_state, rule_state, model_state, outputs
 
 
 def check_presence(state: DeviceStateTensors, registered: jnp.ndarray,

@@ -40,6 +40,7 @@ STAGES: Tuple[str, ...] = (
     "h2d",             # host: device_put submit (async; segment = submit cost)
     "dispatch",        # host: jit step call until handles returned
     "device_compute",  # device: dispatch start -> outputs ready (needs sync)
+    "model_eval",      # host: resolve anomaly-model fires from fetched lanes
     "lane_fetch",      # host: the single device_get of the alert lanes
     "materialize",     # host: decode lanes + emit alert events
 )

@@ -405,6 +405,62 @@ def register_all(router: Router, instance, server) -> None:
                   authority=SiteWhereRoles.ADMINISTER_TENANTS)
 
     # ------------------------------------------------------------------
+    # Anomaly models — on-TPU inference control plane (ml/compiler.py,
+    # ops/anomaly.py): tiny learned scorers compiled into replicated
+    # weight tables and evaluated inside the fused step. Installs are
+    # durable (ModelStore), replicated with the LWW/tombstone algebra,
+    # and carry per-model fire/eval counters read on demand from the
+    # model state.
+    # ------------------------------------------------------------------
+    def list_anomaly_models(request: Request):
+        tenant = _program_tenant(request)
+        engine = instance.pipeline_engine
+        counters = (engine.anomaly_model_counters()
+                    if engine is not None else {})
+        out = []
+        for row in instance.anomaly_models.installs_for(tenant):
+            spec = row["spec"]
+            out.append({**spec,
+                        **counters.get(spec.get("token", ""),
+                                       {"fires": 0, "evals": 0})})
+        return {"models": out}
+
+    def create_anomaly_model(request: Request):
+        tenant = _program_tenant(request)
+        return instance.install_anomaly_model(tenant, _body(request))
+
+    def get_anomaly_model(request: Request):
+        tenant = _program_tenant(request)
+        token = request.params["model"]
+        row = instance.anomaly_models.get(tenant, token)
+        if row is None:
+            raise NotFoundError(f"anomaly model '{token}' not found",
+                                ErrorCode.GENERIC)
+        engine = instance.pipeline_engine
+        counters = (engine.anomaly_model_counters()
+                    if engine is not None else {})
+        return {**row["spec"],
+                **counters.get(token, {"fires": 0, "evals": 0})}
+
+    def delete_anomaly_model(request: Request):
+        tenant = _program_tenant(request)
+        token = request.params["model"]
+        if not instance.remove_anomaly_model(tenant, token):
+            raise NotFoundError(f"anomaly model '{token}' not found",
+                                ErrorCode.GENERIC)
+        return {"token": token, "removed": True}
+
+    router.get("/api/tenants/{token}/models", list_anomaly_models,
+               authority=SiteWhereRoles.VIEW_SERVER_INFO)
+    router.post("/api/tenants/{token}/models", create_anomaly_model,
+                authority=SiteWhereRoles.ADMINISTER_TENANTS)
+    router.get("/api/tenants/{token}/models/{model}", get_anomaly_model,
+               authority=SiteWhereRoles.VIEW_SERVER_INFO)
+    router.delete("/api/tenants/{token}/models/{model}",
+                  delete_anomaly_model,
+                  authority=SiteWhereRoles.ADMINISTER_TENANTS)
+
+    # ------------------------------------------------------------------
     # Prometheus exposition + on-demand device profiling (reference:
     # Dropwizard reporters, Microservice.java:146,244-246; Jaeger spans)
     # ------------------------------------------------------------------
@@ -427,6 +483,10 @@ def register_all(router: Router, instance, server) -> None:
                 extra[f"pipeline.rule_program.fires.{ptoken}"] = c["fires"]
                 extra[f"pipeline.rule_program.suppressed.{ptoken}"] = \
                     c["suppressed"]
+            # per-model fire/eval counters (same on-demand D2H contract)
+            for mtoken, c in engine.anomaly_model_counters().items():
+                extra[f"pipeline.anomaly_model.fires.{mtoken}"] = c["fires"]
+                extra[f"pipeline.anomaly_model.evals.{mtoken}"] = c["evals"]
         hooks = getattr(instance, "cluster_hooks", None)
         if hooks is not None:
             gossip = hooks.gossip

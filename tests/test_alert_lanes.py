@@ -317,7 +317,9 @@ class TestDifferentialSharded:
                    "threshold_first_rule", "threshold_alert_level",
                    "geofence_fired", "geofence_first_rule",
                    "geofence_alert_level", "program_fired",
-                   "program_first_rule", "program_alert_level")
+                   "program_first_rule", "program_alert_level",
+                   "model_fired", "model_first", "model_level",
+                   "model_score")
         flat_out = out.replace(
             **{name: flat(np.asarray(getattr(out, name)))
                for name in per_row})

@@ -1,0 +1,817 @@
+"""On-TPU anomaly-model inference (ml/compiler.py + ops/anomaly.py).
+
+Differential contract: compiled model evaluation — scores, rising-edge
+fires, readiness gating and counter evolution — must match a pure-NumPy
+step-by-step oracle exactly, on the single-chip AND sharded engines,
+across value / ewma / rate features and mlp / autoencoder scorers,
+including checkpoint/restore parity mid-flight. Plus: the no-model,
+multi-model-per-device-type and NaN-feature cases (a NaN feature never
+fires), the alert-lane fetch budget with models active, structured 409
+validation naming the offending field, `_model` gossip redelivery
+idempotence + tombstones, and REST CRUD with live fire/eval counters.
+"""
+
+import numpy as np
+import pytest
+
+from sitewhere_tpu.model import (
+    Area, Device, DeviceAssignment, DeviceMeasurement, DeviceType,
+)
+from sitewhere_tpu.ml import AnomalyModelError
+from sitewhere_tpu.pipeline.engine import (
+    PipelineEngine, ThresholdRule, materialize_alerts_maskscan,
+)
+from sitewhere_tpu.registry import DeviceManagement, RegistryTensors
+
+_NEG = -(2 ** 31)
+_ENGINE_SEQ = iter(range(10_000))
+
+
+def _unique_name() -> str:
+    return f"models-test-{next(_ENGINE_SEQ)}"
+
+
+def _world(n_devices=12):
+    dm = DeviceManagement()
+    dtype = dm.create_device_type(DeviceType(token="t"))
+    area = dm.create_area(Area(token="area"))
+    tensors = RegistryTensors(max_devices=64, max_zones=8,
+                              max_zone_vertices=8)
+    for i in range(n_devices):
+        device = dm.create_device(Device(token=f"d{i}",
+                                         device_type_id=dtype.id))
+        dm.create_device_assignment(DeviceAssignment(
+            token=f"a{i}", device_id=device.id, area_id=area.id))
+    tensors.attach(dm, "tenant")
+    return dm, tensors
+
+
+def _engine(tensors, **kw):
+    kw.setdefault("batch_size", 32)
+    kw.setdefault("measurement_slots", 8)
+    kw.setdefault("max_tenants", 4)
+    kw.setdefault("name", _unique_name())
+    engine = PipelineEngine(tensors, **kw)
+    engine.start()
+    return engine
+
+
+# ---------------------------------------------------------------------------
+# the pure-NumPy step-by-step oracle (independent of the compiler/kernel)
+# ---------------------------------------------------------------------------
+
+def _forward(spec, xn):
+    """Reference forward pass on the TRUE (unpadded) dims, float32
+    throughout — mirrors ops/anomaly.py's padded einsum exactly because
+    padded lanes stay zero (tanh(0) = 0)."""
+    kind = spec.get("kind", "mlp")
+    h = np.asarray(xn, np.float32)
+    x0 = h.copy()
+    layers = spec.get("layers") or []
+    for i, layer in enumerate(layers):
+        w = np.asarray(layer["weights"], np.float32)
+        b = np.asarray(layer["bias"], np.float32)
+        lin = (w @ h + b).astype(np.float32)
+        last = i == len(layers) - 1
+        h = lin if (kind == "autoencoder" and last) \
+            else np.tanh(lin).astype(np.float32)
+    if kind == "autoencoder":
+        err = (h[:x0.shape[0]] - x0).astype(np.float32)
+        return np.float32(np.sum(err * err)
+                          / np.float32(max(x0.shape[0], 1)))
+    ow = np.asarray(spec["output"]["weights"], np.float32)
+    ob = np.float32(spec["output"].get("bias", 0.0))
+    z = np.float32(np.dot(ow, h) + ob)
+    return np.float32(1.0) / (np.float32(1.0) + np.exp(-z,
+                                                       dtype=np.float32))
+
+
+class ModelOracle:
+    """Reference semantics, evaluated event-list by event-list exactly
+    as ops/anomaly.py's docstring specifies — no tensor code shared with
+    the device path. float32 arithmetic where the kernel uses it."""
+
+    def __init__(self, models):
+        # models: [(slot, normalized spec)] in slot order
+        self.models = list(models)
+        self.mm = {}       # (dev, name) -> (f32 value, ts)
+        self.feat = {}     # (dev, slot, fi) -> per-feature state dict
+        self.prev = {}     # (dev, slot) -> above-threshold at last score
+        self.fires = {}    # slot -> int
+        self.evals = {}    # slot -> int
+
+    def step(self, events, tokens):
+        """{dev_token: {fired, first, level, score}} for ticked devices
+        (rising-edge fires of scored ticks, slot-ascending)."""
+        per_dev = {}
+        for ev, tok in zip(events, tokens):
+            if isinstance(ev, DeviceMeasurement):
+                per_dev.setdefault(tok, []).append(
+                    (ev.name, np.float32(ev.value), ev.event_date))
+        out = {}
+        for dev, rows in per_dev.items():
+            by_name = {}
+            for name, value, ts in rows:  # later position wins ts ties
+                cur = by_name.get(name)
+                if cur is None or ts >= cur[1]:
+                    by_name[name] = (value, ts)
+            observed = set(by_name)
+            for name, (value, ts) in by_name.items():
+                stored = self.mm.get((dev, name))
+                if stored is None or ts >= stored[1]:
+                    self.mm[(dev, name)] = (value, ts)
+            fired = []
+            levels = []
+            scored = []
+            scores = {}
+            for slot, spec in self.models:
+                score = self._score(dev, slot, spec, observed)
+                if score is None:
+                    continue
+                scored.append(slot)
+                scores[slot] = score
+                self.evals[slot] = self.evals.get(slot, 0) + 1
+                above = bool(score > np.float32(spec["threshold"]))
+                if above and not self.prev.get((dev, slot), False):
+                    fired.append(slot)
+                    levels.append(int(spec["alert_level"]))
+                    self.fires[slot] = self.fires.get(slot, 0) + 1
+                self.prev[(dev, slot)] = above
+            out[dev] = {
+                "fired": fired,
+                "first": min(fired) if fired else -1,
+                "level": max(levels) if levels else -1,
+                "score": float(scores[min(scored)]) if scored else 0.0,
+            }
+        return out
+
+    def _score(self, dev, slot, spec, observed):
+        """Advance this (dev, model)'s feature state for the tick and
+        return the f32 score, or None when the model did not score
+        (a feature not ready, or NaN)."""
+        xs = []
+        ready = True
+        for fi, f in enumerate(spec["features"]):
+            st = self.feat.setdefault((dev, slot, fi), {})
+            kind = f["feature"]
+            name = f["measurement"]
+            cur = self.mm.get((dev, name))
+            obs = name in observed
+            if kind == "ewma":
+                if obs:
+                    v = np.float32(cur[0])
+                    if st.get("cnt", 0) == 0:
+                        st["e"] = v
+                    else:
+                        a = np.float32(f["alpha"])
+                        st["e"] = np.float32(
+                            a * v + (np.float32(1.0) - a) * st["e"])
+                    st["cnt"] = st.get("cnt", 0) + 1
+                ready &= st.get("cnt", 0) > 0
+                x = st.get("e", np.float32(0.0))
+            elif kind == "rate":
+                if obs:
+                    v, ts = np.float32(cur[0]), cur[1]
+                    if st.get("cnt", 0) > 0:
+                        dt = np.float32(max(ts - st["ts"], 1))
+                        st["rate"] = np.float32(
+                            (v - st["v"]) * np.float32(1000.0) / dt)
+                    st["v"], st["ts"] = v, ts
+                    st["cnt"] = st.get("cnt", 0) + 1
+                ready &= st.get("cnt", 0) > 1
+                x = np.float32(st.get("rate", 0.0))
+            else:  # value: the post-fold last measurement IS the state
+                ready &= cur is not None
+                x = np.float32(cur[0]) if cur is not None \
+                    else np.float32(0.0)
+            scale = np.float32(1.0 / f["std"])
+            xs.append(np.float32((x - np.float32(f["mean"])) * scale))
+        if not ready or any(np.isnan(x) for x in xs):
+            return None
+        return _forward(spec, xs)
+
+
+# four models covering each feature kind + both scorer kinds; all four
+# apply to device type "t" (the multi-model-per-device-type case)
+def _models():
+    return [
+        {"token": "m-hot", "kind": "mlp", "threshold": 0.5,
+         "alert_level": "WARNING", "alert_type": "anomaly.hot",
+         "features": [{"feature": "value", "measurement": "temp",
+                       "mean": 50.0, "std": 10.0}],
+         "layers": [{"weights": [[1.0]], "bias": [0.0]}],
+         "output": {"weights": [10.0], "bias": 0.0}},
+        {"token": "m-ewma", "kind": "mlp", "threshold": 0.6,
+         "alert_level": "ERROR", "alert_type": "anomaly.ewma",
+         "features": [{"feature": "ewma", "measurement": "temp",
+                       "alpha": 0.5, "mean": 60.0, "std": 20.0}],
+         "layers": [{"weights": [[2.0]], "bias": [0.5]}],
+         "output": {"weights": [3.0], "bias": -0.5}},
+        {"token": "m-rate", "kind": "autoencoder", "threshold": 0.5,
+         "alert_level": "CRITICAL", "alert_type": "anomaly.rate",
+         "features": [{"feature": "rate", "measurement": "temp",
+                       "mean": 0.0, "std": 10.0}],
+         "layers": [{"weights": [[0.5]], "bias": [0.0]}]},
+        {"token": "m-2feat", "kind": "mlp", "threshold": 0.55,
+         "alert_level": "INFO", "alert_type": "anomaly.two",
+         "device_type_token": "t",
+         "features": [{"feature": "value", "measurement": "temp",
+                       "mean": 50.0, "std": 20.0},
+                      {"feature": "ewma", "measurement": "hum",
+                       "alpha": 0.3, "mean": 30.0, "std": 20.0}],
+         "layers": [{"weights": [[0.6, -0.4], [0.3, 0.8]],
+                     "bias": [0.1, -0.2]}],
+         "output": {"weights": [1.5, -1.0], "bias": 0.2}},
+    ]
+
+
+def _trace(t0):
+    """[(events, tokens)] per step: d1 oscillates across every model's
+    threshold, d2 never reports humidity (m-2feat stays not-ready for
+    it — the readiness gate under test). `t0` must sit near the
+    packer's epoch_base_ms so rebased int32 timestamps don't clamp."""
+    def m(name, value, ts):
+        return DeviceMeasurement(name=name, value=value, event_date=ts)
+
+    steps = []
+    d1_temp = [30.0, 80.0, 81.0, 30.0, 82.0, 83.0, 30.0, 90.0]
+    d2_temp = [55.0, 40.0, 86.0, 87.0, 55.0, 88.0, 20.0, 89.0]
+    for i, (a, b) in enumerate(zip(d1_temp, d2_temp)):
+        ts = t0 + i * 1000
+        events = [m("temp", a, ts), m("temp", b, ts + 1)]
+        tokens = ["d1", "d2"]
+        if i in (2, 5):
+            events.append(m("hum", 40.0 if i == 2 else 15.0, ts + 2))
+            tokens.append("d1")
+        steps.append((events, tokens))
+    return steps
+
+
+def _install(engine, specs):
+    for spec in specs:
+        engine.upsert_anomaly_model(dict(spec))
+
+
+def _oracle_for(engine):
+    by_slot = sorted(((e["slot"], e["spec"])
+                      for e in engine._anomaly_models.values()),
+                     key=lambda t: t[0])
+    return ModelOracle(by_slot)
+
+
+def _check_counters(engine, oracle, slot_of):
+    counters = engine.anomaly_model_counters()
+    for token, slot in slot_of.items():
+        assert counters[token]["fires"] == oracle.fires.get(slot, 0), token
+        assert counters[token]["evals"] == oracle.evals.get(slot, 0), token
+    # the trace must actually exercise every model at least once
+    assert all(counters[t]["fires"] > 0 for t in slot_of
+               if t != "m-2feat"), counters
+    assert counters["m-2feat"]["evals"] > 0, counters
+
+
+class TestDifferentialSingleChip:
+    def test_trace_matches_oracle(self):
+        _, tensors = _world()
+        engine = _engine(tensors)
+        _install(engine, _models())
+        oracle = _oracle_for(engine)
+        slot_of = {e["spec"]["token"]: e["slot"]
+                   for e in engine._anomaly_models.values()}
+        for events, tokens in _trace(engine.packer.epoch_base_ms + 10_000):
+            expect = oracle.step(events, tokens)
+            batch = engine.packer.pack_events(events, tokens)[0]
+            out = engine.submit(batch)
+            fired = np.asarray(out.model_fired).reshape(-1)
+            first = np.asarray(out.model_first).reshape(-1)
+            level = np.asarray(out.model_level).reshape(-1)
+            score = np.asarray(out.model_score).reshape(-1)
+            dev_col = np.asarray(batch.device_idx)
+            got = {}
+            for row in np.nonzero(fired)[0]:
+                token = engine.registry.devices.token_of(int(dev_col[row]))
+                got[token] = (int(first[row]), int(level[row]))
+            want = {d: (v["first"], v["level"])
+                    for d, v in expect.items() if v["fired"]}
+            assert got == want
+            # score channel: one nonzero row per ticked device (slot 0's
+            # value feature is ready from its first observation)
+            got_scores = {}
+            for row in np.nonzero(score)[0]:
+                token = engine.registry.devices.token_of(int(dev_col[row]))
+                got_scores[token] = float(score[row])
+            assert set(got_scores) == set(expect)
+            for token, v in expect.items():
+                np.testing.assert_allclose(got_scores[token], v["score"],
+                                           rtol=1e-4, atol=1e-5,
+                                           err_msg=token)
+        _check_counters(engine, oracle, slot_of)
+
+    def test_lane_materialization_matches_maskscan(self):
+        _, tensors = _world()
+        engine = _engine(tensors)
+        _install(engine, _models())
+        engine.add_threshold_rule(ThresholdRule(
+            token="thr-hot", measurement_name="temp", operator=">",
+            threshold=94.0))
+
+        def key(a):
+            return (a.device_id, a.source, a.level, a.type, a.message,
+                    a.event_date)
+
+        seen_types = set()
+        for events, tokens in _trace(engine.packer.epoch_base_ms + 10_000):
+            batch = engine.packer.pack_events(events, tokens)[0]
+            out = engine.submit(batch)
+            ref = materialize_alerts_maskscan(engine, batch, out)
+            f0 = engine.d2h_fetches
+            got = engine.materialize_alerts(batch, out)
+            assert engine.d2h_fetches - f0 == 1  # fetch budget holds
+            assert [key(a) for a in got] == [key(a) for a in ref]
+            seen_types.update(a.type for a in got)
+        # model fires actually rode the lanes, alongside rule alerts.
+        # m-2feat is absent by design: the lane meta carries the MIN
+        # fired slot per device, and in this trace slot 3's fires always
+        # coincide with slot 0's (both are temp-driven rising edges) —
+        # its fires still land in the counters (checked above).
+        assert {"anomaly.hot", "anomaly.ewma", "anomaly.rate"} \
+            <= seen_types
+        assert "anomaly.two" not in seen_types
+
+    def test_no_models_is_silent_and_budget_holds(self):
+        _, tensors = _world()
+        engine = _engine(tensors)
+        for events, tokens in _trace(engine.packer.epoch_base_ms + 10_000):
+            batch = engine.packer.pack_events(events, tokens)[0]
+            out = engine.submit(batch)
+            assert not np.asarray(out.model_fired).any()
+            assert not np.asarray(out.model_score).any()
+            f0 = engine.d2h_fetches
+            assert engine.materialize_alerts(batch, out) == []
+            assert engine.d2h_fetches - f0 == 1
+        assert engine.anomaly_model_counters() == {}
+
+    def test_nan_feature_never_fires_or_scores(self):
+        _, tensors = _world(4)
+        engine = _engine(tensors)
+        _install(engine, [_models()[0]])  # m-hot: value(temp) > 50ish
+
+        def step(value, ts):
+            batch = engine.packer.pack_events(
+                [DeviceMeasurement(name="temp", value=value,
+                                   event_date=ts)], ["d1"])[0]
+            return engine.submit(batch)
+
+        out = step(float("nan"), 1000)
+        assert not np.asarray(out.model_fired).any()
+        assert not np.asarray(out.model_score).any()
+        assert engine.anomaly_model_counters()["m-hot"] \
+            == {"fires": 0, "evals": 0}
+        # the NaN did not poison the slot: a valid hot reading fires
+        out = step(80.0, 2000)
+        assert np.asarray(out.model_fired).any()
+        assert engine.anomaly_model_counters()["m-hot"] \
+            == {"fires": 1, "evals": 1}
+
+    def test_model_replace_resets_feature_state(self):
+        """Reinstalling a model (new epoch, same slot) resets its
+        feature state and edge latch inside the step — no stale
+        suppression from the previous install."""
+        _, tensors = _world(4)
+        engine = _engine(tensors)
+        spec = _models()[0]
+        engine.upsert_anomaly_model(dict(spec))
+
+        def step(value, ts):
+            batch = engine.packer.pack_events(
+                [DeviceMeasurement(name="temp", value=value,
+                                   event_date=ts)], ["d1"])[0]
+            return engine.submit(batch)
+
+        assert np.asarray(step(80.0, 1000).model_fired).any()
+        assert not np.asarray(step(81.0, 2000).model_fired).any()
+        engine.upsert_anomaly_model(dict(spec))  # replace -> epoch bump
+        # latch reset: still-hot reads as a fresh rising edge
+        assert np.asarray(step(82.0, 3000).model_fired).any()
+
+    def test_checkpoint_mid_flight_parity(self, tmp_path):
+        """EWMA accumulators, rate state and rising-edge latches
+        checkpointed mid-trace resume on a FRESH engine and produce the
+        exact same fires/scores as the uninterrupted run."""
+        from sitewhere_tpu.persist.checkpoint import PipelineCheckpointer
+
+        cut = 3  # m-hot's latch is armed; ewma/rate state mid-window
+
+        _, tensors_a = _world()
+        engine_a = _engine(tensors_a)
+        _install(engine_a, _models())
+        steps = _trace(engine_a.packer.epoch_base_ms + 10_000)
+        for events, tokens in steps[:cut]:
+            engine_a.submit(engine_a.packer.pack_events(events, tokens)[0])
+        ckpt = PipelineCheckpointer(str(tmp_path))
+        ckpt.save(engine_a)
+
+        _, tensors_b = _world()
+        engine_b = _engine(tensors_b)
+        ckpt.restore(engine_b)
+        assert {e["spec"]["token"]
+                for e in engine_b._anomaly_models.values()} \
+            == {s["token"] for s in _models()}
+
+        for events, tokens in steps[cut:]:
+            out_a = engine_a.submit(
+                engine_a.packer.pack_events(events, tokens)[0])
+            out_b = engine_b.submit(
+                engine_b.packer.pack_events(events, tokens)[0])
+            for field in ("model_fired", "model_first", "model_level",
+                          "model_score"):
+                np.testing.assert_array_equal(
+                    np.asarray(getattr(out_a, field)),
+                    np.asarray(getattr(out_b, field)), err_msg=field)
+        ca, cb = (engine_a.anomaly_model_counters(),
+                  engine_b.anomaly_model_counters())
+        assert ca == cb
+        assert any(c["fires"] > 0 for c in ca.values())
+
+
+class TestDifferentialSharded:
+    def _engine(self, tensors, shards=4, **kw):
+        from sitewhere_tpu.parallel import ShardedPipelineEngine, make_mesh
+
+        kw.setdefault("measurement_slots", 8)
+        kw.setdefault("max_tenants", 4)
+        kw.setdefault("name", _unique_name())
+        engine = ShardedPipelineEngine(tensors, mesh=make_mesh(shards),
+                                       per_shard_batch=16, **kw)
+        engine.start()
+        return engine
+
+    def test_trace_matches_oracle(self):
+        _, tensors = _world()
+        engine = self._engine(tensors)
+        _install(engine, _models())
+        oracle = _oracle_for(engine)
+        slot_of = {e["spec"]["token"]: e["slot"]
+                   for e in engine._anomaly_models.values()}
+        for events, tokens in _trace(engine.packer.epoch_base_ms + 10_000):
+            expect = oracle.step(events, tokens)
+            batch = engine.packer.pack_events(events, tokens)[0]
+            routed, out = engine.submit(batch)
+            fired = np.asarray(out.model_fired)          # [S, B]
+            first = np.asarray(out.model_first)
+            level = np.asarray(out.model_level)
+            score = np.asarray(out.model_score)
+            dev_local = np.asarray(routed.device_idx)
+            got = {}
+            for s, row in zip(*np.nonzero(fired)):
+                gidx = int(dev_local[s, row]) * engine.n_shards + int(s)
+                token = engine.registry.devices.token_of(gidx)
+                got[token] = (int(first[s, row]), int(level[s, row]))
+            want = {d: (v["first"], v["level"])
+                    for d, v in expect.items() if v["fired"]}
+            assert got == want
+            got_scores = {}
+            for s, row in zip(*np.nonzero(score)):
+                gidx = int(dev_local[s, row]) * engine.n_shards + int(s)
+                token = engine.registry.devices.token_of(gidx)
+                got_scores[token] = float(score[s, row])
+            assert set(got_scores) == set(expect)
+            for token, v in expect.items():
+                np.testing.assert_allclose(got_scores[token], v["score"],
+                                           rtol=1e-4, atol=1e-5,
+                                           err_msg=token)
+        _check_counters(engine, oracle, slot_of)
+
+    def test_fetch_budget_with_models_active(self):
+        from sitewhere_tpu.ops.compact import ALERT_LANE_ROWS
+
+        _, tensors = _world()
+        engine = self._engine(tensors)
+        _install(engine, _models())
+        for events, tokens in _trace(engine.packer.epoch_base_ms + 10_000):
+            batch = engine.packer.pack_events(events, tokens)[0]
+            routed, out = engine.submit(batch)
+            f0, b0 = engine.d2h_fetches, engine.d2h_bytes
+            engine.materialize_alerts(routed, out)
+            assert engine.d2h_fetches - f0 == 1
+            assert (engine.d2h_bytes - b0
+                    == engine.n_shards * ALERT_LANE_ROWS
+                    * engine.alert_lane_capacity * 4)
+
+    def test_checkpoint_roundtrip_sharded_to_single(self, tmp_path):
+        """Canonical checkpoints with model state restore across engine
+        kinds (4-shard save -> single-chip resume, mid-flight): scoring
+        continues — edge latches suppress refires, counters carry on."""
+        from sitewhere_tpu.persist.checkpoint import PipelineCheckpointer
+
+        cut = 3
+        _, tensors_a = _world()
+        sharded = self._engine(tensors_a)
+        _install(sharded, _models())
+        steps = _trace(sharded.packer.epoch_base_ms + 10_000)
+        for events, tokens in steps[:cut]:
+            sharded.submit(sharded.packer.pack_events(events, tokens)[0])
+        ckpt = PipelineCheckpointer(str(tmp_path))
+        ckpt.save(sharded)
+
+        _, tensors_b = _world()
+        single = _engine(tensors_b)
+        ckpt.restore(single)
+
+        for events, tokens in steps[cut:]:
+            routed, out_a = sharded.submit(
+                sharded.packer.pack_events(events, tokens)[0])
+            batch_b = single.packer.pack_events(events, tokens)[0]
+            out_b = single.submit(batch_b)
+            # compare per-device fire sets (layouts differ)
+            fired_a = np.asarray(out_a.model_fired)
+            dev_a = np.asarray(routed.device_idx)
+            set_a = set()
+            for s, row in zip(*np.nonzero(fired_a)):
+                set_a.add(sharded.registry.devices.token_of(
+                    int(dev_a[s, row]) * sharded.n_shards + int(s)))
+            fired_b = np.asarray(out_b.model_fired).reshape(-1)
+            dev_b = np.asarray(batch_b.device_idx)
+            set_b = {single.registry.devices.token_of(int(d))
+                     for d in dev_b[np.nonzero(fired_b)[0]]}
+            assert set_a == set_b
+        assert (sharded.anomaly_model_counters()
+                == single.anomaly_model_counters())
+        assert any(c["fires"] > 0
+                   for c in single.anomaly_model_counters().values())
+
+
+class TestValidation:
+    """Structured 409s naming the offending field — never a stack
+    trace."""
+
+    def setup_method(self):
+        _, tensors = _world(4)
+        self.engine = _engine(tensors)
+
+    def _err(self, spec):
+        with pytest.raises(AnomalyModelError) as err:
+            self.engine.upsert_anomaly_model(spec)
+        assert err.value.http_status == 409
+        return str(err.value)
+
+    def _base(self, **over):
+        spec = dict(_models()[0])
+        spec.update(over)
+        return spec
+
+    def test_unknown_feature_kind_names_field(self):
+        msg = self._err(self._base(features=[
+            {"feature": "median", "measurement": "temp"}]))
+        assert "features[0].feature" in msg
+        assert "unknown feature kind" in msg
+
+    def test_nonpositive_std_names_field(self):
+        msg = self._err(self._base(features=[
+            {"feature": "value", "measurement": "temp", "std": 0.0}]))
+        assert "features[0].std" in msg
+
+    def test_layer_dim_chain_mismatch_names_layer(self):
+        msg = self._err(self._base(layers=[
+            {"weights": [[1.0, 2.0]], "bias": [0.0]}]))
+        assert "layers[0].weights" in msg
+        assert "input dim 2" in msg
+
+    def test_over_feature_bucket(self):
+        feats = [{"feature": "value", "measurement": f"m{i}"}
+                 for i in range(5)]  # default bucket is 4
+        msg = self._err(self._base(
+            features=feats,
+            layers=[{"weights": [[0.1] * 5], "bias": [0.0]}]))
+        assert "over the static bucket" in msg
+
+    def test_unknown_model_kind(self):
+        msg = self._err(self._base(kind="svm"))
+        assert "spec.kind" in msg and "unknown model kind" in msg
+
+    def test_mlp_output_arity(self):
+        msg = self._err(self._base(output={"weights": [1.0, 2.0]}))
+        assert "spec.output.weights" in msg
+
+    def test_capacity_exceeded_is_structured(self):
+        from sitewhere_tpu.errors import SiteWhereError
+
+        _, tensors = _world(4)
+        engine = _engine(tensors, max_anomaly_models=2)
+        engine.upsert_anomaly_model(self._base(token="a"))
+        engine.upsert_anomaly_model(self._base(token="b"))
+        with pytest.raises(SiteWhereError) as err:
+            engine.upsert_anomaly_model(self._base(token="c"))
+        assert err.value.http_status == 409
+
+
+class TestReplicatedApply:
+    def _instance(self, tmp_path, name):
+        from sitewhere_tpu.instance import SiteWhereInstance
+
+        inst = SiteWhereInstance(
+            instance_id=name, data_dir=str(tmp_path / name),
+            enable_pipeline=True, max_devices=64, batch_size=32,
+            measurement_slots=8)
+        inst.start()
+        return inst
+
+    def test_lww_and_tombstone_convergence(self, tmp_path):
+        inst = self._instance(tmp_path, "am-lww")
+        try:
+            norm = inst.install_anomaly_model("default",
+                                              dict(_models()[0]))
+            stamp = inst.anomaly_models.get("default", "m-hot")["stamp"]
+            # older replicated add loses
+            older = dict(norm)
+            older["alert_message"] = "stale"
+            assert not inst.apply_replicated_anomaly_model(
+                "add", "default", "m-hot",
+                {"spec": older, "stamp": stamp - 10})
+            assert inst.anomaly_models.get(
+                "default", "m-hot")["spec"].get("alert_message") != "stale"
+            # newer replicated add wins and reaches the engine
+            newer = dict(norm)
+            newer["alert_message"] = "fresh"
+            assert inst.apply_replicated_anomaly_model(
+                "add", "default", "m-hot",
+                {"spec": newer, "stamp": stamp + 10})
+            assert inst.pipeline_engine.get_anomaly_model(
+                "m-hot")["alert_message"] == "fresh"
+            # replicated remove tombstones + detaches
+            assert inst.apply_replicated_anomaly_model(
+                "remove", "default", "m-hot", stamp + 20)
+            assert inst.pipeline_engine.get_anomaly_model("m-hot") is None
+            # the tombstoned add cannot resurrect
+            assert not inst.apply_replicated_anomaly_model(
+                "add", "default", "m-hot",
+                {"spec": newer, "stamp": stamp + 15})
+        finally:
+            inst.stop()
+
+    def test_invalid_replicated_spec_is_structured_409(self, tmp_path):
+        inst = self._instance(tmp_path, "am-bad")
+        try:
+            bad = dict(_models()[0])
+            bad["token"] = "bad"
+            bad["features"] = [{"feature": "nope", "measurement": "m"}]
+            with pytest.raises(AnomalyModelError) as err:
+                inst.apply_replicated_anomaly_model(
+                    "add", "default", "bad", {"spec": bad, "stamp": 10})
+            assert err.value.http_status == 409
+            assert "features[0].feature" in str(err.value)
+            # the loser left no store state behind
+            assert inst.anomaly_models.get("default", "bad") is None
+        finally:
+            inst.stop()
+
+    def test_durable_across_restart(self, tmp_path):
+        inst = self._instance(tmp_path, "am-dur")
+        inst.install_anomaly_model("default", dict(_models()[0]))
+        inst.stop()
+        from sitewhere_tpu.instance import SiteWhereInstance
+
+        inst2 = SiteWhereInstance(
+            instance_id="am-dur", data_dir=str(tmp_path / "am-dur"),
+            enable_pipeline=True, max_devices=64, batch_size=32,
+            measurement_slots=8)
+        inst2.start()
+        try:
+            assert inst2.pipeline_engine.get_anomaly_model(
+                "m-hot") is not None
+        finally:
+            inst2.stop()
+
+
+class TestGossipModelKind:
+    """`_model` gossip payloads: redelivery idempotence, tombstones, and
+    stale-add suppression — the same algebra the registry kinds pin in
+    test_tenant_replication.py, driven through the cluster gossip's
+    `_handle` dispatch."""
+
+    class _Capture:
+        def __init__(self):
+            self.sent = []
+
+        def publish(self, topic, key, value):
+            self.sent.append(value)
+
+        def drain(self):
+            out, self.sent = self.sent, []
+            return out
+
+    def _host(self, tmp_path, name):
+        from sitewhere_tpu.instance import SiteWhereInstance
+        from sitewhere_tpu.parallel.cluster import RegistryGossip
+
+        inst = SiteWhereInstance(
+            instance_id=name, data_dir=str(tmp_path / name),
+            enable_pipeline=True, max_devices=64, batch_size=32,
+            measurement_slots=8)
+        inst.start()
+        cap = self._Capture()
+        gossip = RegistryGossip(0, {1: cap}, inst, inst.naming)
+        gossip.register_scripts(inst)
+        return inst, gossip, cap
+
+    @staticmethod
+    def _apply(gossip, payloads):
+        from sitewhere_tpu.runtime.bus import Record
+
+        gossip._handle([Record("t", 0, i, b"", p, 0)
+                        for i, p in enumerate(payloads)])
+
+    def test_redelivery_idempotence_and_tombstone(self, tmp_path):
+        inst_a, _gossip_a, cap = self._host(tmp_path, "gm-a")
+        inst_b, gossip_b, _ = self._host(tmp_path, "gm-b")
+        try:
+            inst_a.install_anomaly_model("default", dict(_models()[0]))
+            add = cap.drain()
+            assert add, "model install must gossip a _model payload"
+            self._apply(gossip_b, add)
+            assert inst_b.pipeline_engine.get_anomaly_model(
+                "m-hot") is not None
+            stamp0 = inst_b.anomaly_models.get("default", "m-hot")["stamp"]
+            # duplicate redelivery: a no-op, stamp unchanged
+            self._apply(gossip_b, add + add)
+            assert inst_b.anomaly_models.get(
+                "default", "m-hot")["stamp"] == stamp0
+            # removal tombstones on B...
+            inst_a.remove_anomaly_model("default", "m-hot")
+            remove = cap.drain()
+            assert remove
+            self._apply(gossip_b, remove)
+            assert inst_b.pipeline_engine.get_anomaly_model("m-hot") is None
+            # ...and the stale add redelivered AFTER cannot resurrect
+            self._apply(gossip_b, add)
+            assert inst_b.pipeline_engine.get_anomaly_model("m-hot") is None
+            # redelivered tombstone stays a no-op
+            self._apply(gossip_b, remove + add)
+            assert inst_b.anomaly_models.get("default", "m-hot") is None
+        finally:
+            inst_a.stop()
+            inst_b.stop()
+
+
+class TestRest:
+    @pytest.fixture()
+    def server(self, tmp_path):
+        from sitewhere_tpu.instance import SiteWhereInstance
+        from sitewhere_tpu.web import RestServer
+
+        instance = SiteWhereInstance(
+            instance_id="am-web", enable_pipeline=True, max_devices=64,
+            batch_size=32, measurement_slots=8)
+        instance.start()
+        rest = RestServer(instance, port=0)
+        rest.start()
+        yield rest
+        rest.stop()
+        instance.stop()
+
+    @pytest.fixture()
+    def client(self, server):
+        from sitewhere_tpu.client import SiteWhereClient
+
+        c = SiteWhereClient(server.base_url)
+        c.authenticate("admin", "password")
+        return c
+
+    def test_crud_round_trip_with_counters(self, client):
+        created = client.post("/api/tenants/default/models",
+                              dict(_models()[0]))
+        assert created["token"] == "m-hot"
+        assert created["tenant_token"] == "default"
+        listed = client.get("/api/tenants/default/models")
+        assert [m["token"] for m in listed["models"]] == ["m-hot"]
+        assert listed["models"][0]["fires"] == 0
+        assert listed["models"][0]["evals"] == 0
+        got = client.get("/api/tenants/default/models/m-hot")
+        assert got["kind"] == "mlp"
+        assert got["fires"] == 0
+        assert client.delete(
+            "/api/tenants/default/models/m-hot")["removed"]
+        from sitewhere_tpu.client import SiteWhereClientError
+
+        with pytest.raises(SiteWhereClientError) as err:
+            client.get("/api/tenants/default/models/m-hot")
+        assert err.value.status == 404
+
+    def test_invalid_spec_is_409_naming_field(self, client):
+        from sitewhere_tpu.client import SiteWhereClientError
+
+        bad = dict(_models()[0])
+        bad["features"] = [{"feature": "zigzag", "measurement": "m"}]
+        with pytest.raises(SiteWhereClientError) as err:
+            client.post("/api/tenants/default/models", bad)
+        assert err.value.status == 409
+        assert "features[0].feature" in str(err.value)
+
+    def test_duplicate_token_409(self, client):
+        from sitewhere_tpu.client import SiteWhereClientError
+
+        client.post("/api/tenants/default/models", dict(_models()[0]))
+        with pytest.raises(SiteWhereClientError) as err:
+            client.post("/api/tenants/default/models", dict(_models()[0]))
+        assert err.value.status == 409
+        client.delete("/api/tenants/default/models/m-hot")
