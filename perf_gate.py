@@ -160,6 +160,7 @@ MAX_OBSERVABILITY_OVERHEAD_PCT = 1.0
 # crossing set and the sum must stay under 0.5% of the synchronous step
 # wall. Same small-scale advisory policy as observability_overhead.
 MAX_FAULT_OVERHEAD_PCT = 0.5
+MAX_FENCING_OVERHEAD_PCT = 1.0
 
 # Trial-spread bounds: full scale judges the accelerator-scale claim; the
 # BENCH_SCALE=small smoke still EVALUATES the check (bench's sections now
@@ -462,6 +463,25 @@ def self_consistency(bench: Dict) -> Dict:
                     "steps make the ratio noise — the bound gates at "
                     "full scale)")
             checks["fault_injection_overhead"] = entry
+    # Fencing overhead: the steady-state failover-plane crossings
+    # (inactive replay-barrier check + per-origin fence admit + lease
+    # renewal) must stay under 1% of the step wall (full scale; advisory
+    # on the cpu smoke for the same sub-ms-step reason).
+    fe = bench.get("fencing")
+    if isinstance(fe, dict):
+        fe_pct = fe.get("disarmed_overhead_pct_of_step")
+        if isinstance(fe_pct, (int, float)):
+            fe_ok = fe_pct < MAX_FENCING_OVERHEAD_PCT
+            entry = {
+                "ok": fe_ok or small,
+                "disarmed_overhead_pct_of_step": fe_pct,
+                "max_pct": MAX_FENCING_OVERHEAD_PCT}
+            if small and not fe_ok:
+                entry["advisory"] = (
+                    "over bound on the cpu smoke host (advisory; sub-ms "
+                    "steps make the ratio noise — the bound gates at "
+                    "full scale)")
+            checks["fencing_overhead"] = entry
     # Spread judged against the steady-state windows at every scale; the
     # BENCH_SCALE=small smoke gets the wider bound (sub-millisecond CPU
     # section timings ride scheduler noise on shared CI hosts).

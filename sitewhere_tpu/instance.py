@@ -72,6 +72,13 @@ class SiteWhereInstance(LifecycleComponent):
         self.cluster_hooks = None
         self.naming = TopicNaming(instance=instance_id)
         self.metrics = GLOBAL_METRICS
+        # recovery epoch (runtime/recovery.py): minted once per boot,
+        # durable under data_dir — stamps checkpoint manifests, gossip/
+        # provisioning envelopes, and busnet RPCs so anything this
+        # incarnation wrote can be fenced after a takeover, and a
+        # restarted host always comes back above its fenced floor
+        from sitewhere_tpu.runtime.recovery import mint_epoch
+        self.recovery_epoch = mint_epoch(data_dir)
 
         bus_dir = os.path.join(data_dir, "bus") if data_dir else None
         log_dir = os.path.join(data_dir, "events") if data_dir else None
@@ -251,6 +258,10 @@ class SiteWhereInstance(LifecycleComponent):
             self.checkpoint_manager = InstanceCheckpointManager(
                 self, os.path.join(data_dir, "checkpoints"),
                 interval_s=checkpoint_interval_s)
+            # manifests carry this boot's epoch; a zombie writer (taken
+            # over elsewhere) is refused by the stale-save fence
+            self.checkpoint_manager.checkpointer.recovery_epoch = \
+                self.recovery_epoch
 
         # scripts load from disk FIRST so the checkpoint restore's
         # last-writer-wins apply sees the local copies (and tenant
@@ -627,10 +638,26 @@ class SiteWhereInstance(LifecycleComponent):
         from sitewhere_tpu.sources.manager import GLOBAL_ADMISSION
         if GLOBAL_ADMISSION.enabled:
             out["admission"] = GLOBAL_ADMISSION.report()
+        # failover plane (runtime/recovery.py): this boot's epoch, the
+        # replay barrier's remaining suppression budget, and — with a
+        # cluster — lease/takeover state from the monitor
+        from sitewhere_tpu.runtime.recovery import GLOBAL_REPLAY_BARRIER
+        recovery: Dict = {
+            "epoch": getattr(self, "recovery_epoch", 0),
+            "replay_barrier_active": GLOBAL_REPLAY_BARRIER.active(),
+            "replay_suppressed_effects": GLOBAL_REPLAY_BARRIER.suppressed,
+        }
+        if self.checkpoint_manager is not None:
+            recovery["last_restore_epoch"] = \
+                self.checkpoint_manager.checkpointer.last_restore_epoch
+        out["recovery"] = recovery
         if self.cluster_hooks is not None:
             # multi-host deployment: per-process heartbeat states with
             # liveness (reference: TopologyStateAggregator.java)
             out["processes"] = self.cluster_hooks.processes()
             out["process_id"] = self.cluster_hooks.process_id
             out["degraded_peers"] = list(self.cluster_hooks.degraded)
+            monitor = getattr(self.cluster_hooks, "takeover_monitor", None)
+            if monitor is not None:
+                recovery.update(monitor.snapshot())
         return out

@@ -56,6 +56,7 @@ from sitewhere_tpu.model.tenant import Tenant
 from sitewhere_tpu.model.user import GrantedAuthority, User
 from sitewhere_tpu.runtime.bus import ConsumerHost, Record, TopicNaming
 from sitewhere_tpu.runtime.metrics import GLOBAL_METRICS
+from sitewhere_tpu.runtime.recovery import EpochFence
 
 LOGGER = logging.getLogger("sitewhere.provisioning")
 
@@ -249,6 +250,15 @@ class ProvisioningReplicator:
         self.conflicts = 0
         self.publish_errors = 0
         self.parked_rows = 0
+        # recovery-epoch fencing (runtime/recovery.py): every envelope is
+        # stamped with this host's origin identity + current epoch, and
+        # the apply side keeps per-origin floors — a fenced (taken-over)
+        # peer's stale envelopes are rejected instead of resurrecting
+        # pre-takeover provisioning state. Epochs only compare within one
+        # origin; envelopes without a stamp (older peers) always admit.
+        self.origin = f"proc:{process_id}"
+        self.epoch = 0
+        self._fence = EpochFence()
         self._applying = threading.local()
         # (kind, token) -> delete stamp; seeded from the checkpoint at
         # boot restore (apply_provisioning) so replayed stale creates
@@ -296,22 +306,22 @@ class ProvisioningReplicator:
                 key = (kind, token)
                 self._tombstones[key] = max(self._tombstones.get(key, 0),
                                             stamp)
-                payload = msgpack.packb(
+                payload = self._envelope(
                     {"kind": kind, "op": "delete", "token": token,
-                     "stamp": stamp}, use_bin_type=True)
+                     "stamp": stamp})
                 if kind == "tenant":
                     # the local host parks its own in-flight rows; each
                     # peer parks its own on apply
                     self._park_inflight(token)
             elif kind == "authority":
-                payload = msgpack.packb(
+                payload = self._envelope(
                     {"kind": kind, "op": op, "entity": to_jsonable(entity),
-                     "stamp": now_ms()}, use_bin_type=True)
+                     "stamp": now_ms()})
             else:
                 self._stamp_live_entity(kind, entity)
-                payload = msgpack.packb(
+                payload = self._envelope(
                     {"kind": kind, "op": op,
-                     "entity": to_jsonable(entity)}, use_bin_type=True)
+                     "entity": to_jsonable(entity)})
         except Exception:
             LOGGER.exception("provisioning encode failed (%s %s)", kind, op)
             return
@@ -339,6 +349,21 @@ class ProvisioningReplicator:
             except Exception:
                 LOGGER.exception("could not persist resurrection stamp "
                                  "for %s %r", kind, entity.token)
+
+    def _envelope(self, body: Dict) -> bytes:
+        body["origin"] = self.origin
+        body["epoch"] = int(self.epoch)
+        return msgpack.packb(body, use_bin_type=True)
+
+    def set_epoch(self, epoch: int) -> None:
+        """Adopt the instance's minted recovery epoch (instance boot /
+        takeover re-mint); outgoing envelopes carry it from here on."""
+        self.epoch = int(epoch)
+
+    def fence(self, origin: str, epoch: int) -> int:
+        """Raise the apply-side floor for `origin` (takeover broadcast):
+        envelopes it stamped below `epoch` are rejected from now on."""
+        return self._fence.fence(str(origin), int(epoch))
 
     def _publish(self, key: bytes, payload: bytes) -> None:
         from sitewhere_tpu.runtime.busnet import BusNetError
@@ -371,6 +396,16 @@ class ProvisioningReplicator:
             self._applying.active = False
 
     def _apply(self, data: Dict) -> None:
+        origin = data.get("origin")
+        if origin is not None and not self._fence.admit(
+                str(origin), int(data.get("epoch", 0))):
+            # stale-epoch envelope from a fenced (taken-over) writer:
+            # admit() already counted it on `fencing.rejected`
+            LOGGER.warning(
+                "rejected stale provisioning envelope from %s "
+                "(epoch %s < floor %d)", origin, data.get("epoch"),
+                self._fence.floor(str(origin)))
+            return
         kind = data.get("kind")
         if kind == "authority":
             self._apply_authority(data)
@@ -467,4 +502,7 @@ class ProvisioningReplicator:
             "publishErrors": self.publish_errors,
             "parkedRows": self.parked_rows,
             "tombstones": len(self._tombstones),
+            "origin": self.origin,
+            "epoch": self.epoch,
+            "fencedOrigins": self._fence.snapshot(),
         }

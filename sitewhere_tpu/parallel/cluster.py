@@ -104,6 +104,9 @@ from sitewhere_tpu.parallel.engine import ShardedPipelineEngine
 from sitewhere_tpu.parallel.mesh import SHARD_AXIS
 from sitewhere_tpu.runtime.bus import ConsumerHost, Record, TopicNaming
 from sitewhere_tpu.runtime.busnet import BusClient, BusNetError
+from sitewhere_tpu.runtime.metrics import GLOBAL_METRICS
+from sitewhere_tpu.runtime.recovery import (
+    EpochFence, LeaseTable, elect_successor)
 
 LOGGER = logging.getLogger("sitewhere.cluster")
 
@@ -852,6 +855,14 @@ class RegistryGossip:
         self.applied = 0
         self.conflicts = 0
         self.publish_errors = 0
+        # recovery-epoch fencing (runtime/recovery.py): outgoing gossip
+        # carries this host's origin identity + epoch; the apply side
+        # keeps per-origin floors so a fenced (taken-over) peer's stale
+        # envelopes cannot resurrect pre-takeover registry state.
+        # Unstamped envelopes (older peers) always admit.
+        self.origin = f"proc:{process_id}"
+        self.epoch = 0
+        self._fence = EpochFence()
         self._applying = threading.local()
         self._registries: Dict[str, object] = {}
         # (tenant, kind, token) -> delete stamp; in-memory (a restarted
@@ -924,10 +935,8 @@ class RegistryGossip:
                 key = (tenant, kind, token)
                 self._tombstones[key] = max(self._tombstones.get(key, 0),
                                             stamp)
-                payload = msgpack.packb(
-                    {"tenant": tenant, "kind": kind, "op": "delete",
-                     "token": token, "stamp": stamp},
-                    use_bin_type=True)
+                payload = {"tenant": tenant, "kind": kind, "op": "delete",
+                           "token": token, "stamp": stamp}
             else:
                 refs = {}
                 for field, coll_name in _GOSSIP_REFS.get(kind, []):
@@ -936,16 +945,26 @@ class RegistryGossip:
                         ref = getattr(registry, coll_name).get(ref_id)
                         if ref is not None:
                             refs[field] = ref.token
-                payload = msgpack.packb(
-                    {"tenant": tenant, "kind": kind, "op": op,
-                     "entity": to_jsonable(entity), "refs": refs},
-                    use_bin_type=True)
+                payload = {"tenant": tenant, "kind": kind, "op": op,
+                           "entity": to_jsonable(entity), "refs": refs}
         except Exception:
             LOGGER.exception("registry gossip encode failed (%s)", kind)
             return
         self._publish(getattr(entity, "token", "").encode(), payload)
 
-    def _publish(self, key: bytes, payload: bytes) -> None:
+    def set_epoch(self, epoch: int) -> None:
+        """Adopt the instance's minted recovery epoch; outgoing gossip
+        carries it from here on."""
+        self.epoch = int(epoch)
+
+    def fence(self, origin: str, epoch: int) -> int:
+        """Raise the apply-side floor for `origin` (takeover broadcast)."""
+        return self._fence.fence(str(origin), int(epoch))
+
+    def _publish(self, key: bytes, data: Dict) -> None:
+        data["origin"] = self.origin
+        data["epoch"] = int(self.epoch)
+        payload = msgpack.packb(data, use_bin_type=True)
         for pid, client in self.peers.items():
             try:
                 client.publish(self.topic, key, payload)
@@ -977,8 +996,7 @@ class RegistryGossip:
             token = payload.token
             data = {"kind": "_rule", "op": "add",
                     "rule": rule_to_dict(kind, payload)}
-        self._publish(token.encode(),
-                      msgpack.packb(data, use_bin_type=True))
+        self._publish(token.encode(), data)
 
     def _apply_rule(self, data: Dict) -> None:
         engine = self.instance.pipeline_engine
@@ -1025,8 +1043,7 @@ class RegistryGossip:
             return
         data = {"kind": "_script", "op": op, "scope": scope,
                 "scriptId": script_id, "payload": payload}
-        self._publish(f"script:{scope}:{script_id}".encode(),
-                      msgpack.packb(data, use_bin_type=True))
+        self._publish(f"script:{scope}:{script_id}".encode(), data)
 
     def _on_scripted_rule_mutation(self, op: str, tenant: str, token: str,
                                    payload) -> None:
@@ -1034,8 +1051,7 @@ class RegistryGossip:
             return
         data = {"kind": "_scripted_rule", "op": op, "tenant": tenant,
                 "token": token, "payload": payload}
-        self._publish(token.encode(),
-                      msgpack.packb(data, use_bin_type=True))
+        self._publish(token.encode(), data)
 
     def _apply_script(self, data: Dict) -> None:
         scripts = self.instance.script_manager
@@ -1060,8 +1076,7 @@ class RegistryGossip:
             return
         data = {"kind": "_rule_program", "op": op, "tenant": tenant,
                 "token": token, "payload": payload}
-        self._publish(token.encode(),
-                      msgpack.packb(data, use_bin_type=True))
+        self._publish(token.encode(), data)
 
     def _apply_rule_program(self, data: Dict) -> None:
         # an invalid spec raises the structured RuleProgramError (409,
@@ -1080,8 +1095,7 @@ class RegistryGossip:
             return
         data = {"kind": "_model", "op": op, "tenant": tenant,
                 "token": token, "payload": payload}
-        self._publish(token.encode(),
-                      msgpack.packb(data, use_bin_type=True))
+        self._publish(token.encode(), data)
 
     def _apply_anomaly_model(self, data: Dict) -> None:
         # invalid specs raise the structured AnomalyModelError (409,
@@ -1155,6 +1169,16 @@ class RegistryGossip:
             DuplicateTokenError, ErrorCode, NotFoundError, SiteWhereError)
         from sitewhere_tpu.web.marshal import entity_from_payload
 
+        origin = data.get("origin")
+        if origin is not None and not self._fence.admit(
+                str(origin), int(data.get("epoch", 0))):
+            # stale-epoch gossip from a fenced (taken-over) writer:
+            # admit() already counted it on `fencing.rejected`
+            LOGGER.warning(
+                "rejected stale registry gossip from %s (epoch %s < "
+                "floor %d)", origin, data.get("epoch"),
+                self._fence.floor(str(origin)))
+            return
         kind = data.get("kind")
         if kind == "_rule":
             self._apply_rule(data)
@@ -1314,6 +1338,235 @@ class RegistryGossip:
 
 
 # ---------------------------------------------------------------------------
+# leased ownership + automated takeover
+# ---------------------------------------------------------------------------
+
+class TakeoverMonitor:
+    """Leased ownership + automated takeover (runtime/recovery.py).
+
+    Every host leases its own shard group and renews it through the
+    existing heartbeat edges — each ProcessStateReporter state carries
+    `{"leases": {resource: epoch}}`, so the lease protocol adds no new
+    transport. Every host mirrors the leases it hears into a local
+    LeaseTable (a stale heartbeat does NOT refresh, so the mirrored TTL
+    lapses exactly when the heartbeats stop).
+
+    When a peer's lease lapses — or its heartbeat reports a `failed`
+    health ladder — every surviving host computes the same deterministic
+    successor (lowest healthy rank, elect_successor); ONLY the successor
+    acts. It fences the failed owner's epoch (local appliers via
+    `fence_hooks`, cluster-wide via the busnet `fence` broadcast — from
+    then on the zombie's stale-epoch writes are rejected and counted),
+    steals the lease at the fenced epoch, runs `on_takeover` (checkpoint
+    restore + retained-log replay on the wired instance), and counts
+    `takeover.count`. No operator in the loop.
+
+    When the fenced owner comes back (a restart mints epoch = floor, so
+    its traffic re-admits automatically), the successor releases the
+    stolen lease and the owner's own renewal takes over again.
+
+    `check_once()` is the whole state machine; the background thread
+    just calls it on a cadence. Deterministic tests drive it directly
+    with an injectable clock and peer-state snapshots."""
+
+    def __init__(self, process_id: int,
+                 peer_states: Callable[[], Dict[str, Dict]],
+                 epoch_of: Callable[[], int],
+                 on_takeover: Optional[Callable[[str, Dict], None]] = None,
+                 fence_hooks: Optional[List[Callable[[str, int], None]]]
+                 = None,
+                 fence_broadcast: Optional[Callable[[str, int], None]]
+                 = None,
+                 leases: Optional[LeaseTable] = None,
+                 ttl_s: float = 6.0, check_interval_s: float = 1.0,
+                 clock: Callable[[], float] = time.monotonic):
+        self.process_id = int(process_id)
+        self.owner = f"proc:{process_id}"
+        self.resource = f"shard-group:{process_id}"
+        self.peer_states = peer_states
+        self.epoch_of = epoch_of
+        self.on_takeover = on_takeover
+        self.fence_hooks = list(fence_hooks or [])
+        self.fence_broadcast = fence_broadcast
+        self.ttl_s = float(ttl_s)
+        self.check_interval_s = float(check_interval_s)
+        self._clock = clock
+        self.leases = leases if leases is not None else LeaseTable(
+            clock=clock)
+        self.taken: set = set()  # resources this host took over and holds
+        self.events: deque = deque(maxlen=32)
+        self._takeovers = GLOBAL_METRICS.counter("takeover.count")
+        self._local_takeovers = 0  # this monitor's share of the counter
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run,
+                                        name="takeover-monitor",
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.check_interval_s):
+            try:
+                self.check_once()
+            except Exception:
+                LOGGER.exception("takeover check failed")
+
+    # -- heartbeat ride-along ---------------------------------------------
+    def lease_advertisement(self) -> Dict[str, int]:
+        """The `leases` block this host's heartbeat carries: its own
+        shard group plus anything it took over, at its current epoch."""
+        epoch = int(self.epoch_of())
+        out = {self.resource: epoch}
+        for resource in list(self.taken):
+            out[resource] = epoch
+        return out
+
+    # -- the state machine -------------------------------------------------
+    def check_once(self) -> List[Dict]:
+        """One tick: renew own lease, mirror peers' leases, detect lapses
+        and failed-health owners, take over as the deterministic
+        successor. Returns the takeover events performed this tick."""
+        now = self._clock()
+        epoch = int(self.epoch_of())
+        if not self.leases.renew(self.resource, self.owner, epoch,
+                                 now=now):
+            self.leases.acquire(self.resource, self.owner, epoch,
+                                self.ttl_s, now=now)
+        states = dict(self.peer_states() or {})
+        healthy: Dict[int, bool] = {self.process_id: True}
+        owner_failed: Dict[str, bool] = {}
+        for pid, state in states.items():
+            try:
+                rank = int(state.get("process_id", pid))
+            except (TypeError, ValueError):
+                continue
+            if rank == self.process_id:
+                continue
+            stale = bool(state.get("stale"))
+            failed = state.get("health") == "failed"
+            healthy[rank] = not stale and not failed
+            owner_failed[f"proc:{rank}"] = failed
+            if stale or failed:
+                # a stale heartbeat must not refresh leases, and a host
+                # reporting `failed` gets no mirror/handback either — a
+                # zombie advertising its old lease would otherwise flap
+                # ownership back and forth every tick
+                continue
+            advertised = state.get("leases") or {}
+            for resource, lease_epoch in advertised.items():
+                owner = f"proc:{rank}"
+                if resource in self.taken:
+                    # the fenced owner is back and advertising again:
+                    # hand the lease back (its restart minted an epoch
+                    # at the fenced floor, so its writes already
+                    # re-admit) and let its renewal take over
+                    self.leases.release(resource, self.owner)
+                    self.taken.discard(resource)
+                    self.events.append({
+                        "resource": resource, "op": "handback",
+                        "to": owner, "at_ms": int(time.time() * 1000)})
+                    LOGGER.info("lease %s handed back to %s", resource,
+                                owner)
+                if not self.leases.renew(resource, owner,
+                                         int(lease_epoch), now=now):
+                    self.leases.acquire(resource, owner, int(lease_epoch),
+                                        self.ttl_s, now=now)
+        performed: List[Dict] = []
+        for resource, info in self.leases.snapshot(now=now).items():
+            owner = info["owner"]
+            if owner == self.owner:
+                continue
+            lapsed = info["expired"] or owner_failed.get(owner, False)
+            if not lapsed:
+                continue
+            try:
+                owner_rank = int(owner.rpartition(":")[2])
+            except ValueError:
+                owner_rank = None
+            successor = elect_successor(healthy, exclude=owner_rank)
+            if successor != self.process_id:
+                continue
+            performed.append(
+                self._take_over(resource, owner, int(info["epoch"]),
+                                now=now))
+        return performed
+
+    def _take_over(self, resource: str, owner: str, last_epoch: int,
+                   now: float) -> Dict:
+        fence_epoch = last_epoch + 1
+        for hook in self.fence_hooks:
+            try:
+                hook(owner, fence_epoch)
+            except Exception:
+                LOGGER.exception("fence hook failed for %s", owner)
+        if self.fence_broadcast is not None:
+            try:
+                self.fence_broadcast(owner, fence_epoch)
+            except Exception:
+                LOGGER.exception("fence broadcast failed for %s", owner)
+        # the steal and the fence are one decision: the lease is taken
+        # at the FENCED epoch, so even a still-live lease record yields
+        # (LeaseTable.acquire's strictly-higher-epoch rule)
+        self.leases.acquire(resource, self.owner, fence_epoch, self.ttl_s,
+                            now=now)
+        self.taken.add(resource)
+        self._takeovers.inc()
+        self._local_takeovers += 1
+        event = {"resource": resource, "op": "takeover", "from": owner,
+                 "to": self.owner, "fenced_epoch": fence_epoch,
+                 "at_ms": int(time.time() * 1000)}
+        self.events.append(event)
+        LOGGER.warning("took over %s from %s (fenced at epoch %d)",
+                       resource, owner, fence_epoch)
+        if self.on_takeover is not None:
+            try:
+                self.on_takeover(resource, event)
+            except Exception:
+                LOGGER.exception("takeover callback failed for %s",
+                                 resource)
+        return event
+
+    def snapshot(self) -> Dict:
+        return {
+            "leases": self.leases.snapshot(),
+            "taken_over": sorted(self.taken),
+            "takeovers": self._local_takeovers,
+            "takeover_events": list(self.events),
+        }
+
+
+def _annotate_recovery_state(cluster, state: Dict) -> None:
+    """Failover fields every heartbeat carries (runtime/recovery.py):
+    the host's recovery epoch + fence-key origin, its lease
+    advertisement (peers mirror these into their lease tables), and the
+    engine health-ladder state (a `failed` report triggers takeover
+    without waiting for the heartbeat TTL to lapse)."""
+    epoch = int(getattr(cluster.instance, "recovery_epoch", 0))
+    state["epoch"] = epoch
+    state["origin"] = f"proc:{cluster.process_id}"
+    monitor = getattr(cluster, "takeover_monitor", None)
+    if monitor is not None:
+        state["leases"] = monitor.lease_advertisement()
+    else:
+        state["leases"] = {f"shard-group:{cluster.process_id}": epoch}
+    health = getattr(cluster.instance.pipeline_engine, "health", None)
+    if health is not None:
+        state["health"] = health.state
+
+
+# ---------------------------------------------------------------------------
 # composition root: one cluster host
 # ---------------------------------------------------------------------------
 
@@ -1399,6 +1652,18 @@ class ClusterService:
         self.provisioning = (ProvisioningReplicator(
             process_id, self.peers, instance, naming)
             if registry_gossip else None)
+        # epoch stamping (runtime/recovery.py): the SPMD gang restarts as
+        # a unit, so there is no takeover monitor here — but stamping
+        # gossip/provisioning envelopes and busnet RPCs means a zombie
+        # from BEFORE the gang restart (a host the supervisor failed to
+        # kill) is fenced out once any peer raises its floor.
+        epoch = int(getattr(instance, "recovery_epoch", 0))
+        if self.gossip is not None:
+            self.gossip.set_epoch(epoch)
+        if self.provisioning is not None:
+            self.provisioning.set_epoch(epoch)
+        for client in self.peers.values():
+            client.set_epoch(f"proc:{process_id}", epoch)
         self.aggregator = TopologyAggregator(
             instance.bus, naming, stale_after_s=stale_after_s)
         expected_peers = [p for p in range(num_processes)
@@ -1510,6 +1775,7 @@ class ClusterService:
         if self.provisioning is not None:
             state["provisioning_published"] = self.provisioning.published
             state["provisioning_applied"] = self.provisioning.applied
+        _annotate_recovery_state(self, state)
         return state
 
     def _on_fatal(self, exc: BaseException) -> None:
@@ -1643,10 +1909,29 @@ class ControlPlaneCluster:
             build_state=self._build_state, interval_s=heartbeat_s)
         self.aggregator = TopologyAggregator(
             instance.bus, naming, stale_after_s=stale_after_s)
+        # epoch-fenced failover (runtime/recovery.py): stamp this host's
+        # recovery epoch into every gossip/provisioning envelope and
+        # busnet RPC, and run the lease/takeover state machine over the
+        # heartbeat topology. The lease TTL tracks the staleness window
+        # so a lapse and a stale heartbeat mean the same thing.
+        epoch = int(getattr(instance, "recovery_epoch", 0))
+        self.gossip.set_epoch(epoch)
+        self.provisioning.set_epoch(epoch)
+        for client in self.peers.values():
+            client.set_epoch(f"proc:{process_id}", epoch)
+        self.takeover_monitor = TakeoverMonitor(
+            process_id,
+            peer_states=self.aggregator.snapshot,
+            epoch_of=lambda: int(getattr(self.instance,
+                                         "recovery_epoch", 0)),
+            on_takeover=self._perform_takeover,
+            fence_hooks=[self.gossip.fence, self.provisioning.fence],
+            fence_broadcast=self._broadcast_fence,
+            ttl_s=stale_after_s, check_interval_s=heartbeat_s)
         instance.cluster_hooks = self
 
     def _build_state(self) -> Dict:
-        return {
+        state = {
             "instance_id": self.instance.instance_id,
             "status": self.instance.status.name,
             "mode": "control-plane",
@@ -1655,6 +1940,33 @@ class ControlPlaneCluster:
             "provisioning_published": self.provisioning.published,
             "provisioning_applied": self.provisioning.applied,
         }
+        _annotate_recovery_state(self, state)
+        return state
+
+    def _broadcast_fence(self, origin: str, epoch: int) -> None:
+        """Raise the fence floor for `origin` on every reachable peer —
+        the cluster-wide half of a takeover (local appliers are fenced
+        via fence_hooks). Unreachable peers are skipped: they learn the
+        floor from admitted successor traffic (EpochFence.observe)."""
+        for pid, client in self.peers.items():
+            try:
+                client.fence(origin, epoch)
+            except BusNetError:
+                LOGGER.warning("fence broadcast to process %d failed "
+                               "(will learn floor from traffic)", pid)
+
+    def _perform_takeover(self, resource: str, event: Dict) -> None:
+        """Successor-side recovery: restore the last-good checkpoint and
+        replay the retained log past its saved offsets (the replay
+        barrier keeps the replayed records' effects suppressed). Traffic
+        admits as soon as this returns — no operator action."""
+        manager = getattr(self.instance, "checkpoint_manager", None)
+        if manager is None:
+            return
+        try:
+            manager.restore_on_boot()
+        except Exception:
+            LOGGER.exception("takeover restore failed for %s", resource)
 
     @property
     def bus_port(self) -> int:
@@ -1667,8 +1979,10 @@ class ControlPlaneCluster:
         self.gossip.start()
         self.provisioning.start()
         self.reporter.start()
+        self.takeover_monitor.start()
 
     def stop(self) -> None:
+        self.takeover_monitor.stop()
         self.reporter.stop()
         self.provisioning.stop()
         self.gossip.stop()

@@ -463,6 +463,11 @@ class PipelineCheckpointer:
         import threading
 
         self._save_lock = threading.Lock()
+        # recovery epoch of the process that owns this checkpointer;
+        # stamped into every manifest so a later incarnation (or a
+        # takeover successor) can fence a zombie writer's stale saves
+        self.recovery_epoch = 0
+        self.last_restore_epoch: Optional[int] = None
         os.makedirs(directory, exist_ok=True)
 
     # -- save --------------------------------------------------------------
@@ -487,6 +492,7 @@ class PipelineCheckpointer:
 
     def _save_locked(self, engine, consumer_groups: Optional[List],
                      extra_manifest: Optional[Dict] = None) -> str:
+        self._fence_stale_save()
         captured_offsets = {
             f"{g.topic.name}@{g.group_id}": list(g.committed)
             for g in consumer_groups or []
@@ -597,12 +603,40 @@ class PipelineCheckpointer:
             "anomaly_models": (engine.anomaly_model_manifest()
                                if hasattr(engine, "anomaly_model_manifest")
                                else []),
+            # fencing stamp: a successor that took over this shard group
+            # minted a higher epoch; its checkpoints outrank ours and
+            # _fence_stale_save refuses to let a zombie clobber them
+            "recovery_epoch": int(self.recovery_epoch),
             **(extra_manifest or {}),
             **layout,
         }
         final = _write_checkpoint_dir(self.directory, arrays, manifest)
         self._gc()
         return final
+
+    def _fence_stale_save(self) -> None:
+        """Refuse to write a checkpoint below the newest on-disk epoch.
+
+        After a takeover the successor restores from this directory and
+        saves with a higher recovery_epoch; a paused-then-resumed old
+        owner (zombie) that still holds a checkpointer must not promote
+        a snapshot of pre-takeover state over the successor's."""
+        latest = self.latest()
+        if latest is None:
+            return
+        try:
+            with open(os.path.join(latest, "manifest.json"),
+                      encoding="utf-8") as fh:
+                disk_epoch = int(json.load(fh).get("recovery_epoch", 0))
+        except (OSError, ValueError):
+            return  # unreadable manifest: latest() already quarantines
+        if disk_epoch > int(self.recovery_epoch):
+            from sitewhere_tpu.runtime.metrics import GLOBAL_METRICS
+
+            GLOBAL_METRICS.counter("fencing.rejected").inc()
+            raise SiteWhereCheckpointError(
+                f"checkpoint save fenced: on-disk epoch {disk_epoch} > "
+                f"writer epoch {self.recovery_epoch} (stale owner)")
 
     def _gc(self) -> None:
         ckpts = sorted(n for n in os.listdir(self.directory)
@@ -772,6 +806,7 @@ class PipelineCheckpointer:
             # something under the restored interners + epoch base, and
             # its events must fire the restored rules, not an empty set
             _install_overflow(engine, overflow_cols)
+        self.last_restore_epoch = int(manifest.get("recovery_epoch", 0))
         return manifest.get("offsets", {})
 
     @staticmethod
@@ -1012,10 +1047,77 @@ class InstanceCheckpointManager:
             "anomaly_model_installs":
                 self.instance.anomaly_models.export_state(),
             "provisioning": export_provisioning(self.instance),
+            # exactly-once-effects replay (runtime/recovery.py): the
+            # per-tenant eventlog high-watermarks are the replay cursor's
+            # twin — on restore, rows durable ABOVE these marks are the
+            # budget of inbound records whose effects must not re-fire
+            "eventlog_watermarks": self._eventlog_watermarks(),
+            # recent-duplicate LRU windows ride along so a restart does
+            # not forget what the store lookup is too slow to re-learn
+            "dedup_windows": self._dedup_windows(),
         }
         return self.checkpointer.save(
             engine, consumer_groups=self._inbound_groups(),
             extra_manifest=extra)
+
+    # bounded per-source checkpoint payload: newest ids win (LRU order)
+    DEDUP_WINDOW_LIMIT = 4096
+
+    def _dedup_windows(self) -> Dict[str, Dict[str, List[str]]]:
+        """{tenant: {source_id: [alternate ids, oldest first]}} across
+        every running tenant engine's event sources."""
+        windows: Dict[str, Dict[str, List[str]]] = {}
+        manager = getattr(self.instance, "engine_manager", None)
+        if manager is None:
+            return windows
+        with manager._lock:
+            engines = dict(manager.engines)
+        for token, engine in engines.items():
+            sources = getattr(getattr(engine, "event_sources", None),
+                              "sources", [])
+            per_source = {}
+            for source in sources:
+                export = getattr(getattr(source, "deduplicator", None),
+                                 "export_window", None)
+                if export is None:
+                    continue
+                ids = export(limit=self.DEDUP_WINDOW_LIMIT)
+                if ids:
+                    per_source[source.source_id] = ids
+            if per_source:
+                windows[token] = per_source
+        return windows
+
+    def _eventlog_watermarks(self) -> Dict[str, Dict[str, int]]:
+        """Per-tenant `(id_prefix -> max id_seq)` maxima, merged across
+        the shared default log and any dedicated tenant stores that
+        support watermarks (widerow stores don't; their tenants simply
+        skip the replay barrier and fall back to at-least-once)."""
+        marks: Dict[str, Dict[str, int]] = {}
+
+        def _merge(per_tenant):
+            for tenant, m in (per_tenant or {}).items():
+                merged = marks.setdefault(tenant, {})
+                for prefix, seq in m.items():
+                    if int(seq) > merged.get(prefix, -1):
+                        merged[prefix] = int(seq)
+
+        # Seal the buffered tail first: rows at-or-below the watermark are
+        # never re-offered by the bus once the offsets commit, so their
+        # durability cannot ride at-least-once replay the way the un-sealed
+        # tail normally does — the checkpoint boundary must be on disk.
+        log = getattr(self.instance, "event_log", None)
+        if hasattr(log, "flush"):
+            log.flush()
+        if hasattr(log, "sequence_watermarks"):
+            _merge(log.sequence_watermarks())
+        datastores = getattr(self.instance, "datastores", None)
+        for store in getattr(datastores, "_dedicated", {}).values():
+            if hasattr(store, "flush"):
+                store.flush()
+            if hasattr(store, "sequence_watermarks"):
+                _merge(store.sequence_watermarks())
+        return marks
 
     def list_checkpoints(self) -> List[str]:
         return sorted(
@@ -1032,9 +1134,10 @@ class InstanceCheckpointManager:
         happened after it), and replaying from the older checkpoint cursor
         is what makes the restored state catch up (at-least-once)."""
         engine = self.instance.pipeline_engine
-        if engine is None or self.checkpointer.latest() is None:
+        path = self.checkpointer.latest()
+        if engine is None or path is None:
             return False
-        self._restore_scripting(self.checkpointer.latest())
+        self._restore_scripting(path)
         offsets = self.checkpointer.restore(engine)
         self.last_restore_offsets = offsets
         for key, saved in offsets.items():
@@ -1058,7 +1161,42 @@ class InstanceCheckpointManager:
             consumer = self.instance.bus.consumer(topic, group)
             consumer.committed = [0] * len(consumer.topic.partitions)
             consumer.seek_to_committed()
+        self._arm_replay_guards(path)
         return True
+
+    def _arm_replay_guards(self, path: str) -> None:
+        """Exactly-once effects for the replay that follows this restore:
+        arm the global replay barrier with per-tenant budgets (durable
+        rows ABOVE the checkpointed watermarks == the replay overlap)
+        and stage the checkpointed dedup windows for the event sources
+        that boot later (runtime/recovery.py)."""
+        from sitewhere_tpu.runtime.recovery import (
+            GLOBAL_REPLAY_BARRIER, stash_dedup_seeds)
+
+        try:
+            with open(os.path.join(path, "manifest.json"),
+                      encoding="utf-8") as fh:
+                manifest = json.load(fh)
+        except (OSError, ValueError):
+            return
+        stash_dedup_seeds(manifest.get("dedup_windows") or {})
+        marks = manifest.get("eventlog_watermarks") or {}
+        # tenants with durable rows but NO checkpointed watermark (created
+        # after the save) replay their whole retained log: every durable
+        # row of theirs is overlap too, so enumerate the live log as well
+        default_log = self.instance.event_log
+        tenants = set(marks)
+        if hasattr(default_log, "sequence_watermarks"):
+            tenants |= set(default_log.sequence_watermarks())
+        budgets: Dict[str, int] = {}
+        datastores = getattr(self.instance, "datastores", None)
+        for tenant in tenants:
+            log = (datastores.event_log_for(tenant)
+                   if datastores is not None else default_log)
+            if hasattr(log, "rows_above"):
+                budgets[tenant] = int(
+                    log.rows_above(tenant, marks.get(tenant, {})))
+        GLOBAL_REPLAY_BARRIER.arm(budgets, watermarks=marks)
 
     def _restore_scripting(self, path: str) -> None:
         """Merge checkpointed instance-level payloads — provisioning

@@ -549,6 +549,50 @@ class TenantEventLog:
         with self._lock:
             return self._buffer.n + sum(s.n for s in self._segments)
 
+    def _id_segments(self) -> List[Dict[str, np.ndarray]]:
+        with self._lock:
+            segments = list(self._segments)
+            pending = self._buffer.peek()
+        if pending is not None:
+            segments.append(pending)
+        return [seg.cols for seg in segments]
+
+    def sequence_watermarks(self) -> Dict[str, int]:
+        """Per `id_prefix` max `id_seq` over this tenant's rows (buffered
+        + sealed). Each prefix is one process incarnation, each seq is
+        monotonic within it, so the map is a compact high-watermark of
+        everything this log has materialized — the instance checkpoint
+        captures it next to the bus offsets (persist/checkpoint.py) and
+        the replay barrier suppresses re-emission below it."""
+        marks: Dict[str, int] = {}
+        for cols in self._id_segments():
+            prefixes = np.asarray(cols["id_prefix"], dtype=object)
+            seqs = cols["id_seq"]
+            for prefix in set(prefixes.tolist()):
+                if prefix is None:
+                    continue  # legacy rows without sequence identity
+                top = int(seqs[prefixes == prefix].max())
+                if top > marks.get(prefix, -1):
+                    marks[prefix] = top
+        return marks
+
+    def rows_above(self, marks: Dict[str, int]) -> int:
+        """Count rows whose (id_prefix, id_seq) lies ABOVE `marks` — at
+        restore, with `marks` from the checkpoint manifest, this is the
+        already-durable replay overlap (rows the retained log will
+        re-offer past the saved offsets), i.e. the tenant's replay
+        barrier budget."""
+        n = 0
+        for cols in self._id_segments():
+            prefixes = np.asarray(cols["id_prefix"], dtype=object)
+            seqs = cols["id_seq"]
+            for prefix in set(prefixes.tolist()):
+                if prefix is None:
+                    continue
+                sel = seqs[prefixes == prefix]
+                n += int((sel > marks.get(prefix, -1)).sum())
+        return n
+
 
 class ColumnarEventLog:
     """Multi-tenant event store facade.
@@ -628,6 +672,16 @@ class ColumnarEventLog:
         log = self.tenant_if_exists(tenant)
         if log is not None:
             log.flush()
+
+    def sequence_watermarks(self) -> Dict[str, Dict[str, int]]:
+        """Per-tenant `(id_prefix -> max id_seq)` high-watermarks — the
+        checkpoint's exactly-once-effects anchor."""
+        return {log.tenant: log.sequence_watermarks()
+                for log in self._tenant_list()}
+
+    def rows_above(self, tenant: str, marks: Dict[str, int]) -> int:
+        log = self.tenant_if_exists(tenant)
+        return 0 if log is None else log.rows_above(marks)
 
     # -- hot-path append ---------------------------------------------------
     def append_batch(self, tenant: str, batch, packer,
@@ -740,7 +794,14 @@ class ColumnarEventLog:
         n = len(events)
         if n == 0:
             return
-        cols = _full_cols(n)
+        # control-plane rows carry (id_prefix, id_seq) too — the explicit
+        # event id stays authoritative on read, but sequence identity is
+        # what the checkpoint watermarks and replay-barrier budgets count,
+        # and inbound persist lands here rather than on the packed path
+        base = self._next_ids(n)
+        cols = _full_cols(n,
+                          id_prefix=_const_col(n, _ID_PREFIX),
+                          id_seq=np.arange(base, base + n, dtype=np.int64))
         for i, ev in enumerate(events):
             self._fill_row(cols, i, ev, device_interner)
         self.tenant(tenant).append(cols, n)

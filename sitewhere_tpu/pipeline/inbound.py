@@ -30,6 +30,7 @@ from sitewhere_tpu.model.event import (
 from sitewhere_tpu.runtime.bus import ConsumerHost, EventBus, Record, TopicNaming
 from sitewhere_tpu.runtime.lifecycle import LifecycleComponent
 from sitewhere_tpu.runtime.metrics import MetricsRegistry
+from sitewhere_tpu.runtime.recovery import GLOBAL_REPLAY_BARRIER
 
 LOGGER = logging.getLogger("sitewhere.inbound")
 
@@ -111,6 +112,7 @@ class InboundProcessingService(LifecycleComponent):
         hot: List[Tuple[DeviceEvent, str]] = []
         hot_records: List[Record] = []
         forward: Dict[int, List[Record]] = {}
+        replay_all: Optional[bool] = None  # every hot record suppressed?
         for record in records:
             try:
                 data = msgpack.unpackb(record.value, raw=False)
@@ -145,9 +147,27 @@ class InboundProcessingService(LifecycleComponent):
                     continue
             if not self._validate(token, record):
                 continue
-            persisted = self._persist(token, events)
+            # exactly-once effects under checkpoint replay
+            # (runtime/recovery.py): while this tenant's replay budget
+            # lasts, a record's events still rebuild device/rule/model
+            # state (they join `hot`) but skip re-persisting — the rows
+            # are already durable, and skipping the persist also skips
+            # the trigger fan-out (enriched topics, command delivery,
+            # analytics increments). A PARTIAL take at the budget
+            # boundary persists anyway: at-least-once for that record,
+            # with sequence-watermark dedup catching stamped stragglers.
+            suppressed = False
+            if events and GLOBAL_REPLAY_BARRIER.active(self.tenant):
+                took = GLOBAL_REPLAY_BARRIER.take(self.tenant, len(events))
+                suppressed = took >= len(events)
+            if suppressed:
+                persisted = list(events)
+            else:
+                persisted = self._persist(token, events)
             if persisted:
                 hot_records.append(record)
+                replay_all = suppressed if replay_all is None \
+                    else (replay_all and suppressed)
             for event in persisted:
                 hot.append((event, token))
             self.processed_meter.mark(len(persisted))
@@ -175,7 +195,7 @@ class InboundProcessingService(LifecycleComponent):
             # offered event either materializes, parks, or is counted
             # shed, never silently lost.
             try:
-                self._submit_hot(hot)
+                self._submit_hot(hot, suppress_effects=bool(replay_all))
             except Exception:
                 self.failed_counter.inc()
                 LOGGER.exception("fused step failed for batch of %d events",
@@ -240,9 +260,15 @@ class InboundProcessingService(LifecycleComponent):
             LOGGER.exception("persist failed for device '%s'", token)
             return []
 
-    def _submit_hot(self, hot: List[Tuple[DeviceEvent, str]]) -> None:
+    def _submit_hot(self, hot: List[Tuple[DeviceEvent, str]],
+                    suppress_effects: bool = False) -> None:
         """Pack + run the fused step; rule alerts feed back into persistence
-        (the reference's ZoneTestRuleProcessor -> addDeviceAlerts loop)."""
+        (the reference's ZoneTestRuleProcessor -> addDeviceAlerts loop).
+
+        `suppress_effects` (replay barrier): the step still runs — the
+        replayed events must rebuild rule/device state — but the derived
+        alerts fired the first time around, so their persist + fan-out
+        is skipped for an all-replay batch."""
         events = [e for e, _ in hot]
         tokens = [t for _, t in hot]
         if self.batcher is not None:
@@ -255,7 +281,8 @@ class InboundProcessingService(LifecycleComponent):
                      for batch in self.engine.packer.pack_events(events,
                                                                  tokens))
         for batch, outputs in pairs:
-            if not self.persist_rule_alerts or self.events is None:
+            if not self.persist_rule_alerts or self.events is None \
+                    or suppress_effects:
                 continue
             for alert in self.engine.materialize_alerts(batch, outputs):
                 device = self.registry.get_device_by_token(alert.device_id)

@@ -29,6 +29,7 @@ import msgpack
 from sitewhere_tpu.runtime.bus import (EventBus, Record, batch_extent,
                                        jittered)
 from sitewhere_tpu.runtime.faults import fault_point
+from sitewhere_tpu.runtime.recovery import EpochFence, StaleEpochError
 
 _LEN = struct.Struct("<I")
 _MAX_FRAME = 64 * 1024 * 1024
@@ -36,6 +37,14 @@ _MAX_FRAME = 64 * 1024 * 1024
 
 class BusNetError(Exception):
     """Protocol or transport failure on the networked bus edge."""
+
+
+class StaleEpochBusError(BusNetError, StaleEpochError):
+    """Fencing rejection over the wire: a request stamped with an epoch
+    below the server's fenced floor for its resource. Catchable as a
+    BusNetError (publishers park, consumers back off — the zombie's
+    rows never reach live state) AND as the structured StaleEpochError
+    (resource/epoch/floor ride the exception)."""
 
 
 def _read_exact(sock: socket.socket, n: int) -> bytes:
@@ -131,7 +140,8 @@ class _Handler(socketserver.BaseRequestHandler):
                 if fault_point("busnet_partition") is not None:
                     return
                 try:
-                    resp = self._dispatch(bus, coordinator, member, req)
+                    resp = self._dispatch(bus, coordinator, member, req,
+                                          self.server.fence)  # type: ignore[attr-defined]
                     fault_point("busnet_delay")
                     if fault_point("busnet_drop") is not None:
                         return
@@ -151,8 +161,30 @@ class _Handler(socketserver.BaseRequestHandler):
 
     @staticmethod
     def _dispatch(bus: EventBus, coordinator: _GroupCoordinator,
-                  member: int, req) -> dict:
+                  member: int, req, fence: EpochFence) -> dict:
         op = req.get("op")
+        # Epoch fencing (runtime/recovery.py): a request stamped with a
+        # fencing identity is admitted only at-or-above the resource's
+        # fenced floor. Floors auto-learn from admitted traffic (a
+        # restarted writer's newer epoch fences its old incarnation) and
+        # are raised explicitly by the takeover broadcast below — the
+        # zombie/split-brain write guard. Unstamped requests pass
+        # (backward compatible; fencing is opt-in per writer).
+        fence_key = req.get("fence")
+        if fence_key is not None and op != "fence":
+            epoch = int(req.get("epoch", 0))
+            if not fence.admit(str(fence_key), epoch):
+                floor = fence.floor(str(fence_key))
+                return {"ok": False, "stale_epoch": True,
+                        "fence": str(fence_key), "epoch": epoch,
+                        "floor": floor,
+                        "error": f"stale epoch {epoch} < fenced floor "
+                                 f"{floor} for '{fence_key}'"}
+        if op == "fence":
+            # takeover broadcast: raise the floor for a (usually dead)
+            # writer's identity so its surviving incarnation is rejected
+            floor = fence.fence(str(req["key"]), int(req["epoch"]))
+            return {"ok": True, "floor": floor}
         if op == "publish":
             topic = bus.topic(req["topic"])
             records = req["records"]
@@ -263,7 +295,13 @@ class BusServer:
         self._server = _Server((host, port), _Handler)
         self._server.bus = bus  # type: ignore[attr-defined]
         self._server.coordinator = _GroupCoordinator(bus)  # type: ignore[attr-defined]
+        self._server.fence = EpochFence()  # type: ignore[attr-defined]
         self._thread: Optional[threading.Thread] = None
+
+    @property
+    def fence(self) -> EpochFence:
+        """The server's per-resource epoch floors (fencing state)."""
+        return self._server.fence  # type: ignore[attr-defined]
 
     @property
     def port(self) -> int:
@@ -300,6 +338,23 @@ class BusClient:
         self.retries = retries
         self._sock: Optional[socket.socket] = None
         self._lock = threading.Lock()
+        # fencing identity: once set, every request is stamped with
+        # (fence, epoch) and the server rejects it below the floor
+        self._fence_key: Optional[str] = None
+        self._epoch = 0
+
+    def set_epoch(self, fence_key: str, epoch: int) -> None:
+        """Adopt a fencing identity: stamp subsequent requests with this
+        resource key + epoch (minted by runtime/recovery.py at boot or
+        takeover)."""
+        self._fence_key = str(fence_key)
+        self._epoch = int(epoch)
+
+    def fence(self, key: str, epoch: int) -> int:
+        """Raise the server's floor for `key` to at least `epoch` (the
+        takeover broadcast); returns the resulting floor."""
+        return int(self._rpc({"op": "fence", "key": str(key),
+                              "epoch": int(epoch)})["floor"])
 
     def _connect(self) -> socket.socket:
         if self._sock is None:
@@ -315,6 +370,9 @@ class BusClient:
         the server-side cursor to committed, because a poll whose RESPONSE
         was lost already advanced the position (retrying blindly would skip
         those records and the next commit would lose them permanently)."""
+        if self._fence_key is not None and req.get("op") != "fence" \
+                and "fence" not in req:
+            req = dict(req, fence=self._fence_key, epoch=self._epoch)
         with self._lock:
             last: Optional[Exception] = None
             for attempt in range(self.retries + 1):
@@ -329,6 +387,13 @@ class BusClient:
                     _send_frame(sock, req)
                     resp = _recv_frame(sock)
                     if not resp.get("ok"):
+                        if resp.get("stale_epoch"):
+                            # fenced: structured, non-retryable — the
+                            # socket stays healthy, the WRITER is dead
+                            raise StaleEpochBusError(
+                                str(resp.get("fence", "")),
+                                int(resp.get("epoch", 0)),
+                                int(resp.get("floor", 0)))
                         raise BusNetError(resp.get("error", "request failed"))
                     return resp
                 except (OSError, BusNetError) as exc:

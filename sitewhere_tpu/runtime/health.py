@@ -27,7 +27,8 @@ from __future__ import annotations
 import logging
 import threading
 import time
-from typing import Dict, Optional
+from collections import deque
+from typing import Dict, List, Optional
 
 LOGGER = logging.getLogger("sitewhere.health")
 
@@ -45,13 +46,17 @@ class EngineHealth:
     appear on failure paths (note_success is a counter bump + one branch,
     cheap enough for every submit)."""
 
-    def __init__(self, name: str, metrics=None, recover_after: int = 8):
+    def __init__(self, name: str, metrics=None, recover_after: int = 8,
+                 ring_size: int = 32):
         self.name = name
         self.recover_after = int(recover_after)
         self.state = HEALTHY
         self.transitions = 0
         self.last_transition_ms: Optional[int] = None
         self.last_cause: Optional[str] = None
+        # recent transitions (state, cause, timestamp) for post-incident
+        # triage — counters say HOW MANY, the ring says WHAT happened
+        self._ring: "deque[Dict]" = deque(maxlen=int(ring_size))
         self._streak = 0  # consecutive clean submits while impaired
         self._lock = threading.Lock()
         self._transition_counter = (
@@ -72,6 +77,8 @@ class EngineHealth:
         self.transitions += 1
         self.last_transition_ms = int(time.time() * 1000)
         self.last_cause = cause
+        self._ring.append({"state": state, "cause": cause,
+                           "at_ms": self.last_transition_ms})
         self._streak = 0
         if self._transition_counter is not None:
             self._transition_counter.inc()
@@ -114,8 +121,13 @@ class EngineHealth:
         with self._lock:
             self._move(HEALTHY, "operator reset")
 
+    def recent_transitions(self) -> List[Dict]:
+        with self._lock:
+            return list(self._ring)
+
     def to_json(self) -> Dict:
         return {"state": self.state, "code": self.code,
                 "transitions": self.transitions,
                 "last_transition_ms": self.last_transition_ms,
-                "last_cause": self.last_cause}
+                "last_cause": self.last_cause,
+                "recent": self.recent_transitions()}

@@ -13,7 +13,8 @@ from sitewhere_tpu.sources.decoders import (
     JsonRequestDecoder, ScriptedDecoder, WireDecoder)
 from sitewhere_tpu.transport.protobuf_compat import ProtobufCompatDecoder
 from sitewhere_tpu.sources.dedup import (
-    AlternateIdDeduplicator, ScriptedDeduplicator)
+    AlternateIdDeduplicator, ScriptedDeduplicator,
+    SequenceWatermarkDeduplicator)
 from sitewhere_tpu.sources.manager import (
     EventSourcesManager, InboundEventSource)
 from sitewhere_tpu.sources.receivers import (
@@ -26,6 +27,7 @@ __all__ = [
     "JsonRequestDecoder", "ProtobufCompatDecoder", "ScriptedDecoder",
     "WireDecoder",
     "AlternateIdDeduplicator", "ScriptedDeduplicator",
+    "SequenceWatermarkDeduplicator",
     "EventSourcesManager", "InboundEventSource",
     "CoapEventReceiver", "HttpEventReceiver", "MqttEventReceiver",
     "StompBrokerEventReceiver",

@@ -28,6 +28,7 @@ from sitewhere_tpu.runtime.bus import EventBus, TopicNaming
 from sitewhere_tpu.runtime.flight import GLOBAL_FLIGHT
 from sitewhere_tpu.runtime.lifecycle import LifecycleComponent
 from sitewhere_tpu.runtime.metrics import GLOBAL_METRICS, MetricsRegistry
+from sitewhere_tpu.runtime.recovery import take_dedup_seed
 from sitewhere_tpu.sources.decoders import DecodedRequest, DecodeError
 
 
@@ -192,6 +193,14 @@ class InboundEventSource(LifecycleComponent):
 
     # -- lifecycle ---------------------------------------------------------
     def on_start(self, monitor) -> None:
+        # a boot restore may have staged this source's checkpointed
+        # recent-duplicate window (runtime/recovery.py): claim it before
+        # receivers deliver, or the first post-crash duplicates slip by
+        restore = getattr(self.deduplicator, "restore_window", None)
+        if restore is not None:
+            seed = take_dedup_seed(self.tenant, self.source_id)
+            if seed:
+                restore(seed)
         for receiver in self.receivers:
             receiver.bind(self)
             receiver.start()

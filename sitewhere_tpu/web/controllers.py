@@ -514,6 +514,10 @@ def register_all(router: Router, instance, server) -> None:
                     hooks.forwarder.dead_lettered
                 extra["cluster.step_ticks"] = hooks.loop.tick_count
             extra["cluster.degraded_peers"] = len(hooks.degraded)
+        # failover epoch (runtime/recovery.py): lets dashboards graph
+        # restarts/takeovers as step changes and alert on epoch skew
+        extra["recovery.epoch"] = float(getattr(instance,
+                                                "recovery_epoch", 0))
         text = instance.metrics.prometheus_text(extra)
         return 200, text.encode("utf-8"), "text/plain; version=0.0.4"
 

@@ -1,0 +1,334 @@
+"""Failover chaos drills: SIGKILL takeover with exactly-once effects,
+partition-heal zombie-write fencing, and lease-expiry split-brain.
+
+Run with `-m chaos`. These are the wall-clock halves of the epoch-fenced
+failover contract; the deterministic tick-driven unit halves live in
+tests/test_recovery.py (tier-1).
+
+Marked both `chaos` and `slow`: the tier-1 gate's `-m "not slow"`
+excludes these on the command line.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import msgpack
+import pytest
+
+pytestmark = [pytest.mark.chaos, pytest.mark.slow]
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# drill 1: SIGKILL mid-serve -> takeover boot -> conservation, zero dup
+# ---------------------------------------------------------------------------
+
+VICTIM = r"""
+import os, signal, sys, time
+import msgpack
+from sitewhere_tpu.instance import SiteWhereInstance
+from sitewhere_tpu.model import Device, DeviceAssignment, DeviceType
+from sitewhere_tpu.model.common import _asdict
+from sitewhere_tpu.model.event import DeviceEventBatch, DeviceMeasurement
+
+data_dir = sys.argv[1]
+n1, n2, burst = int(sys.argv[2]), int(sys.argv[3]), int(sys.argv[4])
+
+instance = SiteWhereInstance(
+    instance_id="failover", data_dir=data_dir, enable_pipeline=True,
+    max_devices=64, batch_size=16, measurement_slots=4)
+instance.start()
+engine = instance.engine_manager.get_engine("default")
+dt = engine.registry.create_device_type(DeviceType(token="t"))
+total = n1 + n2 + burst
+for i in range(total):
+    d = engine.registry.create_device(
+        Device(token=f"fd{i}", device_type_id=dt.id))
+    engine.registry.create_device_assignment(
+        DeviceAssignment(token=f"fa{i}", device_id=d.id))
+
+def publish(i):
+    topic = instance.naming.event_source_decoded_events("default")
+    payload = msgpack.packb({
+        "sourceId": "drill", "deviceToken": f"fd{i}",
+        "kind": "DeviceEventBatch",
+        "request": _asdict(DeviceEventBatch(
+            device_token=f"fd{i}",
+            measurements=[DeviceMeasurement(name="m",
+                                            value=float(i + 1))])),
+        "metadata": {}}, use_bin_type=True)
+    instance.bus.publish(topic, f"fd{i}".encode(), payload)
+
+def wait_materialized(upto, timeout_s=60):
+    pe = instance.pipeline_engine
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        done = sum(1 for i in range(upto)
+                   if (s := pe.get_device_state(f"fd{i}")) is not None
+                   and "m" in s.last_measurements)
+        if done == upto:
+            return True
+        time.sleep(0.1)
+    return False
+
+for i in range(n1):
+    publish(i)
+assert wait_materialized(n1), "pre-checkpoint events did not land"
+instance.checkpoint_manager.save()
+print("CHECKPOINTED", flush=True)
+
+# these rows land in the durable eventlog BEYOND the checkpoint: the
+# successor must replay them for state but suppress their re-persist
+for i in range(n1, n1 + n2):
+    publish(i)
+assert wait_materialized(n1 + n2), "post-checkpoint events did not land"
+# seal the tail to disk (stands in for the linger flusher's segment
+# seal) so the post-checkpoint rows are DURABLE overlap: the bus will
+# re-offer their records past the saved offsets, and re-persisting
+# them would be the duplicate this drill asserts against
+instance.event_log.flush()
+print("QUIESCED epoch=%d" % instance.recovery_epoch, flush=True)
+
+# in-flight traffic at the moment of death (mid-step): published to the
+# durable bus, possibly half-processed when the KILL lands
+for i in range(n1 + n2, total):
+    publish(i)
+print("READY_FOR_KILL", flush=True)
+os.kill(os.getpid(), signal.SIGKILL)
+"""
+
+
+class TestSigkillTakeoverConservation:
+    def test_successor_replays_exactly_once(self, tmp_path):
+        """SIGKILL the serving process mid-step; the successor boot over
+        the same durable state restores the last-good checkpoint, replays
+        the retained log past the saved offsets, and admits traffic —
+        with conservation: every durably offered event materializes in
+        device state EXACTLY once (zero duplicate eventlog rows), the
+        replayed rows' effects suppressed (`replay.suppressed_effects`),
+        and the successor's epoch above the victim's."""
+        from sitewhere_tpu.instance import SiteWhereInstance
+
+        n1, n2, burst = 4, 4, 3
+        env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+        proc = subprocess.run(
+            [sys.executable, "-c", VICTIM, str(tmp_path), str(n1),
+             str(n2), str(burst)],
+            capture_output=True, text=True, timeout=180, env=env,
+            cwd=REPO)
+        assert proc.returncode == -signal.SIGKILL, proc.stderr[-2000:]
+        assert "QUIESCED" in proc.stdout, proc.stdout
+        victim_epoch = int(proc.stdout.split("epoch=")[1].split()[0])
+
+        revived = SiteWhereInstance(
+            instance_id="failover", data_dir=str(tmp_path),
+            enable_pipeline=True, max_devices=64, batch_size=16,
+            measurement_slots=4)
+        revived.start()
+        try:
+            # automated takeover boot: checkpoint restored, no operator
+            assert revived.checkpoint_manager.last_restore_offsets
+            assert revived.recovery_epoch > victim_epoch
+
+            # the durably-offered set: whatever the decoded topic holds
+            # (the in-flight burst may have partially reached the bus)
+            topic = revived.naming.event_source_decoded_events("default")
+            durable = sum(revived.bus.topic(topic).end_offsets())
+            assert durable >= n1 + n2  # the quiesced rows are all there
+
+            pe = revived.pipeline_engine
+            deadline = time.time() + 90
+            while time.time() < deadline:
+                done = sum(
+                    1 for i in range(durable)
+                    if (s := pe.get_device_state(f"fd{i}")) is not None
+                    and "m" in s.last_measurements)
+                if done == durable:
+                    break
+                time.sleep(0.2)
+            assert done == durable, f"{done}/{durable} materialized"
+
+            # zero duplicates: one eventlog row per offered event, even
+            # though the n2 post-checkpoint rows were REPLAYED through
+            # the full inbound path (and re-persist was suppressed)
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                rows = revived.event_log.count("default")
+                if rows >= durable:
+                    break
+                time.sleep(0.2)
+            assert rows == durable, (
+                f"{rows} eventlog rows for {durable} offered events")
+
+            recovery = revived.topology()["recovery"]
+            assert recovery["epoch"] == revived.recovery_epoch
+            assert recovery["replay_suppressed_effects"] >= n2
+            assert recovery["last_restore_epoch"] == victim_epoch
+        finally:
+            revived.stop()
+
+
+# ---------------------------------------------------------------------------
+# drill 2: partition heal -> zombie gossip writes fenced, then re-admit
+# ---------------------------------------------------------------------------
+
+class _Capture:
+    """BusClient stand-in collecting published gossip payloads."""
+
+    def __init__(self):
+        self.sent = []
+
+    def publish(self, topic, key, value):
+        self.sent.append(value)
+
+    def drain(self):
+        out, self.sent = self.sent, []
+        return out
+
+
+class TestPartitionHealZombieWrites:
+    def test_zombie_mutations_rejected_then_remint_readmits(self):
+        """A partitioned host keeps writing with its pre-partition epoch;
+        after the survivor fences it (takeover), the healed partition
+        delivers those writes — they must be REJECTED (counted on
+        `fencing.rejected`) so replicas do not diverge, and the host's
+        restart (epoch re-mint at the fenced floor) re-admits it."""
+        from sitewhere_tpu.instance import SiteWhereInstance
+        from sitewhere_tpu.model import DeviceType
+        from sitewhere_tpu.parallel.cluster import RegistryGossip
+        from sitewhere_tpu.runtime.bus import Record
+
+        def host(instance_id, origin_rank, epoch):
+            instance = SiteWhereInstance(instance_id=instance_id)
+            instance.start()
+            capture = _Capture()
+            gossip = RegistryGossip(origin_rank, {99: capture}, instance,
+                                    instance.naming)
+            gossip.set_epoch(epoch)
+            engine = instance.get_tenant_engine("default")
+            gossip.register_tenant_registry("default", engine.registry)
+            return instance, engine.registry, gossip, capture
+
+        def apply(gossip, payloads):
+            gossip._handle([Record("t", 0, i, b"", p, 0)
+                            for i, p in enumerate(payloads)])
+
+        inst_a, reg_a, gossip_a, cap_a = host("zombie-a", 0, epoch=3)
+        inst_b, reg_b, gossip_b, _ = host("zombie-b", 1, epoch=1)
+        try:
+            # healthy epoch-stamped replication converges
+            reg_a.create_device_type(DeviceType(token="dt-live"))
+            apply(gossip_b, cap_a.drain())
+            assert reg_b.get_device_type_by_token("dt-live") is not None
+
+            # partition: B (survivor/successor) fences A's origin — the
+            # takeover broadcast — while A keeps writing at epoch 3
+            gossip_b.fence("proc:0", 4)
+            rejected0 = gossip_b._fence.rejected
+            applied0 = gossip_b.applied
+            reg_a.create_device_type(DeviceType(token="dt-zombie"))
+            zombie_payloads = cap_a.drain()
+
+            # heal: the queued pre-partition writes arrive and are fenced
+            apply(gossip_b, zombie_payloads)
+            from sitewhere_tpu.errors import NotFoundError
+            with pytest.raises(NotFoundError):
+                reg_b.get_device_type_by_token("dt-zombie")
+            assert gossip_b._fence.rejected > rejected0
+            assert gossip_b.applied == applied0  # no divergence
+
+            # A restarts: mint lands AT the fenced floor -> re-admitted
+            # with no operator action, convergence resumes
+            gossip_a.set_epoch(4)
+            reg_a.create_device_type(DeviceType(token="dt-healed"))
+            apply(gossip_b, cap_a.drain())
+            assert reg_b.get_device_type_by_token("dt-healed") is not None
+            assert gossip_b._fence.snapshot()["proc:0"] == 4
+        finally:
+            inst_a.stop()
+            inst_b.stop()
+
+
+# ---------------------------------------------------------------------------
+# drill 3: lease expiry with BOTH hosts alive -> no dual ownership
+# ---------------------------------------------------------------------------
+
+class TestLeaseExpirySplitBrain:
+    def test_no_dual_ownership_of_effects(self):
+        """Heartbeats from host 1 stop reaching host 0 (asymmetric
+        partition) while BOTH stay alive. Host 0 takes over host 1's
+        shard group at a fenced epoch. The lease TABLES briefly disagree
+        (each host trusts its own view) — the invariant is about
+        EFFECTS: the shared write path admits exactly one owner's epoch
+        at any moment, so the zombie's writes are rejected, not merged.
+        When the partition heals, ownership hands back and the zombie's
+        re-minted epoch is re-admitted."""
+        from sitewhere_tpu.parallel.cluster import TakeoverMonitor
+        from sitewhere_tpu.runtime.metrics import MetricsRegistry
+        from sitewhere_tpu.runtime.recovery import EpochFence
+
+        # the cluster's write path (busnet servers' fence, condensed)
+        write_fence = EpochFence(metrics=MetricsRegistry())
+        epochs = {0: 5, 1: 3}
+        clk = [0.0]
+
+        # each host's view of the other's heartbeat state
+        view0 = {"1": {"process_id": 1, "stale": False,
+                       "health": "healthy", "leases": {"shard-group:1": 3}}}
+        view1 = {"0": {"process_id": 0, "stale": False,
+                       "health": "healthy", "leases": {"shard-group:0": 5}}}
+
+        m0 = TakeoverMonitor(
+            0, peer_states=lambda: {k: dict(v) for k, v in view0.items()},
+            epoch_of=lambda: epochs[0],
+            fence_hooks=[write_fence.fence],
+            ttl_s=6.0, clock=lambda: clk[0])
+        m1 = TakeoverMonitor(
+            1, peer_states=lambda: {k: dict(v) for k, v in view1.items()},
+            epoch_of=lambda: epochs[1],
+            fence_hooks=[write_fence.fence],
+            ttl_s=6.0, clock=lambda: clk[0])
+
+        m0.check_once()
+        m1.check_once()
+        assert write_fence.admit("proc:1", 3)   # both admitted pre-fault
+
+        # asymmetric partition: host 1's heartbeats stop reaching host 0;
+        # host 1 still sees host 0 fine and keeps renewing locally
+        view0["1"]["stale"] = True
+        clk[0] = 10.0
+        events = m0.check_once()
+        assert [e["op"] for e in events] == ["takeover"]
+        m1.check_once()  # host 1, alive, renews its own lease locally
+
+        # tables disagree (split view)...
+        assert m0.leases.holder("shard-group:1", now=clk[0]) == "proc:0"
+        assert m1.leases.holder("shard-group:1", now=clk[0]) == "proc:1"
+        # ...but the WRITE PATH has one owner: the zombie's epoch is
+        # below the fenced floor, so its effects are rejected
+        assert not write_fence.admit("proc:1", epochs[1])
+        assert write_fence.rejected >= 1
+        # host 1 never counter-takes-over host 0 (its view shows 0 fresh)
+        assert m1.snapshot()["takeovers"] == 0
+
+        # repeated ticks: the takeover is stable, no flapping
+        clk[0] = 11.0
+        assert m0.check_once() == []
+        assert m1.check_once() == []
+
+        # heal: host 1 restarts, mints AT the fenced floor, heartbeats
+        # reach host 0 again -> handback, single ownership, re-admitted
+        epochs[1] = 4
+        view0["1"] = {"process_id": 1, "stale": False,
+                      "health": "healthy", "leases": {"shard-group:1": 4}}
+        clk[0] = 12.0
+        assert m0.check_once() == []
+        assert m0.taken == set()
+        assert m0.leases.holder("shard-group:1", now=clk[0]) == "proc:1"
+        assert write_fence.admit("proc:1", 4)
+        ops = [e["op"] for e in m0.snapshot()["takeover_events"]]
+        assert ops == ["takeover", "handback"]

@@ -1,0 +1,565 @@
+"""Epoch-fenced failover, tier-1 half: deterministic unit contracts for
+recovery epochs, write fencing, leases, the replay output barrier, the
+sequence-watermark deduplicator, dedup-window checkpoint ride-along, the
+takeover state machine (driven tick by tick with an injected clock), and
+the busnet fencing protocol. The wall-clock chaos drills — SIGKILL
+takeover conservation, partition-heal zombie writes, dual-ownership —
+live in test_chaos_failover.py (`-m chaos`).
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from sitewhere_tpu.runtime.metrics import MetricsRegistry
+from sitewhere_tpu.runtime.recovery import (
+    EpochFence, LeaseTable, ReplayBarrier, StaleEpochError,
+    elect_successor, mint_epoch, stash_dedup_seeds, stored_epoch,
+    take_dedup_seed)
+
+
+# ---------------------------------------------------------------------------
+# recovery epochs
+# ---------------------------------------------------------------------------
+
+class TestEpochMint:
+    def test_mint_is_durable_and_monotonic(self, tmp_path):
+        d = str(tmp_path)
+        assert stored_epoch(d) == 0
+        assert mint_epoch(d) == 1
+        assert mint_epoch(d) == 2
+        assert stored_epoch(d) == 2
+        # a "restarted" process (fresh reader) sees the durable value
+        assert mint_epoch(d) == 3
+
+    def test_memory_fallback_still_monotonic(self):
+        a = mint_epoch(None)
+        b = mint_epoch(None)
+        assert b == a + 1
+        assert stored_epoch(None) == b
+
+    def test_concurrent_mints_unique(self, tmp_path):
+        # mint is read-inc-rename under no lock across processes, but a
+        # single process's threads must never mint the same epoch twice
+        # through the in-memory path
+        out = []
+        threads = [threading.Thread(
+            target=lambda: out.append(mint_epoch(None)))
+            for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(set(out)) == 8
+
+
+class TestEpochFence:
+    def test_floors_learn_from_traffic(self):
+        fence = EpochFence(metrics=MetricsRegistry())
+        assert fence.admit("proc:1", 3)       # first sight sets floor
+        assert fence.admit("proc:1", 3)       # at-floor admits
+        assert not fence.admit("proc:1", 2)   # older incarnation fenced
+        assert fence.admit("proc:1", 5)       # newer raises floor
+        assert not fence.admit("proc:1", 4)
+        assert fence.rejected == 2
+
+    def test_explicit_fence_and_structured_error(self):
+        fence = EpochFence(metrics=MetricsRegistry())
+        assert fence.fence("proc:2", 7) == 7
+        with pytest.raises(StaleEpochError) as err:
+            fence.check("proc:2", 6)
+        assert err.value.resource == "proc:2"
+        assert err.value.epoch == 6
+        assert err.value.floor == 7
+        fence.check("proc:2", 7)  # at-floor passes
+        assert fence.snapshot() == {"proc:2": 7}
+
+    def test_origins_are_independent(self):
+        # epochs are comparable only within one origin: host B's small
+        # epoch must never be fenced by host A's large one
+        fence = EpochFence(metrics=MetricsRegistry())
+        assert fence.admit("proc:1", 50)
+        assert fence.admit("proc:2", 1)
+        assert fence.rejected == 0
+
+
+class TestLeaseTable:
+    def _table(self):
+        clk = [0.0]
+        table = LeaseTable(metrics=MetricsRegistry(),
+                           clock=lambda: clk[0])
+        return table, clk
+
+    def test_acquire_renew_expire(self):
+        table, clk = self._table()
+        assert table.acquire("r", "proc:0", 1, ttl_s=5.0)
+        assert table.holder("r") == "proc:0"
+        clk[0] = 4.0
+        assert table.renew("r", "proc:0", 1)
+        clk[0] = 8.0  # renewed at 4.0, ttl 5.0 -> still live
+        assert not table.expired("r")
+        clk[0] = 9.5
+        assert table.expired("r")
+        assert table.holder("r") is None
+
+    def test_live_lease_steals_only_with_higher_epoch(self):
+        table, clk = self._table()
+        table.acquire("r", "proc:0", 3, ttl_s=5.0)
+        assert not table.acquire("r", "proc:1", 3, ttl_s=5.0)  # equal
+        assert not table.acquire("r", "proc:1", 2, ttl_s=5.0)  # lower
+        assert table.acquire("r", "proc:1", 4, ttl_s=5.0)      # fenced
+        assert table.holder("r") == "proc:1"
+
+    def test_expired_lease_acquired_at_any_epoch(self):
+        table, clk = self._table()
+        table.acquire("r", "proc:0", 9, ttl_s=1.0)
+        clk[0] = 2.0
+        assert table.acquire("r", "proc:1", 1, ttl_s=1.0)
+
+    def test_renew_requires_owner_and_current_epoch(self):
+        table, _ = self._table()
+        table.acquire("r", "proc:0", 3, ttl_s=5.0)
+        assert not table.renew("r", "proc:1", 3)   # not the owner
+        assert not table.renew("r", "proc:0", 2)   # older incarnation
+        assert table.renew("r", "proc:0", 4)       # newer epoch renews
+        assert table.get("r").epoch == 4
+
+    def test_release_is_owner_gated(self):
+        table, _ = self._table()
+        table.acquire("r", "proc:0", 1, ttl_s=5.0)
+        assert not table.release("r", "proc:1")
+        assert table.release("r", "proc:0")
+        assert table.get("r") is None
+        assert not table.release("r", "proc:0")
+
+    def test_renewals_counted(self):
+        metrics = MetricsRegistry()
+        table = LeaseTable(metrics=metrics, clock=lambda: 0.0)
+        table.acquire("r", "proc:0", 1, ttl_s=5.0)
+        table.renew("r", "proc:0", 1)
+        table.renew("r", "proc:0", 1)
+        assert metrics.counter("lease.renewals").value == 2
+
+
+class TestElectSuccessor:
+    def test_lowest_healthy_rank_wins(self):
+        healthy = {0: False, 1: True, 2: True}
+        assert elect_successor(healthy) == 1
+
+    def test_failed_owner_excluded(self):
+        healthy = {0: True, 1: True}
+        assert elect_successor(healthy, exclude=0) == 1
+
+    def test_no_survivors(self):
+        assert elect_successor({0: False}, exclude=1) is None
+
+
+# ---------------------------------------------------------------------------
+# replay output barrier + straggler dedup
+# ---------------------------------------------------------------------------
+
+class TestReplayBarrier:
+    def test_budget_consumed_per_tenant(self):
+        barrier = ReplayBarrier(metrics=MetricsRegistry())
+        barrier.arm({"a": 3, "b": 1, "empty": 0})
+        assert barrier.active() and barrier.active("a")
+        assert not barrier.active("empty")
+        assert barrier.take("a", 2) == 2
+        assert barrier.remaining("a") == 1
+        # boundary: a 2-event record against a 1-row budget takes 1 —
+        # the caller persists anyway (at-least-once straggler)
+        assert barrier.take("a", 2) == 1
+        assert not barrier.active("a")
+        assert barrier.take("b", 1) == 1
+        assert not barrier.active()       # all budgets drained -> disarm
+        assert barrier.take("b", 1) == 0
+        assert barrier.suppressed == 4
+
+    def test_watermarks_ride_the_barrier(self):
+        barrier = ReplayBarrier(metrics=MetricsRegistry())
+        barrier.arm({"a": 2}, watermarks={"a": {"p1": 7}})
+        assert barrier.watermarks("a") == {"p1": 7}
+        assert barrier.watermarks("other") == {}
+        barrier.disarm()
+        assert not barrier.active()
+        assert barrier.watermarks("a") == {}
+
+    def test_unarmed_take_is_free(self):
+        barrier = ReplayBarrier(metrics=MetricsRegistry())
+        assert not barrier.active("a")
+        assert barrier.take("a", 10) == 0
+        assert barrier.suppressed == 0
+
+
+class TestSequenceWatermarkDeduplicator:
+    def _request(self, prefix=None, seq=None):
+        from sitewhere_tpu.model.event import DeviceEventBatch
+        from sitewhere_tpu.sources.decoders import DecodedRequest
+
+        meta = {}
+        if prefix is not None:
+            meta = {"id_prefix": prefix, "id_seq": seq}
+        return DecodedRequest(device_token="d0",
+                              request=DeviceEventBatch(device_token="d0"),
+                              metadata=meta)
+
+    def test_watermarked_rows_drop_live_rows_pass(self):
+        from sitewhere_tpu.sources.dedup import (
+            SequenceWatermarkDeduplicator)
+
+        dedup = SequenceWatermarkDeduplicator({"p1": 10})
+        assert dedup.is_duplicate(self._request("p1", 10))   # at mark
+        assert dedup.is_duplicate(self._request("p1", 3))    # below
+        assert not dedup.is_duplicate(self._request("p1", 11))
+        assert not dedup.is_duplicate(self._request("p2", 1))
+        assert not dedup.is_duplicate(self._request())  # no metadata
+
+    def test_observe_merge_export(self):
+        from sitewhere_tpu.sources.dedup import (
+            SequenceWatermarkDeduplicator)
+
+        dedup = SequenceWatermarkDeduplicator()
+        dedup.observe("p1", 5)
+        dedup.observe("p1", 3)          # never regresses
+        dedup.merge({"p1": 9, "p2": 2})
+        assert dedup.export() == {"p1": 9, "p2": 2}
+        dedup.remember(self._request("p2", 7))
+        assert dedup.is_duplicate_row("p2", 7)
+
+
+class TestDedupWindowRideAlong:
+    def _request(self, alt):
+        from sitewhere_tpu.model.event import (
+            DeviceEventBatch, DeviceMeasurement)
+        from sitewhere_tpu.sources.decoders import DecodedRequest
+
+        return DecodedRequest(
+            device_token="d0",
+            request=DeviceEventBatch(device_token="d0", measurements=[
+                DeviceMeasurement(name="m", value=1.0, alternate_id=alt)]))
+
+    def test_export_restore_preserves_window(self):
+        from sitewhere_tpu.sources.dedup import AlternateIdDeduplicator
+
+        dedup = AlternateIdDeduplicator(window=10)
+        for i in range(4):
+            dedup.remember(self._request(f"alt-{i}"))
+        exported = dedup.export_window()
+        assert exported == [f"alt-{i}" for i in range(4)]
+        # truncation keeps the NEWEST ids (the ones most likely to recur)
+        assert dedup.export_window(limit=2) == ["alt-2", "alt-3"]
+
+        revived = AlternateIdDeduplicator(window=10)
+        revived.restore_window(exported)
+        assert revived.is_duplicate(self._request("alt-1"))
+        assert not revived.is_duplicate(self._request("alt-9"))
+
+    def test_seed_registry_hands_off_across_boot(self):
+        stash_dedup_seeds({"tenant-a": {"src-1": ["x", "y"]}})
+        assert take_dedup_seed("tenant-a", "src-1") == ["x", "y"]
+        assert take_dedup_seed("tenant-a", "src-1") is None  # claimed
+        assert take_dedup_seed("tenant-a", "other") is None
+
+    def test_source_start_claims_seed(self):
+        from sitewhere_tpu.runtime.bus import EventBus
+        from sitewhere_tpu.sources.dedup import AlternateIdDeduplicator
+        from sitewhere_tpu.sources.manager import InboundEventSource
+
+        stash_dedup_seeds({"default": {"seeded": ["alt-a", "alt-b"]}})
+        bus = EventBus(partitions=1)
+        try:
+            source = InboundEventSource(
+                "seeded", None, [], bus,
+                deduplicator=AlternateIdDeduplicator(window=10))
+            source.start()
+            try:
+                assert source.deduplicator.is_duplicate(
+                    self._request("alt-a"))
+            finally:
+                source.stop()
+        finally:
+            bus.close()
+
+
+# ---------------------------------------------------------------------------
+# health ladder ring (satellite)
+# ---------------------------------------------------------------------------
+
+class TestHealthRing:
+    def test_recent_transitions_recorded(self):
+        from sitewhere_tpu.runtime.health import EngineHealth
+
+        health = EngineHealth("t", metrics=MetricsRegistry())
+        health.note_retry()
+        health.note_fatal()
+        recent = health.recent_transitions()
+        assert [r["state"] for r in recent] == ["degraded", "failed"]
+        assert all(r["cause"] for r in recent)
+        assert health.to_json()["recent"] == recent
+
+
+# ---------------------------------------------------------------------------
+# busnet fencing protocol + released-partition replay (satellites)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def busnet_server(tmp_path):
+    from sitewhere_tpu.runtime.bus import EventBus
+    from sitewhere_tpu.runtime.busnet import BusServer
+
+    bus = EventBus(partitions=4, data_dir=str(tmp_path / "bus"))
+    srv = BusServer(bus)
+    srv.start()
+    yield bus, srv
+    srv.stop()
+    bus.close()
+
+
+class TestBusNetFencing:
+    def test_stale_epoch_rpc_rejected_structured(self, busnet_server):
+        from sitewhere_tpu.runtime.busnet import (
+            BusClient, BusNetError, StaleEpochBusError)
+
+        bus, srv = busnet_server
+        live = BusClient("127.0.0.1", srv.port)
+        live.set_epoch("proc:9", 3)
+        live.publish("t.x", b"k", b"v")          # learns floor 3
+        zombie = BusClient("127.0.0.1", srv.port)
+        zombie.set_epoch("proc:9", 2)            # pre-crash incarnation
+        with pytest.raises(StaleEpochBusError) as err:
+            zombie.publish("t.x", b"k", b"stale")
+        assert err.value.resource == "proc:9"
+        assert err.value.epoch == 2
+        assert err.value.floor == 3
+        # catchable as the transport error family too (non-retryable ride)
+        assert isinstance(err.value, BusNetError)
+        assert isinstance(err.value, StaleEpochError)
+        assert srv.fence.rejected >= 1
+        # only the zombie's write was rejected
+        assert bus.topic("t.x").end_offsets() == [
+            n for n in bus.topic("t.x").end_offsets()]
+        assert sum(bus.topic("t.x").end_offsets()) == 1
+        live.close()
+        zombie.close()
+
+    def test_fence_rpc_raises_floor_and_remint_readmits(self,
+                                                        busnet_server):
+        from sitewhere_tpu.runtime.busnet import (
+            BusClient, StaleEpochBusError)
+
+        bus, srv = busnet_server
+        writer = BusClient("127.0.0.1", srv.port)
+        writer.set_epoch("proc:4", 5)
+        writer.publish("t.y", b"k", b"v1")
+        # takeover broadcast: successor fences the old owner at floor 6
+        successor = BusClient("127.0.0.1", srv.port)
+        assert successor.fence("proc:4", 6) == 6
+        with pytest.raises(StaleEpochBusError):
+            writer.publish("t.y", b"k", b"zombie")
+        # restart mints epoch 6 (= floor): re-admitted, no operator step
+        writer.set_epoch("proc:4", 6)
+        writer.publish("t.y", b"k", b"v2")
+        assert sum(bus.topic("t.y").end_offsets()) == 2
+        writer.close()
+        successor.close()
+
+    def test_unstamped_clients_unaffected(self, busnet_server):
+        from sitewhere_tpu.runtime.busnet import BusClient
+
+        bus, srv = busnet_server
+        srv.fence.fence("proc:1", 99)
+        plain = BusClient("127.0.0.1", srv.port)
+        plain.publish("t.z", b"k", b"v")  # no identity -> always admits
+        assert sum(bus.topic("t.z").end_offsets()) == 1
+        plain.close()
+
+
+class TestReleasedPartitionReplay:
+    def test_member_leave_replays_uncommitted_on_next_owner(
+            self, busnet_server):
+        """Pins _GroupCoordinator.leave_all: a departing member's
+        partitions re-seek to committed, so its in-flight (uncommitted)
+        records redeliver to the surviving owner — rebalance loses
+        nothing."""
+        import time as _time
+
+        from sitewhere_tpu.runtime.busnet import BusClient
+
+        bus, srv = busnet_server
+        a = BusClient("127.0.0.1", srv.port)
+        b = BusClient("127.0.0.1", srv.port)
+        # join the group (each poll registers membership + splits parts)
+        a.poll("t.stream", "g", timeout_s=0.1)
+        b.poll("t.stream", "g", timeout_s=0.1)
+        for i in range(20):
+            a.publish("t.stream", b"k%d" % i, b"v%d" % i)
+        got_a = a.poll("t.stream", "g", timeout_s=2.0)
+        got_b = b.poll("t.stream", "g", timeout_s=2.0)
+        assert len(got_a) + len(got_b) == 20
+        assert got_a and got_b            # both members own partitions
+        a.commit("t.stream", "g")
+        # b LEAVES with its batch uncommitted (crash): its partitions
+        # must replay from committed for the next owner
+        b_values = {r.value for r in got_b}
+        b.close()
+        survivors = []
+        deadline = _time.time() + 5.0
+        while _time.time() < deadline and len(survivors) < len(got_b):
+            survivors.extend(a.poll("t.stream", "g", timeout_s=0.5))
+        assert {r.value for r in survivors} == b_values
+        a.close()
+
+
+# ---------------------------------------------------------------------------
+# takeover state machine (deterministic, injected clock)
+# ---------------------------------------------------------------------------
+
+class TestTakeoverMonitor:
+    def _monitor(self, peers, fenced, took, epoch=5):
+        from sitewhere_tpu.parallel.cluster import TakeoverMonitor
+
+        clk = [0.0]
+        monitor = TakeoverMonitor(
+            0, peer_states=lambda: {k: dict(v) for k, v in peers.items()},
+            epoch_of=lambda: epoch,
+            on_takeover=lambda r, e: took.append((r, e)),
+            fence_hooks=[lambda o, ep: fenced.append((o, ep))],
+            ttl_s=6.0, clock=lambda: clk[0])
+        return monitor, clk
+
+    def _peer(self, rank, epoch, stale=False, health="healthy"):
+        return {"process_id": rank, "stale": stale, "health": health,
+                "leases": {f"shard-group:{rank}": epoch}}
+
+    def test_lapsed_lease_takes_over_once_at_fenced_epoch(self):
+        fenced, took = [], []
+        peers = {"1": self._peer(1, 3)}
+        monitor, clk = self._monitor(peers, fenced, took)
+        assert monitor.check_once() == []          # mirror while healthy
+        assert monitor.leases.holder("shard-group:1",
+                                     now=clk[0]) == "proc:1"
+        peers["1"]["stale"] = True
+        clk[0] = 10.0                              # lapse the mirror
+        events = monitor.check_once()
+        assert len(events) == 1
+        assert events[0]["op"] == "takeover"
+        assert events[0]["fenced_epoch"] == 4      # old epoch + 1
+        assert fenced == [("proc:1", 4)]
+        assert took and took[0][0] == "shard-group:1"
+        assert monitor.leases.holder("shard-group:1",
+                                     now=clk[0]) == "proc:0"
+        # idempotent: held by self now, no repeat
+        assert monitor.check_once() == []
+        assert monitor.snapshot()["takeovers"] >= 1
+        # the heartbeat advertisement carries the stolen resource
+        assert "shard-group:1" in monitor.lease_advertisement()
+
+    def test_failed_health_takes_over_live_lease(self):
+        fenced, took = [], []
+        peers = {"1": self._peer(1, 4)}
+        monitor, clk = self._monitor(peers, fenced, took)
+        monitor.check_once()
+        peers["1"]["health"] = "failed"            # fresh but failed
+        events = monitor.check_once()
+        assert len(events) == 1
+        assert events[0]["fenced_epoch"] == 5
+        # still failed on later ticks: no ownership flapping
+        assert monitor.check_once() == []
+        assert monitor.check_once() == []
+
+    def test_recovered_owner_gets_handback(self):
+        fenced, took = [], []
+        peers = {"1": self._peer(1, 3)}
+        monitor, clk = self._monitor(peers, fenced, took)
+        monitor.check_once()
+        peers["1"]["stale"] = True
+        clk[0] = 10.0
+        monitor.check_once()
+        assert "shard-group:1" in monitor.taken
+        # restart: mints epoch 4 (the fenced floor) and heartbeats fresh
+        peers["1"] = self._peer(1, 4)
+        clk[0] = 11.0
+        assert monitor.check_once() == []
+        assert monitor.taken == set()
+        assert monitor.leases.holder("shard-group:1",
+                                     now=clk[0]) == "proc:1"
+        ops = [e["op"] for e in monitor.snapshot()["takeover_events"]]
+        assert ops == ["takeover", "handback"]
+
+    def test_only_deterministic_successor_acts(self):
+        from sitewhere_tpu.parallel.cluster import TakeoverMonitor
+
+        # rank 2's view: ranks 0 (lowest healthy) wins the election, so
+        # rank 2 must NOT take over even though it sees the same lapse
+        clk = [0.0]
+        peers = {"0": self._peer(0, 2), "1": self._peer(1, 3)}
+        monitor = TakeoverMonitor(
+            2, peer_states=lambda: {k: dict(v) for k, v in peers.items()},
+            epoch_of=lambda: 9, ttl_s=6.0, clock=lambda: clk[0])
+        monitor.check_once()
+        peers["1"]["stale"] = True
+        clk[0] = 10.0
+        # peer 0 still fresh: re-advertise so its mirror doesn't lapse
+        assert monitor.check_once() == []
+        assert monitor.taken == set()
+
+
+# ---------------------------------------------------------------------------
+# checkpoint manifest fencing
+# ---------------------------------------------------------------------------
+
+def _small_engine():
+    from sitewhere_tpu.model import Device, DeviceAssignment, DeviceType
+    from sitewhere_tpu.pipeline.engine import PipelineEngine
+    from sitewhere_tpu.registry import DeviceManagement, RegistryTensors
+
+    dm = DeviceManagement()
+    dt = dm.create_device_type(DeviceType(token="t"))
+    tensors = RegistryTensors(max_devices=16, max_zones=2,
+                              max_zone_vertices=4)
+    tensors.attach(dm, "tenant")
+    d = dm.create_device(Device(token="d0", device_type_id=dt.id))
+    dm.create_device_assignment(DeviceAssignment(token="a0",
+                                                 device_id=d.id))
+    engine = PipelineEngine(tensors, batch_size=8)
+    engine.start()
+    return engine
+
+
+class TestCheckpointFencing:
+    def test_stale_writer_save_fenced(self, tmp_path):
+        import json
+        import os
+
+        from sitewhere_tpu.persist.checkpoint import (
+            PipelineCheckpointer, SiteWhereCheckpointError)
+
+        engine = _small_engine()
+        current = PipelineCheckpointer(str(tmp_path))
+        current.recovery_epoch = 3
+        path = current.save(engine)
+        with open(os.path.join(path, "manifest.json"),
+                  encoding="utf-8") as fh:
+            assert json.load(fh)["recovery_epoch"] == 3
+
+        zombie = PipelineCheckpointer(str(tmp_path))
+        zombie.recovery_epoch = 2        # pre-takeover incarnation
+        with pytest.raises(SiteWhereCheckpointError, match="fenced"):
+            zombie.save(engine)
+        # the surviving owner keeps saving
+        assert current.save(engine)
+
+    def test_restore_reports_manifest_epoch(self, tmp_path):
+        from sitewhere_tpu.persist.checkpoint import PipelineCheckpointer
+
+        engine = _small_engine()
+        ckpt = PipelineCheckpointer(str(tmp_path))
+        ckpt.recovery_epoch = 4
+        ckpt.save(engine)
+
+        revived = PipelineCheckpointer(str(tmp_path))
+        revived.recovery_epoch = 5
+        assert revived.last_restore_epoch is None
+        revived.restore(_small_engine())
+        assert revived.last_restore_epoch == 4
