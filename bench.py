@@ -67,6 +67,7 @@ _COMPACT_KEYS = (
     "latency_mode_p50_ms", "latency_mode_p99_ms",
     "latency_mode_trial_p99_ms", "latency_mode",
     "latency_fetch", "materialize_lane_speedup_x",
+    "age_p50_ms", "age_p99_ms", "telemetry_overhead_pct",
     "telemetry_packed_events_per_sec",
     "persist_events_per_sec", "analytics_replay_events_per_sec",
     "sharded_1chip_events_per_sec", "sharded_from_bytes_events_per_sec",
@@ -612,7 +613,11 @@ def _t_latency(jax, ctx) -> Dict:
 
     def one_offer() -> float:
         t0 = time.perf_counter()
-        fut = batcher.offer(events, tokens)
+        # stamp the delivery like a receiver would (sources/receivers.py
+        # received_at): the batcher carries the stamp into an AgeSidecar
+        # so the flight records + age histogram cover the bench offers —
+        # age_p50/p99_ms below come out of exactly the deployed path
+        fut = batcher.offer(events, tokens, received_at=t0)
         alerts = []
         for batch, outputs in fut.result(timeout=60.0):
             # materialize_alerts' single batched device_get blocks on the
@@ -628,7 +633,13 @@ def _t_latency(jax, ctx) -> Dict:
     # latency_fetch_budget pins it)
     f0, b0 = engine.d2h_fetches, engine.d2h_bytes
     samples = [one_offer() for _ in range(ctx["SYNC_STEPS"] * 2)]
+    # ingest->materialize age waterfall over this trial's window, read
+    # back from the flight recorder the way GET /api/instance/flight
+    # serves it (closed AgeSummary ride-alongs merged in _rollups)
+    age = (engine.flight.export(last_n=256).get("rollups") or {}).get(
+        "event_age") or {}
     return {"lat_s": samples,
+            "age": age,
             "d2h_fetches": engine.d2h_fetches - f0,
             "d2h_bytes": engine.d2h_bytes - b0,
             "offers": len(samples)}
@@ -778,6 +789,23 @@ def _t_sync(jax, ctx) -> Dict:
             r.begin_stage(st)
             r.end_stage(st)
     recorder_overhead_s = (time.perf_counter() - o0) / K
+    # event-age telemetry self-cost: per step the hot path pays one
+    # sidecar stamp at ingest, one pure close() at materialize, and one
+    # aggregate bucket-fold into the labeled histogram — probe the full
+    # set on a private registry for perf_gate's `telemetry_overhead` pin
+    # (< 1% of step wall)
+    from sitewhere_tpu.runtime.eventage import (
+        AgeSidecar, age_histogram, observe_summary)
+    from sitewhere_tpu.runtime.metrics import MetricsRegistry as _ProbeReg
+    probe_hist = age_histogram(_ProbeReg())
+    stamp = time.perf_counter() - 0.005
+    a0 = time.perf_counter()
+    for _ in range(K):
+        sc = AgeSidecar()
+        sc.add(stamp, 2048)
+        observe_summary(probe_hist, sc.close(), engine="overhead-probe",
+                        edge="materialize")
+    telemetry_overhead_s = (time.perf_counter() - a0) / K
     # disarmed robustness-plane cost: the hot path crosses ~4 fault
     # points per step plus one admission check per ingest request; probe
     # both disarmed (runtime/faults.py compiles fault_point to a global
@@ -843,6 +871,7 @@ def _t_sync(jax, ctx) -> Dict:
             "h2d_s": [r.stage_s("h2d") for r in recs],
             "device_s": [r.stage_s("device_compute") for r in recs],
             "recorder_overhead_s": [recorder_overhead_s],
+            "telemetry_overhead_s": [telemetry_overhead_s],
             "fault_overhead_s": [fault_overhead_s],
             "fencing_overhead_s": [fencing_overhead_s],
             "takeover_mechanics_s": [takeover_mechanics_s]}
@@ -1684,6 +1713,18 @@ def _aggregate(jax, ctx, trials: Dict[str, List[Dict]],
         "critical_stage": max(crit, key=crit.get) if crit else "",
     }
 
+    # event-age telemetry: the ingest->materialize waterfall measured
+    # through the latency tier's deployed path (receiver stamp -> sidecar
+    # -> close at materialize), plus the telemetry plane's own per-step
+    # cost (sidecar + close + histogram fold; perf_gate
+    # telemetry_overhead pins it < 1% of step wall). Best-count trial:
+    # the summary with the widest window describes the path best.
+    telemetry_overhead_s = min(
+        x for t in trials["sync"] for x in t["telemetry_overhead_s"])
+    ages = [t.get("age") or {} for t in trials["latency"]]
+    event_age = (max(ages, key=lambda a: a.get("count", 0))
+                 if ages else {})
+
     # robustness plane: disarmed fault points + a disabled admission
     # check, per step crossing (perf_gate fault_injection_overhead pins
     # the sum < 0.5% of step wall). Same min-of-trials policy as the
@@ -1779,6 +1820,16 @@ def _aggregate(jax, ctx, trials: Dict[str, List[Dict]],
             rule_lat[int(len(rule_lat) * 0.99)] * 1000, 3),
         "step_breakdown": step_breakdown,
         "flight": flight,
+        # ingest->materialize event-age waterfall through the deployed
+        # latency path (full summary with buckets in the sidecar; the
+        # p50/p99 scalars ride the compact line for the perf gate's
+        # advisory age_p99_budget_ms)
+        "event_age": event_age,
+        "age_p50_ms": round(float(event_age.get("p50_ms", 0.0)), 3),
+        "age_p99_ms": round(float(event_age.get("p99_ms", 0.0)), 3),
+        "telemetry_overhead_pct": round(
+            telemetry_overhead_s * 1000 / sync_total_ms * 100, 4)
+        if sync_total_ms else 0.0,
         "faults": faults,
         "fencing": fencing,
         # ingest + durable persist + enriched consumer, concurrently (the

@@ -98,7 +98,8 @@ def _build_instance(cfg, mesh=None):
         admission_queue_depth_budget=(
             int(cfg.get("faults.admission_queue_depth_budget"))
             if cfg.get("faults.admission_queue_depth_budget") is not None
-            else None))
+            else None),
+        trace_sample_n=int(cfg.get("observability.trace_sample_n") or 0))
 
 
 def _apply_rule_config(instance, cfg) -> None:

@@ -62,10 +62,15 @@ class SiteWhereInstance(LifecycleComponent):
                  allow_fault_drills: bool = False,
                  fault_plan: Optional[Dict] = None,
                  admission_step_budget_ms: Optional[float] = None,
-                 admission_queue_depth_budget: Optional[int] = None):
+                 admission_queue_depth_budget: Optional[int] = None,
+                 trace_sample_n: int = 0):
         super().__init__(f"instance:{instance_id}")
         self.instance_id = instance_id
         self.data_dir = data_dir
+        # observability.trace_sample_n: sample 1 in N ingest deliveries
+        # into a journey span stitched across busnet hops (0 disables);
+        # ingest services read this at construction (sources/fastlane.py)
+        self.trace_sample_n = int(trace_sample_n or 0)
         # multi-host deployment hooks (parallel/cluster.py ClusterService
         # installs itself here BEFORE start(); tenant engines pass it into
         # their inbound processors for ownership routing + lockstep feeds)
@@ -635,6 +640,10 @@ class SiteWhereInstance(LifecycleComponent):
                 # degradation ladder (runtime/health.py):
                 # healthy -> degraded -> draining -> failed
                 out["pipeline_health"] = health.to_json()
+            # HBM residency ledger (runtime/hbmledger.py): per-table
+            # resident bytes + backend headroom for capacity planning
+            from sitewhere_tpu.runtime import hbmledger
+            out["hbm"] = hbmledger.ledger(self.pipeline_engine)
         from sitewhere_tpu.sources.manager import GLOBAL_ADMISSION
         if GLOBAL_ADMISSION.enabled:
             out["admission"] = GLOBAL_ADMISSION.report()
@@ -661,3 +670,68 @@ class SiteWhereInstance(LifecycleComponent):
             if monitor is not None:
                 recovery.update(monitor.snapshot())
         return out
+
+    def extra_gauges(self) -> Dict[str, float]:
+        """Derived gauges folded into the Prometheus exposition alongside
+        the registry's own metrics: engine counters (one on-demand D2H
+        fetch for the per-program/per-model vectors), cluster replication
+        stats, the failover epoch, and the HBM residency ledger. Shared by
+        GET /metrics and the cluster telemetry fan-in, so every peer's
+        snapshot carries the same gauge families."""
+        extra: Dict[str, float] = {}
+        engine = self.pipeline_engine
+        if engine is not None:
+            extra["pipeline.batches_processed"] = engine.batches_processed
+            extra["pipeline.alerts_dropped"] = engine.alerts_dropped
+            health = getattr(engine, "health", None)
+            if health is not None:
+                # 0=healthy 1=degraded 2=draining 3=failed
+                extra["pipeline.health_state"] = health.code
+            for ptoken, c in engine.rule_program_counters().items():
+                extra[f"pipeline.rule_program.fires.{ptoken}"] = c["fires"]
+                extra[f"pipeline.rule_program.suppressed.{ptoken}"] = \
+                    c["suppressed"]
+            for mtoken, c in engine.anomaly_model_counters().items():
+                extra[f"pipeline.anomaly_model.fires.{mtoken}"] = c["fires"]
+                extra[f"pipeline.anomaly_model.evals.{mtoken}"] = c["evals"]
+            # HBM residency: hbm.table_bytes{table="..."} per resident
+            # table + hbm.total_bytes (host-side nbytes walk, no device
+            # sync — runtime/hbmledger.py)
+            from sitewhere_tpu.runtime import hbmledger
+            extra.update(hbmledger.export_gauges(engine))
+        hooks = self.cluster_hooks
+        if hooks is not None:
+            gossip = hooks.gossip
+            if gossip is not None:
+                extra.update({
+                    "cluster.gossip.published": gossip.published,
+                    "cluster.gossip.applied": gossip.applied,
+                    "cluster.gossip.conflicts": gossip.conflicts,
+                    "cluster.gossip.publish_errors": gossip.publish_errors,
+                })
+            provisioning = getattr(hooks, "provisioning", None)
+            if provisioning is not None:
+                extra.update({
+                    "cluster.provisioning.published":
+                        provisioning.published,
+                    "cluster.provisioning.applied": provisioning.applied,
+                    "cluster.provisioning.publish_errors":
+                        provisioning.publish_errors,
+                    "cluster.provisioning.parked_rows":
+                        provisioning.parked_rows,
+                })
+            if getattr(hooks, "data_plane", True):
+                extra["cluster.forwarded_rows"] = hooks.forwarder.forwarded
+                extra["cluster.forward_dead_lettered"] = \
+                    hooks.forwarder.dead_lettered
+                extra["cluster.step_ticks"] = hooks.loop.tick_count
+            extra["cluster.degraded_peers"] = len(hooks.degraded)
+        # failover epoch (runtime/recovery.py): lets dashboards graph
+        # restarts/takeovers as step changes and alert on epoch skew
+        extra["recovery.epoch"] = float(getattr(self, "recovery_epoch", 0))
+        return extra
+
+    def prometheus_text(self) -> str:
+        """Full Prometheus exposition for this process: registry metrics
+        plus every derived gauge from extra_gauges()."""
+        return self.metrics.prometheus_text(self.extra_gauges())

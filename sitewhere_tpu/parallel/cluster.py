@@ -1567,6 +1567,90 @@ def _annotate_recovery_state(cluster, state: Dict) -> None:
 
 
 # ---------------------------------------------------------------------------
+# cluster telemetry fan-in (busnet `telemetry` op + /api/cluster/telemetry)
+# ---------------------------------------------------------------------------
+
+def _telemetry_snapshot(instance, process_id: int) -> Dict:
+    """One process's telemetry payload: metrics report + full Prometheus
+    exposition (instance.extra_gauges families included), the flight
+    recorder's window rollups, and the event-age waterfall when the
+    window saw stamped batches. This is what the busnet `telemetry` op
+    serves to peers — all host-side reads, no device sync, so a peer's
+    scrape never perturbs this host's step loop."""
+    from sitewhere_tpu.runtime.flight import GLOBAL_FLIGHT
+
+    rollups = GLOBAL_FLIGHT.export(last_n=64).get("rollups", {})
+    out = {
+        "process_id": int(process_id),
+        "instance_id": instance.instance_id,
+        "status": instance.status.name,
+        "metrics": instance.metrics.report(),
+        "prometheus_text": instance.prometheus_text(),
+        "flight_rollups": rollups,
+    }
+    age = rollups.get("event_age")
+    if age:
+        out["event_age"] = age
+    return out
+
+
+def _inject_peer_label(line: str, pid: str) -> str:
+    """`name{edge="x"} 1` -> `name{edge="x",peer="<pid>"} 1` (and bare
+    `name 1` grows a label block). Label VALUES in this codebase are
+    tokens (engine names, table names, edges) — never contain spaces —
+    so splitting on the first space is safe."""
+    name_part, _, rest = line.partition(" ")
+    if not rest:
+        return line
+    if name_part.endswith("}") and "{" in name_part:
+        base, _, labels = name_part.partition("{")
+        labels = labels[:-1]
+        name_part = (f'{base}{{{labels},peer="{pid}"}}' if labels
+                     else f'{base}{{peer="{pid}"}}')
+    else:
+        name_part = f'{name_part}{{peer="{pid}"}}'
+    return f"{name_part} {rest}"
+
+
+def _cluster_telemetry(cluster) -> Dict:
+    """Fan out over busnet and merge: local snapshot + every reachable
+    peer's, keyed by process id, plus one merged Prometheus exposition
+    with a peer="<pid>" label injected into every sample (header lines
+    deduplicated across peers). Unreachable peers land in `stale_peers`
+    instead of failing the whole view — during an incident a partial
+    waterfall is exactly what the operator needs."""
+    processes: Dict[str, Dict] = {
+        str(cluster.process_id): _telemetry_snapshot(cluster.instance,
+                                                     cluster.process_id)}
+    stale: List[str] = []
+    for pid, client in sorted(cluster.peers.items()):
+        try:
+            processes[str(pid)] = client.telemetry()
+        except (BusNetError, OSError) as exc:
+            LOGGER.warning("telemetry fan-in: peer %d unreachable (%s)",
+                           pid, exc)
+            stale.append(str(pid))
+    merged: List[str] = []
+    seen_headers = set()
+    for pid in sorted(processes, key=int):
+        for line in (processes[pid].get("prometheus_text") or
+                     "").splitlines():
+            if line.startswith("#"):
+                if line not in seen_headers:
+                    seen_headers.add(line)
+                    merged.append(line)
+            elif line:
+                merged.append(_inject_peer_label(line, pid))
+    return {
+        "process_id": cluster.process_id,
+        "num_processes": cluster.num_processes,
+        "processes": processes,
+        "stale_peers": stale,
+        "prometheus_text": "\n".join(merged) + ("\n" if merged else ""),
+    }
+
+
+# ---------------------------------------------------------------------------
 # composition root: one cluster host
 # ---------------------------------------------------------------------------
 
@@ -1617,6 +1701,10 @@ class ClusterService:
         naming = instance.naming
         self.bus_server = BusServer(instance.bus, host=bus_host,
                                     port=bus_port)
+        # serve this host's telemetry snapshot to peers (the fan-in for
+        # GET /api/cluster/telemetry rides the existing bus edge)
+        self.bus_server.telemetry_provider = (
+            lambda: _telemetry_snapshot(instance, process_id))
         self.peers: Dict[int, BusClient] = {}
         for pid, addr in (peer_bus_addrs or {}).items():
             if int(pid) != process_id:
@@ -1853,6 +1941,10 @@ class ClusterService:
             out[me] = state
         return out
 
+    def cluster_telemetry(self) -> Dict:
+        """Cluster-wide telemetry fan-in (GET /api/cluster/telemetry)."""
+        return _cluster_telemetry(self)
+
 
 # ---------------------------------------------------------------------------
 # control-plane-only cluster (no SPMD mesh)
@@ -1893,6 +1985,10 @@ class ControlPlaneCluster:
         naming = instance.naming
         self.bus_server = BusServer(instance.bus, host=bus_host,
                                     port=bus_port)
+        # peer telemetry for GET /api/cluster/telemetry (same fan-in as
+        # the SPMD cluster — the control plane has a bus edge too)
+        self.bus_server.telemetry_provider = (
+            lambda: _telemetry_snapshot(instance, process_id))
         self.peers: Dict[int, BusClient] = {}
         for pid, addr in (peer_bus_addrs or {}).items():
             if int(pid) != process_id:
@@ -2002,3 +2098,7 @@ class ControlPlaneCluster:
             state["stale"] = False
             out[me] = state
         return out
+
+    def cluster_telemetry(self) -> Dict:
+        """Cluster-wide telemetry fan-in (GET /api/cluster/telemetry)."""
+        return _cluster_telemetry(self)

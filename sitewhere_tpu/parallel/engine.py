@@ -635,7 +635,8 @@ class ShardedPipelineEngine(PipelineEngine):
 
     # -- processing -----------------------------------------------------------
 
-    def submit(self, batch: EventBatch) -> Tuple[EventBatch, ProcessOutputs]:
+    def submit(self, batch: EventBatch, age=None
+               ) -> Tuple[EventBatch, ProcessOutputs]:
         """Route a flat host batch (global indices, any length) to shards and
         run one collective step. Returns (the LAST routed batch with a
         [S, B] layout, outputs of the last step). Events overflowing a
@@ -656,7 +657,7 @@ class ShardedPipelineEngine(PipelineEngine):
         # a pooled routed blob) remains the fallback for skewed batches
         # that would overflow a device lane — and the only path on
         # single-chip meshes and multi-host clusters.
-        prepared, over_rows = self._prepare_step(batch)
+        prepared, over_rows = self._prepare_step(batch, age=age)
         try:
             routed_batch, outputs = self._one_step(params, prepared)
         except BaseException:
@@ -692,7 +693,7 @@ class ShardedPipelineEngine(PipelineEngine):
             self.park_overflow(backlog, over_rows)
         return routed_batch, outputs
 
-    def _prepare_step(self, batch: EventBatch
+    def _prepare_step(self, batch: EventBatch, age=None
                       ) -> Tuple["_PreparedStep", np.ndarray]:
         """Host half of one step's routing decision. Device-routing mode:
         when the flat batch fits the mesh's fixed lanes (cheap bincount
@@ -702,6 +703,11 @@ class ShardedPipelineEngine(PipelineEngine):
         the host arena route, whose overflow rows requeue as always —
         the bounded, loudly-counted spill path."""
         rec = self.flight.begin_step(engine=self.name)
+        if age is not None:
+            # ingest-age sidecar rides the flight record through the
+            # stage_prepared/dispatch_staged handoffs (feeder threads);
+            # _materialize_routed closes it (runtime/eventage.py)
+            rec.age = age
         self._sample_tenant_mix(rec, batch)
         if self.device_routing and self._device_route_fits(batch):
             self.device_route_steps += 1
@@ -933,10 +939,10 @@ class ShardedPipelineEngine(PipelineEngine):
         self._foreign = (flat if getattr(self, "_foreign", None) is None
                          else concat_flat_batches([self._foreign, flat]))
 
-    def submit_routed(self, batch: EventBatch):
+    def submit_routed(self, batch: EventBatch, age=None):
         """See PipelineEngine.submit_routed: sharded submit already returns
         (routed [S, B] batch, outputs)."""
-        return self.submit(batch)
+        return self.submit(batch, age=age)
 
     def materialize_alerts(self, routed_batch: EventBatch,
                            outputs: ProcessOutputs,
@@ -1041,6 +1047,7 @@ class ShardedPipelineEngine(PipelineEngine):
                 self._stage_hist.observe(
                     rec.stage_s("materialize"),
                     engine=self.name, stage="materialize")
+                self._close_age(rec)
 
     def _account_route_dropped(self, dropped: int) -> None:
         """Defensive on-device route drop accounting (lane counts slot 3,

@@ -32,6 +32,7 @@ from sitewhere_tpu.pipeline.state_tensors import DeviceStateTensors, init_device
 from sitewhere_tpu.pipeline.step import PipelineParams, ProcessOutputs, check_presence, process_batch
 from sitewhere_tpu.registry.tensors import RegistryTensors
 from sitewhere_tpu.runtime.bus import jittered
+from sitewhere_tpu.runtime.eventage import age_histogram, observe_summary
 from sitewhere_tpu.runtime.faults import fault_point
 from sitewhere_tpu.runtime.lifecycle import LifecycleComponent
 from sitewhere_tpu.runtime.flight import GLOBAL_FLIGHT
@@ -353,6 +354,9 @@ class PipelineEngine(LifecycleComponent):
         self._tenant_hist = GLOBAL_METRICS.histogram(
             "pipeline.step_tenant_events",
             buckets=(1.0, 8.0, 64.0, 512.0, 4096.0, 32768.0))
+        # ingest->effect event-age histogram (runtime/eventage.py);
+        # ingest attaches an AgeSidecar per submit, materialize closes it
+        self._age_hist = age_histogram(GLOBAL_METRICS)
         self._flight_sample_every = 16
         from sitewhere_tpu.ops.geofence import resolve_geofence_impl
         self.geofence_impl = resolve_geofence_impl(
@@ -1099,13 +1103,18 @@ class PipelineEngine(LifecycleComponent):
                     self._blob_ring_guards[i] = guard
                     return
 
-    def submit(self, batch: EventBatch) -> ProcessOutputs:
-        """Run one fused step; state advances in place (donated)."""
+    def submit(self, batch: EventBatch, age=None) -> ProcessOutputs:
+        """Run one fused step; state advances in place (donated). `age`
+        is the optional ingest-age sidecar (runtime/eventage.py) the
+        caller opened at the receive edge — it rides the flight record
+        and is closed by materialize_alerts."""
         # single-transfer host->device staging (see ops.pack.batch_to_blob).
         # The flight record's "pack" segment keeps host staging visible
         # now that "dispatch" covers only the jit call (pack used to be
         # inside it); the staging-ring guard wait is marked separately.
         rec = self.flight.begin_step(engine=self.name)
+        if age is not None:
+            rec.age = age
         # buffer acquisition first: its ring-guard wait is the "guard"
         # segment and must not nest inside (double-count with) "pack"
         out_buf = self._staging_blob_buffer(batch, flight_rec=rec)
@@ -1212,14 +1221,14 @@ class PipelineEngine(LifecycleComponent):
                 self.health.note_retry()
                 time.sleep(jittered(0.01 * (2 ** (attempt - 1))))
 
-    def submit_routed(self, batch: EventBatch):
+    def submit_routed(self, batch: EventBatch, age=None):
         """Engine-agnostic submit: returns (batch_for_materialization,
         outputs) on both engine kinds. The sharded engine's submit already
         returns its routed [S, B] batch; here the input batch doubles as the
         materialization batch. Callers that support either engine
         (pipeline/inbound.py, sources/fastlane.py) use this instead of
         type-sniffing submit()'s return."""
-        return batch, self.submit(batch)
+        return batch, self.submit(batch, age=age)
 
     def _fetch_lanes_with_retry(self, outputs: ProcessOutputs):
         """D2H lane fetch with the same bounded retry/backoff contract as
@@ -1293,6 +1302,21 @@ class PipelineEngine(LifecycleComponent):
                 self._stage_hist.observe(
                     rec.stage_s("materialize"),
                     engine=self.name, stage="materialize")
+                self._close_age(rec)
+
+    def _close_age(self, rec) -> None:
+        """Close the step's ingest-age sidecar at the materialize edge:
+        the open AgeSidecar resolves (pure close — the ingest service
+        re-closes the same sidecar at its persist/alert edges) into the
+        AgeSummary that replaces it on the record, feeding the rollup
+        ride-along and the (engine, edge) histogram."""
+        age = rec.age
+        if age is None or not hasattr(age, "close"):
+            return
+        summary = age.close()
+        rec.age = summary
+        observe_summary(self._age_hist, summary,
+                        engine=self.name, edge="materialize")
 
     def _account_lane_overflow(self, dropped: int) -> None:
         if not dropped:

@@ -103,9 +103,9 @@ class PipelinedSubmitter:
         self._step_thread.start()
 
     # -- producer ----------------------------------------------------------
-    def submit(self, batch: EventBatch) -> StepFuture:
+    def submit(self, batch: EventBatch, age=None) -> StepFuture:
         fut = StepFuture()
-        item = (self._alloc_seq(), batch, fut)
+        item = (self._alloc_seq(), batch, fut, age)
         # closure check and enqueue are atomic under _close_lock: close()
         # sets _stop under the same lock, so once close() proceeds to
         # drain, no producer can slip an item into the unattended queue
@@ -133,7 +133,7 @@ class PipelinedSubmitter:
     def _stage_loop(self) -> None:
         while not self._stop.is_set():
             try:
-                seq, batch, fut = self._in.get(timeout=0.1)
+                seq, batch, fut, age = self._in.get(timeout=0.1)
             except queue.Empty:
                 continue
             # Bound the staged-ahead window: without this the ready heap
@@ -159,6 +159,10 @@ class PipelinedSubmitter:
                 # thread; dispatch lands on the step thread; both sides
                 # share one monotonic clock so overlap is computable.
                 rec = self.engine.flight.begin_step(engine=self.engine.name)
+                if age is not None:
+                    # the ingest-age sidecar crosses threads on the record
+                    # itself, exactly like the stage timeline
+                    rec.age = age
                 buf = self.engine._staging_blob_buffer(batch, flight_rec=rec)
                 rec.begin_stage("pack")
                 blob = batch_to_blob(batch, out=buf)
@@ -266,7 +270,7 @@ class PipelinedSubmitter:
             while self._ready:
                 leftovers.append(heapq.heappop(self._ready))
         for item in leftovers:
-            fut = item[2] if len(item) == 3 else item[3]
+            fut = item[2] if len(item) == 4 else item[3]
             if not fut.done():
                 fut._resolve(error=RuntimeError("submitter closed"))
 
@@ -337,9 +341,9 @@ class ShardedPipelinedSubmitter:
         self._step_thread.start()
 
     # -- producer ----------------------------------------------------------
-    def submit(self, batch: EventBatch) -> StepFuture:
+    def submit(self, batch: EventBatch, age=None) -> StepFuture:
         fut = StepFuture()
-        item = (self._alloc_seq(), batch, fut)
+        item = (self._alloc_seq(), batch, fut, age)
         while True:
             with self._close_lock:
                 if self._stop.is_set():
@@ -361,7 +365,7 @@ class ShardedPipelinedSubmitter:
     def _stage_loop(self) -> None:
         while not self._stop.is_set():
             try:
-                seq, batch, fut = self._in.get(timeout=0.1)
+                seq, batch, fut, age = self._in.get(timeout=0.1)
             except queue.Empty:
                 continue
             # bound the staged-ahead window (see PipelinedSubmitter)
@@ -392,7 +396,7 @@ class ShardedPipelinedSubmitter:
                     # freeing stager CPU for persist/consumer work; the
                     # host arena route runs just for skewed spills
                     merged = eng.merge_pending_overflow(batch)
-                    prepared, over = eng._prepare_step(merged)
+                    prepared, over = eng._prepare_step(merged, age=age)
                     eng.park_overflow(merged, over)
                     prepped = [prepared]
                     # backpressure: route drain blobs (backlog only) as
@@ -551,6 +555,9 @@ class AdaptiveBatcher:
         self._events: List = []
         self._tokens: List[str] = []
         self._futures: List[StepFuture] = []
+        # (ingest stamp, event count) per offer — folded into one
+        # AgeSidecar at flush so the age waterfall sees linger time
+        self._ages: List = []
         self._oldest: Optional[float] = None
         self._stop = threading.Event()
         # steady-state accounting: flushes counts every engine flush this
@@ -590,13 +597,14 @@ class AdaptiveBatcher:
             self.warm_flushes = self.flushes
         return self.warm_flushes
 
-    def offer(self, events, tokens) -> StepFuture:
+    def offer(self, events, tokens, received_at=None) -> StepFuture:
         """Buffer events (parallel `tokens` list, one per event); the
         returned future resolves with the flush's list of
         (batch, outputs) pairs — one pair per engine batch the flush
         needed (usually one; a flush bigger than the engine batch packs
         into several) — once every fused step covering these rows has
-        been dispatched."""
+        been dispatched. `received_at` is the offer's ingest stamp
+        (time.perf_counter at the receive edge); None stamps now."""
         fut = StepFuture()
         if not events:
             fut._resolve([])  # nothing to wait for; don't arm the linger
@@ -606,6 +614,8 @@ class AdaptiveBatcher:
                 raise RuntimeError("batcher closed")
             self._events.extend(events)
             self._tokens.extend(tokens)
+            self._ages.append((received_at if received_at is not None
+                               else time.perf_counter(), len(events)))
             self._futures.append(fut)
             if self._oldest is None:
                 self._oldest = time.monotonic()
@@ -635,14 +645,24 @@ class AdaptiveBatcher:
                 events, self._events = self._events, []
                 tokens, self._tokens = self._tokens, []
                 futures, self._futures = self._futures, []
+                ages, self._ages = self._ages, []
                 self._oldest = None
-            self._flush(events, tokens, futures)
+            self._flush(events, tokens, futures, ages)
 
-    def _flush(self, events, tokens, futures) -> None:
+    def _flush(self, events, tokens, futures, ages=()) -> None:
+        from sitewhere_tpu.runtime.eventage import AgeSidecar
+
+        age = AgeSidecar()
+        for stamp, n in ages:
+            age.add(stamp, n)
         try:
-            results = [self.engine.submit_routed(batch)
-                       for batch in self.engine.packer.pack_events(events,
-                                                                   tokens)]
+            # the whole flush's sidecar rides the FIRST batch (a flush
+            # rarely spans batches; splitting per-offer stamps across
+            # them would be guesswork, double-attaching would double-count)
+            results = [self.engine.submit_routed(
+                           batch, age=(age if i == 0 else None))
+                       for i, batch in enumerate(
+                           self.engine.packer.pack_events(events, tokens))]
             with self._lock:
                 self.flushes += 1
             for fut in futures:

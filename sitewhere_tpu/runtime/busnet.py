@@ -30,6 +30,7 @@ from sitewhere_tpu.runtime.bus import (EventBus, Record, batch_extent,
                                        jittered)
 from sitewhere_tpu.runtime.faults import fault_point
 from sitewhere_tpu.runtime.recovery import EpochFence, StaleEpochError
+from sitewhere_tpu.runtime.tracing import GLOBAL_TRACER, extract_traceparent
 
 _LEN = struct.Struct("<I")
 _MAX_FRAME = 64 * 1024 * 1024
@@ -140,8 +141,27 @@ class _Handler(socketserver.BaseRequestHandler):
                 if fault_point("busnet_partition") is not None:
                     return
                 try:
-                    resp = self._dispatch(bus, coordinator, member, req,
-                                          self.server.fence)  # type: ignore[attr-defined]
+                    # W3C trace propagation: a client-stamped envelope
+                    # opens a server span parented on the caller's
+                    # context, stitching feeder -> mesh-host journeys.
+                    # Unstamped requests (the overwhelming steady state)
+                    # pay one dict lookup.
+                    ctx = extract_traceparent(req.get("traceparent"))
+                    if ctx is not None:
+                        with GLOBAL_TRACER.span(
+                                f"busnet.{req.get('op')}", parent=ctx,
+                                topic=str(req.get("topic", ""))):
+                            resp = self._dispatch(
+                                bus, coordinator, member, req,
+                                self.server.fence,  # type: ignore[attr-defined]
+                                getattr(self.server,
+                                        "telemetry_provider", None))
+                    else:
+                        resp = self._dispatch(
+                            bus, coordinator, member, req,
+                            self.server.fence,  # type: ignore[attr-defined]
+                            getattr(self.server, "telemetry_provider",
+                                    None))
                     fault_point("busnet_delay")
                     if fault_point("busnet_drop") is not None:
                         return
@@ -161,7 +181,9 @@ class _Handler(socketserver.BaseRequestHandler):
 
     @staticmethod
     def _dispatch(bus: EventBus, coordinator: _GroupCoordinator,
-                  member: int, req, fence: EpochFence) -> dict:
+                  member: int, req, fence: EpochFence,
+                  telemetry_provider: Optional[Callable[[], dict]] = None
+                  ) -> dict:
         op = req.get("op")
         # Epoch fencing (runtime/recovery.py): a request stamped with a
         # fencing identity is admitted only at-or-above the resource's
@@ -241,6 +263,13 @@ class _Handler(socketserver.BaseRequestHandler):
             return {"ok": True, "topics": bus.topics()}
         if op == "ping":
             return {"ok": True, "ts": int(time.time() * 1000)}
+        if op == "telemetry":
+            # cluster fan-in: hand back this process's observability
+            # snapshot (metrics/flight/age/prometheus text) assembled by
+            # whatever the host wired in via BusServer.telemetry_provider
+            if telemetry_provider is None:
+                return {"ok": False, "error": "no telemetry provider"}
+            return {"ok": True, "telemetry": telemetry_provider()}
         return {"ok": False, "error": f"unknown op {op!r}"}
 
 
@@ -296,12 +325,23 @@ class BusServer:
         self._server.bus = bus  # type: ignore[attr-defined]
         self._server.coordinator = _GroupCoordinator(bus)  # type: ignore[attr-defined]
         self._server.fence = EpochFence()  # type: ignore[attr-defined]
+        self._server.telemetry_provider = None  # type: ignore[attr-defined]
         self._thread: Optional[threading.Thread] = None
 
     @property
     def fence(self) -> EpochFence:
         """The server's per-resource epoch floors (fencing state)."""
         return self._server.fence  # type: ignore[attr-defined]
+
+    @property
+    def telemetry_provider(self) -> Optional[Callable[[], dict]]:
+        """Zero-arg callable answering the `telemetry` op (cluster
+        fan-in); None rejects the op."""
+        return self._server.telemetry_provider  # type: ignore[attr-defined]
+
+    @telemetry_provider.setter
+    def telemetry_provider(self, fn: Optional[Callable[[], dict]]) -> None:
+        self._server.telemetry_provider = fn  # type: ignore[attr-defined]
 
     @property
     def port(self) -> int:
@@ -373,6 +413,14 @@ class BusClient:
         if self._fence_key is not None and req.get("op") != "fence" \
                 and "fence" not in req:
             req = dict(req, fence=self._fence_key, epoch=self._epoch)
+        if "traceparent" not in req:
+            # trace propagation mirrors the fence stamp: when the calling
+            # thread has an active span (sampled journeys, REST ingress),
+            # its W3C context rides the envelope so the server span
+            # stitches into the same trace. No span -> one dict lookup.
+            tp = GLOBAL_TRACER.current_traceparent()
+            if tp is not None:
+                req = dict(req, traceparent=tp)
         with self._lock:
             last: Optional[Exception] = None
             for attempt in range(self.retries + 1):
@@ -458,6 +506,11 @@ class BusClient:
 
     def topics(self) -> List[str]:
         return self._rpc({"op": "topics"})["topics"]
+
+    def telemetry(self) -> dict:
+        """Fetch the remote process's observability snapshot (cluster
+        telemetry fan-in)."""
+        return self._rpc({"op": "telemetry"})["telemetry"]
 
     def ping(self) -> bool:
         try:

@@ -208,6 +208,12 @@ DEFAULTS: Dict = {
     # MicroserviceAnalytics role, inverted to off-by-default and
     # operator-owned endpoint)
     "telemetry": {"enabled": False, "endpoint": None, "interval_s": 3600},
+    # in-process observability (runtime/config_model.py
+    # observability_model): sample 1 in N ingest deliveries into a
+    # journey span stitched across busnet hops (runtime/tracing.py
+    # traceparent propagation). 0 disables sampling entirely — the
+    # disarmed path is one modulo per delivery.
+    "observability": {"trace_sample_n": 0},
     # deterministic fault injection + ingest admission (runtime/faults.py,
     # sources/manager.py AdmissionController; config_model faults_model;
     # docs/OPERATIONS.md "Fault drills"). Everything off by default:

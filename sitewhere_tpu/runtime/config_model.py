@@ -377,6 +377,20 @@ def telemetry_model() -> ElementModel:
                     _attr("interval_s", _D, default=3600.0)])
 
 
+def observability_model() -> ElementModel:
+    return ElementModel(
+        name="observability", role="instance-observability",
+        description="Tracing + event-age telemetry knobs (the flight "
+                    "recorder and metrics registry are always on; this "
+                    "controls the optional extras)",
+        attributes=[
+            _attr("trace_sample_n", _I, default=0,
+                  description="sample 1-in-N ingest deliveries with a "
+                              "journey span that propagates over busnet "
+                              "(W3C traceparent); 0 disables sampling"),
+        ])
+
+
 def faults_model() -> ElementModel:
     """Deterministic fault injection + ingest admission (runtime/faults.py,
     sources/manager.py AdmissionController; docs/OPERATIONS.md
@@ -428,7 +442,8 @@ def _all_elements() -> List[ElementModel]:
         outbound_connectors_model(), command_delivery_model(),
         registration_model(), batch_operations_model(), schedule_model(),
         label_generation_model(), web_rest_model(), analytics_model(),
-        event_search_model(), telemetry_model(), faults_model(),
+        event_search_model(), telemetry_model(), observability_model(),
+        faults_model(),
     ]
 
 

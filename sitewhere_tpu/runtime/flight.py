@@ -63,7 +63,7 @@ class StepRecord:
     """
 
     __slots__ = ("seq", "gen", "engine", "events", "tenant_mix",
-                 "begin", "end", "created")
+                 "begin", "end", "created", "age")
 
     def __init__(self) -> None:
         self.seq = -1            # lineage id (recorder-wide monotonic)
@@ -74,6 +74,10 @@ class StepRecord:
         self.begin: List[float] = [-1.0] * N_STAGES
         self.end: List[float] = [-1.0] * N_STAGES
         self.created = 0.0
+        # event-age ride-along (runtime/eventage.py): an open AgeSidecar
+        # while the batch is in flight, replaced by the closed AgeSummary
+        # at materialize — export only reads the closed form
+        self.age = None
 
     # -- hot path -----------------------------------------------------
     def reset(self, seq: int, gen: int, engine: str) -> None:
@@ -87,6 +91,7 @@ class StepRecord:
             b[i] = -1.0
             e[i] = -1.0
         self.created = time.perf_counter()
+        self.age = None
 
     def mark(self, stage: str, t0: float, t1: float) -> None:
         """Record a completed segment from explicit timestamps."""
@@ -154,6 +159,11 @@ class StepRecord:
         }
         if self.tenant_mix is not None:
             out["tenant_mix"] = list(self.tenant_mix)
+        age = self.age
+        if age is not None and hasattr(age, "export"):
+            exported = age.export()
+            if exported.get("count"):
+                out["age"] = exported
         return out
 
 
@@ -205,6 +215,7 @@ class FlightRecorder:
             copy.begin = list(slot.begin)
             copy.end = list(slot.end)
             copy.created = slot.created
+            copy.age = slot.age
             if slot.gen != gen:  # re-armed while we copied: discard
                 continue
             out.append(copy)
@@ -268,10 +279,26 @@ class FlightRecorder:
             for i in range(N_STAGES) if stage_tot[i] > 0.0
         }
         n = len(sum_ms)
+        # ingest->effect event-age rollup: merge the closed AgeSummary
+        # ride-alongs across the window and derive p50/p99 from the log2
+        # buckets (runtime/eventage.py) — the flight endpoint's answer to
+        # "how old were events when their effects landed"
+        age_total = None
+        for r in recs:
+            age = r.age
+            if age is None or not hasattr(age, "buckets") \
+                    or not getattr(age, "count", 0):
+                continue
+            if age_total is None:
+                from sitewhere_tpu.runtime.eventage import AgeSummary
+                age_total = AgeSummary()
+            age_total.merge(age)
+        out_age = age_total.export() if age_total is not None else None
         return {
             "steps": n,
             "events": events,
             "window_ms": round(wall * 1e3, 3),
+            **({"event_age": out_age} if out_age else {}),
             "stage_occupancy": occupancy,
             # sum-vs-max: if the pipeline overlapped perfectly, wall per
             # step converges to the max stage cost; serial execution

@@ -1,0 +1,120 @@
+"""HBM residency ledger: what the pipeline keeps resident on the mesh.
+
+The engines pin several tensor tables in device memory for the life of
+the process — device state, rule state, anomaly-model state, compiled
+rule tables, model weights, the registry param mirrors — plus bounded
+per-step allocations (alert/route lanes, the staging-blob ring). Nothing
+reported how much HBM each table holds, so capacity planning ("how many
+more devices/rules fit this chip?") meant reading shapes out of source.
+
+This module walks the engine's resident pytrees and computes the fixed
+per-step capacities, returning a named byte ledger that exports as
+``hbm.table_bytes{table="..."}`` gauges (runtime/metrics.py labeled
+extra-gauges) and as the ``hbm`` block of ``GET /api/instance/topology``.
+Everything here is host-side accounting over ``.nbytes`` — no device
+sync, no fetch; safe on the telemetry path.
+
+``device_headroom()`` adds the runtime's own view when the backend
+exposes one (``Device.memory_stats()`` on TPU; absent on cpu) so the
+ledger can be sanity-checked against actual ``bytes_in_use``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+
+
+def _tree_bytes(tree) -> int:
+    """Total nbytes across a pytree's array leaves (0 for None). For
+    sharded arrays this is the GLOBAL footprint — the ledger answers
+    "what does this table cost the mesh", not one chip."""
+    if tree is None:
+        return 0
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        total += int(getattr(leaf, "nbytes", 0) or 0)
+    return total
+
+
+def table_bytes(engine) -> Dict[str, int]:
+    """Byte ledger of every resident table for one engine (single-chip
+    PipelineEngine or ShardedPipelineEngine — the sharded state trees are
+    global arrays, so the same walk covers both)."""
+    from sitewhere_tpu.ops.compact import ALERT_LANE_ROWS
+    from sitewhere_tpu.ops.pack import WIRE_ROWS
+
+    params = getattr(engine, "_params", None)
+    out: Dict[str, int] = {
+        "device_state": _tree_bytes(getattr(engine, "_state", None)),
+        "rule_state": _tree_bytes(getattr(engine, "_rule_state", None)),
+        "model_state": _tree_bytes(getattr(engine, "_model_state", None)),
+        "rule_tables": 0,
+        "model_weights": 0,
+        "registry_params": 0,
+    }
+    if params is not None:
+        out["rule_tables"] = sum(
+            _tree_bytes(getattr(params, k, None))
+            for k in ("threshold", "zones", "geofence", "programs"))
+        out["model_weights"] = _tree_bytes(getattr(params, "models", None))
+        out["registry_params"] = sum(
+            _tree_bytes(getattr(params, k, None))
+            for k in ("assignment_status", "tenant_idx", "area_idx",
+                      "device_type_idx"))
+    # Fixed per-step capacities (allocated fresh each step but always the
+    # same shape — they size the steady-state working set):
+    shards = int(getattr(engine, "n_shards", 1) or 1)
+    alert_cap = int(getattr(engine, "alert_lane_capacity", 0) or 0)
+    out["alert_lanes"] = ALERT_LANE_ROWS * 4 * alert_cap * shards
+    route_cap = int(getattr(engine, "route_lane_capacity", 0) or 0)
+    # device-routing exchange lanes: [S, WIRE_ROWS, lane_cap] int32 per
+    # shard pair exchanged inside the step (ops/route.py)
+    out["route_lanes"] = WIRE_ROWS * 4 * route_cap * shards
+    # staging-blob ring (host-pinned, counted because it sizes the H2D
+    # working set; empty until first full-size accelerator submit)
+    ring = getattr(engine, "_blob_ring", None)
+    out["staging_buffers"] = (sum(int(b.nbytes) for b in ring)
+                              if ring else 0)
+    return out
+
+
+def device_headroom() -> Optional[Dict[str, int]]:
+    """The backend's own memory accounting for device 0 (None when the
+    runtime doesn't expose memory_stats — cpu, some emulators)."""
+    try:
+        stats = jax.local_devices()[0].memory_stats()
+    except Exception:
+        return None
+    if not stats:
+        return None
+    out = {k: int(v) for k, v in stats.items()
+           if isinstance(v, (int, float))}
+    if "bytes_limit" in out and "bytes_in_use" in out:
+        out["bytes_free"] = out["bytes_limit"] - out["bytes_in_use"]
+    return out
+
+
+def ledger(engine) -> Dict:
+    """The full ledger block: per-table bytes, total, and (when the
+    backend reports it) device headroom — the /api/instance/topology
+    ``hbm`` payload."""
+    tables = table_bytes(engine)
+    out: Dict = {"tables": tables,
+                 "total_bytes": int(sum(tables.values()))}
+    headroom = device_headroom()
+    if headroom is not None:
+        out["device"] = headroom
+    return out
+
+
+def export_gauges(engine, prefix: str = "hbm.table_bytes") -> Dict[str, int]:
+    """Labeled extra-gauge dict for MetricsRegistry.prometheus_text:
+    one ``hbm.table_bytes{table="..."}`` sample per resident table plus
+    the ``hbm.total_bytes`` rollup."""
+    tables = table_bytes(engine)
+    out = {f'{prefix}{{table="{name}"}}': bytes_
+           for name, bytes_ in tables.items()}
+    out["hbm.total_bytes"] = int(sum(tables.values()))
+    return out

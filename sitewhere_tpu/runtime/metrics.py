@@ -132,6 +132,14 @@ class Timer:
 DEFAULT_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
                    0.1, 0.25, 0.5, 1.0, 2.5)
 
+# Cardinality guard: a histogram family never grows past this many
+# labeled children. The label vocabularies are meant to be fixed (stage
+# names, engine names, bounded tenant indices) — an unbounded label
+# (device token, batch id) would grow the exposition without limit, so
+# past the cap observations land on a per-family `_overflow` child and
+# `metrics.label_overflow` counts the spills (loud, never silent).
+MAX_LABEL_CHILDREN = 64
+
 
 class Histogram:
     """Prometheus-style bucketed histogram with optional labels.
@@ -151,20 +159,34 @@ class Histogram:
             self.total = 0.0
             self.count = 0
 
-    def __init__(self, buckets: Optional[tuple] = None) -> None:
+    def __init__(self, buckets: Optional[tuple] = None,
+                 max_children: int = MAX_LABEL_CHILDREN) -> None:
         self.buckets = tuple(buckets if buckets is not None
                              else DEFAULT_BUCKETS)
+        self.max_children = max_children
         self._children: Dict[tuple, "Histogram._Child"] = {}
         self._lock = threading.Lock()
 
     def child(self, **labels: str) -> "Histogram._Child":
         key = tuple(sorted(labels.items()))
+        overflowed = False
         with self._lock:
             ch = self._children.get(key)
             if ch is None:
-                ch = Histogram._Child(len(self.buckets))
-                self._children[key] = ch
-            return ch
+                if key and len(self._children) >= self.max_children:
+                    # cardinality cap: spill to the family's _overflow
+                    # child (same label keys, sentinel values) instead of
+                    # growing the exposition unboundedly
+                    key = tuple((lk, "_overflow") for lk, _ in key)
+                    ch = self._children.get(key)
+                    overflowed = True
+                if ch is None:
+                    ch = Histogram._Child(len(self.buckets))
+                    self._children[key] = ch
+        if overflowed:
+            # outside self._lock; the registry lock nests independently
+            GLOBAL_METRICS.counter("metrics.label_overflow").inc()
+        return ch
 
     def observe(self, seconds: float, **labels: str) -> None:
         ch = self.child(**labels)
@@ -177,6 +199,24 @@ class Histogram:
                 if seconds <= ub:
                     ch.counts[i] += 1
                     break
+
+    def observe_buckets(self, bucket_counts, sum_value: float, count: int,
+                        **labels: str) -> None:
+        """Aggregate-observe: fold precomputed raw per-bucket counts in
+        one call (the age sidecar closes a whole batch this way — never
+        a per-event observe loop on the hot path). The first
+        ``len(self.buckets)`` entries align with ``self.buckets``; any
+        trailing entries count only toward ``_count`` (the +Inf
+        bucket)."""
+        ch = self.child(**labels)
+        with self._lock:
+            ch.total += sum_value
+            ch.count += count
+            counts = ch.counts
+            n = len(counts)
+            for i, c in enumerate(bucket_counts):
+                if c and i < n:
+                    counts[i] += c
 
     def snapshot(self) -> Dict:
         with self._lock:
@@ -306,8 +346,19 @@ class MetricsRegistry:
                 lbl = f"{{{prefix}}}" if prefix else ""
                 lines.append(f'{base}_sum{lbl} {snap["sum_s"]:.9f}')
                 lines.append(f'{base}_count{lbl} {snap["count"]}')
-        for key in sorted(extra_gauges or {}):
-            emit(f"swtpu_{_prom_name(key)}", "gauge", extra_gauges[key])
+        # extra gauges may carry a literal label block in the key
+        # (`hbm.table_bytes{table="device_state"}`): one TYPE line per
+        # family, labels pass through verbatim
+        extras = extra_gauges or {}
+        typed: set = set()
+        for key in sorted(extras):
+            name, brace, labelrest = key.partition("{")
+            base = f"swtpu_{_prom_name(name)}"
+            if base not in typed:
+                lines.append(f"# TYPE {base} gauge")
+                typed.add(base)
+            labels = ("{" + labelrest) if brace else ""
+            lines.append(f"{base}{labels} {extras[key]}")
         return "\n".join(lines) + "\n"
 
 

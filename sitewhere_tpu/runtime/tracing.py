@@ -55,6 +55,13 @@ def inject_traceparent(span: "Span") -> str:
     return f"00-{span.trace_id:0>32}-{span.span_id:0>16}-01"
 
 
+def format_traceparent(ctx: TraceContext) -> str:
+    """TraceContext -> W3C `traceparent` value — the wire form carried
+    inside busnet RPC envelopes and gossip payloads (runtime/busnet.py,
+    parallel/cluster.py), symmetric with `extract_traceparent`."""
+    return f"00-{ctx.trace_id:0>32}-{ctx.span_id:0>16}-01"
+
+
 @dataclass
 class Span:
     trace_id: str
@@ -89,19 +96,38 @@ class Span:
 
 
 class Tracer:
-    """Thread-local active-span stack + bounded finished-span buffer."""
+    """Per-thread active-span stacks + bounded finished-span buffer.
+
+    The stacks are keyed by thread ident in a plain dict (not
+    ``threading.local``): feeder/stager threads die on engine restart,
+    and a thread-local would strand their entries invisibly — worse,
+    idents recycle, so a reused ident could adopt a dead thread's stale
+    parentage.  ``finished()``/``stats()`` sweep stacks whose thread no
+    longer exists (thread hygiene; regression-tested)."""
 
     def __init__(self, capacity: int = 4096):
         self._finished: Deque[Span] = deque(maxlen=capacity)
-        self._local = threading.local()
+        self._stacks: Dict[int, List[Span]] = {}
         self._lock = threading.Lock()
         self.error_count = 0
         self.finished_count = 0
 
     def _stack(self) -> List[Span]:
-        if not hasattr(self._local, "stack"):
-            self._local.stack = []
-        return self._local.stack
+        ident = threading.get_ident()
+        stack = self._stacks.get(ident)
+        if stack is None:
+            with self._lock:
+                stack = self._stacks.setdefault(ident, [])
+        return stack
+
+    def _sweep_dead_threads(self) -> None:
+        """Drop per-thread stacks whose thread is gone. Caller holds
+        ``self._lock``."""
+        if not self._stacks:
+            return
+        live = {t.ident for t in threading.enumerate()}
+        for ident in [i for i in self._stacks if i not in live]:
+            del self._stacks[ident]
 
     @contextlib.contextmanager
     def span(self, operation: str,
@@ -153,15 +179,24 @@ class Tracer:
         span = self.active()
         return span.context() if span is not None else None
 
+    def current_traceparent(self) -> Optional[str]:
+        """W3C `traceparent` of this thread's active span (None when no
+        span is open) — what busnet RPC envelopes stamp."""
+        span = self.active()
+        return inject_traceparent(span) if span is not None else None
+
     def finished(self, limit: int = 100) -> List[Dict]:
         with self._lock:
+            self._sweep_dead_threads()
             spans = list(self._finished)[-limit:]
         return [s.to_dict() for s in spans]
 
     def stats(self) -> Dict[str, int]:
         with self._lock:
+            self._sweep_dead_threads()
             return {"finished": self.finished_count,
-                    "errors": self.error_count}
+                    "errors": self.error_count,
+                    "thread_stacks": len(self._stacks)}
 
 
 GLOBAL_TRACER = Tracer()

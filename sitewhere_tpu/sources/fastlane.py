@@ -24,8 +24,11 @@ import numpy as np
 from sitewhere_tpu.model.event import DeviceEventType
 from sitewhere_tpu.ops.pack import EventBatch, EventPacker
 from sitewhere_tpu.runtime.bus import TopicNaming
+from sitewhere_tpu.runtime.eventage import (AgeSidecar, age_histogram,
+                                            observe_summary)
 from sitewhere_tpu.runtime.lifecycle import LifecycleComponent
-from sitewhere_tpu.runtime.metrics import MetricsRegistry
+from sitewhere_tpu.runtime.metrics import GLOBAL_METRICS, MetricsRegistry
+from sitewhere_tpu.runtime.tracing import GLOBAL_TRACER
 from sitewhere_tpu.transport.wire import (
     MessageType, WireError, decode_event_frames_to_columns, decode_frames,
     encode_frame)
@@ -167,7 +170,7 @@ class BulkWireIngestService(LifecycleComponent):
                  tenant: str = "default", naming=None, control_sink=None,
                  persist_rule_alerts: bool = True, registry=None,
                  metrics=None, persist_async: bool = False,
-                 persist_depth: int = 8):
+                 persist_depth: int = 8, trace_sample_n: int = 0):
         super().__init__(f"bulk-wire-ingest:{tenant}")
         self.engine = engine
         self.lane = FastWireIngest(engine.packer)
@@ -196,9 +199,36 @@ class BulkWireIngestService(LifecycleComponent):
         self.unregistered_counter = m.counter("unregistered")
         self.failed_counter = m.counter("failed_decode")
         self._remainder = b""
+        # ingest->effect age telemetry (runtime/eventage.py): the age
+        # histogram lives on the SCRAPED registry (global by default)
+        # under labels (engine, edge); journey tracing samples one
+        # delivery in trace_sample_n with a span whose traceparent rides
+        # any busnet RPC issued while processing it (0 = off).
+        self._age_hist = age_histogram(metrics if metrics is not None
+                                       else GLOBAL_METRICS)
+        self._engine_label = getattr(engine, "name", "pipeline")
+        self.trace_sample_n = int(trace_sample_n)
+        self._delivery_seq = 0
 
     def on_encoded_event_received(self, payload: bytes,
                                   metadata=None) -> None:
+        # one ingest stamp per delivery (sources/receivers.py); popped so
+        # decoders never see the float. Direct callers without a stamp
+        # age from "now" (ages ~0 — still counted).
+        received_at = None
+        if metadata is not None:
+            received_at = metadata.pop("received_at", None)
+        self._delivery_seq += 1
+        n = self.trace_sample_n
+        if n > 0 and self._delivery_seq % n == 0:
+            with GLOBAL_TRACER.span("ingest.journey", tenant=self.tenant,
+                                    delivery=str(self._delivery_seq)):
+                self._handle_delivery(payload, metadata, received_at)
+        else:
+            self._handle_delivery(payload, metadata, received_at)
+
+    def _handle_delivery(self, payload: bytes, metadata,
+                         received_at) -> None:
         data = self._remainder + payload if self._remainder else payload
         try:
             res = self.lane.ingest(data)
@@ -224,15 +254,26 @@ class BulkWireIngestService(LifecycleComponent):
                 self.control_sink(frame, metadata)
         row = 0
         for batch in res.batches:
-            alert_batch, outputs = self.engine.submit_routed(batch)
+            age = AgeSidecar()
+            age.add(received_at, min(batch.batch_size, res.n_events - row))
+            alert_batch, outputs = self.engine.submit_routed(batch, age=age)
+            persisted = True
             if self.persister is not None:
                 self.persister.submit(batch, self.tenant)
             elif self.eventlog is not None:
                 self.eventlog.append_batch(self.tenant, batch,
                                            self.engine.packer,
                                            registry=self.registry)
+            else:
+                persisted = False
+            if persisted:
+                # persist edge: durable append handed off (close() is
+                # pure — the engine separately closed the materialize
+                # edge on the same sidecar)
+                observe_summary(self._age_hist, age.close(),
+                                engine=self._engine_label, edge="persist")
             self._route_unregistered(res, batch, row)
-            self._persist_alerts(alert_batch, outputs)
+            self._persist_alerts(alert_batch, outputs, age=age)
             row += batch.batch_size
         self.events_meter.mark(res.n_events)
 
@@ -265,14 +306,19 @@ class BulkWireIngestService(LifecycleComponent):
             self._snap = tensors.snapshot()
         return self._snap
 
-    def _persist_alerts(self, batch, outputs) -> None:
+    def _persist_alerts(self, batch, outputs, age=None) -> None:
         if not self.persist_rule_alerts or self.events is None \
                 or self.registry is None:
             return
-        for alert in self.engine.materialize_alerts(batch, outputs):
+        alerts = list(self.engine.materialize_alerts(batch, outputs))
+        for alert in alerts:
             device = self.registry.get_device_by_token(alert.device_id)
             if device is None:
                 continue
             assignment = self.registry.get_active_assignment(device.id)
             if assignment is not None:
                 self.events.add_alerts(assignment.token, alert)
+        if alerts and age is not None:
+            # alert edge: rule alerts reached the event store
+            observe_summary(self._age_hist, age.close(),
+                            engine=self._engine_label, edge="alert")

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import asyncio
 import threading
+import time
 from typing import Dict, List, Optional
 
 
@@ -65,7 +66,14 @@ class _ReceiverBase:
     async def _forward(self, payload: bytes,
                        metadata: Optional[Dict[str, str]] = None) -> None:
         # decode + bus publish are cheap/non-blocking; run inline on the loop
-        self.source.on_encoded_event_received(payload, metadata or {})
+        metadata = metadata or {}
+        # ingest-edge age stamp: one monotonic clock read per DELIVERY
+        # (a payload of N events shares it) — the open edge of the
+        # ingest->effect age waterfall (runtime/eventage.py). Kept as a
+        # float; the ingest service pops it before metadata reaches
+        # decoders.
+        metadata.setdefault("received_at", time.perf_counter())
+        self.source.on_encoded_event_received(payload, metadata)
 
 
 class MqttEventReceiver(_ReceiverBase):
@@ -254,8 +262,9 @@ class CoapEventReceiver(_ReceiverBase):
         from sitewhere_tpu.transport.coap import CoapServer
 
         def handler(path: str, payload: bytes, method: int):
-            self.source.on_encoded_event_received(payload,
-                                                  {"coap.path": path})
+            self.source.on_encoded_event_received(
+                payload, {"coap.path": path,
+                          "received_at": time.perf_counter()})
             return b""
 
         async def go():

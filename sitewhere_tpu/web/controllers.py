@@ -125,6 +125,22 @@ def register_all(router: Router, instance, server) -> None:
         last_n = request.query_int("last", 64)
         return GLOBAL_FLIGHT.export(last_n=max(1, min(last_n, 256)))
 
+    def get_cluster_telemetry(request: Request):
+        """GET /api/cluster/telemetry — cluster-wide telemetry fan-in:
+        this host collects every peer's metrics snapshot, flight rollups,
+        and event-age summary over busnet (`telemetry` op) and returns the
+        peer-labeled merged view plus a merged Prometheus exposition
+        (every sample re-labeled with peer="<pid>"). Unreachable peers are
+        listed in `stale_peers` — a partial view beats a 502 during the
+        exact incidents this endpoint exists for."""
+        hooks = getattr(instance, "cluster_hooks", None)
+        if hooks is None or not hasattr(hooks, "cluster_telemetry"):
+            raise SiteWhereError(
+                "cluster telemetry requires a cluster deployment "
+                "(ClusterService or ControlPlaneCluster installed)",
+                http_status=409)
+        return hooks.cluster_telemetry()
+
     def get_logs(request: Request):
         return {"records": instance.log_aggregator.recent(
             limit=request.query_int("limit", 200),
@@ -186,6 +202,8 @@ def register_all(router: Router, instance, server) -> None:
     router.get("/api/instance/metrics", get_metrics,
                authority=SiteWhereRoles.VIEW_SERVER_INFO)
     router.get("/api/instance/flight", get_flight,
+               authority=SiteWhereRoles.VIEW_SERVER_INFO)
+    router.get("/api/cluster/telemetry", get_cluster_telemetry,
                authority=SiteWhereRoles.VIEW_SERVER_INFO)
     router.get("/api/instance/logs", get_logs,
                authority=SiteWhereRoles.VIEW_SERVER_INFO)
@@ -467,58 +485,10 @@ def register_all(router: Router, instance, server) -> None:
     def metrics_prometheus(request: Request):
         """GET /metrics — Prometheus text format. Public like every
         scrape endpoint (operational counters only; front with a network
-        policy if the deployment needs to)."""
-        extra: Dict[str, float] = {}
-        engine = instance.pipeline_engine
-        if engine is not None:
-            extra["pipeline.batches_processed"] = engine.batches_processed
-            extra["pipeline.alerts_dropped"] = engine.alerts_dropped
-            health = getattr(engine, "health", None)
-            if health is not None:
-                # 0=healthy 1=degraded 2=draining 3=failed
-                extra["pipeline.health_state"] = health.code
-            # per-program fire/suppress counters (one on-demand D2H fetch
-            # of two [P] vectors; cumulative, checkpoint-durable)
-            for ptoken, c in engine.rule_program_counters().items():
-                extra[f"pipeline.rule_program.fires.{ptoken}"] = c["fires"]
-                extra[f"pipeline.rule_program.suppressed.{ptoken}"] = \
-                    c["suppressed"]
-            # per-model fire/eval counters (same on-demand D2H contract)
-            for mtoken, c in engine.anomaly_model_counters().items():
-                extra[f"pipeline.anomaly_model.fires.{mtoken}"] = c["fires"]
-                extra[f"pipeline.anomaly_model.evals.{mtoken}"] = c["evals"]
-        hooks = getattr(instance, "cluster_hooks", None)
-        if hooks is not None:
-            gossip = hooks.gossip
-            if gossip is not None:
-                extra.update({
-                    "cluster.gossip.published": gossip.published,
-                    "cluster.gossip.applied": gossip.applied,
-                    "cluster.gossip.conflicts": gossip.conflicts,
-                    "cluster.gossip.publish_errors": gossip.publish_errors,
-                })
-            provisioning = getattr(hooks, "provisioning", None)
-            if provisioning is not None:
-                extra.update({
-                    "cluster.provisioning.published":
-                        provisioning.published,
-                    "cluster.provisioning.applied": provisioning.applied,
-                    "cluster.provisioning.publish_errors":
-                        provisioning.publish_errors,
-                    "cluster.provisioning.parked_rows":
-                        provisioning.parked_rows,
-                })
-            if getattr(hooks, "data_plane", True):
-                extra["cluster.forwarded_rows"] = hooks.forwarder.forwarded
-                extra["cluster.forward_dead_lettered"] = \
-                    hooks.forwarder.dead_lettered
-                extra["cluster.step_ticks"] = hooks.loop.tick_count
-            extra["cluster.degraded_peers"] = len(hooks.degraded)
-        # failover epoch (runtime/recovery.py): lets dashboards graph
-        # restarts/takeovers as step changes and alert on epoch skew
-        extra["recovery.epoch"] = float(getattr(instance,
-                                                "recovery_epoch", 0))
-        text = instance.metrics.prometheus_text(extra)
+        policy if the deployment needs to). The derived-gauge assembly
+        lives on the instance (extra_gauges) so the cluster telemetry
+        fan-in serves the identical families per peer."""
+        text = instance.prometheus_text()
         return 200, text.encode("utf-8"), "text/plain; version=0.0.4"
 
     def start_device_trace(request: Request):

@@ -1,0 +1,177 @@
+"""N=2 OS-process cluster telemetry fan-in drill over the REAL transport.
+
+ISSUE 13 acceptance: `GET /api/cluster/telemetry` on host 0 fans out
+over busnet and returns BOTH processes' snapshots — metrics, flight
+rollups, and a merged Prometheus exposition with a `peer="<pid>"` label
+on every sample — and when host 1 is hard-killed the same endpoint keeps
+serving a partial view with `stale_peers == ["1"]` instead of failing.
+
+Runs the `ControlPlaneCluster` composition (`serve --cluster-peers`
+without a coordinator), so the drill needs no multi-controller backend.
+Marked slow: tier-1 excludes it; run directly with
+`pytest tests/test_cluster_telemetry.py -m slow`.
+"""
+
+import json
+import os
+import re
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+N = 2
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class _HostLog:
+    def __init__(self, proc):
+        self.proc = proc
+        self.lines = []
+        self._lock = threading.Lock()
+        self.thread = threading.Thread(target=self._run, daemon=True)
+        self.thread.start()
+
+    def _run(self):
+        for line in self.proc.stdout:
+            with self._lock:
+                self.lines.append(line)
+
+    def text(self) -> str:
+        with self._lock:
+            return "".join(self.lines)
+
+    def banners(self) -> int:
+        return self.text().count("REST gateway")
+
+
+def _wait(predicate, timeout_s, what, logs=None):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(0.25)
+    detail = ""
+    if logs:
+        detail = "\n".join(f"--- host {i} ---\n{log.text()[-3000:]}"
+                           for i, log in enumerate(logs))
+    raise AssertionError(f"timed out waiting for {what}\n{detail}")
+
+
+def _client(port):
+    from sitewhere_tpu.client.rest import SiteWhereClient
+
+    c = SiteWhereClient(f"http://127.0.0.1:{port}")
+    c.authenticate("admin", "password")
+    return c
+
+
+def test_two_host_telemetry_fan_in_and_peer_loss(tmp_path):
+    bus_ports = [_free_port() for _ in range(N)]
+    rest_ports = [_free_port() for _ in range(N)]
+    peers = ",".join(f"{i}=127.0.0.1:{bus_ports[i]}" for i in range(N))
+    cfg_path = tmp_path / "cfg.json"
+    cfg_path.write_text(json.dumps({
+        "instance": {"id": "telemdrill"},
+        "pipeline": {"enabled": True, "batch_size": 16, "max_devices": 64,
+                     "max_zones": 4, "max_zone_vertices": 4,
+                     "measurement_slots": 4, "max_tenants": 4},
+        # survivors must keep serving the partial view after the kill
+        "cluster": {"heartbeat_s": 0.5, "exit_on_peer_loss": False},
+        "persist": {"checkpoint_interval_s": None},
+    }))
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONUNBUFFERED"] = "1"
+    procs, logs = [], []
+    for i in range(N):
+        procs.append(subprocess.Popen(
+            [sys.executable, "-u", "-m", "sitewhere_tpu", "serve",
+             "--config", str(cfg_path),
+             "--cluster-num-processes", str(N),
+             "--cluster-process-id", str(i),
+             "--cluster-peers", peers,
+             "--bus-port", str(bus_ports[i]),
+             "--port", str(rest_ports[i]),
+             "--data-dir", str(tmp_path / f"h{i}")],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env, cwd=str(tmp_path)))
+        logs.append(_HostLog(procs[-1]))
+
+    try:
+        _wait(lambda: all(log.banners() >= 1 for log in logs), 300,
+              "both hosts serving", logs)
+        c0 = _client(rest_ports[0])
+
+        # ---- full fan-in: both peers present, peer-labeled merge ------
+        telem = c0.get("/api/cluster/telemetry")
+        assert telem["process_id"] == 0
+        assert telem["num_processes"] == N
+        assert telem["stale_peers"] == []
+        assert set(telem["processes"]) == {"0", "1"}
+        for pid, snap in telem["processes"].items():
+            assert snap["process_id"] == int(pid)
+            assert snap["instance_id"] == "telemdrill"
+            assert "counters" in snap["metrics"]
+            assert "flight_rollups" in snap
+            assert "swtpu_" in snap["prometheus_text"]
+        merged = telem["prometheus_text"]
+        assert 'peer="0"' in merged and 'peer="1"' in merged
+        # every sample line carries exactly one peer label; headers are
+        # deduplicated, not peer-labeled
+        for line in merged.splitlines():
+            if line.startswith("#"):
+                assert 'peer="' not in line
+            elif line:
+                assert len(re.findall(r'peer="\d+"', line)) == 1, line
+        # both peers export the HBM ledger gauge families
+        for pid in ("0", "1"):
+            assert re.search(
+                r'swtpu_hbm_total_bytes\{peer="%s"\}' % pid, merged)
+
+        # the same fan-in works from host 1's side too
+        telem1 = _client(rest_ports[1]).get("/api/cluster/telemetry")
+        assert telem1["process_id"] == 1
+        assert set(telem1["processes"]) == {"0", "1"}
+
+        # ---- hard-kill host 1: partial view with stale_peers ----------
+        procs[1].kill()
+        procs[1].wait(timeout=30)
+
+        def partial_view():
+            out = c0.get("/api/cluster/telemetry")
+            return out["stale_peers"] == ["1"] \
+                and set(out["processes"]) == {"0"}
+
+        _wait(partial_view, 60, "host 0 serves partial view", logs)
+        after = c0.get("/api/cluster/telemetry")
+        assert after["stale_peers"] == ["1"]
+        assert 'peer="0"' in after["prometheus_text"]
+        assert 'peer="1"' not in after["prometheus_text"]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        for p in procs:
+            if p.poll() is None:
+                try:
+                    p.wait(timeout=30)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+                    p.wait(timeout=30)

@@ -22,10 +22,30 @@ DOCS = REPO / "docs" / "OBSERVABILITY.md"
 # their *prefix* conventions are documented prose-side instead.
 _REG_CALL = re.compile(
     r"\.(counter|meter|timer|histogram)\(\s*\"([^\"{]+)\"", re.S)
-# extra_gauges keys in web/controllers.py: extra["k"] = / "k": value
-_EXTRA_ITEM = re.compile(r"\"((?:cluster|pipeline)\.[a-z_.0-9]+)\"\s*[:\]]")
+# extra_gauges keys: extra["k"] = / "k": value. The builder lives on the
+# instance now (instance.extra_gauges, shared by GET /metrics and the
+# cluster telemetry fan-in); controllers.py stays scanned for any
+# endpoint-local additions.
+_EXTRA_ITEM = re.compile(
+    r"\"((?:cluster|pipeline|hbm)\.[a-z_.0-9]+)\"\s*[:\]]")
+# labeled extra-gauge families emitted with a literal label block
+# (runtime/hbmledger.py: hbm.table_bytes{table="..."}) — collect the
+# family name; the label keys are linted separately below
+_LABELED_FAMILY = re.compile(r"\"(hbm\.[a-z_.0-9]+)\"")
+
+# every label KEY that may appear on an exported sample, anywhere —
+# labeled histogram children (engine/edge/stage/tenant), the HBM ledger's
+# table label, and the cluster fan-in's injected peer label. New label
+# keys are a cardinality decision: add them here AND document them.
+LABEL_KEY_ALLOW = {"engine", "edge", "stage", "tenant", "table", "peer",
+                   "le", "topic"}
+# no whitespace allowed after { or , : label BLOCKS are written tight
+# (`{table="..."` / `,peer="..."`), python kwargs are not (`, name="x"`)
+_LABEL_KEY = re.compile(r"(?:\{|,)([a-z_]+)=\\?\"")
 
 _PROM_LEGAL = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+
+_EXTRA_FILES = ("instance.py", "web/controllers.py")
 
 
 def _collect_names():
@@ -34,8 +54,10 @@ def _collect_names():
         text = path.read_text()
         for _, name in _REG_CALL.findall(text):
             names.add(name)
-    controllers = (PKG / "web" / "controllers.py").read_text()
-    names.update(_EXTRA_ITEM.findall(controllers))
+    for rel in _EXTRA_FILES:
+        names.update(_EXTRA_ITEM.findall((PKG / rel).read_text()))
+    names.update(_LABELED_FAMILY.findall(
+        (PKG / "runtime" / "hbmledger.py").read_text()))
     return names
 
 
@@ -46,6 +68,27 @@ def test_found_a_plausible_inventory():
     assert "pipeline.step_stage_seconds" in names
     assert "events" in names
     assert "cluster.gossip.published" in names
+    assert "pipeline.event_age_seconds" in names
+    assert "metrics.label_overflow" in names
+    assert "hbm.table_bytes" in names
+    assert "hbm.total_bytes" in names
+
+
+def test_exported_label_keys_are_allow_listed():
+    """Every label key that can reach a Prometheus sample must come from
+    the allow-list: labels are a cardinality commitment (metrics.py caps
+    children per family and spills to `_overflow`), so a new key is a
+    deliberate decision, not a drive-by."""
+    offenders = {}
+    for rel in ("runtime/hbmledger.py", "parallel/cluster.py",
+                "runtime/eventage.py"):
+        text = (PKG / rel).read_text()
+        bad = sorted(set(_LABEL_KEY.findall(text)) - LABEL_KEY_ALLOW)
+        if bad:
+            offenders[rel] = bad
+    assert not offenders, (
+        f"label keys outside the allow-list (add deliberately to "
+        f"LABEL_KEY_ALLOW and document them): {offenders}")
 
 
 def test_every_metric_name_is_documented():
