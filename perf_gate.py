@@ -171,12 +171,22 @@ MAX_TELEMETRY_OVERHEAD_PCT = 1.0
 
 # Ingest->materialize age budget (bench's age_p99_ms, measured through
 # the latency tier's deployed path: receiver stamp -> sidecar -> close at
-# materialize). ADVISORY at every scale: age is end-to-end freshness — a
-# deployment target like the latency budget, but it also folds in
-# linger policy and host scheduling, so the gate records the number and
-# flags the breach without hard-failing CI. Hard enforcement stays with
-# latency_budget_met (accelerator-fingerprinted runs).
+# materialize). HARD on accelerator-fingerprinted hosts, advisory on the
+# cpu smoke: age is end-to-end freshness — a deployment target like the
+# latency budget — and with the staging ring overlapping H2D with
+# dispatch the deployed path is expected to hold it wherever the
+# latency budget itself is enforced. The cpu host stays advisory for the
+# same reason latency_budget_met does: the budget is a TPU target.
 AGE_P99_BUDGET_MS = 25.0
+
+# H2D overlap (runtime/flight.py h2d_overlap_fraction, ROADMAP item 2):
+# with the multi-buffered staging ring (pipeline/staging.py) the
+# staging-side work of step N+1 (pack/route/guard/h2d) must mostly run
+# under step N's dispatch window, and the critical stage must no longer
+# be dispatch. HARD on accelerator hosts at full scale; advisory on the
+# cpu smoke (no async dispatch on the cpu backend — device_put and the
+# fused step are synchronous there, so overlap is structurally ~0).
+MIN_H2D_OVERLAP = 0.6
 
 # Trial-spread bounds: full scale judges the accelerator-scale claim; the
 # BENCH_SCALE=small smoke still EVALUATES the check (bench's sections now
@@ -479,20 +489,47 @@ def self_consistency(bench: Dict) -> Dict:
                 "full scale)")
         checks["telemetry_overhead"] = entry
     # Age budget: ingest->materialize p99 through the deployed latency
-    # path. Advisory at every scale (see AGE_P99_BUDGET_MS) — the entry
-    # records the breach without failing the gate.
+    # path. Hard on accelerator hosts, advisory on the cpu smoke (see
+    # AGE_P99_BUDGET_MS) — the freshness target gates wherever the
+    # latency budget itself does.
     age_p99 = bench.get("age_p99_ms")
     if isinstance(age_p99, (int, float)) and age_p99 > 0:
         age_ok = age_p99 <= AGE_P99_BUDGET_MS
-        entry = {"ok": True, "age_p99_ms": age_p99,
+        entry = {"ok": age_ok or cpu_host, "age_p99_ms": age_p99,
                  "budget_ms": AGE_P99_BUDGET_MS}
-        if not age_ok:
+        if cpu_host and not age_ok:
             entry["advisory"] = (
                 f"age p99 {age_p99} ms over the {AGE_P99_BUDGET_MS} ms "
-                "freshness target (advisory; folds in linger policy and "
-                "host scheduling — hard enforcement stays with "
-                "latency_budget_met)")
+                "freshness target on a CPU-only bench host (advisory; "
+                "the budget is a TPU target and gates only "
+                "accelerator-fingerprinted runs)")
         checks["age_p99_budget_ms"] = entry
+    # H2D overlap: the staging ring must actually overlap — most of the
+    # staging-side work under the previous dispatch window, and dispatch
+    # no longer the modal critical stage. Hard on accelerator hosts at
+    # full scale; advisory on the cpu smoke (synchronous backend, no
+    # async dispatch window to hide transfers under) and at small scale
+    # (sub-ms steps make the fraction noise). Keys live in the full
+    # in-run result only — recorded compact lines skip the check.
+    fl = bench.get("flight")
+    if isinstance(fl, dict) and "h2d_overlap_fraction" in fl:
+        overlap = fl.get("h2d_overlap_fraction")
+        crit = fl.get("critical_stage") or ""
+        if isinstance(overlap, (int, float)):
+            met = overlap >= MIN_H2D_OVERLAP and crit != "dispatch"
+            entry = {
+                "ok": met or small or cpu_host,
+                "h2d_overlap_fraction": overlap,
+                "critical_stage": crit,
+                "min_overlap": MIN_H2D_OVERLAP}
+            if (small or cpu_host) and not met:
+                entry["advisory"] = (
+                    "overlap under bound on a CPU-only/smoke host "
+                    "(advisory; the cpu backend dispatches "
+                    "synchronously, so there is no dispatch window to "
+                    "overlap — the bound gates accelerator-"
+                    "fingerprinted full-scale runs)")
+            checks["h2d_overlap"] = entry
     # Fault-injection overhead: disarmed fault points + the admission
     # check must stay under 0.5% of the step wall (full scale; advisory
     # on the cpu smoke for the same sub-ms-step reason).

@@ -82,6 +82,7 @@ def _build_instance(cfg, mesh=None):
         # "auto" -> None: the engine decides by mesh shape/topology
         device_routing={"on": True, "off": False}.get(
             str(cfg.get("pipeline.device_routing") or "auto").lower()),
+        h2d_buffer_depth=int(cfg.get("pipeline.h2d_buffer_depth") or 3),
         checkpoint_interval_s=(
             float(cfg.get("persist.checkpoint_interval_s"))
             if cfg.get("persist.checkpoint_interval_s") is not None

@@ -63,7 +63,8 @@ class SiteWhereInstance(LifecycleComponent):
                  fault_plan: Optional[Dict] = None,
                  admission_step_budget_ms: Optional[float] = None,
                  admission_queue_depth_budget: Optional[int] = None,
-                 trace_sample_n: int = 0):
+                 trace_sample_n: int = 0,
+                 h2d_buffer_depth: int = 3):
         super().__init__(f"instance:{instance_id}")
         self.instance_id = instance_id
         self.data_dir = data_dir
@@ -130,13 +131,15 @@ class SiteWhereInstance(LifecycleComponent):
                     per_shard_batch=batch_size,
                     measurement_slots=measurement_slots,
                     max_tenants=max_tenants,
-                    device_routing=device_routing)
+                    device_routing=device_routing,
+                    h2d_buffer_depth=h2d_buffer_depth)
             else:
                 from sitewhere_tpu.pipeline.engine import PipelineEngine
                 self.pipeline_engine = PipelineEngine(
                     self.registry_tensors, batch_size=batch_size,
                     measurement_slots=measurement_slots,
-                    max_tenants=max_tenants)
+                    max_tenants=max_tenants,
+                    h2d_buffer_depth=h2d_buffer_depth)
         # latency tier (pipeline.mode="latency"): one shared adaptive
         # batcher coalesces every tenant's hot events and flushes on fill
         # or linger (pipeline/feed.py) — inbound consumers offer to it
@@ -687,6 +690,14 @@ class SiteWhereInstance(LifecycleComponent):
             if health is not None:
                 # 0=healthy 1=degraded 2=draining 3=failed
                 extra["pipeline.health_state"] = health.code
+            # H2D staging ring (pipeline/staging.py): instantaneous slot
+            # occupancy + configured depth. Only exported once the ring
+            # has been built (first staged transfer) — a never-staging
+            # engine keeps its exposition unchanged.
+            ring = getattr(engine, "_staging_ring", None)
+            if ring is not None:
+                extra["pipeline.staging_ring.occupancy"] = ring.occupancy()
+                extra["pipeline.staging_ring.depth"] = ring.depth
             for ptoken, c in engine.rule_program_counters().items():
                 extra[f"pipeline.rule_program.fires.{ptoken}"] = c["fires"]
                 extra[f"pipeline.rule_program.suppressed.{ptoken}"] = \

@@ -258,16 +258,20 @@ class _StagedStep:
     program ("host" routed / "device" routing-prologue) consumes it."""
 
     __slots__ = ("blob", "view", "counted", "routed_blob", "kind",
-                 "flight")
+                 "flight", "slot")
 
     def __init__(self, blob, view, counted, routed_blob,
-                 kind: str = "host", flight=None):
+                 kind: str = "host", flight=None, slot=None):
         self.blob = blob
         self.view = view
         self.counted = counted
         self.routed_blob = routed_blob
         self.kind = kind
         self.flight = flight
+        # staging-ring slot (pipeline/staging.py) the transfer occupies;
+        # dispatch_staged releases it with the step output as guard.
+        # None when the caller bypassed the ring (overflow drain blobs).
+        self.slot = slot
 
 
 class ShardedPipelineEngine(PipelineEngine):
@@ -793,84 +797,96 @@ class ShardedPipelineEngine(PipelineEngine):
                   ) -> Tuple["RoutedBlobView", ProcessOutputs]:
         return self.dispatch_staged(params, self.stage_prepared(prepared))
 
-    def _h2d_with_retry(self, put):
-        """Bounded retry/backoff around a host->mesh transfer. The host
-        blob is intact regardless of how far a failed transfer got (no
-        donation on this edge), so re-issuing the put is always safe."""
-        attempt = 0
-        while True:
-            try:
-                fault_point("h2d_error")
-                return put()
-            except Exception:
-                attempt += 1
-                if attempt > self.step_retries:
-                    raise
-                self._retry_counter.inc()
-                self.health.note_retry()
-                time.sleep(jittered(0.01 * (2 ** (attempt - 1))))
-
-    def stage_prepared(self, prepared: "_PreparedStep") -> "_StagedStep":
+    def stage_prepared(self, prepared: "_PreparedStep",
+                       order: Optional[int] = None,
+                       use_ring: bool = True) -> "_StagedStep":
         """Start the host->mesh transfer of a prepared step WITHOUT
         dispatching it. device_put is async on accelerator runtimes, so a
         pipelined feeder can overlap this staging (and the host prep that
         produced the blob) with the previous step's device execution —
         the sharded half of pipeline/feed.py's double-buffered contract.
         Returns a staged handle for dispatch_staged; a pooled blob's
-        release is wired there (its H2D guard is the step's output)."""
+        release is wired there (its H2D guard is the step's output).
+
+        The transfer goes through the H2D staging ring: `order` is the
+        feeder's sequence so slots are granted in dispatch order, and
+        overflow-drain blobs bypass the ring (`use_ring=False`) — a
+        drain blob dispatches before its step reaches the ready heap, so
+        blocking on a slot held by its own siblings would self-deadlock;
+        the first blob of each step still provides the backpressure."""
         rec = prepared.flight
         if prepared.kind == "device":
             # UNROUTED flat blob, split along the LANE axis: shard i's
             # chunk is flat lanes [i*B, (i+1)*B) — the routing prologue
             # inside the step exchanges rows to their owners
             flat = NamedSharding(self.mesh, P(None, SHARD_AXIS))
+            slot = self._acquire_staging_slot(rec, order, use_ring)
             if rec is not None:
                 rec.begin_stage("h2d")
-            blob = self._h2d_with_retry(
-                lambda: jax.device_put(prepared.blob, flat))
-            if rec is not None:
-                rec.end_stage("h2d")
+            try:
+                blob = self._h2d_with_retry(
+                    lambda: jax.device_put(prepared.blob, flat))
+            except BaseException:
+                if slot is not None:
+                    self.staging_ring.release(slot)
+                raise
+            finally:
+                if rec is not None:
+                    rec.end_stage("h2d")
+            if slot is not None:
+                slot.device_blob = blob
             view = DeviceRoutedView(prepared.blob, self.router)
             return _StagedStep(blob, view, prepared.blob, prepared.blob,
-                               kind="device", flight=rec)
-        return self.stage_routed_blob(prepared.blob, flight_rec=rec)
+                               kind="device", flight=rec, slot=slot)
+        return self.stage_routed_blob(prepared.blob, flight_rec=rec,
+                                      order=order, use_ring=use_ring)
 
     def stage_routed_blob(self, routed_blob: np.ndarray,
-                          flight_rec=None) -> "_StagedStep":
+                          flight_rec=None, order: Optional[int] = None,
+                          use_ring: bool = True) -> "_StagedStep":
         """Start the host->mesh transfer of a HOST-routed [S, WIRE_ROWS,
         B] blob (see stage_prepared; this is the host-arena half, and the
         only one multi-process feeding uses)."""
         shard0 = NamedSharding(self.mesh, P(SHARD_AXIS))
+        slot = self._acquire_staging_slot(flight_rec, order, use_ring)
         if flight_rec is not None:
             flight_rec.begin_stage("h2d")
-        if self.is_multiprocess:
-            # Per-host feeding (the multi-host jax data contract): this
-            # process stages ONLY its local shards' rows; rows routed to
-            # shards on other processes are stashed for take_foreign()
-            # (the caller forwards them over the bus edge — at-least-once,
-            # never dropped here).
-            local = self.local_shards
-            self._stash_foreign(routed_blob)
-            local_blob = np.ascontiguousarray(routed_blob[local])
-            # the view holds the local copy; the pooled routed blob is
-            # fully consumed at this point and can go back on the shelf
-            self.router.release_staging_buffer(routed_blob)
-            blob = self._h2d_with_retry(
-                lambda: jax.make_array_from_process_local_data(
-                    shard0, local_blob, routed_blob.shape))
-            view = RoutedBlobView(local_blob, shard_ids=local)
-            counted = local_blob
-        else:
-            blob = self._h2d_with_retry(
-                lambda: jax.device_put(routed_blob, shard0))
-            # release wired after the step runs, carrying the step output
-            # as the transfer-completion guard
-            view = RoutedBlobView(routed_blob)
-            counted = routed_blob
-        if flight_rec is not None:
-            flight_rec.end_stage("h2d")
+        try:
+            if self.is_multiprocess:
+                # Per-host feeding (the multi-host jax data contract): this
+                # process stages ONLY its local shards' rows; rows routed to
+                # shards on other processes are stashed for take_foreign()
+                # (the caller forwards them over the bus edge —
+                # at-least-once, never dropped here).
+                local = self.local_shards
+                self._stash_foreign(routed_blob)
+                local_blob = np.ascontiguousarray(routed_blob[local])
+                # the view holds the local copy; the pooled routed blob is
+                # fully consumed at this point and can go back on the shelf
+                self.router.release_staging_buffer(routed_blob)
+                blob = self._h2d_with_retry(
+                    lambda: jax.make_array_from_process_local_data(
+                        shard0, local_blob, routed_blob.shape))
+                view = RoutedBlobView(local_blob, shard_ids=local)
+                counted = local_blob
+            else:
+                blob = self._h2d_with_retry(
+                    lambda: jax.device_put(routed_blob, shard0))
+                # release wired after the step runs, carrying the step
+                # output as the transfer-completion guard
+                view = RoutedBlobView(routed_blob)
+                counted = routed_blob
+        except BaseException:
+            if slot is not None:
+                self.staging_ring.release(slot)
+            raise
+        finally:
+            if flight_rec is not None:
+                flight_rec.end_stage("h2d")
+        if slot is not None:
+            slot.device_blob = blob
         return _StagedStep(blob, view, counted, routed_blob,
-                           flight=flight_rec)
+                           flight=flight_rec, slot=slot)
 
     def dispatch_staged(self, params, staged: "_StagedStep"
                         ) -> Tuple["RoutedBlobView", ProcessOutputs]:
@@ -887,11 +903,22 @@ class ShardedPipelineEngine(PipelineEngine):
         rec.begin_stage("dispatch")
         # h2d_error is staged separately here (stage_prepared /
         # stage_routed_blob) — only the dispatch point arms on this edge
-        outputs = self._dispatch_with_retry(
-            lambda: step(params, self._state, self._rule_state,
-                         self._model_state, staged.blob),
-            points=("dispatch_error",))
+        try:
+            outputs = self._dispatch_with_retry(
+                lambda: step(params, self._state, self._rule_state,
+                             self._model_state, staged.blob),
+                points=("dispatch_error",))
+        except BaseException:
+            if staged.slot is not None:
+                # guard-free: a failed step never recycles the slot's
+                # array into anything — next reuse just drops it
+                self.staging_ring.release(staged.slot)
+            raise
         rec.end_stage("dispatch")
+        if staged.slot is not None:
+            # the step executed => its input transfer completed; the
+            # output's readiness is the slot's reuse guard
+            self.staging_ring.release(staged.slot, outputs.processed)
         self._flight_last = rec
         self._stage_hist.observe(rec.stage_s("dispatch"),
                                  engine=self.name, stage="dispatch")

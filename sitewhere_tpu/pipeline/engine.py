@@ -28,6 +28,7 @@ from sitewhere_tpu.ops.geofence import GeofenceCondition, GeofenceRuleTable, Zon
 from sitewhere_tpu.ops.pack import (
     EventBatch, EventPacker, batch_to_blob, blob_to_batch)
 from sitewhere_tpu.ops.threshold import ThresholdOp, ThresholdRuleTable, empty_threshold_table
+from sitewhere_tpu.pipeline.staging import StagedBlob, StagingRing
 from sitewhere_tpu.pipeline.state_tensors import DeviceStateTensors, init_device_state
 from sitewhere_tpu.pipeline.step import PipelineParams, ProcessOutputs, check_presence, process_batch
 from sitewhere_tpu.registry.tensors import RegistryTensors
@@ -253,7 +254,8 @@ class PipelineEngine(LifecycleComponent):
                  max_anomaly_models: int = 8,
                  anomaly_model_features: int = 4,
                  anomaly_model_layers: int = 2,
-                 anomaly_model_width: int = 8):
+                 anomaly_model_width: int = 8,
+                 h2d_buffer_depth: int = 3):
         from sitewhere_tpu.ml.compiler import MAX_MODEL_BUCKET
         from sitewhere_tpu.ops.compact import (
             DEFAULT_ALERT_LANE_CAPACITY, MIN_ALERT_LANE_CAPACITY)
@@ -386,6 +388,17 @@ class PipelineEngine(LifecycleComponent):
         self._blob_ring_guards: Optional[list] = None
         self._blob_ring_pos = 0
         self._blob_ring_lock = threading.Lock()
+        # on-device H2D staging ring (pipeline/staging.py): every hot-path
+        # device_put first takes a slot, so at most `h2d_buffer_depth`
+        # transfers are in flight and slot reuse recycles the same
+        # fixed-shape HBM destinations. Depth 1 degenerates to today's
+        # serial transfer behavior; built lazily so config can tune it
+        # before first submit.
+        if not (1 <= int(h2d_buffer_depth) <= 8):
+            raise ValueError("h2d_buffer_depth must be in 1..8")
+        self.h2d_buffer_depth = int(h2d_buffer_depth)
+        self._staging_ring = None
+        self._staging_ring_lock = threading.Lock()
         # Degradation machinery (runtime/health.py, runtime/faults.py):
         # transient H2D/dispatch failures retry with backoff + jitter
         # (step_retries attempts past the first) instead of poisoning the
@@ -1103,6 +1116,82 @@ class PipelineEngine(LifecycleComponent):
                     self._blob_ring_guards[i] = guard
                     return
 
+    @property
+    def staging_ring(self) -> StagingRing:
+        """Lazily-built on-device H2D staging ring (pipeline/staging.py).
+        Lazy so config can set `h2d_buffer_depth` before first use and so
+        engines that never stage explicitly (pure serial submit of numpy
+        blobs) pay nothing."""
+        ring = self._staging_ring
+        if ring is None:
+            with self._staging_ring_lock:
+                if self._staging_ring is None:
+                    self._staging_ring = StagingRing(
+                        self.h2d_buffer_depth, metrics=self._metrics)
+                ring = self._staging_ring
+        return ring
+
+    def _h2d_with_retry(self, put):
+        """Bounded retry/backoff around a host->device transfer. The host
+        blob is intact regardless of how far a failed transfer got (no
+        donation on this edge), so re-issuing the put is always safe."""
+        attempt = 0
+        while True:
+            try:
+                fault_point("h2d_error")
+                return put()
+            except Exception:
+                attempt += 1
+                if attempt > self.step_retries:
+                    raise
+                self._retry_counter.inc()
+                self.health.note_retry()
+                time.sleep(jittered(0.01 * (2 ** (attempt - 1))))
+
+    def _acquire_staging_slot(self, flight_rec, order: Optional[int],
+                              use_ring: bool):
+        """Ring-slot acquisition for a staging edge: ordered + blocking
+        on the normal path (backpressure when the ring is full), skipped
+        entirely when the caller bypasses (overflow drain blobs — see
+        stage_prepared). Stamps the at-acquire ring snapshot on the
+        flight record for the occupancy rollup."""
+        if not use_ring:
+            return None
+        ring = self.staging_ring
+        slot = ring.acquire(order=order, flight_rec=flight_rec)
+        if flight_rec is not None:
+            flight_rec.ring = (ring.occupancy(), ring.depth)
+        return slot
+
+    def stage_blob(self, blob, flight_rec=None,
+                   order: Optional[int] = None) -> StagedBlob:
+        """Stage a packed wire blob through the H2D staging ring: acquire
+        a slot (backpressure when all `h2d_buffer_depth` transfers are in
+        flight), start the async device_put — arming the `h2d_error`
+        fault point with the same bounded retry/backoff as every transfer
+        edge — and return a handle submit_blob dispatches and releases.
+        The pipelined feeder passes its sequence as `order` so slots are
+        granted in dispatch order (see staging.py on why that matters).
+        A failed transfer releases the slot guard-free and propagates, so
+        neighboring in-flight slots are never disturbed."""
+        slot = self._acquire_staging_slot(flight_rec, order, True)
+        if flight_rec is not None:
+            flight_rec.begin_stage("h2d")
+        try:
+            dev = self._h2d_with_retry(lambda: jax.device_put(blob))
+        except BaseException:
+            self.staging_ring.release(slot)
+            raise
+        finally:
+            if flight_rec is not None:
+                flight_rec.end_stage("h2d")
+        slot.device_blob = dev
+        if isinstance(blob, np.ndarray):
+            # host-side blob-ring guard unchanged: the device array's
+            # readiness proves the host staging buffer was fully read
+            self._note_blob_guard(blob, dev)
+        return StagedBlob(dev, slot, self.staging_ring)
+
     def submit(self, batch: EventBatch, age=None) -> ProcessOutputs:
         """Run one fused step; state advances in place (donated). `age`
         is the optional ingest-age sidecar (runtime/eventage.py) the
@@ -1160,6 +1249,12 @@ class PipelineEngine(LifecycleComponent):
         flight record opened by the caller (submit(), or a feeder's
         stager thread — the explicit cross-thread handoff); when None
         this opens a dispatch-only record."""
+        slot = None
+        if isinstance(blob, StagedBlob):
+            # stage_blob already ran the transfer through a ring slot;
+            # dispatch here, then hand the slot back with the step output
+            # as the reuse guard
+            slot, blob = blob.slot, blob.blob
         if self._state is None:  # lazy init for direct (un-started) use
             self.initialize()  # full lifecycle init so a later start() won't re-init
         if self._rule_state is None:  # set_state() without lifecycle init
@@ -1170,10 +1265,19 @@ class PipelineEngine(LifecycleComponent):
         rec = flight_rec if flight_rec is not None else (
             self.flight.begin_step(engine=self.name))
         rec.begin_stage("dispatch")
-        outputs = self._dispatch_with_retry(
-            lambda: self._step_blob(params, self._state, self._rule_state,
-                                    self._model_state, blob))
+        try:
+            outputs = self._dispatch_with_retry(
+                lambda: self._step_blob(params, self._state, self._rule_state,
+                                        self._model_state, blob))
+        except BaseException:
+            if slot is not None:
+                # guard-free release: the failed step's input array is
+                # dropped at next reuse without waiting on anything
+                self.staging_ring.release(slot)
+            raise
         rec.end_stage("dispatch")
+        if slot is not None:
+            self.staging_ring.release(slot, outputs.processed)
         if n_events is not None:
             rec.events = int(n_events)
         self._flight_last = rec

@@ -38,6 +38,19 @@ from sitewhere_tpu.ops.pack import EventBatch, batch_to_blob
 from sitewhere_tpu.runtime.faults import FaultError, fault_point
 
 
+def _stage_window(depth: int, engine) -> int:
+    """How far ahead of the dispatch cursor a stager may run (allowed:
+    seq - next_step <= window). Bounded by the engine's H2D staging-ring
+    depth: with window <= ring_depth - 1, the slots held by sequences
+    LATER than the earliest unstaged one can never fill the ring, so the
+    ordered ring grant (pipeline/staging.py) always reaches it — the
+    pigeonhole half of the deadlock-freedom argument. Ring depth 1
+    degenerates to window 0: stage strictly in dispatch order (today's
+    serial transfer behavior, the differential-test baseline)."""
+    ring_depth = int(getattr(engine, "h2d_buffer_depth", depth))
+    return min(max(1, depth), max(0, ring_depth - 1))
+
+
 class StepFuture:
     """Result handle for one pipelined submit."""
 
@@ -90,6 +103,7 @@ class PipelinedSubmitter:
         self._next_seq = 0              # next sequence to assign
         self._next_step = 0             # next sequence to dispatch
         self._dispatched = 0            # steps whose dispatch has RETURNED
+        self._stage_window = _stage_window(self.depth, engine)
         self._stop = threading.Event()
         self._close_lock = threading.Lock()  # atomic submit-vs-close gate
         self._stagers = [
@@ -140,12 +154,13 @@ class PipelinedSubmitter:
             # (and its device-resident blobs) would grow without limit
             # whenever staging outpaces dispatch, and a staging-ring slot
             # could be repacked while its H2D copy was still in flight.
-            # With the wait, at most depth staged-undispatched + one
-            # in-stage per stager exist at any moment (the engine's ring
-            # of 6 covers depth 3 + 2 stagers with margin).
+            # The window is additionally capped at h2d_buffer_depth - 1
+            # (_stage_window) so the ordered ring grant always reaches
+            # the earliest unstaged sequence — the deadlock-freedom
+            # invariant of the on-device staging ring.
             with self._ready_lock:
                 while (not self._stop.is_set()
-                       and seq - self._next_step > self.depth):
+                       and seq - self._next_step > self._stage_window):
                     self._ready_lock.wait(timeout=0.1)
             if self._stop.is_set():
                 fut._resolve(error=RuntimeError("submitter closed"))
@@ -168,14 +183,16 @@ class PipelinedSubmitter:
                 blob = batch_to_blob(batch, out=buf)
                 rec.end_stage("pack")
                 n = int(np.asarray(batch.valid).sum())
-                # start the H2D transfer now; on async runtimes this
-                # overlaps both other stagers' packs and device compute
-                rec.begin_stage("h2d")
-                dev_blob = jax.device_put(blob)
-                rec.end_stage("h2d")
-                # ring-slot guard: the transferred array itself becomes
-                # ready exactly when the DMA stops reading `blob`
-                self.engine._note_blob_guard(blob, dev_blob)
+                # acquire an on-device staging-ring slot (granted in seq
+                # order; backpressure when all h2d_buffer_depth transfers
+                # are in flight) and start the H2D transfer — on async
+                # runtimes it overlaps both other stagers' packs and
+                # device compute. stage_blob arms the h2d_error fault
+                # point with bounded retry/backoff and notes the host
+                # blob-ring guard; submit_blob releases the slot with the
+                # step's output as the reuse guard.
+                dev_blob = self.engine.stage_blob(blob, flight_rec=rec,
+                                                  order=seq)
                 item = (seq, dev_blob, n, fut, rec, None)
             except BaseException as exc:  # surface through the future
                 item = (seq, None, 0, fut, None, exc)
@@ -273,6 +290,13 @@ class PipelinedSubmitter:
             fut = item[2] if len(item) == 4 else item[3]
             if not fut.done():
                 fut._resolve(error=RuntimeError("submitter closed"))
+            # staged-but-never-dispatched blobs still hold ring slots;
+            # hand them back (guard-free) so a later submitter over the
+            # same engine isn't starved
+            staged = item[1] if len(item) == 6 else None
+            slot = getattr(staged, "slot", None)
+            if slot is not None:
+                self.engine.staging_ring.release(slot)
 
 
 class ShardedPipelinedSubmitter:
@@ -327,6 +351,7 @@ class ShardedPipelinedSubmitter:
         self._next_route = 0        # routing turnstile position
         self._next_step = 0
         self._dispatched = 0
+        self._stage_window = _stage_window(self.depth, engine)
         self._stop = threading.Event()
         self._close_lock = threading.Lock()
         self._stagers = [
@@ -368,10 +393,12 @@ class ShardedPipelinedSubmitter:
                 seq, batch, fut, age = self._in.get(timeout=0.1)
             except queue.Empty:
                 continue
-            # bound the staged-ahead window (see PipelinedSubmitter)
+            # bound the staged-ahead window (see PipelinedSubmitter; the
+            # h2d_buffer_depth - 1 cap keeps the staging ring's ordered
+            # grant deadlock-free here too)
             with self._ready_lock:
                 while (not self._stop.is_set()
-                       and seq - self._next_step > self.depth):
+                       and seq - self._next_step > self._stage_window):
                     self._ready_lock.wait(timeout=0.1)
             # routing turnstile: strict submission order — routing folds
             # in (and re-parks) the engine overflow backlog, so two
@@ -412,8 +439,16 @@ class ShardedPipelinedSubmitter:
                         self._next_route += 1
                         self._ready_lock.notify_all()
                 # mesh transfers start here, OUTSIDE the turnstile: they
-                # overlap other stagers' routing and the device compute
-                staged = [eng.stage_prepared(p) for p in prepped]
+                # overlap other stagers' routing and the device compute.
+                # The step's first blob takes a staging-ring slot in seq
+                # order (backpressure edge); drain blobs bypass the ring
+                # (use_ring=False) — they dispatch before this step's
+                # heap push, so blocking on slots held by their own
+                # siblings would self-deadlock (see stage_prepared)
+                staged = [eng.stage_prepared(p, order=seq if i == 0
+                                             else None,
+                                             use_ring=(i == 0))
+                          for i, p in enumerate(prepped)]
             except BaseException as stage_exc:
                 exc = stage_exc
             with self._ready_lock:
@@ -508,6 +543,13 @@ class ShardedPipelinedSubmitter:
             fut = item[2]
             if not fut.done():
                 fut._resolve(error=RuntimeError("submitter closed"))
+            # release ring slots of staged-but-never-dispatched steps
+            # (ready-heap items carry a staged LIST; _in queue items
+            # carry the raw EventBatch — skip those)
+            if isinstance(item[1], list):
+                for s in item[1]:
+                    if getattr(s, "slot", None) is not None:
+                        self.engine.staging_ring.release(s.slot)
 
 
 class AdaptiveBatcher:

@@ -1,0 +1,207 @@
+"""On-device H2D staging-buffer ring: bounded, ordered, guarded slots.
+
+ROADMAP item 2 / "Extending TensorFlow's Semantics with Pipelined
+Execution": a depth-configurable ring of on-device staging destinations
+so batch N+1's host->device transfer overlaps batch N's compute. Every
+transfer on the hot path — the single-chip feeder's ``device_put``, the
+sharded engine's ``stage_prepared``/``stage_routed_blob`` — first
+acquires a ring slot; the ring bounds how many transfers can be in
+flight (backpressure when full), recycles each slot's fixed-shape HBM
+destination (the previous step's array is dropped only after its
+consuming step proved the transfer complete, so the allocator hands the
+same block back to the next ``device_put`` instead of growing the
+working set), and preserves dispatch order via ordered acquisition.
+
+Why ordered acquisition matters: stagers pack concurrently, so the
+stager holding sequence N can reach the ring AFTER the stagers holding
+N+1 and N+2. Granting free slots in arrival order could then fill the
+ring with later sequences while the step thread waits for N — every
+slot held by a step that cannot dispatch until N does. ``acquire``
+therefore grants a free slot to the LOWEST pending order key; callers
+without an order (serial submit paths) draw keys from a high counter so
+they never starve an ordered feeder. The feeders additionally bound
+their stage-ahead window to the ring depth (pipeline/feed.py), so the
+earliest unstaged sequence always finds a slot — the pigeonhole
+argument that makes the ring deadlock-free.
+
+Slot lifecycle::
+
+    acquire(order)        wait for a free slot (counting full_waits and
+                          marking the flight "stage_wait" segment when
+                          the ring is full), then wait on the slot's
+                          guard (the previous consumer's output — ready
+                          no earlier than the previous transfer) and
+                          drop the previous device array
+    slot.device_blob = .. the caller's device_put result parks here;
+                          resident ring bytes show in the HBM ledger
+    release(guard)        slot returns to the free pool; `guard` is the
+                          consuming step's output (or None on an error
+                          path — reuse then skips the guard wait)
+
+The disarmed cost is one lock acquisition and a couple of list ops per
+step — no allocation, no device sync (the guard wait is almost always
+already-ready by the time a slot cycles back).
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import threading
+from typing import List, Optional
+
+# order keys for callers that do not pass one (serial submit paths):
+# drawn from a counter starting far above any plausible feeder sequence,
+# so an ordered feeder's keys always win the grant when both wait
+_UNORDERED_BASE = 1 << 60
+
+
+class RingSlot:
+    """One staging destination: the device array most recently
+    transferred into this slot and the guard proving its consumer is
+    done with it."""
+
+    __slots__ = ("index", "device_blob", "guard", "in_flight")
+
+    def __init__(self, index: int) -> None:
+        self.index = index
+        self.device_blob = None   # last device_put result staged here
+        self.guard = None         # consuming step's output (readiness
+        self.in_flight = False    # proves the transfer completed)
+
+
+class StagedBlob:
+    """Handle for a wire blob whose H2D transfer went through a ring
+    slot (PipelineEngine.stage_blob): `blob` is the device array,
+    `slot`/`ring` let submit_blob release the slot with the consuming
+    step's output as the reuse guard."""
+
+    __slots__ = ("blob", "slot", "ring")
+
+    def __init__(self, blob, slot: RingSlot, ring: "StagingRing") -> None:
+        self.blob = blob
+        self.slot = slot
+        self.ring = ring
+
+
+class StagingRing:
+    """Fixed-depth ring of on-device staging slots with ordered,
+    backpressured acquisition (module docstring has the full contract).
+
+    `metrics` is the owning engine's scoped registry; the ring counts
+    `staging_ring.full_waits` there (every acquire that found no free
+    slot) so a stalled ring is visible per engine.
+    """
+
+    def __init__(self, depth: int, metrics=None) -> None:
+        self.depth = max(1, int(depth))
+        self._slots = [RingSlot(i) for i in range(self.depth)]
+        self._free: List[RingSlot] = list(self._slots)
+        self._cv = threading.Condition()
+        self._waiters: List = []              # heap of (key, tiebreak)
+        self._tiebreak = itertools.count()
+        self._unordered = itertools.count(_UNORDERED_BASE)
+        self.full_waits = 0
+        self.acquires = 0
+        self._full_counter = (metrics.counter("staging_ring.full_waits")
+                              if metrics is not None else None)
+
+    # -- hot path -----------------------------------------------------
+    def acquire(self, order: Optional[int] = None, flight_rec=None,
+                blocking: bool = True) -> Optional[RingSlot]:
+        """Take a free slot, granting in `order` (lowest pending key
+        first). Blocks while the ring is full — the backpressure edge —
+        counting `full_waits` and marking the flight record's
+        "stage_wait" segment. `blocking=False` returns None instead of
+        waiting (drain-step bypass). After the grant, waits on the
+        slot's guard so the previous occupant's transfer is provably
+        complete before its device array is dropped for reuse."""
+        key = (order if order is not None else next(self._unordered),
+               next(self._tiebreak))
+        waited = False
+        with self._cv:
+            if not blocking:
+                if not self._free:
+                    return None
+                slot = self._free.pop(0)
+                slot.in_flight = True
+            else:
+                heapq.heappush(self._waiters, key)
+                while not (self._free and self._waiters[0] == key):
+                    if not waited and not self._free:
+                        # the ring-full wait is the backpressure signal;
+                        # an ordering wait (slot free, earlier sequence
+                        # pending) is not "full" and stays uncounted
+                        waited = True
+                        self.full_waits += 1
+                        if self._full_counter is not None:
+                            self._full_counter.inc()
+                        if flight_rec is not None:
+                            flight_rec.begin_stage("stage_wait")
+                    self._cv.wait(timeout=0.1)
+                heapq.heappop(self._waiters)
+                slot = self._free.pop(0)
+                slot.in_flight = True
+                self._cv.notify_all()   # next-lowest waiter re-checks
+            self.acquires += 1
+        if waited and flight_rec is not None:
+            flight_rec.end_stage("stage_wait")
+        guard, slot.guard = slot.guard, None
+        if guard is not None:
+            # reuse must wait for the slot's previous consumer: its
+            # output is ready no earlier than the transfer it consumed.
+            # By the time a ring cycles back this is almost always done.
+            if flight_rec is not None:
+                flight_rec.begin_stage("guard")
+            try:
+                guard.block_until_ready()
+            except Exception:
+                pass  # a failed step still implies the transfer finished
+            if flight_rec is not None:
+                flight_rec.end_stage("guard")
+        # drop the previous occupant only now: the allocator hands the
+        # same fixed-shape block to the caller's next device_put instead
+        # of growing the steady-state working set
+        slot.device_blob = None
+        return slot
+
+    def release(self, slot: RingSlot, guard=None) -> None:
+        """Return `slot` to the free pool. `guard` is the consuming
+        step's output; None (error paths) makes the next reuse skip the
+        guard wait — safe, because the error path never recycles the
+        host buffer the failed transfer may still be reading."""
+        with self._cv:
+            if not slot.in_flight:
+                return  # double-release guard (error-path idempotence)
+            slot.guard = guard
+            slot.in_flight = False
+            self._free.append(slot)
+            self._cv.notify_all()
+
+    # -- telemetry ----------------------------------------------------
+    def occupancy(self) -> int:
+        """Slots currently acquired (in flight)."""
+        with self._cv:
+            return self.depth - len(self._free)
+
+    def resident_bytes(self) -> int:
+        """Device bytes currently parked in ring slots (the HBM
+        ledger's `staging_ring` table row)."""
+        total = 0
+        for slot in self._slots:
+            blob = slot.device_blob
+            total += int(getattr(blob, "nbytes", 0) or 0)
+        return total
+
+    def state(self) -> dict:
+        """Snapshot for flight export / REST diagnosis: per-slot
+        in-flight bits plus the backpressure counters — a stalled ring
+        shows every slot in flight and `full_waits` climbing."""
+        with self._cv:
+            return {
+                "depth": self.depth,
+                "occupancy": self.depth - len(self._free),
+                "in_flight": [s.in_flight for s in self._slots],
+                "full_waits": self.full_waits,
+                "acquires": self.acquires,
+            }

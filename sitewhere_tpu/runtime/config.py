@@ -186,6 +186,10 @@ DEFAULTS: Dict = {
         # real multi-shard single-controller meshes (single-chip and
         # multi-host keep the host arena route); "on"/"off" force it
         "device_routing": "auto",
+        # H2D staging-ring depth (pipeline/staging.py): in-flight
+        # host->device transfers; 1 = serial staging, 2-3 overlap the
+        # transfer of batch N+1 with the compute of batch N (PERF.md)
+        "h2d_buffer_depth": 3,
         "max_devices": 131072,
         "max_zones": 256,
         "max_zone_vertices": 32,

@@ -139,6 +139,14 @@ def pipeline_model() -> ElementModel:
                               "ICI all_to_all in the fused step) instead "
                               "of the host arena router; auto = on for "
                               "multi-shard single-controller meshes"),
+            _attr("h2d_buffer_depth", _I, default=3,
+                  description="on-device H2D staging-ring depth "
+                              "(pipeline/staging.py): how many host->"
+                              "device transfers may be in flight so "
+                              "batch N+1's transfer overlaps batch N's "
+                              "compute; 1 = serial transfers (the "
+                              "differential baseline), 2-3 typical — "
+                              "see docs/PERF.md"),
         ])
 
 

@@ -37,6 +37,7 @@ STAGES: Tuple[str, ...] = (
     "route_host",      # sharded host fallback: arena router route_batch
     "route_device",    # sharded device path: flat-blob pack for radix route
     "guard",           # host: wait on staging-ring transfer guard
+    "stage_wait",      # host: backpressure wait for a free staging-ring slot
     "h2d",             # host: device_put submit (async; segment = submit cost)
     "dispatch",        # host: jit step call until handles returned
     "device_compute",  # device: dispatch start -> outputs ready (needs sync)
@@ -51,6 +52,9 @@ N_STAGES = len(STAGES)
 # step thread still has the previous step's dispatch in flight.  Overlap
 # of these segments with the preceding record's dispatch window is the
 # ``h2d_overlap_fraction`` ROADMAP item 2 will be gated on.
+# ``stage_wait`` is deliberately NOT here: time spent blocked on a full
+# staging ring is backpressure, not productive staging work — counting it
+# would inflate the overlap fraction exactly when the ring stalls.
 _STAGING_STAGES = ("pack", "route_host", "route_device", "guard", "h2d")
 
 
@@ -63,7 +67,7 @@ class StepRecord:
     """
 
     __slots__ = ("seq", "gen", "engine", "events", "tenant_mix",
-                 "begin", "end", "created", "age")
+                 "begin", "end", "created", "age", "ring")
 
     def __init__(self) -> None:
         self.seq = -1            # lineage id (recorder-wide monotonic)
@@ -78,6 +82,9 @@ class StepRecord:
         # while the batch is in flight, replaced by the closed AgeSummary
         # at materialize — export only reads the closed form
         self.age = None
+        # staging-ring snapshot at slot-acquire time: (occupancy, depth),
+        # None when the step never touched the ring
+        self.ring: Optional[Tuple[int, int]] = None
 
     # -- hot path -----------------------------------------------------
     def reset(self, seq: int, gen: int, engine: str) -> None:
@@ -92,6 +99,7 @@ class StepRecord:
             e[i] = -1.0
         self.created = time.perf_counter()
         self.age = None
+        self.ring = None
 
     def mark(self, stage: str, t0: float, t1: float) -> None:
         """Record a completed segment from explicit timestamps."""
@@ -159,6 +167,9 @@ class StepRecord:
         }
         if self.tenant_mix is not None:
             out["tenant_mix"] = list(self.tenant_mix)
+        if self.ring is not None:
+            out["ring"] = {"occupancy": self.ring[0],
+                           "depth": self.ring[1]}
         age = self.age
         if age is not None and hasattr(age, "export"):
             exported = age.export()
@@ -216,6 +227,7 @@ class FlightRecorder:
             copy.end = list(slot.end)
             copy.created = slot.created
             copy.age = slot.age
+            copy.ring = slot.ring
             if slot.gen != gen:  # re-armed while we copied: discard
                 continue
             out.append(copy)
@@ -294,11 +306,26 @@ class FlightRecorder:
                 age_total = AgeSummary()
             age_total.merge(age)
         out_age = age_total.export() if age_total is not None else None
+        # staging-ring occupancy rollup: how full the H2D ring ran across
+        # the window (mean/max of the at-acquire snapshots).  A ring
+        # pinned at depth means the feeder is transfer-bound; zero means
+        # the ring never engaged (serial path or depth 1 idle).
+        ring_occ = [r.ring[0] for r in recs if r.ring is not None]
+        ring_depth = max((r.ring[1] for r in recs if r.ring is not None),
+                         default=0)
+        ring_out = None
+        if ring_occ:
+            ring_out = {
+                "depth": ring_depth,
+                "mean_occupancy": round(sum(ring_occ) / len(ring_occ), 3),
+                "max_occupancy": max(ring_occ),
+            }
         return {
             "steps": n,
             "events": events,
             "window_ms": round(wall * 1e3, 3),
             **({"event_age": out_age} if out_age else {}),
+            **({"staging_ring": ring_out} if ring_out else {}),
             "stage_occupancy": occupancy,
             # sum-vs-max: if the pipeline overlapped perfectly, wall per
             # step converges to the max stage cost; serial execution

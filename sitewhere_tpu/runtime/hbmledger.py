@@ -77,6 +77,13 @@ def table_bytes(engine) -> Dict[str, int]:
     ring = getattr(engine, "_blob_ring", None)
     out["staging_buffers"] = (sum(int(b.nbytes) for b in ring)
                               if ring else 0)
+    # on-device H2D staging ring (pipeline/staging.py): device arrays
+    # currently parked in ring slots — the DEVICE-side counterpart of
+    # staging_buffers, sizing the multi-buffered transfer working set
+    # (h2d_buffer_depth in-flight blobs at steady state)
+    dev_ring = getattr(engine, "_staging_ring", None)
+    out["staging_ring"] = (int(dev_ring.resident_bytes())
+                           if dev_ring is not None else 0)
     return out
 
 

@@ -1,0 +1,516 @@
+"""H2D staging ring (pipeline/staging.py) + ring-staged engine paths.
+
+The multi-buffered ring must be INVISIBLE in results: any depth produces
+bit-identical outputs and final state to depth-1 serial staging on both
+engine kinds (single-chip and sharded, including the device-routing and
+overflow-requeue paths), with strict dispatch order under concurrent
+stagers and backpressure — never overrun — when every slot is in
+flight. Fault drills prove a failed transfer into a slot retries with
+backoff, releases the slot on exhaustion, never disturbs neighboring
+in-flight slots, and parks byte-identical on the dead-letter topic when
+the consumer layer's budget runs out.
+"""
+
+import dataclasses
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from sitewhere_tpu.model import (
+    Device, DeviceAssignment, DeviceMeasurement, DeviceType)
+from sitewhere_tpu.ops.pack import batch_to_blob, empty_batch
+from sitewhere_tpu.pipeline.engine import PipelineEngine, ThresholdRule
+from sitewhere_tpu.pipeline.feed import (
+    PipelinedSubmitter, ShardedPipelinedSubmitter)
+from sitewhere_tpu.pipeline.staging import StagedBlob, StagingRing
+from sitewhere_tpu.registry import DeviceManagement, RegistryTensors
+from sitewhere_tpu.runtime.faults import (
+    FaultError, FaultPlan, FaultRule, arm, disarm)
+
+
+@pytest.fixture(autouse=True)
+def _always_disarm():
+    disarm()
+    yield
+    disarm()
+
+
+def _world(n_devices=16, capacity=64):
+    dm = DeviceManagement()
+    dtype = dm.create_device_type(DeviceType(token="t"))
+    tensors = RegistryTensors(capacity, 4, 4)
+    for i in range(n_devices):
+        device = dm.create_device(Device(token=f"d{i}",
+                                         device_type_id=dtype.id))
+        dm.create_device_assignment(
+            DeviceAssignment(token=f"a{i}", device_id=device.id))
+    tensors.attach(dm, "tenant")
+    return dm, tensors
+
+
+def _engine(tensors, batch_size=32, depth=3):
+    engine = PipelineEngine(tensors, batch_size=batch_size,
+                            h2d_buffer_depth=depth)
+    engine.start()
+    engine.add_threshold_rule(ThresholdRule(
+        token="r", measurement_name="m", operator=">", threshold=100.0))
+    return engine
+
+
+def _sharded_engine(tensors, per_shard=24, n_shards=4, **kw):
+    from sitewhere_tpu.parallel import ShardedPipelineEngine, make_mesh
+
+    eng = ShardedPipelineEngine(tensors, mesh=make_mesh(n_shards),
+                                per_shard_batch=per_shard, **kw)
+    eng.start()
+    eng.add_threshold_rule(ThresholdRule(
+        token="r", measurement_name="m", operator=">", threshold=100.0))
+    return eng
+
+
+def _batches(engine, n_batches, n_devices=16, tokens=None):
+    out = []
+    for k in range(n_batches):
+        events = [DeviceMeasurement(name="m", value=float(k * 100 + i),
+                                    event_date=1000 + k * 50 + i)
+                  for i in range(n_devices)]
+        out.append(engine.packer.pack_events(
+            events, tokens or [f"d{i}" for i in range(n_devices)])[0])
+    return out
+
+
+def _assert_same_state(a, b):
+    sa, sb = a.canonical_state(), b.canonical_state()
+    for f in dataclasses.fields(sa):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(sa, f.name)),
+            np.asarray(getattr(sb, f.name)), err_msg=f.name)
+
+
+class TestStagingRingUnit:
+    def test_depth_clamped_and_initially_free(self):
+        ring = StagingRing(0)
+        assert ring.depth == 1
+        ring = StagingRing(3)
+        assert ring.occupancy() == 0
+        assert ring.state()["in_flight"] == [False, False, False]
+
+    def test_nonblocking_returns_none_when_full(self):
+        ring = StagingRing(2)
+        a = ring.acquire()
+        b = ring.acquire()
+        assert a is not None and b is not None
+        assert ring.acquire(blocking=False) is None
+        ring.release(a)
+        assert ring.acquire(blocking=False) is a
+
+    def test_ordered_grant_lowest_sequence_first(self):
+        """With the ring full and two ordered waiters pending, the freed
+        slot must go to the LOWER sequence regardless of arrival order."""
+        ring = StagingRing(1)
+        held = ring.acquire(order=0)
+        got = []
+
+        def _waiter(seq):
+            slot = ring.acquire(order=seq)
+            got.append(seq)
+            ring.release(slot)
+
+        t_late = threading.Thread(target=_waiter, args=(7,))
+        t_late.start()
+        time.sleep(0.05)  # the later sequence queues FIRST
+        t_early = threading.Thread(target=_waiter, args=(3,))
+        t_early.start()
+        time.sleep(0.05)
+        ring.release(held)
+        t_early.join(timeout=5)
+        t_late.join(timeout=5)
+        assert got == [3, 7]
+        assert ring.full_waits >= 2
+
+    def test_unordered_acquire_never_starves_ordered(self):
+        """Serial-path callers draw keys above any feeder sequence, so an
+        ordered waiter always wins the next free slot."""
+        ring = StagingRing(1)
+        held = ring.acquire()
+        got = []
+
+        def _unordered():
+            slot = ring.acquire()
+            got.append("unordered")
+            ring.release(slot)
+
+        def _ordered():
+            slot = ring.acquire(order=5)
+            got.append("ordered")
+            ring.release(slot)
+
+        t1 = threading.Thread(target=_unordered)
+        t1.start()
+        time.sleep(0.05)
+        t2 = threading.Thread(target=_ordered)
+        t2.start()
+        time.sleep(0.05)
+        ring.release(held)
+        t2.join(timeout=5)
+        t1.join(timeout=5)
+        assert got[0] == "ordered"
+
+    def test_release_idempotent_and_guard_waited_on_reuse(self):
+        ring = StagingRing(1)
+        slot = ring.acquire()
+        waited = []
+
+        class Guard:
+            def block_until_ready(self):
+                waited.append(True)
+
+        ring.release(slot, guard=Guard())
+        ring.release(slot)  # double release: no-op, not a second free
+        assert ring.occupancy() == 0
+        assert ring.acquire(blocking=False) is slot
+        assert waited == [True]  # reuse blocked on the previous consumer
+        # error-path release (no guard): next reuse skips the wait
+        ring.release(slot)
+        assert ring.acquire(blocking=False) is slot
+        assert waited == [True]
+
+    def test_resident_bytes_and_counters(self):
+        ring = StagingRing(2)
+        slot = ring.acquire()
+        slot.device_blob = np.zeros((5, 8), np.int32)
+        assert ring.resident_bytes() == 5 * 8 * 4
+        state = ring.state()
+        assert state["depth"] == 2 and state["occupancy"] == 1
+        assert state["acquires"] == 1
+        ring.release(slot)
+        # reuse is FIFO across slots; cycling back to the parked slot
+        # drops its array at acquire for allocator reuse
+        ring.acquire()          # the other slot
+        ring.acquire()          # the parked slot: array dropped here
+        assert ring.resident_bytes() == 0
+
+
+class TestSingleChipDifferential:
+    def test_depth3_bit_identical_to_depth1_and_sequential(self):
+        """The ring is invisible in results: pipelined feeding at depth 3
+        == depth 1 (serial staging) == plain sequential submit."""
+        _, t0 = _world()
+        _, t1 = _world()
+        _, t3 = _world()
+        seq = _engine(t0)
+        d1 = _engine(t1, depth=1)
+        d3 = _engine(t3, depth=3)
+        batches = _batches(seq, 12)
+
+        seq_outs = [seq.submit(b) for b in batches]
+        outs = {}
+        for eng in (d1, d3):
+            sub = PipelinedSubmitter(eng, depth=3, stagers=2)
+            futs = [sub.submit(b) for b in batches]
+            sub.flush()
+            outs[eng] = [f.result() for f in futs]
+            sub.close()
+        for eng in (d1, d3):
+            for got, want in zip(outs[eng], seq_outs):
+                assert int(got.processed) == int(want.processed)
+                assert int(got.alerts) == int(want.alerts)
+                np.testing.assert_array_equal(
+                    np.asarray(got.threshold_fired),
+                    np.asarray(want.threshold_fired))
+            _assert_same_state(eng, seq)
+        # depth 1 collapses the feeder's stage-ahead window to serial
+        assert d1.staging_ring.depth == 1
+        assert d3.staging_ring.depth == 3
+
+    def test_explicit_stage_blob_roundtrip(self):
+        _, tensors = _world()
+        eng = _engine(tensors, depth=2)
+        batch = _batches(eng, 1)[0]
+        staged = eng.stage_blob(batch_to_blob(batch))
+        assert isinstance(staged, StagedBlob)
+        assert eng.staging_ring.occupancy() == 1
+        out = eng.submit_blob(staged)
+        assert int(out.processed) == 16
+        assert eng.staging_ring.occupancy() == 0  # released post-dispatch
+
+    def test_feeder_order_preserved_under_slow_dispatch_stall(self):
+        """A stalled dispatch must back the stagers up against the ring
+        (full_waits climbs) without ever reordering steps: last-value
+        state still shows the final batch."""
+        _, t1 = _world()
+        _, t2 = _world()
+        ref = _engine(t1)
+        eng = _engine(t2, depth=2)
+        batches = _batches(ref, 16)
+        for b in batches:
+            ref.submit(b)
+
+        real = type(eng).submit_blob
+
+        def slow(self, blob, n_events=None, flight_rec=None):
+            time.sleep(0.02)  # dispatch is the slow stage
+            return real(self, blob, n_events=n_events,
+                        flight_rec=flight_rec)
+
+        try:
+            type(eng).submit_blob = slow
+            sub = PipelinedSubmitter(eng, depth=4, stagers=3)
+            last = None
+            for b in batches:
+                last = sub.submit(b)
+            sub.flush()
+            last.result(timeout=60)
+            sub.close()
+        finally:
+            type(eng).submit_blob = real
+        _assert_same_state(eng, ref)
+        # depth-2 ring + 3 stagers behind a slow dispatcher: the
+        # backpressure edge must have engaged
+        assert eng.staging_ring.full_waits > 0
+        assert eng.staging_ring.occupancy() == 0
+
+    def test_backpressure_bounds_in_flight_transfers(self):
+        """stage_blob with every slot held must block until a slot frees
+        — the ring, not the caller, bounds in-flight H2D transfers."""
+        _, tensors = _world()
+        eng = _engine(tensors, depth=2)
+        blob = batch_to_blob(_batches(eng, 1)[0])
+        s1 = eng.stage_blob(blob)
+        s2 = eng.stage_blob(blob)
+        assert eng.staging_ring.occupancy() == 2
+        staged3 = []
+
+        def _third():
+            staged3.append(eng.stage_blob(blob))
+
+        th = threading.Thread(target=_third)
+        th.start()
+        time.sleep(0.1)
+        assert not staged3  # blocked: ring full
+        assert eng.staging_ring.full_waits >= 1
+        out = eng.submit_blob(s1)  # dispatch frees slot 1
+        th.join(timeout=10)
+        assert len(staged3) == 1
+        assert int(out.processed) == 16
+        eng.submit_blob(s2)
+        eng.submit_blob(staged3[0])
+        assert eng.staging_ring.occupancy() == 0
+
+
+class TestShardedDifferential:
+    def test_depth3_bit_identical_to_depth1(self):
+        _, t1 = _world()
+        _, t2 = _world()
+        d1 = _sharded_engine(t1, h2d_buffer_depth=1)
+        d3 = _sharded_engine(t2, h2d_buffer_depth=3)
+        batches = _batches(d1, 12)
+        outs = {}
+        for eng in (d1, d3):
+            sub = ShardedPipelinedSubmitter(eng, depth=3, stagers=2)
+            futs = [sub.submit(b) for b in batches]
+            sub.flush()
+            outs[eng] = [f.result()[1] for f in futs]
+            sub.close()
+        for got, want in zip(outs[d3], outs[d1]):
+            assert int(got.processed) == int(want.processed)
+            assert int(got.alerts) == int(want.alerts)
+        _assert_same_state(d1, d3)
+
+    def test_device_routing_path_bit_identical_to_depth1(self):
+        """The on-device routing staging path (stage_prepared device
+        kind) rides ring slots too — results must still match serial."""
+        _, t1 = _world()
+        _, t2 = _world()
+        d1 = _sharded_engine(t1, device_routing=True, h2d_buffer_depth=1)
+        d3 = _sharded_engine(t2, device_routing=True, h2d_buffer_depth=3)
+        assert d1.device_routing and d3.device_routing
+        batches = _batches(d1, 10)
+        for eng in (d1, d3):
+            sub = ShardedPipelinedSubmitter(eng, depth=3, stagers=2)
+            last = None
+            for b in batches:
+                last = sub.submit(b)
+            sub.flush()
+            last.result(timeout=60)
+            sub.close()
+        _assert_same_state(d1, d3)
+
+    def test_overflow_requeue_bit_identical_to_depth1(self):
+        """Skewed batches overflow a shard every step; the drain blobs
+        bypass the ring (use_ring=False) but results must still match
+        the depth-1 serial baseline exactly."""
+        _, t1 = _world()
+        _, t2 = _world()
+        d1 = _sharded_engine(t1, per_shard=8, h2d_buffer_depth=1)
+        d3 = _sharded_engine(t2, per_shard=8, h2d_buffer_depth=3)
+        batches = []
+        for k in range(10):
+            events = [DeviceMeasurement(name="m", value=float(k * 100 + i),
+                                        event_date=1000 + k * 50 + i)
+                      for i in range(16)]
+            batches.append(d1.packer.pack_events(events, ["d5"] * 16)[0])
+        for eng in (d1, d3):
+            sub = ShardedPipelinedSubmitter(eng, depth=4, stagers=3)
+            last = None
+            for b in batches:
+                last = sub.submit(b)
+            sub.flush()
+            last.result(timeout=60)
+            sub.close()
+            while eng.pending_overflow:
+                eng.submit(empty_batch(4))
+        _assert_same_state(d1, d3)
+        assert (d3.get_device_state("d5").last_measurements["m"][1]
+                == 915.0)  # batch k=9, row i=15: the true last value
+
+
+class TestStagingFaults:
+    def test_h2d_error_in_slot_retries_with_backoff(self):
+        _, tensors = _world()
+        eng = _engine(tensors, depth=2)
+        blob = batch_to_blob(_batches(eng, 1)[0])
+        retries0 = eng._retry_counter.value
+        arm(FaultPlan(seed=29, rules=[FaultRule("h2d_error", times=1)]))
+        t0 = time.perf_counter()
+        staged = eng.stage_blob(blob)
+        elapsed = time.perf_counter() - t0
+        disarm()
+        assert eng._retry_counter.value == retries0 + 1
+        assert elapsed >= 0.005  # the retry backed off before re-issuing
+        out = eng.submit_blob(staged)
+        assert int(out.processed) == 16
+
+    def test_exhaustion_releases_slot_and_spares_neighbors(self):
+        """h2d_error past the retry budget: the failed acquire's slot
+        returns to the pool, the neighboring in-flight slot's staged
+        transfer is untouched (same outputs as a clean engine), and the
+        ring keeps working afterwards."""
+        _, t1 = _world()
+        _, t2 = _world()
+        ref = _engine(t1)
+        eng = _engine(t2, depth=3)
+        batches = _batches(ref, 3)
+        ref_outs = [ref.submit(b) for b in batches]
+
+        neighbor = eng.stage_blob(batch_to_blob(batches[0]), order=0)
+        assert eng.staging_ring.occupancy() == 1
+        arm(FaultPlan(seed=29, rules=[FaultRule("h2d_error", times=8)]))
+        with pytest.raises(FaultError):
+            eng.stage_blob(batch_to_blob(batches[1]), order=1)
+        disarm()
+        # the failed slot was released; only the neighbor is in flight
+        assert eng.staging_ring.occupancy() == 1
+        out0 = eng.submit_blob(neighbor)
+        assert int(out0.processed) == int(ref_outs[0].processed)
+        np.testing.assert_array_equal(
+            np.asarray(out0.threshold_fired),
+            np.asarray(ref_outs[0].threshold_fired))
+        # the ring still cycles: stage + dispatch the remaining batches
+        for i in (1, 2):
+            out = eng.submit_blob(eng.stage_blob(batch_to_blob(batches[i])))
+            assert int(out.processed) == int(ref_outs[i].processed)
+        _assert_same_state(eng, ref)
+        assert eng.staging_ring.occupancy() == 0
+
+    def test_exhausted_staging_parks_byte_identical_on_dead_letter(self):
+        """Through the consumer layer: a batch whose ring-slot staging
+        deterministically fails stops redelivering after the retry
+        budget and parks BYTE-IDENTICAL on the dead-letter topic; with
+        faults cleared the parked bytes replay to full effect."""
+        from sitewhere_tpu.runtime.bus import ConsumerHost, EventBus
+
+        _, tensors = _world()
+        eng = _engine(tensors, depth=2)
+        batches = _batches(eng, 2)
+        payloads = {b"batch-0": batches[0], b"batch-1": batches[1]}
+        done = threading.Event()
+
+        def handler(batch):
+            for record in batch:
+                staged = eng.stage_blob(
+                    batch_to_blob(payloads[record.value]))
+                eng.submit_blob(staged)
+                if record.value == b"batch-1":
+                    done.set()
+
+        bus = EventBus(partitions=1)
+        host = ConsumerHost(bus, "ingest", "g", handler,
+                            poll_timeout_s=0.05, max_retries=1)
+        host.start()
+        # each handler attempt burns 1 + step_retries h2d attempts; a
+        # large `times` keeps the fault firing through every redelivery
+        arm(FaultPlan(seed=43, rules=[FaultRule("h2d_error", times=64)]))
+        bus.publish("ingest", b"k", b"batch-0")
+        deadline = time.time() + 15
+        while time.time() < deadline and host.dead_lettered == 0:
+            time.sleep(0.02)
+        assert host.dead_lettered == 1
+        disarm()
+        bus.publish("ingest", b"k", b"batch-1")  # progress resumes
+        assert done.wait(15.0)
+        host.stop()
+        # byte-identical park, and no slot leaked across the failures
+        dlq = bus.consumer(host.dead_letter_topic, "repair")
+        dlq.seek_to_beginning()
+        parked = dlq.poll()
+        assert [r.value for r in parked] == [b"batch-0"]
+        assert eng.staging_ring.occupancy() == 0
+        # replay the parked bytes with faults disarmed: full effect
+        handler(parked)
+        assert (eng.get_device_state("d3").last_measurements["m"][1]
+                == 3.0)  # batch k=0, row i=3 — the replayed batch landed
+
+
+class TestStagingObservability:
+    def test_flight_records_carry_ring_snapshot_and_rollup(self):
+        """The feeder path stamps the at-acquire ring snapshot on each
+        step's flight record; the export rollup aggregates occupancy."""
+        _, tensors = _world()
+        eng = _engine(tensors, depth=2)
+        sub = PipelinedSubmitter(eng, depth=3, stagers=2)
+        last = None
+        for b in _batches(eng, 6):
+            last = sub.submit(b)
+        sub.flush()
+        last.result(timeout=60)
+        sub.close()
+        export = eng.flight.export(last_n=6)
+        ringed = [r for r in export["records"] if "ring" in r]
+        assert ringed, "staged steps must carry the at-acquire snapshot"
+        assert all(r["ring"]["depth"] == 2 for r in ringed)
+        roll = export["rollups"].get("staging_ring")
+        assert roll and roll["depth"] == 2
+        assert 0 < roll["mean_occupancy"] <= 2
+        assert 1 <= roll["max_occupancy"] <= 2
+
+    def test_hbm_ledger_counts_parked_ring_bytes(self):
+        from sitewhere_tpu.runtime.hbmledger import table_bytes
+
+        _, tensors = _world()
+        eng = _engine(tensors, depth=2)
+        assert table_bytes(eng)["staging_ring"] == 0  # ring unused
+        staged = eng.stage_blob(batch_to_blob(_batches(eng, 1)[0]))
+        parked = table_bytes(eng)["staging_ring"]
+        assert parked == int(staged.blob.nbytes)
+        eng.submit_blob(staged)
+
+    def test_full_waits_counted_in_engine_metrics(self):
+        _, tensors = _world()
+        eng = _engine(tensors, depth=1)
+        counter = eng._metrics.counter("staging_ring.full_waits")
+        before = counter.value
+        blob = batch_to_blob(_batches(eng, 1)[0])
+        held = eng.stage_blob(blob)
+
+        def _second():
+            eng.submit_blob(eng.stage_blob(blob, order=1))
+
+        th = threading.Thread(target=_second)
+        th.start()
+        time.sleep(0.1)
+        eng.submit_blob(held)
+        th.join(timeout=10)
+        assert counter.value > before
