@@ -162,6 +162,15 @@ MAX_OBSERVABILITY_OVERHEAD_PCT = 1.0
 MAX_FAULT_OVERHEAD_PCT = 0.5
 MAX_FENCING_OVERHEAD_PCT = 1.0
 
+# Feeder fleet (sitewhere_tpu/feeders/): with feeders attached the mesh
+# host's per-blob work must be H2D + dispatch — the receiver-side handoff
+# overhead (decode + watermark + lock bookkeeping around the step) must
+# stay under 5% of the step wall at feeders=1. Advisory on CPU-only
+# hosts: the cpu backend's step is host CPU too, so the ratio there
+# measures Python dispatch against a synchronous step, not the
+# accelerator deployment the bound is about.
+MAX_FEEDER_HANDOFF_PCT = 5.0
+
 # Event-age telemetry (runtime/eventage.py): per step the hot path pays
 # one sidecar stamp at ingest + one pure close() + one aggregate bucket
 # fold into the labeled histogram; bench probes the full set and the sum
@@ -567,6 +576,28 @@ def self_consistency(bench: Dict) -> Dict:
                     "steps make the ratio noise — the bound gates at "
                     "full scale)")
             checks["fencing_overhead"] = entry
+    # Feeder-fleet handoff budget: at feeders=1 the blob receiver's
+    # non-step work must stay under 5% of the step wall — the subsystem's
+    # whole point is that the mesh host no longer decodes/interns/packs.
+    # Hard on accelerator-fingerprinted hosts; advisory on the cpu smoke
+    # (see MAX_FEEDER_HANDOFF_PCT). Absent before the tier existed: no
+    # check.
+    ff = bench.get("feeder_fleet")
+    if isinstance(ff, dict):
+        ff_pct = ff.get("handoff_pct_of_step")
+        if isinstance(ff_pct, (int, float)):
+            ff_ok = ff_pct < MAX_FEEDER_HANDOFF_PCT
+            entry = {
+                "ok": ff_ok or cpu_host or small,
+                "handoff_pct_of_step": ff_pct,
+                "max_pct": MAX_FEEDER_HANDOFF_PCT}
+            if (cpu_host or small) and not ff_ok:
+                entry["advisory"] = (
+                    "over bound on a CPU-only/smoke host (advisory; the "
+                    "cpu backend's step is host CPU too, so the ratio "
+                    "measures dispatch noise — the bound gates "
+                    "accelerator-fingerprinted runs)")
+            checks["feeder_fleet"] = entry
     # Spread judged against the steady-state windows at every scale; the
     # BENCH_SCALE=small smoke gets the wider bound (sub-millisecond CPU
     # section timings ride scheduler noise on shared CI hosts).

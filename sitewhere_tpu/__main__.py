@@ -227,6 +227,58 @@ def _parse_peers(spec: Optional[str]) -> dict:
     return out
 
 
+def _serve_feeder(cfg) -> int:
+    """Run ONE feeder worker process (``serve --feeder``): no instance,
+    no engine — connect to the mesh host's bus edge, lease source
+    partitions, and run decode -> intern -> pack -> guard -> ship until
+    stopped. Composes with --supervise (generic argv passthrough): a
+    killed feeder restarts with a freshly minted epoch, above any floor
+    its previous incarnation was fenced at."""
+    import os
+    import socket
+
+    from sitewhere_tpu.feeders import FeederWorker
+    from sitewhere_tpu.runtime.recovery import mint_epoch
+
+    connect = cfg.get("feeders.connect")
+    if not connect:
+        print("serve --feeder requires --feeder-connect host:port "
+              "(the mesh host's bus edge)", file=sys.stderr)
+        return 2
+    host, _, port = str(connect).rpartition(":")
+    name = cfg.get("feeders.name") or f"{socket.gethostname()}:{os.getpid()}"
+    spec = cfg.get("feeders.partitions")
+    partitions = None
+    if spec not in (None, ""):
+        partitions = [int(p) for p in str(spec).split(",") if p.strip()]
+    epoch = mint_epoch(cfg.get("persist.data_dir"))
+    stop = _install_stop_handlers()
+    worker = FeederWorker(
+        host or "127.0.0.1", int(port), name, epoch=epoch,
+        partitions=partitions,
+        poll_max_records=int(cfg.get("feeders.poll_max_records")),
+        shed_backoff_s=float(cfg.get("feeders.shed_backoff_s")),
+        hard_exit=True)
+    hello = worker.connect()
+    worker.acquire_leases()
+    print(f"sitewhere-tpu feeder '{name}' serving", flush=True)
+    print(f"  mesh host  : tcp://{connect}", flush=True)
+    print(f"  topic      : {hello['topic']} "
+          f"({hello['partitions']} partitions)", flush=True)
+    print(f"  epoch      : {epoch}", flush=True)
+    print(f"  partitions : {sorted(worker.owned) or '(contending)'}",
+          flush=True)
+    try:
+        while not stop.is_set():
+            if worker.run_once() == 0 and not worker.owned:
+                # nothing leased yet (another worker holds everything):
+                # retry acquisition on a lazy cadence instead of spinning
+                stop.wait(0.5)
+    finally:
+        worker.stop()
+    return 0
+
+
 def cmd_serve(args) -> int:
     from sitewhere_tpu.runtime.busnet import BusServer
     from sitewhere_tpu.web.server import RestServer
@@ -245,6 +297,16 @@ def cmd_serve(args) -> int:
         cfg.set("pipeline.enabled", False)
     if args.bus_port is not None:
         cfg.set("bus.edge_port", args.bus_port)
+    for flag, key in (("feeder_connect", "feeders.connect"),
+                      ("feeder_name", "feeders.name"),
+                      ("feeder_partitions", "feeders.partitions")):
+        value = getattr(args, flag, None)
+        if value is not None:
+            cfg.set(key, value)
+    if getattr(args, "feeder", False):
+        return _serve_feeder(cfg)
+    if getattr(args, "feeders", False):
+        cfg.set("feeders.enabled", True)
     for flag, key in (("cluster_coordinator", "cluster.coordinator"),
                       ("cluster_num_processes", "cluster.num_processes"),
                       ("cluster_process_id", "cluster.process_id"),
@@ -289,6 +351,21 @@ def cmd_serve(args) -> int:
         bus_server = BusServer(instance.bus, host=cfg.get("api.host"),
                                port=int(edge_port))
         bus_server.start()
+    feeder_service = None
+    if (cfg.get("feeders.enabled") and bus_server is not None
+            and instance.pipeline_engine is not None):
+        # mount the feeder fleet's landing zone on the bus edge: remote
+        # workers lease partitions of the frames topic and this host's
+        # per-step work on their blobs shrinks to H2D + step
+        from sitewhere_tpu.feeders import FeederService
+        from sitewhere_tpu.sources.manager import GLOBAL_ADMISSION
+        feeder_service = FeederService(
+            instance.pipeline_engine, bus_server,
+            frames_topic=(cfg.get("feeders.frames_topic")
+                          or instance.naming.feeder_frames()),
+            lease_ttl_s=float(cfg.get("feeders.lease_ttl_s")),
+            tenant=cfg.get("instance.default_tenant") or "default",
+            admission=GLOBAL_ADMISSION)
 
     print(f"sitewhere-tpu instance '{instance.instance_id}' serving",
           flush=True)
@@ -297,6 +374,9 @@ def cmd_serve(args) -> int:
     if bus_server is not None:
         print(f"  bus edge     : tcp://{cfg.get('api.host')}:"
               f"{bus_server.port}", flush=True)
+    if feeder_service is not None:
+        print(f"  feeder fleet : topic {feeder_service.frames_topic} "
+              f"(lease ttl {feeder_service.lease_ttl_s:g}s)", flush=True)
 
     try:
         while not stop.wait(1.0):
@@ -563,6 +643,22 @@ def main(argv=None) -> int:
                        help="control plane only (no device engine)")
     serve.add_argument("--bus-port", type=int,
                        help="expose the event bus on TCP for edge processes")
+    serve.add_argument("--feeders", action="store_true",
+                       help="mesh host: mount the feeder-fleet landing "
+                            "zone on the bus edge (feeders.enabled; "
+                            "requires --bus-port)")
+    serve.add_argument("--feeder", action="store_true",
+                       help="run as a FEEDER WORKER process instead of "
+                            "an instance: lease source partitions on the "
+                            "mesh host named by --feeder-connect and "
+                            "ship packed wire blobs (docs/FEEDERS.md)")
+    serve.add_argument("--feeder-connect",
+                       help="feeder mode: mesh host bus edge host:port")
+    serve.add_argument("--feeder-name",
+                       help="feeder lease identity (default host:pid)")
+    serve.add_argument("--feeder-partitions",
+                       help="feeder mode: csv partition pin, e.g. '0,1' "
+                            "(default: contend for every partition)")
     serve.add_argument("--cluster-coordinator",
                        help="jax.distributed coordinator host:port — "
                             "enables multi-host cluster mode")

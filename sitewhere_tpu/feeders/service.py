@@ -1,0 +1,322 @@
+"""Mesh-host blob-ingest endpoint: the feeder fleet's landing zone.
+
+Mounts the ``feeder_*`` op family on the serve process's BusServer
+(runtime/busnet.py ``register_op``). With feeders attached, the mesh
+host's per-step work on this path is H2D-into-StagingRing + dispatch —
+no decode, no interning, no pack, no route guard; the flight records it
+opens carry only ``h2d``/``dispatch`` (and ``stage_wait``/``guard``
+backpressure) segments, which is exactly what the bench's
+``mesh_host_cpu_per_step`` attribution checks.
+
+Exactly-once across takeover: every blob names the [start, end)
+partition-offset extent it covers. The service keeps a per-partition
+watermark (max applied end offset) that OUTLIVES any feeder; a blob
+fully at-or-under the watermark is a replay — dropped, counted
+(`feeder.replay_dropped`), its rows handed to the armed ReplayBarrier
+as suppressed effects. Feeders commit offsets only after the ack, so
+extents are blob-aligned: a replayed extent is either fully duplicate
+or fully new.
+
+Zombie fencing: blob requests are stamped ``fence=feeder:p<N>`` and
+epoch-checked by busnet dispatch BEFORE this service sees them; a
+takeover raises the partition's floor so the dead feeder's in-flight
+blobs bounce with ``stale_epoch`` instead of landing twice.
+
+Admission: the shed decision propagates to the SOURCE — a shedding
+AdmissionController turns the blob ack into a structured 429 the
+feeder's receiver counts and backs off on, instead of the blob landing
+first and shedding after the transfer was already paid.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+import numpy as np
+
+from sitewhere_tpu.feeders import protocol
+from sitewhere_tpu.ops.pack import _VALID_SHIFT, blob_to_batch_np
+from sitewhere_tpu.runtime.eventage import (age_histogram, observe_summary,
+                                            sidecar_from_wire)
+from sitewhere_tpu.runtime.metrics import GLOBAL_METRICS
+from sitewhere_tpu.runtime.recovery import GLOBAL_REPLAY_BARRIER, LeaseTable
+
+# age-waterfall edge recorded when a feeder blob crosses onto the mesh
+# host (cumulative age at the handoff; per-hop = difference against the
+# downstream persist/alert edges)
+FEEDER_EDGE = "feeder_to_mesh"
+
+
+class FeederService:
+    """Serve-process side of the feeder fleet: lease authority, interner
+    journal authority, and the blob-ingest endpoint feeding the engine's
+    staging ring directly."""
+
+    def __init__(self, engine, server, frames_topic: str,
+                 lease_ttl_s: float = 5.0, tenant: str = "default",
+                 admission=None, metrics=GLOBAL_METRICS,
+                 replay_barrier=GLOBAL_REPLAY_BARRIER,
+                 on_outputs: Optional[Callable] = None,
+                 submitter=None):
+        self.engine = engine
+        self.server = server
+        # optional pipeline/feed.py PipelinedSubmitter: blobs from
+        # concurrent feeders then stage (H2D) in parallel on its stager
+        # threads while the step thread dispatches in order — the ack
+        # still waits for dispatch so the watermark never outruns a step
+        self.submitter = submitter
+        self.frames_topic = frames_topic
+        self.lease_ttl_s = float(lease_ttl_s)
+        self.tenant = tenant
+        self.admission = admission
+        self.replay_barrier = replay_barrier
+        self.on_outputs = on_outputs
+        self.leases = LeaseTable(metrics=metrics)
+        self._metrics = metrics
+        self._blob_counter = metrics.counter("feeder.blobs")
+        self._events_meter = metrics.meter("feeder.events")
+        self._shed_counter = metrics.counter("feeder.shed")
+        self._replay_counter = metrics.counter("feeder.replay_dropped")
+        self._spill_counter = metrics.counter("feeder.guard_spills")
+        self._takeover_counter = metrics.counter("takeover.count")
+        self._age_hist = age_histogram(metrics)
+        # per-partition exclusive end offset of applied extents — the
+        # exactly-once watermark; survives any feeder's death
+        self._watermarks: dict = {}
+        # blob staging order + the engine step are serialized: the step
+        # is not concurrent-safe, and a single arrival order keeps the
+        # staging ring's ordered grant meaningful across feeders
+        self._step_lock = threading.Lock()
+        self._order = 0
+        # receiver-side accounting (read by bench/perf_gate): wall spent
+        # handling blobs, the engine-step part of it, and actual thread
+        # CPU (thread_time stops during lock waits and device blocks) —
+        # handoff overhead per blob = (handle - step) / blobs
+        self.blob_handle_s = 0.0
+        self.blob_step_s = 0.0
+        self.blob_cpu_s = 0.0
+        from sitewhere_tpu.parallel.engine import ShardedPipelineEngine
+        self._sharded = isinstance(engine, ShardedPipelineEngine)
+        for op, fn in ((protocol.OP_HELLO, self._op_hello),
+                       (protocol.OP_LEASE, self._op_lease),
+                       (protocol.OP_JOURNAL, self._op_journal),
+                       (protocol.OP_INTERN, self._op_intern),
+                       (protocol.OP_BLOB, self._op_blob)):
+            server.register_op(op, fn)
+
+    # -- op: hello ----------------------------------------------------------
+
+    def _op_hello(self, req: dict) -> dict:
+        """The packing contract a feeder needs for a bit-identical remote
+        pack, plus fleet wiring (topic, group, lease TTL)."""
+        engine = self.engine
+        packer = engine.packer
+        n_parts = len(self.server.bus.topic(self.frames_topic).partitions)
+        resp = {
+            "ok": True,
+            "engine": "sharded" if self._sharded else "single",
+            "batch_size": packer.batch_size,
+            "epoch_base_ms": packer.epoch_base_ms,
+            "dev_capacity": packer.devices.capacity,
+            "dev_shard_classes": packer.devices.shard_classes,
+            "mm_capacity": packer.measurements.capacity,
+            "at_capacity": packer.alert_types.capacity,
+            "topic": self.frames_topic,
+            "group": protocol.FEEDER_GROUP,
+            "partitions": n_parts,
+            "lease_ttl_s": self.lease_ttl_s,
+            "shedding": bool(self.admission is not None
+                             and getattr(self.admission, "_shedding",
+                                         False)),
+        }
+        if self._sharded:
+            resp.update(
+                n_shards=engine.n_shards,
+                per_shard_batch=engine.batch_size,
+                device_routing=bool(engine.device_routing),
+                route_lane_capacity=int(engine.route_lane_capacity),
+                fixed_wire_rows=int(getattr(engine.router,
+                                            "fixed_wire_rows", 0) or 0))
+        return resp
+
+    # -- op: lease ----------------------------------------------------------
+
+    def _op_lease(self, req: dict) -> dict:
+        action = req.get("action")
+        partition = int(req["partition"])
+        owner = str(req["owner"])
+        epoch = int(req.get("epoch", 0))
+        resource = protocol.partition_resource(partition)
+        if action == "acquire":
+            previous = self.leases.holder(resource)
+            ttl = float(req.get("ttl_s", self.lease_ttl_s))
+            granted = self.leases.acquire(resource, owner, epoch, ttl)
+            if granted and previous is not None and previous != owner:
+                # a live lease changed hands — only possible via the
+                # strictly-higher-epoch steal: this is a takeover
+                self._takeover_counter.inc()
+            if granted:
+                # the new owner's epoch fences the old one: raise the
+                # partition floor so the previous incarnation's in-flight
+                # blobs are rejected (same decision as the steal)
+                self.server.fence.fence(
+                    protocol.feeder_fence_key(partition), epoch)
+            return {"ok": True, "granted": bool(granted),
+                    "ttl_s": ttl, "holder": self.leases.holder(resource),
+                    "took_over": bool(granted and previous is not None
+                                      and previous != owner)}
+        if action == "renew":
+            renewed = self.leases.renew(resource, owner, epoch)
+            return {"ok": True, "renewed": bool(renewed)}
+        if action == "release":
+            return {"ok": True,
+                    "released": bool(self.leases.release(resource, owner))}
+        return {"ok": False, "error": f"unknown lease action {action!r}"}
+
+    # -- ops: interner journal ----------------------------------------------
+
+    def _journal_interner(self, name: str):
+        packer = self.engine.packer
+        table = {"devices": packer.devices,
+                 "measurements": packer.measurements,
+                 "alert_types": packer.alert_types}
+        return table.get(name)
+
+    def _op_journal(self, req: dict) -> dict:
+        interner = self._journal_interner(str(req.get("interner")))
+        if interner is None:
+            return {"ok": False,
+                    "error": f"unknown interner {req.get('interner')!r}"}
+        since = int(req.get("since", 0))
+        epoch, entries = interner.journal_since(since)
+        return {"ok": True, "journal_epoch": epoch, "base": since,
+                "entries": [[i, t] for i, t in entries]}
+
+    def _op_intern(self, req: dict) -> dict:
+        """Authoritative allocation for NEW meta tokens a feeder saw
+        mid-stream. Devices are refused — ingest never allocates device
+        tokens on either side (unknown must stay 0)."""
+        name = str(req.get("interner"))
+        if name == "devices":
+            return {"ok": False,
+                    "error": "devices are lookup-only for ingest"}
+        interner = self._journal_interner(name)
+        if interner is None:
+            return {"ok": False, "error": f"unknown interner {name!r}"}
+        since = int(req.get("since", 0))
+        for token in req.get("tokens", []):
+            interner.intern(str(token))
+        epoch, entries = interner.journal_since(since)
+        return {"ok": True, "journal_epoch": epoch, "base": since,
+                "entries": [[i, t] for i, t in entries]}
+
+    # -- op: blob -----------------------------------------------------------
+
+    def _op_blob(self, req: dict) -> dict:
+        # 1. front-door shedding FIRST: the whole point of propagating
+        # the decision is that an overloaded mesh host refuses before
+        # doing any work with the payload
+        admit = getattr(self.admission, "admit_remote", None) \
+            or getattr(self.admission, "admit", None)
+        if admit is not None and not admit():
+            self._shed_counter.inc()
+            # transport-level ok (the socket and request were fine), app-
+            # level structured 429: the feeder's receiver branches on
+            # `shed`, backs off, and does NOT commit the extent
+            return {"ok": True, "shed": True, "http_status": 429,
+                    "events": 0}
+        partition = int(req["partition"])
+        start, end = (int(x) for x in req["extent"])
+        n_events = int(req["n_events"])
+        # 2. exactly-once replay watermark: feeders commit only after the
+        # ack, so a takeover replay re-ships whole already-applied
+        # extents — fully at-or-under the watermark, never partial
+        wm = self._watermarks.get(partition, -1)
+        if end <= wm:
+            self._replay_counter.inc()
+            suppressed = self.replay_barrier.take(self.tenant, n_events) \
+                if self.replay_barrier is not None else 0
+            return {"ok": True, "dup": True, "events": 0,
+                    "suppressed": int(suppressed or n_events)}
+        t0 = time.perf_counter()
+        c0 = time.thread_time()
+        blob = protocol.decode_blob(req)
+        age = sidecar_from_wire(req.get("age") or [])
+        # cumulative age at the feeder->mesh handoff (per-hop p50/p99 =
+        # this edge minus the feeder's ingest edge downstream dashboards
+        # already chart)
+        observe_summary(self._age_hist, age.close(),
+                        engine=self.engine.name, edge=FEEDER_EDGE)
+        with self._step_lock:
+            order = self._order
+            self._order += 1
+            s0 = time.perf_counter()
+            if self._sharded:
+                events = self._step_sharded(blob, req, age, order)
+            else:
+                events = self._step_single(blob, n_events, age, order)
+            s1 = time.perf_counter()
+            if req.get("advance", True):
+                self._watermarks[partition] = max(wm, end)
+            self.blob_step_s += s1 - s0
+            self.blob_handle_s += s1 - t0
+            self.blob_cpu_s += time.thread_time() - c0
+        self._blob_counter.inc()
+        self._events_meter.mark(events)
+        return {"ok": True, "events": int(events), "seq": int(req["seq"])}
+
+    def _step_single(self, blob: np.ndarray, n_events: int, age,
+                     order: int) -> int:
+        engine = self.engine
+        if self.submitter is not None:
+            fut = self.submitter.submit_blob(
+                np.ascontiguousarray(blob), n_events, age=age)
+            fut.result(timeout=120.0)
+            return n_events
+        rec = engine.flight.begin_step(engine=engine.name)
+        rec.age = age
+        staged = engine.stage_blob(np.ascontiguousarray(blob),
+                                   flight_rec=rec, order=order)
+        outputs = engine.submit_blob(staged, n_events=n_events,
+                                     flight_rec=rec)
+        if self.on_outputs is not None:
+            self.on_outputs(engine, outputs, rec)
+        return n_events
+
+    def _step_sharded(self, blob: np.ndarray, req: dict, age,
+                      order: int) -> int:
+        """Sharded landing: the feeder's guard verdict picks the path.
+        Fits -> the blob IS the device-routing flat layout; stage it
+        through the ring and dispatch (zero per-event host work). Doesn't
+        fit (skew past lane capacity) -> the loudly-counted spill: unpack
+        to columns and take the host-arena route via submit()."""
+        from sitewhere_tpu.parallel.engine import _PreparedStep
+
+        engine = self.engine
+        fits = bool(req.get("fits_device_route", True)) \
+            and engine.device_routing
+        if not fits:
+            self._spill_counter.inc()
+            batch = blob_to_batch_np(np.ascontiguousarray(blob))
+            valid = np.asarray(batch.valid)
+            n = int(valid.sum())
+            engine.submit(batch, age=age)
+            return n
+        params = engine._ensure_params()
+        rec = engine.flight.begin_step(engine=engine.name)
+        rec.age = age
+        prepared = _PreparedStep("device", np.ascontiguousarray(blob),
+                                 flight=rec)
+        staged = engine.stage_prepared(prepared, order=order)
+        view, outputs = engine.dispatch_staged(params, staged)
+        if self.on_outputs is not None:
+            self.on_outputs(engine, outputs, rec)
+        n = int(((blob[0, :] >> _VALID_SHIFT) & 1).sum())
+        return n
+
+    # -- introspection ------------------------------------------------------
+
+    def watermark(self, partition: int) -> int:
+        return int(self._watermarks.get(int(partition), -1))

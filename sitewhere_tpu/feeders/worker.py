@@ -1,0 +1,379 @@
+"""Feeder worker: lease-owned partitions -> ready-to-stage wire blobs.
+
+One worker (thread in-proc, process under ``serve --feeder``) owns a set
+of TTL-leased source partitions and runs the whole per-event pipeline
+locally — decode, interner-replica token resolution, pack, host-route
+guard — then ships each blob to the mesh host's ``feeder_blob`` endpoint
+and commits the covered offsets ONLY after the ack. The commit-after-ack
+order is the exactly-once half the mesh-side watermark needs: a worker
+that dies mid-blob leaves its offsets uncommitted, the successor (fenced
+at a strictly higher epoch) replays the extent, and the watermark drops
+what already stepped.
+
+Blob grouping is record-ALIGNED (protocol.count_hot_events header walk):
+an offset commit can never split a bus record, so replayed extents are
+whole blobs. The `feeder_process_death` fault point fires mid-blob —
+between ship and commit — and kills the worker the hard way (os._exit
+under ``serve --feeder``; an abandoned thread in the in-proc drill), the
+exact window where exactly-once is hardest.
+
+A structured 429 from the mesh host (AdmissionController shed propagated
+over busnet) is counted at THIS receiver (`feeder.shed_received`) and
+backs the partition off without committing — the events redeliver when
+admission reopens, instead of being dropped after the transfer was paid.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from sitewhere_tpu.feeders import protocol
+from sitewhere_tpu.feeders.replica import ReplicaPacker
+from sitewhere_tpu.ops.pack import batch_to_blob, wire_variant_for
+from sitewhere_tpu.runtime.busnet import BusClient, StaleEpochBusError
+from sitewhere_tpu.runtime.eventage import AgeSidecar
+from sitewhere_tpu.runtime.faults import FaultError, fault_point
+from sitewhere_tpu.runtime.metrics import GLOBAL_METRICS
+
+
+class FeederWorker:
+    """One feeder: hello -> lease -> (sync, poll, pack, ship, commit)*.
+
+    ``epoch`` is the worker's fencing epoch (runtime/recovery.py
+    mint_epoch in ``serve --feeder``; explicit in drills). A successor
+    taking over a dead worker's partitions MUST run at a strictly higher
+    epoch — the lease steal and the fence raise are one decision
+    (feeders/service.py)."""
+
+    def __init__(self, host: str, port: int, name: str, epoch: int,
+                 partitions: Optional[Sequence[int]] = None,
+                 poll_max_records: int = 4096,
+                 poll_timeout_s: float = 0.25,
+                 shed_backoff_s: float = 0.25,
+                 hard_exit: bool = False,
+                 metrics=GLOBAL_METRICS):
+        self.name = str(name)
+        self.epoch = int(epoch)
+        self.client = BusClient(host, port)
+        self.configured_partitions = (list(partitions)
+                                      if partitions is not None else None)
+        self.poll_max_records = int(poll_max_records)
+        self.poll_timeout_s = float(poll_timeout_s)
+        self.shed_backoff_s = float(shed_backoff_s)
+        # serve --feeder: an injected process death must not unwind
+        # through handlers that could commit — leave no trace, like
+        # SIGKILL would
+        self.hard_exit = bool(hard_exit)
+        self._metrics = metrics
+        self._blob_counter = metrics.counter("feeder.blobs_shipped")
+        self._shed_counter = metrics.counter("feeder.shed_received")
+        self._fenced_counter = metrics.counter("feeder.fenced")
+        self._takeover_counter = metrics.counter("feeder.takeovers")
+        self.hello: Optional[dict] = None
+        self.replica: Optional[ReplicaPacker] = None
+        self.owned: Dict[int, float] = {}   # partition -> last renew ts
+        self.seq = 0
+        self.events_shipped = 0
+        self.blobs_shipped = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._dead = False
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def connect(self) -> dict:
+        """Hello handshake + replica bootstrap (idempotent)."""
+        if self.hello is None:
+            self.hello = self.client.call(protocol.OP_HELLO)
+            self.replica = ReplicaPacker(self.hello, self.client,
+                                         metrics=self._metrics)
+            self.replica.sync()
+        return self.hello
+
+    def acquire_leases(self) -> List[int]:
+        """Try to lease every configured partition (all partitions when
+        none were configured). Grants out of another owner's lapsed or
+        fenced lease count as takeovers."""
+        hello = self.connect()
+        wanted = (self.configured_partitions
+                  if self.configured_partitions is not None
+                  else list(range(int(hello["partitions"]))))
+        now = time.monotonic()
+        fresh: List[int] = []
+        for p in wanted:
+            if p in self.owned:
+                continue
+            resp = self.client.call(
+                protocol.OP_LEASE,
+                **protocol.lease_request("acquire", p, self.name,
+                                         self.epoch,
+                                         hello["lease_ttl_s"]))
+            if resp.get("granted"):
+                self.owned[p] = now
+                fresh.append(p)
+                if resp.get("took_over"):
+                    self._takeover_counter.inc()
+        if fresh:
+            # a takeover inherits its predecessor's polled-but-uncommitted
+            # tail: rewind exactly the granted partitions to their last
+            # COMMITTED offsets so those records redeliver (the mesh
+            # watermark drops whatever was already applied)
+            self.client.seek_committed(hello["topic"], hello["group"],
+                                       partitions=fresh)
+        return sorted(self.owned)
+
+    def release_leases(self) -> None:
+        for p in list(self.owned):
+            try:
+                self.client.call(
+                    protocol.OP_LEASE,
+                    **protocol.lease_request("release", p, self.name,
+                                             self.epoch))
+            except Exception:
+                pass
+            self.owned.pop(p, None)
+
+    def _renew_leases(self) -> None:
+        hello = self.hello or {}
+        ttl = float(hello.get("lease_ttl_s", 5.0))
+        now = time.monotonic()
+        for p, last in list(self.owned.items()):
+            if now - last < ttl / 3.0:
+                continue
+            resp = self.client.call(
+                protocol.OP_LEASE,
+                **protocol.lease_request("renew", p, self.name, self.epoch))
+            if resp.get("renewed"):
+                self.owned[p] = now
+            else:
+                # lost the lease (lapsed + stolen): the partition is no
+                # longer ours — its uncommitted tail replays on the owner
+                self.owned.pop(p, None)
+
+    # -- one ship cycle -----------------------------------------------------
+
+    def run_once(self, timeout_s: Optional[float] = None) -> int:
+        """One sync -> poll -> pack -> ship -> commit cycle over the
+        owned partitions. Returns events shipped (0 on an idle poll)."""
+        if self._dead:
+            return 0
+        self.connect()
+        if not self.owned:
+            self.acquire_leases()
+            if not self.owned:
+                return 0
+        self._renew_leases()
+        if not self.owned:
+            return 0
+        self.replica.sync()
+        parts = sorted(self.owned)
+        records = self.client.poll(
+            self.hello["topic"], self.hello["group"],
+            max_records=self.poll_max_records,
+            timeout_s=self.poll_timeout_s if timeout_s is None
+            else timeout_s,
+            partitions=parts)
+        if not records:
+            return 0
+        shipped = 0
+        by_part: Dict[int, List] = {}
+        for rec in records:
+            by_part.setdefault(rec.partition, []).append(rec)
+        for p, recs in sorted(by_part.items()):
+            if self._dead:
+                break
+            if p not in self.owned:
+                continue
+            shipped += self._ship_partition(p, recs)
+        return shipped
+
+    def _ship_partition(self, partition: int, records: List) -> int:
+        """Pack one partition's polled records into record-aligned blobs
+        and ship them; commit after the last ack. Any failure before the
+        commit leaves the extent uncommitted — at-least-once upstream,
+        deduplicated downstream by the mesh watermark."""
+        B = int(self.hello["batch_size"])
+        # record-aligned groups: greedily accumulate whole records up to
+        # the batch width so an offset commit never splits a record
+        groups: List[List] = []
+        group: List = []
+        group_events = 0
+        for rec in records:
+            n = protocol.count_hot_events(rec.value)
+            if group and group_events + n > B:
+                groups.append(group)
+                group, group_events = [], 0
+            group.append(rec)
+            group_events += n
+        if group:
+            groups.append(group)
+        shipped = 0
+        committed_through: Optional[int] = None
+        stopped_early = False
+        for group in groups:
+            age = AgeSidecar()
+            data = b"".join(rec.value for rec in group)
+            batches, n_events, _rest = self.replica.pack_bytes(data)
+            age.add(None, n_events)
+            extent = (group[0].offset, group[-1].offset + 1)
+            ok = self._ship_blobs(partition, batches, n_events, extent,
+                                  age)
+            if self._dead:
+                # injected death: commit NOTHING — acked-but-uncommitted
+                # extents must replay through the successor, exactly like
+                # a SIGKILL before the commit_at went out
+                return shipped
+            if not ok:
+                stopped_early = True
+                break  # shed/fenced: do not commit past this point
+            shipped += n_events
+            committed_through = extent[1]
+        if committed_through is not None:
+            self.client.commit_at(
+                self.hello["topic"], self.hello["group"],
+                {partition: committed_through}, partitions=[partition])
+        if stopped_early:
+            # polled-but-unshipped records (the shed/fenced group and
+            # everything after it) advanced the server-side cursor without
+            # a commit: rewind this partition so they redeliver — to us on
+            # the next poll, or to the successor after a fencing
+            self.client.seek_committed(self.hello["topic"],
+                                       self.hello["group"],
+                                       partitions=[partition])
+        return shipped
+
+    def _ship_blobs(self, partition: int, batches, n_events: int,
+                    extent, age: AgeSidecar) -> bool:
+        """Pack each batch into its wire blob and ship. A single record
+        group normally yields one batch; an oversized record chunks into
+        several — only the last advances the mesh watermark (see
+        protocol.blob_message)."""
+        sharded = self.hello.get("engine") == "sharded"
+        for i, batch in enumerate(batches):
+            final = i == len(batches) - 1
+            blob, fits = self._pack_blob(batch, sharded)
+            n = int(np.asarray(batch.valid).sum())
+            self.seq += 1
+            try:
+                resp = self.client.call(protocol.OP_BLOB, **protocol.blob_message(
+                    blob, n_events=n, partition=partition, seq=self.seq,
+                    extent=extent, epoch=self.epoch,
+                    fits_device_route=fits, age=age, advance=final))
+            except StaleEpochBusError:
+                # fenced: a successor took this partition over — drop the
+                # lease and never commit (our rows land via its replay)
+                self._fenced_counter.inc()
+                self.owned.pop(partition, None)
+                return False
+            if resp.get("shed"):
+                # the propagated AdmissionController 429: counted here at
+                # the receiver, partition backs off uncommitted
+                self._shed_counter.inc()
+                time.sleep(self.shed_backoff_s)
+                return False
+            # the kill drill's window: the blob is ACKED (applied on the
+            # mesh host) but the offsets behind it are not yet committed —
+            # the successor replays this extent and exactly-once must
+            # come from the watermark, not from us
+            try:
+                fault_point("feeder_process_death")
+            except FaultError:
+                self._die()
+                return False
+            self._blob_counter.inc()
+            self.blobs_shipped += 1
+            self.events_shipped += n
+        return True
+
+    def _pack_blob(self, batch, sharded: bool):
+        """Batch -> the exact wire layout the engine would have packed
+        inline, plus the host-route guard verdict (sharded only)."""
+        if not sharded:
+            return batch_to_blob(batch), True
+        S = int(self.hello["n_shards"])
+        per_shard = int(self.hello["per_shard_batch"])
+        G = S * per_shard
+        fits = True
+        if self.hello.get("device_routing"):
+            from sitewhere_tpu.ops.route import host_fits_device_route
+
+            valid = np.asarray(batch.valid)
+            fits = bool(host_fits_device_route(
+                np.asarray(batch.device_idx), valid, S, per_shard,
+                int(self.hello["route_lane_capacity"])))
+        rows, ts_base = wire_variant_for(batch)
+        rows, ts_base = _routable_variant(rows, ts_base, per_shard)
+        fixed = int(self.hello.get("fixed_wire_rows") or 0)
+        if fixed:
+            rows = fixed
+        small = batch_to_blob(batch, wire_rows=rows)
+        n = batch.device_idx.shape[0]
+        if n == G:
+            return small, fits
+        buf = np.zeros((small.shape[0], G), np.int32)
+        buf[:, :n] = small
+        return buf, fits
+
+    def _die(self) -> None:
+        """The injected process death: no commits, no lease release, no
+        cleanup — indistinguishable from SIGKILL to everyone else."""
+        self._dead = True
+        self._stop.set()
+        if self.hard_exit:
+            os._exit(9)
+
+    @property
+    def dead(self) -> bool:
+        return self._dead
+
+    # -- background thread --------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run,
+                                        name=f"feeder-{self.name}",
+                                        daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                if self.run_once() == 0:
+                    # idle poll already long-polled server-side
+                    continue
+            except FaultError:
+                self._die()
+                return
+            except Exception:
+                if self._stop.is_set() or self._dead:
+                    return
+                time.sleep(0.2)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None
+        if not self._dead:
+            self.release_leases()
+        self.client.close()
+
+
+def _routable_variant(rows: int, ts_base: int, per_shard_batch: int):
+    """Mirror of ShardRouter._routable_variant for the remote pack: the
+    packed 3-row layout embeds its ts base across 11 lanes of row 0 —
+    per-shard widths below that cannot carry it after the on-device
+    route, so downgrade to compact exactly like the inline path."""
+    from sitewhere_tpu.ops.pack import (_BASE_LANES, WIRE_ROWS_COMPACT,
+                                        WIRE_ROWS_PACKED)
+
+    if rows == WIRE_ROWS_PACKED and per_shard_batch < _BASE_LANES:
+        return WIRE_ROWS_COMPACT, 0
+    return rows, ts_base

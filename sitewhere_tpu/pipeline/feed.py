@@ -79,6 +79,18 @@ class StepFuture:
         self._event.set()
 
 
+class _PrePackedBlob:
+    """A host wire blob that arrived already packed (a feeder's remote
+    pack, feeders/service.py): the stager skips the pack stage and goes
+    straight to the staging-ring grant + H2D."""
+
+    __slots__ = ("blob", "n_events")
+
+    def __init__(self, blob: np.ndarray, n_events: int):
+        self.blob = blob
+        self.n_events = int(n_events)
+
+
 class PipelinedSubmitter:
     """Stage-ahead feeder for a PipelineEngine.
 
@@ -143,6 +155,15 @@ class PipelinedSubmitter:
             self._next_seq += 1
             return seq
 
+    def submit_blob(self, blob: np.ndarray, n_events: int,
+                    age=None) -> StepFuture:
+        """Enqueue a PRE-PACKED host wire blob (a feeder's remote pack,
+        feeders/service.py): same ordered stage->dispatch path as
+        submit(), minus the pack stage — interleaves correctly with
+        concurrent submit() calls because both draw from the one
+        sequence counter."""
+        return self.submit(_PrePackedBlob(blob, n_events), age=age)
+
     # -- stager ------------------------------------------------------------
     def _stage_loop(self) -> None:
         while not self._stop.is_set():
@@ -178,11 +199,19 @@ class PipelinedSubmitter:
                     # the ingest-age sidecar crosses threads on the record
                     # itself, exactly like the stage timeline
                     rec.age = age
-                buf = self.engine._staging_blob_buffer(batch, flight_rec=rec)
-                rec.begin_stage("pack")
-                blob = batch_to_blob(batch, out=buf)
-                rec.end_stage("pack")
-                n = int(np.asarray(batch.valid).sum())
+                if isinstance(batch, _PrePackedBlob):
+                    # a feeder's remote pack: no pack stage on this host —
+                    # the blob goes straight to the ring grant + H2D, the
+                    # whole point of the disaggregated fleet
+                    blob = np.ascontiguousarray(batch.blob)
+                    n = batch.n_events
+                else:
+                    buf = self.engine._staging_blob_buffer(batch,
+                                                           flight_rec=rec)
+                    rec.begin_stage("pack")
+                    blob = batch_to_blob(batch, out=buf)
+                    rec.end_stage("pack")
+                    n = int(np.asarray(batch.valid).sum())
                 # acquire an on-device staging-ring slot (granted in seq
                 # order; backpressure when all h2d_buffer_depth transfers
                 # are in flight) and start the H2D transfer — on async

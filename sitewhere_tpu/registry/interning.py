@@ -66,6 +66,16 @@ class TokenInterner:
         # a valid cache key for snapshot consumers — a checkpoint restore
         # can swap same-length contents.
         self.version = 0
+        # Append-only assignment journal for replica feeders (feeders/
+        # replica.py): one (idx, token) entry per slot ASSIGNMENT — gap
+        # slots journal as (idx, None), a later in-place gap fill journals
+        # the same idx again with its token. Replaying the journal from 0
+        # reproduces _to_token exactly, so a remote replica that applies
+        # deltas in order packs bit-identical indices. restore() swaps
+        # table contents wholesale — the journal is rebuilt and
+        # journal_epoch bumped so replicas discard and resync from 0.
+        self._journal: List[tuple] = []
+        self.journal_epoch = 0
         # cached dense index -> token array (token_array); rebuilt lazily
         # when version moves — hot-path materialization fancy-indexes it
         # instead of calling token_of per row
@@ -129,6 +139,7 @@ class TokenInterner:
             while len(self._to_token) < idx:
                 gap = len(self._to_token)
                 self._to_token.append(None)
+                self._journal.append((gap, None))
                 if self._nat is not None:
                     # gap slots never enter the native hash: unfindable by
                     # construction, no byte pattern is reserved
@@ -139,6 +150,7 @@ class TokenInterner:
                 nidx = self._nat.add(token)
                 if nidx != idx:
                     self._mirror_sync_error(nidx, idx)
+        self._journal.append((idx, token))
         self._to_index[token] = idx
         self._class_next[cls] = idx + self.shard_classes
         self.version += 1
@@ -160,6 +172,7 @@ class TokenInterner:
                 self._raise_capacity()
             self._to_token.append(token)
             self._to_index[token] = idx
+            self._journal.append((idx, token))
             self.version += 1
             if self._nat is not None:
                 nidx = self._nat.add(token)
@@ -267,6 +280,74 @@ class TokenInterner:
             token = self._nat.token_at(idx)
             self._to_token.append(token)
             self._to_index[token] = idx
+            self._journal.append((idx, token))
+
+    # -- replica journal (feeders/replica.py) -------------------------------
+
+    def journal_len(self) -> int:
+        with self._lock:
+            return len(self._journal)
+
+    def journal_since(self, n: int) -> tuple:
+        """(journal_epoch, entries[n:]) — the delta a replica at journal
+        position ``n`` needs to catch up. A replica whose remembered
+        epoch differs must discard its table and resync from 0 (the
+        authoritative interner was checkpoint-restored)."""
+        with self._lock:
+            return self.journal_epoch, list(self._journal[n:])
+
+    def apply_delta(self, entries: Sequence[tuple], base: int) -> int:
+        """Replay journal entries [base, base+len) onto THIS interner (a
+        replica). Applies are by explicit index — append-with-gaps plus
+        in-place gap fills reproduce the authoritative table exactly, so
+        a replica's lookups return bit-identical indices. Raises on a
+        positional mismatch or slot conflict (the replica must resync).
+        Returns the new journal length."""
+        from sitewhere_tpu.errors import ErrorCode, SiteWhereError
+        with self._lock:
+            if base != len(self._journal):
+                raise SiteWhereError(
+                    f"interner '{self.name}' delta base {base} != replica "
+                    f"journal {len(self._journal)} (resync required)",
+                    ErrorCode.GENERIC)
+            mutated = False
+            for idx, token in entries:
+                idx = int(idx)
+                if idx >= self.capacity:
+                    self._raise_capacity()
+                while len(self._to_token) <= idx:
+                    self._to_token.append(None)
+                    if self._nat is not None:
+                        if self._nat.add_gap() != len(self._to_token) - 1:
+                            self._mirror_sync_error(
+                                -1, len(self._to_token) - 1)
+                cur = self._to_token[idx]
+                if token is None:
+                    if cur is not None:
+                        raise SiteWhereError(
+                            f"interner '{self.name}' delta gap at occupied "
+                            f"slot {idx} ({cur!r})", ErrorCode.GENERIC)
+                elif cur is None:
+                    self._to_token[idx] = token
+                    self._to_index[token] = idx
+                    if self._nat is not None:
+                        if self._nat.set_at(idx, token) != 0:
+                            self._mirror_sync_error(-1, idx)
+                    if self.shard_classes > 1:
+                        cls = idx % self.shard_classes
+                        self._class_next[cls] = max(
+                            self._class_next.get(cls, 0),
+                            idx + self.shard_classes)
+                    mutated = True
+                elif cur != token:
+                    raise SiteWhereError(
+                        f"interner '{self.name}' delta conflict at slot "
+                        f"{idx}: {cur!r} != {token!r} (resync required)",
+                        ErrorCode.GENERIC)
+                self._journal.append((idx, token))
+            if mutated:
+                self.version += 1
+            return len(self._journal)
 
     def snapshot(self) -> List[Optional[str]]:
         with self._lock:
@@ -301,6 +382,12 @@ class TokenInterner:
             self._to_token = incoming
             self._to_index = {t: i for i, t in enumerate(self._to_token)
                               if t is not None}
+            # the journal no longer describes the table: rebuild it as the
+            # snapshot's slot assignments and bump journal_epoch so
+            # replica feeders discard their copy and resync from 0
+            self._journal = [(i, t) for i, t in
+                             enumerate(self._to_token) if i > 0]
+            self.journal_epoch += 1
             # congruent allocator: resume each class past its restored max
             self._class_next = {}
             if self.shard_classes > 1:

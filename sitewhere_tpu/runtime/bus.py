@@ -99,6 +99,13 @@ class TopicNaming:
     def instance_logging(self) -> str:
         return self._global("instance-logging")
 
+    def feeder_frames(self) -> str:
+        """Raw hot-event wire frames awaiting a feeder's decode+pack
+        (feeders/): partition ownership follows TTL leases, not consumer
+        membership, so this stays a global topic — tenancy is resolved by
+        the engine after the blob lands."""
+        return self._global("feeder-frames")
+
     # per-tenant topics (KafkaTopicNaming.java:45-69)
     def event_source_decoded_events(self, tenant: str) -> str:
         return self._tenant(tenant, "event-source-decoded-events")

@@ -155,13 +155,15 @@ class _Handler(socketserver.BaseRequestHandler):
                                 bus, coordinator, member, req,
                                 self.server.fence,  # type: ignore[attr-defined]
                                 getattr(self.server,
-                                        "telemetry_provider", None))
+                                        "telemetry_provider", None),
+                                getattr(self.server, "op_handlers", None))
                     else:
                         resp = self._dispatch(
                             bus, coordinator, member, req,
                             self.server.fence,  # type: ignore[attr-defined]
                             getattr(self.server, "telemetry_provider",
-                                    None))
+                                    None),
+                            getattr(self.server, "op_handlers", None))
                     fault_point("busnet_delay")
                     if fault_point("busnet_drop") is not None:
                         return
@@ -182,9 +184,21 @@ class _Handler(socketserver.BaseRequestHandler):
     @staticmethod
     def _dispatch(bus: EventBus, coordinator: _GroupCoordinator,
                   member: int, req, fence: EpochFence,
-                  telemetry_provider: Optional[Callable[[], dict]] = None
-                  ) -> dict:
+                  telemetry_provider: Optional[Callable[[], dict]] = None,
+                  op_handlers: Optional[dict] = None) -> dict:
         op = req.get("op")
+
+        def _parts(topic: str, group: str):
+            # Explicit partition pinning: a leased owner (feeders/) names
+            # the partitions its lease covers instead of taking the
+            # connection-scoped group assignment — ownership then follows
+            # the LEASE (durable, fenced, stealable at epoch+1), not the
+            # TCP connection. Absent, the coordinator assignment applies.
+            pinned = req.get("partitions")
+            if pinned is not None:
+                return [int(p) for p in pinned]
+            return coordinator.owned(topic, group, member)
+
         # Epoch fencing (runtime/recovery.py): a request stamped with a
         # fencing identity is admitted only at-or-above the resource's
         # fenced floor. Floors auto-learn from admitted traffic (a
@@ -216,7 +230,7 @@ class _Handler(socketserver.BaseRequestHandler):
             return {"ok": True, "count": len(records), "last": list(last)}
         if op == "poll":
             topic, group = req["topic"], req["group"]
-            owned = coordinator.owned(topic, group, member)
+            owned = _parts(topic, group)
             consumer = bus.consumer(topic, group)
             commit_at = req.get("commit_at")
             if commit_at:
@@ -240,12 +254,12 @@ class _Handler(socketserver.BaseRequestHandler):
                 for r in batch]}
         if op == "commit":
             topic, group = req["topic"], req["group"]
-            owned = coordinator.owned(topic, group, member)
+            owned = _parts(topic, group)
             bus.commit(bus.consumer(topic, group), partitions=owned)
             return {"ok": True}
         if op == "commit_at":
             topic, group = req["topic"], req["group"]
-            owned = coordinator.owned(topic, group, member)
+            owned = _parts(topic, group)
             bus.commit_at(bus.consumer(topic, group),
                           {int(k): int(v)
                            for k, v in req.get("offsets", {}).items()},
@@ -253,7 +267,7 @@ class _Handler(socketserver.BaseRequestHandler):
             return {"ok": True}
         if op == "seek_committed":
             topic, group = req["topic"], req["group"]
-            owned = coordinator.owned(topic, group, member)
+            owned = _parts(topic, group)
             bus.consumer(topic, group).seek_to_committed(partitions=owned)
             return {"ok": True}
         if op == "end_offsets":
@@ -270,6 +284,13 @@ class _Handler(socketserver.BaseRequestHandler):
             if telemetry_provider is None:
                 return {"ok": False, "error": "no telemetry provider"}
             return {"ok": True, "telemetry": telemetry_provider()}
+        if op_handlers:
+            handler = op_handlers.get(op)
+            if handler is not None:
+                # pluggable subsystem ops (BusServer.register_op): the
+                # handler sees the raw request AFTER the fence admit above
+                # and returns the response dict (ok/error convention)
+                return handler(req)
         return {"ok": False, "error": f"unknown op {op!r}"}
 
 
@@ -326,7 +347,18 @@ class BusServer:
         self._server.coordinator = _GroupCoordinator(bus)  # type: ignore[attr-defined]
         self._server.fence = EpochFence()  # type: ignore[attr-defined]
         self._server.telemetry_provider = None  # type: ignore[attr-defined]
+        self._server.op_handlers = {}  # type: ignore[attr-defined]
         self._thread: Optional[threading.Thread] = None
+
+    def register_op(self, name: str,
+                    handler: Callable[[dict], dict]) -> None:
+        """Mount a subsystem op on this server's dispatch table (e.g. the
+        feeder fleet's `feeder_*` family, feeders/service.py). The
+        handler receives the raw request dict after epoch-fence admission
+        and returns the response dict; exceptions become `{"ok": False,
+        "error": ...}` replies on a healthy connection. Built-in ops
+        cannot be shadowed — dispatch consults the registry last."""
+        self._server.op_handlers[str(name)] = handler  # type: ignore[attr-defined]
 
     @property
     def fence(self) -> EpochFence:
@@ -477,35 +509,58 @@ class BusClient:
     def poll(self, topic: str, group: str, max_records: int = 4096,
              timeout_s: float = 0.0,
              until: Optional[dict] = None,
-             commit_at: Optional[dict] = None) -> List[Record]:
+             commit_at: Optional[dict] = None,
+             partitions: Optional[List[int]] = None) -> List[Record]:
         req = {"op": "poll", "topic": topic, "group": group,
                "max": max_records, "timeout_s": timeout_s}
         if commit_at:
             req["commit_at"] = {str(k): int(v) for k, v in commit_at.items()}
         if until is not None:
             req["until"] = {str(k): int(v) for k, v in until.items()}
-        resp = self._rpc(
-            req, pre_retry={"op": "seek_committed", "topic": topic,
-                            "group": group})
+        pre_retry = {"op": "seek_committed", "topic": topic, "group": group}
+        if partitions is not None:
+            # lease-pinned consumption (feeders/): poll exactly the named
+            # partitions regardless of the coordinator's connection-scoped
+            # assignment; the re-seek after a lost reply pins the same set
+            req["partitions"] = [int(p) for p in partitions]
+            pre_retry["partitions"] = [int(p) for p in partitions]
+        resp = self._rpc(req, pre_retry=pre_retry)
         return [Record(topic, part, offset, key, value, ts)
                 for part, offset, key, value, ts in resp["records"]]
 
     def commit(self, topic: str, group: str) -> None:
         self._rpc({"op": "commit", "topic": topic, "group": group})
 
-    def commit_at(self, topic: str, group: str, offsets: dict) -> None:
+    def commit_at(self, topic: str, group: str, offsets: dict,
+                  partitions: Optional[List[int]] = None) -> None:
         """Commit explicit per-partition exclusive end offsets."""
-        self._rpc({"op": "commit_at", "topic": topic, "group": group,
-                   "offsets": {str(k): int(v) for k, v in offsets.items()}})
+        req = {"op": "commit_at", "topic": topic, "group": group,
+               "offsets": {str(k): int(v) for k, v in offsets.items()}}
+        if partitions is not None:
+            req["partitions"] = [int(p) for p in partitions]
+        self._rpc(req)
 
-    def seek_committed(self, topic: str, group: str) -> None:
-        self._rpc({"op": "seek_committed", "topic": topic, "group": group})
+    def seek_committed(self, topic: str, group: str,
+                       partitions: Optional[List[int]] = None) -> None:
+        req = {"op": "seek_committed", "topic": topic, "group": group}
+        if partitions is not None:
+            # pinned seek (feeders/): rewind ONLY the named partitions —
+            # a lease takeover must re-read its predecessor's uncommitted
+            # tail without disturbing other live feeders' cursors
+            req["partitions"] = [int(p) for p in partitions]
+        self._rpc(req)
 
     def end_offsets(self, topic: str) -> List[int]:
         return self._rpc({"op": "end_offsets", "topic": topic})["offsets"]
 
     def topics(self) -> List[str]:
         return self._rpc({"op": "topics"})["topics"]
+
+    def call(self, op: str, **fields) -> dict:
+        """Invoke a registered subsystem op (BusServer.register_op) —
+        same fencing stamp, tracing envelope, and reconnect/backoff
+        policy as the built-in ops. Returns the full response dict."""
+        return self._rpc(dict(fields, op=str(op)))
 
     def telemetry(self) -> dict:
         """Fetch the remote process's observability snapshot (cluster

@@ -201,6 +201,21 @@ DEFAULTS: Dict = {
     },
     "bus": {"partitions": 8, "retention_chunks": 64, "chunk_events": 65536,
             "edge_port": None},  # set to expose the bus on TCP (busnet)
+    # disaggregated feeder fleet (feeders/): remote workers own TTL-leased
+    # source partitions and ship ready-to-stage wire blobs; the mesh host
+    # does only H2D + step. `enabled` mounts the feeder_* busnet ops on
+    # the bus edge (requires bus.edge_port). Worker-side keys (`connect`,
+    # `name`, `partitions`) configure `serve --feeder` processes.
+    "feeders": {
+        "enabled": False,
+        "frames_topic": None,      # default: TopicNaming.feeder_frames()
+        "lease_ttl_s": 5.0,
+        "connect": None,           # mesh host bus edge "host:port"
+        "name": None,              # worker identity (default: host:pid)
+        "partitions": None,        # csv pin, e.g. "0,1"; None = all
+        "poll_max_records": 4096,
+        "shed_backoff_s": 0.25,
+    },
     # fused pipeline rules applied at boot (list of dicts matching the
     # `rules` config-model element — runtime/config_model.py
     # rule_processing_model; same shape as POST /api/rules bodies)

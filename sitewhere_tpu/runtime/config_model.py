@@ -441,6 +441,43 @@ def faults_model() -> ElementModel:
         children=[rule])
 
 
+def feeders_model() -> ElementModel:
+    """Disaggregated feeder fleet (feeders/; docs/FEEDERS.md): remote
+    workers own TTL-leased source partitions, decode+intern+pack locally,
+    and ship ready-to-stage wire blobs to the mesh host's bus edge."""
+    return ElementModel(
+        name="feeders", role="feeder-fleet",
+        description="Disaggregated input feeders: lease-owned partition "
+                    "decode/pack off the mesh host, blob handoff over "
+                    "busnet with exactly-once takeover replay",
+        attributes=[
+            _attr("enabled", _B, default=False,
+                  description="mount the feeder_* ops on the bus edge "
+                              "(requires bus.edge_port)"),
+            _attr("frames_topic",
+                  description="raw wire-frame topic feeders consume "
+                              "(default: the instance feeder-frames "
+                              "topic)"),
+            _attr("lease_ttl_s", _D, default=5.0,
+                  description="partition lease TTL; a worker renews at "
+                              "TTL/3 and a lapsed lease is stealable at "
+                              "a higher epoch"),
+            _attr("connect",
+                  description="worker mode: mesh host bus edge "
+                              "host:port (serve --feeder)"),
+            _attr("name",
+                  description="worker identity for leases (default "
+                              "host:pid)"),
+            _attr("partitions",
+                  description="worker mode: csv partition pin, e.g. "
+                              "'0,1'; unset leases every partition"),
+            _attr("poll_max_records", _I, default=4096),
+            _attr("shed_backoff_s", _D, default=0.25,
+                  description="worker backoff after a propagated "
+                              "admission shed (structured 429)"),
+        ])
+
+
 def _all_elements() -> List[ElementModel]:
     """Every subsystem's element model — the single source both the UI model
     and the validator consume."""
@@ -451,7 +488,7 @@ def _all_elements() -> List[ElementModel]:
         registration_model(), batch_operations_model(), schedule_model(),
         label_generation_model(), web_rest_model(), analytics_model(),
         event_search_model(), telemetry_model(), observability_model(),
-        faults_model(),
+        faults_model(), feeders_model(),
     ]
 
 

@@ -169,6 +169,33 @@ class AgeSidecar:
         return summary
 
 
+def sidecar_to_wire(sidecar: Optional[AgeSidecar],
+                    now_s: Optional[float] = None) -> List[List[float]]:
+    """Sidecar entries for cross-process transport. ``perf_counter``
+    stamps are process-local — they must never cross a process boundary
+    raw. The wire form carries AGE-SO-FAR per entry ([age_s, n]); the
+    receiver re-stamps against its own clock (:func:`sidecar_from_wire`),
+    so the end-to-end age keeps accumulating across the hop and only the
+    one-way transport skew (not clock-domain garbage) is lost."""
+    if sidecar is None or not sidecar.entries:
+        return []
+    if now_s is None:
+        now_s = time.perf_counter()
+    return [[max(0.0, now_s - stamp), n] for stamp, n in sidecar.entries]
+
+
+def sidecar_from_wire(entries: Sequence[Sequence[float]],
+                      now_s: Optional[float] = None) -> AgeSidecar:
+    """Rebuild a sidecar from wire age-so-far entries, re-stamped on the
+    receiving process's ``perf_counter`` clock."""
+    if now_s is None:
+        now_s = time.perf_counter()
+    sidecar = AgeSidecar()
+    for age_s, n in entries:
+        sidecar.add(now_s - max(0.0, float(age_s)), int(n))
+    return sidecar
+
+
 def observe_summary(hist, summary: AgeSummary, **labels) -> None:
     """Feed a closed summary into a bucketed Prometheus histogram whose
     buckets are AGE_BUCKET_EDGES_S (runtime/metrics.py Histogram built

@@ -47,6 +47,7 @@ FAULT_POINTS = (
     "busnet_partition",
     "checkpoint_torn_write",
     "feeder_thread_death",
+    "feeder_process_death",
     "rest_worker_stall",
 )
 
@@ -55,6 +56,12 @@ FAULT_POINTS = (
 _RAISING_POINTS = frozenset((
     "pack_fail", "h2d_error", "dispatch_error", "lane_fetch_error",
     "checkpoint_torn_write", "feeder_thread_death",
+    # feeder_process_death extends the thread-death drill to feeder
+    # PROCESSES: fired mid-blob in the feeder worker's ship loop, the
+    # worker dies WITHOUT committing or releasing its lease (os._exit in
+    # `serve --feeder`; abandoned thread in the in-proc drill) — the
+    # takeover path, not the error path, must recover it.
+    "feeder_process_death",
 ))
 
 

@@ -81,6 +81,8 @@ class AdmissionController:
         self._last_step_ms = 0.0
         self._last_depth = 0
         self._shed_counter = GLOBAL_METRICS.counter("admission.shed")
+        self._remote_shed_counter = GLOBAL_METRICS.counter(
+            "admission.shed_remote")
 
     @property
     def enabled(self) -> bool:
@@ -136,6 +138,19 @@ class AdmissionController:
                 self._shed_counter.inc()
                 return False
             return True
+
+    def admit_remote(self) -> bool:
+        """Admission decision for a REMOTE producer (a feeder shipping a
+        packed blob over busnet, feeders/service.py). Same budgets and
+        cadence as admit(); a shed is additionally counted under
+        `admission.shed_remote` so operators can tell propagated
+        structured-429 refusals from local front-door sheds — the remote
+        refusal happens before the payload is even decoded, where the
+        local path sheds before pack."""
+        ok = self.admit()
+        if not ok:
+            self._remote_shed_counter.inc()
+        return ok
 
     def report(self) -> Dict[str, Any]:
         with self._lock:

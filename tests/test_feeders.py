@@ -1,0 +1,449 @@
+"""Feeder fleet (feeders/): remote pack bit-identity, exactly-once
+takeover replay, admission-shed propagation.
+
+The differential contract: a feeder's decode -> replica-intern -> pack
+must produce BIT-IDENTICAL wire blobs to the inline FastWireIngest path
+on the mesh host — including under interner-delta lag, brand-new tokens
+mid-stream, and the sharded guard-spill path — because the engine treats
+a landed blob as if it had packed it itself.
+
+The chaos drill (feeder killed between blob ack and offset commit,
+successor steals the lease at epoch+1) lives here too, marked
+chaos+slow like tests/test_chaos.py.
+"""
+
+import numpy as np
+import pytest
+
+from sitewhere_tpu.feeders import FeederService, FeederWorker
+from sitewhere_tpu.feeders.replica import ReplicaPacker
+from sitewhere_tpu.model import Device, DeviceAssignment, DeviceType
+from sitewhere_tpu.pipeline.engine import PipelineEngine
+from sitewhere_tpu.registry import DeviceManagement, RegistryTensors
+from sitewhere_tpu.runtime.bus import EventBus
+from sitewhere_tpu.runtime.busnet import BusClient, BusServer
+from sitewhere_tpu.runtime.faults import FaultPlan, FaultRule, arm, disarm
+from sitewhere_tpu.runtime.metrics import GLOBAL_METRICS
+from sitewhere_tpu.sources.fastlane import FastWireIngest
+from sitewhere_tpu.transport.wire import (
+    MessageType, WireCodec, encode_frame)
+
+
+@pytest.fixture(autouse=True)
+def _always_disarm():
+    disarm()
+    yield
+    disarm()
+
+
+def _world_single(batch_size=64, n_devices=24, shard_classes=1):
+    dm = DeviceManagement()
+    dt = dm.create_device_type(DeviceType(token="t"))
+    tensors = RegistryTensors(max_devices=64, max_zones=4,
+                              max_zone_vertices=4,
+                              shard_classes=shard_classes)
+    for i in range(n_devices):
+        d = dm.create_device(Device(token=f"d{i}", device_type_id=dt.id))
+        dm.create_device_assignment(
+            DeviceAssignment(token=f"a{i}", device_id=d.id))
+    tensors.attach(dm, "tenant")
+    engine = PipelineEngine(tensors, batch_size=batch_size)
+    engine.start()
+    # pin the packing contract so two worlds built seconds apart pack
+    # identical rel_ts (the hello ships this to feeders either way)
+    engine.packer.epoch_base_ms = 1_700_000_000_000
+    return engine
+
+
+def _world_sharded(shards=4, per_shard=16, n_devices=24):
+    from sitewhere_tpu.parallel import ShardedPipelineEngine, make_mesh
+
+    dm = DeviceManagement()
+    dt = dm.create_device_type(DeviceType(token="t"))
+    tensors = RegistryTensors(max_devices=64, max_zones=4,
+                              max_zone_vertices=4, shard_classes=shards)
+    for i in range(n_devices):
+        d = dm.create_device(Device(token=f"d{i}", device_type_id=dt.id))
+        dm.create_device_assignment(
+            DeviceAssignment(token=f"a{i}", device_id=d.id))
+    tensors.attach(dm, "tenant")
+    engine = ShardedPipelineEngine(tensors, mesh=make_mesh(shards),
+                                   per_shard_batch=per_shard)
+    engine.start()
+    engine.packer.epoch_base_ms = 1_700_000_000_000
+    return engine
+
+
+def _stream(n=150, seed=2, n_devices=24, skew_device=None):
+    """Mixed hot-event wire frames as (device_key, frame) records.
+
+    Keyed by device token — like production ingest — so per-device
+    ordering survives bus partitioning (last-write-wins state can only
+    be compared against the inline path when each device's events stay
+    in one partition)."""
+    rng = np.random.default_rng(seed)
+    out = []
+    for i in range(n):
+        tok = (f"d{skew_device}" if skew_device is not None
+               else f"d{int(rng.integers(0, n_devices))}")
+        ts = 1_700_000_000_000 + i
+        kind = int(rng.integers(0, 3))
+        if kind == 0:
+            frame = encode_frame(
+                MessageType.MEASUREMENT, WireCodec.encode_measurement(
+                    tok, ts, f"m{int(rng.integers(0, 5))}",
+                    float(rng.normal())))
+        elif kind == 1:
+            frame = encode_frame(
+                MessageType.LOCATION, WireCodec.encode_location(
+                    tok, ts, float(rng.uniform(-90, 90)),
+                    float(rng.uniform(-180, 180)), float(rng.normal())))
+        else:
+            frame = encode_frame(
+                MessageType.ALERT, WireCodec.encode_alert(
+                    tok, ts, f"alert.t{int(rng.integers(0, 3))}",
+                    int(rng.integers(0, 5)), "hot"))
+        out.append((tok.encode(), frame))
+    return out
+
+
+def _wire(stream):
+    return b"".join(f for _, f in stream)
+
+
+class _Loopback:
+    """A mesh host in miniature: bus + busnet edge + FeederService."""
+
+    def __init__(self, engine, tmp_path=None, partitions=2, **svc_kw):
+        self.bus = EventBus(
+            partitions=partitions,
+            data_dir=str(tmp_path / "bus") if tmp_path is not None
+            else None)
+        self.server = BusServer(self.bus)
+        self.server.start()
+        self.service = FeederService(engine, self.server, "frames",
+                                     **svc_kw)
+
+    def publish(self, stream):
+        for key, f in stream:
+            self.bus.publish("frames", key, f)
+
+    def worker(self, name="w0", epoch=1, **kw):
+        return FeederWorker("127.0.0.1", self.server.port, name,
+                            epoch=epoch, **kw)
+
+    def close(self):
+        self.server.stop()
+        self.bus.close()
+
+
+def _drain(worker, rounds=12):
+    total = 0
+    for _ in range(rounds):
+        total += worker.run_once(timeout_s=0.05)
+    return total
+
+
+def _batches_equal(a, b):
+    import jax.tree_util as jtu
+
+    assert len(a) == len(b)
+    for b1, b2 in zip(a, b):
+        for l1, l2 in zip(jtu.tree_leaves(b1), jtu.tree_leaves(b2)):
+            np.testing.assert_array_equal(np.asarray(l1), np.asarray(l2))
+
+
+class TestRemotePackBitIdentity:
+    """ReplicaPacker vs inline FastWireIngest on an identical twin."""
+
+    def _compare(self, frames, prep=None):
+        inline = _world_single()
+        remote = _world_single()
+        if prep is not None:
+            prep(inline)
+            prep(remote)
+        lb = _Loopback(remote)
+        try:
+            client = BusClient("127.0.0.1", lb.server.port)
+            hello = client.call("feeder_hello")
+            replica = ReplicaPacker(hello, client)
+            replica.sync()
+            data = _wire(frames)
+            remote_batches, n_remote, _ = replica.pack_bytes(data)
+            res = FastWireIngest(inline.packer).ingest(data)
+            assert n_remote == res.n_events
+            _batches_equal(remote_batches, res.batches)
+            # the authoritative meta interners converge too: the replica
+            # allocated its new tokens THROUGH the mesh host
+            assert (remote.packer.measurements.snapshot()
+                    == inline.packer.measurements.snapshot())
+            assert (remote.packer.alert_types.snapshot()
+                    == inline.packer.alert_types.snapshot())
+            client.close()
+        finally:
+            lb.close()
+
+    def test_remote_pack_bit_identical(self, tmp_path):
+        self._compare(_stream(150, seed=2))
+
+    def test_new_tokens_mid_stream(self, tmp_path):
+        """Every measurement name and alert type is unseen: the replica
+        must allocate them authoritatively (feeder_intern) in first-seen
+        order, matching what inline interning would have assigned."""
+        before = GLOBAL_METRICS.counter("feeder.interned_tokens").value
+        self._compare(_stream(120, seed=7))
+        assert GLOBAL_METRICS.counter(
+            "feeder.interned_tokens").value > before
+
+    def test_interner_delta_lag(self, tmp_path):
+        """Tokens interned on the mesh host AFTER the replica bootstrap
+        (rule compilation, another feeder's stream) reach the replica as
+        a journal delta, keeping indices aligned."""
+        def prep(engine):
+            for t in ("pre.a", "pre.b", "pre.c"):
+                engine.packer.measurements.intern(t)
+
+        inline = _world_single()
+        remote = _world_single()
+        lb = _Loopback(remote)
+        try:
+            client = BusClient("127.0.0.1", lb.server.port)
+            replica = ReplicaPacker(client.call("feeder_hello"), client)
+            replica.sync()
+            # delta lands after bootstrap on BOTH worlds
+            prep(inline)
+            prep(remote)
+            data = _wire(_stream(100, seed=4))
+            remote_batches, _, _ = replica.pack_bytes(data)
+            res = FastWireIngest(inline.packer).ingest(data)
+            _batches_equal(remote_batches, res.batches)
+            client.close()
+        finally:
+            lb.close()
+
+    def test_device_registered_after_bootstrap(self, tmp_path):
+        """A device registered after the replica's bootstrap must not
+        pack as UNKNOWN: the miss triggers one device-journal re-sync."""
+        remote = _world_single()
+        lb = _Loopback(remote)
+        try:
+            client = BusClient("127.0.0.1", lb.server.port)
+            replica = ReplicaPacker(client.call("feeder_hello"), client)
+            replica.sync()
+            remote.packer.devices.intern("late-device")
+            frame = encode_frame(
+                MessageType.MEASUREMENT, WireCodec.encode_measurement(
+                    "late-device", 1_700_000_000_500, "m0", 1.0))
+            batches, n, _ = replica.pack_bytes(frame)
+            assert n == 1
+            idx = int(np.asarray(batches[0].device_idx)[0])
+            assert idx == remote.packer.devices.lookup("late-device") > 0
+            client.close()
+        finally:
+            lb.close()
+
+
+
+class TestEndToEndSingleChip:
+    def test_worker_ships_everything_and_state_matches(self, tmp_path):
+        inline = _world_single()
+        remote = _world_single()
+        frames = _stream(180, seed=3)
+        # inline baseline
+        res = FastWireIngest(inline.packer).ingest(_wire(frames))
+        for batch in res.batches:
+            inline.submit(batch)
+        lb = _Loopback(remote, tmp_path)
+        try:
+            lb.publish(frames)
+            w = lb.worker()
+            assert _drain(w) == 180
+            w.stop()
+            for i in range(24):
+                s_in = inline.get_device_state(f"d{i}")
+                s_rm = remote.get_device_state(f"d{i}")
+                assert (s_in is None) == (s_rm is None)
+                if s_in is not None:
+                    assert s_in.last_measurements == s_rm.last_measurements
+        finally:
+            lb.close()
+
+    def test_replayed_extent_is_deduplicated(self, tmp_path):
+        """A blob whose extent is at-or-under the watermark (a successor
+        replaying acked-but-uncommitted work) applies zero events."""
+        from sitewhere_tpu.feeders import protocol
+        from sitewhere_tpu.ops.pack import batch_to_blob
+        from sitewhere_tpu.runtime.recovery import ReplayBarrier
+
+        remote = _world_single()
+        barrier = ReplayBarrier()
+        barrier.arm({"default": 10_000})
+        lb = _Loopback(remote, tmp_path, replay_barrier=barrier)
+        try:
+            client = BusClient("127.0.0.1", lb.server.port)
+            replica = ReplicaPacker(client.call("feeder_hello"), client)
+            replica.sync()
+            batches, n, _ = replica.pack_bytes(_wire(_stream(20, seed=5)))
+            msg = protocol.blob_message(
+                batch_to_blob(batches[0]), n_events=n, partition=0, seq=1,
+                extent=(0, 20), epoch=1)
+            first = client.call("feeder_blob", **msg)
+            assert first["events"] == n and not first.get("dup")
+            again = client.call("feeder_blob", **dict(msg, seq=2))
+            assert again["dup"] and again["events"] == 0
+            assert again["suppressed"] == n
+            assert lb.service.watermark(0) == 20
+            client.close()
+        finally:
+            lb.close()
+
+    def test_shed_propagates_to_feeder(self, tmp_path):
+        """An AdmissionController breach turns the blob ack into a
+        structured 429 counted at the FEEDER's receiver; nothing is
+        committed, so reopening admission delivers exactly once."""
+        from sitewhere_tpu.sources.manager import AdmissionController
+
+        remote = _world_single()
+        admission = AdmissionController(queue_depth_budget=1,
+                                        queue_depth=lambda: 100,
+                                        check_every=1)
+        lb = _Loopback(remote, tmp_path, admission=admission)
+        try:
+            frames = _stream(40, seed=6)
+            lb.publish(frames)
+            w = lb.worker()
+            shed_before = GLOBAL_METRICS.counter(
+                "feeder.shed_received").value
+            remote_before = GLOBAL_METRICS.counter(
+                "admission.shed_remote").value
+            assert _drain(w, rounds=3) == 0  # everything refused
+            assert GLOBAL_METRICS.counter(
+                "feeder.shed_received").value > shed_before
+            assert GLOBAL_METRICS.counter(
+                "admission.shed_remote").value > remote_before
+            # reopen admission: the uncommitted extents redeliver
+            admission.configure(queue_depth_budget=0)
+            assert _drain(w) == 40
+            w.stop()
+        finally:
+            lb.close()
+
+    def test_fenced_zombie_cannot_land_blobs(self, tmp_path):
+        """After a higher-epoch takeover, the dead feeder's in-flight
+        blobs bounce with stale_epoch instead of double-applying."""
+        remote = _world_single()
+        lb = _Loopback(remote, tmp_path, lease_ttl_s=60.0)
+        try:
+            lb.publish(_stream(30, seed=8))
+            w1 = lb.worker("w1", epoch=1)
+            w1.connect()
+            w1.acquire_leases()
+            w2 = lb.worker("w2", epoch=2)
+            w2.connect()
+            taken = w2.acquire_leases()  # live steal: strictly higher epoch
+            assert taken == sorted(w2.owned)
+            fenced_before = GLOBAL_METRICS.counter("feeder.fenced").value
+            _drain(w1, rounds=2)  # its blobs bounce; leases drop
+            assert not w1.owned
+            assert GLOBAL_METRICS.counter(
+                "feeder.fenced").value > fenced_before
+            assert _drain(w2) == 30
+            w1.stop()
+            w2.stop()
+        finally:
+            lb.close()
+
+
+class TestEndToEndSharded:
+    def test_sharded_state_matches_inline(self, tmp_path):
+        inline = _world_sharded()
+        remote = _world_sharded()
+        frames = _stream(128, seed=9)
+        res = FastWireIngest(inline.packer).ingest(_wire(frames))
+        for batch in res.batches:
+            inline.submit(batch)
+        inline.drain_pending()  # fold any parked skew-overflow rows
+        lb = _Loopback(remote, tmp_path)
+        try:
+            lb.publish(frames)
+            w = lb.worker()
+            assert _drain(w) == 128
+            w.stop()
+            remote.drain_pending()
+            for i in range(24):
+                s_in = inline.get_device_state(f"d{i}")
+                s_rm = remote.get_device_state(f"d{i}")
+                assert (s_in is None) == (s_rm is None)
+                if s_in is not None:
+                    assert s_in.last_measurements == s_rm.last_measurements
+        finally:
+            lb.close()
+
+    def test_guard_spill_path(self, tmp_path):
+        """Skew every event onto one device: the feeder's host-route
+        guard reports no-fit, the mesh host takes the counted spill path
+        (host arena route) and still applies every event."""
+        remote = _world_sharded()
+        if not remote.device_routing:
+            pytest.skip("device routing unavailable on this mesh")
+        lb = _Loopback(remote, tmp_path)
+        try:
+            lb.publish(_stream(96, seed=10, skew_device=5))
+            spills_before = GLOBAL_METRICS.counter(
+                "feeder.guard_spills").value
+            w = lb.worker()
+            assert _drain(w) == 96
+            w.stop()
+            assert GLOBAL_METRICS.counter(
+                "feeder.guard_spills").value > spills_before
+            state = remote.get_device_state("d5")
+            assert state is not None
+        finally:
+            lb.close()
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+class TestFeederKillDrill:
+    def test_kill_mid_blob_takeover_exactly_once(self, tmp_path):
+        """The ISSUE acceptance drill: kill a feeder BETWEEN blob ack and
+        offset commit, steal its partitions at epoch+1, replay — the
+        watermark drops the acked-but-uncommitted extents, takeover.count
+        moves, and the engine applies every event exactly once."""
+        remote = _world_single(batch_size=16)
+        applied = []
+        lb = _Loopback(
+            remote, tmp_path, lease_ttl_s=60.0,
+            on_outputs=lambda eng, outs, rec: applied.append(
+                int(outs.processed)))
+        try:
+            n_events = 120
+            lb.publish(_stream(n_events, seed=11))
+            takeover_before = GLOBAL_METRICS.counter("takeover.count").value
+            replay_before = GLOBAL_METRICS.counter(
+                "feeder.replay_dropped").value
+            # die on the 3rd blob: after its ACK, before any commit
+            arm(FaultPlan(seed=0, rules=[
+                FaultRule("feeder_process_death", times=1, after=2)]))
+            w1 = lb.worker("w1", epoch=1)
+            _drain(w1, rounds=6)
+            assert w1.dead
+            disarm()
+            # successor at a strictly higher epoch: steals live leases,
+            # fences w1, replays from the last COMMITTED offsets
+            w2 = lb.worker("w2", epoch=2)
+            w2.connect()
+            assert w2.acquire_leases()
+            assert GLOBAL_METRICS.counter(
+                "takeover.count").value > takeover_before
+            _drain(w2)
+            w2.stop()
+            # conservation: every published event applied EXACTLY once —
+            # replayed extents were suppressed by the watermark, none
+            # were lost, none doubled
+            assert sum(applied) == n_events
+            assert GLOBAL_METRICS.counter(
+                "feeder.replay_dropped").value > replay_before
+        finally:
+            disarm()
+            lb.close()
