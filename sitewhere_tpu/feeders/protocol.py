@@ -33,6 +33,12 @@ Five ops, mounted on the mesh host's BusServer via ``register_op``
 
 Blobs travel as raw ``tobytes()`` payloads inside the existing
 length-prefixed msgpack busnet frames — no new framing layer.
+
+Consume-side ops (the built-in busnet poll / commit_at /
+seek_committed) are not in this table but carry the same per-partition
+fencing: ``consume_fences`` stamps them with ``[fence_key, epoch]``
+pairs so a fenced-out zombie cannot move the shared server-side cursor
+(records it silently skipped would otherwise be lost, not duplicated).
 """
 
 from __future__ import annotations
@@ -61,6 +67,29 @@ def feeder_fence_key(partition: int) -> str:
     return f"feeder:p{int(partition)}"
 
 
+def fence_key_partition(key: str) -> Optional[int]:
+    """Inverse of feeder_fence_key: the partition a stale_epoch rejection
+    names, or None for a non-feeder fence resource."""
+    key = str(key)
+    if not key.startswith("feeder:p"):
+        return None
+    try:
+        return int(key[len("feeder:p"):])
+    except ValueError:
+        return None
+
+
+def consume_fences(partitions: Sequence[int], epoch: int) -> List[list]:
+    """Per-partition [fence_key, epoch] stamps for consume-side busnet
+    ops (poll / commit_at / seek_committed). A fenced-out zombie feeder
+    must bounce with stale_epoch BEFORE its request can move the shared
+    server-side cursor: an unfenced zombie poll skips records it will
+    never ship, and once the successor's later extents advance the mesh
+    watermark those skipped records redeliver as false 'replays' —
+    silent loss, not duplicates."""
+    return [[feeder_fence_key(p), int(epoch)] for p in partitions]
+
+
 def partition_resource(partition: int) -> str:
     """LeaseTable resource name for one source partition."""
     return f"feeder-partition-{int(partition)}"
@@ -70,14 +99,18 @@ def blob_message(blob: np.ndarray, *, n_events: int, partition: int,
                  seq: int, extent: Sequence[int], epoch: int,
                  fits_device_route: bool = True,
                  age: Optional[AgeSidecar] = None,
-                 advance: bool = True) -> dict:
+                 advance: bool = True, chunk: int = 0) -> dict:
     """Build the ``feeder_blob`` request body. ``extent`` is the
     [start, end) partition offset range the blob covers — the mesh
-    host's replay watermark judges duplicates by it. ``advance=False``
-    marks a non-final chunk of a record too large for one batch: the
-    watermark only moves on the record's LAST chunk, so a mid-record
-    crash replays the whole record (at-least-once for that edge case;
-    record-aligned blobs — the steady state — stay exactly-once)."""
+    host's replay watermark judges duplicates by it. A record too large
+    for one batch ships as chunks: ``chunk`` is the 0-based index within
+    the extent and ``advance=False`` marks every chunk but the last.
+    The watermark only moves on the LAST chunk, but the mesh host also
+    remembers the highest applied (extent, chunk) of an in-progress
+    record, so a replay after a mid-record shed/fence/crash dedupes the
+    already-applied chunks instead of double-stepping them — chunking is
+    deterministic (greedy record grouping + fixed batch width), so a
+    re-pack of the same extent reproduces the same chunk boundaries."""
     blob = np.ascontiguousarray(blob, np.int32)
     return {
         "blob": blob.tobytes(),
@@ -90,6 +123,7 @@ def blob_message(blob: np.ndarray, *, n_events: int, partition: int,
         "fits_device_route": bool(fits_device_route),
         "age": sidecar_to_wire(age),
         "advance": bool(advance),
+        "chunk": int(chunk),
         "fence": feeder_fence_key(partition),
         "epoch": int(epoch),
     }

@@ -15,12 +15,33 @@ fully at-or-under the watermark is a replay — dropped, counted
 (`feeder.replay_dropped`), its rows handed to the armed ReplayBarrier
 as suppressed effects. Feeders commit offsets only after the ack, so
 extents are blob-aligned: a replayed extent is either fully duplicate
-or fully new.
+or fully new. Two refinements close the partial cases:
+
+* Chunked records (one record wider than a batch) dedupe per CHUNK: the
+  highest applied (extent, chunk) of an in-progress record is kept
+  alongside the watermark, so a replay after a mid-record shed/fence/
+  kill suppresses the chunks that already stepped instead of
+  double-applying them.
+* An extent that STRADDLES the watermark (start < watermark < end — a
+  regrouped replay after new records extended the greedy group
+  boundary) is refused with a structured ``overlap`` verdict carrying
+  the watermark; the feeder advances its commit to the watermark and
+  re-polls, so the straddling blob's already-applied prefix is never
+  stepped twice. Counted on `feeder.extent_overlap`.
+
+The replay check runs lock-free as a fast path and AGAIN under the step
+lock before stepping: blob handlers run on concurrent busnet threads,
+so a zombie's in-flight duplicate that passed the first check while the
+successor's replay held the lock is caught by the in-lock re-check
+after the watermark advanced.
 
 Zombie fencing: blob requests are stamped ``fence=feeder:p<N>`` and
 epoch-checked by busnet dispatch BEFORE this service sees them; a
 takeover raises the partition's floor so the dead feeder's in-flight
-blobs bounce with ``stale_epoch`` instead of landing twice.
+blobs bounce with ``stale_epoch`` instead of landing twice. Feeders
+stamp the same per-partition fences on their consume-side ops (poll /
+commit / seek), so a fenced zombie cannot move the shared server-side
+cursor either.
 
 Admission: the shed decision propagates to the SOURCE — a shedding
 AdmissionController turns the blob ack into a structured 429 the
@@ -80,11 +101,16 @@ class FeederService:
         self._shed_counter = metrics.counter("feeder.shed")
         self._replay_counter = metrics.counter("feeder.replay_dropped")
         self._spill_counter = metrics.counter("feeder.guard_spills")
+        self._overlap_counter = metrics.counter("feeder.extent_overlap")
         self._takeover_counter = metrics.counter("takeover.count")
         self._age_hist = age_histogram(metrics)
         # per-partition exclusive end offset of applied extents — the
         # exactly-once watermark; survives any feeder's death
         self._watermarks: dict = {}
+        # per-partition (extent_end, max applied chunk) of the ONE
+        # in-progress chunked record, cleared when its final chunk
+        # advances the watermark — the sub-extent half of exactly-once
+        self._partials: dict = {}
         # blob staging order + the engine step are serialized: the step
         # is not concurrent-safe, and a single arrival order keeps the
         # staging ring's ordered grant meaningful across feeders
@@ -214,10 +240,53 @@ class FeederService:
 
     # -- op: blob -----------------------------------------------------------
 
+    def _extent_disposition(self, partition: int, start: int, end: int,
+                            chunk: int):
+        """'dup' (fully applied — drop and suppress), 'overlap' (the
+        extent straddles the watermark — the shipper must re-group from
+        it), or None (fresh). Consulted lock-free as a fast path and
+        AGAIN under ``_step_lock`` before stepping; only the in-lock
+        answer is authoritative."""
+        wm = self._watermarks.get(partition, -1)
+        if end <= wm:
+            return "dup"
+        if start < wm:
+            return "overlap"
+        partial = self._partials.get(partition)
+        if partial is not None and partial[0] == end and chunk <= partial[1]:
+            return "dup"
+        return None
+
+    def _dup_reply(self, n_events: int) -> dict:
+        self._replay_counter.inc()
+        suppressed = self.replay_barrier.take(self.tenant, n_events) \
+            if self.replay_barrier is not None else 0
+        # report what the barrier actually suppressed — 0 when disarmed
+        # (no durable rows to protect), never a fabricated n_events
+        return {"ok": True, "dup": True, "events": 0,
+                "suppressed": int(suppressed)}
+
+    def _overlap_reply(self, partition: int) -> dict:
+        self._overlap_counter.inc()
+        return {"ok": True, "overlap": True, "events": 0,
+                "watermark": int(self._watermarks.get(partition, -1))}
+
     def _op_blob(self, req: dict) -> dict:
-        # 1. front-door shedding FIRST: the whole point of propagating
-        # the decision is that an overloaded mesh host refuses before
-        # doing any work with the payload
+        partition = int(req["partition"])
+        start, end = (int(x) for x in req["extent"])
+        n_events = int(req["n_events"])
+        chunk = int(req.get("chunk", 0))
+        # 1. replay watermark FIRST: a duplicate is dropped for free —
+        # were shedding checked first, an overloaded mesh host would
+        # 429 takeover replays and the feeder would re-ship the same
+        # already-applied extents forever instead of converging
+        verdict = self._extent_disposition(partition, start, end, chunk)
+        if verdict == "dup":
+            return self._dup_reply(n_events)
+        if verdict == "overlap":
+            return self._overlap_reply(partition)
+        # 2. front-door shedding: an overloaded mesh host refuses fresh
+        # work before doing anything with the payload
         admit = getattr(self.admission, "admit_remote", None) \
             or getattr(self.admission, "admit", None)
         if admit is not None and not admit():
@@ -227,19 +296,6 @@ class FeederService:
             # `shed`, backs off, and does NOT commit the extent
             return {"ok": True, "shed": True, "http_status": 429,
                     "events": 0}
-        partition = int(req["partition"])
-        start, end = (int(x) for x in req["extent"])
-        n_events = int(req["n_events"])
-        # 2. exactly-once replay watermark: feeders commit only after the
-        # ack, so a takeover replay re-ships whole already-applied
-        # extents — fully at-or-under the watermark, never partial
-        wm = self._watermarks.get(partition, -1)
-        if end <= wm:
-            self._replay_counter.inc()
-            suppressed = self.replay_barrier.take(self.tenant, n_events) \
-                if self.replay_barrier is not None else 0
-            return {"ok": True, "dup": True, "events": 0,
-                    "suppressed": int(suppressed or n_events)}
         t0 = time.perf_counter()
         c0 = time.thread_time()
         blob = protocol.decode_blob(req)
@@ -250,6 +306,17 @@ class FeederService:
         observe_summary(self._age_hist, age.close(),
                         engine=self.engine.name, edge=FEEDER_EDGE)
         with self._step_lock:
+            # 3. authoritative re-check: blob handlers run on concurrent
+            # busnet threads, so a duplicate that passed the fast path
+            # while another handler (the successor's replay of the same
+            # extent) held this lock must be caught here, AFTER that
+            # handler advanced the watermark — or it would step twice
+            verdict = self._extent_disposition(partition, start, end,
+                                               chunk)
+            if verdict == "dup":
+                return self._dup_reply(n_events)
+            if verdict == "overlap":
+                return self._overlap_reply(partition)
             order = self._order
             self._order += 1
             s0 = time.perf_counter()
@@ -259,7 +326,21 @@ class FeederService:
                 events = self._step_single(blob, n_events, age, order)
             s1 = time.perf_counter()
             if req.get("advance", True):
-                self._watermarks[partition] = max(wm, end)
+                # compute from the fresh in-lock value — never from a
+                # pre-lock read, which could regress the watermark and
+                # re-admit replays a concurrent handler already applied
+                wm = max(self._watermarks.get(partition, -1), end)
+                self._watermarks[partition] = wm
+                partial = self._partials.get(partition)
+                if partial is not None and partial[0] <= wm:
+                    del self._partials[partition]
+            else:
+                # non-final chunk: remember the sub-extent so a replay
+                # of this in-progress record dedupes its applied chunks
+                partial = self._partials.get(partition)
+                prev = partial[1] if partial is not None \
+                    and partial[0] == end else -1
+                self._partials[partition] = (end, max(prev, chunk))
             self.blob_step_s += s1 - s0
             self.blob_handle_s += s1 - t0
             self.blob_cpu_s += time.thread_time() - c0

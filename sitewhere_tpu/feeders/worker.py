@@ -21,6 +21,14 @@ A structured 429 from the mesh host (AdmissionController shed propagated
 over busnet) is counted at THIS receiver (`feeder.shed_received`) and
 backs the partition off without committing — the events redeliver when
 admission reopens, instead of being dropped after the transfer was paid.
+
+Every consume-side op (poll / commit_at / seek_committed) is stamped
+with the per-partition lease fences, so a fenced-out zombie cannot move
+the shared server-side cursor — records it would silently skip past
+could otherwise never redeliver to the successor. And ANY failure mid-
+cycle — shed, fence, or a raw transport error — takes the same exit:
+commit what was acked, rewind the partition to committed so the polled-
+but-unshipped records redeliver.
 """
 
 from __future__ import annotations
@@ -72,6 +80,7 @@ class FeederWorker:
         self._metrics = metrics
         self._blob_counter = metrics.counter("feeder.blobs_shipped")
         self._shed_counter = metrics.counter("feeder.shed_received")
+        self._error_counter = metrics.counter("feeder.cycle_errors")
         self._fenced_counter = metrics.counter("feeder.fenced")
         self._takeover_counter = metrics.counter("feeder.takeovers")
         self.hello: Optional[dict] = None
@@ -123,8 +132,9 @@ class FeederWorker:
             # tail: rewind exactly the granted partitions to their last
             # COMMITTED offsets so those records redeliver (the mesh
             # watermark drops whatever was already applied)
-            self.client.seek_committed(hello["topic"], hello["group"],
-                                       partitions=fresh)
+            self.client.seek_committed(
+                hello["topic"], hello["group"], partitions=fresh,
+                fences=protocol.consume_fences(fresh, self.epoch))
         return sorted(self.owned)
 
     def release_leases(self) -> None:
@@ -172,12 +182,26 @@ class FeederWorker:
             return 0
         self.replica.sync()
         parts = sorted(self.owned)
-        records = self.client.poll(
-            self.hello["topic"], self.hello["group"],
-            max_records=self.poll_max_records,
-            timeout_s=self.poll_timeout_s if timeout_s is None
-            else timeout_s,
-            partitions=parts)
+        try:
+            records = self.client.poll(
+                self.hello["topic"], self.hello["group"],
+                max_records=self.poll_max_records,
+                timeout_s=self.poll_timeout_s if timeout_s is None
+                else timeout_s,
+                partitions=parts,
+                fences=protocol.consume_fences(parts, self.epoch))
+        except StaleEpochBusError as exc:
+            # consume-side fencing: a successor's takeover raised a
+            # partition's floor past our epoch — the rejected poll moved
+            # NO cursor, so nothing was skipped. Drop the named
+            # partition; the next cycle polls the survivors.
+            self._fenced_counter.inc()
+            stale = protocol.fence_key_partition(exc.resource)
+            if stale is not None:
+                self.owned.pop(stale, None)
+            else:
+                self.owned.clear()
+            return 0
         if not records:
             return 0
         shipped = 0
@@ -194,9 +218,13 @@ class FeederWorker:
 
     def _ship_partition(self, partition: int, records: List) -> int:
         """Pack one partition's polled records into record-aligned blobs
-        and ship them; commit after the last ack. Any failure before the
-        commit leaves the extent uncommitted — at-least-once upstream,
-        deduplicated downstream by the mesh watermark."""
+        and ship them; commit after the last ack. ANY exit before the
+        commit — shed, fence, or a raw transport error unwinding out of
+        a ship — leaves the unacked tail uncommitted AND rewinds the
+        partition to committed, so the polled-but-unshipped records
+        redeliver instead of sitting forever past the server-side
+        cursor. At-least-once upstream, deduplicated downstream by the
+        mesh watermark."""
         B = int(self.hello["batch_size"])
         # record-aligned groups: greedily accumulate whole records up to
         # the batch width so an offset commit never splits a record
@@ -214,45 +242,85 @@ class FeederWorker:
             groups.append(group)
         shipped = 0
         committed_through: Optional[int] = None
-        stopped_early = False
-        for group in groups:
-            age = AgeSidecar()
-            data = b"".join(rec.value for rec in group)
-            batches, n_events, _rest = self.replica.pack_bytes(data)
-            age.add(None, n_events)
-            extent = (group[0].offset, group[-1].offset + 1)
-            ok = self._ship_blobs(partition, batches, n_events, extent,
-                                  age)
-            if self._dead:
-                # injected death: commit NOTHING — acked-but-uncommitted
-                # extents must replay through the successor, exactly like
-                # a SIGKILL before the commit_at went out
-                return shipped
-            if not ok:
-                stopped_early = True
-                break  # shed/fenced: do not commit past this point
-            shipped += n_events
-            committed_through = extent[1]
-        if committed_through is not None:
-            self.client.commit_at(
-                self.hello["topic"], self.hello["group"],
-                {partition: committed_through}, partitions=[partition])
-        if stopped_early:
-            # polled-but-unshipped records (the shed/fenced group and
-            # everything after it) advanced the server-side cursor without
-            # a commit: rewind this partition so they redeliver — to us on
-            # the next poll, or to the successor after a fencing
-            self.client.seek_committed(self.hello["topic"],
-                                       self.hello["group"],
-                                       partitions=[partition])
+        rewind = False
+        try:
+            for group in groups:
+                age = AgeSidecar()
+                data = b"".join(rec.value for rec in group)
+                batches, n_events, _rest = self.replica.pack_bytes(data)
+                age.add(None, n_events)
+                extent = (group[0].offset, group[-1].offset + 1)
+                ok, skip_to = self._ship_blobs(partition, batches,
+                                               n_events, extent, age)
+                if self._dead:
+                    # injected death: commit NOTHING — acked-but-
+                    # uncommitted extents must replay through the
+                    # successor, exactly like a SIGKILL before the
+                    # commit_at went out (the finally below skips too)
+                    return shipped
+                if skip_to is not None:
+                    # mesh overlap verdict: everything below the
+                    # watermark IS applied — advance the commit to it so
+                    # the rewound re-poll regroups from exactly the
+                    # first unapplied record
+                    committed_through = max(committed_through
+                                            if committed_through is not None
+                                            else -1, skip_to)
+                if not ok:
+                    rewind = True
+                    break  # shed/fenced/overlap: nothing past this point
+                shipped += n_events
+                committed_through = extent[1]
+        except Exception:
+            # a transport (or any other) failure mid-ship takes the SAME
+            # exit as shed/fenced — without the rewind, the polled-but-
+            # unshipped records sit past the server-side cursor, later
+            # extents advance the mesh watermark over them, and their
+            # eventual redelivery is dropped as a false replay (loss)
+            rewind = True
+            raise
+        finally:
+            if not self._dead and partition in self.owned:
+                self._commit_and_rewind(partition, committed_through,
+                                        rewind)
         return shipped
 
+    def _commit_and_rewind(self, partition: int,
+                           committed_through: Optional[int],
+                           rewind: bool) -> None:
+        """Best-effort cycle exit: commit the acked extents, then rewind
+        to committed when the cycle stopped early. Both ops are fenced —
+        a takeover between ship and commit bounces them (the successor
+        replays; the watermark dedupes) — and both may fail on a dead
+        transport, which only costs redelivery (at-least-once)."""
+        fences = protocol.consume_fences([partition], self.epoch)
+        try:
+            if committed_through is not None:
+                self.client.commit_at(
+                    self.hello["topic"], self.hello["group"],
+                    {partition: committed_through},
+                    partitions=[partition], fences=fences)
+            if rewind:
+                self.client.seek_committed(self.hello["topic"],
+                                           self.hello["group"],
+                                           partitions=[partition],
+                                           fences=fences)
+        except StaleEpochBusError:
+            self._fenced_counter.inc()
+            self.owned.pop(partition, None)
+        except Exception:
+            pass
+
     def _ship_blobs(self, partition: int, batches, n_events: int,
-                    extent, age: AgeSidecar) -> bool:
+                    extent, age: AgeSidecar):
         """Pack each batch into its wire blob and ship. A single record
         group normally yields one batch; an oversized record chunks into
-        several — only the last advances the mesh watermark (see
-        protocol.blob_message)."""
+        several — each stamped with its chunk index, only the last
+        advancing the mesh watermark (see protocol.blob_message).
+        Returns ``(ok, skip_to)``: ok False stops the cycle before any
+        commit past this group; skip_to (the watermark from an overlap
+        verdict) tells the caller to advance the partition's commit to
+        it before rewinding."""
         sharded = self.hello.get("engine") == "sharded"
         for i, batch in enumerate(batches):
             final = i == len(batches) - 1
@@ -263,19 +331,26 @@ class FeederWorker:
                 resp = self.client.call(protocol.OP_BLOB, **protocol.blob_message(
                     blob, n_events=n, partition=partition, seq=self.seq,
                     extent=extent, epoch=self.epoch,
-                    fits_device_route=fits, age=age, advance=final))
+                    fits_device_route=fits, age=age, advance=final,
+                    chunk=i))
             except StaleEpochBusError:
                 # fenced: a successor took this partition over — drop the
                 # lease and never commit (our rows land via its replay)
                 self._fenced_counter.inc()
                 self.owned.pop(partition, None)
-                return False
+                return False, None
             if resp.get("shed"):
                 # the propagated AdmissionController 429: counted here at
                 # the receiver, partition backs off uncommitted
                 self._shed_counter.inc()
                 time.sleep(self.shed_backoff_s)
-                return False
+                return False, None
+            if resp.get("overlap"):
+                # the extent straddles the mesh watermark (a regrouped
+                # replay after new records widened the greedy group):
+                # its applied prefix must NOT step again — skip the
+                # commit to the watermark and re-poll from there
+                return False, int(resp["watermark"])
             # the kill drill's window: the blob is ACKED (applied on the
             # mesh host) but the offsets behind it are not yet committed —
             # the successor replays this extent and exactly-once must
@@ -284,11 +359,11 @@ class FeederWorker:
                 fault_point("feeder_process_death")
             except FaultError:
                 self._die()
-                return False
+                return False, None
             self._blob_counter.inc()
             self.blobs_shipped += 1
             self.events_shipped += n
-        return True
+        return True, None
 
     def _pack_blob(self, batch, sharded: bool):
         """Batch -> the exact wire layout the engine would have packed
@@ -354,6 +429,11 @@ class FeederWorker:
             except Exception:
                 if self._stop.is_set() or self._dead:
                     return
+                # safe to swallow-and-retry ONLY because _ship_partition
+                # already rewound the partition to committed on its way
+                # out — the failed cycle's records redeliver; counted so
+                # a flapping transport is visible, not silent
+                self._error_counter.inc()
                 time.sleep(0.2)
 
     def stop(self) -> None:

@@ -205,17 +205,35 @@ class _Handler(socketserver.BaseRequestHandler):
         # restarted writer's newer epoch fences its old incarnation) and
         # are raised explicitly by the takeover broadcast below — the
         # zombie/split-brain write guard. Unstamped requests pass
-        # (backward compatible; fencing is opt-in per writer).
+        # (backward compatible; fencing is opt-in per writer). Two stamp
+        # forms check the same floors: the single `fence` identity, and
+        # the multi-key `fences` list ([key, epoch] pairs) consume-side
+        # ops use to cover every leased partition in one request — a
+        # fenced-out zombie's poll/commit/seek must bounce BEFORE it can
+        # move the shared server-side cursor (records a zombie silently
+        # skips past would otherwise look like replays downstream and be
+        # dropped — permanent loss, not duplicates).
         fence_key = req.get("fence")
-        if fence_key is not None and op != "fence":
-            epoch = int(req.get("epoch", 0))
-            if not fence.admit(str(fence_key), epoch):
-                floor = fence.floor(str(fence_key))
-                return {"ok": False, "stale_epoch": True,
-                        "fence": str(fence_key), "epoch": epoch,
-                        "floor": floor,
-                        "error": f"stale epoch {epoch} < fenced floor "
-                                 f"{floor} for '{fence_key}'"}
+
+        def _stale_reply():
+            checks = []
+            if fence_key is not None:
+                checks.append((str(fence_key), int(req.get("epoch", 0))))
+            for pair in req.get("fences") or []:
+                checks.append((str(pair[0]), int(pair[1])))
+            for key, epoch in checks:
+                if not fence.admit(key, epoch):
+                    floor = fence.floor(key)
+                    return {"ok": False, "stale_epoch": True,
+                            "fence": key, "epoch": epoch, "floor": floor,
+                            "error": f"stale epoch {epoch} < fenced "
+                                     f"floor {floor} for '{key}'"}
+            return None
+
+        if op != "fence":
+            stale = _stale_reply()
+            if stale is not None:
+                return stale
         if op == "fence":
             # takeover broadcast: raise the floor for a (usually dead)
             # writer's identity so its surviving incarnation is rejected
@@ -249,6 +267,16 @@ class _Handler(socketserver.BaseRequestHandler):
                                   timeout_s=min(float(req.get("timeout_s",
                                                               0.0)), 30.0),
                                   partitions=owned, until=until)
+            if fence_key is not None or req.get("fences"):
+                # re-validate AFTER the poll: a takeover that raised the
+                # floor while this poll was in flight must not let the
+                # zombie's cursor advance stand — rewind to committed
+                # (idempotent with the successor's own seek) and reject,
+                # so no record is silently skipped past
+                stale = _stale_reply()
+                if stale is not None:
+                    consumer.seek_to_committed(partitions=owned)
+                    return stale
             return {"ok": True, "records": [
                 [r.partition, r.offset, r.key, r.value, r.timestamp_ms]
                 for r in batch]}
@@ -510,7 +538,8 @@ class BusClient:
              timeout_s: float = 0.0,
              until: Optional[dict] = None,
              commit_at: Optional[dict] = None,
-             partitions: Optional[List[int]] = None) -> List[Record]:
+             partitions: Optional[List[int]] = None,
+             fences: Optional[List] = None) -> List[Record]:
         req = {"op": "poll", "topic": topic, "group": group,
                "max": max_records, "timeout_s": timeout_s}
         if commit_at:
@@ -524,6 +553,14 @@ class BusClient:
             # assignment; the re-seek after a lost reply pins the same set
             req["partitions"] = [int(p) for p in partitions]
             pre_retry["partitions"] = [int(p) for p in partitions]
+        if fences:
+            # per-partition epoch stamps: a fenced-out caller bounces
+            # with stale_epoch instead of advancing the shared cursor;
+            # the lost-reply re-seek carries the same stamps so a
+            # zombie's retry cannot rewind a successor's partition
+            stamps = [[str(k), int(e)] for k, e in fences]
+            req["fences"] = stamps
+            pre_retry["fences"] = stamps
         resp = self._rpc(req, pre_retry=pre_retry)
         return [Record(topic, part, offset, key, value, ts)
                 for part, offset, key, value, ts in resp["records"]]
@@ -532,22 +569,28 @@ class BusClient:
         self._rpc({"op": "commit", "topic": topic, "group": group})
 
     def commit_at(self, topic: str, group: str, offsets: dict,
-                  partitions: Optional[List[int]] = None) -> None:
+                  partitions: Optional[List[int]] = None,
+                  fences: Optional[List] = None) -> None:
         """Commit explicit per-partition exclusive end offsets."""
         req = {"op": "commit_at", "topic": topic, "group": group,
                "offsets": {str(k): int(v) for k, v in offsets.items()}}
         if partitions is not None:
             req["partitions"] = [int(p) for p in partitions]
+        if fences:
+            req["fences"] = [[str(k), int(e)] for k, e in fences]
         self._rpc(req)
 
     def seek_committed(self, topic: str, group: str,
-                       partitions: Optional[List[int]] = None) -> None:
+                       partitions: Optional[List[int]] = None,
+                       fences: Optional[List] = None) -> None:
         req = {"op": "seek_committed", "topic": topic, "group": group}
         if partitions is not None:
             # pinned seek (feeders/): rewind ONLY the named partitions —
             # a lease takeover must re-read its predecessor's uncommitted
             # tail without disturbing other live feeders' cursors
             req["partitions"] = [int(p) for p in partitions]
+        if fences:
+            req["fences"] = [[str(k), int(e)] for k, e in fences]
         self._rpc(req)
 
     def end_offsets(self, topic: str) -> List[int]:

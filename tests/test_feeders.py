@@ -12,6 +12,8 @@ successor steals the lease at epoch+1) lives here too, marked
 chaos+slow like tests/test_chaos.py.
 """
 
+import threading
+
 import numpy as np
 import pytest
 
@@ -21,7 +23,8 @@ from sitewhere_tpu.model import Device, DeviceAssignment, DeviceType
 from sitewhere_tpu.pipeline.engine import PipelineEngine
 from sitewhere_tpu.registry import DeviceManagement, RegistryTensors
 from sitewhere_tpu.runtime.bus import EventBus
-from sitewhere_tpu.runtime.busnet import BusClient, BusServer
+from sitewhere_tpu.runtime.busnet import (BusClient, BusNetError, BusServer,
+                                          StaleEpochBusError)
 from sitewhere_tpu.runtime.faults import FaultPlan, FaultRule, arm, disarm
 from sitewhere_tpu.runtime.metrics import GLOBAL_METRICS
 from sitewhere_tpu.sources.fastlane import FastWireIngest
@@ -398,6 +401,228 @@ class TestEndToEndSharded:
                 "feeder.guard_spills").value > spills_before
             state = remote.get_device_state("d5")
             assert state is not None
+        finally:
+            lb.close()
+
+
+class _RefuseNth:
+    """Admission stub: refuse exactly the Nth admit() calls."""
+
+    def __init__(self, refuse):
+        self.calls = 0
+        self.refuse = set(refuse)
+
+    def admit(self):
+        self.calls += 1
+        return self.calls not in self.refuse
+
+
+class TestExactlyOnceHardening:
+    """Regression suite for the exactly-once race windows: the in-lock
+    watermark re-check, consume-side epoch fencing, the any-failure
+    rewind, per-chunk replay dedup, and the overlap verdict."""
+
+    def _blob_msg(self, lb, n_frames=20, extent=(0, 20), seed=5):
+        from sitewhere_tpu.feeders import protocol
+        from sitewhere_tpu.ops.pack import batch_to_blob
+
+        client = BusClient("127.0.0.1", lb.server.port)
+        replica = ReplicaPacker(client.call("feeder_hello"), client)
+        replica.sync()
+        batches, n, _ = replica.pack_bytes(_wire(_stream(n_frames, seed=seed)))
+        msg = protocol.blob_message(
+            batch_to_blob(batches[0]), n_events=n, partition=0, seq=1,
+            extent=extent, epoch=1)
+        return client, msg, n
+
+    def test_concurrent_duplicate_blobs_step_once(self, tmp_path):
+        """Two handler threads racing the SAME extent (a zombie's
+        in-flight blob vs the successor's replay): the in-lock watermark
+        re-check must let exactly one step — the pre-lock fast path
+        alone would admit both."""
+        remote = _world_single()
+        applied = []
+        lb = _Loopback(remote, tmp_path,
+                       on_outputs=lambda eng, outs, rec: applied.append(
+                           int(outs.processed)))
+        try:
+            c1, msg, n = self._blob_msg(lb)
+            c2 = BusClient("127.0.0.1", lb.server.port)
+            gate = threading.Barrier(2)
+            results = [None, None]
+
+            def ship(idx, client):
+                gate.wait()
+                results[idx] = client.call("feeder_blob",
+                                           **dict(msg, seq=idx + 1))
+
+            threads = [threading.Thread(target=ship, args=(i, c))
+                       for i, c in enumerate((c1, c2))]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=30.0)
+            assert all(r is not None for r in results)
+            assert sum(r["events"] for r in results) == n
+            assert len([r for r in results if r.get("dup")]) == 1
+            assert sum(applied) == n
+            assert lb.service.watermark(0) == 20
+            c1.close()
+            c2.close()
+        finally:
+            lb.close()
+
+    def test_replay_dup_beats_shed_and_reports_real_suppression(
+            self, tmp_path):
+        """A replayed extent on an OVERLOADED mesh host must dedupe, not
+        429 (a shed replay would re-ship forever without converging);
+        and `suppressed` reports what the barrier actually took — zero
+        when disarmed — not a fabricated n_events."""
+        from sitewhere_tpu.runtime.recovery import GLOBAL_REPLAY_BARRIER
+        from sitewhere_tpu.sources.manager import AdmissionController
+
+        GLOBAL_REPLAY_BARRIER.disarm()
+        remote = _world_single()
+        admission = AdmissionController(queue_depth_budget=0,
+                                        queue_depth=lambda: 100,
+                                        check_every=1)
+        lb = _Loopback(remote, tmp_path, admission=admission)
+        try:
+            client, msg, n = self._blob_msg(lb)
+            first = client.call("feeder_blob", **msg)
+            assert first["events"] == n
+            admission.configure(queue_depth_budget=1)  # now shedding
+            again = client.call("feeder_blob", **dict(msg, seq=2))
+            assert again["dup"] and not again.get("shed")
+            assert again["suppressed"] == 0
+            client.close()
+        finally:
+            lb.close()
+
+    def test_consume_ops_fenced_poll_cannot_move_cursor(self, tmp_path):
+        """poll/commit_at/seek_committed stamped with a stale partition
+        fence bounce BEFORE the shared server-side cursor moves — the
+        loss window where a fenced zombie's poll skips records the
+        successor then never sees."""
+        from sitewhere_tpu.feeders import protocol
+
+        remote = _world_single()
+        lb = _Loopback(remote, tmp_path, partitions=1)
+        try:
+            lb.publish(_stream(12, seed=14))
+            client = BusClient("127.0.0.1", lb.server.port)
+            key = protocol.feeder_fence_key(0)
+            lb.server.fence.fence(key, 2)  # the takeover broadcast
+            with pytest.raises(StaleEpochBusError):
+                client.poll("frames", protocol.FEEDER_GROUP,
+                            partitions=[0], timeout_s=0.05,
+                            fences=[[key, 1]])
+            with pytest.raises(StaleEpochBusError):
+                client.commit_at("frames", protocol.FEEDER_GROUP, {0: 5},
+                                 partitions=[0], fences=[[key, 1]])
+            with pytest.raises(StaleEpochBusError):
+                client.seek_committed("frames", protocol.FEEDER_GROUP,
+                                      partitions=[0], fences=[[key, 1]])
+            # nothing moved: the successor polls every record
+            recs = client.poll("frames", protocol.FEEDER_GROUP,
+                               partitions=[0], timeout_s=0.5,
+                               fences=[[key, 2]])
+            assert len(recs) == 12
+            client.close()
+        finally:
+            lb.close()
+
+    def test_transport_error_mid_cycle_rewinds_and_redelivers(
+            self, tmp_path):
+        """A raw transport failure mid-ship (not shed, not fenced) must
+        take the same commit+rewind exit as stopped_early: without it,
+        the polled-but-unshipped records sit past the server-side cursor
+        forever and the stream silently loses them."""
+        from sitewhere_tpu.feeders import protocol
+
+        remote = _world_single(batch_size=16)
+        applied = []
+        lb = _Loopback(remote, tmp_path, partitions=1,
+                       on_outputs=lambda eng, outs, rec: applied.append(
+                           int(outs.processed)))
+        try:
+            n_events = 80
+            lb.publish(_stream(n_events, seed=21))
+            w = lb.worker()
+            w.connect()
+            real_call = w.client.call
+            state = {"failed": False}
+
+            def flaky(op, **fields):
+                if op == protocol.OP_BLOB and not state["failed"]:
+                    state["failed"] = True
+                    raise BusNetError("injected transport failure")
+                return real_call(op, **fields)
+
+            w.client.call = flaky
+            with pytest.raises(BusNetError):
+                w.run_once(timeout_s=0.05)
+            # the rewound records redeliver and apply exactly once
+            assert _drain(w) == n_events
+            w.stop()
+            assert sum(applied) == n_events
+        finally:
+            lb.close()
+
+    def test_chunked_record_shed_replay_no_duplicates(self, tmp_path):
+        """A record too large for one batch ships as chunks; shedding a
+        LATER chunk (routine overload, not a crash) replays the whole
+        record — the per-chunk sub-extent marks must dedupe the already-
+        applied chunks instead of double-stepping them."""
+        remote = _world_single(batch_size=16)
+        applied = []
+        admission = _RefuseNth({2})  # shed exactly the second chunk
+        lb = _Loopback(remote, tmp_path, partitions=1,
+                       admission=admission,
+                       on_outputs=lambda eng, outs, rec: applied.append(
+                           int(outs.processed)))
+        try:
+            # ONE bus record holding 40 events: packs into chunks of
+            # 16 + 16 + 8 against the batch-16 engine
+            frames = _stream(40, seed=23)
+            lb.bus.publish("frames", b"oversized",
+                           b"".join(f for _, f in frames))
+            replay_before = GLOBAL_METRICS.counter(
+                "feeder.replay_dropped").value
+            w = lb.worker(shed_backoff_s=0.0)
+            assert _drain(w) == 40
+            w.stop()
+            assert sum(applied) == 40  # chunk 0 stepped exactly once
+            assert GLOBAL_METRICS.counter(
+                "feeder.replay_dropped").value > replay_before
+            assert lb.service.watermark(0) == 1
+        finally:
+            lb.close()
+
+    def test_overlap_extent_refused_and_skipped(self, tmp_path):
+        """An extent straddling the watermark (regrouped replay after
+        new records widened the greedy group boundary) is refused with
+        the overlap verdict; the feeder advances its commit to the
+        watermark and re-ships only the unapplied suffix."""
+        remote = _world_single()
+        applied = []
+        lb = _Loopback(remote, tmp_path, partitions=1,
+                       on_outputs=lambda eng, outs, rec: applied.append(
+                           int(outs.processed)))
+        try:
+            lb.publish(_stream(20, seed=13))
+            # a predecessor applied offsets [0, 15) without committing
+            # (its effects happened before this service's on_outputs)
+            lb.service._watermarks[0] = 15
+            overlap_before = GLOBAL_METRICS.counter(
+                "feeder.extent_overlap").value
+            w = lb.worker()
+            assert _drain(w) == 5
+            w.stop()
+            assert GLOBAL_METRICS.counter(
+                "feeder.extent_overlap").value > overlap_before
+            assert sum(applied) == 5  # only the unapplied suffix stepped
+            assert lb.service.watermark(0) == 20
         finally:
             lb.close()
 
