@@ -155,9 +155,60 @@ def _compact_result(result: Dict, detail_path) -> Dict:
             name for cmp in (gate.get("vs_recorded") or {}).values()
             for name in cmp.get("failures", [])}),
     }
+    # checks that passed ONLY via a degraded-link waiver ride the line by
+    # name (the waiver objects themselves live in the sidecar) so a
+    # recorded round shows mechanically why ok held
+    waived = sorted(
+        name for name, c in (consistency.get("checks") or {}).items()
+        if isinstance(c, dict) and "link_waived" in c)
+    if waived:
+        out["perf_gate"]["link_waived_checks"] = waived
     if detail_path:
         out["detail"] = os.path.basename(detail_path)
     return out
+
+
+# trim order when the compact line outgrows the tail budget: least
+# gate-critical first (everything dropped here still lives verbatim in
+# the BENCH_DETAIL sidecar). The essentials — metric/value/scale/device,
+# the three offload speedup blocks, perf_gate — go last and in practice
+# never trim.
+_TRIM_ORDER = (
+    "spread_worst", "latency_mode", "fencing", "faults", "flight",
+    "feeder_fleet", "step_breakdown", "telemetry_overhead_pct",
+    "telemetry_packed_events_per_sec", "persist_events_per_sec",
+    "query_10m_narrow_window_ms", "multitenant_sharded_events_per_sec",
+    "latency_mode_trial_p99_ms", "latency_fetch",
+    "materialize_lane_speedup_x", "sharded_from_bytes_events_per_sec",
+    "age_p99_ms", "latency_mode_p50_ms", "latency_mode_p99_ms",
+    "p99_rule_eval_ms", "p50_step_ms", "p99_step_ms",
+    "link_probe_pre", "vs_baseline", "failed_checks", "drift_failures",
+)
+
+
+def _fit_result_line(compact: Dict) -> str:
+    """Serialize the compact result, trimming lowest-priority keys until
+    the line fits the driver's tail-capture budget. The line must ALWAYS
+    print (and print last) — a crash here is how round 5's numbers were
+    lost — so this never raises; the sidecar keeps everything trimmed."""
+    line = json.dumps(compact, separators=(",", ":"))
+    for key in _TRIM_ORDER:
+        if len(line) <= MAX_RESULT_LINE_BYTES:
+            return line
+        if key in compact:
+            compact.pop(key, None)
+            pg = compact.get("perf_gate")
+            if isinstance(pg, dict):
+                pg.setdefault("trimmed", []).append(key)
+            line = json.dumps(compact, separators=(",", ":"))
+    if len(line) > MAX_RESULT_LINE_BYTES:
+        # last resort: the irreducible core still parses
+        core = {k: compact[k] for k in (
+            "metric", "value", "unit", "scale", "device", "detail")
+            if k in compact}
+        core["trimmed"] = "overflow"
+        line = json.dumps(core, separators=(",", ":"))
+    return line
 
 
 def main() -> None:
@@ -244,10 +295,7 @@ def main() -> None:
               "was NOT checked this run", file=sys.stderr)
     sys.stderr.flush()
     compact = _compact_result(result, detail_path)
-    line = json.dumps(compact, separators=(",", ":"))
-    assert len(line) <= MAX_RESULT_LINE_BYTES, (
-        f"result line {len(line)} bytes > {MAX_RESULT_LINE_BYTES}: trim "
-        f"_compact_result, the driver tail capture would truncate it")
+    line = _fit_result_line(compact)
     print(line)
     sys.stdout.flush()
     if not gate["ok"] and os.environ.get("BENCH_GATE_STRICT") == "1":
@@ -1060,6 +1108,29 @@ def _host_rule_processor_rate(ctx) -> float:
     return len(events) / dt if dt else 0.0
 
 
+def _settled_step_seconds(engine, pool, steps: int) -> float:
+    """Median per-step seconds for the routed submit + alert
+    materialization, under the settled discipline `_t_sharded`'s router
+    section established after r05's steal-spike drift: gc.collect first
+    (so the timed loop never pays a collection another section armed),
+    one unmeasured settling step after the section switch (re-warms the
+    allocator/page caches the previous section evicted), then the MEDIAN
+    of per-step samples — a single host-CPU steal spike lands in one
+    sample instead of multiplying the mean."""
+    import gc
+
+    gc.collect()
+    rb, ro = engine.submit_routed(pool[0])   # settling pass, unmeasured
+    engine.materialize_alerts(rb, ro)
+    samples: List[float] = []
+    for i in range(steps):
+        t0 = time.perf_counter()
+        rb, ro = engine.submit_routed(pool[i % len(pool)])
+        engine.materialize_alerts(rb, ro)    # lane fetch syncs the step
+        samples.append(time.perf_counter() - t0)
+    return _median(samples)
+
+
 def _t_rule_programs(jax, ctx) -> Dict:
     """Rule-program tier, three measurements on the same traffic:
 
@@ -1073,38 +1144,35 @@ def _t_rule_programs(jax, ctx) -> Dict:
        host);
     3. the host RuleProcessor dispatch path evaluating the same logic
        per event. speedup = host per-event cost / marginal in-step cost.
+
+    Timing discipline is the settled one `_t_sharded`'s router section
+    uses (gc.collect, one unmeasured settling pass, median of
+    per-iteration samples): the marginal cost is a DIFFERENCE of two
+    loops, so a single host-CPU steal spike in either loop used to land
+    directly in the speedup. The median absorbs it.
     """
     engine, base, pool = ctx["rp_engine"], ctx["rp_base"], ctx["rp_pool"]
     steps = ctx["STEPS"]
-    rb, ro = engine.submit_routed(pool[0])   # unmeasured re-warm
-    engine.materialize_alerts(rb, ro)
     f0 = engine.d2h_fetches
-    t0 = time.perf_counter()
-    for i in range(steps):
-        rb, ro = engine.submit_routed(pool[i % len(pool)])
-        engine.materialize_alerts(rb, ro)    # lane fetch syncs the step
-    with_s = time.perf_counter() - t0
-    compiled = steps * engine.batch_size / with_s
+    with_s = _settled_step_seconds(engine, pool, steps)
+    compiled = engine.batch_size / with_s if with_s else 0.0
     # baseline: identical engine, no programs, same batches and the same
     # materialize leg (adjacent in the same trial so both loops see the
     # same host/link state — the difference isolates the program stage)
-    rb2, ob = base.submit_routed(pool[0])
-    base.materialize_alerts(rb2, ob)
-    t0 = time.perf_counter()
-    for i in range(steps):
-        rb2, ob = base.submit_routed(pool[i % len(pool)])
-        base.materialize_alerts(rb2, ob)
-    base_s = time.perf_counter() - t0
-    events = steps * engine.batch_size
-    marginal_us = max(with_s - base_s, 1e-9) / events * 1e6
+    base_s = _settled_step_seconds(base, pool, steps)
+    # per-step medians over per-step events: the difference is the
+    # marginal cost of the program stage for one step's batch
+    marginal_us = max(with_s - base_s, 1e-9) / engine.batch_size * 1e6
     host_rate = _host_rule_processor_rate(ctx)
     host_us = 1e6 / host_rate if host_rate else 0.0
     return {"events_per_sec": compiled,
             "host_events_per_sec": host_rate,
             "marginal_us_per_event": marginal_us,
             "host_us_per_event": host_us,
+            # the settling pass offers+fetches too: steps+1 of each,
+            # ratio still pinned at exactly 1
             "d2h_fetches": engine.d2h_fetches - f0,
-            "offers": steps}
+            "offers": steps + 1}
 
 
 def _bench_models():
@@ -1173,24 +1241,15 @@ def _t_anomaly_models(jax, ctx) -> Dict:
     the host-side per-event scoring loop the stage replaces."""
     engine, base, pool = ctx["am_engine"], ctx["am_base"], ctx["rp_pool"]
     steps = ctx["STEPS"]
-    rb, ro = engine.submit_routed(pool[0])   # unmeasured re-warm
-    engine.materialize_alerts(rb, ro)
     f0 = engine.d2h_fetches
-    t0 = time.perf_counter()
-    for i in range(steps):
-        rb, ro = engine.submit_routed(pool[i % len(pool)])
-        engine.materialize_alerts(rb, ro)    # lane fetch syncs the step
-    with_s = time.perf_counter() - t0
-    scored = steps * engine.batch_size / with_s
-    rb2, bo = base.submit_routed(pool[0])
-    base.materialize_alerts(rb2, bo)
-    t0 = time.perf_counter()
-    for i in range(steps):
-        rb2, bo = base.submit_routed(pool[i % len(pool)])
-        base.materialize_alerts(rb2, bo)
-    base_s = time.perf_counter() - t0
-    events = steps * engine.batch_size
-    marginal_us = max(with_s - base_s, 1e-9) / events * 1e6
+    # settled per-step medians (gc.collect, settling pass, median of
+    # per-step samples — _settled_step_seconds), same discipline as the
+    # rule-program tier: the <10% marginal gate is a difference of two
+    # loops and a steal spike in either used to land in it whole
+    with_s = _settled_step_seconds(engine, pool, steps)
+    scored = engine.batch_size / with_s if with_s else 0.0
+    base_s = _settled_step_seconds(base, pool, steps)
+    marginal_us = max(with_s - base_s, 1e-9) / engine.batch_size * 1e6
     host_rate = _host_model_scorer_rate(ctx)
     host_us = 1e6 / host_rate if host_rate else 0.0
     return {"events_per_sec": scored,
@@ -1199,8 +1258,9 @@ def _t_anomaly_models(jax, ctx) -> Dict:
             "marginal_step_pct": (max(with_s - base_s, 0.0) / base_s
                                   * 100 if base_s else 0.0),
             "host_us_per_event": host_us,
+            # settling pass included on both sides of the ratio
             "d2h_fetches": engine.d2h_fetches - f0,
-            "offers": steps}
+            "offers": steps + 1}
 
 
 def _t_persist(jax, ctx) -> Dict:
@@ -1423,7 +1483,16 @@ def _build_sharded(jax, ctx) -> None:
     parity = (len(over) == 0 and np.array_equal(
         np.asarray(jax.device_get(dev_routed)), np.asarray(host_routed)))
     eng1.router.release_staging_buffer(host_routed)
-    reps = 3 if small else 10
+    # settled median-of-5 (the _t_sharded router discipline): gc.collect
+    # plus one unmeasured settling pass per path so neither side pays
+    # the other's allocator evictions, median so one steal spike cannot
+    # multiply the speedup ratio
+    import gc
+    reps = 5
+    gc.collect()
+    hb, _ = eng1.router.route_batch(pool[0])   # settling pass, unmeasured
+    jax.device_put(hb, shard_spec).block_until_ready()
+    eng1.router.release_staging_buffer(hb)
     host_s: List[float] = []
     for _ in range(reps):
         t0 = time.perf_counter()
@@ -1436,6 +1505,10 @@ def _build_sharded(jax, ctx) -> None:
     # H2D consumed the buffer before the next pack overwrites it
     from sitewhere_tpu.ops.pack import WIRE_ROWS
     flat_buf = np.empty((WIRE_ROWS, BATCH), np.int32)
+    gc.collect()
+    flat = batch_to_blob(pool[0], out=flat_buf)  # settling pass, unmeasured
+    routed, _ = prog(jax.device_put(flat, flat_spec))
+    jax.block_until_ready(routed)
     dev_s: List[float] = []
     for _ in range(reps):
         t0 = time.perf_counter()

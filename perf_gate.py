@@ -13,6 +13,11 @@ Absolute drift hard-fails only between runs on the SAME hardware
 host-CPU timing fingerprints (`host_argsort_1m_ms`) are also comparable —
 VM CPU steal moves host absolutes 4x on unchanged code (docs/PERF.md) —
 and is otherwise reported as advisory with the reason in the verdict.
+Link-sensitive checks (latency/age budgets, H2D overlap, the offload
+speedup bounds) consume the same probe the bench records: on a degraded
+H2D link (below MIN_LINK_H2D_MBPS) a miss becomes a structured
+`link_waived` verdict object with the probe attached instead of a hard
+FAIL, so `ok` keeps meaning "the code regressed".
 
 One anomalous round must not poison the gate forever, so a current run
 passes if its ratios are within tolerance of EITHER of the two most recent
@@ -93,6 +98,17 @@ DEFAULT_ABS_TOL = float(os.environ.get("BENCH_GATE_ABS_TOL", "0.35"))
 # 1.25 keeps +25% of pure CPU steal below the 35% hard-fail line.
 HOST_STATE_RATIO_BOUND = 1.25
 
+# Degraded-link threshold for the H2D probe the bench already records
+# (link_probe_pre/post h2d_4mb_mbps_last): the tunnel's sustained floor
+# has been observed from 9 MB/s to 1.4 GB/s on the SAME code and day.
+# Below this, every round trip in the link-sensitive checks (latency/age
+# budgets, overlap, the offload speedup micro-benches whose finish line
+# is a device_put) is measuring tunnel weather, not code health — those
+# checks then return a structured `link_waived` verdict object with the
+# probe attached instead of a hard FAIL, so perf_gate.ok keeps meaning
+# "the code regressed", and the waiver is mechanically auditable.
+MIN_LINK_H2D_MBPS = 100.0
+
 # intra-run self-consistency: the step_breakdown's parts must explain the
 # synchronous step total (VERDICT r4: 16.7 ms total vs 3.1 ms of parts)
 MAX_UNACCOUNTED_PCT = 25.0
@@ -107,11 +123,12 @@ LATENCY_BUDGET_MS = 10.0
 
 # On-device shard routing (ops/route.py): the routed blob the mesh
 # produces must be bit-identical to the host arena router's (any host —
-# parity is a workload fact), and at full scale the device route must at
-# least match the host arena route it replaces. Advisory on the
-# BENCH_SCALE=small smoke for the same reason rule-program speedups are:
-# a 1-core CPU host measures XLA-vs-native-C++ dispatch, not the
-# workload.
+# parity is a workload fact, hard everywhere), and the device route must
+# at least match the host arena route it replaces at EVERY scale — the
+# sort-based bucketing rewrite removed the O(B*S) one-hot work that made
+# small batches lose, so the claim now gates on every
+# accelerator-fingerprinted run. On a CPU-only host the ratio measures
+# XLA-vs-native-C++ dispatch, not the workload: advisory there.
 MIN_ROUTER_OFFLOAD_SPEEDUP = 1.0
 
 # Device-compacted alert lanes pin the latency tier's materialize path to
@@ -123,12 +140,13 @@ ALERT_LANE_BYTES_PER_SLOT = 16
 
 # Compiled rule programs must at least match the host-side per-event
 # RuleProcessor dispatch path they replace (marginal in-step cost per
-# event vs host cost per event). Judged at FULL scale only: the claim is
-# about the accelerator deployment, and on a 1-core CI smoke host the
-# comparison measures XLA-vs-Python dispatch overhead, not the workload
-# — the same reasoning that makes host absolutes advisory across
-# non-comparable hosts. The smoke still records the number (advisory)
-# and always gates the fetch budget.
+# event vs host cost per event) at EVERY scale: the fused state slabs +
+# segment-fold gather rewrite (ops/stateful.py) removed the per-row
+# one-hot HBM round trips that made small batches lose, so small scale
+# is no longer excused. On a CPU-only host the comparison measures
+# XLA-vs-Python dispatch overhead, not the workload — advisory there,
+# same reasoning that makes host absolutes advisory across
+# non-comparable hosts. Every host always gates the fetch budget.
 MIN_RULE_PROGRAM_SPEEDUP = 1.0
 
 # Compiled anomaly models (ml/compiler.py scoring inside the fused
@@ -138,8 +156,10 @@ MIN_RULE_PROGRAM_SPEEDUP = 1.0
 # The scoring stage's marginal step cost must stay under 10% of the
 # model-free step, and its marginal per-event cost must at least match
 # the host-side per-event scoring loop it replaces — both judged at
-# FULL scale only (on a 1-core cpu smoke they measure XLA-vs-Python
-# dispatch, not the workload; same policy as rule_programs).
+# EVERY scale on accelerator-fingerprinted hosts (the slab rewrite in
+# ops/anomaly.py makes the small-batch claim winnable), advisory on
+# CPU-only hosts (XLA-vs-Python dispatch, not the workload; same
+# policy as rule_programs).
 MIN_ANOMALY_MODEL_SPEEDUP = 1.0
 MAX_ANOMALY_MODEL_MARGINAL_PCT = 10.0
 
@@ -228,6 +248,39 @@ def extract_bench(doc: Dict) -> Optional[Dict]:
                 if isinstance(cand, dict) and "value" in cand:
                     return cand
     return None
+
+
+def link_state(bench: Dict) -> Dict:
+    """Degraded-link verdict from the run's own probes: worst
+    h2d_4mb_mbps_last across link_probe_pre/post (the compact line may
+    carry only the pre probe; the sidecar has both) against
+    MIN_LINK_H2D_MBPS. Runs recorded before the probe existed are never
+    'degraded' — absence of evidence keeps the checks hard."""
+    probes: Dict[str, float] = {}
+    worst: Optional[float] = None
+    for key in ("link_probe_pre", "link_probe_post"):
+        probe = bench.get(key)
+        if isinstance(probe, dict):
+            v = probe.get("h2d_4mb_mbps_last")
+            if isinstance(v, (int, float)) and v > 0:
+                probes[key] = v
+                worst = v if worst is None else min(worst, v)
+    return {"degraded": worst is not None and worst < MIN_LINK_H2D_MBPS,
+            "h2d_4mb_mbps": probes,
+            "threshold_mbps": MIN_LINK_H2D_MBPS}
+
+
+def _link_waiver(link: Dict, what: str) -> Dict:
+    """The structured link_waived object: what was waived, why, and the
+    probe evidence — everything a reader needs to adjudicate the waiver
+    without the run's shell logs."""
+    return {"waived": "link_degraded",
+            "what": what,
+            "reason": (f"H2D probe below {MIN_LINK_H2D_MBPS} MB/s — the "
+                       "check measures tunnel weather on this link, not "
+                       "code health"),
+            "h2d_4mb_mbps": link["h2d_4mb_mbps"],
+            "threshold_mbps": MIN_LINK_H2D_MBPS}
 
 
 def ratios_of(bench: Dict) -> Dict[str, float]:
@@ -320,16 +373,31 @@ def compare(prev_bench: Dict, cur_bench: Dict, tol: float = DEFAULT_TOL,
                      f"host CPU state mismatch (argsort {prev_fp} -> "
                      f"{cur_fp} ms); host-absolute drift is advisory")
 
+    # A degraded tunnel is whole-VM I/O weather: the same runs that show
+    # it also show host-absolute swings on unchanged code, so absolute
+    # drift between a degraded run and anything else carries a
+    # structured waiver instead of hard-failing (satellite: perf_gate
+    # consumes the link probe it records).
+    prev_link, cur_link = link_state(prev_bench), link_state(cur_bench)
+    link_waived = None
+    if prev_link["degraded"] or cur_link["degraded"]:
+        which = ("baseline" if prev_link["degraded"] else "current") \
+            if prev_link["degraded"] != cur_link["degraded"] else "both"
+        link_waived = _link_waiver(
+            cur_link if cur_link["degraded"] else prev_link,
+            f"host-absolute drift vs a degraded-link run ({which})")
     ratios = drifts(ratios_of(prev_bench), ratios_of(cur_bench), tol)
     absolutes = drifts(
         {k: prev_bench[k] for k in ABS_KEYS
          if isinstance(prev_bench.get(k), (int, float))},
         {k: cur_bench[k] for k in ABS_KEYS
          if isinstance(cur_bench.get(k), (int, float))}, abs_tol,
-        gated=host_comparable)
+        gated=host_comparable and link_waived is None)
     out = {"ok": not failures, "tol": tol, "abs_tol": abs_tol,
            "ratios": ratios, "absolutes": absolutes,
            "failures": failures}
+    if link_waived:
+        out["link_waived"] = link_waived
     if host_note:
         out["absolutes_advisory"] = host_note
     return out
@@ -360,6 +428,7 @@ def self_consistency(bench: Dict) -> Dict:
     # path must meet the budget too, or CI cannot vouch for the tier.
     trial_p99 = bench.get("latency_mode_trial_p99_ms")
     cpu_host = "cpu" in str(bench.get("device") or "").lower()
+    link = link_state(bench)
     if isinstance(trial_p99, list):
         numeric = [v for v in trial_p99 if isinstance(v, (int, float))]
         if numeric:
@@ -374,6 +443,12 @@ def self_consistency(bench: Dict) -> Dict:
                     "over budget on a CPU-only bench host (advisory; the "
                     "10 ms p99 is a TPU target and gates only "
                     "accelerator-fingerprinted runs)")
+            elif not met and link["degraded"]:
+                # every offer in the tier rides the degraded tunnel once
+                # per round trip — budget misses there are link weather
+                entry["ok"] = True
+                entry["link_waived"] = _link_waiver(
+                    link, "end-to-end latency budget missed")
             checks["latency_budget_met"] = entry
     # Fetch budget: the latency tier's materialize path must perform
     # exactly 1 fixed-shape D2H fetch per offer, bytes bounded by the
@@ -405,14 +480,20 @@ def self_consistency(bench: Dict) -> Dict:
                for v in (rp_fpo, rp_speedup)):
             speedup_ok = rp_speedup >= MIN_RULE_PROGRAM_SPEEDUP
             entry = {
-                "ok": rp_fpo == 1 and (speedup_ok or small),
+                "ok": rp_fpo == 1 and (speedup_ok or cpu_host),
                 "d2h_fetches_per_offer": rp_fpo,
                 "compiled_vs_host_speedup_x": rp_speedup,
                 "min_speedup_x": MIN_RULE_PROGRAM_SPEEDUP}
-            if small and not speedup_ok:
+            if cpu_host and not speedup_ok:
                 entry["speedup_advisory"] = (
-                    "below bound on the cpu smoke host (advisory; the "
-                    "bound gates at full scale)")
+                    "below bound on a CPU-only bench host (advisory; "
+                    "XLA-vs-native-dispatch, not the workload — the "
+                    "bound gates accelerator-fingerprinted runs at "
+                    "every scale)")
+            elif not speedup_ok and link["degraded"]:
+                entry["ok"] = rp_fpo == 1
+                entry["link_waived"] = _link_waiver(
+                    link, "rule-program offload speedup below bound")
             checks["rule_programs"] = entry
     # Anomaly-model budget: with compiled models scoring every tick in
     # the fused step, alert delivery must still be exactly 1 fixed-shape
@@ -430,16 +511,22 @@ def self_consistency(bench: Dict) -> Dict:
             cost_ok = (am_speedup >= MIN_ANOMALY_MODEL_SPEEDUP
                        and am_marginal < MAX_ANOMALY_MODEL_MARGINAL_PCT)
             entry = {
-                "ok": am_fpo == 1 and (cost_ok or small),
+                "ok": am_fpo == 1 and (cost_ok or cpu_host),
                 "d2h_fetches_per_offer": am_fpo,
                 "offload_speedup_x": am_speedup,
                 "marginal_step_pct": am_marginal,
                 "min_speedup_x": MIN_ANOMALY_MODEL_SPEEDUP,
                 "max_marginal_step_pct": MAX_ANOMALY_MODEL_MARGINAL_PCT}
-            if small and not cost_ok:
+            if cpu_host and not cost_ok:
                 entry["cost_advisory"] = (
-                    "below bound on the cpu smoke host (advisory; the "
-                    "cost bounds gate at full scale)")
+                    "below bound on a CPU-only bench host (advisory; "
+                    "XLA-vs-Python-dispatch, not the workload — the "
+                    "bounds gate accelerator-fingerprinted runs at "
+                    "every scale)")
+            elif not cost_ok and link["degraded"]:
+                entry["ok"] = am_fpo == 1
+                entry["link_waived"] = _link_waiver(
+                    link, "anomaly-model offload cost bounds missed")
             checks["anomaly_models"] = entry
     # Device routing: the on-device route's output must be bit-identical
     # to the host arena router's (parity_ok — a workload fact on any
@@ -453,14 +540,22 @@ def self_consistency(bench: Dict) -> Dict:
         if dr_parity is not None and isinstance(dr_speedup, (int, float)):
             dr_speedup_ok = dr_speedup >= MIN_ROUTER_OFFLOAD_SPEEDUP
             entry = {
-                "ok": bool(dr_parity) and (dr_speedup_ok or small),
+                "ok": bool(dr_parity) and (dr_speedup_ok or cpu_host),
                 "parity_ok": bool(dr_parity),
                 "router_offload_speedup_x": dr_speedup,
                 "min_speedup_x": MIN_ROUTER_OFFLOAD_SPEEDUP}
-            if small and not dr_speedup_ok:
+            if cpu_host and not dr_speedup_ok:
                 entry["speedup_advisory"] = (
-                    "below bound on the cpu smoke host (advisory; the "
-                    "bound gates at full scale)")
+                    "below bound on a CPU-only bench host (advisory; "
+                    "XLA-vs-native-C++-dispatch, not the workload — "
+                    "the bound gates accelerator-fingerprinted runs "
+                    "at every scale)")
+            elif not dr_speedup_ok and link["degraded"]:
+                # parity stays HARD: bit-identity is a workload fact on
+                # any link; only the timing ratio rides the tunnel
+                entry["ok"] = bool(dr_parity)
+                entry["link_waived"] = _link_waiver(
+                    link, "router offload speedup below bound")
             checks["device_routing"] = entry
     # Observability overhead: the always-on flight recorder's per-step
     # self-cost must stay under 1% of the synchronous step time (full
@@ -512,6 +607,10 @@ def self_consistency(bench: Dict) -> Dict:
                 "freshness target on a CPU-only bench host (advisory; "
                 "the budget is a TPU target and gates only "
                 "accelerator-fingerprinted runs)")
+        elif not age_ok and link["degraded"]:
+            entry["ok"] = True
+            entry["link_waived"] = _link_waiver(
+                link, "ingest->materialize age budget missed")
         checks["age_p99_budget_ms"] = entry
     # H2D overlap: the staging ring must actually overlap — most of the
     # staging-side work under the previous dispatch window, and dispatch
@@ -538,6 +637,10 @@ def self_consistency(bench: Dict) -> Dict:
                     "synchronously, so there is no dispatch window to "
                     "overlap — the bound gates accelerator-"
                     "fingerprinted full-scale runs)")
+            elif not met and link["degraded"]:
+                entry["ok"] = True
+                entry["link_waived"] = _link_waiver(
+                    link, "H2D overlap fraction under bound")
             checks["h2d_overlap"] = entry
     # Fault-injection overhead: disarmed fault points + the admission
     # check must stay under 0.5% of the step wall (full scale; advisory
@@ -652,6 +755,7 @@ def gate_against_recorded(cur_bench: Dict, root: str = ".",
     # files), not that drift was checked and passed. Callers surface it.
     return {"ok": bool(consistency["ok"] and ratio_ok),
             "compared": compared,
+            "link": link_state(cur_bench),
             "self_consistency": consistency,
             "vs_recorded": comparisons}
 

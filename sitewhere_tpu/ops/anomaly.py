@@ -7,9 +7,10 @@ the same feature semantics the rule-program predicates use
 (ops/stateful.py), pinned by the same kind of NumPy oracle
 (tests/test_anomaly_models.py).
 
-Work scales with the BATCH, not the device capacity: feature state rows
-gather per batch row from the [D, P, F] HBM tensors and scatter back
-from each device's ATTACH row (its last tracked-measurement row this
+Work scales with the BATCH, not the device capacity: each batch row's
+whole feature-state record gathers with one contiguous read from the
+fused i32 slab [D, P, 4*F+2] (ops/stateful.py lane layout) and scatters
+back from each device's ATTACH row (its last tracked-measurement row this
 step — a unique writer, so the scatter is deterministic like every
 other fold here). The model forward pass is a static unroll over the
 layer bucket: one [P, H, H] einsum per layer over every (row, model)
@@ -44,12 +45,14 @@ from __future__ import annotations
 
 from typing import Dict, Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 from flax import struct
 
 from sitewhere_tpu.ml.compiler import AnomalyModelTable, FeatureKind, \
     ModelKind
+from sitewhere_tpu.ops.slab import _slab_f32, _slab_i32, state_slab_lanes
 
 _NEG = -(2 ** 31)
 
@@ -60,6 +63,12 @@ class ModelStateTensors:
     RuleStateTensors (sharded engines carry a leading shard axis on
     every field).
 
+    All per-device state lives in ONE fused i32 slab [D, P, 4*F+2] with
+    the same lane layout as the rule-state slab (value/aux bits, ts,
+    counter planes, then the score_prev bit and the row generation), so
+    a step gathers a device's whole scoring record with one contiguous
+    HBM read.
+
     The (value, aux, ts, counter) quad is one uniform record per
     feature slot:
       VALUE  unused (the post-fold last measurement IS the state)
@@ -68,12 +77,7 @@ class ModelStateTensors:
              ts = prev observation ts, counter = observation count
     """
 
-    value: jnp.ndarray       # f32 [D, P, F]
-    aux: jnp.ndarray         # f32 [D, P, F]
-    ts: jnp.ndarray          # i32 [D, P, F]
-    counter: jnp.ndarray     # i32 [D, P, F]
-    score_prev: jnp.ndarray  # bool [D, P] above-threshold at last score
-    row_gen: jnp.ndarray     # i32 [D, P] per-row state generation
+    slab: jnp.ndarray        # i32 [D, P, 4*F+2] fused per-device state
     gen: jnp.ndarray         # i32 [P] counter-row generation
     fire_count: jnp.ndarray  # i32 [P] cumulative fires
     eval_count: jnp.ndarray  # i32 [P] cumulative scored ticks
@@ -84,7 +88,7 @@ class ModelStateTensors:
 
     @property
     def num_features(self) -> int:
-        return self.value.shape[-1]
+        return (self.slab.shape[-1] - 2) // 4
 
 
 def init_model_state_np(max_devices: int, max_models: int,
@@ -93,13 +97,10 @@ def init_model_state_np(max_devices: int, max_models: int,
     no device buffers, so sharded engines place the tree with ONE
     device_put on their mesh)."""
     D, P, F = max_devices, max_models, max_features
+    slab = np.zeros((D, P, state_slab_lanes(F)), np.int32)
+    slab[:, :, 2 * F:3 * F] = _NEG   # ts plane; zero bits are 0.0f elsewhere
     return ModelStateTensors(
-        value=np.zeros((D, P, F), np.float32),
-        aux=np.zeros((D, P, F), np.float32),
-        ts=np.full((D, P, F), _NEG, np.int32),
-        counter=np.zeros((D, P, F), np.int32),
-        score_prev=np.zeros((D, P), bool),
-        row_gen=np.zeros((D, P), np.int32),
+        slab=slab,
         gen=np.zeros((P,), np.int32),
         fire_count=np.zeros((P,), np.int32),
         eval_count=np.zeros((P,), np.int32),
@@ -108,8 +109,6 @@ def init_model_state_np(max_devices: int, max_models: int,
 
 def init_model_state(max_devices: int, max_models: int,
                      max_features: int) -> ModelStateTensors:
-    import jax
-
     return jax.tree_util.tree_map(
         jnp.asarray,
         init_model_state_np(max_devices, max_models, max_features))
@@ -137,7 +136,7 @@ def eval_anomaly_models(
       score:       f32 [B] lowest scored slot's score (0 = none scored)
     """
     B = dev.shape[0]
-    D = state.value.shape[0]
+    D = state.slab.shape[0]
     P, F = table.num_models, table.num_features
     H = table.width
 
@@ -150,15 +149,18 @@ def eval_anomaly_models(
     )                                                     # [B, P]
     tick = eligible & attach[:, None]                     # [B, P]
 
-    # gather this batch's state rows; rows whose generation lags their
-    # model's epoch read as fresh (lazy per-row reset)
-    stale = state.row_gen[dev] != table.epoch[None, :]    # [B, P]
+    # ONE contiguous gather pulls each row's whole fused state record;
+    # rows whose generation lags their model's epoch read as fresh
+    # (lazy per-row reset)
+    slab_rows = state.slab[dev]                           # [B, P, 4F+2]
+    stale = slab_rows[:, :, 4 * F + 1] != table.epoch[None, :]  # [B, P]
     stale_f = stale[:, :, None]
-    value_s = jnp.where(stale_f, 0.0, state.value[dev])   # [B, P, F]
-    aux_s = jnp.where(stale_f, 0.0, state.aux[dev])
-    ts_s = jnp.where(stale_f, _NEG, state.ts[dev])
-    ctr_s = jnp.where(stale_f, 0, state.counter[dev])
-    prev_row = jnp.where(stale, False, state.score_prev[dev])  # [B, P]
+    value_s = jnp.where(stale_f, 0.0,
+                        _slab_f32(slab_rows[:, :, 0:F]))  # [B, P, F]
+    aux_s = jnp.where(stale_f, 0.0, _slab_f32(slab_rows[:, :, F:2 * F]))
+    ts_s = jnp.where(stale_f, _NEG, slab_rows[:, :, 2 * F:3 * F])
+    ctr_s = jnp.where(stale_f, 0, slab_rows[:, :, 3 * F:4 * F])
+    prev_row = jnp.where(stale, False, slab_rows[:, :, 4 * F] != 0)  # [B, P]
 
     # ---- feature extraction + state advance ([B, P, F] vectorized) ----
     mm = jnp.clip(table.feat_mm, 0, lm_row.shape[1] - 1)  # [P, F]
@@ -240,21 +242,20 @@ def eval_anomaly_models(
     fired = above & ~prev_row
     new_prev_row = jnp.where(scored, above, prev_row)
 
-    # scatter updated rows back from attach rows only (unique writer per
-    # device; other rows route to the dropped pad index)
+    # fuse the updated record back into slab lanes and scatter it from
+    # attach rows only (unique writer per device; other rows route to
+    # the dropped pad index) — with attach-sorted rows this is a single
+    # contiguous segment write per touched device
+    new_rows = jnp.concatenate([
+        _slab_i32(new_value), _slab_i32(new_aux),
+        new_ts.astype(jnp.int32), new_ctr.astype(jnp.int32),
+        new_prev_row.astype(jnp.int32)[:, :, None],
+        jnp.broadcast_to(table.epoch[None, :],
+                         (B, P)).astype(jnp.int32)[:, :, None],
+    ], axis=-1)
     target = jnp.where(attach, dev, D)
-
-    def put(arr, rows):
-        return arr.at[target].set(rows, mode="drop")
-
     new_state = state.replace(
-        value=put(state.value, new_value),
-        aux=put(state.aux, new_aux),
-        ts=put(state.ts, new_ts),
-        counter=put(state.counter, new_ctr),
-        score_prev=put(state.score_prev, new_prev_row),
-        row_gen=put(state.row_gen,
-                    jnp.broadcast_to(table.epoch[None, :], (B, P))),
+        slab=state.slab.at[target].set(new_rows, mode="drop"),
         # per-model counters reset when their slot's epoch moved
         gen=table.epoch,
         fire_count=jnp.where(state.gen != table.epoch, 0,

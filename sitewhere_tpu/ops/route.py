@@ -11,10 +11,11 @@ the mesh routes it itself inside the same shard_map as the fused step:
 
   1. bucket: each shard computes its chunk rows' destination shard
      (`dev % S` — the same hash partition the host router and the
-     registry interner use), then counting-sorts them into S
-     fixed-capacity per-destination lanes with a one-hot prefix-sum
-     (the same rank-by-cumsum machinery ops/compact.py packs alert
-     lanes with).
+     registry interner use), then stable-sorts them into S
+     fixed-capacity per-destination lanes via shared sort-rank
+     arithmetic (ops/segments.py bucket_ranks): O(B log B), no [B, S]
+     one-hot intermediate — the same in-bucket arrival order the old
+     one-hot prefix-sum produced, bit for bit.
   2. exchange: ONE `all_to_all` over ICI transposes the [S_dest, C]
      lanes so every shard holds the [S_src, C] buckets destined to it,
      source-major — i.e. flat-batch arrival order.
@@ -166,24 +167,28 @@ def device_route_chunk(chunk, n_shards: int, per_shard_batch: int,
             jax.lax.axis_index(axis_name) == 0, _extract_ts_base(head),
             jnp.int32(0))
         base = jax.lax.psum(base_local, axis_name)
+    from sitewhere_tpu.ops.segments import bucket_ranks
+
     valid = (head >> _VALID_SHIFT) & 1
     dev = head & (WIRE_DEV_MAX - 1)
     dest = jnp.where(valid == 1, dev % S, S)          # S = padding sentinel
-    # stable counting sort by destination: rank of each row within its
-    # destination bucket via a one-hot prefix sum (invalid rows rank -1)
-    onehot = (dest[:, None] == jnp.arange(S, dtype=jnp.int32)[None, :]
-              ).astype(jnp.int32)                      # [B, S]
-    pos = jnp.sum(jnp.cumsum(onehot, axis=0) * onehot, axis=1) - 1
+    # stable sort-based bucketing: rank of each row within its
+    # destination bucket via one shared stable sort + segment-start
+    # subtraction — O(B log B), no [B, S] one-hot intermediate. Invalid
+    # rows (sentinel bucket S) get real ranks but `keep` masks them out
+    # exactly like the old counting sort's rank -1.
+    pos = bucket_ranks(dest)
     keep = (valid == 1) & (pos < C)
     slot = jnp.where(keep, dest * C + pos, S * C)      # OOB -> dropped
     # routed heads carry LOCAL device indices with spare bits stripped,
     # exactly like the host router's head rewrite
     local_head = ((head & _SPARE_CLEAR & ~jnp.int32(WIRE_DEV_MAX - 1))
                   | (dev // S))
-    lanes = jnp.stack([
-        jnp.zeros((S * C,), jnp.int32).at[slot].set(
-            local_head if r == 0 else chunk[r], mode="drop")
-        for r in range(rows)])                         # [rows, S*C]
+    # one [rows, B] -> [rows, S*C] scatter builds every wire row's lane
+    # at once (unique slots; OOB rows drop)
+    vals = jnp.concatenate([local_head[None], chunk[1:]], axis=0)
+    lanes = jnp.zeros((rows, S * C), jnp.int32).at[:, slot].set(
+        vals, mode="drop")                             # [rows, S*C]
     dropped = jnp.sum(((valid == 1) & ~keep).astype(jnp.int32))
     # ONE collective: transpose the per-destination lanes so this shard
     # holds every source's bucket for it, source-major (= arrival order)
@@ -194,9 +199,8 @@ def device_route_chunk(chunk, n_shards: int, per_shard_batch: int,
     crank = jnp.cumsum(cvalid) - cvalid                # exclusive rank
     ckeep = (cvalid == 1) & (crank < B)
     cslot = jnp.where(ckeep, crank, B)                 # OOB -> dropped
-    blob = jnp.stack([
-        jnp.zeros((B,), jnp.int32).at[cslot].set(cand[r], mode="drop")
-        for r in range(rows)])
+    blob = jnp.zeros((rows, B), jnp.int32).at[:, cslot].set(
+        cand, mode="drop")
     dropped = dropped + jnp.sum(((cvalid == 1) & ~ckeep).astype(jnp.int32))
     if packed:
         blob = blob.at[0].set(_embed_ts_base(blob[0], base))

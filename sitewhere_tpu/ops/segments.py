@@ -83,6 +83,46 @@ def last_by_key(keys: jnp.ndarray, ts: jnp.ndarray, valid: jnp.ndarray,
     return new_state_ts, tuple(new_states)
 
 
+def batch_device_order(dev: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Stable permutation grouping batch rows by device, plus its inverse.
+
+    One shared argsort per step: the rule-program and anomaly-model
+    kernels gather their HBM state rows at `dev[order]` so all rows of
+    the same device read adjacent state, and per-row outputs are
+    un-sorted with `out[inv]`. Stability preserves batch arrival order
+    inside each device segment, so last-writer-wins semantics are
+    untouched.
+
+    Returns (order, inv) with `inv[order[i]] == i`.
+    """
+    B = dev.shape[0]
+    rows = jnp.arange(B, dtype=jnp.int32)
+    order = jnp.lexsort((rows, dev))
+    inv = jnp.zeros((B,), order.dtype).at[order].set(rows)
+    return order, inv
+
+
+def bucket_ranks(keys: jnp.ndarray) -> jnp.ndarray:
+    """Arrival-order rank of each row within its key bucket.
+
+    Sort-based replacement for the one-hot × cumsum counting sort
+    (O(B·S) work, [B, S] intermediate): a single stable sort by key
+    plus segment-start subtraction gives the same rank in O(B log B)
+    with no wide intermediates. For rows sharing a key, ranks follow
+    batch position (stable sort), exactly like cumsum over arrival
+    order.
+    """
+    B = keys.shape[0]
+    rows = jnp.arange(B, dtype=jnp.int32)
+    order = jnp.lexsort((rows, keys))
+    sk = keys[order]
+    first = jnp.concatenate(
+        [jnp.ones((1,), bool), sk[1:] != sk[:-1]])
+    seg_start = jax.lax.cummax(jnp.where(first, rows, 0))
+    rank = rows - seg_start
+    return jnp.zeros((B,), jnp.int32).at[order].set(rank)
+
+
 def scatter_max_by_key(keys: jnp.ndarray, values: jnp.ndarray,
                        valid: jnp.ndarray, num_segments: int,
                        state: jnp.ndarray) -> jnp.ndarray:

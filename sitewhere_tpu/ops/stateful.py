@@ -9,12 +9,15 @@ hysteresis, and satisfied-since timestamps for `for_duration`.
 Work scales with the BATCH, not the device capacity: the step first
 reduces the batch to per-device observations with the same keyed
 reductions the device-state fold uses (ops/segments.py), then evaluates
-the [B, P] program matrix only on the batch's rows — state rows gather
-per row from the [D, P, S] HBM tensors and scatter back from each
-device's ATTACH row (its last tracked-measurement row this step, a
-unique writer, so the scatter is deterministic like every other fold
-here). A device with no event this step costs nothing, exactly like the
-rest of the pipeline.
+the [B, P] program matrix only on the batch's rows — each row's whole
+state record gathers with ONE contiguous read from the fused i32 slab
+[D, P, 4*S+2] and scatters back from the device's ATTACH row (its last
+tracked-measurement row this step, a unique writer, so the scatter is
+deterministic like every other fold here). The step sorts batch rows by
+device first (ops/segments.py batch_device_order), so gathers and the
+attach scatter touch HBM in contiguous device segments. A device with
+no event this step costs nothing, exactly like the rest of the
+pipeline.
 
 Step semantics (the NumPy oracle in tests/test_rule_programs.py pins
 them exactly):
@@ -45,10 +48,17 @@ from __future__ import annotations
 
 from typing import Dict, Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 from flax import struct
 
+# slab primitives live in the import-leaf ops/slab.py (ops/anomaly.py
+# needs them too, and this module's compiler import chain reaches
+# anomaly); re-exported here because this is the layout's home API
+from sitewhere_tpu.ops.slab import (  # noqa: F401  (re-export)
+    _slab_f32, _slab_i32, pack_state_slab_np, state_slab_lanes,
+    unpack_state_slab_np)
 from sitewhere_tpu.rules.compiler import ProgramOp, RuleProgramTable
 
 _NEG = -(2 ** 31)
@@ -60,6 +70,13 @@ class RuleStateTensors:
     DeviceStateTensors (sharded engines carry a leading shard axis on
     every field, exactly like the device-state group).
 
+    All per-device state lives in ONE fused i32 slab [D, P, 4*S+2] so a
+    step gathers a device's whole state row with a single contiguous
+    HBM read instead of six strided ones (the structural fix for the
+    small-scale offload losses). Lane layout (see pack_state_slab_np):
+    value bits / aux bits / ts / counter planes of S lanes each, then
+    the root_prev bit and the per-row generation.
+
     The (value, aux, ts, counter) quad is one uniform state record per
     stateful node (compiler-assigned state_slot):
       EWMA          value = accumulator, counter = observation count
@@ -70,12 +87,7 @@ class RuleStateTensors:
       HYSTERESIS    counter = latch bit
     """
 
-    value: jnp.ndarray     # f32 [D, P, S]
-    aux: jnp.ndarray       # f32 [D, P, S]
-    ts: jnp.ndarray        # i32 [D, P, S]
-    counter: jnp.ndarray   # i32 [D, P, S]
-    root_prev: jnp.ndarray  # bool [D, P] root output at the last tick
-    row_gen: jnp.ndarray   # i32 [D, P] per-row state generation
+    slab: jnp.ndarray      # i32 [D, P, 4*S+2] fused per-device state
     gen: jnp.ndarray       # i32 [P] counter-row generation
     fire_count: jnp.ndarray      # i32 [P] cumulative fires
     suppress_count: jnp.ndarray  # i32 [P] cumulative suppressions
@@ -86,7 +98,7 @@ class RuleStateTensors:
 
     @property
     def num_state_slots(self) -> int:
-        return self.value.shape[-1]
+        return (self.slab.shape[-1] - 2) // 4
 
 
 def init_rule_state_np(max_devices: int,
@@ -96,13 +108,10 @@ def init_rule_state_np(max_devices: int,
     no device buffers, so sharded engines place the tree with ONE
     device_put on their mesh)."""
     D, P, S = max_devices, max_programs, state_slots
+    slab = np.zeros((D, P, state_slab_lanes(S)), np.int32)
+    slab[:, :, 2 * S:3 * S] = _NEG   # ts plane; zero bits are 0.0f elsewhere
     return RuleStateTensors(
-        value=np.zeros((D, P, S), np.float32),
-        aux=np.zeros((D, P, S), np.float32),
-        ts=np.full((D, P, S), _NEG, np.int32),
-        counter=np.zeros((D, P, S), np.int32),
-        root_prev=np.zeros((D, P), bool),
-        row_gen=np.zeros((D, P), np.int32),
+        slab=slab,
         gen=np.zeros((P,), np.int32),
         fire_count=np.zeros((P,), np.int32),
         suppress_count=np.zeros((P,), np.int32),
@@ -111,37 +120,29 @@ def init_rule_state_np(max_devices: int,
 
 def init_rule_state(max_devices: int, max_programs: int,
                     state_slots: int) -> RuleStateTensors:
-    import jax
-
     return jax.tree_util.tree_map(
         jnp.asarray,
         init_rule_state_np(max_devices, max_programs, state_slots))
 
 
-def _slot_onehot(slots: jnp.ndarray, size: int) -> jnp.ndarray:
-    """[P] slot ids -> bool [P, size] one-hot. The lane axes here are
-    tiny static buckets (state slots, node slots), so dense one-hot
-    select/merge beats per-element scatter/gather by orders of magnitude
-    on every backend (XLA scatters with full index arrays serialize on
-    CPU and tile poorly on the VPU)."""
-    return slots[:, None] == jnp.arange(size, dtype=slots.dtype)[None, :]
-
-
 def _gather_slot(arr: jnp.ndarray, slots: jnp.ndarray) -> jnp.ndarray:
-    """arr [B, P, S], slots [P] -> [B, P] (each program's assigned lane)."""
-    onehot = _slot_onehot(slots, arr.shape[2])[None]      # [1, P, S]
-    if arr.dtype == jnp.bool_:
-        return jnp.any(arr & onehot, axis=2)
-    return jnp.sum(jnp.where(onehot, arr, 0), axis=2).astype(arr.dtype)
+    """arr [B, P, S], slots [P] (in-range) -> [B, P]: each program's
+    assigned lane, as one narrow take_along_axis instead of a dense
+    one-hot reduction over the lane axis."""
+    idx = slots.astype(jnp.int32)[None, :, None]          # [1, P, 1]
+    return jnp.take_along_axis(arr, idx, axis=2)[..., 0]
 
 
 def _scatter_slot(arr: jnp.ndarray, slots: jnp.ndarray,
                   values: jnp.ndarray, write: jnp.ndarray) -> jnp.ndarray:
     """Write `values` [B, P] into arr[b, p, slots[p]] where `write` [P];
-    programs outside `write` keep their lane untouched."""
-    onehot = _slot_onehot(slots, arr.shape[2])[None]      # [1, P, S]
-    mask = onehot & write[None, :, None]
-    return jnp.where(mask, values[:, :, None], arr)
+    programs outside `write` keep their lane untouched (the current lane
+    value is written back bit-identically, so the update is one unique-
+    index scatter instead of a [B, P, S] select)."""
+    cur = _gather_slot(arr, slots)
+    new = jnp.where(write[None, :], values, cur)
+    lanes = jnp.arange(arr.shape[1], dtype=jnp.int32)
+    return arr.at[:, lanes, slots.astype(jnp.int32)].set(new)
 
 
 def eval_rule_programs(
@@ -169,7 +170,7 @@ def eval_rule_programs(
     from sitewhere_tpu.ops.threshold import _compare
 
     B = dev.shape[0]
-    D = state.value.shape[0]
+    D = state.slab.shape[0]
     P, N = table.num_programs, table.num_nodes
     # trim the unrolled node pass to the slots the COMPILED table
     # actually populates (trace-time static, threaded from the engine's
@@ -189,15 +190,18 @@ def eval_rule_programs(
     )                                                     # [B, P]
     tick = eligible & attach[:, None]                     # [B, P]
 
-    # gather this batch's state rows; rows whose generation lags their
-    # program's epoch read as fresh (lazy per-row reset)
-    stale = state.row_gen[dev] != table.epoch[None, :]    # [B, P]
+    # ONE contiguous gather pulls each row's whole fused state record;
+    # rows whose generation lags their program's epoch read as fresh
+    # (lazy per-row reset)
+    slab_rows = state.slab[dev]                           # [B, P, 4S+2]
+    stale = slab_rows[:, :, 4 * S + 1] != table.epoch[None, :]  # [B, P]
     stale_s = stale[:, :, None]
-    value_s = jnp.where(stale_s, 0.0, state.value[dev])   # [B, P, S]
-    aux_s = jnp.where(stale_s, 0.0, state.aux[dev])
-    ts_s = jnp.where(stale_s, _NEG, state.ts[dev])
-    ctr_s = jnp.where(stale_s, 0, state.counter[dev])
-    prev_row = jnp.where(stale, False, state.root_prev[dev])  # [B, P]
+    value_s = jnp.where(stale_s, 0.0,
+                        _slab_f32(slab_rows[:, :, 0:S]))  # [B, P, S]
+    aux_s = jnp.where(stale_s, 0.0, _slab_f32(slab_rows[:, :, S:2 * S]))
+    ts_s = jnp.where(stale_s, _NEG, slab_rows[:, :, 2 * S:3 * S])
+    ctr_s = jnp.where(stale_s, 0, slab_rows[:, :, 3 * S:4 * S])
+    prev_row = jnp.where(stale, False, slab_rows[:, :, 4 * S] != 0)  # [B, P]
 
     outs = jnp.zeros((B, P, N), bool)
 
@@ -294,19 +298,20 @@ def eval_rule_programs(
     suppressed = tick & root & prev_row
     new_prev_row = jnp.where(tick, root, prev_row)
 
-    # scatter updated rows back from attach rows only (unique writer per
-    # device; other rows route to the dropped pad index)
+    # fuse the updated record back into slab lanes and scatter it from
+    # attach rows only (unique writer per device; other rows route to
+    # the dropped pad index) — with attach-sorted rows this is a single
+    # contiguous segment write per touched device
+    new_rows = jnp.concatenate([
+        _slab_i32(value_s), _slab_i32(aux_s),
+        ts_s.astype(jnp.int32), ctr_s.astype(jnp.int32),
+        new_prev_row.astype(jnp.int32)[:, :, None],
+        jnp.broadcast_to(table.epoch[None, :],
+                         (B, P)).astype(jnp.int32)[:, :, None],
+    ], axis=-1)
     target = jnp.where(attach, dev, D)
-    def put(arr, rows):
-        return arr.at[target].set(rows, mode="drop")
     new_state = state.replace(
-        value=put(state.value, value_s),
-        aux=put(state.aux, aux_s),
-        ts=put(state.ts, ts_s),
-        counter=put(state.counter, ctr_s),
-        root_prev=put(state.root_prev, new_prev_row),
-        row_gen=put(state.row_gen,
-                    jnp.broadcast_to(table.epoch[None, :], (B, P))),
+        slab=state.slab.at[target].set(new_rows, mode="drop"),
         # per-program counters reset when their slot's epoch moved
         gen=table.epoch,
         fire_count=jnp.where(state.gen != table.epoch, 0,

@@ -413,7 +413,7 @@ class ShardedPipelineEngine(PipelineEngine):
 
     def _init_rule_state(self):
         # rule-program state rides the shard axis with the other state
-        # tensors: per-shard [S, D/S, P, slots] device lanes plus
+        # tensors: per-shard [S, D/S, P, 4*slots+2] fused slab lanes plus
         # per-shard [S, P] generation/counter rows (counters are additive
         # partials, summed on read like the tenant counters). Sized by
         # _rule_state_dims: a [.., 1, 1] placeholder while no programs
@@ -433,7 +433,8 @@ class ShardedPipelineEngine(PipelineEngine):
 
     def _init_model_state(self):
         # anomaly-model state rides the shard axis exactly like the
-        # rule-program state: per-shard [S, D/S, P, F] feature lanes plus
+        # rule-program state: per-shard [S, D/S, P, 4*F+2] fused slab
+        # lanes plus
         # per-shard [S, P] generation/counter rows (fire/eval counters
         # are additive partials, summed on read). Sized by
         # _model_state_dims: a [.., 1, 1] placeholder while no models
@@ -1297,8 +1298,7 @@ class ShardedPipelineEngine(PipelineEngine):
 
     # -- rule-program state layouts ----------------------------------------
 
-    _RULE_STATE_DEVICE_FIELDS = ("value", "aux", "ts", "counter",
-                                 "root_prev", "row_gen")
+    _RULE_STATE_DEVICE_FIELDS = ("slab",)
     _RULE_STATE_PROGRAM_FIELDS = ("gen", "fire_count", "suppress_count")
 
     def canonical_rule_state(self):
@@ -1410,8 +1410,7 @@ class ShardedPipelineEngine(PipelineEngine):
 
     # -- anomaly-model state layouts ---------------------------------------
 
-    _MODEL_STATE_DEVICE_FIELDS = ("value", "aux", "ts", "counter",
-                                  "score_prev", "row_gen")
+    _MODEL_STATE_DEVICE_FIELDS = ("slab",)
     _MODEL_STATE_MODEL_FIELDS = ("gen", "fire_count", "eval_count")
 
     def canonical_model_state(self):

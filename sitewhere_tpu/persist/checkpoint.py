@@ -92,8 +92,7 @@ def _shift_ts(array: np.ndarray, delta_ms: int) -> np.ndarray:
 
 # rule-program state fields with a device-major leading axis (the rest —
 # gen/fire_count/suppress_count — are program-indexed and move verbatim)
-_RULE_STATE_DEVICE_FIELDS = ("value", "aux", "ts", "counter", "root_prev",
-                             "row_gen")
+_RULE_STATE_DEVICE_FIELDS = ("slab",)
 
 
 def _permute_rule_state_rows(kwargs: Dict[str, np.ndarray],
@@ -103,9 +102,9 @@ def _permute_rule_state_rows(kwargs: Dict[str, np.ndarray],
     init sentinels so unmapped devices start temporal windows fresh."""
     from sitewhere_tpu.ops.stateful import init_rule_state_np
 
-    sample = kwargs["value"]
+    sample = kwargs["slab"]
     init = init_rule_state_np(sample.shape[0], sample.shape[1],
-                              sample.shape[2])
+                              (sample.shape[2] - 2) // 4)
     out = {}
     old_idx = np.nonzero(perm)[0]
     new_idx = perm[old_idx]
@@ -121,8 +120,7 @@ def _permute_rule_state_rows(kwargs: Dict[str, np.ndarray],
 
 # anomaly-model state fields with a device-major leading axis (the rest —
 # gen/fire_count/eval_count — are model-indexed and move verbatim)
-_MODEL_STATE_DEVICE_FIELDS = ("value", "aux", "ts", "counter", "score_prev",
-                              "row_gen")
+_MODEL_STATE_DEVICE_FIELDS = ("slab",)
 
 
 def _permute_model_state_rows(kwargs: Dict[str, np.ndarray],
@@ -132,9 +130,9 @@ def _permute_model_state_rows(kwargs: Dict[str, np.ndarray],
     init sentinels so unmapped devices start feature windows fresh."""
     from sitewhere_tpu.ops.anomaly import init_model_state_np
 
-    sample = kwargs["value"]
+    sample = kwargs["slab"]
     init = init_model_state_np(sample.shape[0], sample.shape[1],
-                               sample.shape[2])
+                               (sample.shape[2] - 2) // 4)
     out = {}
     old_idx = np.nonzero(perm)[0]
     new_idx = perm[old_idx]
@@ -146,6 +144,27 @@ def _permute_model_state_rows(kwargs: Dict[str, np.ndarray],
         fresh[new_idx] = array[old_idx]
         out[name] = fresh
     return out
+
+
+def _migrate_state_cols(cols: Dict[str, np.ndarray], *, flag_field: str
+                        ) -> Dict[str, np.ndarray]:
+    """Fuse a pre-slab checkpoint's separate state columns
+    (value/aux/ts/counter + flag + row_gen) into the current fused-slab
+    layout (ops/stateful.py pack_state_slab_np). Slab-era checkpoints
+    (or empty column sets) pass through untouched. float planes travel
+    as raw IEEE bits, so restored state is bit-identical."""
+    if not cols or "slab" in cols or "value" not in cols:
+        return cols
+    from sitewhere_tpu.ops.stateful import pack_state_slab_np
+
+    fused = {"slab": pack_state_slab_np(
+        cols["value"], cols["aux"], cols["ts"], cols["counter"],
+        cols[flag_field], cols["row_gen"])}
+    for key, array in cols.items():
+        if key not in ("value", "aux", "ts", "counter", flag_field,
+                       "row_gen"):
+            fused[key] = array
+    return fused
 
 
 def _install_overflow(engine, overflow_cols: Dict[str, np.ndarray]) -> None:
@@ -718,6 +737,15 @@ class PipelineCheckpointer:
                     f"checkpoint {path} is unreadable: {err}") from err
             self._quarantine(path)
             return self.restore(engine)
+        # pre-slab checkpoints saved the state quads as separate columns;
+        # fuse them into the current slab layout in place so old
+        # checkpoints restore transparently (no operator migration step).
+        # Works uniformly for canonical [D, P, S] arrays and host-shard
+        # stacked blocks: the fuse is a last-axis concat of bit planes.
+        rule_state_cols = _migrate_state_cols(
+            rule_state_cols, flag_field="root_prev")
+        model_state_cols = _migrate_state_cols(
+            model_state_cols, flag_field="score_prev")
         packer = engine.packer
         # rule programs re-install FIRST (they only mutate host lists):
         # the restored rule state's per-slot generations must meet their

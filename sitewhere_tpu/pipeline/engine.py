@@ -829,12 +829,12 @@ class PipelineEngine(LifecycleComponent):
         THIS engine's current program dims — what checkpoints must match
         (computed, not allocated: the resident state may still be the
         no-programs placeholder when a restore re-installs programs)."""
+        from sitewhere_tpu.ops.stateful import state_slab_lanes
+
         D = self.registry.devices.capacity
         P, S = self._rule_state_dims()
-        return {"value": (D, P, S), "aux": (D, P, S), "ts": (D, P, S),
-                "counter": (D, P, S), "root_prev": (D, P),
-                "row_gen": (D, P), "gen": (P,), "fire_count": (P,),
-                "suppress_count": (P,)}
+        return {"slab": (D, P, state_slab_lanes(S)), "gen": (P,),
+                "fire_count": (P,), "suppress_count": (P,)}
 
     def _validate_canonical_rule_state(self, rule_state) -> None:
         for name, want in self._expected_rule_state_shapes().items():
@@ -1006,12 +1006,12 @@ class PipelineEngine(LifecycleComponent):
         return jax.tree_util.tree_map(lambda a: np.asarray(a), snap)
 
     def _expected_model_state_shapes(self):
+        from sitewhere_tpu.ops.stateful import state_slab_lanes
+
         D = self.registry.devices.capacity
         P, F = self._model_state_dims()
-        return {"value": (D, P, F), "aux": (D, P, F), "ts": (D, P, F),
-                "counter": (D, P, F), "score_prev": (D, P),
-                "row_gen": (D, P), "gen": (P,), "fire_count": (P,),
-                "eval_count": (P,)}
+        return {"slab": (D, P, state_slab_lanes(F)), "gen": (P,),
+                "fire_count": (P,), "eval_count": (P,)}
 
     def _validate_canonical_model_state(self, model_state) -> None:
         for name, want in self._expected_model_state_shapes().items():

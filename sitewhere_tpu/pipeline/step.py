@@ -35,7 +35,7 @@ from sitewhere_tpu.ops.geofence import (
 )
 from sitewhere_tpu.ops.pack import EventBatch
 from sitewhere_tpu.ops.segments import (
-    count_by_key, last_by_key, scatter_max_by_key,
+    batch_device_order, count_by_key, last_by_key, scatter_max_by_key,
 )
 from sitewhere_tpu.ops.anomaly import ModelStateTensors, eval_anomaly_models
 from sitewhere_tpu.ops.stateful import (
@@ -204,23 +204,37 @@ def process_batch(params: PipelineParams, state: DeviceStateTensors,
     # are installed.
     B = batch.device_idx.shape[0]
     if programs_enabled or models_enabled:
-        # the observation masks and attach rows feed BOTH stateful stages
+        # the observation masks and attach rows feed BOTH stateful stages.
+        # ONE shared stable argsort groups batch rows by device so both
+        # kernels' HBM slab gathers and attach scatters run over
+        # contiguous device segments; per-row outputs un-sort with the
+        # inverse permutation. Per-row math depends only on own-row
+        # inputs and the attach scatter targets are unique, so results
+        # are bit-identical to the unsorted evaluation.
         obs_mm, _touched, now_d, attach_row = observations_of_batch(
             batch, M, D)
+        order, inv = batch_device_order(dev)
+        sdev = dev[order]
+        sattach = attach_row[order]
+        s_obs = obs_mm[sdev]
+        s_lm = last_measurement[sdev]
+        s_lmts = last_measurement_ts[sdev]
+        s_tenant = params.tenant_idx[sdev]
+        s_dtype = params.device_type_idx[sdev]
     if programs_enabled:
         with jax.named_scope("step_rule_programs"):
-            # per-ROW evaluation: state gathers/scatters ride the batch's
-            # device rows (attach rows are the unique writers), so program
-            # evaluation costs O(batch), not O(device capacity)
+            # per-ROW evaluation over attach-sorted rows: state gathers/
+            # scatters ride contiguous device segments (attach rows are
+            # the unique writers), so program evaluation costs O(batch),
+            # not O(device capacity)
             rule_state, prog = eval_rule_programs(
                 params.programs, rule_state,
-                dev=dev, attach=attach_row,
-                obs_row=obs_mm[dev], now_row=now_d[dev],
-                lm_row=last_measurement[dev],
-                lmts_row=last_measurement_ts[dev],
-                tenant_row=params.tenant_idx[dev],
-                dtype_row=params.device_type_idx[dev],
+                dev=sdev, attach=sattach,
+                obs_row=s_obs, now_row=now_d[sdev],
+                lm_row=s_lm, lmts_row=s_lmts,
+                tenant_row=s_tenant, dtype_row=s_dtype,
                 node_limit=program_node_limit)
+            prog = {k: v[inv] for k, v in prog.items()}
     else:
         prog = {"fired": jnp.zeros((B,), bool),
                 "first_rule": jnp.full((B,), -1, jnp.int32),
@@ -235,12 +249,11 @@ def process_batch(params: PipelineParams, state: DeviceStateTensors,
         with jax.named_scope("step_model_eval"):
             model_state, model = eval_anomaly_models(
                 params.models, model_state,
-                dev=dev, attach=attach_row,
-                obs_row=obs_mm[dev],
-                lm_row=last_measurement[dev],
-                lmts_row=last_measurement_ts[dev],
-                tenant_row=params.tenant_idx[dev],
-                dtype_row=params.device_type_idx[dev])
+                dev=sdev, attach=sattach,
+                obs_row=s_obs,
+                lm_row=s_lm, lmts_row=s_lmts,
+                tenant_row=s_tenant, dtype_row=s_dtype)
+            model = {k: v[inv] for k, v in model.items()}
     else:
         model = {"fired": jnp.zeros((B,), bool),
                  "first_model": jnp.full((B,), -1, jnp.int32),

@@ -24,7 +24,6 @@ from sitewhere_tpu.model.event import (
     AlertLevel, AlertSource, DeviceAlert, DeviceCommandInvocation,
     DeviceCommandResponse, DeviceEvent, DeviceEventContext, DeviceLocation,
     DeviceMeasurement, DeviceStateChange, dispatch_event)
-from sitewhere_tpu.pipeline.enrichment import unpack_enriched
 from sitewhere_tpu.runtime.bus import ConsumerHost, EventBus, Record, TopicNaming
 from sitewhere_tpu.runtime.lifecycle import LifecycleComponent
 from sitewhere_tpu.runtime.metrics import MetricsRegistry
@@ -85,6 +84,10 @@ class RuleProcessorHost(LifecycleComponent):
 
     def process(self, records: List[Record]) -> None:
         """attemptToProcess :144 per record; public for synchronous tests."""
+        # deferred: the pipeline package imports ops/stateful.py, which
+        # imports this package's compiler — a module-level import here
+        # closes that cycle whenever ops.stateful is imported first
+        from sitewhere_tpu.pipeline.enrichment import unpack_enriched
         for record in records:
             try:
                 context, event = unpack_enriched(record.value)

@@ -48,6 +48,9 @@ def table_bytes(engine) -> Dict[str, int]:
     params = getattr(engine, "_params", None)
     out: Dict[str, int] = {
         "device_state": _tree_bytes(getattr(engine, "_state", None)),
+        # rule/model state are the fused i32 slabs ([D, P, 4*S+2] /
+        # [D, P, 4*F+2], ops/stateful.py) plus their [P] counter rows —
+        # the nbytes walk reports the slab layout directly
         "rule_state": _tree_bytes(getattr(engine, "_rule_state", None)),
         "model_state": _tree_bytes(getattr(engine, "_model_state", None)),
         "rule_tables": 0,

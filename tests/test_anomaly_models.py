@@ -271,9 +271,17 @@ def _check_counters(engine, oracle, slot_of):
 
 
 class TestDifferentialSingleChip:
-    def test_trace_matches_oracle(self):
+    # batch-size sweep: the slab gather/scatter path must be
+    # bit-identical to the oracle at small, medium (default) and full
+    # lane fills — no batch-size special cases in the sorted fold
+    @pytest.mark.parametrize("batch_size", [
+        pytest.param(4, marks=pytest.mark.slow),
+        32,
+        pytest.param(128, marks=pytest.mark.slow),
+    ])
+    def test_trace_matches_oracle(self, batch_size):
         _, tensors = _world()
-        engine = _engine(tensors)
+        engine = _engine(tensors, batch_size=batch_size)
         _install(engine, _models())
         oracle = _oracle_for(engine)
         slot_of = {e["spec"]["token"]: e["slot"]
@@ -432,6 +440,58 @@ class TestDifferentialSingleChip:
                   engine_b.anomaly_model_counters())
         assert ca == cb
         assert any(c["fires"] > 0 for c in ca.values())
+
+
+    def test_old_layout_checkpoint_migrates_into_slab(self, tmp_path):
+        """A pre-slab checkpoint (separate modelstate arrays, score_prev
+        flag) restores transparently into the fused slab with bit-exact
+        state parity and an identical continued run."""
+        from sitewhere_tpu.ops.slab import unpack_state_slab_np
+        from sitewhere_tpu.persist.atomic import write_digest_manifest
+        from sitewhere_tpu.persist.checkpoint import PipelineCheckpointer
+
+        cut = 3
+        _, tensors_a = _world()
+        engine_a = _engine(tensors_a)
+        _install(engine_a, _models())
+        steps = _trace(engine_a.packer.epoch_base_ms + 10_000)
+        for events, tokens in steps[:cut]:
+            engine_a.submit(engine_a.packer.pack_events(events, tokens)[0])
+        ckpt = PipelineCheckpointer(str(tmp_path))
+        ckpt.save(engine_a)
+
+        [path] = tmp_path.glob("ckpt-*")
+        npz = path / "state.npz"
+        with np.load(npz) as data:
+            arrays = {k: np.asarray(data[k]) for k in data.files}
+        legacy = unpack_state_slab_np(arrays.pop("modelstate.slab"))
+        arrays["modelstate.value"] = legacy["value"]
+        arrays["modelstate.aux"] = legacy["aux"]
+        arrays["modelstate.ts"] = legacy["ts"]
+        arrays["modelstate.counter"] = legacy["counter"]
+        arrays["modelstate.score_prev"] = legacy["flag"].astype(bool)
+        arrays["modelstate.row_gen"] = legacy["row_gen"]
+        np.savez_compressed(npz, **arrays)
+        write_digest_manifest(str(path))
+
+        _, tensors_b = _world()
+        engine_b = _engine(tensors_b)
+        ckpt.restore(engine_b)
+        np.testing.assert_array_equal(
+            np.asarray(engine_b._model_state.slab),
+            np.asarray(engine_a._model_state.slab))
+        for events, tokens in steps[cut:]:
+            out_a = engine_a.submit(
+                engine_a.packer.pack_events(events, tokens)[0])
+            out_b = engine_b.submit(
+                engine_b.packer.pack_events(events, tokens)[0])
+            for field in ("model_fired", "model_first", "model_level",
+                          "model_score"):
+                np.testing.assert_array_equal(
+                    np.asarray(getattr(out_a, field)),
+                    np.asarray(getattr(out_b, field)), err_msg=field)
+        assert engine_a.anomaly_model_counters() \
+            == engine_b.anomaly_model_counters()
 
 
 class TestDifferentialSharded:

@@ -304,6 +304,86 @@ class TestSegments:
         ref = np.bincount(keys[valid], minlength=D)
         assert (np.asarray(out) == ref).all()
 
+    def test_batch_device_order_stable_permutation(self, rng):
+        from sitewhere_tpu.ops.segments import batch_device_order
+        for B, D in ((1, 4), (7, 3), (256, 32), (300, 1)):
+            dev = rng.integers(0, D, B).astype(np.int32)
+            order, inv = batch_device_order(jnp.asarray(dev))
+            order, inv = np.asarray(order), np.asarray(inv)
+            # stable: equal keys keep batch order — numpy's stable
+            # argsort is the definition
+            assert (order == np.argsort(dev, kind="stable")).all()
+            # inverse permutation round-trips row identity
+            assert (order[inv] == np.arange(B)).all()
+            assert (inv[order] == np.arange(B)).all()
+
+    def test_bucket_ranks_matches_onehot_counting_sort(self, rng):
+        """The sort-based rank arithmetic must reproduce the old
+        one-hot x cumsum counting sort bit for bit, including rows in
+        the padding-sentinel bucket (old kernel: real rank within the
+        sentinel segment — rows there are masked by `keep`, but the
+        arithmetic is compared exactly anyway)."""
+        from sitewhere_tpu.ops.segments import bucket_ranks
+
+        def ref(keys, n_buckets):
+            onehot = (keys[:, None] == np.arange(n_buckets)[None, :])
+            csum = np.cumsum(onehot.astype(np.int64), axis=0)
+            return ((csum - 1) * onehot).sum(axis=1).astype(np.int32)
+
+        for B, S in ((1, 2), (16, 4), (257, 8), (64, 1)):
+            keys = rng.integers(0, S + 1, B).astype(np.int32)  # incl. sentinel
+            got = np.asarray(bucket_ranks(jnp.asarray(keys)))
+            assert (got == ref(keys, S + 1)).all(), (B, S)
+        # all-one-bucket and empty-bucket extremes
+        keys = np.zeros(32, np.int32)
+        assert (np.asarray(bucket_ranks(jnp.asarray(keys)))
+                == np.arange(32)).all()
+
+
+class TestStateSlab:
+    def test_pack_unpack_roundtrip_bit_exact(self, rng):
+        """Float planes ride the slab as raw i32 bits: NaN payloads and
+        -0.0 must survive the round trip bit-exactly."""
+        from sitewhere_tpu.ops.stateful import (
+            pack_state_slab_np, state_slab_lanes, unpack_state_slab_np)
+        D, P, S = 5, 3, 4
+        value = rng.standard_normal((D, P, S)).astype(np.float32)
+        value[0, 0, 0] = np.float32(np.nan)
+        value[1, 1, 1] = np.frombuffer(
+            np.uint32(0x7FC0BEEF).tobytes(), np.float32)[0]  # NaN payload
+        value[2, 2, 2] = np.float32(-0.0)
+        aux = rng.standard_normal((D, P, S)).astype(np.float32)
+        ts = rng.integers(-2 ** 31, 2 ** 31 - 1, (D, P, S)).astype(np.int32)
+        ctr = rng.integers(0, 1000, (D, P, S)).astype(np.int32)
+        flag = (rng.random((D, P)) > 0.5)
+        row_gen = rng.integers(0, 99, (D, P)).astype(np.int32)
+        slab = pack_state_slab_np(value, aux, ts, ctr, flag, row_gen)
+        assert slab.shape == (D, P, state_slab_lanes(S))
+        assert slab.dtype == np.int32
+        got = unpack_state_slab_np(slab)
+        assert (got["value"].view(np.int32)
+                == value.view(np.int32)).all()   # bit compare, NaN-safe
+        assert (got["aux"].view(np.int32) == aux.view(np.int32)).all()
+        assert (got["ts"] == ts).all()
+        assert (got["counter"] == ctr).all()
+        assert (got["flag"] == flag.astype(np.int32)).all()
+        assert (got["row_gen"] == row_gen).all()
+        # -0.0 sign bit survived
+        assert np.signbit(got["value"][2, 2, 2])
+
+    def test_device_bitcast_matches_host_view(self, rng):
+        """The on-device lane bitcasts (_slab_f32/_slab_i32) and the
+        host-side numpy views must agree bit for bit — the checkpoint
+        migration packs on the host, the kernel unpacks on device."""
+        from sitewhere_tpu.ops.stateful import _slab_f32, _slab_i32
+        vals = rng.standard_normal((4, 8)).astype(np.float32)
+        vals[0, 0] = np.float32(np.nan)
+        vals[1, 1] = np.float32(-0.0)
+        bits = vals.view(np.int32)
+        assert (np.asarray(_slab_f32(jnp.asarray(bits))).view(np.int32)
+                == bits).all()
+        assert (np.asarray(_slab_i32(jnp.asarray(vals))) == bits).all()
+
 
 # ---------------------------------------------------------------------------
 # packing

@@ -369,7 +369,8 @@ def test_latency_budget_advisory_on_cpu_host():
 
 def test_device_routing_check():
     """Parity is a hard fact on any host; the offload speedup gates at
-    full scale and is advisory on the cpu smoke."""
+    EVERY scale on accelerator hosts (the sort-based bucketing makes
+    small batches winnable) and is advisory on CPU-only hosts."""
     ok = _bench()
     ok["device_routing"] = {"router_offload_speedup_x": 3.0,
                             "parity_ok": True}
@@ -382,14 +383,78 @@ def test_device_routing_check():
     assert not self_consistency(broken)["ok"]
     broken["scale"] = "small"
     assert not self_consistency(broken)["ok"]
-    # a sub-1x offload fails at full scale, advisory on the smoke
+    # a sub-1x offload fails on an accelerator host at EVERY scale...
     slow = _bench()
     slow["device_routing"] = {"router_offload_speedup_x": 0.4,
                               "parity_ok": True}
     assert not self_consistency(slow)["ok"]
     slow["scale"] = "small"
+    assert not self_consistency(slow)["ok"]
+    # ...and is advisory only on a CPU-only bench host
+    slow["device"] = "TFRT_CPU_0"
     out = self_consistency(slow)
     assert out["ok"]
     assert "speedup_advisory" in out["checks"]["device_routing"]
+    # parity stays hard even on the cpu host
+    slow["device_routing"]["parity_ok"] = False
+    assert not self_consistency(slow)["ok"]
     # rounds recorded before the device route existed have no check
     assert "device_routing" not in self_consistency(_bench())["checks"]
+
+
+def test_link_waiver_on_degraded_h2d():
+    """On a degraded H2D link (probe below MIN_LINK_H2D_MBPS) the
+    link-sensitive misses become structured link_waived verdicts with
+    the probe attached; the same misses stay hard on a healthy link,
+    and the bit-fact checks (parity, fetch budget) never waive."""
+    slow = _bench()
+    slow["device_routing"] = {"router_offload_speedup_x": 0.4,
+                              "parity_ok": True}
+    slow["rule_programs"] = {"d2h_fetches_per_offer": 1,
+                             "compiled_vs_host_speedup_x": 0.2}
+    slow["anomaly_models"] = {"d2h_fetches_per_offer": 1,
+                              "offload_speedup_x": 0.75,
+                              "marginal_step_pct": 2.0}
+    slow["latency_mode_trial_p99_ms"] = [233.2, 228.2]
+    # accelerator host, healthy link (no probe evidence of degradation):
+    # every miss is a hard FAIL
+    assert not self_consistency(slow)["ok"]
+    slow["link_probe_pre"]["h2d_4mb_mbps_last"] = 1200.0
+    assert not self_consistency(slow)["ok"]
+    # degraded tunnel: the misses carry waiver objects and ok holds
+    slow["link_probe_pre"]["h2d_4mb_mbps_last"] = 9.0
+    out = self_consistency(slow)
+    assert out["ok"]
+    for name in ("device_routing", "rule_programs", "anomaly_models",
+                 "latency_budget_met"):
+        entry = out["checks"][name]
+        assert entry["ok"], name
+        waiver = entry["link_waived"]
+        assert waiver["waived"] == "link_degraded"
+        assert waiver["h2d_4mb_mbps"] == {"link_probe_pre": 9.0}
+    # parity + the fetch budget stay hard even on a degraded link
+    slow["device_routing"]["parity_ok"] = False
+    assert not self_consistency(slow)["ok"]
+    slow["device_routing"]["parity_ok"] = True
+    slow["rule_programs"]["d2h_fetches_per_offer"] = 3
+    assert not self_consistency(slow)["ok"]
+
+
+def test_link_waiver_makes_absolute_drift_advisory():
+    """Absolute drift against (or from) a degraded-link run is recorded
+    with a structured waiver instead of hard-failing: a degraded tunnel
+    is whole-VM I/O weather, the same condition that swings host
+    absolutes on unchanged code."""
+    prev, cur = _bench(), _bench(persist=8e6 * 3)   # 3x host drift
+    assert not compare(prev, cur)["ok"]             # comparable hosts: FAIL
+    cur["link_probe_pre"]["h2d_4mb_mbps_last"] = 12.0
+    out = compare(prev, cur)
+    assert out["ok"]
+    assert out["link_waived"]["waived"] == "link_degraded"
+    entry = out["absolutes"]["persist_events_per_sec"]
+    assert entry["advisory_exceeded"]
+    # ratio drift is NEVER link-waived (ratios cancel the link by
+    # construction — drift there is workload shape, not weather)
+    worse = _bench(sharded=36e6 * 0.5)
+    worse["link_probe_pre"]["h2d_4mb_mbps_last"] = 12.0
+    assert not compare(_bench(), worse)["ok"]

@@ -269,9 +269,17 @@ def _fired_rows_from_outputs(outputs):
 
 
 class TestDifferentialSingleChip:
-    def test_trace_matches_oracle(self):
+    # batch-size sweep: the segment-fold gather/scatter must be
+    # bit-identical to the oracle at small, medium (default) and full
+    # lane fills — the sorted-batch path has no batch-size special cases
+    @pytest.mark.parametrize("batch_size", [
+        pytest.param(4, marks=pytest.mark.slow),
+        32,
+        pytest.param(128, marks=pytest.mark.slow),
+    ])
+    def test_trace_matches_oracle(self, batch_size):
         _, tensors = _world()
-        engine = _engine(tensors)
+        engine = _engine(tensors, batch_size=batch_size)
         _install(engine, _programs())
         oracle = _oracle_for(engine)
         slot_of = {e["spec"]["token"]: e["slot"]
@@ -364,6 +372,62 @@ class TestDifferentialSingleChip:
                   engine_b.rule_program_counters())
         assert ca == cb
         assert any(c["fires"] > 0 for c in ca.values())
+
+    def test_old_layout_checkpoint_migrates_into_slab(self, tmp_path):
+        """A pre-slab checkpoint (six separate rulestate arrays) restores
+        transparently into the fused slab with bit-exact state parity and
+        a bit-identical continued run — no operator migration step."""
+        from sitewhere_tpu.ops.slab import unpack_state_slab_np
+        from sitewhere_tpu.persist.atomic import write_digest_manifest
+        from sitewhere_tpu.persist.checkpoint import PipelineCheckpointer
+
+        cut = 4
+        _, tensors_a = _world()
+        engine_a = _engine(tensors_a)
+        _install(engine_a, _programs())
+        steps = _trace(engine_a.packer.epoch_base_ms + 10_000)
+        for events, tokens in steps[:cut]:
+            engine_a.submit(engine_a.packer.pack_events(events, tokens)[0])
+        ckpt = PipelineCheckpointer(str(tmp_path))
+        ckpt.save(engine_a)
+
+        # rewrite the checkpoint into the PRE-SLAB layout: split the
+        # fused slab back into the legacy per-field arrays, exactly what
+        # a checkpoint written before the slab rewrite contains
+        [path] = tmp_path.glob("ckpt-*")
+        npz = path / "state.npz"
+        with np.load(npz) as data:
+            arrays = {k: np.asarray(data[k]) for k in data.files}
+        legacy = unpack_state_slab_np(arrays.pop("rulestate.slab"))
+        arrays["rulestate.value"] = legacy["value"]
+        arrays["rulestate.aux"] = legacy["aux"]
+        arrays["rulestate.ts"] = legacy["ts"]
+        arrays["rulestate.counter"] = legacy["counter"]
+        arrays["rulestate.root_prev"] = legacy["flag"].astype(bool)
+        arrays["rulestate.row_gen"] = legacy["row_gen"]
+        np.savez_compressed(npz, **arrays)
+        write_digest_manifest(str(path))
+
+        _, tensors_b = _world()
+        engine_b = _engine(tensors_b)
+        ckpt.restore(engine_b)
+        # the migrated slab is bit-identical to the live engine's
+        np.testing.assert_array_equal(
+            np.asarray(engine_b._rule_state.slab),
+            np.asarray(engine_a._rule_state.slab))
+        # and the continued run stays bit-identical mid-window
+        for events, tokens in steps[cut:]:
+            out_a = engine_a.submit(
+                engine_a.packer.pack_events(events, tokens)[0])
+            out_b = engine_b.submit(
+                engine_b.packer.pack_events(events, tokens)[0])
+            for field in ("program_fired", "program_first_rule",
+                          "program_alert_level"):
+                np.testing.assert_array_equal(
+                    np.asarray(getattr(out_a, field)),
+                    np.asarray(getattr(out_b, field)), err_msg=field)
+        assert engine_a.rule_program_counters() \
+            == engine_b.rule_program_counters()
 
     def test_program_replace_resets_temporal_state(self):
         """Reinstalling a program (new epoch, same slot) restarts its
