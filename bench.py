@@ -94,6 +94,17 @@ def _compact_result(result: Dict, detail_path) -> Dict:
     out["anomaly_models"] = {k: am[k] for k in (
         "offload_speedup_x", "marginal_step_pct",
         "d2h_fetches_per_offer") if k in am}
+    # actuation tier: the gate-checked fields (fetch bit-fact, marginal
+    # step cost) + the headline waterfall p99; rates live in the sidecar
+    act = result.get("actuation") or {}
+    out["actuation"] = {k: act[k] for k in (
+        "lane_vs_host_speedup_x", "marginal_step_pct",
+        "detection_to_actuation_p99_ms",
+        "d2h_fetches_per_offer") if k in act}
+    # drift scenario: only the headline adapt time rides the line
+    drf = result.get("drift") or {}
+    out["drift"] = {k: drf[k] for k in (
+        "time_to_adapt_s",) if k in drf}
     # only the gate-checked fields ride the line (the byte budget);
     # device_route_ms_per_step etc. live in the sidecar
     dr = result.get("device_routing") or {}
@@ -174,7 +185,7 @@ def _compact_result(result: Dict, detail_path) -> Dict:
 # the three offload speedup blocks, perf_gate — go last and in practice
 # never trim.
 _TRIM_ORDER = (
-    "spread_worst", "latency_mode", "fencing", "faults", "flight",
+    "spread_worst", "drift", "latency_mode", "fencing", "faults", "flight",
     "feeder_fleet", "step_breakdown", "telemetry_overhead_pct",
     "telemetry_packed_events_per_sec", "persist_events_per_sec",
     "query_10m_narrow_window_ms", "multitenant_sharded_events_per_sec",
@@ -242,6 +253,8 @@ def main() -> None:
         ("persist", _t_persist),
         ("rule_programs", _t_rule_programs),
         ("anomaly_models", _t_anomaly_models),
+        ("actuation", _t_actuation),
+        ("drift", _t_drift),
         ("analytics", _t_analytics),
         ("sharded", _t_sharded),
         ("sharded_bytes", _t_sharded_bytes),
@@ -439,13 +452,14 @@ def _build(jax, small: bool) -> Dict:
     params = engine._ensure_params()
     host_blob = batch_to_blob(pool[0])
     dblob = jax.device_put(host_blob)
-    state, rstate, mstate = (engine._state, engine._rule_state,
-                             engine._model_state)
-    state, rstate, mstate, cout = engine._step_blob(
-        params, state, rstate, mstate, dblob)  # warm compile
+    state, rstate, mstate, astate = (
+        engine._state, engine._rule_state, engine._model_state,
+        engine._actuation_state)
+    state, rstate, mstate, astate, cout = engine._step_blob(
+        params, state, rstate, mstate, astate, dblob)  # warm compile
     jax.block_until_ready(cout.processed)
     engine._state, engine._rule_state = state, rstate
-    engine._model_state = mstate
+    engine._model_state, engine._actuation_state = mstate, astate
     ctx["dblob"], ctx["params"] = dblob, params
     ctx["blob_bytes_per_event"] = host_blob.shape[0] * 4
 
@@ -548,6 +562,7 @@ def _build(jax, small: bool) -> Dict:
     rp_engine.start()
     # the marginal-cost baseline: the IDENTICAL engine with no programs
     # (the step compiles without the program stage at all)
+    # — and the same two-lane materialize leg on both sides
     rp_base = PipelineEngine(tensors, batch_size=LAT_BATCH,
                              measurement_slots=8 if small else 32,
                              max_tenants=16, max_rule_programs=4,
@@ -610,6 +625,91 @@ def _build(jax, small: bool) -> Dict:
         am_base.materialize_alerts(bb, bo)
     jax.block_until_ready((ao.processed, bo.processed))
     ctx["am_engine"], ctx["am_base"] = am_engine, am_base
+
+    # actuation tier (actuation/ + ops/actuate.py): same marginal-cost
+    # design — a fifth engine at the latency batch shape with a threshold
+    # rule AND a policy wired to it (stage 3d evaluates policies in-step;
+    # command fires compact into the [4, K] lane fetched in the SAME
+    # materialize device_get — the two-fetch bit-fact perf_gate pins), vs
+    # an identical engine with the SAME rule but no policy, so the
+    # difference isolates the policy stage + command lane, not alerting.
+    # A CommandFanout with a no-op transport sinks the fires so fan-out
+    # cost stays inside the measured materialize leg.
+    from sitewhere_tpu.actuation.dispatcher import CommandFanout
+    act_engine = PipelineEngine(tensors, batch_size=LAT_BATCH,
+                                measurement_slots=8 if small else 32,
+                                max_tenants=16, max_actuation_policies=4,
+                                name="bench-actuation")
+    act_engine.packer.measurements.intern("m1")
+    act_engine.add_threshold_rule(ThresholdRule(
+        token="bench-act-rule", measurement_name="m1", operator=">",
+        threshold=98.0, alert_level=AlertLevel.WARNING))
+    act_engine.upsert_actuation_policy({
+        "token": "bench-act", "source": "threshold",
+        "min_level": "WARNING", "debounce_ms": 0,
+        "command": "bench-cmd", "params": []})
+    act_engine.command_dispatcher = CommandFanout(lambda fire: None)
+    act_engine.start()
+    act_base = PipelineEngine(tensors, batch_size=LAT_BATCH,
+                              measurement_slots=8 if small else 32,
+                              max_tenants=16, max_actuation_policies=4,
+                              name="bench-act-base")
+    act_base.packer.measurements.intern("m1")
+    act_base.add_threshold_rule(ThresholdRule(
+        token="bench-act-rule", measurement_name="m1", operator=">",
+        threshold=98.0, alert_level=AlertLevel.WARNING))
+    act_base.start()
+    for i in range(3):  # warm both jits + the command-lane path
+        xb, xo = act_engine.submit_routed(rp_pool[i % len(rp_pool)])
+        act_engine.materialize_alerts(xb, xo)
+        yb, yo = act_base.submit_routed(rp_pool[i % len(rp_pool)])
+        act_base.materialize_alerts(yb, yo)
+    jax.block_until_ready((xo.processed, yo.processed))
+    ctx["act_engine"], ctx["act_base"] = act_engine, act_base
+
+    # drift tier (actuation/refit.py): a dedicated engine with one tiny
+    # value-feature MLP whose constants are centred on calm traffic —
+    # the drift scenario feeds a shifted fleet, measures the alert storm,
+    # runs DriftRefitter online (state-slab moments -> recentred
+    # constants -> upsert), and times first-drifted-batch ->
+    # post-refit-quiet. Batches are prebuilt; the section re-upserts the
+    # pristine spec per trial so every trial starts un-adapted.
+    drift_engine = PipelineEngine(tensors, batch_size=LAT_BATCH,
+                                  measurement_slots=8 if small else 32,
+                                  max_tenants=16, max_anomaly_models=4,
+                                  name="bench-drift")
+    drift_engine.packer.measurements.intern("m1")
+    ctx["drift_spec"] = {
+        "token": "bench-refit", "kind": "mlp", "threshold": 0.5,
+        "alert_level": "WARNING", "alert_type": "anomaly.bench.refit",
+        "features": [{"feature": "value", "measurement": "m1",
+                      "mean": 50.0, "std": 25.0}],
+        "layers": [{"weights": [[1.0]], "bias": [0.0]}],
+        "output": {"weights": [40.0], "bias": -38.3}}
+    drift_engine.upsert_anomaly_model(dict(ctx["drift_spec"]))
+    drift_engine.start()
+
+    def _drifted_batch(seed: int):
+        # measurement-only traffic shifted to uniform(80, 100): the calm
+        # model (centred at 50) reads the whole fleet as anomalous
+        from sitewhere_tpu.model.event import DeviceEventType
+        rng = np.random.default_rng(seed)
+        n = LAT_BATCH
+        now = drift_engine.packer.epoch_base_ms
+        return drift_engine.packer.pack_columns(
+            rng.integers(1, N_REGISTERED + 1, n).astype(np.int32),
+            np.full(n, int(DeviceEventType.MEASUREMENT), np.int32),
+            (now + rng.integers(0, 1000, n)).astype(np.int64),
+            mm_idx=np.full(n, 1, np.int32),
+            value=rng.uniform(80, 100, n).astype(np.float32),
+            lat=rng.uniform(-5, 15, n).astype(np.float32),
+            lon=rng.uniform(-5, 15, n).astype(np.float32))
+
+    ctx["drift_pool"] = [_drifted_batch(1300 + s) for s in range(4)]
+    db, do = drift_engine.submit_routed(ctx["drift_pool"][0])
+    drift_engine.materialize_alerts(db, do)  # warm the jit, not the state
+    jax.block_until_ready(do.processed)
+    ctx["drift_engine"] = drift_engine
 
     # analytics replay log (BASELINE config 4), built + warmed once
     from sitewhere_tpu.analytics.engine import WindowedAnalyticsEngine
@@ -788,7 +888,8 @@ def _t_latency(jax, ctx) -> Dict:
     for _ in range(ctx["lat_trial_warmup"]):
         one_offer()  # re-enter steady state; excluded from samples
     # fetch-budget evidence over the measured window only: the lane path
-    # must ship exactly ONE fixed-shape D2H fetch per offer (perf_gate
+    # must ship exactly TWO fixed-shape D2H fetches per offer — alert +
+    # command lanes, one batched device_get (perf_gate
     # latency_fetch_budget pins it)
     f0, b0 = engine.d2h_fetches, engine.d2h_bytes
     samples = [one_offer() for _ in range(ctx["SYNC_STEPS"] * 2)]
@@ -1043,25 +1144,26 @@ def _t_compute(jax, ctx) -> Dict:
     without host->device staging)."""
     engine, dblob, params = ctx["engine"], ctx["dblob"], ctx["params"]
     STEPS = ctx["STEPS"]
-    state, rstate, mstate = (engine._state, engine._rule_state,
-                             engine._model_state)
+    state, rstate, mstate, astate = (
+        engine._state, engine._rule_state, engine._model_state,
+        engine._actuation_state)
     c0 = time.perf_counter()
     for _ in range(STEPS):
-        state, rstate, mstate, cout = engine._step_blob(
-            params, state, rstate, mstate, dblob)
+        state, rstate, mstate, astate, cout = engine._step_blob(
+            params, state, rstate, mstate, astate, dblob)
     jax.block_until_ready(cout.processed)
     rate = STEPS * ctx["BATCH"] / (time.perf_counter() - c0)
     rule_lat: List[float] = []
     for _ in range(STEPS):
         s0 = time.perf_counter()
-        state, rstate, mstate, cout = engine._step_blob(
-            params, state, rstate, mstate, dblob)
+        state, rstate, mstate, astate, cout = engine._step_blob(
+            params, state, rstate, mstate, astate, dblob)
         cout.processed.block_until_ready()
         rule_lat.append(time.perf_counter() - s0)
     # the step donates its state arguments: hand the final buffers back
     # so the engine is not left referencing deleted arrays
     engine._state, engine._rule_state = state, rstate
-    engine._model_state = mstate
+    engine._model_state, engine._actuation_state = mstate, astate
     return {"events_per_sec": rate, "rule_lat_s": rule_lat}
 
 
@@ -1135,9 +1237,9 @@ def _t_rule_programs(jax, ctx) -> Dict:
     """Rule-program tier, three measurements on the same traffic:
 
     1. fused-step throughput with compiled programs active,
-       materialization included (the deployed path — one lane fetch per
-       step; perf_gate pins d2h_fetches_per_offer == 1, the alert-lane
-       budget unchanged by programs);
+       materialization included (the deployed path — one batched lane
+       fetch per step; perf_gate pins d2h_fetches_per_offer == 2, the
+       alert-lane budget unchanged by programs);
     2. the MARGINAL per-event cost of the compiled program stage (step
        with programs minus the identical engine's step without — the
        operator's actual decision: run composite rules in-step or on the
@@ -1169,8 +1271,8 @@ def _t_rule_programs(jax, ctx) -> Dict:
             "host_events_per_sec": host_rate,
             "marginal_us_per_event": marginal_us,
             "host_us_per_event": host_us,
-            # the settling pass offers+fetches too: steps+1 of each,
-            # ratio still pinned at exactly 1
+            # the settling pass offers+fetches too: steps+1 offers,
+            # ratio still pinned at exactly 2
             "d2h_fetches": engine.d2h_fetches - f0,
             "offers": steps + 1}
 
@@ -1235,7 +1337,7 @@ def _t_anomaly_models(jax, ctx) -> Dict:
     tier on the same traffic: fused-step throughput with compiled models
     scoring every tick (materialization included — model fires ride the
     spare alert-lane meta bits, so perf_gate pins d2h_fetches_per_offer
-    == 1); the MARGINAL cost of the scoring stage (identical engine
+    == 2); the MARGINAL cost of the scoring stage (identical engine
     without models, adjacent in the same trial, reported both per event
     and as a percentage of the model-free step — the <10% gate); and
     the host-side per-event scoring loop the stage replaces."""
@@ -1261,6 +1363,127 @@ def _t_anomaly_models(jax, ctx) -> Dict:
             # settling pass included on both sides of the ratio
             "d2h_fetches": engine.d2h_fetches - f0,
             "offers": steps + 1}
+
+
+def _host_policy_loop_rate(ctx) -> float:
+    """Host-side equivalent of the benched actuation policy: the same
+    threshold + min-level + debounce decision per event in Python with a
+    per-device last-fire dict — what actuation costs as an outbound
+    processor on the host instead of a lane stage in the fused step."""
+    last_fire: Dict = {}
+    fires = 0
+    events = ctx["rp_host_events"]
+    t0 = time.perf_counter()
+    for event in events:
+        if event.value > 98.0:
+            key = event.name
+            prev = last_fire.get(key)
+            if prev is None or event.event_date >= prev:
+                last_fire[key] = event.event_date
+                fires += 1
+    dt = time.perf_counter() - t0
+    return len(events) / dt if dt else 0.0
+
+
+def _t_actuation(jax, ctx) -> Dict:
+    """Actuation tier, the anomaly-model tier's marginal design plus the
+    closing waterfall edge:
+
+    1. fused-step throughput with a policy active and a CommandFanout
+       sink attached (the deployed path — perf_gate actuation_lanes pins
+       d2h_fetches_per_offer == 2, the two-lane materialize bit-fact);
+    2. the MARGINAL cost of the policy stage + command lane (identical
+       engine with the same threshold rule but no policy, adjacent in
+       the same trial), reported per event and as a percentage of the
+       policy-free step — the <10% gate;
+    3. the host-side per-event policy loop the stage replaces (speedup
+       recorded advisory — the lane exists for the fetch shape);
+    4. detection->actuation age p99 through the deployed edge: an
+       AgeSidecar stamped at offer, fan-out inside materialize, and the
+       engine re-observing the closed summary on the
+       detection_to_actuation child of the shared age histogram."""
+    from sitewhere_tpu.runtime.eventage import (
+        AGE_BUCKET_EDGES_S, AgeSidecar, age_histogram)
+    from sitewhere_tpu.runtime.metrics import GLOBAL_METRICS
+
+    engine, base, pool = ctx["act_engine"], ctx["act_base"], ctx["rp_pool"]
+    steps = ctx["STEPS"]
+    f0 = engine.d2h_fetches
+    cf0 = engine.commands_fired
+    with_s = _settled_step_seconds(engine, pool, steps)
+    rate = engine.batch_size / with_s if with_s else 0.0
+    base_s = _settled_step_seconds(base, pool, steps)
+    marginal_us = max(with_s - base_s, 1e-9) / engine.batch_size * 1e6
+    host_rate = _host_policy_loop_rate(ctx)
+    host_us = 1e6 / host_rate if host_rate else 0.0
+    # age-stamped offers: the engine folds each closed summary into the
+    # (engine, edge=detection_to_actuation) histogram child only on
+    # steps that actually fired commands — read the child's raw bucket
+    # delta and take the bucketed p99 (AgeSummary.quantile_s's
+    # upper-edge rule)
+    ch = age_histogram(GLOBAL_METRICS).child(
+        engine=engine.name, edge="detection_to_actuation")
+    c0, n0 = list(ch.counts), ch.count
+    age_offers = min(steps, 16)
+    for i in range(age_offers):
+        batch = pool[i % len(pool)]
+        age = AgeSidecar()
+        age.add(None, int(np.asarray(batch.valid).sum()))
+        rb, ro = engine.submit_routed(batch, age=age)
+        engine.materialize_alerts(rb, ro)
+    dn = ch.count - n0
+    p99_s = 0.0
+    if dn:
+        rank, acc = 0.99 * dn, 0
+        p99_s = AGE_BUCKET_EDGES_S[-1]
+        for i, c in enumerate(b - a for a, b in zip(c0, ch.counts)):
+            acc += c
+            if c and acc >= rank:
+                p99_s = AGE_BUCKET_EDGES_S[i]
+                break
+    return {"events_per_sec": rate,
+            "host_events_per_sec": host_rate,
+            "marginal_us_per_event": marginal_us,
+            "marginal_step_pct": (max(with_s - base_s, 0.0) / base_s
+                                  * 100 if base_s else 0.0),
+            "host_us_per_event": host_us,
+            "detection_to_actuation_p99_ms": round(p99_s * 1000, 3),
+            "fires": engine.commands_fired - cf0,
+            # settling pass + the age-stamped offers fetch too
+            "d2h_fetches": engine.d2h_fetches - f0,
+            "offers": steps + 1 + age_offers}
+
+
+def _t_drift(jax, ctx) -> Dict:
+    """Drift scenario (actuation/refit.py): re-arm the pristine model
+    (constants centred on calm traffic), feed the shifted fleet until
+    the storm is evident, refit online from the state-slab moments, and
+    feed again — time_to_adapt_s is first-drifted-batch ->
+    post-refit-quiet, the operator-facing number for how long a drifted
+    fleet storms before the loop recentres itself."""
+    from sitewhere_tpu.actuation.refit import DriftRefitter
+
+    engine, pool = ctx["drift_engine"], ctx["drift_pool"]
+    engine.upsert_anomaly_model(dict(ctx["drift_spec"]))  # un-adapt
+    storm = 0
+    t0 = time.perf_counter()
+    steps = 4
+    for i in range(steps):
+        rb, ro = engine.submit_routed(pool[i % len(pool)])
+        storm += len(engine.materialize_alerts(rb, ro))
+    refitter = DriftRefitter(engine)
+    r0 = time.perf_counter()
+    report = refitter.refit("bench-refit") or {}
+    refit_ms = (time.perf_counter() - r0) * 1000
+    post = 0
+    for i in range(2):
+        rb, ro = engine.submit_routed(pool[(steps + i) % len(pool)])
+        post += len(engine.materialize_alerts(rb, ro))
+    return {"time_to_adapt_s": time.perf_counter() - t0,
+            "refit_ms": refit_ms,
+            "storm_alerts": storm,
+            "post_refit_alerts": post,
+            "refit_devices": int(report.get("devices", 0) or 0)}
 
 
 def _t_persist(jax, ctx) -> Dict:
@@ -1915,6 +2138,8 @@ def _latency_fetch(ctx, lat_trials: List[Dict]) -> Dict:
         "d2h_fetches_per_offer": round(fetches / offers, 4) if offers else 0,
         "d2h_bytes_per_offer": round(nbytes / offers, 1) if offers else 0,
         "lane_capacity": int(ctx["lat_engine"].alert_lane_capacity),
+        "command_lane_capacity": int(
+            ctx["lat_engine"].command_lane_capacity),
     }
 
 
@@ -1977,6 +2202,46 @@ def _aggregate(jax, ctx, trials: Dict[str, List[Dict]],
         "d2h_fetches_per_offer": round(
             sum(t["d2h_fetches"] for t in am_trials) / am_offers, 4)
         if am_offers else 0,
+    }
+
+    act_trials = trials["actuation"]
+    # same best-trial policy as the other marginal tiers: the marginal
+    # is a small difference of two loop timings
+    act_marginal = min(t["marginal_us_per_event"] for t in act_trials)
+    act_marginal_pct = min(t["marginal_step_pct"] for t in act_trials)
+    act_host_us = _median([t["host_us_per_event"] for t in act_trials])
+    act_offers = sum(t["offers"] for t in act_trials)
+    actuation = {
+        "events_per_sec": round(
+            _median([t["events_per_sec"] for t in act_trials]), 1),
+        "host_policy_loop_events_per_sec": round(
+            _median([t["host_events_per_sec"] for t in act_trials]), 1),
+        "marginal_us_per_event": round(act_marginal, 4),
+        "marginal_step_pct": round(act_marginal_pct, 2),
+        "host_us_per_event": round(act_host_us, 4),
+        "lane_vs_host_speedup_x": round(act_host_us / act_marginal, 2)
+        if act_marginal else 0.0,
+        # best-trial p99 of the closing waterfall edge (link weather can
+        # poison a whole trial's offers, same policy as the latency tier)
+        "detection_to_actuation_p99_ms": min(
+            t["detection_to_actuation_p99_ms"] for t in act_trials),
+        "command_fires": int(sum(t["fires"] for t in act_trials)),
+        "d2h_fetches_per_offer": round(
+            sum(t["d2h_fetches"] for t in act_trials) / act_offers, 4)
+        if act_offers else 0,
+    }
+
+    drift_trials = trials["drift"]
+    drift = {
+        "time_to_adapt_s": round(
+            min(t["time_to_adapt_s"] for t in drift_trials), 3),
+        "refit_ms": round(min(t["refit_ms"] for t in drift_trials), 3),
+        "storm_alerts": int(_median(
+            [t["storm_alerts"] for t in drift_trials])),
+        "post_refit_alerts": int(_median(
+            [t["post_refit_alerts"] for t in drift_trials])),
+        "refit_devices": int(_median(
+            [t["refit_devices"] for t in drift_trials])),
     }
 
     plain = sorted(x for t in trials["sync"] for x in t["plain_s"])
@@ -2133,6 +2398,8 @@ def _aggregate(jax, ctx, trials: Dict[str, List[Dict]],
             [t["events_per_sec"] for t in rp_trials]),
         "anomaly_models": _spread_pct(
             [t["events_per_sec"] for t in am_trials]),
+        "actuation": _spread_pct(
+            [t["events_per_sec"] for t in act_trials]),
         "analytics": _spread_pct(analytics),
         "sharded_1chip": _spread_pct(sharded),
         "sharded_from_bytes": _spread_pct(sharded_bytes),
@@ -2226,12 +2493,21 @@ def _aggregate(jax, ctx, trials: Dict[str, List[Dict]],
         "telemetry_wire_bytes_per_event": ctx["telemetry_rows"] * 4,
         "persist_events_per_sec": round(_median(persist), 1),
         # compiled rule programs vs the host RuleProcessor loop (the
-        # perf_gate rule_programs check pins fetches==1 and speedup>=1)
+        # perf_gate rule_programs check pins fetches==2 and speedup>=1)
         "rule_programs": rule_programs,
         # compiled anomaly-model scoring vs the host per-event scorer
-        # (the perf_gate anomaly_models check pins fetches==1, marginal
+        # (the perf_gate anomaly_models check pins fetches==2, marginal
         # step cost < 10%, and offload speedup >= 1 at full scale)
         "anomaly_models": anomaly_models,
+        # in-step actuation policies + command lane vs the host policy
+        # loop (the perf_gate actuation_lanes check pins fetches==2 and
+        # marginal step cost < 10%; speedup rides advisory), plus the
+        # detection->actuation p99 through the deployed fan-out edge
+        "actuation": actuation,
+        # online-refit drift scenario: storm -> refit -> quiet, the
+        # time-to-adapt number docs/ACTUATION.md quotes (sidecar keeps
+        # the full report; time_to_adapt_s rides the compact line)
+        "drift": drift,
         "analytics_replay_events_per_sec": round(_median(analytics), 1),
         "sharded_1chip_events_per_sec": round(_median(sharded), 1),
         # from-encoded-bytes sharded headline: decode + intern + pack +

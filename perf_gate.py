@@ -131,12 +131,17 @@ LATENCY_BUDGET_MS = 10.0
 # XLA-vs-native-C++ dispatch, not the workload: advisory there.
 MIN_ROUTER_OFFLOAD_SPEEDUP = 1.0
 
-# Device-compacted alert lanes pin the latency tier's materialize path to
-# ONE fixed-shape D2H fetch per offer, sized lane_capacity slots of
-# ALERT_LANE_ROWS int32 rows (ops/compact.py). A regression back to
-# per-array fetches (or a fatter lane layout) fails this on ANY host —
-# fetch count and bytes are workload facts, not link weather.
+# Device-compacted alert + command lanes pin the latency tier's
+# materialize path to exactly TWO fixed-shape D2H fetches per offer (one
+# batched device_get of both lanes), sized lane_capacity slots of
+# ALERT_LANE_ROWS int32 rows (ops/compact.py) plus command_lane_capacity
+# slots of COMMAND_LANE_ROWS int32 rows (ops/actuate.py). A regression
+# back to per-array fetches (or a fatter lane layout) fails this on ANY
+# host — fetch count and bytes are workload facts, not link weather.
 ALERT_LANE_BYTES_PER_SLOT = 16
+COMMAND_LANE_BYTES_PER_SLOT = 16
+MATERIALIZE_FETCHES_PER_OFFER = 2
+DEFAULT_COMMAND_LANE_CAPACITY = 64
 
 # Compiled rule programs must at least match the host-side per-event
 # RuleProcessor dispatch path they replace (marginal in-step cost per
@@ -151,8 +156,9 @@ MIN_RULE_PROGRAM_SPEEDUP = 1.0
 
 # Compiled anomaly models (ml/compiler.py scoring inside the fused
 # step): model fires ride the spare alert-lane meta bits, so alert
-# delivery must stay exactly ONE fixed-shape D2H fetch per offer with
-# models scoring every tick — a workload fact, gated at every scale.
+# delivery must stay exactly TWO fixed-shape D2H fetches per offer
+# (alert + command lanes in one batched device_get) with models scoring
+# every tick — a workload fact, gated at every scale.
 # The scoring stage's marginal step cost must stay under 10% of the
 # model-free step, and its marginal per-event cost must at least match
 # the host-side per-event scoring loop it replaces — both judged at
@@ -162,6 +168,18 @@ MIN_RULE_PROGRAM_SPEEDUP = 1.0
 # policy as rule_programs).
 MIN_ANOMALY_MODEL_SPEEDUP = 1.0
 MAX_ANOMALY_MODEL_MARGINAL_PCT = 10.0
+
+# Actuation lanes (ops/actuate.py evaluating policies inside the fused
+# step): command fires compact into their own fixed [4, K] int32 lane
+# fetched in the SAME materialize device_get as the alert lane, so the
+# fetch count stays at the two-fetch bit-fact — gated at every scale.
+# The policy-evaluation stage's marginal step cost must stay under 10%
+# of the policy-free step on accelerator-fingerprinted hosts (advisory
+# on CPU-only hosts, same policy as anomaly_models); the speedup vs the
+# host-side per-fire policy loop is recorded advisory everywhere — the
+# lane exists for the fetch shape, not raw throughput.
+MIN_ACTUATION_SPEEDUP = 1.0
+MAX_ACTUATION_MARGINAL_PCT = 10.0
 
 # The step flight recorder (runtime/flight.py) is ALWAYS ON, so its cost
 # rides every step: the recorder's per-step self-cost (slot claim + a
@@ -451,8 +469,9 @@ def self_consistency(bench: Dict) -> Dict:
                     link, "end-to-end latency budget missed")
             checks["latency_budget_met"] = entry
     # Fetch budget: the latency tier's materialize path must perform
-    # exactly 1 fixed-shape D2H fetch per offer, bytes bounded by the
-    # lane capacity — self-consistent on every host, fast or slow link
+    # exactly 2 fixed-shape D2H fetches per offer (alert lane + command
+    # lane, one batched device_get), bytes bounded by the two lane
+    # capacities — self-consistent on every host, fast or slow link
     # alike (absent from rounds before the lanes existed: no check).
     fetch = bench.get("latency_fetch")
     if isinstance(fetch, dict):
@@ -460,18 +479,24 @@ def self_consistency(bench: Dict) -> Dict:
         bpo = fetch.get("d2h_bytes_per_offer")
         cap = fetch.get("lane_capacity")
         if all(isinstance(v, (int, float)) for v in (fpo, bpo, cap)):
-            max_bytes = cap * ALERT_LANE_BYTES_PER_SLOT
+            cmd_cap = fetch.get("command_lane_capacity")
+            if not isinstance(cmd_cap, (int, float)):
+                cmd_cap = DEFAULT_COMMAND_LANE_CAPACITY
+            max_bytes = (cap * ALERT_LANE_BYTES_PER_SLOT
+                         + cmd_cap * COMMAND_LANE_BYTES_PER_SLOT)
             checks["latency_fetch_budget"] = {
-                "ok": fpo == 1 and bpo <= max_bytes,
+                "ok": fpo == MATERIALIZE_FETCHES_PER_OFFER
+                and bpo <= max_bytes,
                 "d2h_fetches_per_offer": fpo,
                 "d2h_bytes_per_offer": bpo,
                 "max_bytes_per_offer": max_bytes}
     # Rule-program budget: with compiled programs ACTIVE in the fused
-    # step, alert delivery must still be exactly 1 fixed-shape D2H fetch
-    # per offer (program fires ride the spare alert-lane meta bits — the
-    # lane budget is unchanged), and the compiled path must beat the
-    # host-side per-event RuleProcessor loop it replaces. Both are
-    # workload facts, valid on any host (absent before the tier existed).
+    # step, alert delivery must still be exactly 2 fixed-shape D2H
+    # fetches per offer (program fires ride the spare alert-lane meta
+    # bits — the lane budget is unchanged), and the compiled path must
+    # beat the host-side per-event RuleProcessor loop it replaces. Both
+    # are workload facts, valid on any host (absent before the tier
+    # existed).
     rp = bench.get("rule_programs")
     if isinstance(rp, dict):
         rp_fpo = rp.get("d2h_fetches_per_offer")
@@ -480,7 +505,8 @@ def self_consistency(bench: Dict) -> Dict:
                for v in (rp_fpo, rp_speedup)):
             speedup_ok = rp_speedup >= MIN_RULE_PROGRAM_SPEEDUP
             entry = {
-                "ok": rp_fpo == 1 and (speedup_ok or cpu_host),
+                "ok": rp_fpo == MATERIALIZE_FETCHES_PER_OFFER
+                and (speedup_ok or cpu_host),
                 "d2h_fetches_per_offer": rp_fpo,
                 "compiled_vs_host_speedup_x": rp_speedup,
                 "min_speedup_x": MIN_RULE_PROGRAM_SPEEDUP}
@@ -491,13 +517,13 @@ def self_consistency(bench: Dict) -> Dict:
                     "bound gates accelerator-fingerprinted runs at "
                     "every scale)")
             elif not speedup_ok and link["degraded"]:
-                entry["ok"] = rp_fpo == 1
+                entry["ok"] = rp_fpo == MATERIALIZE_FETCHES_PER_OFFER
                 entry["link_waived"] = _link_waiver(
                     link, "rule-program offload speedup below bound")
             checks["rule_programs"] = entry
     # Anomaly-model budget: with compiled models scoring every tick in
-    # the fused step, alert delivery must still be exactly 1 fixed-shape
-    # D2H fetch per offer (model fires ride the spare alert-lane meta
+    # the fused step, alert delivery must still be exactly 2 fixed-shape
+    # D2H fetches per offer (model fires ride the spare alert-lane meta
     # bits); the scoring stage's marginal step cost and its per-event
     # cost vs the host scorer gate at full scale (absent before the
     # tier existed: no check).
@@ -511,7 +537,8 @@ def self_consistency(bench: Dict) -> Dict:
             cost_ok = (am_speedup >= MIN_ANOMALY_MODEL_SPEEDUP
                        and am_marginal < MAX_ANOMALY_MODEL_MARGINAL_PCT)
             entry = {
-                "ok": am_fpo == 1 and (cost_ok or cpu_host),
+                "ok": am_fpo == MATERIALIZE_FETCHES_PER_OFFER
+                and (cost_ok or cpu_host),
                 "d2h_fetches_per_offer": am_fpo,
                 "offload_speedup_x": am_speedup,
                 "marginal_step_pct": am_marginal,
@@ -524,10 +551,53 @@ def self_consistency(bench: Dict) -> Dict:
                     "bounds gate accelerator-fingerprinted runs at "
                     "every scale)")
             elif not cost_ok and link["degraded"]:
-                entry["ok"] = am_fpo == 1
+                entry["ok"] = am_fpo == MATERIALIZE_FETCHES_PER_OFFER
                 entry["link_waived"] = _link_waiver(
                     link, "anomaly-model offload cost bounds missed")
             checks["anomaly_models"] = entry
+    # Actuation-lane budget: with actuation policies ACTIVE, command
+    # fires ride their own [4, K] lane inside the SAME materialize
+    # device_get — the fetch count must stay at the two-fetch bit-fact
+    # on every host. The policy stage's marginal step cost gates under
+    # 10% on accelerator-fingerprinted hosts; the speedup vs the
+    # host-side per-fire policy loop is recorded advisory everywhere
+    # (absent before the tier existed: no check).
+    act = bench.get("actuation")
+    if isinstance(act, dict):
+        act_fpo = act.get("d2h_fetches_per_offer")
+        act_marginal = act.get("marginal_step_pct")
+        if all(isinstance(v, (int, float))
+               for v in (act_fpo, act_marginal)):
+            marginal_ok = act_marginal < MAX_ACTUATION_MARGINAL_PCT
+            entry = {
+                "ok": act_fpo == MATERIALIZE_FETCHES_PER_OFFER
+                and (marginal_ok or cpu_host),
+                "d2h_fetches_per_offer": act_fpo,
+                "marginal_step_pct": act_marginal,
+                "max_marginal_step_pct": MAX_ACTUATION_MARGINAL_PCT}
+            act_speedup = act.get("lane_vs_host_speedup_x")
+            if isinstance(act_speedup, (int, float)):
+                entry["lane_vs_host_speedup_x"] = act_speedup
+                entry["min_speedup_x"] = MIN_ACTUATION_SPEEDUP
+                if act_speedup < MIN_ACTUATION_SPEEDUP:
+                    entry["speedup_advisory"] = (
+                        "below bound (advisory everywhere; the command "
+                        "lane exists for the fixed fetch shape, not raw "
+                        "throughput)")
+            act_p99 = act.get("detection_to_actuation_p99_ms")
+            if isinstance(act_p99, (int, float)):
+                entry["detection_to_actuation_p99_ms"] = act_p99
+            if cpu_host and not marginal_ok:
+                entry["cost_advisory"] = (
+                    "over bound on a CPU-only bench host (advisory; "
+                    "XLA-vs-Python-dispatch, not the workload — the "
+                    "bound gates accelerator-fingerprinted runs at "
+                    "every scale)")
+            elif not marginal_ok and link["degraded"]:
+                entry["ok"] = act_fpo == MATERIALIZE_FETCHES_PER_OFFER
+                entry["link_waived"] = _link_waiver(
+                    link, "actuation marginal step cost over bound")
+            checks["actuation_lanes"] = entry
     # Device routing: the on-device route's output must be bit-identical
     # to the host arena router's (parity_ok — a workload fact on any
     # host), and the pinned full-batch micro-bench must show the device

@@ -1,0 +1,20 @@
+"""Closing the loop on device: alert -> command actuation.
+
+The reference platform's other half is command delivery back to devices
+(SURVEY.md §3.4: routing -> encoding -> delivery). This package compiles
+declarative per-tenant alert->command policies into fixed-shape SoA
+tables the fused step evaluates right after anomaly scoring
+(ops/actuate.py), fans the resulting command lane out through the
+existing commands/ destinations (actuation/dispatcher.py), and refits
+anomaly-model constants from accumulated feature moments when the fleet
+drifts (actuation/refit.py).
+"""
+
+from sitewhere_tpu.actuation.compiler import (  # noqa: F401
+    ActuationPolicyError, ActuationPolicyTable, PolicySource,
+    compile_policy_into, dry_run_compile, empty_policy_table,
+    policy_from_dict)
+from sitewhere_tpu.actuation.store import ActuationPolicyStore  # noqa: F401
+from sitewhere_tpu.actuation.dispatcher import (  # noqa: F401
+    CommandFanout, deliver_via_service)
+from sitewhere_tpu.actuation.refit import DriftRefitter  # noqa: F401

@@ -1,0 +1,207 @@
+"""Durable actuation-policy install registry.
+
+The control-plane twin of ml/store.py's ModelStore for the compiled
+alert->command policies (actuation/compiler.py): (tenant, token) ->
+{spec, stamp}; JSON-durable, last-writer-wins with removal tombstones,
+so installs survive restarts, ride the instance checkpoint, and
+replicate cluster-wide under gossip kind `_actuation_policy` with the
+same LWW/tombstone algebra the provisioning replicator uses
+(multitenant/replication.py).
+
+The payload is the whole normalized spec — the payload IS the identity:
+appliers are idempotent and order-free, and the LWW tiebreak on equal
+stamps compares the spec's canonical JSON so every host converges on
+the same winner.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+from typing import Callable, Dict, List, Optional
+
+from sitewhere_tpu.model.common import now_ms
+
+LOGGER = logging.getLogger("sitewhere.actuation.store")
+
+
+class ActuationPolicyStore:
+    """(tenant, token) -> {spec, stamp}; JSON-durable, LWW, with removal
+    tombstones (see module docstring)."""
+
+    def __init__(self, data_dir: Optional[str] = None):
+        self._path = (os.path.join(data_dir, "actuation_policies.json")
+                      if data_dir else None)
+        self._lock = threading.Lock()
+        # (tenant, token) -> {"spec": dict, "stamp": int}
+        self._installs: Dict[tuple, Dict] = {}
+        self._tombstones: Dict[tuple, int] = {}
+        self._listeners: List[Callable] = []
+        self._load()
+
+    # -- durability --------------------------------------------------------
+    def _load(self) -> None:
+        if not self._path or not os.path.exists(self._path):
+            return
+        try:
+            with open(self._path, encoding="utf-8") as fh:
+                data = json.load(fh)
+        except (OSError, ValueError):
+            LOGGER.exception("unreadable actuation-policy store %s",
+                             self._path)
+            return
+        for row in data.get("installs", []):
+            self._installs[(row["tenant"], row["token"])] = {
+                "spec": row["spec"], "stamp": int(row.get("stamp", 0))}
+        for row in data.get("tombstones", []):
+            self._tombstones[(row["tenant"], row["token"])] = int(
+                row.get("stamp", 0))
+
+    def _sync(self) -> None:
+        if not self._path:
+            return
+        data = {
+            "installs": [{"tenant": t, "token": k, **v}
+                         for (t, k), v in sorted(self._installs.items())],
+            "tombstones": [{"tenant": t, "token": k, "stamp": s}
+                           for (t, k), s in sorted(self._tombstones.items())],
+        }
+        tmp = f"{self._path}.{os.getpid()}.tmp"
+        os.makedirs(os.path.dirname(self._path), exist_ok=True)
+        with open(tmp, "w", encoding="utf-8") as fh:
+            json.dump(data, fh)
+        os.replace(tmp, self._path)
+
+    # -- replication surface ----------------------------------------------
+    def add_listener(self, fn: Callable) -> None:
+        """fn(op: "add"|"remove", tenant, token, payload) — fired on LOCAL
+        mutations only (record/erase, not apply_*)."""
+        self._listeners.append(fn)
+
+    def _notify(self, op: str, tenant: str, token: str, payload) -> None:
+        for fn in list(self._listeners):
+            try:
+                fn(op, tenant, token, payload)
+            except Exception:
+                LOGGER.exception(
+                    "actuation-policy listener failed (%s %s/%s)",
+                    op, tenant, token)
+
+    # -- mutations ---------------------------------------------------------
+    def record(self, tenant: str, token: str, spec: Dict,
+               notify: bool = True) -> Dict:
+        """Local install; returns the payload the gossip side publishes.
+        ``notify=False`` defers the listener fire to the caller (`emit`)
+        — same deferred-publish contract as the rule/model stores."""
+        with self._lock:
+            stamp = max(now_ms(),
+                        self._tombstones.get((tenant, token), -1) + 1,
+                        self._installs.get((tenant, token),
+                                           {"stamp": -1})["stamp"] + 1)
+            payload = {"spec": dict(spec), "stamp": stamp}
+            self._installs[(tenant, token)] = payload
+            self._tombstones.pop((tenant, token), None)
+            self._sync()
+        if notify:
+            self._notify("add", tenant, token, payload)
+        return payload
+
+    def erase(self, tenant: str, token: str,
+              notify: bool = True) -> Optional[int]:
+        """Local removal; returns the tombstone stamp (None if unknown)."""
+        with self._lock:
+            existing = self._installs.pop((tenant, token), None)
+            if existing is None:
+                return None
+            stamp = max(now_ms(), existing["stamp"] + 1)
+            self._tombstones[(tenant, token)] = stamp
+            self._sync()
+        if notify:
+            self._notify("remove", tenant, token, stamp)
+        return stamp
+
+    def emit(self, op: str, tenant: str, token: str, payload) -> None:
+        """Deferred listener fire for record/erase with notify=False —
+        call OUTSIDE any lock (listeners publish to peer bus edges)."""
+        self._notify(op, tenant, token, payload)
+
+    @staticmethod
+    def _spec_key(spec: Dict) -> str:
+        return json.dumps(spec, sort_keys=True, separators=(",", ":"))
+
+    def _add_wins_locked(self, key: tuple, spec: Dict, stamp: int) -> bool:
+        if stamp <= self._tombstones.get(key, -1):
+            return False
+        local = self._installs.get(key)
+        return local is None or (
+            (local["stamp"], self._spec_key(local["spec"]))
+            < (stamp, self._spec_key(spec)))
+
+    def would_apply_add(self, tenant: str, token: str, spec: Dict,
+                        stamp: int) -> bool:
+        """Non-mutating LWW check: lets the caller attach the live policy
+        BEFORE committing the store (an attach that fails must leave the
+        store unchanged so redelivery retries cleanly)."""
+        with self._lock:
+            return self._add_wins_locked((tenant, token), spec, stamp)
+
+    def apply_add(self, tenant: str, token: str, spec: Dict,
+                  stamp: int) -> bool:
+        """Replicated install: LWW against local install/tombstone;
+        idempotent, never notifies. Returns True when it newly wins."""
+        with self._lock:
+            key = (tenant, token)
+            if not self._add_wins_locked(key, spec, stamp):
+                return False
+            self._installs[key] = {"spec": dict(spec), "stamp": stamp}
+            self._tombstones.pop(key, None)
+            self._sync()
+            return True
+
+    def apply_remove(self, tenant: str, token: str, stamp: int) -> bool:
+        with self._lock:
+            key = (tenant, token)
+            local = self._installs.get(key)
+            if local is not None and local["stamp"] > stamp:
+                return False
+            self._tombstones[key] = max(stamp,
+                                        self._tombstones.get(key, -1))
+            if local is None:
+                # durable tombstone even with nothing to remove: a remove
+                # arriving before its add must survive a restart or the
+                # redelivered older add resurrects the policy here
+                self._sync()
+                return False
+            del self._installs[key]
+            self._sync()
+            return True
+
+    # -- reads -------------------------------------------------------------
+    def installs_for(self, tenant: str) -> List[Dict]:
+        with self._lock:
+            return [{"token": token, "spec": dict(v["spec"]),
+                     "stamp": v["stamp"]}
+                    for (t, token), v in sorted(self._installs.items())
+                    if t == tenant]
+
+    def all_installs(self) -> List[Dict]:
+        with self._lock:
+            return [{"tenant": t, "token": token, "spec": dict(v["spec"]),
+                     "stamp": v["stamp"]}
+                    for (t, token), v in sorted(self._installs.items())]
+
+    def get(self, tenant: str, token: str) -> Optional[Dict]:
+        with self._lock:
+            v = self._installs.get((tenant, token))
+            return {"spec": dict(v["spec"]), "stamp": v["stamp"]} \
+                if v else None
+
+    def export_state(self) -> Dict:
+        """Checkpoint payload (installs only; tombstones are a gossip
+        convergence aid, not durable state worth moving cross-topology)."""
+        with self._lock:
+            return {"installs": [{"tenant": t, "token": k, **v}
+                                 for (t, k), v in
+                                 sorted(self._installs.items())]}

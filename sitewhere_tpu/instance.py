@@ -237,6 +237,27 @@ class SiteWhereInstance(LifecycleComponent):
                     logging.getLogger("sitewhere.instance").exception(
                         "could not restore anomaly model %r for tenant %s",
                         row["token"], row["tenant"])
+        # durable actuation-policy installs (alert -> command policies —
+        # actuation/): same store pattern as the anomaly models,
+        # re-installed into the engine's policy table at boot so
+        # in-flight debounce windows resume against the same slots
+        from sitewhere_tpu.actuation import ActuationPolicyStore, CommandFanout
+        self.actuation_policies = ActuationPolicyStore(data_dir=self.data_dir)
+        self._actuation_policy_lock = threading.Lock()
+        self.command_fanout = None
+        if self.pipeline_engine is not None:
+            for row in self.actuation_policies.all_installs():
+                try:
+                    self.pipeline_engine.upsert_actuation_policy(row["spec"])
+                except Exception:
+                    logging.getLogger("sitewhere.instance").exception(
+                        "could not restore actuation policy %r for tenant %s",
+                        row["token"], row["tenant"])
+            # delivery fan-out: lane fires route through the firing
+            # tenant's command-delivery stack (resolve + route + encode);
+            # bounded retry then dead-letter, replay-barrier suppression
+            self.command_fanout = CommandFanout(self._deliver_command_fire)
+            self.pipeline_engine.command_dispatcher = self.command_fanout
         # serializes scripted-rule check+attach+commit sequences: a gossip
         # apply that passed its LWW pre-check must not interleave with a
         # local install, or the loser's attach could replace the winner's
@@ -571,6 +592,89 @@ class SiteWhereInstance(LifecycleComponent):
                     return True
         return False
 
+    # -- actuation policies (durable + replicated; alert -> command) -------
+    def install_actuation_policy(self, tenant: str, spec: Dict,
+                                 replace: bool = False) -> Dict:
+        """Validate + install an alert->command policy on the fused
+        pipeline: live engine install first (the compile 409s naming the
+        offending field BEFORE any mutation), then durable record, then
+        gossip via the store's listeners. Policy tokens are
+        instance-global (the engine's slot table is); the store scopes
+        listing and removal by tenant."""
+        from sitewhere_tpu.errors import ErrorCode, SiteWhereError
+
+        engine = self.pipeline_engine
+        if engine is None:
+            raise SiteWhereError(
+                "actuation policies require a pipeline engine "
+                "(pipeline.enabled)", ErrorCode.GENERIC, http_status=409)
+        spec = dict(spec or {})
+        spec["tenant_token"] = tenant  # force the request tenant's scope
+        with self._actuation_policy_lock:
+            if replace:
+                entry = engine.upsert_actuation_policy(spec)
+            else:
+                entry = engine.create_actuation_policy(spec)
+            payload = self.actuation_policies.record(
+                tenant, entry["spec"]["token"], entry["spec"], notify=False)
+        self.actuation_policies.emit("add", tenant, entry["spec"]["token"],
+                                     payload)
+        return dict(entry["spec"])
+
+    def remove_actuation_policy(self, tenant: str, token: str) -> bool:
+        engine = self.pipeline_engine
+        with self._actuation_policy_lock:
+            removed = bool(engine is not None
+                           and self.actuation_policies.get(tenant, token)
+                           is not None
+                           and engine.remove_actuation_policy(token))
+            stamp = self.actuation_policies.erase(tenant, token,
+                                                  notify=False)
+        if stamp is not None:
+            self.actuation_policies.emit("remove", tenant, token, stamp)
+        return stamp is not None or removed
+
+    def apply_replicated_actuation_policy(self, op: str, tenant: str,
+                                          token: str, payload) -> bool:
+        """Gossip receive side: converge the durable store, then mirror
+        the live engine. An invalid spec raises ActuationPolicyError —
+        the structured 409 naming the offending field — BEFORE any store
+        mutation (same contract as the anomaly models)."""
+        engine = self.pipeline_engine
+        if op == "add":
+            spec, stamp = dict(payload["spec"]), int(payload["stamp"])
+            with self._actuation_policy_lock:
+                if not self.actuation_policies.would_apply_add(
+                        tenant, token, spec, stamp):
+                    return False
+                if engine is not None:
+                    engine.upsert_actuation_policy(spec)
+                return self.actuation_policies.apply_add(
+                    tenant, token, spec, stamp)
+        if op == "remove":
+            with self._actuation_policy_lock:
+                if self.actuation_policies.apply_remove(tenant, token,
+                                                        int(payload)):
+                    if engine is not None:
+                        engine.remove_actuation_policy(token)
+                    return True
+        return False
+
+    def _deliver_command_fire(self, fire: Dict) -> None:
+        """CommandFanout transport: route one lane fire through the
+        firing tenant's command-delivery stack. Raises (-> bounded retry,
+        then dead-letter) when the tenant engine is down or the device
+        has no active assignment."""
+        from sitewhere_tpu.actuation import deliver_via_service
+        from sitewhere_tpu.errors import SiteWhereError
+
+        tenant = fire.get("tenant") or ""
+        engine = self.engine_manager.get_engine(tenant)
+        if engine is None:
+            raise SiteWhereError(
+                f"no running tenant engine for '{tenant}'")
+        deliver_via_service(engine.command_delivery)(fire)
+
     # -- lifecycle ---------------------------------------------------------
     def on_initialize(self, monitor) -> None:
         self.event_log.start()  # background linger-flush thread
@@ -705,6 +809,13 @@ class SiteWhereInstance(LifecycleComponent):
             for mtoken, c in engine.anomaly_model_counters().items():
                 extra[f"pipeline.anomaly_model.fires.{mtoken}"] = c["fires"]
                 extra[f"pipeline.anomaly_model.evals.{mtoken}"] = c["evals"]
+            for atoken, c in engine.actuation_policy_counters().items():
+                extra[f"pipeline.actuation.fires.{atoken}"] = c["fires"]
+                extra[f"pipeline.actuation.debounced.{atoken}"] = \
+                    c["debounced"]
+            if self.command_fanout is not None:
+                for key, val in self.command_fanout.stats().items():
+                    extra[f"pipeline.command_fanout.{key}"] = val
             # HBM residency: hbm.table_bytes{table="..."} per resident
             # table + hbm.total_bytes (host-side nbytes walk, no device
             # sync — runtime/hbmledger.py)

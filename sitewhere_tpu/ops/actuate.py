@@ -1,0 +1,300 @@
+"""In-step actuation: policy evaluation + debounce + command-lane pack.
+
+Closing the detection->actuation loop ON DEVICE (ROADMAP item 5): right
+after anomaly scoring, every (batch row, policy) pair is tested against
+the step's fired alert bits (threshold/geofence/program/model), matched
+triggers are debounced against per-(device, policy) state carried in
+HBM (the ops/slab.py bit-exact packing, one ts/counter slot per
+policy), and the surviving (device, command) pairs prefix-sum-compact
+(the ops/compact.py pattern) into a SECOND fixed-capacity [4, K] int32
+lane the host fetches in the SAME materialize pass as the alert lanes —
+the one-fetch-per-step budget grows to exactly two fixed-shape fetches
+and detection->actuation never ships per-row arrays.
+
+Step semantics (tests/test_actuation.py pins them with a NumPy oracle):
+
+  * a policy MATCHES a batch row when any allowed source kind fired on
+    that row with (match_slot < 0 or the kind's slot id == match_slot)
+    and the kind's alert level >= min_level, the policy is active, and
+    the row's tenant matches (tenant_idx 0 = any);
+  * per device a policy TRIGGERS at most once per step, on the device's
+    LAST matching row (highest batch index) — one command per
+    (device, policy) per step;
+  * a trigger FIRES only when the debounce window allows: never fired
+    before (or the slot's epoch moved — the generation reset trick), or
+    trigger_ts - last_fire_ts >= debounce_ms, both in EVENT time so the
+    semantics replay deterministically; a blocked trigger counts as
+    DEBOUNCED and leaves the stored last-fire ts unchanged;
+  * fires pack into the command lane in (device, policy) ascending
+    order; rows beyond the K capacity are counted on device (counts[1])
+    and dropped loudly, never silently.
+
+On the sharded engine each device lives on exactly one shard, so the
+whole kernel is shard-local and the lane rides the shard axis like the
+alert lanes — no new collectives. Device indices in lane row 2 are
+shard-LOCAL; the materializer remaps to global exactly like alert rows.
+
+Lane layout ([COMMAND_LANE_ROWS, K] int32; slot i = i-th fired
+(device, policy) pair in device-major order):
+
+  row 0 (idx):    batch-row index of the triggering row; -1 unused
+  row 1 (meta):   policy slot bits 0-7 | trigger alert level bits 8-11 |
+                  trigger source kind bits 12-14 (PolicySource ids)
+  row 2 (dev):    shard-local device index of the fired device
+  row 3 (counts): [0] = commands fired this step (INCLUDING pairs beyond
+                  capacity), [1] = commands dropped by lane overflow,
+                  [2] = triggers debounced this step, [3] reserved (0)
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from sitewhere_tpu.actuation.compiler import (
+    ActuationPolicyTable, PolicySource)
+from sitewhere_tpu.ops.slab import state_slab_lanes
+
+_NEG = -(2 ** 31)
+
+COMMAND_LANE_ROWS = 4
+# bytes each lane slot costs on the wire — the perf gate's two-fetch
+# bytes budget adds capacity * this to the alert-lane term
+COMMAND_LANE_BYTES_PER_SLOT = COMMAND_LANE_ROWS * 4
+DEFAULT_COMMAND_LANE_CAPACITY = 64
+# counts ride slots 0..2 of the counts row
+MIN_COMMAND_LANE_CAPACITY = 4
+
+_LEVEL_SHIFT = 8
+_SOURCE_SHIFT = 12
+
+
+@struct.dataclass
+class ActuationStateTensors:
+    """Per-(device, policy) debounce state, HBM-resident like
+    RuleStateTensors/ModelStateTensors (sharded engines carry a leading
+    shard axis on every field).
+
+    The slab is the shared ops/slab.py layout with ONE state slot
+    [D, P, 6]: lane 2 = last command-fire ts (event time, _NEG = never),
+    lane 3 = per-(device, policy) cumulative fire counter, lane 5 = the
+    row generation vs the policy's table epoch; lanes 0/1/4 (value/aux/
+    flag) are unused and held at zero."""
+
+    slab: jnp.ndarray            # i32 [D, P, 6] fused debounce state
+    gen: jnp.ndarray             # i32 [P] counter-row generation
+    fire_count: jnp.ndarray      # i32 [P] cumulative commands fired
+    debounce_count: jnp.ndarray  # i32 [P] cumulative triggers debounced
+
+    @property
+    def num_policies(self) -> int:
+        return self.gen.shape[-1]
+
+
+def init_actuation_state_np(max_devices: int,
+                            max_policies: int) -> ActuationStateTensors:
+    """Numpy-leaved initial state (same contract as init_rule_state_np:
+    no device buffers, so sharded engines place the tree with ONE
+    device_put on their mesh)."""
+    D, P = max_devices, max_policies
+    slab = np.zeros((D, P, state_slab_lanes(1)), np.int32)
+    slab[:, :, 2] = _NEG   # last-fire ts plane: never fired
+    return ActuationStateTensors(
+        slab=slab,
+        gen=np.zeros((P,), np.int32),
+        fire_count=np.zeros((P,), np.int32),
+        debounce_count=np.zeros((P,), np.int32),
+    )
+
+
+def init_actuation_state(max_devices: int,
+                         max_policies: int) -> ActuationStateTensors:
+    return jax.tree_util.tree_map(
+        jnp.asarray, init_actuation_state_np(max_devices, max_policies))
+
+
+def eval_actuation_policies(
+        table: ActuationPolicyTable,
+        state: ActuationStateTensors,
+        *,
+        dev: jnp.ndarray,           # i32 [B] row device index (local)
+        ts: jnp.ndarray,            # i32 [B] row relative timestamps
+        tenant_row: jnp.ndarray,    # i32 [B] registry mirror per row
+        thr: Dict[str, jnp.ndarray],    # eval_threshold_rules output
+        geo: Dict[str, jnp.ndarray],    # eval_geofence_rules output
+        prog: Dict[str, jnp.ndarray],   # rule-program row dict
+        model: Dict[str, jnp.ndarray],  # anomaly-model row dict
+        capacity: int,
+) -> Tuple[ActuationStateTensors, jnp.ndarray]:
+    """One fused-step actuation advance (jax, call under jit/shard_map).
+
+    Returns (new_state, command_lanes [COMMAND_LANE_ROWS, capacity]).
+    Works per shard under shard_map: `dev` and the state's device axis
+    are shard-local, and every reduction here is per-device."""
+    if capacity < MIN_COMMAND_LANE_CAPACITY:
+        raise ValueError(
+            f"command lane capacity {capacity} < "
+            f"{MIN_COMMAND_LANE_CAPACITY}")
+    B = dev.shape[0]
+    D = state.slab.shape[0]
+    P = table.num_policies
+
+    # ---- per-(row, policy) matching over the step's fire bits ----------
+    # (fired, slot id, level) per source family, all [B]
+    families = (
+        (PolicySource.THRESHOLD, thr["fired"], thr["first_rule"],
+         thr["alert_level"]),
+        (PolicySource.GEOFENCE, geo["fired"], geo["first_rule"],
+         geo["alert_level"]),
+        (PolicySource.PROGRAM, prog["fired"], prog["first_rule"],
+         prog["alert_level"]),
+        (PolicySource.MODEL, model["fired"], model["first_model"],
+         model["alert_level"]),
+    )
+    tenant_ok = ((table.tenant_idx[None, :] == 0)
+                 | (table.tenant_idx[None, :] == tenant_row[:, None]))
+    eligible = table.active[None, :] & tenant_ok            # [B, P]
+
+    matched = jnp.zeros((B, P), bool)
+    # lowest matching source kind and max matching level per (row, policy)
+    trig_src = jnp.full((B, P), 8, jnp.int32)
+    trig_level = jnp.full((B, P), -1, jnp.int32)
+    for kind, fired_k, slot_k, level_k in families:
+        src_ok = ((table.source[None, :] == PolicySource.ANY)
+                  | (table.source[None, :] == kind))
+        slot_ok = ((table.match_slot[None, :] < 0)
+                   | (table.match_slot[None, :] == slot_k[:, None]))
+        level_ok = level_k[:, None] >= table.min_level[None, :]
+        m = eligible & fired_k[:, None] & src_ok & slot_ok & level_ok
+        matched = matched | m
+        trig_src = jnp.where(m, jnp.minimum(trig_src, kind), trig_src)
+        trig_level = jnp.where(m, jnp.maximum(trig_level, level_k[:, None]),
+                               trig_level)
+
+    # ---- per-(device, policy) trigger: LAST matching row wins ----------
+    row_ids = jnp.arange(B, dtype=jnp.int32)
+    slot_ids = jnp.arange(P, dtype=jnp.int32)
+    keyr = dev[:, None] * P + slot_ids[None, :]             # [B, P]
+    tgt = jnp.where(matched, keyr, D * P)
+    last_row = (jnp.full((D * P,), -1, jnp.int32)
+                .at[tgt.reshape(-1)]
+                .max(jnp.broadcast_to(row_ids[:, None], (B, P)).reshape(-1),
+                     mode="drop")
+                .reshape(D, P))
+    trig = last_row >= 0                                    # [D, P]
+    safe_row = jnp.clip(last_row, 0, B - 1)
+    fire_ts = ts[safe_row]                                  # [D, P]
+    lvl_dp = jnp.take_along_axis(trig_level, safe_row, axis=0)
+    src_dp = jnp.take_along_axis(trig_src, safe_row, axis=0)
+
+    # ---- debounce against stored last-fire ts (generation-reset) -------
+    stale = state.slab[:, :, 5] != table.epoch[None, :]     # [D, P]
+    last_ts = jnp.where(stale, _NEG, state.slab[:, :, 2])
+    ctr = jnp.where(stale, 0, state.slab[:, :, 3])
+    allow = ((last_ts == _NEG)
+             | ((fire_ts - last_ts) >= table.debounce_ms[None, :]))
+    fired_dp = trig & allow
+    debounced_dp = trig & ~allow
+
+    # ---- state write-back: only TRIGGERED records persist (and destale,
+    # zeroing the unused value/aux/flag lanes of a freshly reset row) ----
+    slab = state.slab
+    fresh = trig & stale
+    zero = jnp.zeros((D, P), jnp.int32)
+    slab = slab.at[:, :, 0].set(jnp.where(fresh, zero, slab[:, :, 0]))
+    slab = slab.at[:, :, 1].set(jnp.where(fresh, zero, slab[:, :, 1]))
+    slab = slab.at[:, :, 4].set(jnp.where(fresh, zero, slab[:, :, 4]))
+    slab = slab.at[:, :, 2].set(
+        jnp.where(trig, jnp.where(fired_dp, fire_ts, last_ts),
+                  slab[:, :, 2]))
+    slab = slab.at[:, :, 3].set(
+        jnp.where(trig, ctr + fired_dp.astype(jnp.int32), slab[:, :, 3]))
+    slab = slab.at[:, :, 5].set(
+        jnp.where(trig, jnp.broadcast_to(table.epoch[None, :], (D, P)),
+                  slab[:, :, 5]))
+
+    epoch_moved = state.gen != table.epoch
+    new_state = state.replace(
+        slab=slab,
+        gen=table.epoch,
+        fire_count=jnp.where(epoch_moved, 0, state.fire_count)
+        + jnp.sum(fired_dp, axis=0, dtype=jnp.int32),
+        debounce_count=jnp.where(epoch_moved, 0, state.debounce_count)
+        + jnp.sum(debounced_dp, axis=0, dtype=jnp.int32),
+    )
+
+    # ---- prefix-sum compaction into the command lane (device-major) ----
+    fired_flat = fired_dp.reshape(-1)
+    fired_i = fired_flat.astype(jnp.int32)
+    rank = jnp.cumsum(fired_i) - 1
+    keep = fired_flat & (rank < capacity)
+    slot = jnp.where(keep, rank, capacity)
+    idx_lane = jnp.full((capacity,), -1, jnp.int32).at[slot].set(
+        last_row.reshape(-1), mode="drop")
+    meta_dp = ((jnp.broadcast_to(slot_ids[None, :], (D, P)) & 0xFF)
+               | ((lvl_dp & 0xF) << _LEVEL_SHIFT)
+               | ((src_dp & 0x7) << _SOURCE_SHIFT))
+    meta_lane = jnp.zeros((capacity,), jnp.int32).at[slot].set(
+        meta_dp.reshape(-1), mode="drop")
+    dev_dp = jnp.broadcast_to(
+        jnp.arange(D, dtype=jnp.int32)[:, None], (D, P))
+    dev_lane = jnp.full((capacity,), -1, jnp.int32).at[slot].set(
+        dev_dp.reshape(-1), mode="drop")
+    total = jnp.sum(fired_i)
+    kept = jnp.sum(keep.astype(jnp.int32))
+    counts_lane = (jnp.zeros((capacity,), jnp.int32)
+                   .at[0].set(total)
+                   .at[1].set(total - kept)
+                   .at[2].set(jnp.sum(debounced_dp, dtype=jnp.int32)))
+    lanes = jnp.stack([idx_lane, meta_lane, dev_lane, counts_lane])
+    return new_state, lanes
+
+
+@dataclass
+class DecodedCommandLanes:
+    """Host-side view of one command-lane array's used slots ([n])."""
+
+    rows: np.ndarray         # int32 triggering batch-row indices
+    policy_slot: np.ndarray  # int32 policy slot ids
+    level: np.ndarray        # int32 trigger alert level
+    source: np.ndarray       # int32 trigger source kind (PolicySource)
+    dev: np.ndarray          # int32 shard-local device indices
+    fired: int               # commands fired incl. overflow
+    dropped: int             # commands lost to lane overflow
+    debounced: int           # triggers blocked by the debounce window
+
+    @property
+    def n(self) -> int:
+        return int(self.rows.shape[0])
+
+    def head(self, n: int) -> "DecodedCommandLanes":
+        """First `n` slots (bounding; counts untouched)."""
+        return DecodedCommandLanes(
+            rows=self.rows[:n], policy_slot=self.policy_slot[:n],
+            level=self.level[:n], source=self.source[:n],
+            dev=self.dev[:n], fired=self.fired, dropped=self.dropped,
+            debounced=self.debounced)
+
+
+def decode_command_lanes(lanes: np.ndarray) -> DecodedCommandLanes:
+    """Inverse of the lane pack on the fetched host copy (numpy)."""
+    lanes = np.asarray(lanes)
+    capacity = lanes.shape[-1]
+    counts = lanes[3]
+    fired = int(counts[0])
+    n = min(fired, capacity)
+    meta = lanes[1, :n]
+    return DecodedCommandLanes(
+        rows=lanes[0, :n],
+        policy_slot=(meta & 0xFF).astype(np.int32),
+        level=((meta >> _LEVEL_SHIFT) & 0xF).astype(np.int32),
+        source=((meta >> _SOURCE_SHIFT) & 0x7).astype(np.int32),
+        dev=lanes[2, :n],
+        fired=fired,
+        dropped=int(counts[1]),
+        debounced=int(counts[2]))

@@ -1036,6 +1036,13 @@ class RegistryGossip:
         if anomaly_models is not None:
             # anomaly-model installs share the rule-program algebra
             anomaly_models.add_listener(self._on_anomaly_model_mutation)
+        actuation_policies = getattr(instance, "actuation_policies", None)
+        if actuation_policies is not None:
+            # alert->command policies replicate the same way: a policy
+            # installed on one peer fires on every peer's shard of the
+            # fleet
+            actuation_policies.add_listener(
+                self._on_actuation_policy_mutation)
 
     def _on_script_mutation(self, op: str, scope: str, script_id: str,
                             payload) -> None:
@@ -1102,6 +1109,23 @@ class RegistryGossip:
         # names the offending field) BEFORE any local mutation — a
         # non-retryable conflict, same contract as _apply_rule_program
         if self.instance.apply_replicated_anomaly_model(
+                data.get("op", ""), data.get("tenant", ""),
+                data.get("token", ""), data.get("payload")):
+            self.applied += 1
+
+    def _on_actuation_policy_mutation(self, op: str, tenant: str,
+                                      token: str, payload) -> None:
+        if getattr(self._applying, "active", False) or not self.peers:
+            return
+        data = {"kind": "_actuation_policy", "op": op, "tenant": tenant,
+                "token": token, "payload": payload}
+        self._publish(token.encode(), data)
+
+    def _apply_actuation_policy(self, data: Dict) -> None:
+        # invalid specs raise the structured ActuationPolicyError (409,
+        # names the offending field) BEFORE any local mutation — a
+        # non-retryable conflict, same contract as _apply_anomaly_model
+        if self.instance.apply_replicated_actuation_policy(
                 data.get("op", ""), data.get("tenant", ""),
                 data.get("token", ""), data.get("payload")):
             self.applied += 1
@@ -1194,6 +1218,9 @@ class RegistryGossip:
             return
         if kind == "_model":
             self._apply_anomaly_model(data)
+            return
+        if kind == "_actuation_policy":
+            self._apply_actuation_policy(data)
             return
         cls = _gossip_class(kind)
         if cls is None:

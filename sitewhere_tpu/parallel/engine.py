@@ -408,6 +408,8 @@ class ShardedPipelineEngine(PipelineEngine):
             self._rule_state = self._init_rule_state()
         if self._model_state is None:
             self._model_state = self._init_model_state()
+        if self._actuation_state is None:
+            self._actuation_state = self._init_actuation_state()
         self._refresh_params()
         self._build_step()
 
@@ -452,6 +454,26 @@ class ShardedPipelineEngine(PipelineEngine):
         shard0 = NamedSharding(self.mesh, P(SHARD_AXIS))
         return _put_global_tree(stacked, _tree_specs(stacked, shard0))
 
+    def _init_actuation_state(self):
+        # actuation debounce state rides the shard axis exactly like the
+        # model state: per-shard [S, D/S, P, 6] fused slab lanes plus
+        # per-shard [S, P] generation/counter rows (fire/debounce counters
+        # are additive partials, summed on read). Sized by
+        # _actuation_state_dims: a [.., 1, ..] placeholder while no
+        # policies are installed (the stage is dropped at trace time).
+        from sitewhere_tpu.ops.actuate import init_actuation_state_np
+
+        dims = self._actuation_state_dims()
+        self._actuation_state_built_dims = dims
+        S = self.n_shards
+        local = init_actuation_state_np(
+            self.registry.devices.capacity // S, *dims)
+        stacked = jax.tree_util.tree_map(
+            lambda a: np.ascontiguousarray(
+                np.broadcast_to(a, (S,) + a.shape)), local)
+        shard0 = NamedSharding(self.mesh, P(SHARD_AXIS))
+        return _put_global_tree(stacked, _tree_specs(stacked, shard0))
+
     def _build_step_blob(self) -> None:
         # the single-chip jit is never used by the sharded engine; the
         # collective program is built by _build_step instead
@@ -464,6 +486,7 @@ class ShardedPipelineEngine(PipelineEngine):
                 != self._step_static_config()):
             self._ensure_rule_state_sized()
             self._ensure_model_state_sized()
+            self._ensure_actuation_state_sized()
             self._build_step()
 
     def _build_step(self) -> None:
@@ -479,10 +502,13 @@ class ShardedPipelineEngine(PipelineEngine):
             programs=_tree_specs(params_template.programs, rep),
             # model weight tables replicate like the rule tables (small,
             # read-only); only the feature STATE rides the shard axis
-            models=_tree_specs(params_template.models, rep))
+            models=_tree_specs(params_template.models, rep),
+            # policy tables replicate too; the debounce STATE is sharded
+            policies=_tree_specs(params_template.policies, rep))
         state_specs = _tree_specs(self._state, dev)
         rule_state_specs = _tree_specs(self._rule_state, dev)
         model_state_specs = _tree_specs(self._model_state, dev)
+        actuation_state_specs = _tree_specs(self._actuation_state, dev)
         blob_specs = dev  # [S, WIRE_ROWS, B] single staging blob, sharded on S
         out_specs = ProcessOutputs(
             valid=dev, unregistered=dev, threshold_fired=dev,
@@ -496,9 +522,12 @@ class ShardedPipelineEngine(PipelineEngine):
             alerts=rep,
             # per-shard compacted alert lanes ride the shard axis with
             # the other outputs — no extra collective, one host fetch
-            alert_lanes=dev)
-        programs_enabled, node_limit, models_enabled = (
-            self._step_static_config())
+            alert_lanes=dev,
+            # the command lane rides the same fetch, shard-major like the
+            # alert lane
+            command_lanes=dev)
+        (programs_enabled, node_limit, models_enabled,
+         actuation_enabled) = self._step_static_config()
 
         def sq(a):
             # shard_map hands blocks with the mapped axis kept (size 1); the
@@ -508,8 +537,8 @@ class ShardedPipelineEngine(PipelineEngine):
         def unsq(a):
             return a[None]
 
-        def local_step(params, state, rule_state, model_state, local_blob,
-                       route_dropped=None):
+        def local_step(params, state, rule_state, model_state,
+                       actuation_state, local_blob, route_dropped=None):
             """Shared per-shard body: fused step over an already-LOCAL
             [wire_rows, B] routed blob. `route_dropped` (device-routing
             prologue only) rides out on the alert lanes' spare counts
@@ -522,14 +551,19 @@ class ShardedPipelineEngine(PipelineEngine):
             state = jax.tree_util.tree_map(sq, state)
             rule_state = jax.tree_util.tree_map(sq, rule_state)
             model_state = jax.tree_util.tree_map(sq, model_state)
+            actuation_state = jax.tree_util.tree_map(sq, actuation_state)
             batch = blob_to_batch(local_blob)        # [12, B] -> columns
-            new_state, new_rule_state, new_model_state, out = process_batch(
-                params, state, rule_state, model_state, batch,
+            (new_state, new_rule_state, new_model_state,
+             new_actuation_state, out) = process_batch(
+                params, state, rule_state, model_state, actuation_state,
+                batch,
                 geofence_impl=self.geofence_impl,
                 alert_lane_capacity=self.alert_lane_capacity,
                 programs_enabled=programs_enabled,
                 program_node_limit=node_limit,
-                models_enabled=models_enabled)
+                models_enabled=models_enabled,
+                actuation_enabled=actuation_enabled,
+                command_lane_capacity=self.command_lane_capacity)
             lanes = out.alert_lanes
             if route_dropped is not None:
                 from sitewhere_tpu.ops.route import ROUTE_DROPPED_SLOT
@@ -537,6 +571,8 @@ class ShardedPipelineEngine(PipelineEngine):
             new_state = jax.tree_util.tree_map(unsq, new_state)
             new_rule_state = jax.tree_util.tree_map(unsq, new_rule_state)
             new_model_state = jax.tree_util.tree_map(unsq, new_model_state)
+            new_actuation_state = jax.tree_util.tree_map(
+                unsq, new_actuation_state)
             out = out.replace(
                 valid=unsq(out.valid), unregistered=unsq(out.unregistered),
                 threshold_fired=unsq(out.threshold_fired),
@@ -553,22 +589,26 @@ class ShardedPipelineEngine(PipelineEngine):
                 model_level=unsq(out.model_level),
                 model_score=unsq(out.model_score),
                 alert_lanes=unsq(lanes),
+                command_lanes=unsq(out.command_lanes),
                 tenant_counts=jax.lax.psum(out.tenant_counts, SHARD_AXIS),
                 processed=jax.lax.psum(out.processed, SHARD_AXIS),
                 alerts=jax.lax.psum(out.alerts, SHARD_AXIS))
-            return new_state, new_rule_state, new_model_state, out
+            return (new_state, new_rule_state, new_model_state,
+                    new_actuation_state, out)
 
-        def sharded(params, state, rule_state, model_state, blob):
+        def sharded(params, state, rule_state, model_state, actuation_state,
+                    blob):
             return local_step(params, state, rule_state, model_state,
-                              sq(blob))
+                              actuation_state, sq(blob))
 
         def build(fn, blob_spec):
             specs = dict(mesh=self.mesh,
                          in_specs=(params_specs, state_specs,
                                    rule_state_specs, model_state_specs,
-                                   blob_spec),
+                                   actuation_state_specs, blob_spec),
                          out_specs=(state_specs, rule_state_specs,
-                                    model_state_specs, out_specs))
+                                    model_state_specs,
+                                    actuation_state_specs, out_specs))
             try:
                 # the geofence containment scan's carry is replicated
                 # only through the psum at the end of the step — a loop
@@ -578,7 +618,7 @@ class ShardedPipelineEngine(PipelineEngine):
                 mapped = _shard_map(fn, check_vma=False, **specs)
             except TypeError:  # older jax spells it check_rep
                 mapped = _shard_map(fn, check_rep=False, **specs)
-            return jax.jit(mapped, donate_argnums=(1, 2, 3))
+            return jax.jit(mapped, donate_argnums=(1, 2, 3, 4))
 
         self._sharded_step = build(sharded, blob_specs)
         if self.device_routing:
@@ -588,7 +628,7 @@ class ShardedPipelineEngine(PipelineEngine):
             lane_cap = self.route_lane_capacity
 
             def sharded_device(params, state, rule_state, model_state,
-                               flat_blob):
+                               actuation_state, flat_blob):
                 # flat_blob block: [wire_rows, B] UNROUTED lane chunk
                 # (the flat blob split along lanes, P(None, shard)) —
                 # the routing prologue buckets + all_to_all's it to the
@@ -596,14 +636,15 @@ class ShardedPipelineEngine(PipelineEngine):
                 local_blob, dropped = device_route_chunk(
                     flat_blob, n_shards, per_shard, lane_cap, SHARD_AXIS)
                 return local_step(params, state, rule_state, model_state,
-                                  local_blob, route_dropped=dropped)
+                                  actuation_state, local_blob,
+                                  route_dropped=dropped)
 
             self._sharded_step_device = build(
                 sharded_device, P(None, SHARD_AXIS))
         else:
             self._sharded_step_device = None
         self._sharded_built_config = (programs_enabled, node_limit,
-                                      models_enabled)
+                                      models_enabled, actuation_enabled)
 
     # -- params ---------------------------------------------------------------
 
@@ -613,6 +654,7 @@ class ShardedPipelineEngine(PipelineEngine):
         geofence = self._compile_geofence_table()
         programs = self._compile_program_table()
         models = self._compile_model_table()
+        policies = self._compile_policy_table()
         from sitewhere_tpu.ops.geofence import ZoneTable
         zones = ZoneTable(vertices=snap.zone_vertices, nvert=snap.zone_nvert,
                           tenant_idx=snap.zone_tenant, active=snap.zone_active)
@@ -626,7 +668,7 @@ class ShardedPipelineEngine(PipelineEngine):
             area_idx=router.shard_param(snap.area_idx),
             device_type_idx=router.shard_param(snap.device_type_idx),
             threshold=threshold, zones=zones, geofence=geofence,
-            programs=programs, models=models)
+            programs=programs, models=models, policies=policies)
         shardings = PipelineParams(
             assignment_status=shard0, tenant_idx=shard0, area_idx=shard0,
             device_type_idx=shard0,
@@ -634,7 +676,8 @@ class ShardedPipelineEngine(PipelineEngine):
             zones=_tree_specs(zones, rep),
             geofence=_tree_specs(geofence, rep),
             programs=_tree_specs(programs, rep),
-            models=_tree_specs(models, rep))
+            models=_tree_specs(models, rep),
+            policies=_tree_specs(policies, rep))
         self._params = _put_global_tree(params, shardings)
         self._params_built_for = (snap.version, self._rules_version)
 
@@ -907,7 +950,8 @@ class ShardedPipelineEngine(PipelineEngine):
         try:
             outputs = self._dispatch_with_retry(
                 lambda: step(params, self._state, self._rule_state,
-                             self._model_state, staged.blob),
+                             self._model_state, self._actuation_state,
+                             staged.blob),
                 points=("dispatch_error",))
         except BaseException:
             if staged.slot is not None:
@@ -1015,14 +1059,16 @@ class ShardedPipelineEngine(PipelineEngine):
             rec.begin_stage("lane_fetch")
         if self.is_multiprocess:
             lanes = self._gather_local(outputs.alert_lanes)
+            cmd_lanes = self._gather_local(outputs.command_lanes)
         else:
-            lanes = self._fetch_lanes_with_retry(outputs)  # [S, ROWS, K]
+            # [S, ROWS, K] alert lanes + [S, 4, Kc] command lanes
+            lanes, cmd_lanes = self._fetch_lanes_with_retry(outputs)
         if rec is not None:
             rec.end_stage("lane_fetch")
             self._stage_hist.observe(rec.stage_s("lane_fetch"),
                                      engine=self.name, stage="lane_fetch")
-        self.d2h_fetches += 1
-        self.d2h_bytes += lanes.nbytes
+        self.d2h_fetches += 2
+        self.d2h_bytes += lanes.nbytes + cmd_lanes.nbytes
         if rec is not None:
             rec.begin_stage("materialize")
         try:
@@ -1075,7 +1121,53 @@ class ShardedPipelineEngine(PipelineEngine):
                 self._stage_hist.observe(
                     rec.stage_s("materialize"),
                     engine=self.name, stage="materialize")
+            self._materialize_commands_sharded(cmd_lanes, rec, shard_ids)
+            if rec is not None:
                 self._close_age(rec)
+
+    def _materialize_commands_sharded(self, cmd_lanes: np.ndarray, rec,
+                                      shard_ids) -> None:
+        """Decode the per-shard command lanes ([S, 4, Kc], same fetch as
+        the alert lanes) and resolve fires with GLOBAL device indices
+        (local l on shard s is global l * S + s); accounting, token
+        resolution, and fan-out are shared with the single-chip engine.
+        Rows remap shard-major like the alert lanes so the fire order
+        matches the flattened oracle scan."""
+        from sitewhere_tpu.ops.actuate import (
+            DecodedCommandLanes, decode_command_lanes)
+
+        if rec is not None:
+            rec.begin_stage("actuate")
+        try:
+            S = cmd_lanes.shape[0]
+            ids = (np.arange(S, dtype=np.int32) if shard_ids is None
+                   else np.array(shard_ids, np.int32))
+            decs = [decode_command_lanes(cmd_lanes[s]) for s in range(S)]
+            B = self.batch_size
+            combined = DecodedCommandLanes(
+                rows=np.concatenate(
+                    [s * B + d.rows for s, d in enumerate(decs)]),
+                policy_slot=np.concatenate(
+                    [d.policy_slot for d in decs]),
+                level=np.concatenate([d.level for d in decs]),
+                source=np.concatenate([d.source for d in decs]),
+                dev=np.concatenate(
+                    [d.dev * self.n_shards + ids[s]
+                     for s, d in enumerate(decs)]),
+                fired=sum(d.fired for d in decs),
+                dropped=sum(d.dropped for d in decs),
+                debounced=sum(d.debounced for d in decs))
+            self._account_command_activity(combined)
+            fires = (self._emit_command_fires(combined)
+                     if combined.n else [])
+            if rec is not None:
+                rec.commands = len(fires)
+        finally:
+            if rec is not None:
+                rec.end_stage("actuate")
+                self._stage_hist.observe(rec.stage_s("actuate"),
+                                         engine=self.name, stage="actuate")
+        self._fanout_commands(fires, rec)
 
     def _account_route_dropped(self, dropped: int) -> None:
         """Defensive on-device route drop accounting (lane counts slot 3,
@@ -1520,6 +1612,114 @@ class ShardedPipelineEngine(PipelineEngine):
         with self._state_lock:
             self._model_state = ModelStateTensors(**out)
             self._model_state_built_dims = self._model_state_dims()
+
+    _ACTUATION_STATE_POLICY_FIELDS = ("gen", "fire_count", "debounce_count")
+
+    def canonical_actuation_state(self):
+        """Flat device-major actuation debounce-state snapshot, mirroring
+        canonical_model_state: device-indexed slab lanes un-shard via the
+        router layout; per-shard fire/debounce counters (additive
+        partials) sum; `gen` takes the per-slot max."""
+        import dataclasses as _dc
+
+        import jax.numpy as jnp
+
+        if self._actuation_state is None:
+            return None
+        if self.is_multiprocess:
+            from sitewhere_tpu.errors import ErrorCode, SiteWhereError
+
+            raise SiteWhereError(
+                "multi-host canonical gather is not available on a live "
+                "cluster; merge per-host checkpoints offline with "
+                "assemble-checkpoint", ErrorCode.GENERIC, http_status=409)
+        with self._state_lock:
+            snap = jax.tree_util.tree_map(jnp.copy, self._actuation_state)
+        out = {}
+        for f in _dc.fields(snap):
+            a = np.asarray(getattr(snap, f.name))
+            if f.name in ("fire_count", "debounce_count"):
+                out[f.name] = a.sum(0, dtype=a.dtype)
+            elif f.name == "gen":
+                out[f.name] = a.max(0)
+            else:
+                out[f.name] = self.router.unshard_param(a)
+        from sitewhere_tpu.ops.actuate import ActuationStateTensors
+        return ActuationStateTensors(**out)
+
+    def load_canonical_actuation_state(self, actuation_state) -> None:
+        import dataclasses as _dc
+
+        from sitewhere_tpu.ops.actuate import ActuationStateTensors
+
+        self._validate_canonical_actuation_state(actuation_state)
+        S = self.n_shards
+        out = {}
+        for f in _dc.fields(ActuationStateTensors):
+            a = np.asarray(getattr(actuation_state, f.name))
+            if f.name in self._ACTUATION_STATE_POLICY_FIELDS:
+                stacked = np.zeros((S,) + a.shape, a.dtype)
+                if f.name == "gen":
+                    # generations must match on EVERY shard or the next
+                    # step's stale check would wipe the restored rows
+                    stacked[:] = a
+                else:
+                    stacked[0] = a  # additive counters land on shard 0
+                out[f.name] = stacked
+            else:
+                out[f.name] = self.router.shard_param(a)
+        stacked_state = ActuationStateTensors(**out)
+        shard0 = NamedSharding(self.mesh, P(SHARD_AXIS))
+        with self._state_lock:
+            self._actuation_state = _put_global_tree(
+                stacked_state, _tree_specs(stacked_state, shard0))
+            self._actuation_state_built_dims = self._actuation_state_dims()
+
+    def local_actuation_state_blocks(self):
+        """THIS host's shard blocks of the actuation debounce state (the
+        per-host complement of canonical_actuation_state; pure local D2H,
+        no collective)."""
+        import dataclasses as _dc
+
+        if self._actuation_state is None:
+            return None
+        with self._state_lock:
+            blocks = {}
+            for f in _dc.fields(self._actuation_state):
+                arr = getattr(self._actuation_state, f.name)
+                blocks[f.name] = (self._gather_local(arr)
+                                  if self.is_multiprocess
+                                  else np.asarray(arr))
+        return blocks
+
+    def load_local_actuation_state_blocks(self, blocks) -> None:
+        import dataclasses as _dc
+
+        from sitewhere_tpu.ops.actuate import ActuationStateTensors
+
+        shard0 = NamedSharding(self.mesh, P(SHARD_AXIS))
+        S = self.n_shards
+        canonical = self._expected_actuation_state_shapes()
+        out = {}
+        for f in _dc.fields(ActuationStateTensors):
+            local = np.ascontiguousarray(blocks[f.name])
+            flat = canonical[f.name]
+            expect = ((S, flat[0] / S) + flat[1:]
+                      if f.name not in self._ACTUATION_STATE_POLICY_FIELDS
+                      else (S,) + flat)
+            global_shape = (S,) + tuple(local.shape[1:])
+            if tuple(global_shape) != tuple(expect):
+                raise ValueError(
+                    f"host-shard actuation-state field {f.name}: global "
+                    f"shape {global_shape} != engine {tuple(expect)}")
+            if self.is_multiprocess:
+                out[f.name] = jax.make_array_from_process_local_data(
+                    shard0, local, global_shape)
+            else:
+                out[f.name] = jax.device_put(local, shard0)
+        with self._state_lock:
+            self._actuation_state = ActuationStateTensors(**out)
+            self._actuation_state_built_dims = self._actuation_state_dims()
 
     def pending_overflow_batch(self) -> Optional[EventBatch]:
         """The parked overflow rows as a flat host batch (checkpoint saves

@@ -146,6 +146,34 @@ def _permute_model_state_rows(kwargs: Dict[str, np.ndarray],
     return out
 
 
+# actuation state fields with a device-major leading axis (the rest —
+# gen/fire_count/debounce_count — are policy-indexed and move verbatim)
+_ACTUATION_STATE_DEVICE_FIELDS = ("slab",)
+
+
+def _permute_actuation_state_rows(kwargs: Dict[str, np.ndarray],
+                                  perm: np.ndarray) -> Dict[str, np.ndarray]:
+    """Re-index the actuation state's device-major rows old -> perm[old]
+    (elastic restore, mirrors _permute_model_state_rows): untouched rows
+    keep init sentinels so unmapped devices start debounce windows
+    fresh."""
+    from sitewhere_tpu.ops.actuate import init_actuation_state_np
+
+    sample = kwargs["slab"]
+    init = init_actuation_state_np(sample.shape[0], sample.shape[1])
+    out = {}
+    old_idx = np.nonzero(perm)[0]
+    new_idx = perm[old_idx]
+    for name, array in kwargs.items():
+        if name not in _ACTUATION_STATE_DEVICE_FIELDS:
+            out[name] = array
+            continue
+        fresh = np.array(getattr(init, name))
+        fresh[new_idx] = array[old_idx]
+        out[name] = fresh
+    return out
+
+
 def _migrate_state_cols(cols: Dict[str, np.ndarray], *, flag_field: str
                         ) -> Dict[str, np.ndarray]:
     """Fuse a pre-slab checkpoint's separate state columns
@@ -441,6 +469,16 @@ def assemble_canonical(paths: List[str]):
             if token and token not in seen_models:
                 seen_models.add(token)
                 anomaly_models.append({"spec": dict(row["spec"])})
+    # actuation policies union identically (slot/epoch stripped): the
+    # assembled restore re-installs fresh and debounce windows restart
+    actuation_policies: List[Dict] = []
+    seen_policies = set()
+    for manifest, _ in loads:
+        for row in manifest.get("actuation_policies", []):
+            token = (row.get("spec") or {}).get("token")
+            if token and token not in seen_policies:
+                seen_policies.add(token)
+                actuation_policies.append({"spec": dict(row["spec"])})
     out_manifest: Dict[str, Any] = {
         "epoch_base_ms": base,
         "interners": {"devices": device_tokens,
@@ -452,6 +490,7 @@ def assemble_canonical(paths: List[str]):
         "rules": rules,
         "rule_programs": rule_programs,
         "anomaly_models": anomaly_models,
+        "actuation_policies": actuation_policies,
         "assembled_from": [os.path.basename(p) for p in paths],
     }
     return out_manifest, canonical, overflow_cols
@@ -540,6 +579,12 @@ class PipelineCheckpointer:
             if model_blocks:
                 arrays.update({f"modelstate.{name}": np.asarray(block)
                                for name, block in model_blocks.items()})
+            act_blocks = (engine.local_actuation_state_blocks()
+                          if hasattr(engine, "local_actuation_state_blocks")
+                          else None)
+            if act_blocks:
+                arrays.update({f"actstate.{name}": np.asarray(block)
+                               for name, block in act_blocks.items()})
             overflow = engine.pending_overflow_batch()
             if overflow is not None:
                 for f in dataclasses.fields(overflow):
@@ -587,6 +632,17 @@ class PipelineCheckpointer:
                     f"modelstate.{f.name}": np.asarray(
                         getattr(model_state, f.name))
                     for f in dataclasses.fields(model_state)})
+            # per-(device, policy) debounce state rides the same way: a
+            # restart must not re-fire a command inside a policy's
+            # debounce window, re-joined by the manifest's slot/epoch pins
+            act_state = (engine.canonical_actuation_state()
+                         if hasattr(engine, "canonical_actuation_state")
+                         else None)
+            if act_state is not None:
+                arrays.update({
+                    f"actstate.{f.name}": np.asarray(
+                        getattr(act_state, f.name))
+                    for f in dataclasses.fields(act_state)})
         packer = engine.packer
         manifest: Dict[str, Any] = {
             "epoch_base_ms": packer.epoch_base_ms,
@@ -622,6 +678,11 @@ class PipelineCheckpointer:
             "anomaly_models": (engine.anomaly_model_manifest()
                                if hasattr(engine, "anomaly_model_manifest")
                                else []),
+            # actuation policies with their (slot, epoch) assignment:
+            # restore re-pins debounce state to its policy mid-window
+            "actuation_policies": (
+                engine.actuation_policy_manifest()
+                if hasattr(engine, "actuation_policy_manifest") else []),
             # fencing stamp: a successor that took over this shard group
             # minted a higher epoch; its checkpoints outrank ours and
             # _fence_stale_save refuses to let a zombie clobber them
@@ -727,6 +788,10 @@ class PipelineCheckpointer:
                     key[len("modelstate."):]: np.asarray(data[key])
                     for key in data.files if key.startswith("modelstate.")
                 }
+                act_state_cols = {
+                    key[len("actstate."):]: np.asarray(data[key])
+                    for key in data.files if key.startswith("actstate.")
+                }
         except (OSError, ValueError, KeyError) as err:
             # a pre-digest checkpoint torn some other way (np.load raises
             # ValueError/BadZipFile subclasses): same treatment as a
@@ -755,6 +820,10 @@ class PipelineCheckpointer:
         # anomaly models likewise re-install before their state loads so
         # the restored row generations meet matching table epochs
         self._restore_anomaly_models(engine, manifest.get("anomaly_models"))
+        # actuation policies too: their debounce rows must meet the same
+        # slot/epoch pins or the stale check would re-open closed windows
+        self._restore_actuation_policies(engine,
+                                         manifest.get("actuation_policies"))
         if manifest.get("layout") == "host-shards":
             # per-host gang-restart checkpoint: same-topology restore of
             # this host's shard blocks + the verbatim overflow batch
@@ -765,6 +834,9 @@ class PipelineCheckpointer:
             if model_state_cols and hasattr(
                     engine, "load_local_model_state_blocks"):
                 engine.load_local_model_state_blocks(model_state_cols)
+            if act_state_cols and hasattr(
+                    engine, "load_local_actuation_state_blocks"):
+                engine.load_local_actuation_state_blocks(act_state_cols)
             if overflow_cols:
                 from sitewhere_tpu.ops.pack import EventBatch
 
@@ -785,6 +857,9 @@ class PipelineCheckpointer:
                 if model_state_cols:
                     model_state_cols = _permute_model_state_rows(
                         model_state_cols, perm)
+                if act_state_cols:
+                    act_state_cols = _permute_actuation_state_rows(
+                        act_state_cols, perm)
                 if overflow_cols:
                     valid_rows = overflow_cols["device_idx"] < len(perm)
                     overflow_cols["device_idx"] = np.where(
@@ -819,6 +894,19 @@ class PipelineCheckpointer:
                     logging.getLogger("sitewhere.checkpoint").exception(
                         "anomaly-model state did not restore (bucket "
                         "mismatch); feature windows restart fresh")
+            if act_state_cols and hasattr(
+                    engine, "load_canonical_actuation_state"):
+                from sitewhere_tpu.ops.actuate import ActuationStateTensors
+
+                try:
+                    engine.load_canonical_actuation_state(
+                        ActuationStateTensors(**act_state_cols))
+                except (TypeError, ValueError):
+                    import logging
+
+                    logging.getLogger("sitewhere.checkpoint").exception(
+                        "actuation state did not restore (bucket "
+                        "mismatch); debounce windows restart fresh")
         packer.epoch_base_ms = manifest["epoch_base_ms"]
         packer.measurements.restore(manifest["interners"]["measurements"])
         packer.alert_types.restore(manifest["interners"]["alert_types"])
@@ -953,6 +1041,28 @@ class PipelineCheckpointer:
                     "checkpointed anomaly model %r did not restore",
                     (row.get("spec") or {}).get("token"))
 
+    @staticmethod
+    def _restore_actuation_policies(engine,
+                                    rows: Optional[List[Dict]]) -> None:
+        """Re-install checkpointed actuation policies, pinning each to
+        its saved (slot, epoch) so the restored debounce rows line up and
+        mid-window suppression resumes. A policy the engine's static
+        buckets cannot hold logs and skips (its slot's state resets)
+        rather than failing the whole restore."""
+        if not rows or not hasattr(engine, "upsert_actuation_policy"):
+            return
+        for row in rows:
+            try:
+                engine.upsert_actuation_policy(dict(row.get("spec") or {}),
+                                               slot=row.get("slot"),
+                                               epoch=row.get("epoch"))
+            except Exception:
+                import logging
+
+                logging.getLogger("sitewhere.checkpoint").exception(
+                    "checkpointed actuation policy %r did not restore",
+                    (row.get("spec") or {}).get("token"))
+
     # -- recovery ----------------------------------------------------------
     def recover(self, engine, bus, topic: str, group_id: str,
                 replay_handler, max_records: int = 4096) -> int:
@@ -1074,6 +1184,8 @@ class InstanceCheckpointManager:
                 self.instance.rule_programs.export_state(),
             "anomaly_model_installs":
                 self.instance.anomaly_models.export_state(),
+            "actuation_policy_installs":
+                self.instance.actuation_policies.export_state(),
             "provisioning": export_provisioning(self.instance),
             # exactly-once-effects replay (runtime/recovery.py): the
             # per-tenant eventlog high-watermarks are the replay cursor's
@@ -1291,6 +1403,19 @@ class InstanceCheckpointManager:
 
                 logging.getLogger("sitewhere.checkpoint").exception(
                     "checkpointed anomaly model %s/%s did not restore",
+                    row.get("tenant"), row.get("token"))
+        for row in (manifest.get("actuation_policy_installs") or {}).get(
+                "installs", []):
+            try:
+                self.instance.apply_replicated_actuation_policy(
+                    "add", row["tenant"], row["token"],
+                    {"spec": row["spec"],
+                     "stamp": int(row.get("stamp", 0))})
+            except Exception:
+                import logging
+
+                logging.getLogger("sitewhere.checkpoint").exception(
+                    "checkpointed actuation policy %s/%s did not restore",
                     row.get("tenant"), row.get("token"))
 
     # -- lifecycle ---------------------------------------------------------

@@ -255,10 +255,17 @@ class PipelineEngine(LifecycleComponent):
                  anomaly_model_features: int = 4,
                  anomaly_model_layers: int = 2,
                  anomaly_model_width: int = 8,
-                 h2d_buffer_depth: int = 3):
+                 h2d_buffer_depth: int = 3,
+                 max_actuation_policies: int = 8,
+                 command_lane_capacity: Optional[int] = None,
+                 max_command_tokens: int = 1024):
+        from sitewhere_tpu.actuation.compiler import MAX_POLICY_BUCKET
         from sitewhere_tpu.ml.compiler import MAX_MODEL_BUCKET
+        from sitewhere_tpu.ops.actuate import (
+            DEFAULT_COMMAND_LANE_CAPACITY, MIN_COMMAND_LANE_CAPACITY)
         from sitewhere_tpu.ops.compact import (
             DEFAULT_ALERT_LANE_CAPACITY, MIN_ALERT_LANE_CAPACITY)
+        from sitewhere_tpu.registry.interning import TokenInterner
         from sitewhere_tpu.rules.compiler import MAX_PROGRAM_BUCKET
 
         super().__init__(name)
@@ -294,6 +301,23 @@ class PipelineEngine(LifecycleComponent):
         self.anomaly_model_features = anomaly_model_features
         self.anomaly_model_layers = anomaly_model_layers
         self.anomaly_model_width = anomaly_model_width
+        # actuation-policy slot ids travel in 8 command-lane meta bits
+        # (ops/actuate.py lane meta packing)
+        if not (0 < max_actuation_policies <= MAX_POLICY_BUCKET):
+            raise ValueError(
+                f"max_actuation_policies must be in 1..{MAX_POLICY_BUCKET} "
+                f"(command-lane policy-id field width)")
+        self.max_actuation_policies = max_actuation_policies
+        self.command_lane_capacity = (
+            command_lane_capacity if command_lane_capacity is not None
+            else DEFAULT_COMMAND_LANE_CAPACITY)
+        if self.command_lane_capacity < MIN_COMMAND_LANE_CAPACITY:
+            raise ValueError(
+                f"command_lane_capacity must be >= "
+                f"{MIN_COMMAND_LANE_CAPACITY}")
+        # command tokens the dispatcher resolves lane rows back through
+        # (the same dense-index discipline the device interner uses)
+        self.commands = TokenInterner(max_command_tokens, "commands")
         self.alert_lane_capacity = (alert_lane_capacity
                                     if alert_lane_capacity is not None
                                     else DEFAULT_ALERT_LANE_CAPACITY)
@@ -321,6 +345,21 @@ class PipelineEngine(LifecycleComponent):
         self._model_epoch = 0
         self._models_enabled = False
         self._model_state = None
+        # actuation policies: token -> {"slot", "epoch", "spec"}, the same
+        # stable-slot/epoch discipline (actuation/compiler.py
+        # ActuationPolicyTable.epoch drives lazy debounce-state reset)
+        self._actuation_policies: Dict[str, Dict] = {}
+        self._actuation_epoch = 0
+        self._actuation_enabled = False
+        self._actuation_state = None
+        # command fan-out: decoded lane rows hand off here. With no
+        # dispatcher attached (tests, bare engines) fires park on the
+        # pending list and drain via take_command_fires().
+        self.command_dispatcher = None
+        self._pending_commands: List[Dict] = []
+        self.commands_fired = 0
+        self.commands_debounced = 0
+        self.commands_dropped = 0
         self._rules_version = 0
         # (op, kind, rule-or-token) feed over rule mutations — the rule
         # management surface rides it (REST audit, cluster replication)
@@ -423,7 +462,8 @@ class PipelineEngine(LifecycleComponent):
         compiled program like any other params refresh)."""
         return (self._programs_enabled,
                 getattr(self, "_program_nodes_in_use", 0),
-                self._models_enabled)
+                self._models_enabled,
+                self._actuation_enabled)
 
     def _build_step_blob(self) -> None:
         """(Re)build the jitted fused step. Called at construction and on
@@ -431,26 +471,31 @@ class PipelineEngine(LifecycleComponent):
         at TRACE time when no programs are installed, so the common case
         pays nothing — one recompile per transition, like any other
         static-shape change."""
-        programs_enabled, node_limit, models_enabled = (
-            self._step_static_config())
+        (programs_enabled, node_limit, models_enabled,
+         actuation_enabled) = self._step_static_config()
 
-        def step_blob(params, state, rule_state, model_state, blob):
+        def step_blob(params, state, rule_state, model_state,
+                      actuation_state, blob):
             return process_batch(params, state, rule_state, model_state,
-                                 blob_to_batch(blob),
+                                 actuation_state, blob_to_batch(blob),
                                  geofence_impl=self.geofence_impl,
                                  alert_lane_capacity=self.alert_lane_capacity,
                                  programs_enabled=programs_enabled,
                                  program_node_limit=node_limit,
-                                 models_enabled=models_enabled)
+                                 models_enabled=models_enabled,
+                                 actuation_enabled=actuation_enabled,
+                                 command_lane_capacity=(
+                                     self.command_lane_capacity))
 
-        self._step_blob = jax.jit(step_blob, donate_argnums=(1, 2, 3))
+        self._step_blob = jax.jit(step_blob, donate_argnums=(1, 2, 3, 4))
         self._step_built_config = (programs_enabled, node_limit,
-                                   models_enabled)
+                                   models_enabled, actuation_enabled)
 
     def _ensure_step_current(self) -> None:
         if self._step_built_config != self._step_static_config():
             self._ensure_rule_state_sized()
             self._ensure_model_state_sized()
+            self._ensure_actuation_state_sized()
             self._build_step_blob()
 
     def _rule_state_dims(self):
@@ -498,6 +543,27 @@ class PipelineEngine(LifecycleComponent):
             with self._state_lock:
                 self._model_state = self._init_model_state()
 
+    def _actuation_state_dims(self):
+        """(P,) the resident ActuationStateTensors are sized for — the
+        same placeholder-when-empty discipline as _rule_state_dims."""
+        if self._actuation_enabled:
+            return (self.max_actuation_policies,)
+        return (1,)
+
+    def _init_actuation_state(self):
+        from sitewhere_tpu.ops.actuate import init_actuation_state
+
+        dims = self._actuation_state_dims()
+        self._actuation_state_built_dims = dims
+        return init_actuation_state(self.registry.devices.capacity, *dims)
+
+    def _ensure_actuation_state_sized(self) -> None:
+        if (self._actuation_state is not None
+                and getattr(self, "_actuation_state_built_dims", None)
+                != self._actuation_state_dims()):
+            with self._state_lock:
+                self._actuation_state = self._init_actuation_state()
+
     # -- lifecycle ------------------------------------------------------------
 
     def on_initialize(self, monitor) -> None:
@@ -507,6 +573,8 @@ class PipelineEngine(LifecycleComponent):
             self._rule_state = self._init_rule_state()
         if self._model_state is None:
             self._model_state = self._init_model_state()
+        if self._actuation_state is None:
+            self._actuation_state = self._init_actuation_state()
         self._refresh_params()
 
     def on_start(self, monitor) -> None:
@@ -1028,6 +1096,182 @@ class PipelineEngine(LifecycleComponent):
             self._model_state = jax.device_put(model_state)
             self._model_state_built_dims = self._model_state_dims()
 
+    # -- actuation policies (alert->command; actuation/compiler.py) ---------
+
+    def _compile_policy_table(self):
+        from sitewhere_tpu.actuation.compiler import (
+            compile_policy_into, empty_policy_table)
+
+        table = empty_policy_table(self.max_actuation_policies)
+        for entry in self._actuation_policies.values():
+            compile_policy_into(
+                table, entry["slot"], entry["spec"], entry["epoch"],
+                intern_command=self.commands.intern,
+                lookup_tenant=self.registry.tenants.lookup)
+        return table
+
+    def _validate_policy_spec(self, spec: Dict) -> Dict:
+        """Dry-run compile against THIS engine's command interner: a spec
+        that passes turns into table rows without crashing the hot path.
+        Raises ActuationPolicyError (409, names the field) otherwise —
+        the contract shared by the REST and replicated-apply paths."""
+        from sitewhere_tpu.actuation.compiler import dry_run_compile
+
+        return dry_run_compile(spec, intern_command=self.commands.intern)
+
+    def upsert_actuation_policy(self, spec: Dict, *,
+                                slot: Optional[int] = None,
+                                epoch: Optional[int] = None) -> Dict:
+        """Install or replace an actuation policy (idempotent — boot
+        config, checkpoint restore, cluster replication). A replace bumps
+        the slot's epoch so its per-(device, policy) debounce state resets
+        inside the fused step; `slot`/`epoch` pin the assignment on
+        checkpoint restore so mid-window debounce state lines back up
+        with its policy."""
+        from sitewhere_tpu.errors import ErrorCode, SiteWhereError
+
+        spec = self._validate_policy_spec(spec)
+        token = spec["token"]
+        with self._rules_io_lock:
+            with self._lock:
+                existing = self._actuation_policies.get(token)
+                if slot is None:
+                    if existing is not None:
+                        slot = existing["slot"]
+                    else:
+                        used = {e["slot"]
+                                for e in self._actuation_policies.values()}
+                        free = [s for s
+                                in range(self.max_actuation_policies)
+                                if s not in used]
+                        if not free:
+                            raise SiteWhereError(
+                                "actuation policy capacity exceeded "
+                                f"({self.max_actuation_policies} slots)",
+                                ErrorCode.CAPACITY_EXCEEDED,
+                                http_status=409)
+                        slot = free[0]
+                if epoch is None:
+                    self._actuation_epoch += 1
+                    epoch = self._actuation_epoch
+                else:
+                    self._actuation_epoch = max(self._actuation_epoch,
+                                                epoch)
+                entry = {"slot": int(slot), "epoch": int(epoch),
+                         "spec": spec}
+                self._actuation_policies[token] = entry
+                self._actuation_enabled = True
+                self._rules_version += 1
+        return entry
+
+    def create_actuation_policy(self, spec: Dict) -> Dict:
+        """REST create semantics: duplicate token 409s atomically."""
+        from sitewhere_tpu.errors import DuplicateTokenError
+
+        with self._lock:
+            token = (spec or {}).get("token")
+            if token in self._actuation_policies:
+                raise DuplicateTokenError(
+                    f"actuation policy '{token}' already exists")
+        return self.upsert_actuation_policy(spec)
+
+    def remove_actuation_policy(self, token: str) -> bool:
+        with self._rules_io_lock:
+            with self._lock:
+                entry = self._actuation_policies.pop(token, None)
+                if entry is None:
+                    return False
+                self._actuation_enabled = bool(self._actuation_policies)
+                self._rules_version += 1
+        return True
+
+    def get_actuation_policy(self, token: str) -> Optional[Dict]:
+        with self._lock:
+            entry = self._actuation_policies.get(token)
+            return dict(entry["spec"]) if entry else None
+
+    def list_actuation_policies(self) -> List[Dict]:
+        """Policy specs in slot order (the order lane rows resolve in)."""
+        with self._lock:
+            entries = sorted(self._actuation_policies.values(),
+                             key=lambda e: e["slot"])
+            return [dict(e["spec"]) for e in entries]
+
+    def actuation_policies_by_slot(self) -> Dict[int, Dict]:
+        with self._lock:
+            return {e["slot"]: dict(e["spec"])
+                    for e in self._actuation_policies.values()}
+
+    def actuation_policy_manifest(self) -> List[Dict]:
+        """Checkpoint form: spec + the runtime (slot, epoch) assignment,
+        so a restore re-pins debounce state to its policy mid-window."""
+        with self._lock:
+            return [{"slot": e["slot"], "epoch": e["epoch"],
+                     "spec": dict(e["spec"])}
+                    for e in sorted(self._actuation_policies.values(),
+                                    key=lambda e: e["slot"])]
+
+    def actuation_policy_counters(self) -> Dict[str, Dict[str, int]]:
+        """Per-policy cumulative fire/debounce counters (one on-demand
+        D2H fetch of two [P] vectors — never on the hot path). Counters
+        live in the actuation state so they survive checkpoints; sharded
+        engines hold per-shard partials summed here."""
+        if self._actuation_state is None:
+            return {}
+        with self._state_lock:
+            fires = np.asarray(self._actuation_state.fire_count)
+            deb = np.asarray(self._actuation_state.debounce_count)
+        if fires.ndim == 2:  # sharded [S, P] partials
+            fires, deb = fires.sum(0), deb.sum(0)
+        with self._lock:
+            return {token: {"fires": int(fires[e["slot"]])
+                            if e["slot"] < fires.shape[0] else 0,
+                            "debounced": int(deb[e["slot"]])
+                            if e["slot"] < deb.shape[0] else 0}
+                    for token, e in self._actuation_policies.items()}
+
+    # -- actuation state (checkpointing) ------------------------------------
+
+    def canonical_actuation_state(self):
+        """Host snapshot of the per-(device, policy) debounce state, flat
+        device-major like canonical_state (sharded engine overrides)."""
+        import jax.numpy as jnp
+
+        if self._actuation_state is None:
+            return None
+        with self._state_lock:
+            snap = jax.tree_util.tree_map(jnp.copy, self._actuation_state)
+        return jax.tree_util.tree_map(lambda a: np.asarray(a), snap)
+
+    def _expected_actuation_state_shapes(self):
+        from sitewhere_tpu.ops.stateful import state_slab_lanes
+
+        D = self.registry.devices.capacity
+        (P,) = self._actuation_state_dims()
+        return {"slab": (D, P, state_slab_lanes(1)), "gen": (P,),
+                "fire_count": (P,), "debounce_count": (P,)}
+
+    def _validate_canonical_actuation_state(self, actuation_state) -> None:
+        for name, want in self._expected_actuation_state_shapes().items():
+            got = tuple(np.asarray(getattr(actuation_state, name)).shape)
+            if got != want:
+                raise ValueError(
+                    f"actuation-state checkpoint shape mismatch for "
+                    f"{name}: got {got}, engine expects {want} (policy "
+                    f"bucket/device capacity must match)")
+
+    def load_canonical_actuation_state(self, actuation_state) -> None:
+        self._validate_canonical_actuation_state(actuation_state)
+        with self._state_lock:
+            self._actuation_state = jax.device_put(actuation_state)
+            self._actuation_state_built_dims = self._actuation_state_dims()
+
+    def take_command_fires(self) -> List[Dict]:
+        """Drain command fires parked while no dispatcher was attached
+        (tests, bare engines). With a dispatcher set this is empty."""
+        out, self._pending_commands = self._pending_commands, []
+        return out
+
     # -- params refresh -------------------------------------------------------
 
     def _refresh_params(self) -> None:
@@ -1037,6 +1281,7 @@ class PipelineEngine(LifecycleComponent):
             geofence = self._compile_geofence_table()
             programs = self._compile_program_table()
             models = self._compile_model_table()
+            policies = self._compile_policy_table()
             zones = ZoneTable(vertices=snap.zone_vertices, nvert=snap.zone_nvert,
                               tenant_idx=snap.zone_tenant, active=snap.zone_active)
             self._params = jax.device_put(PipelineParams(
@@ -1045,7 +1290,7 @@ class PipelineEngine(LifecycleComponent):
                 area_idx=snap.area_idx,
                 device_type_idx=snap.device_type_idx,
                 threshold=threshold, zones=zones, geofence=geofence,
-                programs=programs, models=models))
+                programs=programs, models=models, policies=policies))
             self._params_built_for = (snap.version, self._rules_version)
 
     def _ensure_params(self) -> PipelineParams:
@@ -1261,6 +1506,8 @@ class PipelineEngine(LifecycleComponent):
             self._rule_state = self._init_rule_state()
         if self._model_state is None:
             self._model_state = self._init_model_state()
+        if self._actuation_state is None:
+            self._actuation_state = self._init_actuation_state()
         params = self._ensure_params()
         rec = flight_rec if flight_rec is not None else (
             self.flight.begin_step(engine=self.name))
@@ -1268,7 +1515,8 @@ class PipelineEngine(LifecycleComponent):
         try:
             outputs = self._dispatch_with_retry(
                 lambda: self._step_blob(params, self._state, self._rule_state,
-                                        self._model_state, blob))
+                                        self._model_state,
+                                        self._actuation_state, blob))
         except BaseException:
             if slot is not None:
                 # guard-free release: the failed step's input array is
@@ -1304,9 +1552,9 @@ class PipelineEngine(LifecycleComponent):
         the call may have consumed the donated state buffers, in which
         case the retries fail too and the error escalates through the
         same path. `step_call` returns (state, rule_state, model_state,
-        outputs). `points` lists the fault points armed on this path —
-        the sharded engine stages H2D separately, so its dispatch drops
-        h2d_error."""
+        actuation_state, outputs). `points` lists the fault points armed
+        on this path — the sharded engine stages H2D separately, so its
+        dispatch drops h2d_error."""
         attempt = 0
         while True:
             try:
@@ -1314,7 +1562,7 @@ class PipelineEngine(LifecycleComponent):
                     fault_point(point)
                 with self._state_lock:
                     (self._state, self._rule_state, self._model_state,
-                     outputs) = step_call()
+                     self._actuation_state, outputs) = step_call()
                 self.health.note_success()
                 return outputs
             except Exception:
@@ -1335,14 +1583,16 @@ class PipelineEngine(LifecycleComponent):
         return batch, self.submit(batch, age=age)
 
     def _fetch_lanes_with_retry(self, outputs: ProcessOutputs):
-        """D2H lane fetch with the same bounded retry/backoff contract as
+        """D2H fetch of BOTH fixed-shape lanes (alert + command) in one
+        device_get, with the same bounded retry/backoff contract as
         `_dispatch_with_retry`. Unlike dispatch, the fetch never donates
         buffers, so retrying a genuinely failed device_get is always safe."""
         attempt = 0
         while True:
             try:
                 fault_point("lane_fetch_error")
-                lanes = jax.device_get(outputs.alert_lanes)
+                lanes = jax.device_get((outputs.alert_lanes,
+                                        outputs.command_lanes))
                 self.health.note_success()
                 return lanes
             except Exception:
@@ -1362,11 +1612,13 @@ class PipelineEngine(LifecycleComponent):
         On a tunneled runtime fetch count and fetch bytes — not compute —
         set the latency floor (~100 ms per round trip when the link's
         burst bucket is drained; docs/PERF.md), so the step packs fired
-        rows into fixed-capacity lanes ON DEVICE (ops/compact.py) and
-        this ships exactly ONE fixed-shape, lane-sized fetch per step
-        regardless of batch size — replacing the six-array / two-phase
-        fetch. Device tokens resolve through the interner's cached token
-        array (one fancy-index, no per-row Python lookups).
+        rows into fixed-capacity lanes ON DEVICE (ops/compact.py +
+        ops/actuate.py) and this ships exactly TWO fixed-shape,
+        lane-sized fetches per step — the alert lane and the command lane,
+        in one device_get — regardless of batch size, replacing the
+        six-array / two-phase fetch. Device tokens resolve through the
+        interner's cached token array (one fancy-index, no per-row Python
+        lookups).
 
         A `max_alerts` bound and lane overflow (> capacity fired rows)
         both count on `alerts_dropped`, surface as a metric, and log —
@@ -1382,15 +1634,16 @@ class PipelineEngine(LifecycleComponent):
         rec = self._flight_last
         if rec is not None:
             rec.begin_stage("lane_fetch")
-        lanes = self._fetch_lanes_with_retry(outputs)  # THE one fetch
+        # THE one device_get: both fixed-shape lanes in a single round trip
+        lanes, cmd_lanes = self._fetch_lanes_with_retry(outputs)
         if rec is not None:
             rec.end_stage("lane_fetch")
             rec.begin_stage("materialize")
             self._stage_hist.observe(rec.stage_s("lane_fetch"),
                                      engine=self.name, stage="lane_fetch")
         try:
-            self.d2h_fetches += 1
-            self.d2h_bytes += lanes.nbytes
+            self.d2h_fetches += 2
+            self.d2h_bytes += lanes.nbytes + cmd_lanes.nbytes
             dec = decode_alert_lanes(lanes)
             self._account_lane_overflow(dec.dropped_alerts)
             dec = self._bound_alert_rows(dec, max_alerts)
@@ -1406,6 +1659,8 @@ class PipelineEngine(LifecycleComponent):
                 self._stage_hist.observe(
                     rec.stage_s("materialize"),
                     engine=self.name, stage="materialize")
+            self._materialize_commands(cmd_lanes, rec)
+            if rec is not None:
                 self._close_age(rec)
 
     def _close_age(self, rec) -> None:
@@ -1421,6 +1676,93 @@ class PipelineEngine(LifecycleComponent):
         rec.age = summary
         observe_summary(self._age_hist, summary,
                         engine=self.name, edge="materialize")
+        if getattr(rec, "commands", 0):
+            # the closing waterfall edge: ingest -> command fan-out done.
+            # Fan-out ran synchronously inside this materialize pass, so
+            # the same summary closed after it IS the detection->actuation
+            # age for every event in the step.
+            observe_summary(self._age_hist, summary, engine=self.name,
+                            edge="detection_to_actuation")
+
+    def _materialize_commands(self, cmd_lanes, rec) -> None:
+        """Decode the step's command lane, account fire/debounce/overflow
+        activity, and hand resolved fires to the dispatcher (or the
+        pending list when none is attached). Differential contract: the
+        resolved fires are bit-derived from the lane the NumPy oracle
+        reproduces (tests/test_actuation.py)."""
+        from sitewhere_tpu.ops.actuate import decode_command_lanes
+
+        if rec is not None:
+            rec.begin_stage("actuate")
+        dec = decode_command_lanes(np.asarray(cmd_lanes))
+        self._account_command_activity(dec)
+        fires = self._emit_command_fires(dec) if dec.n else []
+        if rec is not None:
+            rec.commands = len(fires)
+            rec.end_stage("actuate")
+            self._stage_hist.observe(rec.stage_s("actuate"),
+                                     engine=self.name, stage="actuate")
+        self._fanout_commands(fires, rec)
+
+    def _fanout_commands(self, fires: List[Dict], rec) -> None:
+        """Hand resolved fires to the attached dispatcher (or park them);
+        shared by both engines' materialize passes."""
+        if not fires:
+            return
+        if rec is not None:
+            rec.begin_stage("command_fanout")
+        try:
+            if self.command_dispatcher is not None:
+                self.command_dispatcher.dispatch(self, fires)
+            else:
+                self._pending_commands.extend(fires)
+        finally:
+            if rec is not None:
+                rec.end_stage("command_fanout")
+                self._stage_hist.observe(
+                    rec.stage_s("command_fanout"),
+                    engine=self.name, stage="command_fanout")
+
+    def _account_command_activity(self, dec) -> None:
+        fired = int(dec.fired) - int(dec.dropped)
+        if fired:
+            self.commands_fired += fired
+            self._metrics.counter("actuation.fires").inc(fired)
+        if dec.debounced:
+            self.commands_debounced += int(dec.debounced)
+            self._metrics.counter("actuation.debounced").inc(
+                int(dec.debounced))
+        if dec.dropped:
+            self.commands_dropped += int(dec.dropped)
+            self._metrics.counter("commands.dropped").inc(int(dec.dropped))
+            import logging
+            logging.getLogger("sitewhere.pipeline").warning(
+                "command-lane overflow: %d policy fires beyond the %d-row "
+                "lane capacity dropped on device (commands_dropped=%d "
+                "total)", int(dec.dropped), self.command_lane_capacity,
+                self.commands_dropped)
+
+    def _emit_command_fires(self, dec) -> List[Dict]:
+        """Resolve decoded command-lane slots into dispatchable fire
+        records: device token via the cached interner array (one fancy
+        index), command token + params from the installed policy spec."""
+        policies = self.actuation_policies_by_slot()
+        tokens = self.registry.devices.token_array()[dec.dev].tolist()
+        slots = dec.policy_slot.tolist()
+        levels = dec.level.tolist()
+        sources = dec.source.tolist()
+        fires: List[Dict] = []
+        for i in range(dec.n):
+            spec = policies.get(slots[i])
+            if spec is None:  # policy removed between dispatch and fetch
+                continue
+            fires.append({
+                "policy": spec["token"], "slot": slots[i],
+                "device": tokens[i], "command": spec["command"],
+                "params": list(spec.get("params", ())),
+                "level": levels[i], "source": sources[i],
+                "tenant": spec.get("tenant_token", "")})
+        return fires
 
     def _account_lane_overflow(self, dropped: int) -> None:
         if not dropped:

@@ -37,12 +37,17 @@ from sitewhere_tpu.ops.pack import EventBatch
 from sitewhere_tpu.ops.segments import (
     batch_device_order, count_by_key, last_by_key, scatter_max_by_key,
 )
+from sitewhere_tpu.ops.actuate import (
+    COMMAND_LANE_ROWS, DEFAULT_COMMAND_LANE_CAPACITY,
+    ActuationStateTensors, eval_actuation_policies,
+)
 from sitewhere_tpu.ops.anomaly import ModelStateTensors, eval_anomaly_models
 from sitewhere_tpu.ops.stateful import (
     RuleStateTensors, eval_rule_programs, observations_of_batch,
 )
 from sitewhere_tpu.ops.threshold import ThresholdRuleTable, eval_threshold_rules
 from sitewhere_tpu.pipeline.state_tensors import DeviceStateTensors
+from sitewhere_tpu.actuation.compiler import ActuationPolicyTable
 from sitewhere_tpu.ml.compiler import AnomalyModelTable
 from sitewhere_tpu.rules.compiler import RuleProgramTable
 
@@ -69,6 +74,9 @@ class PipelineParams:
     # compiled anomaly-model weight tables (ml/compiler.py); also
     # replicated — features ride the shard axis, weights don't
     models: AnomalyModelTable
+    # compiled actuation policies (actuation/compiler.py); replicated —
+    # debounce state rides the shard axis, the policy table doesn't
+    policies: ActuationPolicyTable
 
 
 @struct.dataclass
@@ -103,20 +111,30 @@ class ProcessOutputs:
     # per-row masks above stay for device-side consumers and tests; the
     # host fast path never fetches them
     alert_lanes: jnp.ndarray        # int32 [ALERT_LANE_ROWS, K]
+    # device-compacted command lane (ops/actuate.py): actuation-policy
+    # fires packed the same way into a SECOND fixed [4, K_cmd] int32
+    # array, fetched in the SAME materialize pass as the alert lanes —
+    # the fetch budget is exactly TWO fixed-shape arrays per step
+    command_lanes: jnp.ndarray      # int32 [COMMAND_LANE_ROWS, K_cmd]
 
 
 def process_batch(params: PipelineParams, state: DeviceStateTensors,
                   rule_state: RuleStateTensors,
-                  model_state: ModelStateTensors, batch: EventBatch, *,
+                  model_state: ModelStateTensors,
+                  actuation_state: ActuationStateTensors,
+                  batch: EventBatch, *,
                   geofence_impl: str = "xla",
                   alert_lane_capacity: int = DEFAULT_ALERT_LANE_CAPACITY,
                   programs_enabled: bool = True,
                   program_node_limit: int = 0,
-                  models_enabled: bool = True
+                  models_enabled: bool = True,
+                  actuation_enabled: bool = True,
+                  command_lane_capacity: int = DEFAULT_COMMAND_LANE_CAPACITY
                   ) -> Tuple[DeviceStateTensors, RuleStateTensors,
-                             ModelStateTensors, ProcessOutputs]:
+                             ModelStateTensors, ActuationStateTensors,
+                             ProcessOutputs]:
     """One fused step. Shapes static; jit/shard_map safe; donate `state`,
-    `rule_state` and `model_state`.
+    `rule_state`, `model_state` and `actuation_state`.
 
     `geofence_impl` selects the containment kernel ("xla" scan,
     "pallas" TPU kernel, "pallas_interpret" for CPU tests) — resolved by the
@@ -131,6 +149,10 @@ def process_batch(params: PipelineParams, state: DeviceStateTensors,
     the slots the compiled table populates.
     `models_enabled` (trace-time static) likewise drops the anomaly-model
     scoring stage when the model table is empty.
+    `actuation_enabled` (trace-time static) drops the actuation stage
+    when no policies are installed — the command lane is then a zero
+    placeholder so the materialize fetch shape never changes.
+    `command_lane_capacity` is the K of the compacted command lane.
     """
     D = state.num_devices
     M = state.num_measurement_slots
@@ -260,6 +282,24 @@ def process_batch(params: PipelineParams, state: DeviceStateTensors,
                  "alert_level": jnp.full((B,), -1, jnp.int32),
                  "score": jnp.zeros((B,), jnp.float32)}
 
+    # ---- stage 3d: actuation policies (ops/actuate.py) ---------------------
+    # After every alert family has fired so policies see the step's full
+    # fire bits; per-(device, policy) debounce state advances in HBM and
+    # fired commands compact into the second fixed-shape lane. Dropped
+    # at trace time when no policies are installed.
+    if actuation_enabled:
+        with jax.named_scope("step_actuate"):
+            actuation_state, command_lanes = eval_actuation_policies(
+                params.policies, actuation_state,
+                dev=dev, ts=ts, tenant_row=tenant,
+                thr=thr, geo=geo, prog=prog, model=model,
+                capacity=command_lane_capacity)
+    else:
+        # fixed-shape placeholder: the materialize pass always fetches
+        # two lanes, so enabling actuation never changes the fetch count
+        command_lanes = jnp.zeros(
+            (COMMAND_LANE_ROWS, command_lane_capacity), jnp.int32)
+
     # ---- stage 4: stats (replaces Dropwizard meters / Kafka state topics) --
     with jax.named_scope("step_stats_compact"):
         tenant_counts = count_by_key(tenant, valid, T)
@@ -309,8 +349,9 @@ def process_batch(params: PipelineParams, state: DeviceStateTensors,
         processed=jnp.sum(valid, dtype=jnp.int32),
         alerts=alerts,
         alert_lanes=alert_lanes,
+        command_lanes=command_lanes,
     )
-    return new_state, rule_state, model_state, outputs
+    return new_state, rule_state, model_state, actuation_state, outputs
 
 
 def check_presence(state: DeviceStateTensors, registered: jnp.ndarray,

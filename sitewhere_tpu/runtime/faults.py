@@ -49,6 +49,7 @@ FAULT_POINTS = (
     "feeder_thread_death",
     "feeder_process_death",
     "rest_worker_stall",
+    "command_delivery_error",
 )
 
 # points whose firing is an *error* raised into the caller (the rest are
@@ -62,6 +63,10 @@ _RAISING_POINTS = frozenset((
     # `serve --feeder`; abandoned thread in the in-proc drill) — the
     # takeover path, not the error path, must recover it.
     "feeder_process_death",
+    # raised into CommandFanout's per-fire delivery attempt: the fan-out
+    # retries in line, then parks the fire on the dead-letter list — the
+    # drill asserts delivered + parked == lane rows (conservation)
+    "command_delivery_error",
 ))
 
 

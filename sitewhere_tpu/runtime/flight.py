@@ -42,8 +42,10 @@ STAGES: Tuple[str, ...] = (
     "dispatch",        # host: jit step call until handles returned
     "device_compute",  # device: dispatch start -> outputs ready (needs sync)
     "model_eval",      # host: resolve anomaly-model fires from fetched lanes
-    "lane_fetch",      # host: the single device_get of the alert lanes
+    "lane_fetch",      # host: the one device_get of the alert+command lanes
     "materialize",     # host: decode lanes + emit alert events
+    "actuate",         # host: decode command lanes + resolve policy fires
+    "command_fanout",  # host: dispatch resolved commands to destinations
 )
 _STAGE_INDEX: Dict[str, int] = {name: i for i, name in enumerate(STAGES)}
 N_STAGES = len(STAGES)
@@ -67,7 +69,7 @@ class StepRecord:
     """
 
     __slots__ = ("seq", "gen", "engine", "events", "tenant_mix",
-                 "begin", "end", "created", "age", "ring")
+                 "begin", "end", "created", "age", "ring", "commands")
 
     def __init__(self) -> None:
         self.seq = -1            # lineage id (recorder-wide monotonic)
@@ -85,6 +87,9 @@ class StepRecord:
         # staging-ring snapshot at slot-acquire time: (occupancy, depth),
         # None when the step never touched the ring
         self.ring: Optional[Tuple[int, int]] = None
+        # command fires resolved from this step's command lane (actuate
+        # stage); drives the detection_to_actuation age edge
+        self.commands = 0
 
     # -- hot path -----------------------------------------------------
     def reset(self, seq: int, gen: int, engine: str) -> None:
@@ -100,6 +105,7 @@ class StepRecord:
         self.created = time.perf_counter()
         self.age = None
         self.ring = None
+        self.commands = 0
 
     def mark(self, stage: str, t0: float, t1: float) -> None:
         """Record a completed segment from explicit timestamps."""

@@ -479,6 +479,64 @@ def register_all(router: Router, instance, server) -> None:
                   authority=SiteWhereRoles.ADMINISTER_TENANTS)
 
     # ------------------------------------------------------------------
+    # Actuation policies — the alert -> command control plane
+    # (actuation/compiler.py, ops/actuate.py): declarative policies
+    # compiled into the fused step's slot table, evaluated right after
+    # anomaly scoring, delivered through the tenant's command stack.
+    # Installs are durable (ActuationPolicyStore), replicated with the
+    # LWW/tombstone algebra, and carry live per-policy fire/debounce
+    # counters read on demand from the actuation state.
+    # ------------------------------------------------------------------
+    def list_actuation_policies(request: Request):
+        tenant = _program_tenant(request)
+        engine = instance.pipeline_engine
+        counters = (engine.actuation_policy_counters()
+                    if engine is not None else {})
+        out = []
+        for row in instance.actuation_policies.installs_for(tenant):
+            spec = row["spec"]
+            out.append({**spec,
+                        **counters.get(spec.get("token", ""),
+                                       {"fires": 0, "debounced": 0})})
+        return {"policies": out}
+
+    def create_actuation_policy(request: Request):
+        tenant = _program_tenant(request)
+        return instance.install_actuation_policy(tenant, _body(request))
+
+    def get_actuation_policy(request: Request):
+        tenant = _program_tenant(request)
+        token = request.params["policy"]
+        row = instance.actuation_policies.get(tenant, token)
+        if row is None:
+            raise NotFoundError(f"actuation policy '{token}' not found",
+                                ErrorCode.GENERIC)
+        engine = instance.pipeline_engine
+        counters = (engine.actuation_policy_counters()
+                    if engine is not None else {})
+        return {**row["spec"],
+                **counters.get(token, {"fires": 0, "debounced": 0})}
+
+    def delete_actuation_policy(request: Request):
+        tenant = _program_tenant(request)
+        token = request.params["policy"]
+        if not instance.remove_actuation_policy(tenant, token):
+            raise NotFoundError(f"actuation policy '{token}' not found",
+                                ErrorCode.GENERIC)
+        return {"token": token, "removed": True}
+
+    router.get("/api/tenants/{token}/actuations", list_actuation_policies,
+               authority=SiteWhereRoles.VIEW_SERVER_INFO)
+    router.post("/api/tenants/{token}/actuations", create_actuation_policy,
+                authority=SiteWhereRoles.ADMINISTER_TENANTS)
+    router.get("/api/tenants/{token}/actuations/{policy}",
+               get_actuation_policy,
+               authority=SiteWhereRoles.VIEW_SERVER_INFO)
+    router.delete("/api/tenants/{token}/actuations/{policy}",
+                  delete_actuation_policy,
+                  authority=SiteWhereRoles.ADMINISTER_TENANTS)
+
+    # ------------------------------------------------------------------
     # Prometheus exposition + on-demand device profiling (reference:
     # Dropwizard reporters, Microservice.java:146,244-246; Jaeger spans)
     # ------------------------------------------------------------------

@@ -1,0 +1,791 @@
+"""In-step actuation (ops/actuate.py + actuation/): policy eval,
+debounce, command-lane compaction, delivery fan-out, store convergence.
+
+Differential contract: the fused actuate kernel's command lane — slot
+order included — must match a pure-NumPy oracle implementing the
+documented step semantics (match -> last-row trigger -> debounce ->
+device-major pack), across no-fire / some-fire / storm (> K fired
+pairs, dropped counted on device), on both the single-chip and sharded
+engines. Debounce state must survive checkpoints mid-window, including
+the sharded-save -> single-chip-restore elastic path. The policy store
+converges LWW + tombstone like the other rule stores, REST 409s name
+the offending field, and the `command_delivery_error` chaos drill pins
+the park -> redeliver loop with the fan-out conservation invariant.
+"""
+
+import numpy as np
+import pytest
+
+from sitewhere_tpu.actuation.compiler import (
+    ActuationPolicyError, PolicySource, compile_policy_into,
+    empty_policy_table)
+from sitewhere_tpu.model import (
+    AlertLevel, Device, DeviceAssignment, DeviceMeasurement, DeviceType,
+)
+from sitewhere_tpu.ops.actuate import (
+    COMMAND_LANE_ROWS, decode_command_lanes, eval_actuation_policies,
+    init_actuation_state_np,
+)
+from sitewhere_tpu.pipeline.engine import PipelineEngine, ThresholdRule
+from sitewhere_tpu.registry import DeviceManagement, RegistryTensors
+
+_NEG = -(2 ** 31)
+
+_ENGINE_SEQ = iter(range(10_000))
+
+
+def _unique_name() -> str:
+    """Per-test engine name: GLOBAL_METRICS scopes counters by engine
+    name, so a default-named engine would pollute other test files'
+    actuation counters."""
+    return f"act-test-{next(_ENGINE_SEQ)}"
+
+
+def _world(n_devices=16, tenant="acme"):
+    dm = DeviceManagement()
+    dtype = dm.create_device_type(DeviceType(token="t"))
+    tensors = RegistryTensors(max_devices=64, max_zones=4,
+                              max_zone_vertices=8)
+    for i in range(n_devices):
+        device = dm.create_device(Device(token=f"d{i}",
+                                         device_type_id=dtype.id))
+        dm.create_device_assignment(DeviceAssignment(
+            token=f"a{i}", device_id=device.id))
+    tensors.attach(dm, tenant)
+    return dm, tensors
+
+
+# ---------------------------------------------------------------------------
+# the NumPy oracle (mirrors the module docstring's step semantics)
+# ---------------------------------------------------------------------------
+
+def _oracle_init(D, P):
+    return {
+        "last_ts": np.full((D, P), _NEG, np.int64),
+        "ctr": np.zeros((D, P), np.int64),
+        "row_gen": np.zeros((D, P), np.int64),
+        "gen": np.zeros((P,), np.int64),
+        "fire_count": np.zeros((P,), np.int64),
+        "debounce_count": np.zeros((P,), np.int64),
+    }
+
+
+def _oracle_step(table, st, *, dev, ts, tenant_row, fam, capacity):
+    """One actuation step over plain Python loops: match each (row,
+    policy) pair against the family fire bits, resolve last-matching-row
+    triggers per (device, policy), debounce against `st`, and pack the
+    survivors device-major into an expected [4, capacity] lane."""
+    B, P = len(dev), table.active.shape[0]
+    D = st["last_ts"].shape[0]
+    matched = np.zeros((B, P), bool)
+    trig_src = np.full((B, P), 8, np.int64)
+    trig_level = np.full((B, P), -1, np.int64)
+    eligible = table.active[None, :] & (
+        (table.tenant_idx[None, :] == 0)
+        | (table.tenant_idx[None, :] == np.asarray(tenant_row)[:, None]))
+    for kind, fired_k, slot_k, level_k in fam:
+        src_ok = ((table.source[None, :] == PolicySource.ANY)
+                  | (table.source[None, :] == kind))
+        slot_ok = ((table.match_slot[None, :] < 0)
+                   | (table.match_slot[None, :]
+                      == np.asarray(slot_k)[:, None]))
+        level_ok = np.asarray(level_k)[:, None] >= table.min_level[None, :]
+        m = (eligible & np.asarray(fired_k, bool)[:, None]
+             & src_ok & slot_ok & level_ok)
+        matched |= m
+        trig_src = np.where(m, np.minimum(trig_src, kind), trig_src)
+        trig_level = np.where(
+            m, np.maximum(trig_level, np.asarray(level_k)[:, None]),
+            trig_level)
+
+    last_row = np.full((D, P), -1, np.int64)
+    for b in range(B):
+        for p in range(P):
+            if matched[b, p]:
+                last_row[dev[b], p] = b  # ascending b: last match wins
+
+    epoch_moved = st["gen"] != table.epoch
+    st["fire_count"] = np.where(epoch_moved, 0, st["fire_count"])
+    st["debounce_count"] = np.where(epoch_moved, 0, st["debounce_count"])
+    kept, fired_total, debounced = [], 0, 0
+    for d in range(D):
+        for p in range(P):
+            b = last_row[d, p]
+            if b < 0:
+                continue
+            stale = st["row_gen"][d, p] != table.epoch[p]
+            lts = _NEG if stale else st["last_ts"][d, p]
+            ctr = 0 if stale else st["ctr"][d, p]
+            fts = int(ts[b])
+            if lts == _NEG or fts - lts >= int(table.debounce_ms[p]):
+                if fired_total < capacity:
+                    kept.append((d, p, b, int(trig_level[b, p]),
+                                 int(trig_src[b, p])))
+                fired_total += 1
+                st["last_ts"][d, p], st["ctr"][d, p] = fts, ctr + 1
+                st["fire_count"][p] += 1
+            else:
+                debounced += 1
+                st["last_ts"][d, p], st["ctr"][d, p] = lts, ctr
+                st["debounce_count"][p] += 1
+            st["row_gen"][d, p] = table.epoch[p]
+    st["gen"] = np.asarray(table.epoch, np.int64).copy()
+
+    lanes = np.zeros((COMMAND_LANE_ROWS, capacity), np.int32)
+    lanes[0, :] = -1
+    lanes[2, :] = -1
+    for i, (d, p, b, lvl, src) in enumerate(kept):
+        lanes[0, i] = b
+        lanes[1, i] = (p & 0xFF) | ((lvl & 0xF) << 8) | ((src & 0x7) << 12)
+        lanes[2, i] = d
+    lanes[3, 0] = fired_total
+    lanes[3, 1] = fired_total - len(kept)
+    lanes[3, 2] = debounced
+    return lanes
+
+
+def _check_state_matches(state, st):
+    """The returned ActuationStateTensors' meaningful slab lanes must
+    equal the oracle's scalar bookkeeping."""
+    slab = np.asarray(state.slab)
+    np.testing.assert_array_equal(slab[:, :, 2], st["last_ts"],
+                                  err_msg="last-fire ts plane")
+    np.testing.assert_array_equal(slab[:, :, 3], st["ctr"],
+                                  err_msg="fire counter plane")
+    np.testing.assert_array_equal(slab[:, :, 5], st["row_gen"],
+                                  err_msg="row generation plane")
+    np.testing.assert_array_equal(np.asarray(state.gen), st["gen"])
+    np.testing.assert_array_equal(np.asarray(state.fire_count),
+                                  st["fire_count"])
+    np.testing.assert_array_equal(np.asarray(state.debounce_count),
+                                  st["debounce_count"])
+
+
+class TestActuateOpDifferential:
+    """Unit-level: the fused kernel vs the NumPy oracle, with synthesized
+    per-family fire bits driving every matching dimension."""
+
+    def _table(self, specs, epochs=None):
+        table = empty_policy_table(max(len(specs), 2))
+        tenants = {"acme": 1, "beta": 2}
+        commands = {}
+        for slot, spec in enumerate(specs):
+            epoch = (epochs[slot] if epochs else slot + 1)
+            compile_policy_into(
+                table, slot, spec, epoch,
+                intern_command=lambda t: commands.setdefault(
+                    t, len(commands) + 1),
+                lookup_tenant=lambda t: tenants.get(t, 0))
+        return table
+
+    def _families(self, B, **per_kind):
+        """Build the four per-row family dicts; per_kind maps
+        'thr'/'geo'/'prog'/'model' -> (fired, slot, level) row lists."""
+        import jax.numpy as jnp
+
+        fams, dicts = [], {}
+        for name, kind, slot_key in (
+                ("thr", PolicySource.THRESHOLD, "first_rule"),
+                ("geo", PolicySource.GEOFENCE, "first_rule"),
+                ("prog", PolicySource.PROGRAM, "first_rule"),
+                ("model", PolicySource.MODEL, "first_model")):
+            fired, slot, level = per_kind.get(
+                name, ([False] * B, [-1] * B, [-1] * B))
+            fams.append((kind, np.asarray(fired, bool),
+                         np.asarray(slot, np.int64),
+                         np.asarray(level, np.int64)))
+            dicts[name] = {"fired": jnp.asarray(np.asarray(fired, bool)),
+                           slot_key: jnp.asarray(
+                               np.asarray(slot, np.int32)),
+                           "alert_level": jnp.asarray(
+                               np.asarray(level, np.int32))}
+        return fams, dicts
+
+    def _run(self, table, state_np, dicts, dev, ts, tenant_row, capacity):
+        import jax
+        import jax.numpy as jnp
+
+        state = jax.tree_util.tree_map(jnp.asarray, state_np)
+        new_state, lanes = jax.jit(
+            eval_actuation_policies,
+            static_argnames=("capacity",))(
+                table, state,
+                dev=jnp.asarray(np.asarray(dev, np.int32)),
+                ts=jnp.asarray(np.asarray(ts, np.int32)),
+                tenant_row=jnp.asarray(np.asarray(tenant_row, np.int32)),
+                thr=dicts["thr"], geo=dicts["geo"], prog=dicts["prog"],
+                model=dicts["model"], capacity=capacity)
+        return new_state, np.asarray(lanes)
+
+    def test_no_fire_empty_lane(self):
+        table = self._table([{"token": "p0", "command": "c"}])
+        B, D = 8, 4
+        fams, dicts = self._families(B)
+        st = _oracle_init(D, table.active.shape[0])
+        state, lanes = self._run(table, init_actuation_state_np(
+            D, table.active.shape[0]), dicts,
+            dev=[i % D for i in range(B)], ts=range(B),
+            tenant_row=[1] * B, capacity=8)
+        want = _oracle_step(
+            table, st, dev=[i % D for i in range(B)], ts=range(B),
+            tenant_row=[1] * B, fam=fams, capacity=8)
+        np.testing.assert_array_equal(lanes, want)
+        assert decode_command_lanes(lanes).n == 0
+        _check_state_matches(state, st)
+
+    def test_mixed_sources_match_oracle_across_steps(self):
+        """Every matching dimension at once — source kind, match_slot,
+        min_level, tenant scope, inactive policy — over two sequential
+        steps so the debounce window is exercised against carried
+        state."""
+        specs = [
+            {"token": "any", "command": "c0"},                   # matches all
+            {"token": "thr-only", "source": "threshold",
+             "command": "c1", "min_level": int(AlertLevel.ERROR)},
+            {"token": "slot3", "source": "model", "match_slot": 3,
+             "command": "c2", "min_level": int(AlertLevel.INFO)},
+            {"token": "acme", "tenant_token": "acme", "command": "c3",
+             "min_level": int(AlertLevel.INFO)},
+            {"token": "deb", "command": "c4", "debounce_ms": 500,
+             "min_level": int(AlertLevel.INFO)},
+            {"token": "off", "command": "c5", "active": False},
+        ]
+        table = self._table(specs)
+        B, D, P = 12, 6, len(specs)
+        dev = [b % D for b in range(B)]
+        tenant_row = [1 if b % 2 == 0 else 2 for b in range(B)]
+        rng = np.random.RandomState(7)
+        state_np = init_actuation_state_np(D, P)
+        st = _oracle_init(D, P)
+        state = state_np
+        for step in range(2):
+            ts = [step * 400 + b for b in range(B)]
+            per_kind = {}
+            for name in ("thr", "geo", "prog", "model"):
+                fired = rng.rand(B) < 0.5
+                slot = rng.randint(0, 5, B)
+                level = rng.randint(0, 4, B)
+                per_kind[name] = (fired.tolist(), slot.tolist(),
+                                  np.where(fired, level, -1).tolist())
+            fams, dicts = self._families(B, **per_kind)
+            state, lanes = self._run(table, state, dicts, dev, ts,
+                                     tenant_row, capacity=32)
+            want = _oracle_step(table, st, dev=dev, ts=ts,
+                                tenant_row=tenant_row, fam=fams,
+                                capacity=32)
+            np.testing.assert_array_equal(lanes, want,
+                                          err_msg=f"step {step}")
+            _check_state_matches(state, st)
+        # the randomized trace must actually have exercised the kernel
+        assert st["fire_count"].sum() > 0
+        assert st["debounce_count"].sum() > 0
+
+    def test_storm_overflow_counts_dropped_on_device(self):
+        """> capacity fired (device, policy) pairs: lane keeps the first
+        K in device-major order, counts[0] still reports the true total
+        and counts[1] the overflow — never a silent truncation."""
+        table = self._table([{"token": "p0", "command": "c",
+                              "min_level": int(AlertLevel.INFO)},
+                             {"token": "p1", "command": "c",
+                              "min_level": int(AlertLevel.INFO)}])
+        B = D = 8
+        fams, dicts = self._families(
+            B, thr=([True] * B, [0] * B, [3] * B))
+        st = _oracle_init(D, table.active.shape[0])
+        dev, ts, tenant = list(range(B)), list(range(B)), [1] * B
+        state, lanes = self._run(
+            table, init_actuation_state_np(D, table.active.shape[0]),
+            dicts, dev, ts, tenant, capacity=4)
+        want = _oracle_step(table, st, dev=dev, ts=ts, tenant_row=tenant,
+                            fam=fams, capacity=4)
+        np.testing.assert_array_equal(lanes, want)
+        dec = decode_command_lanes(lanes)
+        assert dec.fired == 16 and dec.dropped == 12 and dec.n == 4
+        # device-major: both policies of device 0, then device 1
+        assert dec.dev.tolist() == [0, 0, 1, 1]
+        assert dec.policy_slot.tolist() == [0, 1, 0, 1]
+        _check_state_matches(state, st)
+
+    def test_debounce_blocks_and_preserves_stored_ts(self):
+        """A blocked trigger counts as debounced and leaves the stored
+        last-fire ts unchanged, so the window measures from the last
+        FIRE, not the last attempt."""
+        table = self._table([{"token": "p", "command": "c",
+                              "debounce_ms": 1000,
+                              "min_level": int(AlertLevel.INFO)}])
+        P = table.active.shape[0]
+        fams, dicts = self._families(1, thr=([True], [0], [3]))
+        st = _oracle_init(2, P)
+        state = init_actuation_state_np(2, P)
+        fired = []
+        for ts in (100, 600, 1400, 1200):  # 1400: 1300ms after 100 -> fires
+            state, lanes = self._run(table, state, dicts, [0], [ts], [1],
+                                     capacity=4)
+            want = _oracle_step(table, st, dev=[0], ts=[ts],
+                                tenant_row=[1],
+                                fam=fams, capacity=4)
+            np.testing.assert_array_equal(lanes, want, err_msg=f"ts {ts}")
+            fired.append(decode_command_lanes(lanes).n)
+        assert fired == [1, 0, 1, 0]
+        assert int(np.asarray(state.slab)[0, 0, 2]) == 1400
+        _check_state_matches(state, st)
+
+    def test_epoch_bump_resets_debounce_inside_the_step(self):
+        """Recompiling a slot with a new epoch makes the stored record
+        stale — the generation-reset trick — so a mid-window trigger
+        fires again without any host-side state wipe."""
+        spec = {"token": "p", "command": "c", "debounce_ms": 10_000,
+                "min_level": int(AlertLevel.INFO)}
+        table = self._table([spec])
+        P = table.active.shape[0]
+        fams, dicts = self._families(1, thr=([True], [0], [3]))
+        st = _oracle_init(2, P)
+        state = init_actuation_state_np(2, P)
+        state, _ = self._run(table, state, dicts, [0], [100], [1], 4)
+        _oracle_step(table, st, dev=[0], ts=[100], tenant_row=[1],
+                     fam=fams, capacity=4)
+        # same table: still inside the window -> debounced
+        state, lanes = self._run(table, state, dicts, [0], [200], [1], 4)
+        _oracle_step(table, st, dev=[0], ts=[200], tenant_row=[1],
+                     fam=fams, capacity=4)
+        assert decode_command_lanes(lanes).n == 0
+        # epoch bump -> the same trigger fires
+        table2 = self._table([spec], epochs=[9])
+        state, lanes = self._run(table2, state, dicts, [0], [300], [1], 4)
+        want = _oracle_step(table2, st, dev=[0], ts=[300], tenant_row=[1],
+                            fam=fams, capacity=4)
+        np.testing.assert_array_equal(lanes, want)
+        assert decode_command_lanes(lanes).n == 1
+        _check_state_matches(state, st)
+
+    def test_last_matching_row_wins_per_device(self):
+        """One command per (device, policy) per step, stamped from the
+        device's LAST matching batch row."""
+        table = self._table([{"token": "p", "command": "c",
+                              "min_level": int(AlertLevel.INFO)}])
+        B, D = 6, 2
+        fams, dicts = self._families(
+            B, thr=([True, False, True, True, False, True],
+                    [0] * B, [3, -1, 2, 1, -1, 2]))
+        st = _oracle_init(D, table.active.shape[0])
+        dev = [0, 0, 0, 1, 1, 1]
+        state, lanes = self._run(
+            table, init_actuation_state_np(D, table.active.shape[0]),
+            dicts, dev, list(range(B)), [1] * B, capacity=8)
+        want = _oracle_step(table, st, dev=dev, ts=list(range(B)),
+                            tenant_row=[1] * B, fam=fams, capacity=8)
+        np.testing.assert_array_equal(lanes, want)
+        dec = decode_command_lanes(lanes)
+        assert dec.n == 2
+        assert dec.rows.tolist() == [2, 5]  # last matching rows
+        assert dec.level.tolist() == [2, 2]
+        _check_state_matches(state, st)
+
+
+# ---------------------------------------------------------------------------
+# engine-level differential (single-chip and sharded)
+# ---------------------------------------------------------------------------
+
+def _single_engine(tensors, **kw):
+    kw.setdefault("batch_size", 32)
+    kw.setdefault("measurement_slots", 4)
+    kw.setdefault("max_tenants", 4)
+    kw.setdefault("max_threshold_rules", 4)
+    kw.setdefault("max_geofence_rules", 4)
+    kw.setdefault("name", _unique_name())
+    engine = PipelineEngine(tensors, **kw)
+    engine.start()
+    return engine
+
+
+def _hot_rule(engine):
+    engine.add_threshold_rule(ThresholdRule(
+        token="hot", measurement_name="m", operator=">", threshold=100.0,
+        alert_level=AlertLevel.CRITICAL, alert_message="too hot"))
+
+
+_POLICY = {"token": "cool-down", "source": "threshold",
+           "min_level": "WARNING", "debounce_ms": 0,
+           "command": "spin-up-fan", "params": [7, 3]}
+
+
+def _feed(engine, values_by_dev, t0):
+    """One step: per-device measurement values, materialized so command
+    fires land in the engine's pending list."""
+    events, tokens = [], []
+    for i, value in enumerate(values_by_dev):
+        events.append(DeviceMeasurement(name="m", value=value,
+                                        event_date=t0 + i))
+        tokens.append(f"d{i}")
+    batch = engine.packer.pack_events(events, tokens)[0]
+    out = engine.submit(batch)
+    if isinstance(out, tuple):  # sharded: (routed, outputs)
+        engine.materialize_alerts(*out)
+    else:
+        engine.materialize_alerts(batch, out)
+
+
+class TestEngineSingleChip:
+    def test_fires_match_host_oracle_fields_intact(self):
+        _, tensors = _world()
+        engine = _single_engine(tensors)
+        _hot_rule(engine)
+        engine.upsert_actuation_policy(dict(_POLICY))
+        t0 = engine.packer.epoch_base_ms + 10_000
+        values = [150.0 if i % 3 == 0 else 20.0 for i in range(16)]
+        _feed(engine, values, t0)
+        fires = engine.take_command_fires()
+        want = {f"d{i}" for i in range(16) if values[i] > 100.0}
+        assert {f["device"] for f in fires} == want
+        for f in fires:
+            assert f["policy"] == "cool-down"
+            assert f["command"] == "spin-up-fan"
+            assert f["params"] == [7, 3]
+            assert f["source"] == PolicySource.THRESHOLD
+            assert f["level"] == int(AlertLevel.CRITICAL)
+        counters = engine.actuation_policy_counters()
+        assert counters["cool-down"] == {"fires": len(want),
+                                         "debounced": 0}
+        assert engine.commands_fired == len(want)
+
+    def test_debounce_window_in_event_time(self):
+        _, tensors = _world()
+        engine = _single_engine(tensors)
+        _hot_rule(engine)
+        engine.upsert_actuation_policy(
+            dict(_POLICY, debounce_ms=60_000))
+        t0 = engine.packer.epoch_base_ms + 10_000
+        hot = [150.0] * 8 + [20.0] * 8
+        _feed(engine, hot, t0)
+        assert len(engine.take_command_fires()) == 8
+        _feed(engine, hot, t0 + 30_000)     # inside the window
+        assert engine.take_command_fires() == []
+        _feed(engine, hot, t0 + 90_000)     # 90s after the fire
+        assert len(engine.take_command_fires()) == 8
+        counters = engine.actuation_policy_counters()["cool-down"]
+        assert counters == {"fires": 16, "debounced": 8}
+        assert engine.commands_debounced == 8
+
+    def test_storm_beyond_lane_capacity_drops_loudly(self):
+        _, tensors = _world()
+        engine = _single_engine(tensors, command_lane_capacity=4)
+        _hot_rule(engine)
+        engine.upsert_actuation_policy(dict(_POLICY))
+        t0 = engine.packer.epoch_base_ms + 10_000
+        _feed(engine, [150.0] * 16, t0)
+        fires = engine.take_command_fires()
+        assert len(fires) == 4
+        assert engine.commands_dropped == 12
+        # counters count true on-device fires, not just shipped slots
+        assert engine.actuation_policy_counters()["cool-down"]["fires"] \
+            == 16
+
+    def test_policy_replace_resets_debounce_state(self):
+        _, tensors = _world()
+        engine = _single_engine(tensors)
+        _hot_rule(engine)
+        engine.upsert_actuation_policy(
+            dict(_POLICY, debounce_ms=600_000))
+        t0 = engine.packer.epoch_base_ms + 10_000
+        hot = [150.0] * 4 + [20.0] * 12
+        _feed(engine, hot, t0)
+        assert len(engine.take_command_fires()) == 4
+        _feed(engine, hot, t0 + 1_000)
+        assert engine.take_command_fires() == []   # debounced
+        engine.upsert_actuation_policy(
+            dict(_POLICY, debounce_ms=600_000))    # epoch bump
+        _feed(engine, hot, t0 + 2_000)
+        assert len(engine.take_command_fires()) == 4
+
+
+class TestEngineSharded:
+    def _sharded(self, tensors, shards=4, **kw):
+        from sitewhere_tpu.parallel import ShardedPipelineEngine, make_mesh
+
+        kw.setdefault("per_shard_batch", 16)
+        kw.setdefault("measurement_slots", 4)
+        kw.setdefault("max_tenants", 4)
+        kw.setdefault("max_threshold_rules", 4)
+        kw.setdefault("max_geofence_rules", 4)
+        kw.setdefault("name", _unique_name())
+        engine = ShardedPipelineEngine(tensors, mesh=make_mesh(shards),
+                                       **kw)
+        engine.start()
+        return engine
+
+    def test_sharded_fires_match_single_chip(self):
+        """Same trace, both engine kinds: identical (device, policy)
+        fire sets every step and identical cumulative counters — the
+        lane rides the shard axis but the semantics cannot drift."""
+        _, tensors_a = _world()
+        single = _single_engine(tensors_a)
+        _, tensors_b = _world()
+        sharded = self._sharded(tensors_b)
+        for engine in (single, sharded):
+            _hot_rule(engine)
+            engine.upsert_actuation_policy(
+                dict(_POLICY, debounce_ms=60_000))
+        t0 = single.packer.epoch_base_ms + 10_000
+        rng = np.random.RandomState(11)
+        for step in range(4):
+            values = np.where(rng.rand(16) < 0.4, 150.0, 20.0).tolist()
+            ts = t0 + step * 40_000
+            _feed(single, values, ts)
+            _feed(sharded, values, ts)
+            fa = {(f["device"], f["policy"], f["command"])
+                  for f in single.take_command_fires()}
+            fb = {(f["device"], f["policy"], f["command"])
+                  for f in sharded.take_command_fires()}
+            assert fa == fb, f"step {step}"
+        assert single.actuation_policy_counters() \
+            == sharded.actuation_policy_counters()
+        assert single.commands_fired == sharded.commands_fired
+        assert single.commands_debounced == sharded.commands_debounced
+        assert single.commands_fired > 0
+
+    def test_checkpoint_roundtrip_sharded_to_single(self, tmp_path):
+        """Elastic resume mid-debounce: a 4-shard checkpoint restores on
+        a single-chip engine and the continued run fires identically to
+        the uninterrupted sharded one."""
+        from sitewhere_tpu.persist.checkpoint import PipelineCheckpointer
+
+        _, tensors_a = _world()
+        sharded = self._sharded(tensors_a)
+        _hot_rule(sharded)
+        sharded.upsert_actuation_policy(
+            dict(_POLICY, debounce_ms=100_000))
+        t0 = sharded.packer.epoch_base_ms + 10_000
+        hot = [150.0] * 8 + [20.0] * 8
+        _feed(sharded, hot, t0)            # all 8 fire; window opens
+        assert len(sharded.take_command_fires()) == 8
+        ckpt = PipelineCheckpointer(str(tmp_path))
+        ckpt.save(sharded)
+
+        _, tensors_b = _world()
+        single = _single_engine(tensors_b)
+        ckpt.restore(single)
+        assert [p["token"] for p in single.list_actuation_policies()] \
+            == ["cool-down"]
+        for ts, want in ((t0 + 50_000, 0),     # mid-window on BOTH
+                         (t0 + 150_000, 8)):   # window expired on BOTH
+            _feed(sharded, hot, ts)
+            _feed(single, hot, ts)
+            fa = {f["device"] for f in sharded.take_command_fires()}
+            fb = {f["device"] for f in single.take_command_fires()}
+            assert fa == fb and len(fa) == want, f"ts +{ts - t0}"
+        assert sharded.actuation_policy_counters() \
+            == single.actuation_policy_counters()
+
+
+class TestCheckpointSingleChip:
+    def test_debounce_state_survives_checkpoint_mid_window(self, tmp_path):
+        """Checkpoint taken 30s into a 100s debounce window: the fresh
+        engine must keep suppressing until the SAME event-time instant
+        the uninterrupted engine releases at."""
+        from sitewhere_tpu.persist.checkpoint import PipelineCheckpointer
+
+        _, tensors_a = _world()
+        engine_a = _single_engine(tensors_a)
+        _hot_rule(engine_a)
+        engine_a.upsert_actuation_policy(
+            dict(_POLICY, debounce_ms=100_000))
+        t0 = engine_a.packer.epoch_base_ms + 10_000
+        hot = [150.0] * 8 + [20.0] * 8
+        _feed(engine_a, hot, t0)
+        assert len(engine_a.take_command_fires()) == 8
+        ckpt = PipelineCheckpointer(str(tmp_path))
+        ckpt.save(engine_a)
+
+        _, tensors_b = _world()
+        engine_b = _single_engine(tensors_b)
+        ckpt.restore(engine_b)
+        for ts in (t0 + 30_000, t0 + 90_000, t0 + 120_000):
+            _feed(engine_a, hot, ts)
+            _feed(engine_b, hot, ts)
+            fa = sorted(f["device"] for f in engine_a.take_command_fires())
+            fb = sorted(f["device"] for f in engine_b.take_command_fires())
+            assert fa == fb, f"ts +{ts - t0}"
+        ca = engine_a.actuation_policy_counters()
+        assert ca == engine_b.actuation_policy_counters()
+        assert ca["cool-down"]["fires"] == 16      # t0 and t0+120s
+        assert ca["cool-down"]["debounced"] == 16  # +30s and +90s
+
+
+# ---------------------------------------------------------------------------
+# store convergence + REST + chaos drill
+# ---------------------------------------------------------------------------
+
+class TestReplicatedStore:
+    def _instance(self, tmp_path, name):
+        from sitewhere_tpu.instance import SiteWhereInstance
+
+        inst = SiteWhereInstance(
+            instance_id=name, data_dir=str(tmp_path / name),
+            enable_pipeline=True, max_devices=64, batch_size=32,
+            measurement_slots=8)
+        inst.start()
+        return inst
+
+    def test_lww_and_tombstone_convergence(self, tmp_path):
+        inst = self._instance(tmp_path, "act-lww")
+        try:
+            inst.install_actuation_policy("default", dict(_POLICY))
+            stamp = inst.actuation_policies.get(
+                "default", "cool-down")["stamp"]
+            older = dict(_POLICY, command="stale-cmd")
+            assert not inst.apply_replicated_actuation_policy(
+                "add", "default", "cool-down",
+                {"spec": older, "stamp": stamp - 10})
+            assert inst.pipeline_engine.get_actuation_policy(
+                "cool-down")["command"] == "spin-up-fan"
+            newer = dict(_POLICY, command="fresh-cmd")
+            assert inst.apply_replicated_actuation_policy(
+                "add", "default", "cool-down",
+                {"spec": newer, "stamp": stamp + 10})
+            assert inst.pipeline_engine.get_actuation_policy(
+                "cool-down")["command"] == "fresh-cmd"
+            # replayed add is idempotent: same stamp does not re-apply
+            assert not inst.apply_replicated_actuation_policy(
+                "add", "default", "cool-down",
+                {"spec": newer, "stamp": stamp + 10})
+            assert inst.apply_replicated_actuation_policy(
+                "remove", "default", "cool-down", stamp + 20)
+            assert inst.pipeline_engine.get_actuation_policy(
+                "cool-down") is None
+            # the tombstoned add cannot resurrect
+            assert not inst.apply_replicated_actuation_policy(
+                "add", "default", "cool-down",
+                {"spec": newer, "stamp": stamp + 15})
+        finally:
+            inst.stop()
+
+    def test_invalid_replicated_spec_is_structured_409(self, tmp_path):
+        inst = self._instance(tmp_path, "act-bad")
+        try:
+            with pytest.raises(ActuationPolicyError) as err:
+                inst.apply_replicated_actuation_policy(
+                    "add", "default", "bad",
+                    {"spec": {"token": "bad", "source": "sideways",
+                              "command": "c"}, "stamp": 10})
+            assert err.value.http_status == 409
+            assert "spec.source" in str(err.value)
+            assert inst.actuation_policies.get("default", "bad") is None
+        finally:
+            inst.stop()
+
+    def test_durable_across_restart(self, tmp_path):
+        inst = self._instance(tmp_path, "act-dur")
+        inst.install_actuation_policy("default", dict(_POLICY))
+        inst.stop()
+        from sitewhere_tpu.instance import SiteWhereInstance
+
+        inst2 = SiteWhereInstance(
+            instance_id="act-dur", data_dir=str(tmp_path / "act-dur"),
+            enable_pipeline=True, max_devices=64, batch_size=32,
+            measurement_slots=8)
+        inst2.start()
+        try:
+            assert inst2.pipeline_engine.get_actuation_policy(
+                "cool-down") is not None
+        finally:
+            inst2.stop()
+
+
+class TestRest:
+    @pytest.fixture()
+    def client(self, tmp_path):
+        from sitewhere_tpu.client import SiteWhereClient
+        from sitewhere_tpu.instance import SiteWhereInstance
+        from sitewhere_tpu.web import RestServer
+
+        instance = SiteWhereInstance(
+            instance_id="act-web", enable_pipeline=True, max_devices=64,
+            batch_size=32, measurement_slots=8)
+        instance.start()
+        rest = RestServer(instance, port=0)
+        rest.start()
+        c = SiteWhereClient(rest.base_url)
+        c.authenticate("admin", "password")
+        yield c
+        rest.stop()
+        instance.stop()
+
+    def test_crud_round_trip(self, client):
+        created = client.post("/api/tenants/default/actuations",
+                              dict(_POLICY))
+        assert created["token"] == "cool-down"
+        assert created["tenant_token"] == "default"
+        listed = client.get("/api/tenants/default/actuations")
+        assert [p["token"] for p in listed["policies"]] == ["cool-down"]
+        assert listed["policies"][0]["fires"] == 0
+        got = client.get("/api/tenants/default/actuations/cool-down")
+        assert got["command"] == "spin-up-fan"
+        assert got["debounced"] == 0
+        assert client.delete(
+            "/api/tenants/default/actuations/cool-down")["removed"]
+        from sitewhere_tpu.client import SiteWhereClientError
+
+        with pytest.raises(SiteWhereClientError) as err:
+            client.get("/api/tenants/default/actuations/cool-down")
+        assert err.value.status == 404
+
+    def test_invalid_spec_is_409_naming_field(self, client):
+        from sitewhere_tpu.client import SiteWhereClientError
+
+        with pytest.raises(SiteWhereClientError) as err:
+            client.post("/api/tenants/default/actuations",
+                        {"token": "bad", "source": "sideways",
+                         "command": "c"})
+        assert err.value.status == 409
+        assert "spec.source" in str(err.value)
+        with pytest.raises(SiteWhereClientError) as err:
+            client.post("/api/tenants/default/actuations",
+                        dict(_POLICY, params=[1, 2, 3, 4, 5]))
+        assert err.value.status == 409
+        assert "spec.params" in str(err.value)
+
+    def test_duplicate_token_409(self, client):
+        from sitewhere_tpu.client import SiteWhereClientError
+
+        client.post("/api/tenants/default/actuations", dict(_POLICY))
+        with pytest.raises(SiteWhereClientError) as err:
+            client.post("/api/tenants/default/actuations", dict(_POLICY))
+        assert err.value.status == 409
+        client.delete("/api/tenants/default/actuations/cool-down")
+
+
+class TestDeliveryFaultDrill:
+    def test_park_and_redeliver_under_delivery_faults(self):
+        """The `command_delivery_error` chaos drill: a storm under a
+        p=1.0 delivery fault parks every fire on the dead-letter ring
+        (bounded retries exhausted), the conservation invariant holds,
+        and `redeliver_parked` drains the ring once the fault clears."""
+        from sitewhere_tpu.actuation.dispatcher import CommandFanout
+        from sitewhere_tpu.runtime.faults import (
+            FaultPlan, FaultRule, arm, disarm)
+
+        _, tensors = _world()
+        engine = _single_engine(tensors)
+        _hot_rule(engine)
+        engine.upsert_actuation_policy(dict(_POLICY))
+        fan = CommandFanout(max_retries=1)
+        engine.command_dispatcher = fan
+        t0 = engine.packer.epoch_base_ms + 10_000
+        _feed(engine, [150.0] * 16, t0)
+        assert fan.stats()["delivered"] == 16
+
+        arm(FaultPlan(seed=1, rules=[
+            FaultRule("command_delivery_error", p=1.0)]))
+        try:
+            _feed(engine, [150.0] * 16, t0 + 60_000)
+        finally:
+            disarm()
+        s = fan.stats()
+        assert s["parked"] == 16 and s["dead_letter_depth"] == 16
+        assert s["retries"] == 16                # one bounded retry each
+        # conservation: every fire is delivered, parked, or suppressed
+        assert s["delivered"] + s["parked"] + s["suppressed"] == 32
+
+        assert fan.redeliver_parked() == 16
+        s = fan.stats()
+        assert s["delivered"] == 32 and s["dead_letter_depth"] == 0

@@ -17,6 +17,7 @@ from sitewhere_tpu.model import (
     AlertLevel, Device, DeviceAssignment, DeviceLocation, DeviceMeasurement,
     DeviceType,
 )
+from sitewhere_tpu.ops.actuate import COMMAND_LANE_ROWS
 from sitewhere_tpu.ops.compact import (
     ALERT_LANE_ROWS, compact_alert_lanes, decode_alert_lanes,
 )
@@ -175,12 +176,13 @@ class TestCompactOp:
 
 
 class TestDifferentialSingleChip:
-    def _engine(self, capacity=None):
+    def _engine(self, capacity=None, command_capacity=None):
         _, tensors = _world()
         engine = PipelineEngine(tensors, batch_size=64, measurement_slots=8,
                                 max_tenants=4, max_threshold_rules=16,
                                 max_geofence_rules=16,
                                 alert_lane_capacity=capacity,
+                                command_lane_capacity=command_capacity,
                                 name=_unique_name())
         engine.start()
         _add_rules(engine)
@@ -258,18 +260,22 @@ class TestDifferentialSingleChip:
         assert engine.alerts_dropped > 0
 
     def test_single_fixed_fetch_per_materialize(self):
-        # capacity sized for the batch the way a deployment sizes it
+        # capacities sized for the batch the way a deployment sizes them
         # (the default 128 over the latency tier's 4096 batch is the
-        # same 1:32 ratio; a toy 64-row batch pins capacity 8 so the
-        # bytes claim is tested at deployment proportions)
-        engine = self._engine(capacity=8)
+        # same 1:32 ratio; a toy 64-row batch pins both lanes to 8 so
+        # the bytes claim is tested at deployment proportions)
+        engine = self._engine(capacity=8, command_capacity=8)
         events, tokens = _mixed_events(30)
         batch, out = self._submit(engine, events, tokens)
         f0, b0 = engine.d2h_fetches, engine.d2h_bytes
         engine.materialize_alerts(batch, out)
         lane_bytes = engine.d2h_bytes - b0
-        assert engine.d2h_fetches - f0 == 1
-        assert lane_bytes == ALERT_LANE_ROWS * engine.alert_lane_capacity * 4
+        # two fixed-shape fetches per offer: alert lane + command lane,
+        # one batched device_get
+        assert engine.d2h_fetches - f0 == 2
+        assert lane_bytes == (
+            ALERT_LANE_ROWS * engine.alert_lane_capacity * 4
+            + COMMAND_LANE_ROWS * engine.command_lane_capacity * 4)
         # >= 3x fewer bytes than the pre-lane six-array fetch (the
         # deterministic half of the materialize win; the wall-clock
         # speedup is pinned by bench.py on the real link)
@@ -375,10 +381,13 @@ class TestDifferentialSharded:
         routed, out = engine.submit(batch)
         f0, b0 = engine.d2h_fetches, engine.d2h_bytes
         engine.materialize_alerts(routed, out)
-        assert engine.d2h_fetches - f0 == 1
+        # alert lane + command lane, both sharded, one batched device_get
+        assert engine.d2h_fetches - f0 == 2
         assert (engine.d2h_bytes - b0
                 == engine.n_shards * ALERT_LANE_ROWS
-                * engine.alert_lane_capacity * 4)
+                * engine.alert_lane_capacity * 4
+                + engine.n_shards * COMMAND_LANE_ROWS
+                * engine.command_lane_capacity * 4)
 
 
 class TestTokenArray:

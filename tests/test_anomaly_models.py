@@ -334,7 +334,7 @@ class TestDifferentialSingleChip:
             ref = materialize_alerts_maskscan(engine, batch, out)
             f0 = engine.d2h_fetches
             got = engine.materialize_alerts(batch, out)
-            assert engine.d2h_fetches - f0 == 1  # fetch budget holds
+            assert engine.d2h_fetches - f0 == 2  # fetch budget holds
             assert [key(a) for a in got] == [key(a) for a in ref]
             seen_types.update(a.type for a in got)
         # model fires actually rode the lanes, alongside rule alerts.
@@ -356,7 +356,7 @@ class TestDifferentialSingleChip:
             assert not np.asarray(out.model_score).any()
             f0 = engine.d2h_fetches
             assert engine.materialize_alerts(batch, out) == []
-            assert engine.d2h_fetches - f0 == 1
+            assert engine.d2h_fetches - f0 == 2
         assert engine.anomaly_model_counters() == {}
 
     def test_nan_feature_never_fires_or_scores(self):
@@ -553,10 +553,14 @@ class TestDifferentialSharded:
             routed, out = engine.submit(batch)
             f0, b0 = engine.d2h_fetches, engine.d2h_bytes
             engine.materialize_alerts(routed, out)
-            assert engine.d2h_fetches - f0 == 1
+            # alert + command lanes, both sharded, one batched device_get
+            from sitewhere_tpu.ops.actuate import COMMAND_LANE_ROWS
+            assert engine.d2h_fetches - f0 == 2
             assert (engine.d2h_bytes - b0
                     == engine.n_shards * ALERT_LANE_ROWS
-                    * engine.alert_lane_capacity * 4)
+                    * engine.alert_lane_capacity * 4
+                    + engine.n_shards * COMMAND_LANE_ROWS
+                    * engine.command_lane_capacity * 4)
 
     def test_checkpoint_roundtrip_sharded_to_single(self, tmp_path):
         """Canonical checkpoints with model state restore across engine

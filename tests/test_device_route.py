@@ -283,9 +283,10 @@ class TestEngineDifferential:
         _assert_state_parity(dev_eng, host_eng)
         assert dev_eng.device_route_steps >= 3
         assert dev_eng.device_route_dropped == 0
-        # fetch budget unchanged by device routing: exactly ONE
-        # fixed-shape lane fetch per materialized step
-        assert dev_eng.d2h_fetches == fetches_before + 3
+        # fetch budget unchanged by device routing: exactly TWO
+        # fixed-shape lane fetches per materialized step (alert +
+        # command lanes, one batched device_get)
+        assert dev_eng.d2h_fetches == fetches_before + 6
 
     def test_skew_all_rows_one_device_falls_back(self, engine_pair):
         """All rows to ONE device: a lane bucket overflows, the guard

@@ -182,8 +182,9 @@ class TestAgeSingleChip:
             fetches_before = engine.d2h_fetches
             routed, out = engine.submit_routed(batch, age=age)
             engine.materialize_alerts(routed, out)
-            # one lane fetch per offer — telemetry must not add D2H syncs
-            assert engine.d2h_fetches == fetches_before + 1
+            # two lane fetches per offer (alert + command lanes, one
+            # batched device_get) — telemetry must not add D2H syncs
+            assert engine.d2h_fetches == fetches_before + 2
             rec = engine._flight_last
             assert hasattr(rec.age, "buckets")        # closed AgeSummary
             assert rec.age.count == 16

@@ -161,10 +161,16 @@ def test_compact_result_line_parses_and_fits_tail_capture():
         "latency_mode": {"batch_size": 4096, "linger_ms": 1.0,
                          "adaptive_linger": True, "warm_flushes": 4,
                          "trial_warmup_offers": 2},
-        "latency_fetch": {"d2h_fetches_per_offer": 1.0,
+        "latency_fetch": {"d2h_fetches_per_offer": 2.0,
                           "d2h_bytes_per_offer": 2048.0,
-                          "lane_capacity": 128},
+                          "lane_capacity": 128,
+                          "command_lane_capacity": 64},
         "materialize_lane_speedup_x": 12.34,
+        "actuation": {"lane_vs_host_speedup_x": 1.8,
+                      "marginal_step_pct": 3.2,
+                      "detection_to_actuation_p99_ms": 4.1,
+                      "d2h_fetches_per_offer": 2.0},
+        "drift": {"time_to_adapt_s": 0.42},
         "telemetry_wire_bytes_per_event": 13.7,
         "analytics_replay_events_per_sec": 1.0e7,
         "sharded_from_bytes_events_per_sec": 2.1e7,
@@ -311,30 +317,48 @@ def test_latency_budget_check():
 
 
 def test_latency_fetch_budget_check():
-    """The latency tier must ship exactly ONE fixed-shape D2H fetch per
-    offer, bytes bounded by lane capacity x lane bytes — a regression to
-    per-array fetches fails loudly on any host, any link state."""
+    """The latency tier must ship exactly TWO fixed-shape D2H fetches
+    per offer (alert lane + command lane, one batched device_get), bytes
+    bounded by the two lane capacities — a regression to per-array
+    fetches fails loudly on any host, any link state."""
     ok = _bench()
-    ok["latency_fetch"] = {"d2h_fetches_per_offer": 1.0,
+    ok["latency_fetch"] = {"d2h_fetches_per_offer": 2.0,
                            "d2h_bytes_per_offer": 2048.0,
-                           "lane_capacity": 128}
+                           "lane_capacity": 128,
+                           "command_lane_capacity": 64}
     out = self_consistency(ok)
     assert out["ok"]
     assert out["checks"]["latency_fetch_budget"]["ok"]
     assert out["checks"]["latency_fetch_budget"][
-        "max_bytes_per_offer"] == 128 * 16
-    # a second fetch per offer (regression to per-array fetching) fails
+        "max_bytes_per_offer"] == 128 * 16 + 64 * 16
+    # an extra fetch per offer (regression to per-array fetching) fails
     bad = _bench()
-    bad["latency_fetch"] = {"d2h_fetches_per_offer": 2.0,
+    bad["latency_fetch"] = {"d2h_fetches_per_offer": 3.0,
+                            "d2h_bytes_per_offer": 2048.0,
+                            "lane_capacity": 128,
+                            "command_lane_capacity": 64}
+    assert not self_consistency(bad)["ok"]
+    # so does losing the command lane's ride-along (one bare fetch)
+    one = _bench()
+    one["latency_fetch"] = {"d2h_fetches_per_offer": 1.0,
+                            "d2h_bytes_per_offer": 2048.0,
+                            "lane_capacity": 128,
+                            "command_lane_capacity": 64}
+    assert not self_consistency(one)["ok"]
+    # fatter-than-budget bytes fail even at the pinned fetch count
+    fat = _bench()
+    fat["latency_fetch"] = {"d2h_fetches_per_offer": 2.0,
+                            "d2h_bytes_per_offer": 128 * 16 + 64 * 16 + 4,
+                            "lane_capacity": 128,
+                            "command_lane_capacity": 64}
+    assert not self_consistency(fat)["ok"]
+    # rounds recorded before the command lane reported its capacity get
+    # the default allowance, not a failure
+    old = _bench()
+    old["latency_fetch"] = {"d2h_fetches_per_offer": 2.0,
                             "d2h_bytes_per_offer": 2048.0,
                             "lane_capacity": 128}
-    assert not self_consistency(bad)["ok"]
-    # fatter-than-budget bytes fail even at one fetch
-    fat = _bench()
-    fat["latency_fetch"] = {"d2h_fetches_per_offer": 1.0,
-                            "d2h_bytes_per_offer": 128 * 16 + 4,
-                            "lane_capacity": 128}
-    assert not self_consistency(fat)["ok"]
+    assert self_consistency(old)["ok"]
     # rounds recorded before the lanes existed have nothing to check
     assert self_consistency(_bench())["ok"]
 
@@ -410,11 +434,13 @@ def test_link_waiver_on_degraded_h2d():
     slow = _bench()
     slow["device_routing"] = {"router_offload_speedup_x": 0.4,
                               "parity_ok": True}
-    slow["rule_programs"] = {"d2h_fetches_per_offer": 1,
+    slow["rule_programs"] = {"d2h_fetches_per_offer": 2,
                              "compiled_vs_host_speedup_x": 0.2}
-    slow["anomaly_models"] = {"d2h_fetches_per_offer": 1,
+    slow["anomaly_models"] = {"d2h_fetches_per_offer": 2,
                               "offload_speedup_x": 0.75,
                               "marginal_step_pct": 2.0}
+    slow["actuation"] = {"d2h_fetches_per_offer": 2,
+                         "marginal_step_pct": 22.0}
     slow["latency_mode_trial_p99_ms"] = [233.2, 228.2]
     # accelerator host, healthy link (no probe evidence of degradation):
     # every miss is a hard FAIL
@@ -426,7 +452,7 @@ def test_link_waiver_on_degraded_h2d():
     out = self_consistency(slow)
     assert out["ok"]
     for name in ("device_routing", "rule_programs", "anomaly_models",
-                 "latency_budget_met"):
+                 "actuation_lanes", "latency_budget_met"):
         entry = out["checks"][name]
         assert entry["ok"], name
         waiver = entry["link_waived"]

@@ -328,7 +328,7 @@ class TestDifferentialSingleChip:
             ref = materialize_alerts_maskscan(engine, batch, out)
             f0 = engine.d2h_fetches
             got = engine.materialize_alerts(batch, out)
-            assert engine.d2h_fetches - f0 == 1  # fetch budget holds
+            assert engine.d2h_fetches - f0 == 2  # fetch budget holds
             assert [key(a) for a in got] == [key(a) for a in ref]
             any_fired = any_fired or bool(ref)
         assert any_fired
@@ -506,10 +506,14 @@ class TestDifferentialSharded:
             routed, out = engine.submit(batch)
             f0, b0 = engine.d2h_fetches, engine.d2h_bytes
             alerts = engine.materialize_alerts(routed, out)
-            assert engine.d2h_fetches - f0 == 1
+            # alert + command lanes, both sharded, one batched device_get
+            from sitewhere_tpu.ops.actuate import COMMAND_LANE_ROWS
+            assert engine.d2h_fetches - f0 == 2
             assert (engine.d2h_bytes - b0
                     == engine.n_shards * ALERT_LANE_ROWS
-                    * engine.alert_lane_capacity * 4)
+                    * engine.alert_lane_capacity * 4
+                    + engine.n_shards * COMMAND_LANE_ROWS
+                    * engine.command_lane_capacity * 4)
 
     def test_checkpoint_roundtrip_sharded_to_single(self, tmp_path):
         """Canonical checkpoints with rule state restore across engine
