@@ -235,6 +235,25 @@ AGE_P99_BUDGET_MS = 25.0
 # fused step are synchronous there, so overlap is structurally ~0).
 MIN_H2D_OVERLAP = 0.6
 
+# Query serving tier (sitewhere_tpu/serving/): the incremental window
+# cache must make a repeat window ≥5x cheaper than the cold full rescan
+# (delta-scan + exact merge vs scanning every sealed segment), and the
+# vectorized replay decode must beat the per-record loop oracle it
+# replaced by ≥3x — both are host-vs-host comparisons of the same
+# workload on the same machine, so they gate HARD on every host (the
+# bench takes the best trial: steal noise only shrinks the ratio). The
+# concurrency claims — 64 dashboard clients degrade full-rate ingest
+# < 10% and keep query p99 inside budget — are deployment targets like
+# the latency budget: hard on accelerator-fingerprinted hosts, advisory
+# on the cpu smoke (readers and the synchronous cpu step fight for the
+# same cores there, which is not the deployment), link-waiver eligible
+# (a degraded tunnel stalls the ingest baseline and the loaded run
+# differently, poisoning the quotient).
+MIN_CACHE_DELTA_SPEEDUP = 5.0
+MIN_REPLAY_VEC_SPEEDUP = 3.0
+MAX_INGEST_DEGRADATION_PCT = 10.0
+QUERY_P99_BUDGET_MS = 50.0
+
 # Trial-spread bounds: full scale judges the accelerator-scale claim; the
 # BENCH_SCALE=small smoke still EVALUATES the check (bench's sections now
 # measure steady-state windows with explicit warmup exclusion, so the
@@ -771,6 +790,63 @@ def self_consistency(bench: Dict) -> Dict:
                     "measures dispatch noise — the bound gates "
                     "accelerator-fingerprinted runs)")
             checks["feeder_fleet"] = entry
+    # Query-serving budget: the window cache's delta-scan speedup and
+    # replay parity are same-host workload facts — hard everywhere. The
+    # vectorized-replay pin is also host-vs-host (numpy chunk decode vs
+    # the per-record loop oracle, same compiled kernel on both sides)
+    # but its advantage amortizes a fixed per-call cost over rows, so it
+    # gates hard at full scale only and is advisory on the small smoke's
+    # abbreviated corpus. The 64-client concurrency targets (ingest
+    # degradation, query p99) gate on accelerator hosts only; the cpu
+    # smoke runs readers and the synchronous step on the same cores, so
+    # the degradation there measures core contention, not the
+    # deployment. Absent before the tier existed: no check.
+    sv = bench.get("serving")
+    if isinstance(sv, dict):
+        cache_x = sv.get("cache_delta_speedup_x")
+        replay_x = sv.get("replay_vec_speedup_x")
+        parity = sv.get("replay_parity_ok")
+        if all(isinstance(v, (int, float)) for v in (cache_x, replay_x)):
+            degr = bench.get("ingest_degradation_pct")
+            p99 = bench.get("query_p99_ms")
+            replay_ok = replay_x >= MIN_REPLAY_VEC_SPEEDUP
+            host_ok = (cache_x >= MIN_CACHE_DELTA_SPEEDUP
+                       and (replay_ok or small)
+                       and bool(parity))
+            conc_known = all(isinstance(v, (int, float))
+                             for v in (degr, p99))
+            conc_ok = (not conc_known
+                       or (degr < MAX_INGEST_DEGRADATION_PCT
+                           and p99 <= QUERY_P99_BUDGET_MS))
+            entry = {
+                "ok": host_ok and (conc_ok or cpu_host or small),
+                "cache_delta_speedup_x": cache_x,
+                "min_cache_speedup_x": MIN_CACHE_DELTA_SPEEDUP,
+                "replay_vec_speedup_x": replay_x,
+                "min_replay_speedup_x": MIN_REPLAY_VEC_SPEEDUP,
+                "replay_parity_ok": bool(parity)}
+            if small and not replay_ok:
+                entry["replay_advisory"] = (
+                    "replay vectorization under bound on the small "
+                    "smoke (advisory; the abbreviated replay corpus "
+                    "does not amortize the fixed per-call decode cost "
+                    "— the bound gates full-scale runs on every host)")
+            if conc_known:
+                entry["ingest_degradation_pct"] = degr
+                entry["max_degradation_pct"] = MAX_INGEST_DEGRADATION_PCT
+                entry["query_p99_ms"] = p99
+                entry["query_p99_budget_ms"] = QUERY_P99_BUDGET_MS
+            if (cpu_host or small) and not conc_ok:
+                entry["concurrency_advisory"] = (
+                    "ingest-degradation/p99 over bound on a CPU-only/"
+                    "smoke host (advisory; readers and the synchronous "
+                    "cpu step contend for the same cores — the bounds "
+                    "gate accelerator-fingerprinted runs)")
+            elif not conc_ok and link["degraded"]:
+                entry["ok"] = host_ok
+                entry["link_waived"] = _link_waiver(
+                    link, "serving concurrency bounds missed")
+            checks["query_serving"] = entry
     # Spread judged against the steady-state windows at every scale; the
     # BENCH_SCALE=small smoke gets the wider bound (sub-millisecond CPU
     # section timings ride scheduler noise on shared CI hosts).

@@ -100,7 +100,20 @@ def _build_instance(cfg, mesh=None):
             int(cfg.get("faults.admission_queue_depth_budget"))
             if cfg.get("faults.admission_queue_depth_budget") is not None
             else None),
-        trace_sample_n=int(cfg.get("observability.trace_sample_n") or 0))
+        trace_sample_n=int(cfg.get("observability.trace_sample_n") or 0),
+        serving_workers=int(cfg.get("serving.workers") or 4),
+        serving_queue_depth_budget=int(
+            cfg.get("serving.queue_depth_budget") or 64),
+        serving_latency_budget_ms=float(
+            cfg.get("serving.latency_budget_ms") or 0.0),
+        serving_cache_mb=float(cfg.get("serving.cache_mb") or 64.0),
+        serving_mesh_row_threshold=(
+            int(cfg.get("serving.mesh_row_threshold"))
+            if cfg.get("serving.mesh_row_threshold") is not None
+            else None),
+        refit_interval_s=(
+            float(cfg.get("actuation.refit_interval_s"))
+            if cfg.get("actuation.refit_interval_s") else None))
 
 
 def _apply_rule_config(instance, cfg) -> None:

@@ -209,3 +209,49 @@ class DriftRefitter:
                 "refit '%s': threshold %.4f -> %.4f over %d devices",
                 token, report["old_threshold"], report["threshold"], n)
         return report
+
+
+class DriftRefitJobExecutor:
+    """ScheduleManager executor (ScheduledJobType.DRIFT_REFIT): one job
+    fire = one unattended refit sweep.
+
+    PR 19's named follow-up — refits ran only when an operator POSTed
+    them. Registered on every tenant engine's schedule manager
+    (multitenant/engine.py), so a simple-trigger schedule turns the
+    adaptation loop autonomous: each fire walks the engine's installed
+    anomaly models (or the comma-separated ``models`` subset in the job
+    configuration) and pushes a refit through the same gossip-replicated
+    ``upsert_anomaly_model`` path the manual route uses. Thin-data
+    models are skipped by the refitter itself (`min_devices`), so an
+    unattended sweep can never clobber a model with a bad fit. Sweeps
+    are counted under ``actuation.refit_sweeps``; instance wiring is
+    opt-in via the off-by-default ``actuation.refit_interval_s`` knob
+    (runtime/config.py)."""
+
+    # job_configuration key: comma-separated model tokens ("" = all)
+    MODELS_KEY = "models"
+
+    def __init__(self, refitter: DriftRefitter, metrics=None):
+        from sitewhere_tpu.runtime.metrics import GLOBAL_METRICS
+        self.refitter = refitter
+        m = metrics or GLOBAL_METRICS
+        self.sweep_counter = m.counter("actuation.refit_sweeps")
+
+    def execute(self, job) -> Dict:
+        cfg = getattr(job, "job_configuration", None) or {}
+        wanted = [t for t in
+                  (cfg.get(self.MODELS_KEY) or "").split(",") if t]
+        if not wanted:
+            wanted = [entry["spec"]["token"] for entry in
+                      self.refitter.engine.anomaly_model_manifest()]
+        applied = 0
+        for token in wanted:
+            try:
+                report = self.refitter.refit(token, apply=True)
+            except Exception:
+                LOGGER.exception("scheduled refit of '%s' failed", token)
+                continue
+            if report is not None:
+                applied += 1
+        self.sweep_counter.inc()
+        return {"models": len(wanted), "applied": applied}

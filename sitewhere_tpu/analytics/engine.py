@@ -80,10 +80,18 @@ class WindowReport:
 
 
 class WindowedAnalyticsEngine:
-    """Windowed replay over the columnar event log."""
+    """Windowed replay over the columnar event log.
 
-    def __init__(self, event_log: ColumnarEventLog):
+    With a `planner` (serving/planner.py) attached, the `mesh=None`
+    default below stops meaning "host kernel" and starts meaning
+    "planner-decided": large scans route onto mesh-sharded replay
+    (parallel/distributed.py) by default, small ones stay on the host.
+    Passing an explicit mesh still forces the sharded path either way.
+    """
+
+    def __init__(self, event_log: ColumnarEventLog, planner=None):
         self.event_log = event_log
+        self.planner = planner
 
     def measurement_windows(self, tenant: str, *, window_ms: int = 60_000,
                             mm_name: Optional[str] = None,
@@ -102,6 +110,10 @@ class WindowedAnalyticsEngine:
         flt = EventFilter(event_type=DeviceEventType.MEASUREMENT,
                           mm_name=mm_name, area_id=area_id,
                           start_date=start_ms, end_date=end_ms)
+        if mesh is None and self.planner is not None:
+            # planner-decided routing: the live mesh for large scans,
+            # host kernel for small ones (serving/planner.py)
+            mesh = self.planner.choose_mesh(tenant, flt)
         # Key on the int32 device_idx column, NOT the token strings:
         # sorting/searching 100k+ Python strings in compact_keys dominated
         # replay cost (≈0.9s of a 1.0s replay at 650k rows); integer
@@ -246,6 +258,52 @@ class WindowedAnalyticsEngine:
                             type_counts=type_counts)
 
 
+def _decode_measurement_chunk(batch):
+    """One poll batch -> (tokens, dates, values) preallocated columns.
+
+    The loop oracle (`unpack_enriched` per record) constructs a
+    DeviceEventContext plus a full DeviceEvent dataclass per row and
+    appends scalars to Python lists; replay needs exactly three scalars
+    per measurement, so this path reads them straight out of the msgpack
+    dict into preallocated numpy chunks (no dataclass materialization,
+    no per-row list growth). A record whose shape surprises us retries
+    through the full decoder before being dropped — decode tolerance is
+    unchanged. Returns None when the batch holds no measurements."""
+    import msgpack
+
+    m = len(batch)
+    tokens = np.empty(m, object)
+    dates = np.empty(m, np.int64)
+    values = np.empty(m, np.float32)
+    k = 0
+    measurement = int(DeviceEventType.MEASUREMENT)
+    for record in batch:
+        try:
+            event = msgpack.unpackb(record.value, raw=False)["event"]
+            etype = event["event_type"]
+            edate = event["event_date"]
+            evalue = event.get("value", 0.0)
+            token = event.get("device_id") or ""
+        except Exception:
+            try:  # slow-path retry: the oracle's full decode
+                from sitewhere_tpu.pipeline.enrichment import unpack_enriched
+                _, ev = unpack_enriched(record.value)
+                etype, edate = int(ev.event_type), ev.event_date
+                evalue = getattr(ev, "value", 0.0)
+                token = ev.device_id or ""
+            except Exception:
+                continue
+        if etype != measurement:
+            continue
+        tokens[k] = token
+        dates[k] = int(edate)
+        values[k] = float(evalue or 0.0)
+        k += 1
+    if k == 0:
+        return None
+    return tokens[:k], dates[:k], values[:k]
+
+
 class BusReplayAnalytics:
     """The literal Kafka-replay flavor: re-consume an enriched topic from
     offset zero into columns, then run the same windowed kernels.
@@ -262,33 +320,42 @@ class BusReplayAnalytics:
     def replay_measurements(self, tenant: str, *, window_ms: int = 60_000,
                             group_id: str = "analytics-replay",
                             max_windows: int = 4096) -> WindowReport:
-        from sitewhere_tpu.pipeline.enrichment import unpack_enriched
         topic = self.naming.inbound_enriched_events(tenant)
         consumer = self.bus.consumer(topic, group_id)
         consumer.seek_to_beginning()
-        token_idx: Dict[str, int] = {}
-        keys: List[int] = []
-        dates: List[int] = []
-        values: List[float] = []
+        token_chunks: List[np.ndarray] = []
+        date_chunks: List[np.ndarray] = []
+        value_chunks: List[np.ndarray] = []
         while True:
             batch = consumer.poll(8192)
             if not batch:
                 break
-            for record in batch:
-                try:
-                    _, event = unpack_enriched(record.value)
-                except Exception:
-                    continue
-                if event.event_type != DeviceEventType.MEASUREMENT:
-                    continue
-                token = event.device_id or ""
-                idx = token_idx.setdefault(token, len(token_idx))
-                keys.append(idx)
-                dates.append(int(event.event_date))
-                values.append(float(event.value))
-        tokens = list(token_idx)
+            chunk = _decode_measurement_chunk(batch)
+            if chunk is not None:
+                token_chunks.append(chunk[0])
+                date_chunks.append(chunk[1])
+                value_chunks.append(chunk[2])
+        if not token_chunks:
+            return WindowedAnalyticsEngine._build_report(
+                np.array([], np.int64), np.array([], np.int64),
+                np.array([], np.float32), window_ms=window_ms,
+                start_ms=None, end_ms=None, max_windows=max_windows,
+                tokens=[])
+        all_tokens = np.concatenate(token_chunks)
+        # batch token interning replacing the per-row dict setdefault:
+        # one np.unique pass, then a rank remap so key ids keep the
+        # original FIRST-APPEARANCE numbering (np.unique sorts
+        # lexically; downstream key order must not change).
+        uniq, first, inverse = np.unique(all_tokens, return_index=True,
+                                         return_inverse=True)
+        rank = np.empty(len(uniq), np.int64)
+        rank[np.argsort(first, kind="stable")] = np.arange(
+            len(uniq), dtype=np.int64)
+        keys = rank[inverse]
+        tokens_arr = np.empty(len(uniq), object)
+        tokens_arr[rank] = uniq
         return WindowedAnalyticsEngine._build_report(
-            np.asarray(keys, np.int64), np.asarray(dates, np.int64),
-            np.asarray(values, np.float32), window_ms=window_ms,
+            keys, np.concatenate(date_chunks),
+            np.concatenate(value_chunks), window_ms=window_ms,
             start_ms=None, end_ms=None, max_windows=max_windows,
-            tokens=tokens)
+            tokens=[str(t) for t in tokens_arr])

@@ -64,7 +64,13 @@ class SiteWhereInstance(LifecycleComponent):
                  admission_step_budget_ms: Optional[float] = None,
                  admission_queue_depth_budget: Optional[int] = None,
                  trace_sample_n: int = 0,
-                 h2d_buffer_depth: int = 3):
+                 h2d_buffer_depth: int = 3,
+                 serving_workers: int = 4,
+                 serving_queue_depth_budget: int = 64,
+                 serving_latency_budget_ms: float = 0.0,
+                 serving_cache_mb: float = 64.0,
+                 serving_mesh_row_threshold: Optional[int] = None,
+                 refit_interval_s: Optional[float] = None):
         super().__init__(f"instance:{instance_id}")
         self.instance_id = instance_id
         self.data_dir = data_dir
@@ -153,6 +159,38 @@ class SiteWhereInstance(LifecycleComponent):
             self.latency_batcher = AdaptiveBatcher(
                 self.pipeline_engine, linger_ms=latency_linger_ms,
                 adaptive=latency_adaptive)
+
+        # concurrent query serving tier (serving/, docs/SERVING.md):
+        # planner-routed measurement-window reads — host kernel for small
+        # scans, sharded replay over the live mesh for large ones — behind
+        # an incremental [K, W] grid cache and bounded read admission, so
+        # dashboard pollers never stall ingest. The planner's mesh provider
+        # prefers the pipeline's own mesh (already forming the step loop's
+        # shard axis); a pipeline-less instance falls back to live_mesh().
+        from sitewhere_tpu.analytics.engine import WindowedAnalyticsEngine
+        from sitewhere_tpu.serving import (
+            QueryExecutor, QueryPlanner, WindowGridCache)
+        from sitewhere_tpu.serving.planner import DEFAULT_MESH_ROW_THRESHOLD
+        self.analytics_planner = QueryPlanner(
+            self.event_log, mesh_provider=self._serving_mesh,
+            mesh_row_threshold=(serving_mesh_row_threshold
+                                if serving_mesh_row_threshold is not None
+                                else DEFAULT_MESH_ROW_THRESHOLD))
+        self.analytics_engine = WindowedAnalyticsEngine(
+            self.event_log, planner=self.analytics_planner)
+        self.window_cache = WindowGridCache(
+            max_bytes=int(float(serving_cache_mb) * (1 << 20)))
+        self.serving = QueryExecutor(
+            self.analytics_engine, self.analytics_planner, self.window_cache,
+            workers=serving_workers,
+            queue_depth_budget=serving_queue_depth_budget,
+            latency_budget_ms=serving_latency_budget_ms or 0.0)
+        # unattended drift-refit sweeps (actuation/refit.py): when set, a
+        # SIMPLE-trigger schedule + DRIFT_REFIT job is installed on every
+        # tenant engine at boot (_make_engine). Off by default: refits
+        # rewrite live model constants, so autonomy is an operator opt-in.
+        self.refit_interval_s = (float(refit_interval_s)
+                                 if refit_interval_s else None)
 
         # robustness plane (runtime/faults.py, sources/manager.py):
         # `allow_fault_drills` gates the POST /api/instance/faults drill
@@ -317,6 +355,18 @@ class SiteWhereInstance(LifecycleComponent):
             return None
         return SqliteStore(os.path.join(self.data_dir, f"{kind}.db"))
 
+    def _serving_mesh(self):
+        """Planner mesh provider: the pipeline's own mesh when the hot
+        path is sharded (its shard axis IS the replay axis), else the
+        process-wide live mesh (parallel/distributed.live_mesh — None on
+        single-chip hosts, which keeps every query on the host kernel)."""
+        engine = self.pipeline_engine
+        mesh = getattr(engine, "mesh", None) if engine is not None else None
+        if mesh is not None:
+            return mesh
+        from sitewhere_tpu.parallel.distributed import live_mesh
+        return live_mesh()
+
     def _make_engine(self, tenant: Tenant) -> TenantEngine:
         store_factory: Optional[Callable] = None
         if self.data_dir is not None:
@@ -341,7 +391,50 @@ class SiteWhereInstance(LifecycleComponent):
                 logging.getLogger("sitewhere.instance").exception(
                     "could not restore scripted rule %r (script %r) for "
                     "tenant %s", row["token"], row["script"], tenant.token)
+        if self.refit_interval_s and engine.drift_refitter is not None:
+            try:
+                self._install_refit_schedule(engine)
+            except Exception:
+                logging.getLogger("sitewhere.instance").exception(
+                    "could not install drift-refit schedule for tenant %s",
+                    tenant.token)
         return engine
+
+    # fixed tokens: the install is idempotent across restarts (durable
+    # per-tenant schedule stores would otherwise accrete one job per boot)
+    REFIT_SCHEDULE_TOKEN = "drift-refit-interval"
+    REFIT_JOB_TOKEN = "drift-refit-sweep"
+
+    def _install_refit_schedule(self, engine: TenantEngine) -> None:
+        """Arm the unattended refit loop on one tenant engine: a
+        SIMPLE-trigger schedule at `actuation.refit_interval_s` plus an
+        ACTIVE DRIFT_REFIT job. Created before engine.start(), so the
+        schedule manager's on_start resubmit picks the job up exactly
+        like any job that survived a restart."""
+        from sitewhere_tpu.model.schedule import (
+            Schedule, ScheduledJob, ScheduledJobState, ScheduledJobType,
+            TriggerConstants, TriggerType)
+        management = engine.schedule_management
+        interval_ms = max(1, int(self.refit_interval_s * 1000.0))
+        existing = management.schedules.get_by_token(self.REFIT_SCHEDULE_TOKEN)
+        if existing is None:
+            management.create_schedule(Schedule(
+                token=self.REFIT_SCHEDULE_TOKEN, name="drift refit interval",
+                trigger_type=TriggerType.SIMPLE,
+                trigger_configuration={
+                    TriggerConstants.REPEAT_INTERVAL: str(interval_ms)}))
+        elif existing.trigger_configuration.get(
+                TriggerConstants.REPEAT_INTERVAL) != str(interval_ms):
+            # config changed between boots: durable schedule follows it
+            management.schedules.update(existing.id, {
+                "trigger_configuration": {
+                    TriggerConstants.REPEAT_INTERVAL: str(interval_ms)}})
+        if management.jobs.get_by_token(self.REFIT_JOB_TOKEN) is None:
+            management.create_scheduled_job(ScheduledJob(
+                token=self.REFIT_JOB_TOKEN,
+                schedule_token=self.REFIT_SCHEDULE_TOKEN,
+                job_type=ScheduledJobType.DRIFT_REFIT,
+                job_state=ScheduledJobState.ACTIVE))
 
     # -- scripted rules (durable + replicated) -----------------------------
     def _install_scripted_processor(self, engine, tenant: str, token: str,
@@ -700,6 +793,7 @@ class SiteWhereInstance(LifecycleComponent):
         logging.getLogger("sitewhere").removeHandler(self.log_handler)
         self.log_handler.stop()
         self.log_aggregator.stop()
+        self.serving.stop()  # drain in-flight reads before the log closes
         if self.latency_batcher is not None:
             self.latency_batcher.close()  # flushes pending offers
         self.datastores.stop()
@@ -848,6 +942,9 @@ class SiteWhereInstance(LifecycleComponent):
                     hooks.forwarder.dead_lettered
                 extra["cluster.step_ticks"] = hooks.loop.tick_count
             extra["cluster.degraded_peers"] = len(hooks.degraded)
+        # serving tier: window-grid cache residency rides the hbm.* gauge
+        # family (host RAM here, but the same capacity-planning ledger)
+        extra["hbm.wincache_bytes"] = float(self.window_cache.resident_bytes)
         # failover epoch (runtime/recovery.py): lets dashboards graph
         # restarts/takeovers as step changes and alert on epoch skew
         extra["recovery.epoch"] = float(getattr(self, "recovery_epoch", 0))

@@ -27,6 +27,10 @@ class TriggerConstants:
 class ScheduledJobType(enum.Enum):
     COMMAND_INVOCATION = "CommandInvocation"
     BATCH_COMMAND_INVOCATION = "BatchCommandInvocation"
+    # unattended drift-refit sweeps (actuation/refit.py
+    # DriftRefitJobExecutor) — no reference analogue; the adaptation
+    # loop closed in-platform needs its own trigger type
+    DRIFT_REFIT = "DriftRefit"
 
 
 class ScheduledJobState(enum.Enum):

@@ -81,9 +81,18 @@ class TenantEngine(LifecycleComponent):
             cluster.gossip.register_tenant_registry(tenant.token,
                                                     self.registry)
 
-        # event persistence + triggers
+        # event persistence + triggers. The pipeline packer's device
+        # interner rides along so control-plane appends (inbound persist,
+        # REST event posts, persisted rule alerts) stamp the SAME positive
+        # device_idx the hot path does — lookup() never allocates, so an
+        # unregistered token still lands as idx 0 (UNKNOWN) and the
+        # serving tier's window cache falls back to the monolithic scan
+        # for ranges containing it (serving/wincache.py). Without this
+        # every REST-ingested row was idx 0 and the cache never engaged.
         self.event_management = DeviceEventManagement(
-            log, self.registry, tenant.token)
+            log, self.registry, tenant.token,
+            device_interner=(pipeline_engine.packer.devices
+                             if pipeline_engine is not None else None))
         EventPersistenceTriggers(bus, self.naming,
                                  tenant.token).attach(self.event_management)
 
@@ -137,6 +146,19 @@ class TenantEngine(LifecycleComponent):
             ScheduledJobType.BATCH_COMMAND_INVOCATION,
             BatchCommandInvocationJobExecutor(
                 self.registry, self.batch_manager, self.batch_management))
+        if pipeline_engine is not None and \
+                hasattr(pipeline_engine, "anomaly_model_manifest"):
+            # unattended drift-refit sweeps (PR 19 follow-up): a
+            # DRIFT_REFIT job walks installed anomaly models and pushes
+            # refits through the gossip-replicated upsert path
+            from sitewhere_tpu.actuation.refit import (
+                DriftRefitJobExecutor, DriftRefitter)
+            self.drift_refitter = DriftRefitter(pipeline_engine)
+            self.schedule_manager.register_executor(
+                ScheduledJobType.DRIFT_REFIT,
+                DriftRefitJobExecutor(self.drift_refitter))
+        else:
+            self.drift_refitter = None
 
         for component in (self.event_management, self.inbound, self.enrichment,
                           self.command_delivery, self.registration,

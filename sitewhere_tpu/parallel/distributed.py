@@ -78,6 +78,19 @@ def make_global_mesh(devices: Optional[Sequence] = None) -> Mesh:
     return Mesh(np.asarray(devs), (SHARD_AXIS,))
 
 
+@lru_cache(maxsize=1)
+def live_mesh() -> Optional[Mesh]:
+    """The default replay mesh for the serving planner: a 1-D shard mesh
+    over every visible device, or None when only one device exists (a
+    single chip gains nothing from sharded replay — the host kernel plus
+    one dispatch already wins). Cached: device topology is fixed for the
+    process lifetime."""
+    devs = jax.devices()
+    if len(devs) < 2:
+        return None
+    return Mesh(np.asarray(devs), (SHARD_AXIS,))
+
+
 def process_shard_indices(mesh: Mesh) -> np.ndarray:
     """Shard indices whose devices live on THIS process — the shards this
     host's ingest threads must feed (the multi-host data-loading contract:

@@ -434,6 +434,12 @@ class TenantEventLog:
         self._segments: List[_Segment] = []
         self._seg_paths: List[Optional[str]] = []
         self._lock = threading.Lock()
+        # Bumped whenever sealed segments are REMOVED (retention). Sealing
+        # only appends, so `(retention_epoch, len(_segments))` is a
+        # monotonic watermark within an epoch: anything cached over sealed
+        # segments [0, n) stays exact until the epoch changes
+        # (serving/wincache.py keys its grids on this pair).
+        self.retention_epoch = 0
         self._dir = None
         self._spill = spill and data_dir is not None
         self._next_seg = 0
@@ -548,6 +554,60 @@ class TenantEventLog:
     def count(self) -> int:
         with self._lock:
             return self._buffer.n + sum(s.n for s in self._segments)
+
+    def sealed_snapshot(self) -> Tuple[int, List[_Segment],
+                                       Optional[_Segment]]:
+        """`(retention_epoch, sealed_segments, pending)` under one lock
+        acquisition. Segments are immutable and the list is append-only
+        within an epoch, so a reader can fold the snapshot lock-free while
+        appends/seals proceed — the snapshot-isolation contract the
+        serving tier's cache and delta scans are built on. `pending` is
+        the buffered (unsealed, still-growing) tail; it must be re-read
+        per query, never cached."""
+        with self._lock:
+            return (self.retention_epoch, list(self._segments),
+                    self._buffer.peek())
+
+    def estimate_rows(self, flt: EventFilter) -> int:
+        """Upper-bound row count a scan of `flt` would touch, from the
+        per-segment skip index alone — O(segments), no column reads. The
+        query planner routes host-vs-mesh on this estimate."""
+        with self._lock:
+            segments = list(self._segments)
+            pending_n = self._buffer.n
+        n = pending_n
+        for seg in segments:
+            if flt.start_date is not None and seg.max_date < flt.start_date:
+                continue
+            if flt.end_date is not None and seg.min_date > flt.end_date:
+                continue
+            if flt.device_idx is not None and not (
+                    seg.min_dev <= flt.device_idx <= seg.max_dev):
+                continue
+            n += seg.n
+        return n
+
+    def retain_max_segments(self, keep: int) -> int:
+        """Drop the OLDEST sealed segments past `keep` (retention). Bumps
+        `retention_epoch` so every cached grid over this log invalidates;
+        parquet spills are unlinked outside the lock. Returns segments
+        dropped."""
+        keep = max(0, int(keep))
+        with self._lock:
+            drop = len(self._segments) - keep
+            if drop <= 0:
+                return 0
+            dropped_paths = self._seg_paths[:drop]
+            self._segments = self._segments[drop:]
+            self._seg_paths = self._seg_paths[drop:]
+            self.retention_epoch += 1
+        for path in dropped_paths:
+            if path is not None:
+                try:
+                    os.remove(path)
+                except OSError:
+                    pass
+        return drop
 
     def _id_segments(self) -> List[Dict[str, np.ndarray]]:
         with self._lock:
@@ -682,6 +742,19 @@ class ColumnarEventLog:
     def rows_above(self, tenant: str, marks: Dict[str, int]) -> int:
         log = self.tenant_if_exists(tenant)
         return 0 if log is None else log.rows_above(marks)
+
+    def estimate_rows(self, tenant: str, flt: EventFilter) -> int:
+        """Skip-index scan-size estimate for the query planner (see
+        TenantEventLog.estimate_rows)."""
+        log = self.tenant_if_exists(tenant)
+        return 0 if log is None else log.estimate_rows(flt)
+
+    def retain_max_segments(self, tenant: str, keep: int) -> int:
+        """Retention facade: drop a tenant's oldest sealed segments past
+        `keep` (bumps that log's retention_epoch — cached grids over it
+        invalidate)."""
+        log = self.tenant_if_exists(tenant)
+        return 0 if log is None else log.retain_max_segments(keep)
 
     # -- hot-path append ---------------------------------------------------
     def append_batch(self, tenant: str, batch, packer,

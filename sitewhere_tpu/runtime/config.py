@@ -233,6 +233,22 @@ DEFAULTS: Dict = {
     # traceparent propagation). 0 disables sampling entirely — the
     # disarmed path is one modulo per delivery.
     "observability": {"trace_sample_n": 0},
+    # concurrent query serving tier (serving/, docs/SERVING.md): bounded
+    # analytics readers behind per-tenant admission + the incremental
+    # window-grid cache. latency_budget_ms 0 disables the p99 shed gate;
+    # mesh_row_threshold None keeps the planner's measured default.
+    "serving": {
+        "workers": 4,
+        "queue_depth_budget": 64,
+        "latency_budget_ms": 0,
+        "cache_mb": 64,
+        "mesh_row_threshold": None,
+    },
+    # unattended drift-refit sweeps (actuation/refit.py
+    # DriftRefitJobExecutor): interval in seconds between sweeps over the
+    # installed anomaly models. OFF by default (None) — an autonomous
+    # refit rewrites live model constants, so it is operator opt-in.
+    "actuation": {"refit_interval_s": None},
     # deterministic fault injection + ingest admission (runtime/faults.py,
     # sources/manager.py AdmissionController; config_model faults_model;
     # docs/OPERATIONS.md "Fault drills"). Everything off by default:

@@ -1,0 +1,18 @@
+"""Concurrent query serving tier (docs/SERVING.md).
+
+Reads that never stall ingest: a planner normalizes every
+`measurement_windows`-shaped request and routes it host-vs-mesh by
+estimated scan size (serving/planner.py), an incremental window cache
+reuses finalized `[K, W]` grids across dashboard polls by folding only
+the segments sealed since the cached watermark (serving/wincache.py),
+and a bounded executor runs it all behind per-tenant read admission
+with a structured 429 (serving/executor.py)."""
+
+from sitewhere_tpu.serving.executor import (  # noqa: F401
+    QueryExecutor, QueryShedError)
+from sitewhere_tpu.serving.planner import (  # noqa: F401
+    QueryPlan, QueryPlanner, WindowQuery)
+from sitewhere_tpu.serving.wincache import WindowGridCache  # noqa: F401
+
+__all__ = ["QueryExecutor", "QueryShedError", "QueryPlan", "QueryPlanner",
+           "WindowQuery", "WindowGridCache"]

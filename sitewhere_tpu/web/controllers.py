@@ -242,6 +242,72 @@ def register_all(router: Router, instance, server) -> None:
                authority=SiteWhereRoles.VIEW_SERVER_INFO)
 
     # ------------------------------------------------------------------
+    # Serving tier — concurrent windowed analytics reads (serving/,
+    # docs/SERVING.md). Every request goes through the QueryExecutor:
+    # planner-routed host-vs-mesh replay behind the incremental grid
+    # cache and per-tenant read admission — an over-budget poller gets
+    # the structured 429 (QueryShedError) straight from submit.
+    # ------------------------------------------------------------------
+    def get_analytics_windows(request: Request):
+        """GET /api/analytics/windows — per-device windowed stats for the
+        request's tenant. `start_ms`+`end_ms` make the read cacheable
+        (the grid origin is pinned); `keys` bounds the rows returned."""
+        from sitewhere_tpu.serving import WindowQuery
+        _engine(request)  # tenant existence + authorization gate
+        query = WindowQuery(
+            tenant=request.tenant or "default",
+            window_ms=max(1, request.query_int("window_ms", 60_000)),
+            mm_name=request.query_one("mm"),
+            start_ms=(int(request.query_one("start_ms"))
+                      if request.query_one("start_ms") is not None else None),
+            end_ms=(int(request.query_one("end_ms"))
+                    if request.query_one("end_ms") is not None else None),
+            area_id=request.query_one("area"),
+            max_windows=min(4096, request.query_int("max_windows", 1024)))
+        served = instance.serving.query(query)
+        report, span = served["report"], served["span"]
+        max_keys = min(256, request.query_int("keys", 64))
+
+        def _col(arr, row):
+            # NaN/inf (empty windows) are not strict-JSON; clients get null
+            return [v if v == v and abs(v) != float("inf") else None
+                    for v in (float(x) for x in arr[row, :report.n_windows])]
+
+        keys = []
+        for row in range(min(report.num_keys, max_keys)):
+            keys.append({
+                "id": int(report.key_ids[row]),
+                "token": report.key_tokens[row],
+                "count": [int(c) for c in
+                          report.stats.count[row, :report.n_windows]],
+                "sum": _col(report.stats.sum, row),
+                "mean": _col(report.stats.mean, row),
+                "min": _col(report.stats.min, row),
+                "max": _col(report.stats.max, row),
+            })
+        return {
+            "t0_ms": int(report.t0_ms),
+            "window_ms": int(report.window_ms),
+            "n_windows": int(report.n_windows),
+            "num_keys": report.num_keys,
+            "keys": keys,
+            "serving": {"route": span["route"],
+                        "cache_hit": span["cache_hit"],
+                        "est_rows": span["est_rows"],
+                        "total_ms": span["total_ms"]},
+        }
+
+    def get_serving_report(request: Request):
+        """GET /api/serving/report — the read-side flight plane: pool +
+        admission state, cache residency/hit counters, recent spans."""
+        return instance.serving.report()
+
+    router.get("/api/analytics/windows", get_analytics_windows,
+               authority=REST)
+    router.get("/api/serving/report", get_serving_report,
+               authority=SiteWhereRoles.VIEW_SERVER_INFO)
+
+    # ------------------------------------------------------------------
     # Rule management — the operator surface of the fused pipeline rules
     # (pipeline/engine.py add_threshold_rule/add_geofence_rule; reference:
     # service-rule-processing ZoneTestRuleProcessor.java:33 configured via

@@ -95,6 +95,109 @@ def test_global_mesh_and_local_shards(mesh):
     np.testing.assert_array_equal(local, np.arange(8, dtype=np.int32))
 
 
+def _assert_bit_identical(got, ref):
+    """All five stat grids byte-for-byte equal (NaN == NaN: the empty-
+    window sentinel is part of the contract, not a tolerance)."""
+    for field in ("count", "sum", "mean", "min", "max"):
+        a = np.asarray(getattr(got, field))
+        b = np.asarray(getattr(ref, field))
+        assert a.dtype == b.dtype and a.shape == b.shape, field
+        equal = np.array_equal(a, b, equal_nan=a.dtype.kind == "f")
+        assert equal, f"{field} differs:\n{a}\nvs host\n{b}"
+
+
+@pytest.mark.parametrize("combine", ["psum", "ring"])
+@pytest.mark.parametrize("K,W,n", [
+    (1, 1, 64),      # degenerate grid
+    (5, 3, 257),     # odd everything; rows not divisible by the mesh
+    (64, 7, 1001),   # K >> keys-present: trailing all-empty key rows
+    (32, 16, 4096),  # even split across the 8-way mesh
+])
+def test_sharded_bit_identical_to_host(mesh, combine, K, W, n):
+    """The serving planner routes large scans onto the mesh BY DEFAULT, so
+    the sharded grid must be bit-identical to the host kernel — not merely
+    allclose. Integer-valued float32 rows make every partial sum exact, so
+    any shard split / combine order must reproduce the host bytes."""
+    window_ms = 250
+    rng = np.random.default_rng(K * 1000 + W)
+    keys = rng.integers(0, K, n).astype(np.int32)
+    ts = rng.integers(0, W * window_ms, n).astype(np.int32)
+    value = rng.integers(-50, 50, n).astype(np.float32)
+    valid = rng.random(n) > 0.2
+    ref = windowed_stats(keys, ts, value, valid, window_ms=window_ms,
+                         num_keys=K, n_windows=W)
+    got = sharded_windowed_stats(keys, ts, value, valid,
+                                 window_ms=window_ms, num_keys=K,
+                                 n_windows=W, mesh=mesh, combine=combine)
+    _assert_bit_identical(got, ref)
+
+
+@pytest.mark.parametrize("combine", ["psum", "ring"])
+def test_sharded_empty_window_sentinels(mesh, combine):
+    """Empty-window sentinel edges through the combines: a window empty on
+    SOME shards must not leak the ring/psum ±inf masking into min/max, and
+    a window empty on EVERY shard must finalize to NaN exactly like the
+    host kernel. Row layout is chosen against the 8-way split (2 rows per
+    shard at n=16): key 0 lives only on shard 0, key 1 never occurs
+    (all-empty grid row), key 2 puts window 0 on a single middle shard
+    and window 1 on two different shards."""
+    K, W, window_ms = 3, 2, 100
+    n = 16
+    keys = np.full(n, 1, np.int32)       # key 1 rows all invalidated below
+    ts = np.zeros(n, np.int32)
+    value = np.zeros(n, np.float32)
+    valid = np.zeros(n, bool)
+    # key 0: both rows on shard 0, window 0
+    keys[0:2] = 0
+    ts[0:2] = (10, 20)
+    value[0:2] = (5.0, -3.0)
+    valid[0:2] = True
+    # key 2 / window 0: one row on shard 3 only
+    keys[6] = 2
+    ts[6] = 50
+    value[6] = 7.0
+    valid[6] = True
+    # key 2 / window 1: one row each on shards 5 and 7
+    keys[10] = 2
+    ts[10] = 150
+    value[10] = -9.0
+    valid[10] = True
+    keys[14] = 2
+    ts[14] = 199
+    value[14] = 4.0
+    valid[14] = True
+    ref = windowed_stats(keys, ts, value, valid, window_ms=window_ms,
+                         num_keys=K, n_windows=W)
+    got = sharded_windowed_stats(keys, ts, value, valid,
+                                 window_ms=window_ms, num_keys=K,
+                                 n_windows=W, mesh=mesh, combine=combine)
+    _assert_bit_identical(got, ref)
+    g = np.asarray(got.min)
+    # occupied cells kept finite values (no inf sentinel leak)...
+    assert g[0, 0] == -3.0 and g[2, 0] == 7.0 and g[2, 1] == -9.0
+    assert np.asarray(got.max)[2, 1] == 4.0
+    # ...and fully-empty cells are NaN with zero count/sum
+    assert np.isnan(np.asarray(got.mean)[1]).all()
+    assert np.isnan(g[1]).all() and np.isnan(g[0, 1])
+    assert np.asarray(got.count)[1].sum() == 0
+    assert np.asarray(got.sum)[1].sum() == 0.0
+
+
+@pytest.mark.parametrize("combine", ["psum", "ring"])
+def test_sharded_all_rows_invalid(mesh, combine):
+    """valid=False everywhere: the whole grid is empty — every cell must
+    carry the NaN sentinel bit-identically to the host kernel."""
+    keys, ts, value, _ = _replay(n=64, K=4, W=4, window_ms=100, seed=9)
+    valid = np.zeros(64, bool)
+    ref = windowed_stats(keys, ts, value, valid, window_ms=100,
+                         num_keys=4, n_windows=4)
+    got = sharded_windowed_stats(keys, ts, value, valid, window_ms=100,
+                                 num_keys=4, n_windows=4, mesh=mesh,
+                                 combine=combine)
+    _assert_bit_identical(got, ref)
+    assert np.isnan(np.asarray(got.mean)).all()
+
+
 def test_analytics_engine_mesh_replay(mesh):
     """End-to-end: columnar log replay -> window-sharded grids over the
     8-device mesh match the single-device engine output."""

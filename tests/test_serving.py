@@ -1,0 +1,548 @@
+"""Concurrent query serving tier (sitewhere_tpu/serving/).
+
+Planner routing (host vs mesh by estimated scan size), incremental
+window-cache exactness against the monolithic engine oracle (cold, warm
+delta-scan, retention invalidation, LRU budget, idx-0 fallback), read
+admission (structured 429), the readers-vs-writer concurrency contract
+(snapshot isolation: no torn reads, monotonic watermarks), the
+vectorized replay path vs the per-record loop oracle it replaced, and
+the unattended drift-refit schedule wiring.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from sitewhere_tpu.analytics.engine import WindowedAnalyticsEngine
+from sitewhere_tpu.model.event import (DeviceEventContext, DeviceLocation,
+                                       DeviceMeasurement)
+from sitewhere_tpu.persist.eventlog import ColumnarEventLog
+from sitewhere_tpu.serving import (QueryExecutor, QueryPlanner,
+                                   WindowGridCache)
+from sitewhere_tpu.serving.executor import QueryShedError
+from sitewhere_tpu.serving.planner import QueryPlan, WindowQuery
+
+T0 = 1_700_000_000_000
+WINDOW_MS = 60_000
+SPAN_MS = 10 * WINDOW_MS
+
+
+class _Interner:
+    """Positive-index device interner (idx 0 = the 'not interned'
+    sentinel that marks rows uncacheable)."""
+
+    def __init__(self):
+        self._map = {}
+
+    def lookup(self, token):
+        return self._map.setdefault(token, len(self._map) + 1)
+
+
+def _append(log, tenant, interner, rows, flush=True):
+    """rows = [(token, offset_ms, value)] -> one append (+ one sealed
+    segment when flushed)."""
+    events = [DeviceMeasurement(name="temp", value=float(v), device_id=tok,
+                                event_date=T0 + int(dt))
+              for tok, dt, v in rows]
+    log.append_events(tenant, events, interner)
+    if flush:
+        log.flush_tenant(tenant)
+
+
+def _rows(rng, n, n_tokens=8):
+    return [(f"dev-{int(rng.integers(0, n_tokens))}",
+             int(rng.integers(0, SPAN_MS)),
+             float(rng.integers(-40, 40))) for _ in range(n)]
+
+
+def _query(tenant="t1"):
+    return WindowQuery(tenant=tenant, window_ms=WINDOW_MS, start_ms=T0,
+                       end_ms=T0 + SPAN_MS)
+
+
+def _grid(report):
+    """token -> per-window stat rows over the real (unpadded) grid."""
+    s = report.stats
+    return {tok: tuple(np.asarray(getattr(s, f))[i, :report.n_windows]
+                       for f in ("count", "sum", "mean", "min", "max"))
+            for i, tok in enumerate(report.key_tokens)}
+
+
+def _assert_matches_oracle(got, ref):
+    assert got.t0_ms == ref.t0_ms
+    assert got.window_ms == ref.window_ms
+    assert got.n_windows == ref.n_windows
+    assert sorted(got.key_tokens) == sorted(ref.key_tokens)
+    g, r = _grid(got), _grid(ref)
+    for tok in r:
+        for a, b in zip(g[tok], r[tok]):
+            np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-6,
+                                       equal_nan=True)
+
+
+# -- planner ------------------------------------------------------------------
+
+class _FakeLog:
+    def __init__(self, rows):
+        self.rows = rows
+
+    def estimate_rows(self, tenant, flt):
+        return self.rows
+
+    def tenant_if_exists(self, tenant):
+        return None
+
+
+class TestPlanner:
+    def test_small_scan_routes_host(self):
+        planner = QueryPlanner(_FakeLog(100),
+                               mesh_provider=lambda: "MESH",
+                               mesh_row_threshold=1000)
+        plan = planner.plan(_query())
+        assert isinstance(plan, QueryPlan)
+        assert plan.route == "host" and plan.mesh is None
+        assert plan.est_rows == 100
+
+    def test_large_scan_routes_mesh_by_default(self):
+        planner = QueryPlanner(_FakeLog(5000),
+                               mesh_provider=lambda: "MESH",
+                               mesh_row_threshold=1000)
+        plan = planner.plan(_query())
+        assert plan.route == "mesh" and plan.mesh == "MESH"
+        assert planner.choose_mesh("t1", _query().filter()) == "MESH"
+
+    def test_no_mesh_provider_stays_host(self):
+        planner = QueryPlanner(_FakeLog(10**9), mesh_row_threshold=1000)
+        assert planner.plan(_query()).route == "host"
+        assert planner.choose_mesh("t1", _query().filter()) is None
+
+    def test_mesh_provider_failure_degrades_to_host(self):
+        def boom():
+            raise RuntimeError("no devices")
+        planner = QueryPlanner(_FakeLog(10**9), mesh_provider=boom,
+                               mesh_row_threshold=1)
+        assert planner.plan(_query()).route == "host"
+
+    def test_cacheability(self):
+        planner = QueryPlanner(_FakeLog(1))
+        # explicit range on a snapshot-capable log: cacheable
+        assert planner.plan(_query()).cacheable
+        # open range: the grid origin moves with every append
+        assert not planner.plan(WindowQuery(tenant="t1")).cacheable
+        # histogram queries bypass the cache
+        assert not planner.plan(WindowQuery(
+            tenant="t1", start_ms=T0, end_ms=T0 + SPAN_MS,
+            with_type_histogram=True)).cacheable
+
+    def test_widerow_store_degrades(self):
+        class _WideRow:  # no estimate_rows, no tenant_if_exists
+            pass
+        planner = QueryPlanner(_WideRow(), mesh_provider=lambda: "MESH",
+                               mesh_row_threshold=1)
+        plan = planner.plan(_query())
+        assert plan.route == "host" and not plan.cacheable
+        assert plan.est_rows == 0
+
+
+# -- incremental window cache -------------------------------------------------
+
+class TestWindowGridCache:
+    def _fixture(self, n_segments=3, seed=0):
+        log = ColumnarEventLog()
+        interner = _Interner()
+        rng = np.random.default_rng(seed)
+        for _ in range(n_segments):
+            _append(log, "t1", interner, _rows(rng, 200))
+        return log, interner, rng, WindowedAnalyticsEngine(log)
+
+    def _serve(self, cache, log, q=None):
+        q = q or _query()
+        served = cache.query(log.tenant_if_exists("t1"), tenant="t1",
+                             flt=q.filter(), window_ms=q.window_ms,
+                             start_ms=q.start_ms, end_ms=q.end_ms,
+                             max_windows=q.max_windows)
+        assert served is not None
+        return served
+
+    def _oracle(self, engine, q=None):
+        q = q or _query()
+        return engine.measurement_windows(
+            "t1", window_ms=q.window_ms, start_ms=q.start_ms,
+            end_ms=q.end_ms, max_windows=q.max_windows)
+
+    def test_cold_then_warm_exact(self):
+        log, interner, rng, engine = self._fixture()
+        cache = WindowGridCache()
+        report, info = self._serve(cache, log)
+        assert not info["cache_hit"] and info["watermark"] == 3
+        _assert_matches_oracle(report, self._oracle(engine))
+        # warm: same grid, zero delta rows
+        report2, info2 = self._serve(cache, log)
+        assert info2["cache_hit"] and info2["delta_rows"] == 0
+        _assert_matches_oracle(report2, self._oracle(engine))
+
+    def test_delta_scan_after_seal_and_unsealed_tail(self):
+        log, interner, rng, engine = self._fixture()
+        cache = WindowGridCache()
+        self._serve(cache, log)
+        # one new sealed segment + a buffered (unsealed) tail
+        _append(log, "t1", interner, _rows(rng, 150))
+        _append(log, "t1", interner, _rows(rng, 37), flush=False)
+        report, info = self._serve(cache, log)
+        assert info["cache_hit"] and info["delta_segments"] == 1
+        assert info["delta_rows"] == 150 + 37
+        assert info["watermark"] == 4
+        _assert_matches_oracle(report, self._oracle(engine))
+        # the tail was folded into the RESULT but never stored: a repeat
+        # query re-folds it
+        report2, info2 = self._serve(cache, log)
+        assert info2["cache_hit"] and info2["delta_rows"] == 37
+        _assert_matches_oracle(report2, self._oracle(engine))
+
+    def test_retention_invalidates_and_rebuilds_exact(self):
+        log, interner, rng, engine = self._fixture(n_segments=4)
+        cache = WindowGridCache()
+        self._serve(cache, log)
+        dropped = log.retain_max_segments("t1", 2)
+        assert dropped == 2
+        report, info = self._serve(cache, log)
+        assert not info["cache_hit"]  # retention epoch bumped: rebuilt
+        assert info["watermark"] == 2
+        _assert_matches_oracle(report, self._oracle(engine))
+
+    def test_idx0_rows_uncacheable(self):
+        log = ColumnarEventLog()
+        # no interner: device_idx stays 0 -> synthetic keys the
+        # incremental fold cannot reproduce
+        _append(log, "t1", None, [("dev-1", 10, 1.0), ("dev-2", 20, 2.0)])
+        cache = WindowGridCache()
+        q = _query()
+        served = cache.query(log.tenant_if_exists("t1"), tenant="t1",
+                             flt=q.filter(), window_ms=q.window_ms,
+                             start_ms=q.start_ms, end_ms=q.end_ms,
+                             max_windows=q.max_windows)
+        assert served is None and len(cache) == 0
+
+    def test_lru_byte_budget_evicts(self):
+        log, interner, rng, engine = self._fixture()
+        cache = WindowGridCache(max_bytes=1)  # everything over budget
+        self._serve(cache, log)
+        base = cache.evict_counter.value
+        # a second distinct key forces the first out (the LRU keeps >= 1)
+        q2 = WindowQuery(tenant="t1", window_ms=2 * WINDOW_MS, start_ms=T0,
+                         end_ms=T0 + SPAN_MS)
+        self._serve(cache, log, q2)
+        assert len(cache) == 1
+        assert cache.evict_counter.value > base
+        assert cache.resident_bytes <= max(
+            e.fold.nbytes for e in cache._entries.values())
+
+    def test_invalidate_by_tenant(self):
+        log, interner, rng, engine = self._fixture()
+        cache = WindowGridCache()
+        self._serve(cache, log)
+        assert cache.invalidate("other") == 0
+        assert cache.invalidate("t1") == 1
+        assert len(cache) == 0 and cache.resident_bytes == 0
+
+
+# -- executor admission -------------------------------------------------------
+
+class _GatedEngine:
+    """Engine stub whose scans block on an event — makes queue depth
+    deterministic."""
+
+    def __init__(self, log, gate):
+        self.event_log = log
+        self.gate = gate
+        self.calls = 0
+
+    def measurement_windows(self, tenant, **kwargs):
+        self.calls += 1
+        assert self.gate.wait(10.0)
+        return "report"
+
+
+class TestExecutorAdmission:
+    def test_depth_budget_sheds_structured_429(self):
+        log = ColumnarEventLog()
+        log.tenant("t1")
+        gate = threading.Event()
+        engine = _GatedEngine(log, gate)
+        ex = QueryExecutor(engine, QueryPlanner(log), WindowGridCache(),
+                           workers=1, queue_depth_budget=1)
+        try:
+            open_q = WindowQuery(tenant="t1")  # uncacheable: hits engine
+            fut = ex.submit(open_q)
+            with pytest.raises(QueryShedError) as err:
+                ex.submit(open_q)
+            assert err.value.http_status == 429
+            assert ex.shed_counter.value >= 1
+            # other tenants are not starved by t1's depth
+            gate.set()
+            assert fut.result(10.0)["report"] == "report"
+        finally:
+            gate.set()
+            ex.stop()
+
+    def test_latency_budget_sheds_after_slow_queries(self):
+        log = ColumnarEventLog()
+        log.tenant("t1")
+        gate = threading.Event()
+        gate.set()  # scans return immediately
+        ex = QueryExecutor(_GatedEngine(log, gate), QueryPlanner(log),
+                           WindowGridCache(), workers=2,
+                           queue_depth_budget=64,
+                           latency_budget_ms=1e-6)
+        try:
+            ex.query(WindowQuery(tenant="t1"), timeout=10.0)  # admitted
+            with pytest.raises(QueryShedError):
+                ex.submit(WindowQuery(tenant="t1"))
+        finally:
+            ex.stop()
+
+    def test_report_shape(self):
+        log = ColumnarEventLog()
+        interner = _Interner()
+        _append(log, "t1", interner, [("dev-1", 10, 1.0)])
+        ex = QueryExecutor(WindowedAnalyticsEngine(log), QueryPlanner(log),
+                           WindowGridCache(), workers=2)
+        try:
+            out = ex.query(_query(), timeout=10.0)
+            assert out["span"]["route"] == "cache"
+            assert out["info"]["cache_hit"] is False
+            rep = ex.report()
+            assert rep["queries"] == 1 and rep["workers"] == 2
+            assert rep["cache"]["entries"] == 1
+            assert rep["spans"][-1]["tenant"] == "t1"
+        finally:
+            ex.stop()
+
+
+# -- readers vs writer (snapshot isolation) ----------------------------------
+
+class TestConcurrentServing:
+    BATCH = 7
+
+    def test_readers_never_tear_while_writer_seals(self):
+        log = ColumnarEventLog()
+        interner = _Interner()
+        rng = np.random.default_rng(11)
+        _append(log, "t1", interner, _rows(rng, self.BATCH))
+        engine = WindowedAnalyticsEngine(log)
+        cache = WindowGridCache()
+        ex = QueryExecutor(engine, QueryPlanner(log), cache, workers=4,
+                           queue_depth_budget=256)
+        stop = threading.Event()
+        errors = []
+
+        def writer():
+            wrng = np.random.default_rng(12)
+            try:
+                for i in range(40):
+                    # every append lands the full batch atomically;
+                    # alternate sealed segments and buffered tails
+                    _append(log, "t1", interner,
+                            _rows(wrng, self.BATCH), flush=i % 2 == 0)
+                log.flush_tenant("t1")
+            except Exception as exc:  # pragma: no cover
+                errors.append(exc)
+            finally:
+                stop.set()
+
+        def reader(observed):
+            try:
+                while not stop.is_set():
+                    out = ex.query(_query(), timeout=30.0)
+                    total = int(np.asarray(
+                        out["report"].stats.count).sum())
+                    observed.append((total,
+                                     out["info"].get("watermark", 0)))
+            except Exception as exc:  # pragma: no cover
+                errors.append(exc)
+
+        logs = [[] for _ in range(3)]
+        threads = [threading.Thread(target=reader, args=(obs,))
+                   for obs in logs] + [threading.Thread(target=writer)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(60.0)
+        assert not errors
+        for obs in logs:
+            assert obs, "reader made no progress"
+            totals = [t for t, _ in obs]
+            marks = [w for _, w in obs]
+            # snapshot isolation: a scan sees whole appended batches only
+            assert all(t % self.BATCH == 0 for t in totals), totals[:10]
+            # sequential reads in one thread never go backwards
+            assert totals == sorted(totals)
+            assert marks == sorted(marks)
+        # settled state is exact vs the monolithic oracle
+        final = ex.query(_query(), timeout=30.0)
+        oracle = engine.measurement_windows(
+            "t1", window_ms=WINDOW_MS, start_ms=T0, end_ms=T0 + SPAN_MS)
+        _assert_matches_oracle(final["report"], oracle)
+        assert int(np.asarray(final["report"].stats.count).sum()) == \
+            41 * self.BATCH
+        ex.stop()
+
+    def test_retention_under_serving_stays_exact(self):
+        log = ColumnarEventLog()
+        interner = _Interner()
+        rng = np.random.default_rng(21)
+        for _ in range(6):
+            _append(log, "t1", interner, _rows(rng, 50))
+        engine = WindowedAnalyticsEngine(log)
+        ex = QueryExecutor(engine, QueryPlanner(log), WindowGridCache(),
+                           workers=2)
+        try:
+            warm = ex.query(_query(), timeout=10.0)
+            assert warm["span"]["route"] == "cache"
+            assert log.retain_max_segments("t1", 3) == 3
+            after = ex.query(_query(), timeout=10.0)
+            assert after["info"]["cache_hit"] is False
+            assert after["info"]["watermark"] == 3
+            oracle = engine.measurement_windows(
+                "t1", window_ms=WINDOW_MS, start_ms=T0,
+                end_ms=T0 + SPAN_MS)
+            _assert_matches_oracle(after["report"], oracle)
+        finally:
+            ex.stop()
+
+
+# -- vectorized replay vs the loop oracle ------------------------------------
+
+class TestVectorizedReplay:
+    def test_replay_matches_per_record_loop_oracle(self):
+        from sitewhere_tpu.analytics.engine import BusReplayAnalytics
+        from sitewhere_tpu.pipeline.enrichment import (pack_enriched,
+                                                       unpack_enriched)
+        from sitewhere_tpu.runtime.bus import EventBus, TopicNaming
+
+        bus = EventBus(partitions=2)
+        naming = TopicNaming()
+        topic = naming.inbound_enriched_events("t1")
+        ctx = DeviceEventContext(device_id="d", device_token="d",
+                                 tenant_id="t1")
+        rng = np.random.default_rng(5)
+        for i in range(600):
+            tok = f"dev-{int(rng.integers(0, 12))}"
+            if i % 9 == 0:  # non-measurement rows must be skipped
+                ev = DeviceLocation(latitude=1.0, longitude=2.0,
+                                    device_id=tok, event_date=T0 + i)
+            else:
+                value = float("nan") if i % 50 == 3 else float(
+                    rng.integers(-30, 30))
+                ev = DeviceMeasurement(name="temp", value=value,
+                                       device_id=tok, event_date=T0 + i)
+            bus.publish(topic, tok.encode(), pack_enriched(ctx, ev))
+
+        got = BusReplayAnalytics(bus, naming).replay_measurements(
+            "t1", window_ms=100, group_id="vec")
+
+        # the pre-vectorization reference: per-record full decode +
+        # dict-setdefault interning, same kernel underneath
+        from sitewhere_tpu.model.event import DeviceEventType
+        consumer = bus.consumer(topic, "oracle")
+        consumer.seek_to_beginning()
+        key_of, keys, dates, values = {}, [], [], []
+        while True:
+            batch = consumer.poll(8192)
+            if not batch:
+                break
+            for record in batch:
+                _, ev = unpack_enriched(record.value)
+                if ev.event_type != DeviceEventType.MEASUREMENT:
+                    continue
+                token = ev.device_id or ""
+                keys.append(key_of.setdefault(token, len(key_of)))
+                dates.append(ev.event_date)
+                values.append(getattr(ev, "value", 0.0) or 0.0)
+        ref = WindowedAnalyticsEngine._build_report(
+            np.asarray(keys, np.int64), np.asarray(dates, np.int64),
+            np.asarray(values, np.float32), window_ms=100, start_ms=None,
+            end_ms=None, max_windows=4096, tokens=list(key_of))
+
+        # first-appearance key numbering preserved exactly
+        assert got.key_tokens == ref.key_tokens
+        assert got.t0_ms == ref.t0_ms and got.n_windows == ref.n_windows
+        _assert_matches_oracle(got, ref)
+
+
+# -- unattended drift-refit schedule -----------------------------------------
+
+class TestDriftRefitSchedule:
+    def test_job_executor_sweeps_and_counts(self):
+        from sitewhere_tpu.actuation.refit import DriftRefitJobExecutor
+        from sitewhere_tpu.runtime.metrics import MetricsRegistry
+
+        class _Engine:
+            def anomaly_model_manifest(self):
+                return [{"spec": {"token": "m1"}}, {"spec": {"token": "m2"}}]
+
+        class _Refitter:
+            engine = _Engine()
+            calls = []
+
+            def refit(self, token, apply=True):
+                self.calls.append(token)
+                return None if token == "m2" else {"token": token}
+
+        class _Job:
+            job_configuration = {}
+
+        registry = MetricsRegistry()
+        refitter = _Refitter()
+        executor = DriftRefitJobExecutor(refitter, metrics=registry)
+        out = executor.execute(_Job())
+        assert out == {"models": 2, "applied": 1}
+        assert refitter.calls == ["m1", "m2"]
+        assert executor.sweep_counter.value == 1
+        # the models subset in job configuration narrows the sweep
+        class _SubsetJob:
+            job_configuration = {"models": "m1"}
+        assert executor.execute(_SubsetJob()) == {"models": 1, "applied": 1}
+
+    def test_install_is_idempotent_and_follows_interval(self):
+        from sitewhere_tpu.model.schedule import TriggerConstants
+        from sitewhere_tpu.instance import SiteWhereInstance
+
+        instance = SiteWhereInstance(instance_id="refit-test",
+                                     enable_pipeline=True,
+                                     refit_interval_s=30.0)
+        instance.start()
+        try:
+            engine = instance.engine_manager.get_engine("default")
+            assert engine is not None and engine.drift_refitter is not None
+            management = engine.schedule_management
+            sched = management.schedules.get_by_token(
+                SiteWhereInstance.REFIT_SCHEDULE_TOKEN)
+            assert sched is not None
+            assert sched.trigger_configuration[
+                TriggerConstants.REPEAT_INTERVAL] == "30000"
+            job = management.jobs.get_by_token(
+                SiteWhereInstance.REFIT_JOB_TOKEN)
+            assert job is not None
+            # re-install with a new interval: updates in place, no
+            # second schedule/job accretes
+            n_schedules = len(management.schedules.all())
+            n_jobs = len(management.jobs.all())
+            instance.refit_interval_s = 60.0
+            instance._install_refit_schedule(engine)
+            assert len(management.schedules.all()) == n_schedules
+            assert len(management.jobs.all()) == n_jobs
+            sched = management.schedules.get_by_token(
+                SiteWhereInstance.REFIT_SCHEDULE_TOKEN)
+            assert sched.trigger_configuration[
+                TriggerConstants.REPEAT_INTERVAL] == "60000"
+            jobs = [j for j in management.jobs.all()
+                    if j.token == SiteWhereInstance.REFIT_JOB_TOKEN]
+            assert len(jobs) == 1
+        finally:
+            instance.stop()
+
+    def test_refit_knob_off_by_default(self):
+        from sitewhere_tpu.runtime.config import DEFAULTS
+        assert DEFAULTS["actuation"]["refit_interval_s"] is None
